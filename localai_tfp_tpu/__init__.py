@@ -1,0 +1,26 @@
+"""localai_tfp_tpu — a TPU-native, OpenAI-compatible inference framework.
+
+A brand-new framework with the capabilities of LocalAI (the reference at
+/root/reference): an OpenAI/ElevenLabs/Jina-compatible REST server whose model
+execution is built on JAX/XLA/pjit with Pallas kernels, targeting TPU v5e/v5p.
+
+Top-level layout (mirrors the reference's layer map, SURVEY.md §1, re-designed
+TPU-first):
+
+- ``config``   — per-model YAML configs (ref: core/config/backend_config.go)
+- ``models``   — pure-JAX model families (ref: L0 compute engines)
+- ``ops``      — attention / sampling / KV-cache ops, Pallas kernels
+- ``parallel`` — mesh, sharding rules, collectives (ref: §2.5 parallelism)
+- ``engine``   — continuous-batching serving core
+                 (ref: backend/cpp/llama/grpc-server.cpp update_slots)
+- ``server``   — HTTP API layer (ref: core/http)
+- ``grammars`` — grammar-constrained decoding for tool calls
+                 (ref: pkg/functions)
+- ``workers``  — non-LLM modality workers: embeddings, images, audio
+- ``store``    — vector store (ref: backend/go/stores)
+- ``gallery``  — model acquisition / registry (ref: core/gallery)
+"""
+
+from localai_tfp_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,23 @@
+from localai_tfp_tpu.config.model_config import (
+    ModelConfig,
+    SamplingParams,
+    TemplateConfig,
+    FunctionsConfig,
+    DiffusersConfig,
+    TTSConfig,
+    Usecase,
+)
+from localai_tfp_tpu.config.loader import ConfigLoader
+from localai_tfp_tpu.config.app_config import ApplicationConfig
+
+__all__ = [
+    "ModelConfig",
+    "SamplingParams",
+    "TemplateConfig",
+    "FunctionsConfig",
+    "DiffusersConfig",
+    "TTSConfig",
+    "Usecase",
+    "ConfigLoader",
+    "ApplicationConfig",
+]

@@ -1,0 +1,112 @@
+"""Model-config discovery and loading.
+
+Ref: core/config/backend_config_loader.go — reads a single YAML, a multi-doc
+YAML (--models-config-file), or every ``*.yaml`` in the models directory, and
+answers filter queries used by the HTTP middleware's default-model selection.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from pathlib import Path
+from typing import Iterable, Optional
+
+import yaml
+
+from localai_tfp_tpu.config.model_config import ModelConfig, Usecase
+
+log = logging.getLogger(__name__)
+
+
+class ConfigLoader:
+    def __init__(self, models_path: str | Path = "models"):
+        self.models_path = Path(models_path)
+        self._configs: dict[str, ModelConfig] = {}
+        self._lock = threading.RLock()
+
+    # -- loading --
+
+    def load_config_dict(self, data: dict) -> ModelConfig:
+        cfg = ModelConfig.from_dict(data)
+        if not cfg.validate():
+            raise ValueError(f"invalid model config (path traversal?): {cfg.name}")
+        with self._lock:
+            self._configs[cfg.name] = cfg
+        return cfg
+
+    def load_config_file(self, path: str | Path) -> list[ModelConfig]:
+        """Load one YAML file; multi-doc files yield multiple configs
+        (ref: backend_config_loader.go LoadMultipleBackendConfigsSingleFile)."""
+        out = []
+        text = Path(path).read_text()
+        for doc in yaml.safe_load_all(text):
+            if doc is None:
+                continue
+            if isinstance(doc, list):  # a single doc that is a list of configs
+                for d in doc:
+                    out.append(self.load_config_dict(d))
+            else:
+                out.append(self.load_config_dict(doc))
+        return out
+
+    def load_configs_from_path(self, path: Optional[str | Path] = None) -> int:
+        """Scan ``<models>/**.yaml`` (ref:
+        backend_config_loader.go:335 LoadBackendConfigsFromPath)."""
+        root = Path(path) if path else self.models_path
+        n = 0
+        if not root.is_dir():
+            return 0
+        for f in sorted(root.iterdir()):
+            if f.suffix not in (".yaml", ".yml") or f.name.startswith("."):
+                continue
+            try:
+                n += len(self.load_config_file(f))
+            except Exception as e:  # a bad YAML must not kill startup
+                log.warning("skipping config %s: %s", f, e)
+        return n
+
+    # -- registry / queries --
+
+    def register(self, cfg: ModelConfig) -> None:
+        with self._lock:
+            self._configs[cfg.name] = cfg
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._configs.pop(name, None)
+
+    def get(self, name: str) -> Optional[ModelConfig]:
+        with self._lock:
+            return self._configs.get(name)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._configs)
+
+    def all(self) -> list[ModelConfig]:
+        with self._lock:
+            return [self._configs[k] for k in sorted(self._configs)]
+
+    def by_usecase(self, usecase: Usecase) -> list[ModelConfig]:
+        return [c for c in self.all() if c.has_usecase(usecase)]
+
+    def first_available(self, usecase: Usecase = Usecase.ANY) -> Optional[ModelConfig]:
+        """Default-model selection (ref:
+        core/http/middleware/request.go:84-111)."""
+        matches = self.by_usecase(usecase)
+        return matches[0] if matches else None
+
+    def resolve(self, name: Optional[str], usecase: Usecase = Usecase.ANY) -> Optional[ModelConfig]:
+        """Resolve a request's model name to a config: exact name, else a
+        bare on-disk model file, else the first config serving the usecase."""
+        if name:
+            cfg = self.get(name)
+            if cfg is not None:
+                return cfg
+            if (self.models_path / name).exists():
+                cfg = ModelConfig.from_dict({"name": name, "model": name})
+                self.register(cfg)
+                return cfg
+            return None
+        return self.first_available(usecase)

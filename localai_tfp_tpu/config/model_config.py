@@ -1,0 +1,343 @@
+"""Per-model YAML configuration.
+
+TPU-native re-design of the reference's ``BackendConfig``
+(ref: core/config/backend_config.go:27-73) and ``PredictionOptions``
+(ref: core/schema/prediction.go). YAML field names are kept compatible so a
+user can bring their LocalAI model YAML files over unchanged; fields that only
+make sense for llama.cpp/CUDA (gpu_layers, mmap, numa, ...) are accepted and
+ignored, while TPU-specific knobs (mesh axes, kv page size, dtype) are added.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, fields
+from typing import Any, Optional
+
+
+def _filter_kwargs(cls, data: dict) -> dict:
+    names = {f.name for f in fields(cls)}
+    return {k: v for k, v in data.items() if k in names}
+
+
+@dataclass
+class SamplingParams:
+    """Sampling surface (ref: core/schema/prediction.go PredictionOptions).
+
+    These are the per-request defaults a model YAML may pin; an incoming
+    OpenAI request overrides any subset (ref:
+    core/http/middleware/request.go mergeOpenAIRequestAndBackendConfig).
+    """
+
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    min_p: Optional[float] = None
+    typical_p: Optional[float] = None
+    max_tokens: Optional[int] = None
+    n: int = 1
+    echo: bool = False
+    ignore_eos: bool = False
+    repeat_penalty: float = 0.0
+    repeat_last_n: int = 64
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
+    seed: Optional[int] = None
+    negative_prompt: str = ""
+    rope_freq_base: float = 0.0
+    rope_freq_scale: float = 0.0
+    language: str = ""
+    translate: bool = False
+    batch: int = 0
+    clip_skip: int = 0
+    tokenizer: str = ""
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "SamplingParams":
+        return cls(**_filter_kwargs(cls, data or {}))
+
+    def merged_with(self, overrides: dict) -> "SamplingParams":
+        """New params with non-None entries of `overrides` applied."""
+        out = dict(self.__dict__)
+        names = {f.name for f in fields(self)}
+        for k, v in overrides.items():
+            if k in names and v is not None:
+                out[k] = v
+        return SamplingParams(**out)
+
+
+@dataclass
+class TemplateConfig:
+    """Prompt templating block (ref: core/config/backend_config.go
+    TemplateConfig). Templates are Jinja2 here (the reference uses
+    Go text/template + gonja; Jinja is the native idiom for HF-ecosystem
+    chat templates)."""
+
+    chat: str = ""
+    chat_message: str = ""
+    completion: str = ""
+    edit: str = ""
+    function: str = ""
+    use_tokenizer_template: bool = False
+    join_chat_messages_by_character: Optional[str] = None
+    multimodal: str = ""
+    jinja_template: bool = True
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "TemplateConfig":
+        return cls(**_filter_kwargs(cls, data or {}))
+
+
+@dataclass
+class FunctionsConfig:
+    """Tool-calling / grammar config (ref: pkg/functions/parse.go:16-60
+    FunctionsConfig)."""
+
+    disable_no_action: bool = False
+    no_action_function_name: str = ""
+    no_action_description_name: str = ""
+    function_name_key: str = ""
+    function_arguments_key: str = ""
+    response_regex: list[str] = field(default_factory=list)
+    json_regex_match: list[str] = field(default_factory=list)
+    argument_regex: list[str] = field(default_factory=list)
+    argument_regex_key_name: str = ""
+    argument_regex_value_name: str = ""
+    capture_llm_results: list[str] = field(default_factory=list)
+    replace_function_results: list[dict] = field(default_factory=list)
+    replace_llm_results: list[dict] = field(default_factory=list)
+    grammar: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "FunctionsConfig":
+        return cls(**_filter_kwargs(cls, data or {}))
+
+    def grammar_options(self) -> dict:
+        return self.grammar or {}
+
+
+@dataclass
+class DiffusersConfig:
+    """Image/video generation block (ref: core/config/backend_config.go
+    Diffusers struct)."""
+
+    pipeline_type: str = ""
+    scheduler_type: str = ""
+    enable_parameters: str = ""
+    img2img: bool = False
+    clip_skip: int = 0
+    clip_model: str = ""
+    clip_subfolder: str = ""
+    control_net: str = ""
+    cuda: bool = False  # accepted for compat; ignored on TPU
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "DiffusersConfig":
+        return cls(**_filter_kwargs(cls, data or {}))
+
+
+@dataclass
+class TTSConfig:
+    """TTS block (ref: core/config/backend_config.go TTSConfig)."""
+
+    voice: str = ""
+    audio_path: str = ""
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "TTSConfig":
+        return cls(**_filter_kwargs(cls, data or {}))
+
+
+class Usecase(enum.IntFlag):
+    """Usecase flags for default-model filtering (ref:
+    core/config/backend_config.go:430-580 BackendConfigUsecases)."""
+
+    ANY = 0
+    CHAT = 1 << 0
+    COMPLETION = 1 << 1
+    EDIT = 1 << 2
+    EMBEDDINGS = 1 << 3
+    RERANK = 1 << 4
+    IMAGE = 1 << 5
+    TRANSCRIPT = 1 << 6
+    TTS = 1 << 7
+    SOUND_GENERATION = 1 << 8
+    TOKENIZE = 1 << 9
+    VAD = 1 << 10
+    VIDEO = 1 << 11
+
+    @classmethod
+    def from_string(cls, s: str) -> "Usecase":
+        return cls[s.strip().upper().replace("-", "_")]
+
+
+# Backends that serve text-generation usecases by default.
+_LLM_BACKENDS = {"jax-llm", "llama", "vllm", "transformers", ""}
+
+
+@dataclass
+class ModelConfig:
+    """One model's YAML config (ref: core/config/backend_config.go:27-73).
+
+    TPU-specific additions are grouped at the bottom; all reference fields
+    that matter for behavior are preserved, CUDA/llama.cpp-only fields are
+    accepted via `extra` and ignored.
+    """
+
+    name: str = ""
+    backend: str = ""
+    description: str = ""
+    usage: str = ""
+    model: str = ""  # checkpoint path / HF id (ref: parameters.model)
+
+    parameters: SamplingParams = field(default_factory=SamplingParams)
+    template: TemplateConfig = field(default_factory=TemplateConfig)
+    function: FunctionsConfig = field(default_factory=FunctionsConfig)
+    diffusers: DiffusersConfig = field(default_factory=DiffusersConfig)
+    tts: TTSConfig = field(default_factory=TTSConfig)
+
+    embeddings: bool = False
+    f16: Optional[bool] = None
+    threads: Optional[int] = None
+    debug: bool = False
+    roles: dict[str, str] = field(default_factory=dict)
+    feature_flags: dict[str, bool] = field(default_factory=dict)
+
+    # LLM knobs (ref: LLMConfig, core/config/backend_config.go:107-167)
+    system_prompt: str = ""
+    context_size: Optional[int] = None
+    grammar: str = ""
+    stopwords: list[str] = field(default_factory=list)
+    cutstrings: list[str] = field(default_factory=list)
+    extract_regex: list[str] = field(default_factory=list)
+    trimspace: list[str] = field(default_factory=list)
+    trimsuffix: list[str] = field(default_factory=list)
+    rms_norm_eps: float = 0.0
+    rope_scaling: str = ""
+    yarn_ext_factor: float = 0.0
+    yarn_attn_factor: float = 0.0
+    yarn_beta_fast: float = 0.0
+    yarn_beta_slow: float = 0.0
+    model_type: str = ""
+    quantization: str = ""
+    dtype: str = ""
+    max_model_len: int = 0
+    tensor_parallel_size: int = 0
+    draft_model: str = ""
+    n_draft: int = 0
+    step: int = 0
+    cfg_scale: float = 0.0
+    known_usecases: Optional[list[str]] = None
+    download_files: list[dict] = field(default_factory=list)
+    options: list[str] = field(default_factory=list)
+
+    # --- TPU-native knobs (new) ---
+    mesh: dict[str, int] = field(default_factory=dict)  # e.g. {data: 1, model: 8}
+    kv_page_size: int = 64
+    max_batch_slots: int = 8
+    prefill_chunk: int = 512
+    decode_steps_per_dispatch: int = 1
+    activation_dtype: str = "bfloat16"
+    kv_cache_dtype: str = ""  # "" = same as activations; "int8" enables quantized KV
+
+    # Unrecognized / compat-only YAML keys land here untouched.
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "ModelConfig":
+        data = dict(data or {})
+        params = data.pop("parameters", {}) or {}
+        model_file = params.pop("model", "") if isinstance(params, dict) else ""
+        known = {f.name for f in fields(cls)}
+        kwargs: dict[str, Any] = {}
+        extra: dict[str, Any] = {}
+        for k, v in data.items():
+            if k in known:
+                kwargs[k] = v
+            else:
+                extra[k] = v
+        cfg = cls(
+            **{
+                k: v
+                for k, v in kwargs.items()
+                if k
+                not in ("parameters", "template", "function", "diffusers", "tts")
+            }
+        )
+        cfg.parameters = SamplingParams.from_dict(params)
+        cfg.template = TemplateConfig.from_dict(kwargs.get("template", {}))
+        cfg.function = FunctionsConfig.from_dict(kwargs.get("function", {}))
+        cfg.diffusers = DiffusersConfig.from_dict(kwargs.get("diffusers", {}))
+        cfg.tts = TTSConfig.from_dict(kwargs.get("tts", {}))
+        cfg.model = cfg.model or model_file
+        cfg.extra = extra
+        cfg.set_defaults()
+        return cfg
+
+    def set_defaults(self) -> None:
+        """Fill reference-compatible defaults (ref:
+        core/config/backend_config.go:287-397 SetDefaults)."""
+        p = self.parameters
+        if p.top_k is None:
+            p.top_k = 40
+        if p.top_p is None:
+            p.top_p = 0.95
+        if p.temperature is None:
+            p.temperature = 0.9
+        if p.max_tokens is None:
+            p.max_tokens = 2048
+        if self.context_size is None:
+            self.context_size = 4096
+        if not self.name and self.model:
+            self.name = self.model
+
+    # -- usecase filtering (ref: backend_config.go:430-580) --
+
+    def usecases(self) -> Usecase:
+        if self.known_usecases is not None:
+            flags = Usecase.ANY
+            for s in self.known_usecases:
+                try:
+                    flags |= Usecase.from_string(s)
+                except KeyError:
+                    pass
+            return flags
+        return self._guess_usecases()
+
+    def _guess_usecases(self) -> Usecase:
+        flags = Usecase.ANY
+        b = (self.backend or "").lower()
+        if self.embeddings or b in ("sentencetransformers", "embeddings"):
+            flags |= Usecase.EMBEDDINGS
+        if b in ("rerankers", "rerank"):
+            flags |= Usecase.RERANK
+        if b in ("diffusers", "stablediffusion", "flux"):
+            flags |= Usecase.IMAGE | Usecase.VIDEO
+        if b in ("whisper", "faster-whisper"):
+            flags |= Usecase.TRANSCRIPT
+        if b in ("tts", "piper", "bark", "coqui", "kokoro"):
+            flags |= Usecase.TTS | Usecase.SOUND_GENERATION
+        if b in ("silero-vad", "vad"):
+            flags |= Usecase.VAD
+        if b in _LLM_BACKENDS:
+            flags |= (
+                Usecase.CHAT | Usecase.COMPLETION | Usecase.EDIT | Usecase.TOKENIZE
+            )
+            if self.embeddings:
+                flags |= Usecase.EMBEDDINGS
+        return flags
+
+    def has_usecase(self, u: Usecase) -> bool:
+        if u == Usecase.ANY:
+            return True
+        return bool(self.usecases() & u)
+
+    def validate(self) -> bool:
+        """Reject path-traversal in file-ish fields (ref:
+        core/config/backend_config.go:399-424 Validate)."""
+        for val in (self.model, self.backend, self.draft_model):
+            if not val:
+                continue
+            if val.startswith("/") or ".." in val:
+                return False
+        return True

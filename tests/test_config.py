@@ -1,0 +1,100 @@
+"""Config system tests (ref test model: core/config/backend_config_test.go)."""
+
+import textwrap
+
+from localai_tfp_tpu.config import ConfigLoader, ModelConfig, Usecase
+
+
+def test_defaults_applied():
+    cfg = ModelConfig.from_dict({"name": "m", "backend": "jax-llm"})
+    assert cfg.parameters.top_k == 40
+    assert cfg.parameters.top_p == 0.95
+    assert cfg.parameters.temperature == 0.9
+    assert cfg.parameters.max_tokens == 2048
+    assert cfg.context_size == 4096
+
+
+def test_reference_yaml_compat(tmp_path):
+    # A LocalAI-style model YAML must load unchanged.
+    (tmp_path / "gpt4.yaml").write_text(
+        textwrap.dedent(
+            """
+            name: gpt-4
+            backend: llama
+            parameters:
+              model: testmodel.ggml
+              temperature: 0.2
+              top_p: 0.8
+            context_size: 2048
+            stopwords: ["<|im_end|>"]
+            gpu_layers: 99      # CUDA-only knob: accepted, ignored
+            mmap: true
+            template:
+              chat: chat_tmpl
+            """
+        )
+    )
+    loader = ConfigLoader(tmp_path)
+    assert loader.load_configs_from_path() == 1
+    cfg = loader.get("gpt-4")
+    assert cfg is not None
+    assert cfg.model == "testmodel.ggml"
+    assert cfg.parameters.temperature == 0.2
+    assert cfg.stopwords == ["<|im_end|>"]
+    assert cfg.template.chat == "chat_tmpl"
+    assert cfg.extra.get("gpu_layers") == 99
+
+
+def test_multidoc_yaml(tmp_path):
+    (tmp_path / "all.yaml").write_text("name: a\n---\nname: b\n")
+    loader = ConfigLoader(tmp_path)
+    assert loader.load_configs_from_path() == 2
+    assert loader.names() == ["a", "b"]
+
+
+def test_usecase_filtering():
+    llm = ModelConfig.from_dict({"name": "l", "backend": "jax-llm"})
+    emb = ModelConfig.from_dict({"name": "e", "backend": "sentencetransformers"})
+    img = ModelConfig.from_dict({"name": "i", "backend": "diffusers"})
+    assert llm.has_usecase(Usecase.CHAT)
+    assert not llm.has_usecase(Usecase.IMAGE)
+    assert emb.has_usecase(Usecase.EMBEDDINGS)
+    assert not emb.has_usecase(Usecase.CHAT)
+    assert img.has_usecase(Usecase.IMAGE)
+
+
+def test_known_usecases_override():
+    cfg = ModelConfig.from_dict(
+        {"name": "x", "backend": "jax-llm", "known_usecases": ["chat"]}
+    )
+    assert cfg.has_usecase(Usecase.CHAT)
+    assert not cfg.has_usecase(Usecase.COMPLETION)
+
+
+def test_resolve_and_default(tmp_path):
+    loader = ConfigLoader(tmp_path)
+    loader.load_config_dict({"name": "only", "backend": "jax-llm"})
+    assert loader.resolve(None, Usecase.CHAT).name == "only"
+    assert loader.resolve("only").name == "only"
+    assert loader.resolve("missing") is None
+
+
+def test_path_traversal_rejected(tmp_path):
+    loader = ConfigLoader(tmp_path)
+    try:
+        loader.load_config_dict(
+            {"name": "evil", "parameters": {"model": "../../etc/passwd"}}
+        )
+        raised = False
+    except ValueError:
+        raised = True
+    assert raised
+
+
+def test_sampling_merge():
+    cfg = ModelConfig.from_dict(
+        {"name": "m", "parameters": {"temperature": 0.1, "top_k": 5}}
+    )
+    merged = cfg.parameters.merged_with({"temperature": 0.7, "top_k": None})
+    assert merged.temperature == 0.7
+    assert merged.top_k == 5
