@@ -29,6 +29,8 @@ class ConfigLoader:
 
     def load_config_dict(self, data: dict) -> ModelConfig:
         cfg = ModelConfig.from_dict(data)
+        if not cfg.name:
+            raise ValueError("model config has neither 'name' nor 'model'")
         if not cfg.validate():
             raise ValueError(f"invalid model config (path traversal?): {cfg.name}")
         with self._lock:
@@ -37,21 +39,30 @@ class ConfigLoader:
 
     def load_config_file(self, path: str | Path) -> list[ModelConfig]:
         """Load one YAML file; multi-doc files yield multiple configs
-        (ref: backend_config_loader.go LoadMultipleBackendConfigsSingleFile)."""
-        out = []
+        (ref: backend_config_loader.go LoadMultipleBackendConfigsSingleFile).
+        All docs are parsed and validated before any is registered, so a bad
+        doc doesn't leave the file half-loaded."""
+        docs: list[dict] = []
         text = Path(path).read_text()
         for doc in yaml.safe_load_all(text):
             if doc is None:
                 continue
-            if isinstance(doc, list):  # a single doc that is a list of configs
-                for d in doc:
-                    out.append(self.load_config_dict(d))
-            else:
-                out.append(self.load_config_dict(doc))
-        return out
+            docs.extend(doc if isinstance(doc, list) else [doc])
+        staged = []
+        for d in docs:
+            cfg = ModelConfig.from_dict(d)
+            if not cfg.name:
+                raise ValueError("model config has neither 'name' nor 'model'")
+            if not cfg.validate():
+                raise ValueError(f"invalid model config: {cfg.name}")
+            staged.append(cfg)
+        for cfg in staged:
+            self.register(cfg)
+        return staged
 
     def load_configs_from_path(self, path: Optional[str | Path] = None) -> int:
-        """Scan ``<models>/**.yaml`` (ref:
+        """Scan the top level of the models dir for ``*.yaml``/``*.yml``
+        (non-recursive, matching the reference — ref:
         backend_config_loader.go:335 LoadBackendConfigsFromPath)."""
         root = Path(path) if path else self.models_path
         n = 0
@@ -104,8 +115,8 @@ class ConfigLoader:
             cfg = self.get(name)
             if cfg is not None:
                 return cfg
-            if (self.models_path / name).exists():
-                cfg = ModelConfig.from_dict({"name": name, "model": name})
+            cfg = ModelConfig.from_dict({"name": name, "model": name})
+            if cfg.validate() and (self.models_path / name).exists():
                 self.register(cfg)
                 return cfg
             return None

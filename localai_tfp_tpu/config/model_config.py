@@ -245,8 +245,15 @@ class ModelConfig:
 
     @classmethod
     def from_dict(cls, data: dict) -> "ModelConfig":
+        if data is not None and not isinstance(data, dict):
+            raise ValueError(f"model config must be a mapping, got {type(data).__name__}")
         data = dict(data or {})
         params = data.pop("parameters", {}) or {}
+        if not isinstance(params, (dict, str)):
+            raise ValueError("'parameters' must be a mapping")
+        if isinstance(params, str):
+            # plausible user shorthand: `parameters: file.gguf` means the model file
+            params = {"model": params}
         model_file = params.pop("model", "") if isinstance(params, dict) else ""
         known = {f.name for f in fields(cls)}
         kwargs: dict[str, Any] = {}

@@ -1,0 +1,168 @@
+"""Load HuggingFace checkpoints into the stacked-scan parameter layout.
+
+Capability counterpart of the reference's model-file loading
+(ref: backend/cpp/llama grpc-server.cpp LoadModel :2467 for GGUF;
+backend/python/transformers/backend.py:68-200 for HF checkpoints). Here the
+on-disk format is HF safetensors; weights are transposed into right-matmul
+layout ([in, out]) and stacked on a leading layer axis so the scan body sees
+one [L, ...] leaf per projection.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .llm_spec import LLMSpec, spec_from_hf_config
+from .transformer import Params
+
+
+def load_hf_state(model_dir: str) -> tuple[dict, Callable[[str], np.ndarray], list[str]]:
+    """Return (config dict, tensor getter, tensor names) for a local HF dir."""
+    cfg_path = os.path.join(model_dir, "config.json")
+    with open(cfg_path) as f:
+        config = json.load(f)
+
+    st_files = sorted(
+        os.path.join(model_dir, f)
+        for f in os.listdir(model_dir)
+        if f.endswith(".safetensors") and not f.startswith(".")
+    )
+    if st_files:
+        from safetensors import safe_open
+
+        handles = [safe_open(p, framework="np") for p in st_files]
+        index: dict[str, Any] = {}
+        for h in handles:
+            for name in h.keys():
+                index[name] = h
+
+        def get(name: str) -> np.ndarray:
+            return index[name].get_tensor(name)
+
+        return config, get, list(index)
+
+    # fallback: pytorch .bin shards via torch (cpu)
+    import torch
+
+    state: dict[str, Any] = {}
+    for f in sorted(os.listdir(model_dir)):
+        if f.endswith(".bin") and "training" not in f:
+            state.update(torch.load(os.path.join(model_dir, f), map_location="cpu",
+                                    weights_only=True))
+
+    def get_bin(name: str) -> np.ndarray:
+        t = state[name].to(torch.float32)
+        return t.numpy()
+
+    return config, get_bin, list(state)
+
+
+def _cast(a: np.ndarray, dtype) -> jnp.ndarray:
+    x = jnp.asarray(a)
+    return x.astype(dtype)
+
+
+def load_params(
+    model_dir: str,
+    dtype: Any = jnp.bfloat16,
+    spec_override: Optional[LLMSpec] = None,
+) -> tuple[LLMSpec, Params]:
+    """Load an HF checkpoint directory -> (spec, stacked params)."""
+    config, get, names = load_hf_state(model_dir)
+    spec = spec_override or spec_from_hf_config(config)
+    mt = (config.get("model_type") or "").lower()
+    L = spec.n_layers
+
+    def t(name: str) -> np.ndarray:  # weight, transposed to [in, out]
+        return np.ascontiguousarray(get(name).T)
+
+    def maybe(name: str) -> Optional[np.ndarray]:
+        return get(name) if name in names else None
+
+    p: dict[str, Any] = {}
+    prefix = "model." if "model.embed_tokens.weight" in names else ""
+    p["embed"] = _cast(get(f"{prefix}embed_tokens.weight"), dtype)
+
+    def stack(fn: Callable[[int], np.ndarray]) -> jnp.ndarray:
+        return _cast(np.stack([fn(i) for i in range(L)]), dtype)
+
+    lp = f"{prefix}layers." + "{i}."
+    if mt == "phi":
+        p["wq"] = stack(lambda i: t(lp.format(i=i) + "self_attn.q_proj.weight"))
+        p["wk"] = stack(lambda i: t(lp.format(i=i) + "self_attn.k_proj.weight"))
+        p["wv"] = stack(lambda i: t(lp.format(i=i) + "self_attn.v_proj.weight"))
+        p["wo"] = stack(lambda i: t(lp.format(i=i) + "self_attn.dense.weight"))
+        p["bq"] = stack(lambda i: get(lp.format(i=i) + "self_attn.q_proj.bias"))
+        p["bk"] = stack(lambda i: get(lp.format(i=i) + "self_attn.k_proj.bias"))
+        p["bv"] = stack(lambda i: get(lp.format(i=i) + "self_attn.v_proj.bias"))
+        p["bo"] = stack(lambda i: get(lp.format(i=i) + "self_attn.dense.bias"))
+        p["w_up"] = stack(lambda i: t(lp.format(i=i) + "mlp.fc1.weight"))
+        p["b_up"] = stack(lambda i: get(lp.format(i=i) + "mlp.fc1.bias"))
+        p["w_down"] = stack(lambda i: t(lp.format(i=i) + "mlp.fc2.weight"))
+        p["b_down"] = stack(lambda i: get(lp.format(i=i) + "mlp.fc2.bias"))
+        p["ln1_w"] = stack(lambda i: get(lp.format(i=i) + "input_layernorm.weight"))
+        p["ln1_b"] = stack(lambda i: get(lp.format(i=i) + "input_layernorm.bias"))
+        p["final_norm_w"] = _cast(get(f"{prefix}final_layernorm.weight"), dtype)
+        p["final_norm_b"] = _cast(get(f"{prefix}final_layernorm.bias"), dtype)
+        p["lm_head"] = _cast(t("lm_head.weight"), dtype)
+        p["lm_head_b"] = _cast(get("lm_head.bias"), dtype)
+        return spec, p
+
+    fused_qkv = lp.format(i=0) + "self_attn.qkv_proj.weight" in names  # phi3
+    fused_gate = lp.format(i=0) + "mlp.gate_up_proj.weight" in names
+
+    if fused_qkv:
+        qd, kvd = spec.q_dim, spec.kv_dim
+
+        def split_qkv(i, part):
+            w = get(lp.format(i=i) + "self_attn.qkv_proj.weight")  # [q+2kv, D]
+            q, k, v = w[:qd], w[qd : qd + kvd], w[qd + kvd :]
+            return np.ascontiguousarray({"q": q, "k": k, "v": v}[part].T)
+
+        p["wq"] = stack(lambda i: split_qkv(i, "q"))
+        p["wk"] = stack(lambda i: split_qkv(i, "k"))
+        p["wv"] = stack(lambda i: split_qkv(i, "v"))
+    else:
+        p["wq"] = stack(lambda i: t(lp.format(i=i) + "self_attn.q_proj.weight"))
+        p["wk"] = stack(lambda i: t(lp.format(i=i) + "self_attn.k_proj.weight"))
+        p["wv"] = stack(lambda i: t(lp.format(i=i) + "self_attn.v_proj.weight"))
+        if spec.qkv_bias:
+            p["bq"] = stack(lambda i: get(lp.format(i=i) + "self_attn.q_proj.bias"))
+            p["bk"] = stack(lambda i: get(lp.format(i=i) + "self_attn.k_proj.bias"))
+            p["bv"] = stack(lambda i: get(lp.format(i=i) + "self_attn.v_proj.bias"))
+    p["wo"] = stack(lambda i: t(lp.format(i=i) + "self_attn.o_proj.weight"))
+
+    if fused_gate:
+        F = spec.d_ff
+
+        def split_gate(i, part):
+            w = get(lp.format(i=i) + "mlp.gate_up_proj.weight")  # [2F, D]
+            g, u = w[:F], w[F:]
+            return np.ascontiguousarray((g if part == "g" else u).T)
+
+        p["w_gate"] = stack(lambda i: split_gate(i, "g"))
+        p["w_up"] = stack(lambda i: split_gate(i, "u"))
+    else:
+        if spec.gated_mlp:
+            p["w_gate"] = stack(lambda i: t(lp.format(i=i) + "mlp.gate_proj.weight"))
+        p["w_up"] = stack(lambda i: t(lp.format(i=i) + "mlp.up_proj.weight"))
+    p["w_down"] = stack(lambda i: t(lp.format(i=i) + "mlp.down_proj.weight"))
+
+    p["ln1_w"] = stack(lambda i: get(lp.format(i=i) + "input_layernorm.weight"))
+    p["ln2_w"] = stack(
+        lambda i: get(lp.format(i=i) + "post_attention_layernorm.weight")
+    )
+    p["final_norm_w"] = _cast(get(f"{prefix}norm.weight"), dtype)
+    if not spec.tie_word_embeddings:
+        if "lm_head.weight" in names:
+            p["lm_head"] = _cast(t("lm_head.weight"), dtype)
+        else:  # checkpoint ties despite config
+            object.__setattr__(spec, "tie_word_embeddings", True)
+
+    return spec, {k: _cast(v, dtype) if isinstance(v, np.ndarray) else v
+                  for k, v in p.items()}

@@ -1,0 +1,171 @@
+"""Architecture spec for decoder-only LLMs.
+
+One spec dataclass drives a single stacked-scan transformer implementation
+(models/transformer.py) across the model families the reference serves via
+its llama.cpp / vLLM / transformers backends (ref: backend/cpp/llama
+grpc-server.cpp LoadModel; backend/python/vllm/backend.py:92-128;
+backend/python/transformers/backend.py:68-200). Instead of per-family
+modeling code, family differences are expressed as data: norm type, MLP
+gating, rotary fraction, biases, residual topology.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass(frozen=True, eq=False)  # eq=False: identity hash, so a spec can
+# be a `jax.jit` static argument despite dict-typed fields. The engine holds
+# exactly one spec object per loaded model, so identity-based jit caching is
+# the behavior we want.
+class LLMSpec:
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    d_head: int
+    d_ff: int
+    max_position: int = 4096
+
+    # rotary
+    rope_theta: float = 10000.0
+    rotary_pct: float = 1.0  # phi uses partial rotary
+    rope_scaling: Optional[dict] = None  # llama3 / yarn / linear scaling block
+
+    # norm
+    norm_type: str = "rmsnorm"  # rmsnorm | layernorm
+    norm_eps: float = 1e-5
+    norm_weight_plus_one: bool = False  # gemma convention
+
+    # mlp
+    gated_mlp: bool = True  # llama-style gate*up; False => single up (phi)
+    hidden_act: str = "silu"  # silu | gelu | gelu_tanh
+
+    # biases
+    qkv_bias: bool = False  # qwen2, phi
+    o_bias: bool = False  # phi
+    mlp_bias: bool = False  # phi
+    lm_head_bias: bool = False  # phi
+
+    # topology
+    parallel_residual: bool = False  # phi: x + attn(ln(x)) + mlp(ln(x))
+    tie_word_embeddings: bool = False
+    final_norm: bool = True
+
+    # scaling oddities
+    embedding_multiplier: float = 1.0  # gemma: sqrt(d_model)
+    logit_softcap: float = 0.0  # gemma2
+    attn_logit_softcap: float = 0.0  # gemma2
+    query_pre_attn_scalar: Optional[float] = None  # gemma2 attention scale
+
+    # sliding window attention (mistral); None = full causal
+    sliding_window: Optional[int] = None
+
+    extra: dict = field(default_factory=dict)
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_heads * self.d_head
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.d_head
+
+    @property
+    def rotary_dim(self) -> int:
+        rd = int(self.d_head * self.rotary_pct)
+        return rd - (rd % 2)
+
+
+def spec_from_hf_config(cfg: dict[str, Any]) -> LLMSpec:
+    """Map a HuggingFace ``config.json`` dict onto an LLMSpec.
+
+    Covers: llama / llama3 / mistral / qwen2 / qwen2.5 / phi / phi3 /
+    gemma / gemma2 / tinyllama-class checkpoints (the families the
+    reference's GGUF-introspection defaults table recognizes —
+    ref: core/config/gguf.go:36-123).
+    """
+    mt = (cfg.get("model_type") or "").lower()
+    d_model = cfg.get("hidden_size") or cfg.get("n_embd") or 2048
+    n_heads = cfg.get("num_attention_heads") or cfg.get("n_head") or 16
+    n_kv = cfg.get("num_key_value_heads") or n_heads
+    d_head = cfg.get("head_dim") or d_model // n_heads
+    n_layers = cfg.get("num_hidden_layers") or cfg.get("n_layer") or 24
+    d_ff = cfg.get("intermediate_size") or cfg.get("n_inner") or 4 * d_model
+    act = (cfg.get("hidden_act") or cfg.get("activation_function") or "silu").lower()
+    if act in ("gelu_new", "gelu_pytorch_tanh", "gelu_fast"):
+        act = "gelu_tanh"
+
+    kw: dict[str, Any] = dict(
+        vocab_size=cfg.get("vocab_size", 32000),
+        d_model=d_model,
+        n_layers=n_layers,
+        n_heads=n_heads,
+        n_kv_heads=n_kv,
+        d_head=d_head,
+        d_ff=d_ff,
+        max_position=cfg.get("max_position_embeddings", 4096),
+        rope_theta=float(cfg.get("rope_theta", 10000.0)),
+        rope_scaling=cfg.get("rope_scaling"),
+        norm_eps=float(
+            cfg.get("rms_norm_eps")
+            or cfg.get("layer_norm_eps")
+            or cfg.get("layer_norm_epsilon")
+            or 1e-5
+        ),
+        hidden_act=act,
+        tie_word_embeddings=bool(cfg.get("tie_word_embeddings", False)),
+        sliding_window=cfg.get("sliding_window"),
+    )
+
+    if mt in ("llama", "mistral", ""):
+        pass
+    elif mt in ("qwen2", "qwen2_5", "qwen3", "qwen2_moe"):
+        kw["qkv_bias"] = mt.startswith("qwen2")
+    elif mt == "phi":
+        kw.update(
+            norm_type="layernorm",
+            gated_mlp=False,
+            hidden_act="gelu_tanh",
+            qkv_bias=True,
+            o_bias=True,
+            mlp_bias=True,
+            lm_head_bias=True,
+            parallel_residual=True,
+            rotary_pct=float(cfg.get("partial_rotary_factor", 0.4)),
+        )
+    elif mt == "phi3":
+        pass  # llama-topology with fused proj names (handled in hf_loader)
+    elif mt in ("gemma", "gemma2", "gemma3", "gemma3_text"):
+        kw.update(
+            norm_weight_plus_one=True,
+            hidden_act="gelu_tanh",
+            embedding_multiplier=float(d_model) ** 0.5,
+            tie_word_embeddings=True,
+        )
+        if mt in ("gemma2", "gemma3", "gemma3_text"):
+            kw.update(
+                logit_softcap=float(cfg.get("final_logit_softcapping") or 0.0),
+                attn_logit_softcap=float(cfg.get("attn_logit_softcapping") or 0.0),
+                query_pre_attn_scalar=cfg.get("query_pre_attn_scalar"),
+            )
+    kw["extra"] = {"model_type": mt}
+    return LLMSpec(**kw)
+
+
+def tiny_spec(vocab_size: int = 256, **over: Any) -> LLMSpec:
+    """A small spec for tests: runs on CPU in milliseconds."""
+    kw: dict[str, Any] = dict(
+        vocab_size=vocab_size,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_head=16,
+        d_ff=128,
+        max_position=512,
+    )
+    kw.update(over)
+    return LLMSpec(**kw)

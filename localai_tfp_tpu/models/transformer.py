@@ -1,0 +1,371 @@
+"""TPU-native decoder-only transformer (pure JAX, stacked-layer scan).
+
+This is the compute core that replaces the reference's llama.cpp engine
+(ref: backend/cpp/llama/grpc-server.cpp — llama_decode at :2002 is the
+device-boundary call this module corresponds to). Design choices are
+TPU-first, not a translation:
+
+- All layers are stacked on a leading axis and executed with ``lax.scan``:
+  one compiled layer body regardless of depth => fast compiles, and XLA
+  pipelines the weight fetches from HBM.
+- One ``forward`` covers prefill (T=chunk) and decode (T=1); shapes are
+  static per (batch, T) bucket so XLA never recompiles in the serving hot
+  loop (SURVEY.md §7 hard part #1).
+- KV cache is a preallocated ``[L, B, S, H_kv, Dh]`` array per k/v; writes
+  are per-slot scatters so a continuous-batching scheduler can interleave
+  requests at different offsets (the TPU answer to llama.cpp's slot
+  ``cache_tokens``, grpc-server.cpp:188-385).
+- bfloat16 activations/weights by default; logits in float32.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .llm_spec import LLMSpec
+
+Params = dict[str, jax.Array]
+
+
+@dataclass
+class KVCache:
+    """Preallocated paged-by-slot KV cache.
+
+    k/v: [n_layers, n_slots, max_seq, n_kv_heads, d_head]. ``lengths`` is
+    host-side metadata owned by the engine; the arrays carry no ragged
+    state so they can be donated through jit every step.
+    """
+
+    k: jax.Array
+    v: jax.Array
+
+    @classmethod
+    def create(
+        cls,
+        spec: LLMSpec,
+        n_slots: int,
+        max_seq: int,
+        dtype: Any = jnp.bfloat16,
+    ) -> "KVCache":
+        shape = (spec.n_layers, n_slots, max_seq, spec.n_kv_heads, spec.d_head)
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+    @property
+    def n_slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_seq(self) -> int:
+        return self.k.shape[2]
+
+
+jax.tree_util.register_pytree_node(
+    KVCache,
+    lambda c: ((c.k, c.v), None),
+    lambda _, kv: KVCache(k=kv[0], v=kv[1]),
+)
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def init_params(
+    rng: jax.Array, spec: LLMSpec, dtype: Any = jnp.bfloat16
+) -> Params:
+    """Random-init parameters (tests / bring-up; real weights via hf_loader)."""
+    keys = iter(jax.random.split(rng, 16))
+
+    def dense(key, shape, scale=None):
+        scale = scale or 1.0 / math.sqrt(shape[-2] if len(shape) > 1 else 1)
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+    L, D, F, V = spec.n_layers, spec.d_model, spec.d_ff, spec.vocab_size
+    p: Params = {
+        "embed": dense(next(keys), (V, D), 0.02),
+        "wq": dense(next(keys), (L, D, spec.q_dim)),
+        "wk": dense(next(keys), (L, D, spec.kv_dim)),
+        "wv": dense(next(keys), (L, D, spec.kv_dim)),
+        "wo": dense(next(keys), (L, spec.q_dim, D)),
+        "w_up": dense(next(keys), (L, D, F)),
+        "w_down": dense(next(keys), (L, F, D)),
+        "ln1_w": jnp.ones((L, D), dtype),
+    }
+    if spec.gated_mlp:
+        p["w_gate"] = dense(next(keys), (L, D, F))
+    if not spec.parallel_residual:
+        p["ln2_w"] = jnp.ones((L, D), dtype)
+    if spec.norm_type == "layernorm":
+        p["ln1_b"] = jnp.zeros((L, D), dtype)
+        if "ln2_w" in p:
+            p["ln2_b"] = jnp.zeros((L, D), dtype)
+    if spec.qkv_bias:
+        p["bq"] = jnp.zeros((L, spec.q_dim), dtype)
+        p["bk"] = jnp.zeros((L, spec.kv_dim), dtype)
+        p["bv"] = jnp.zeros((L, spec.kv_dim), dtype)
+    if spec.o_bias:
+        p["bo"] = jnp.zeros((L, D), dtype)
+    if spec.mlp_bias:
+        p["b_up"] = jnp.zeros((L, F), dtype)
+        p["b_down"] = jnp.zeros((L, D), dtype)
+    if spec.final_norm:
+        p["final_norm_w"] = jnp.ones((D,), dtype)
+        if spec.norm_type == "layernorm":
+            p["final_norm_b"] = jnp.zeros((D,), dtype)
+    if not spec.tie_word_embeddings:
+        p["lm_head"] = dense(next(keys), (D, V), 0.02)
+    if spec.lm_head_bias:
+        p["lm_head_b"] = jnp.zeros((V,), dtype)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# building blocks
+# ---------------------------------------------------------------------------
+
+
+def _norm(spec: LLMSpec, x: jax.Array, w: jax.Array, b: Optional[jax.Array]):
+    xf = x.astype(jnp.float32)
+    if spec.norm_type == "layernorm":
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        out = (xf - mu) * lax.rsqrt(var + spec.norm_eps)
+    else:
+        out = xf * lax.rsqrt(
+            jnp.mean(jnp.square(xf), axis=-1, keepdims=True) + spec.norm_eps
+        )
+    wf = w.astype(jnp.float32)
+    if spec.norm_weight_plus_one:
+        wf = wf + 1.0
+    out = out * wf
+    if b is not None:
+        out = out + b.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def rope_inv_freq(spec: LLMSpec) -> jnp.ndarray:
+    """Rotary inverse frequencies, including llama3 / linear / yarn scaling
+    (ref knobs: rope_scaling none/linear/yarn, core/config/backend_config.go
+    :158-164 and grpc-server.cpp:2419-2433)."""
+    rd = spec.rotary_dim
+    inv = 1.0 / (
+        spec.rope_theta ** (jnp.arange(0, rd, 2, dtype=jnp.float32) / rd)
+    )
+    sc = spec.rope_scaling or {}
+    rtype = (sc.get("rope_type") or sc.get("type") or "").lower()
+    if rtype == "linear":
+        inv = inv / float(sc.get("factor", 1.0))
+    elif rtype == "llama3":
+        factor = float(sc.get("factor", 8.0))
+        lo = float(sc.get("low_freq_factor", 1.0))
+        hi = float(sc.get("high_freq_factor", 4.0))
+        orig = float(sc.get("original_max_position_embeddings", 8192))
+        wavelen = 2 * math.pi / inv
+        ratio = orig / wavelen
+        smooth = jnp.clip((ratio - lo) / (hi - lo), 0.0, 1.0)
+        scaled = jnp.where(
+            wavelen > orig / lo,  # low-frequency band: fully scaled
+            inv / factor,
+            jnp.where(
+                wavelen < orig / hi,  # high-frequency band: unscaled
+                inv,
+                (1 - smooth) * inv / factor + smooth * inv,
+            ),
+        )
+        inv = scaled
+    elif rtype == "yarn":
+        factor = float(sc.get("factor", 1.0))
+        orig = float(sc.get("original_max_position_embeddings", 4096))
+        beta_fast = float(sc.get("beta_fast", 32.0))
+        beta_slow = float(sc.get("beta_slow", 1.0))
+
+        def corr_dim(num_rot):
+            return (rd * math.log(orig / (num_rot * 2 * math.pi))) / (
+                2 * math.log(spec.rope_theta)
+            )
+
+        low = max(math.floor(corr_dim(beta_fast)), 0)
+        high = min(math.ceil(corr_dim(beta_slow)), rd - 1)
+        ramp = jnp.clip(
+            (jnp.arange(rd // 2, dtype=jnp.float32) - low) / max(high - low, 1),
+            0.0,
+            1.0,
+        )
+        inv = inv / factor * ramp + inv * (1 - ramp)
+    return inv
+
+
+def apply_rope(
+    x: jax.Array, positions: jax.Array, inv_freq: jax.Array, rotary_dim: int
+) -> jax.Array:
+    """HF-convention rotate-half RoPE. x: [B, T, H, Dh]; positions: [B, T]."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B,T,rd/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B,T,1,rd/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    rot, keep = x[..., :rotary_dim], x[..., rotary_dim:]
+    x1, x2 = jnp.split(rot.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return jnp.concatenate([out.astype(x.dtype), keep], axis=-1)
+
+
+def _attend(
+    spec: LLMSpec,
+    q: jax.Array,  # [B, T, H, Dh]
+    k: jax.Array,  # [B, S, Hkv, Dh]
+    v: jax.Array,  # [B, S, Hkv, Dh]
+    q_pos: jax.Array,  # [B, T] absolute positions of queries
+) -> jax.Array:
+    B, T, H, Dh = q.shape
+    S = k.shape[1]
+    group = H // spec.n_kv_heads
+    scale = (
+        1.0 / math.sqrt(spec.query_pre_attn_scalar)
+        if spec.query_pre_attn_scalar
+        else 1.0 / math.sqrt(Dh)
+    )
+    # bf16 operands ride the MXU natively; fp32 operands (tests) must not be
+    # silently truncated to bf16, hence HIGHEST. Accumulation is fp32 either
+    # way via preferred_element_type — flash-attention-style numerics.
+    prec = lax.Precision.HIGHEST if q.dtype == jnp.float32 else lax.Precision.DEFAULT
+    qg = q.reshape(B, T, spec.n_kv_heads, group, Dh)
+    logits = jnp.einsum(
+        "btkgd,bskd->bktgs", qg, k,
+        preferred_element_type=jnp.float32, precision=prec,
+    ) * scale  # [B, Hkv, T, group, S]
+    if spec.attn_logit_softcap:
+        cap = spec.attn_logit_softcap
+        logits = jnp.tanh(logits / cap) * cap
+    kv_pos = lax.broadcasted_iota(jnp.int32, (1, 1, 1, 1, S), 4)
+    qp = q_pos[:, None, :, None, None]  # [B,1,T,1,1]
+    mask = kv_pos <= qp
+    if spec.sliding_window:
+        mask &= kv_pos > qp - spec.sliding_window
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bktgs,bskd->btkgd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32, precision=prec,
+    )
+    return out.reshape(B, T, H * Dh).astype(q.dtype)
+
+
+def _act(spec: LLMSpec, x: jax.Array) -> jax.Array:
+    if spec.hidden_act == "silu":
+        return jax.nn.silu(x)
+    if spec.hidden_act == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.gelu(x, approximate=False)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def forward(
+    spec: LLMSpec,
+    params: Params,
+    tokens: jax.Array,  # [B, T] int32
+    pos0: jax.Array,  # [B] int32: absolute position of tokens[:, 0]
+    cache: KVCache,
+    slot_ids: jax.Array,  # [B] int32: which cache slot each row occupies
+) -> tuple[jax.Array, KVCache]:
+    """Run the stack; returns (logits [B, T, V] float32, updated cache).
+
+    Serves both phases: prefill passes T=chunk, decode passes T=1 with the
+    full slot batch. Writes the new K/V into ``cache`` at rows ``slot_ids``
+    columns ``pos0 + [0..T)``.
+    """
+    B, T = tokens.shape
+    x = params["embed"][tokens]  # gather: [B, T, D]
+    if spec.embedding_multiplier != 1.0:
+        x = (x.astype(jnp.float32) * spec.embedding_multiplier).astype(x.dtype)
+
+    positions = pos0[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    inv_freq = rope_inv_freq(spec)
+    layer_keys = [k for k in params if params[k].ndim >= 1 and k not in (
+        "embed", "final_norm_w", "final_norm_b", "lm_head", "lm_head_b")]
+    stacked = {k: params[k] for k in layer_keys}
+
+    def body(x, scanned):
+        lp, ck, cv = scanned  # layer params; cache slices [n_slots, S, Hkv, Dh]
+        h = _norm(spec, x, lp["ln1_w"], lp.get("ln1_b"))
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if "bq" in lp:
+            q = q + lp["bq"]
+            k = k + lp["bk"]
+            v = v + lp["bv"]
+        q = q.reshape(B, T, spec.n_heads, spec.d_head)
+        k = k.reshape(B, T, spec.n_kv_heads, spec.d_head)
+        v = v.reshape(B, T, spec.n_kv_heads, spec.d_head)
+        rd = spec.rotary_dim
+        q = apply_rope(q, positions, inv_freq, rd)
+        k = apply_rope(k, positions, inv_freq, rd)
+
+        # scatter new kv into the slot rows at their offsets
+        def write(cbuf, new):
+            def one(buf_row, new_row, off):
+                return lax.dynamic_update_slice(
+                    buf_row, new_row.astype(buf_row.dtype), (off, 0, 0)
+                )
+            rows = jax.vmap(one)(cbuf[slot_ids], new, pos0)
+            return cbuf.at[slot_ids].set(rows)
+
+        ck = write(ck, k)
+        cv = write(cv, v)
+        attn = _attend(spec, q, ck[slot_ids], cv[slot_ids], positions)
+        attn = attn @ lp["wo"]
+        if "bo" in lp:
+            attn = attn + lp["bo"]
+
+        mlp_in = h if spec.parallel_residual else None
+        if not spec.parallel_residual:
+            x = x + attn
+            mlp_in = _norm(spec, x, lp["ln2_w"], lp.get("ln2_b"))
+        up = mlp_in @ lp["w_up"]
+        if "b_up" in lp:
+            up = up + lp["b_up"]
+        if spec.gated_mlp:
+            up = _act(spec, mlp_in @ lp["w_gate"]) * up
+        else:
+            up = _act(spec, up)
+        mlp = up @ lp["w_down"]
+        if "b_down" in lp:
+            mlp = mlp + lp["b_down"]
+        x = (x + attn + mlp) if spec.parallel_residual else (x + mlp)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(body, x, (stacked, cache.k, cache.v))
+
+    if spec.final_norm:
+        x = _norm(spec, x, params["final_norm_w"], params.get("final_norm_b"))
+    head = (
+        params["embed"].T if spec.tie_word_embeddings else params["lm_head"]
+    )
+    prec = (
+        lax.Precision.HIGHEST if x.dtype == jnp.float32 else lax.Precision.DEFAULT
+    )
+    logits = jnp.einsum(
+        "btd,dv->btv", x, head,
+        preferred_element_type=jnp.float32, precision=prec,
+    )
+    if "lm_head_b" in params:
+        logits = logits + params["lm_head_b"].astype(jnp.float32)
+    if spec.logit_softcap:
+        logits = jnp.tanh(logits / spec.logit_softcap) * spec.logit_softcap
+    return logits, KVCache(k=new_k, v=new_v)
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(4,))
+def forward_jit(spec, params, tokens, pos0, cache, slot_ids):
+    return forward(spec, params, tokens, pos0, cache, slot_ids)

@@ -16,7 +16,12 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
 import pytest  # noqa: E402
+
+# fp32 numerics-parity tests must not be silently truncated to bf16 by the
+# backend's default matmul precision (oneDNN on CPU does exactly that).
+jax.config.update("jax_default_matmul_precision", "highest")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,148 @@
+"""Model numerics: our stacked-scan transformer vs HF transformers (torch cpu).
+
+Strategy mirrors the reference's tiny-real-model API tests (SURVEY.md §4:
+Qwen2-1.5B Q2_K etc.) scaled down: random-init tiny checkpoints per family,
+saved through HF, reloaded by our loader, logits compared exactly in fp32.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+
+def _save_tiny(tmp_path, family: str) -> str:
+    import torch
+    from transformers import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        PhiConfig,
+        PhiForCausalLM,
+        Qwen2Config,
+        Qwen2ForCausalLM,
+    )
+
+    torch.manual_seed(0)
+    common = dict(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+    )
+    if family == "llama":
+        model = LlamaForCausalLM(LlamaConfig(**common))
+    elif family == "qwen2":
+        model = Qwen2ForCausalLM(Qwen2Config(**common))
+    elif family == "phi":
+        cfg = dict(common)
+        cfg["num_key_value_heads"] = 4  # phi has no GQA by default
+        model = PhiForCausalLM(PhiConfig(**cfg, partial_rotary_factor=0.5))
+    else:
+        raise ValueError(family)
+    d = tmp_path / family
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+def _hf_logits(model_dir: str, tokens: np.ndarray) -> np.ndarray:
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(model_dir, torch_dtype=torch.float32)
+    model.eval()
+    with torch.no_grad():
+        out = model(torch.tensor(tokens)).logits
+    return out.numpy()
+
+
+@pytest.mark.parametrize("family", ["llama", "qwen2", "phi"])
+def test_logits_match_hf(tmp_path, family):
+    from localai_tfp_tpu.models.hf_loader import load_params
+    from localai_tfp_tpu.models.transformer import KVCache, forward
+
+    model_dir = _save_tiny(tmp_path, family)
+    spec, params = load_params(model_dir, dtype=jnp.float32)
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, spec.vocab_size, size=(1, 12), dtype=np.int32)
+    ref = _hf_logits(model_dir, tokens)
+
+    cache = KVCache.create(spec, n_slots=2, max_seq=32, dtype=jnp.float32)
+    logits, _ = forward(
+        spec,
+        params,
+        jnp.asarray(tokens),
+        pos0=jnp.zeros((1,), jnp.int32),
+        cache=cache,
+        slot_ids=jnp.zeros((1,), jnp.int32),
+    )
+    np.testing.assert_allclose(np.asarray(logits), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_incremental_decode_matches_full_prefill(tmp_path):
+    """Prefill(n) then decode 1-at-a-time == prefill(n+k): KV cache path."""
+    from localai_tfp_tpu.models.hf_loader import load_params
+    from localai_tfp_tpu.models.transformer import KVCache, forward
+
+    model_dir = _save_tiny(tmp_path, "llama")
+    spec, params = load_params(model_dir, dtype=jnp.float32)
+    rng = np.random.default_rng(1)
+    toks = rng.integers(0, spec.vocab_size, size=(1, 10), dtype=np.int32)
+
+    cache = KVCache.create(spec, 2, 32, jnp.float32)
+    full, _ = forward(
+        spec, params, jnp.asarray(toks), jnp.zeros((1,), jnp.int32), cache,
+        jnp.ones((1,), jnp.int32),
+    )
+
+    cache = KVCache.create(spec, 2, 32, jnp.float32)
+    got, cache = forward(
+        spec, params, jnp.asarray(toks[:, :6]), jnp.zeros((1,), jnp.int32),
+        cache, jnp.ones((1,), jnp.int32),
+    )
+    outs = [np.asarray(got)[:, -1]]
+    for i in range(6, 10):
+        logits, cache = forward(
+            spec, params, jnp.asarray(toks[:, i : i + 1]),
+            jnp.full((1,), i, jnp.int32), cache, jnp.ones((1,), jnp.int32),
+        )
+        outs.append(np.asarray(logits)[:, 0])
+    inc = np.stack(outs, axis=1)
+    np.testing.assert_allclose(inc, np.asarray(full)[:, 5:], rtol=2e-4, atol=2e-4)
+
+
+def test_multi_slot_isolation(tmp_path):
+    """Two slots at different offsets don't corrupt each other."""
+    from localai_tfp_tpu.models.hf_loader import load_params
+    from localai_tfp_tpu.models.transformer import KVCache, forward
+
+    model_dir = _save_tiny(tmp_path, "llama")
+    spec, params = load_params(model_dir, dtype=jnp.float32)
+    rng = np.random.default_rng(2)
+    a = rng.integers(0, spec.vocab_size, size=(1, 8), dtype=np.int32)
+    b = rng.integers(0, spec.vocab_size, size=(1, 5), dtype=np.int32)
+
+    # solo run of b
+    cache = KVCache.create(spec, 4, 32, jnp.float32)
+    solo, _ = forward(spec, params, jnp.asarray(b), jnp.zeros((1,), jnp.int32),
+                      cache, jnp.full((1,), 3, jnp.int32))
+
+    # interleaved: a in slot 0, then b in slot 3, then decode both
+    cache = KVCache.create(spec, 4, 32, jnp.float32)
+    _, cache = forward(spec, params, jnp.asarray(a), jnp.zeros((1,), jnp.int32),
+                       cache, jnp.zeros((1,), jnp.int32))
+    got, cache = forward(spec, params, jnp.asarray(b), jnp.zeros((1,), jnp.int32),
+                         cache, jnp.full((1,), 3, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(solo), rtol=1e-5, atol=1e-5)
+
+    # batched decode step across both slots
+    nxt = jnp.asarray([[int(np.asarray(got)[0, -1].argmax())],
+                       [int(np.asarray(solo)[0, -1].argmax())]], jnp.int32)
+    logits, _ = forward(
+        spec, params, nxt, jnp.asarray([8, 5], jnp.int32), cache,
+        jnp.asarray([0, 3], jnp.int32),
+    )
+    assert np.isfinite(np.asarray(logits)).all()
