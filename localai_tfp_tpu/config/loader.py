@@ -27,14 +27,18 @@ class ConfigLoader:
 
     # -- loading --
 
-    def load_config_dict(self, data: dict) -> ModelConfig:
+    @staticmethod
+    def _validated(data: dict) -> ModelConfig:
         cfg = ModelConfig.from_dict(data)
         if not cfg.name:
             raise ValueError("model config has neither 'name' nor 'model'")
         if not cfg.validate():
             raise ValueError(f"invalid model config (path traversal?): {cfg.name}")
-        with self._lock:
-            self._configs[cfg.name] = cfg
+        return cfg
+
+    def load_config_dict(self, data: dict) -> ModelConfig:
+        cfg = self._validated(data)
+        self.register(cfg)
         return cfg
 
     def load_config_file(self, path: str | Path) -> list[ModelConfig]:
@@ -48,14 +52,7 @@ class ConfigLoader:
             if doc is None:
                 continue
             docs.extend(doc if isinstance(doc, list) else [doc])
-        staged = []
-        for d in docs:
-            cfg = ModelConfig.from_dict(d)
-            if not cfg.name:
-                raise ValueError("model config has neither 'name' nor 'model'")
-            if not cfg.validate():
-                raise ValueError(f"invalid model config: {cfg.name}")
-            staged.append(cfg)
+        staged = [self._validated(d) for d in docs]
         for cfg in staged:
             self.register(cfg)
         return staged

@@ -1,0 +1,610 @@
+"""Continuous-batching LLM serving engine (the TPU counterpart of the
+reference's C++ llama.cpp engine).
+
+Reference semantics mirrored (backend/cpp/llama/grpc-server.cpp):
+- N slots share the device; each owns a row of the KV cache
+  (`llama_client_slot` :188-385, `initialize()` :568-616).
+- scheduler loop = `update_slots()` :1639-2075 — admit queued requests,
+  chunked prompt prefill with common-prefix KV reuse (`common_part` :67,
+  cache trim :1893), batched decode of all running slots, per-slot sampling
+  + stop handling (`process_token` :1069-1160).
+- context exhaustion ends the generation (LocalAI patch :1673-1683;
+  context-shift intentionally disabled :2415).
+- per-phase timings (`print_timings` :346-385) surfaced per request
+  (backend.proto:163-164 timing_prompt_processing/timing_token_generation).
+
+TPU-first re-design rather than translation:
+- All shapes static: decode always dispatches [n_slots, 1]; prefill chunks
+  are padded to a small set of buckets — the jit cache holds ≤ len(buckets)+1
+  executables, so the hot loop never recompiles (SURVEY.md §7 hard part #1).
+- Sampling state lives on device as arrays indexed by slot and the sampler
+  fuses into the decode dispatch (ops/sampling.py).
+- KV cache rows are donated through jit every step (no reallocation).
+- Inactive slots still flow through the batched decode but write their K/V
+  at their own row's tail position, so a free slot's cached prefix stays
+  intact for prefix reuse.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from enum import Enum
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.llm_spec import LLMSpec
+from ..models.transformer import KVCache, Params, forward, forward_hidden
+from ..ops.sampling import SamplingState, observe_sequence, sample
+from .tokenizer import StreamDecoder, Tokenizer
+
+DEFAULT_PREFILL_BUCKETS = (32, 128, 512, 2048)
+
+
+@dataclass
+class GenRequest:
+    """One generation request (ref: backend.proto PredictOptions surface)."""
+
+    prompt_ids: list[int]
+    max_tokens: int = 128
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    min_p: float = 0.0
+    repeat_penalty: float = 0.0
+    repeat_last_n: int = 64
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
+    seed: Optional[int] = None
+    stop: list[str] = field(default_factory=list)
+    ignore_eos: bool = False
+    logit_bias: Optional[dict[int, float]] = None
+    # grammar-constrained decoding: object with next_mask(state)->np.bool_[V]
+    # and advance(state, token)->state (see grammars/constrain.py)
+    constraint: Optional[Any] = None
+    correlation_id: str = ""
+    id: str = field(default_factory=lambda: uuid.uuid4().hex)
+
+
+@dataclass
+class StreamEvent:
+    """Streamed to the caller per emitted text span; final carries stats."""
+
+    text: str = ""
+    token_id: Optional[int] = None
+    done: bool = False
+    finish_reason: str = ""  # stop | length | error
+    error: str = ""
+    full_text: str = ""
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    timing_prompt_processing_ms: float = 0.0
+    timing_token_generation_ms: float = 0.0
+
+
+class SlotState(Enum):
+    FREE = 0
+    PREFILL = 1
+    DECODE = 2
+
+
+@dataclass
+class _Slot:
+    idx: int
+    state: SlotState = SlotState.FREE
+    request: Optional[GenRequest] = None
+    out: Optional[queue.SimpleQueue] = None
+    cache_tokens: list[int] = field(default_factory=list)  # KV-resident ids
+    n_past: int = 0  # valid prefix length in this slot's cache row
+    n_prompt: int = 0
+    generated: list[int] = field(default_factory=list)
+    decoder: Optional[StreamDecoder] = None
+    pending_text: str = ""  # withheld tail that may begin a stop string
+    constraint_state: Any = None
+    t_start: float = 0.0
+    t_prefill_ms: float = 0.0
+    t_decode_ms: float = 0.0
+    t_last: float = 0.0
+
+    @property
+    def active(self) -> bool:
+        return self.state is not SlotState.FREE
+
+
+@dataclass
+class EngineMetrics:
+    """ref: backend.proto MetricsResponse / llama_metrics grpc-server.cpp
+    :387-417."""
+
+    requests_completed: int = 0
+    tokens_generated: int = 0
+    prompt_tokens_processed: int = 0
+    tokens_per_second: float = 0.0
+    prompt_tokens_per_second: float = 0.0
+    slots_busy: int = 0
+
+
+def _common_prefix(a: list[int], b: list[int]) -> int:
+    n = 0
+    for x, y in zip(a, b):
+        if x != y:
+            break
+        n += 1
+    return n
+
+
+class LLMEngine:
+    """Continuous-batching engine over one jitted model."""
+
+    def __init__(
+        self,
+        spec: LLMSpec,
+        params: Params,
+        tokenizer: Tokenizer,
+        *,
+        n_slots: int = 8,
+        max_seq: int = 4096,
+        prefill_buckets: tuple[int, ...] = DEFAULT_PREFILL_BUCKETS,
+        cache_dtype: Any = jnp.bfloat16,
+        penalty_window: int = 256,
+        autostart: bool = True,
+    ) -> None:
+        self._autostart = autostart
+        self.spec = spec
+        self.params = params
+        self.tokenizer = tokenizer
+        self.n_slots = n_slots
+        self.max_seq = max_seq
+        self.prefill_buckets = tuple(
+            b for b in sorted(prefill_buckets) if b <= max_seq
+        ) or (max_seq,)
+        self.cache = KVCache.create(spec, n_slots, max_seq, cache_dtype)
+        self.sampling = SamplingState.create(
+            n_slots, spec.vocab_size, window=penalty_window
+        )
+        self.slots = [_Slot(i) for i in range(n_slots)]
+        self._pending: list[tuple[GenRequest, queue.SimpleQueue]] = []
+        self._lock = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self.metrics = EngineMetrics()
+        self._all_slot_ids = jnp.arange(n_slots, dtype=jnp.int32)
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def _prefill(params, tokens, cache, pos0, slot_ids):
+            return forward(spec, params, tokens, pos0, cache, slot_ids)
+
+        @partial(jax.jit, donate_argnums=(2, 5))
+        def _decode(params, tokens, cache, pos0, slot_ids, sampling,
+                    active, masks):
+            logits, cache = forward(
+                spec, params, tokens, pos0, cache, slot_ids
+            )
+            last = logits[:, -1, :]
+            toks, sampling = _sample_masked(sampling, slot_ids, last,
+                                            active, masks)
+            return toks, cache, sampling
+
+        def _sample_masked(sampling, slot_ids, logits, active, masks):
+            toks, new_sampling = sample(sampling, slot_ids, logits,
+                                        mask=masks)
+            # keep inactive slots' sampler state untouched
+            merged = jax.tree_util.tree_map(
+                lambda new, old: _sel(active, new, old), new_sampling,
+                sampling,
+            )
+            return jnp.where(active, toks, 0), merged
+
+        def _sel(active, new, old):
+            if new.ndim == 0:
+                return new
+            a = active
+            while a.ndim < new.ndim:
+                a = a[..., None]
+            return jnp.where(a, new, old)
+
+        @jax.jit
+        def _sample_only(sampling, slot_ids, logits, masks):
+            return sample(sampling, slot_ids, logits, mask=masks)
+
+        @jax.jit
+        def _hidden(params, tokens, cache, pos0, slot_ids):
+            return forward_hidden(spec, params, tokens, pos0, cache, slot_ids)
+
+        self._prefill_fn = _prefill
+        self._decode_fn = _decode
+        self._sample_fn = _sample_only
+        self._hidden_fn = _hidden
+
+    # ------------------------------------------------------------------ API
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="llm-engine", daemon=True
+            )
+            self._thread.start()
+
+    def close(self) -> None:
+        with self._lock:
+            self._stop = True
+            self._lock.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def submit(self, req: GenRequest) -> queue.SimpleQueue:
+        """Queue a request; returns the event stream queue."""
+        out: queue.SimpleQueue = queue.SimpleQueue()
+        if len(req.prompt_ids) >= self.max_seq:
+            out.put(StreamEvent(
+                done=True, finish_reason="error",
+                error=f"prompt ({len(req.prompt_ids)} tokens) exceeds context "
+                      f"size {self.max_seq}",
+            ))
+            return out
+        if not req.prompt_ids:
+            out.put(StreamEvent(done=True, finish_reason="error",
+                                error="empty prompt"))
+            return out
+        with self._lock:
+            self._pending.append((req, out))
+            self._lock.notify_all()
+        if self._autostart:
+            self.start()
+        return out
+
+    def generate(self, req: GenRequest) -> StreamEvent:
+        """Blocking helper: drain the stream, return the final event."""
+        q = self.submit(req)
+        while True:
+            ev = q.get()
+            if ev.done:
+                return ev
+
+    # ------------------------------------------------------------- scheduler
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._stop and not self._has_work():
+                    self._lock.wait(timeout=0.5)
+                if self._stop:
+                    return
+            try:
+                self.step()
+            except Exception as e:  # engine must survive; fail active slots
+                self._fail_all(f"engine step error: {e!r}")
+
+    def _has_work(self) -> bool:
+        return bool(self._pending) or any(s.active for s in self.slots)
+
+    def _fail_all(self, msg: str) -> None:
+        for s in self.slots:
+            if s.active and s.out is not None:
+                s.out.put(StreamEvent(done=True, finish_reason="error",
+                                      error=msg))
+                self._release(s)
+
+    def step(self) -> None:
+        """One scheduler iteration (ref: update_slots, grpc-server.cpp:1639)."""
+        self._admit()
+        prefilling = [s for s in self.slots if s.state is SlotState.PREFILL]
+        if prefilling:
+            self._prefill_step(prefilling[0])
+            return
+        decoding = [s for s in self.slots if s.state is SlotState.DECODE]
+        if decoding:
+            self._decode_step(decoding)
+
+    # admission + prefix reuse (ref: grpc-server.cpp:1749-1900)
+    def _admit(self) -> None:
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for req, out in pending:
+            slot = self._pick_slot(req)
+            if slot is None:
+                with self._lock:  # no free slot; requeue preserving order
+                    self._pending.append((req, out))
+                continue
+            self._assign(slot, req, out)
+
+    def _pick_slot(self, req: GenRequest) -> Optional[_Slot]:
+        free = [s for s in self.slots if not s.active]
+        if not free:
+            return None
+        return max(
+            free, key=lambda s: _common_prefix(s.cache_tokens, req.prompt_ids)
+        )
+
+    def _assign(self, slot: _Slot, req: GenRequest,
+                out: queue.SimpleQueue) -> None:
+        common = _common_prefix(slot.cache_tokens, req.prompt_ids)
+        if common == len(req.prompt_ids):
+            common -= 1  # reprocess last token to get logits (ref :1882-1890)
+        slot.request = req
+        slot.out = out
+        slot.state = SlotState.PREFILL
+        slot.n_past = common
+        slot.n_prompt = len(req.prompt_ids)
+        slot.cache_tokens = list(req.prompt_ids[:common])
+        slot.generated = []
+        slot.decoder = StreamDecoder(self.tokenizer)
+        slot.pending_text = ""
+        slot.t_start = time.perf_counter()
+        slot.t_prefill_ms = 0.0
+        slot.t_decode_ms = 0.0
+        slot.constraint_state = (
+            req.constraint.initial_state() if req.constraint else None
+        )
+        self.sampling = self.sampling.reset_slot(
+            slot.idx,
+            temperature=req.temperature,
+            top_k=req.top_k,
+            top_p=req.top_p,
+            min_p=req.min_p,
+            repeat_penalty=req.repeat_penalty,
+            freq_penalty=req.frequency_penalty,
+            presence_penalty=req.presence_penalty,
+            repeat_last_n=req.repeat_last_n,
+            seed=req.seed,
+        )
+
+    def _bucket(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return self.prefill_buckets[-1]
+
+    def _prefill_step(self, slot: _Slot) -> None:
+        """Process one prompt chunk for one slot (chunked prefill,
+        ref: grpc-server.cpp:1993-2002 n_batch chunking)."""
+        req = slot.request
+        assert req is not None
+        t0 = time.perf_counter()
+        remaining = req.prompt_ids[slot.n_past:]
+        chunk = remaining[: self.prefill_buckets[-1]]
+        bucket = self._bucket(len(chunk))
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, : len(chunk)] = chunk
+        logits, self.cache = self._prefill_fn(
+            self.params,
+            jnp.asarray(toks),
+            self.cache,
+            jnp.asarray([slot.n_past], jnp.int32),
+            jnp.asarray([slot.idx], jnp.int32),
+        )
+        # note: positions beyond len(chunk) write garbage K/V at
+        # [n_past+len(chunk), n_past+bucket) — harmless: they're beyond the
+        # valid prefix and get overwritten when real tokens arrive (causal
+        # mask keeps them invisible to attention reads at these positions).
+        slot.n_past += len(chunk)
+        slot.cache_tokens.extend(chunk)
+        done = slot.n_past >= slot.n_prompt
+        if done:
+            # feed prompt into the penalty window (ref: llama.cpp penalizes
+            # over the last-n of prompt+generation)
+            W = self.sampling.window
+            tail = req.prompt_ids[-W:]
+            padded = np.zeros((W,), np.int32)
+            padded[: len(tail)] = tail
+            self.sampling = observe_sequence(
+                self.sampling,
+                jnp.asarray(slot.idx, jnp.int32),
+                jnp.asarray(padded),
+                jnp.asarray(len(tail), jnp.int32),
+            )
+            last = logits[:, len(chunk) - 1, :]  # [1, V]
+            masks = self._constraint_mask_rows([slot])
+            tok, self.sampling = self._sample_fn(
+                self.sampling, jnp.asarray([slot.idx], jnp.int32), last,
+                masks,
+            )
+            slot.t_prefill_ms += (time.perf_counter() - t0) * 1e3
+            self.metrics.prompt_tokens_processed += slot.n_prompt
+            slot.state = SlotState.DECODE
+            slot.t_last = time.perf_counter()
+            self._emit_token(slot, int(tok[0]))
+        else:
+            slot.t_prefill_ms += (time.perf_counter() - t0) * 1e3
+
+    def _constraint_mask_rows(self, slots: list[_Slot]) -> Optional[jax.Array]:
+        """Build [B, V] bool masks for grammar-constrained slots (host-side
+        automaton, mask shipped to device — SURVEY.md §7 hard part #3)."""
+        rows = []
+        any_mask = False
+        V = self.spec.vocab_size
+        for s in slots:
+            req = s.request
+            mask = None
+            if req is not None and req.constraint is not None:
+                raw = np.asarray(
+                    req.constraint.next_mask(s.constraint_state), dtype=bool
+                )
+                if raw.shape[0] != V:  # tokenizer/model vocab mismatch
+                    mask = np.zeros(V, bool)
+                    mask[: min(raw.shape[0], V)] = raw[:V]
+                else:
+                    mask = raw
+                any_mask = True
+            if req is not None and req.logit_bias:
+                if mask is None:
+                    mask = np.ones(V, bool)
+                for tid, bias in req.logit_bias.items():
+                    if 0 <= int(tid) < V and bias <= -100:
+                        mask[int(tid)] = False
+                any_mask = True
+            rows.append(mask if mask is not None else np.ones(V, bool))
+        if not any_mask:
+            return None
+        return jnp.asarray(np.stack(rows))
+
+    def _decode_step(self, decoding: list[_Slot]) -> None:
+        """One batched decode step over every running slot
+        (ref: grpc-server.cpp:1688-1726 batching ongoing tokens)."""
+        t0 = time.perf_counter()
+        S = self.n_slots
+        tokens = np.zeros((S, 1), np.int32)
+        pos0 = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
+        for s in self.slots:
+            if s.state is SlotState.DECODE:
+                last_tok = (s.generated[-1] if s.generated
+                            else s.request.prompt_ids[-1])
+                tokens[s.idx, 0] = last_tok
+                pos0[s.idx] = s.n_past
+                active[s.idx] = True
+            else:
+                # park inactive rows at their own tail: K/V write lands past
+                # the valid prefix, preserving it for prefix reuse
+                pos0[s.idx] = min(s.n_past, self.max_seq - 1)
+        masks = self._constraint_mask_rows(self.slots)
+        toks, self.cache, self.sampling = self._decode_fn(
+            self.params,
+            jnp.asarray(tokens),
+            self.cache,
+            jnp.asarray(pos0),
+            self._all_slot_ids,
+            self.sampling,
+            jnp.asarray(active),
+            masks,
+        )
+        toks_host = np.asarray(toks)
+        now = time.perf_counter()
+        dt_ms = (now - t0) * 1e3
+        for s in decoding:
+            # the token just consumed becomes part of the cached sequence
+            s.cache_tokens.append(int(tokens[s.idx, 0]))
+            s.n_past += 1
+            s.t_decode_ms += dt_ms
+            self._emit_token(s, int(toks_host[s.idx]))
+        self.metrics.tokens_generated += len(decoding)
+        if now > t0:
+            self.metrics.tokens_per_second = len(decoding) / (now - t0)
+        self.metrics.slots_busy = sum(1 for s in self.slots if s.active)
+
+    # ---------------------------------------------------- token → stream
+
+    def _emit_token(self, slot: _Slot, token_id: int) -> None:
+        """Per-sampled-token bookkeeping (ref: process_token,
+        grpc-server.cpp:1069-1160: stop words, EOS, limits)."""
+        req = slot.request
+        assert req is not None and slot.decoder is not None
+        if req.constraint is not None:
+            slot.constraint_state = req.constraint.advance(
+                slot.constraint_state, token_id
+            )
+        slot.generated.append(token_id)
+
+        if (not req.ignore_eos) and token_id in self.tokenizer.eos_ids:
+            self._finish(slot, "stop")
+            return
+
+        text = slot.decoder.push(token_id)
+        slot.pending_text += text
+
+        # stop-string scan with partial-match withholding
+        emit, stop_hit = _scan_stops(slot.pending_text, req.stop)
+        if stop_hit:
+            if slot.out is not None:
+                slot.out.put(StreamEvent(text=emit, token_id=token_id))
+            slot.pending_text = ""
+            self._finish(slot, "stop")
+            return
+        if slot.out is not None:
+            slot.out.put(StreamEvent(text=emit, token_id=token_id))
+        if emit:
+            slot.pending_text = slot.pending_text[len(emit):]
+
+        if len(slot.generated) >= req.max_tokens:
+            self._finish(slot, "length")
+        elif slot.n_past + 1 >= self.max_seq:
+            # context exhausted: end generation (ref: grpc-server.cpp
+            # :1673-1683 — no context shift)
+            self._finish(slot, "length")
+
+    def _finish(self, slot: _Slot, reason: str) -> None:
+        req = slot.request
+        full = slot.decoder.text if slot.decoder else ""
+        if req is not None and req.stop:
+            for st in req.stop:
+                i = full.find(st)
+                if i >= 0:
+                    full = full[:i]
+        # strip trailing eos token artifacts is tokenizer-dependent; decoder
+        # already excludes eos because we finish before pushing it
+        if slot.pending_text and reason != "stop":
+            if slot.out is not None and slot.pending_text:
+                slot.out.put(StreamEvent(text=slot.pending_text))
+        dt_decode = slot.t_decode_ms
+        ev = StreamEvent(
+            done=True,
+            finish_reason=reason,
+            full_text=full,
+            prompt_tokens=slot.n_prompt,
+            completion_tokens=len(slot.generated),
+            timing_prompt_processing_ms=slot.t_prefill_ms,
+            timing_token_generation_ms=dt_decode,
+        )
+        if slot.out is not None:
+            slot.out.put(ev)
+        self.metrics.requests_completed += 1
+        self._release(slot)
+
+    def _release(self, slot: _Slot) -> None:
+        # cache_tokens stay: they describe this row's reusable prefix
+        slot.state = SlotState.FREE
+        slot.request = None
+        slot.out = None
+        slot.decoder = None
+        slot.pending_text = ""
+        slot.constraint_state = None
+
+    # ------------------------------------------------------------- extras
+
+    def tokenize(self, text: str) -> list[int]:
+        return self.tokenizer.encode(text)
+
+    def embed(self, text: str) -> np.ndarray:
+        """Mean-pooled final hidden state (ref: transformers backend
+        mean-pool embeddings, backend/python/transformers/backend.py
+        :286-324; served via /v1/embeddings). Uses a throwaway 1-slot cache;
+        does not touch the serving slots."""
+        ids = self.tokenizer.encode(text, add_bos=True) or [0]
+        ids = ids[: self.max_seq]
+        bucket = self._bucket(len(ids))
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, : len(ids)] = ids
+        cache = KVCache.create(self.spec, 1, bucket, self.cache.k.dtype)
+        hidden, _ = self._hidden_fn(
+            self.params, jnp.asarray(toks), cache,
+            jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32),
+        )
+        h = np.asarray(hidden[0, : len(ids)], dtype=np.float32)
+        return h.mean(axis=0)
+
+
+def _scan_stops(pending: str, stops: list[str]) -> tuple[str, bool]:
+    """Return (text safe to emit, hit). Withholds any tail that is a prefix
+    of a stop string (ref: stop-word partial matching in process_token)."""
+    if not stops:
+        return pending, False
+    for st in stops:
+        i = pending.find(st)
+        if i >= 0:
+            return pending[:i], True
+    # find longest suffix of pending that is a prefix of some stop
+    hold = 0
+    for st in stops:
+        for k in range(min(len(st) - 1, len(pending)), 0, -1):
+            if pending.endswith(st[:k]):
+                hold = max(hold, k)
+                break
+    return pending[: len(pending) - hold] if hold else pending, False

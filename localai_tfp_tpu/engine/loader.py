@@ -1,0 +1,301 @@
+"""Model lifecycle: registry of live backends, load-or-reuse, watchdog.
+
+Capability counterpart of the reference's ModelLoader + WatchDog
+(ref: pkg/model/loader.go:20-37,119-188 load-or-reuse with health check;
+initializers.go:24-42 backend aliasing, :498-559 ordered auto-try;
+watchdog.go:19-156 busy/idle kill; loader.go:469-496 single-active-backend).
+
+TPU-native re-design: backends are in-process objects, not subprocesses —
+one Python process owns the TPU runtime, so "respawn" means rebuilding the
+backend object (and letting XLA's compilation cache make that cheap). The
+busy/idle watchdog semantics are preserved because they guard the same
+resource: a wedged or forgotten model holding HBM.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from ..config.model_config import ModelConfig
+from ..workers.base import Backend, ModelLoadOptions, Result
+
+log = logging.getLogger(__name__)
+
+BackendFactory = Callable[[], Backend]
+
+# backend-name aliasing (ref: initializers.go:24-42). Every alias of the
+# reference's llama.cpp/vLLM/transformers LLM backends maps to the JAX LLM
+# worker; media backends map to their JAX counterparts.
+ALIASES = {
+    "": "jax-llm",
+    "llama": "jax-llm",
+    "llama-cpp": "jax-llm",
+    "llama-grpc": "jax-llm",
+    "vllm": "jax-llm",
+    "transformers": "jax-llm",
+    "exllama2": "jax-llm",
+    "langchain-huggingface": "jax-llm",
+    "sentencetransformers": "jax-embeddings",
+    "huggingface-embeddings": "jax-embeddings",
+    "embeddings": "jax-embeddings",
+    "rerankers": "jax-rerank",
+    "rerank": "jax-rerank",
+    "whisper": "jax-whisper",
+    "faster-whisper": "jax-whisper",
+    "diffusers": "jax-diffusion",
+    "stablediffusion": "jax-diffusion",
+    "flux": "jax-diffusion",
+    "piper": "jax-tts",
+    "coqui": "jax-tts",
+    "kokoro": "jax-tts",
+    "bark": "jax-tts",
+    "bark-cpp": "jax-tts",
+    "tts": "jax-tts",
+    "silero-vad": "jax-vad",
+    "vad": "jax-vad",
+    "local-store": "local-store",
+    "stores": "local-store",
+}
+
+
+def resolve_backend(name: str) -> str:
+    n = (name or "").strip().lower()
+    return ALIASES.get(n, n)
+
+
+class _Registry:
+    """Factory registry for backend types (the TPU analogue of the asset-dir
+    binary scan, ref: initializers.go:86-179)."""
+
+    def __init__(self) -> None:
+        self._factories: dict[str, BackendFactory] = {}
+
+    def register(self, name: str, factory: BackendFactory) -> None:
+        self._factories[name] = factory
+
+    def create(self, name: str) -> Backend:
+        f = self._factories.get(name)
+        if f is None:
+            raise KeyError(
+                f"no backend '{name}' registered "
+                f"(known: {sorted(self._factories)})"
+            )
+        return f()
+
+    def known(self) -> list[str]:
+        return sorted(self._factories)
+
+
+registry = _Registry()
+
+
+def register_default_backends() -> None:
+    """Register the built-in worker factories (lazy imports so optional
+    deps never block startup)."""
+    from ..workers.llm import JaxLLMBackend
+
+    registry.register("jax-llm", JaxLLMBackend)
+    # additional workers (embeddings/rerank/whisper/diffusion/tts/vad/store)
+    # register themselves here as they land
+    try:
+        from ..workers.embeddings import JaxEmbeddingsBackend
+
+        registry.register("jax-embeddings", JaxEmbeddingsBackend)
+    except ImportError:
+        pass
+    try:
+        from ..store.backend import LocalStoreBackend
+
+        registry.register("local-store", LocalStoreBackend)
+    except ImportError:
+        pass
+
+
+class LoadedModel:
+    def __init__(self, name: str, backend_type: str, backend: Backend):
+        self.name = name
+        self.backend_type = backend_type
+        self.backend = backend
+        self.last_used = time.monotonic()
+        self.busy_since: Optional[float] = None
+
+    def mark_busy(self) -> None:
+        self.busy_since = time.monotonic()
+        self.last_used = self.busy_since
+
+    def mark_idle(self) -> None:
+        self.busy_since = None
+        self.last_used = time.monotonic()
+
+
+class ModelLoader:
+    """Keyed registry of live backends with load-or-reuse semantics
+    (ref: pkg/model/loader.go ModelLoader)."""
+
+    def __init__(
+        self,
+        models_path: str = "models",
+        *,
+        single_active_backend: bool = False,
+    ) -> None:
+        self.models_path = models_path
+        self.single_active = single_active_backend
+        self._models: dict[str, LoadedModel] = {}
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------- loading
+
+    def load(self, cfg: ModelConfig) -> Backend:
+        """Load-or-reuse (ref: loader.go:119-188 CheckIsLoaded: health-check
+        a cached backend and rebuild it if dead)."""
+        with self._lock:
+            lm = self._models.get(cfg.name)
+            if lm is not None:
+                if lm.backend.health():
+                    lm.last_used = time.monotonic()
+                    return lm.backend
+                log.warning("backend for %s unhealthy; rebuilding", cfg.name)
+                self._shutdown_locked(cfg.name)
+
+            if self.single_active:
+                for other in list(self._models):
+                    if other != cfg.name:
+                        self._shutdown_locked(other)
+
+            btype = resolve_backend(cfg.backend)
+            backend = registry.create(btype)
+            res = backend.load_model(self._load_options(cfg))
+            if not res.success:
+                backend.shutdown()
+                raise RuntimeError(
+                    f"loading model '{cfg.name}': {res.message}"
+                )
+            self._models[cfg.name] = LoadedModel(cfg.name, btype, backend)
+            return backend
+
+    def _load_options(self, cfg: ModelConfig) -> ModelLoadOptions:
+        return ModelLoadOptions(
+            model=cfg.model,
+            model_path=self.models_path,
+            context_size=cfg.context_size or 4096,
+            batch_slots=cfg.max_batch_slots,
+            dtype=cfg.dtype or cfg.activation_dtype,
+            kv_cache_dtype=cfg.kv_cache_dtype,
+            mesh=cfg.mesh,
+            threads=cfg.threads or 0,
+            embeddings=cfg.embeddings,
+            options=cfg.options,
+            extra=cfg.extra,
+        )
+
+    # ------------------------------------------------------------ lifecycle
+
+    def get(self, name: str) -> Optional[LoadedModel]:
+        with self._lock:
+            return self._models.get(name)
+
+    def loaded_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def shutdown_model(self, name: str) -> bool:
+        with self._lock:
+            return self._shutdown_locked(name)
+
+    def _shutdown_locked(self, name: str) -> bool:
+        lm = self._models.pop(name, None)
+        if lm is None:
+            return False
+        try:
+            lm.backend.shutdown()
+        except Exception as e:
+            log.warning("shutdown of %s raised: %s", name, e)
+        return True
+
+    def stop_all(self) -> None:
+        with self._lock:
+            for name in list(self._models):
+                self._shutdown_locked(name)
+
+    # ------------------------------------------------- busy/idle accounting
+
+    def mark_busy(self, name: str) -> None:
+        lm = self.get(name)
+        if lm:
+            lm.mark_busy()
+
+    def mark_idle(self, name: str) -> None:
+        lm = self.get(name)
+        if lm:
+            lm.mark_idle()
+
+
+class WatchDog:
+    """Kills models busy or idle beyond thresholds on periodic ticks
+    (ref: pkg/model/watchdog.go:19-156; 30s ticks, flags run.go:65-68)."""
+
+    def __init__(
+        self,
+        loader: ModelLoader,
+        *,
+        busy_timeout: float = 5 * 60,
+        idle_timeout: float = 15 * 60,
+        enable_busy: bool = False,
+        enable_idle: bool = False,
+        interval: float = 30.0,
+    ) -> None:
+        self.loader = loader
+        self.busy_timeout = busy_timeout
+        self.idle_timeout = idle_timeout
+        self.enable_busy = enable_busy
+        self.enable_idle = enable_idle
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is None and (self.enable_busy or self.enable_idle):
+            self._thread = threading.Thread(
+                target=self._run, name="watchdog", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.check(time.monotonic())
+
+    def check(self, now: float) -> list[str]:
+        """One tick; returns names killed (separated out for tests)."""
+        killed = []
+        for name in self.loader.loaded_names():
+            lm = self.loader.get(name)
+            if lm is None:
+                continue
+            if (
+                self.enable_busy
+                and lm.busy_since is not None
+                and now - lm.busy_since > self.busy_timeout
+            ):
+                log.warning("watchdog: %s busy > %.0fs, killing",
+                            name, self.busy_timeout)
+                self.loader.shutdown_model(name)
+                killed.append(name)
+            elif (
+                self.enable_idle
+                and lm.busy_since is None
+                and now - lm.last_used > self.idle_timeout
+            ):
+                log.warning("watchdog: %s idle > %.0fs, killing",
+                            name, self.idle_timeout)
+                self.loader.shutdown_model(name)
+                killed.append(name)
+        return killed

@@ -1,0 +1,238 @@
+"""Prompt templating: chat assembly + completion/edit templates.
+
+Capability counterpart of the reference's template evaluator
+(ref: pkg/templates/evaluator.go:26-36 ChatMessageTemplateData,
+:56-92 template selection, :128+ TemplateMessages; cache.go template
+caching; gonja Jinja support evaluator.go:87-89).
+
+TPU-native design choice: Jinja2 is the single template engine (the HF
+ecosystem's chat-template dialect), replacing the reference's dual
+Go-text/template + gonja stack. For migration, simple Go-template
+pipelines (`{{.Field}}`, `{{if .Field}}...{{end}}`) are transpiled to
+Jinja on the fly so LocalAI model YAMLs keep working.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jinja2
+
+from ..config.model_config import ModelConfig
+
+_GO_PIPE = re.compile(r"\{\{\s*(if|else if)?\s*\.([A-Za-z_][A-Za-z0-9_.]*)\s*\}\}")
+_GO_ELSE = re.compile(r"\{\{\s*else\s*\}\}")
+_GO_END = re.compile(r"\{\{\s*end\s*\}\}")
+
+
+def go_template_to_jinja(src: str) -> str:
+    """Best-effort transpile of simple Go text/templates to Jinja2.
+
+    Covers the forms that appear in LocalAI model galleries:
+    ``{{.Input}}``, ``{{ .SystemPrompt }}``, ``{{if .Content}}…{{else}}…
+    {{end}}``. Anything richer should be written as Jinja directly.
+    """
+    def sub(m: re.Match) -> str:
+        kw, path = m.group(1), m.group(2)
+        expr = path.replace(".", "_")
+        if kw is None:
+            return "{{ %s }}" % expr
+        if kw == "if":
+            return "{%% if %s %%}" % expr
+        return "{%% elif %s %%}" % expr
+
+    out = _GO_PIPE.sub(sub, src)
+    out = _GO_ELSE.sub("{% else %}", out)
+    out = _GO_END.sub("{% endif %}", out)
+    return out
+
+
+@dataclass
+class ChatMessageData:
+    """Per-message template variables (ref: evaluator.go:26-36)."""
+
+    SystemPrompt: str = ""
+    Role: str = ""
+    RoleName: str = ""
+    Content: str = ""
+    FunctionCall: Any = None
+    FunctionName: str = ""
+    LastMessage: bool = False
+    Function: bool = False
+    MessageIndex: int = 0
+
+
+@dataclass
+class PromptTemplateData:
+    """Top-level template variables (ref: evaluator.go chat/completion)."""
+
+    SystemPrompt: str = ""
+    Input: str = ""
+    Instruction: str = ""
+    Functions: list[dict] = field(default_factory=list)
+    MessageIndex: int = 0
+
+
+class Evaluator:
+    """Selects and renders the right template per endpoint
+    (ref: pkg/templates/evaluator.go Evaluator)."""
+
+    def __init__(self, models_path: str = "") -> None:
+        self.models_path = models_path
+        self._env = jinja2.Environment(
+            loader=jinja2.BaseLoader(), keep_trailing_newline=True,
+            trim_blocks=False, lstrip_blocks=False,
+        )
+        self._env.globals["raise_exception"] = _raise_exception
+        self._cache: dict[str, jinja2.Template] = {}
+
+    # -- template resolution (ref: evaluator.go:56-92: explicit template
+    #    name, else <model>.tmpl file, else none) --
+
+    def _load_source(self, name_or_text: str) -> str:
+        """A template field is inline text if it contains '{{' or '{%';
+        otherwise it names a .tmpl/.jinja file under models_path."""
+        if "{{" in name_or_text or "{%" in name_or_text:
+            return name_or_text
+        for ext in ("", ".tmpl", ".jinja", ".jinja2"):
+            p = os.path.join(self.models_path, name_or_text + ext)
+            if self.models_path and os.path.isfile(p):
+                with open(p) as f:
+                    return f.read()
+        return name_or_text  # literal text without placeholders
+
+    def _compile(self, source: str) -> jinja2.Template:
+        tpl = self._cache.get(source)
+        if tpl is None:
+            src = source
+            if re.search(r"\{\{\s*(if\s|else|end|\.)", src):
+                src = go_template_to_jinja(src)
+            tpl = self._env.from_string(src)
+            self._cache[source] = tpl
+        return tpl
+
+    def _render(self, source: str, data: Any) -> str:
+        ctx = dict(data.__dict__)
+        # expose both Go-style (Field) and snake_case names, plus the
+        # transpiler's dotted-path flattening (Function_Name)
+        for k, v in list(ctx.items()):
+            ctx[_snake(k)] = v
+        return self._compile(self._load_source(source)).render(**ctx)
+
+    # -- public API --
+
+    def evaluate_completion(self, cfg: ModelConfig, prompt: str) -> str:
+        if not cfg.template.completion:
+            return prompt
+        return self._render(
+            cfg.template.completion,
+            PromptTemplateData(Input=prompt, SystemPrompt=cfg.system_prompt),
+        )
+
+    def evaluate_edit(self, cfg: ModelConfig, input_: str,
+                      instruction: str) -> str:
+        if not cfg.template.edit:
+            return f"{instruction}\n\n{input_}"
+        data = PromptTemplateData(
+            Input=input_, Instruction=instruction,
+            SystemPrompt=cfg.system_prompt,
+        )
+        return self._render(cfg.template.edit, data)
+
+    def template_messages(
+        self,
+        cfg: ModelConfig,
+        messages: list[dict],
+        tokenizer: Any = None,
+        functions: Optional[list[dict]] = None,
+        use_function_template: bool = False,
+    ) -> str:
+        """Assemble the full chat prompt (ref: evaluator.go TemplateMessages
+        :128+). Precedence: tokenizer chat template (if requested or no
+        explicit template), else per-message template + chat template."""
+        use_tok = cfg.template.use_tokenizer_template or not (
+            cfg.template.chat or cfg.template.chat_message
+        )
+        if use_tok and tokenizer is not None and getattr(
+            tokenizer, "chat_template", None
+        ):
+            msgs = list(messages)
+            if cfg.system_prompt and not any(
+                m.get("role") == "system" for m in msgs
+            ):
+                msgs = [{"role": "system", "content": cfg.system_prompt}] + msgs
+            return tokenizer.apply_chat_template(
+                msgs, add_generation_prompt=True, tools=functions or None
+            )
+
+        rendered: list[str] = []
+        n = len(messages)
+        for i, msg in enumerate(messages):
+            role = msg.get("role", "user")
+            content = _content_to_text(msg.get("content"))
+            fcall = msg.get("tool_calls") or msg.get("function_call")
+            data = ChatMessageData(
+                SystemPrompt=cfg.system_prompt,
+                Role=cfg.roles.get(role, role),
+                RoleName=role,
+                Content=content,
+                FunctionCall=fcall,
+                FunctionName=msg.get("name", ""),
+                LastMessage=i == n - 1,
+                Function=bool(fcall) or role in ("tool", "function"),
+                MessageIndex=i,
+            )
+            if cfg.template.chat_message:
+                rendered.append(self._render(cfg.template.chat_message, data))
+            else:
+                prefix = data.Role
+                rendered.append(f"{prefix}: {content}" if prefix else content)
+
+        joiner = cfg.template.join_chat_messages_by_character
+        if joiner is None:
+            joiner = "\n"
+        combined = joiner.join(r for r in rendered if r)
+
+        chat_tpl = (
+            cfg.template.function
+            if use_function_template and cfg.template.function
+            else cfg.template.chat
+        )
+        if chat_tpl:
+            return self._render(
+                chat_tpl,
+                PromptTemplateData(
+                    Input=combined,
+                    SystemPrompt=cfg.system_prompt,
+                    Functions=functions or [],
+                ),
+            )
+        return combined
+
+
+def _content_to_text(content: Any) -> str:
+    """OpenAI message content may be a string or multimodal part list
+    (ref: core/schema/openai.go content parts; middleware/request.go
+    :302-329 media handling — media slots handled by the caller)."""
+    if content is None:
+        return ""
+    if isinstance(content, str):
+        return content
+    if isinstance(content, list):
+        parts = []
+        for part in content:
+            if isinstance(part, dict) and part.get("type") == "text":
+                parts.append(part.get("text", ""))
+        return "".join(parts)
+    return str(content)
+
+
+def _snake(name: str) -> str:
+    return re.sub(r"(?<!^)(?=[A-Z])", "_", name).lower()
+
+
+def _raise_exception(msg: str):
+    raise jinja2.TemplateError(msg)

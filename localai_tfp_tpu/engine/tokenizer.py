@@ -1,0 +1,148 @@
+"""Tokenizer abstraction for the serving engine.
+
+Counterpart of the reference's tokenization paths: llama.cpp's tokenizer
+inside the C++ engine (ref: backend/cpp/llama/grpc-server.cpp
+`TokenizeString` :2603) and HF tokenizers in the Python workers
+(ref: backend/python/transformers/backend.py, vllm/backend.py:242-243).
+
+Two implementations:
+- ``HFTokenizer``: wraps a HuggingFace fast tokenizer from a checkpoint dir
+  (the production path; also carries the chat template for Jinja templating).
+- ``ByteTokenizer``: dependency-free bytes<->ids codec used by tests and as
+  the fallback when a checkpoint ships no tokenizer files.
+
+Both expose incremental, UTF-8-safe streaming detokenization: the engine
+emits byte-complete strings only (ref: the Go side's rune-reassembly of
+streamed bytes, core/backend/llm.go:128-152 — here it lives next to the
+tokenizer instead of the transport).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Protocol
+
+
+class Tokenizer(Protocol):
+    eos_ids: set[int]
+    bos_id: Optional[int]
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]: ...
+
+    def decode(self, ids: list[int]) -> str: ...
+
+    @property
+    def vocab_size(self) -> int: ...
+
+
+class ByteTokenizer:
+    """ids = raw UTF-8 bytes; 256=BOS, 257=EOS. Vocab 258 (tests/fallback)."""
+
+    def __init__(self) -> None:
+        self.bos_id: Optional[int] = 256
+        self.eos_ids = {257}
+
+    @property
+    def vocab_size(self) -> int:
+        return 258
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: list[int]) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """HuggingFace fast tokenizer from a local checkpoint directory."""
+
+    def __init__(self, model_dir: str) -> None:
+        from transformers import AutoTokenizer
+
+        self._tk = AutoTokenizer.from_pretrained(model_dir)
+        self.bos_id = self._tk.bos_token_id
+        eos = self._tk.eos_token_id
+        self.eos_ids = set()
+        if eos is not None:
+            self.eos_ids = set(eos) if isinstance(eos, (list, tuple)) else {eos}
+        # generation_config may widen eos (llama3: <|eot_id|>)
+        import json
+
+        gc = os.path.join(model_dir, "generation_config.json")
+        if os.path.exists(gc):
+            try:
+                with open(gc) as f:
+                    g = json.load(f)
+                ge = g.get("eos_token_id")
+                if isinstance(ge, int):
+                    self.eos_ids.add(ge)
+                elif isinstance(ge, list):
+                    self.eos_ids.update(ge)
+            except (ValueError, OSError):
+                pass
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._tk)
+
+    @property
+    def chat_template(self) -> Optional[str]:
+        return getattr(self._tk, "chat_template", None)
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]:
+        ids = self._tk.encode(text, add_special_tokens=False)
+        if add_bos and self.bos_id is not None:
+            ids = [self.bos_id] + ids
+        return ids
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tk.decode(ids, skip_special_tokens=False)
+
+    def apply_chat_template(self, messages: list[dict], *,
+                            add_generation_prompt: bool = True,
+                            tools: Optional[list] = None) -> str:
+        return self._tk.apply_chat_template(
+            messages, tokenize=False,
+            add_generation_prompt=add_generation_prompt, tools=tools,
+        )
+
+
+class StreamDecoder:
+    """Incremental detokenizer emitting only UTF-8-complete text.
+
+    Held per active request. ``push(token_id)`` returns the newly completed
+    text (possibly ""). Handles tokenizers whose decode is not prefix-stable
+    (sentencepiece space handling) by re-decoding a trailing token window.
+    """
+
+    def __init__(self, tokenizer: Tokenizer) -> None:
+        self._tk = tokenizer
+        self._ids: list[int] = []
+        self._emitted = ""
+
+    def push(self, token_id: int) -> str:
+        self._ids.append(token_id)
+        text = self._tk.decode(self._ids)
+        if text.endswith("�"):  # mid-UTF-8-sequence; wait for more bytes
+            return ""
+        if not text.startswith(self._emitted):
+            # non-prefix-stable decode: re-emit from scratch is wrong for a
+            # stream; emit the common suffix after the longest common prefix
+            common = os.path.commonprefix([text, self._emitted])
+            out = text[len(common):]
+        else:
+            out = text[len(self._emitted):]
+        self._emitted = text
+        return out
+
+    @property
+    def text(self) -> str:
+        return self._emitted
+
+
+def load_tokenizer(model_dir: str) -> Tokenizer:
+    for fname in ("tokenizer.json", "tokenizer_config.json", "vocab.json"):
+        if os.path.exists(os.path.join(model_dir, fname)):
+            return HFTokenizer(model_dir)
+    return ByteTokenizer()

@@ -1,0 +1,121 @@
+"""Grammar-constrained decoding: per-step token masks for the TPU sampler.
+
+The reference constrains generation by handing llama.cpp a GBNF grammar that
+its sampler consults per candidate token (ref: pkg/functions builds the
+grammar; grpc-server.cpp:2441-2454 plumbs grammar triggers). On TPU the
+sampler runs on device, so the constraint is realized as a boolean
+vocab mask computed host-side by a pushdown automaton and shipped with the
+decode dispatch (SURVEY.md §7 hard part #3: host mask computation
+overlapped with the device step).
+
+Mask computation walks a byte-trie of the vocabulary against the grammar's
+"set of stacks" state: a trie subtree is pruned the moment a prefix char is
+rejected, so the cost per step is proportional to the *feasible* frontier,
+not the vocab size. States are cached by (state, char) in the matcher.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .gbnf import Grammar, GrammarMatcher, MatchState, parse_gbnf
+
+
+class _TrieNode:
+    __slots__ = ("children", "token_ids")
+
+    def __init__(self) -> None:
+        self.children: dict[str, _TrieNode] = {}
+        self.token_ids: list[int] = []
+
+
+def _build_trie(token_strs: list[Optional[str]]) -> _TrieNode:
+    root = _TrieNode()
+    for tid, s in enumerate(token_strs):
+        if not s:
+            continue
+        node = root
+        for ch in s:
+            nxt = node.children.get(ch)
+            if nxt is None:
+                nxt = node.children[ch] = _TrieNode()
+            node = nxt
+        node.token_ids.append(tid)
+    return root
+
+
+class GrammarConstraint:
+    """Constrains decoding to strings of a GBNF grammar.
+
+    Engine contract (engine/engine.py GenRequest.constraint):
+    - ``initial_state()`` → opaque state
+    - ``next_mask(state)`` → np.bool_[vocab] of admissible next tokens
+    - ``advance(state, token_id)`` → next state
+    EOS is admitted iff the grammar can terminate at the current state.
+    """
+
+    def __init__(self, grammar: Grammar, tokenizer) -> None:
+        self.matcher = GrammarMatcher(grammar)
+        self.tokenizer = tokenizer
+        self.vocab_size = tokenizer.vocab_size
+        self.eos_ids = set(getattr(tokenizer, "eos_ids", ()) or ())
+        self._token_strs: list[Optional[str]] = [None] * self.vocab_size
+        for tid in range(self.vocab_size):
+            try:
+                s = tokenizer.decode([tid])
+            except Exception:
+                s = None
+            # control/special tokens (decode to empty or replacement char)
+            # are never part of grammar text
+            if s and "�" not in s:
+                self._token_strs[tid] = s
+        self._trie = _build_trie(self._token_strs)
+        self._mask_cache: dict[MatchState, np.ndarray] = {}
+
+    @classmethod
+    def from_gbnf(cls, text: str, tokenizer) -> "GrammarConstraint":
+        return cls(parse_gbnf(text), tokenizer)
+
+    def initial_state(self) -> MatchState:
+        return self.matcher.initial_state()
+
+    def advance(self, state: MatchState, token_id: int) -> MatchState:
+        s = self._token_strs[token_id]
+        if s is None:
+            return state  # eos / special token: state unchanged (terminal)
+        return self.matcher.accept_string(state, s)
+
+    def next_mask(self, state: MatchState) -> np.ndarray:
+        cached = self._mask_cache.get(state)
+        if cached is not None:
+            return cached
+        mask = np.zeros(self.vocab_size, dtype=bool)
+        # iterative DFS over the vocab trie, pruning rejected prefixes
+        stack = [(self._trie, state)]
+        while stack:
+            node, st = stack.pop()
+            for tid in node.token_ids:
+                mask[tid] = True
+            for ch, child in node.children.items():
+                nst = self.matcher.accept_char(st, ch)
+                if nst:
+                    stack.append((child, nst))
+        if self.matcher.can_end(state):
+            for e in self.eos_ids:
+                mask[e] = True
+        if len(self._mask_cache) < 4096:
+            self._mask_cache[state] = mask
+        return mask
+
+
+class JSONConstraint(GrammarConstraint):
+    """Constrain output to (schema-conforming) JSON — the TPU realization of
+    the reference's response_format json_schema → BNF path
+    (ref: core/http/endpoints/openai/chat.go:216-246)."""
+
+    def __init__(self, tokenizer, schema: Optional[dict] = None) -> None:
+        from .json_schema import schema_to_gbnf
+
+        super().__init__(parse_gbnf(schema_to_gbnf(schema)), tokenizer)

@@ -81,9 +81,6 @@ def load_params(
     def t(name: str) -> np.ndarray:  # weight, transposed to [in, out]
         return np.ascontiguousarray(get(name).T)
 
-    def maybe(name: str) -> Optional[np.ndarray]:
-        return get(name) if name in names else None
-
     p: dict[str, Any] = {}
     prefix = "model." if "model.embed_tokens.weight" in names else ""
     p["embed"] = _cast(get(f"{prefix}embed_tokens.weight"), dtype)
@@ -164,5 +161,4 @@ def load_params(
         else:  # checkpoint ties despite config
             object.__setattr__(spec, "tie_word_embeddings", True)
 
-    return spec, {k: _cast(v, dtype) if isinstance(v, np.ndarray) else v
-                  for k, v in p.items()}
+    return spec, p

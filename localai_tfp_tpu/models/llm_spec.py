@@ -122,8 +122,15 @@ def spec_from_hf_config(cfg: dict[str, Any]) -> LLMSpec:
 
     if mt in ("llama", "mistral", ""):
         pass
-    elif mt in ("qwen2", "qwen2_5", "qwen3", "qwen2_moe"):
-        kw["qkv_bias"] = mt.startswith("qwen2")
+    elif mt in ("qwen2", "qwen2_5"):
+        kw["qkv_bias"] = True
+    elif mt in ("qwen3", "qwen2_moe"):
+        # qwen3 needs per-head q/k RMSNorm, qwen2_moe needs expert MLPs —
+        # refuse rather than silently emit wrong logits
+        raise NotImplementedError(
+            f"model_type '{mt}' is not supported yet (qwen3 q/k-norm and "
+            "qwen2_moe expert MLPs are unimplemented)"
+        )
     elif mt == "phi":
         kw.update(
             norm_type="layernorm",
@@ -138,19 +145,28 @@ def spec_from_hf_config(cfg: dict[str, Any]) -> LLMSpec:
         )
     elif mt == "phi3":
         pass  # llama-topology with fused proj names (handled in hf_loader)
-    elif mt in ("gemma", "gemma2", "gemma3", "gemma3_text"):
+    elif mt == "gemma":
         kw.update(
             norm_weight_plus_one=True,
             hidden_act="gelu_tanh",
             embedding_multiplier=float(d_model) ** 0.5,
             tie_word_embeddings=True,
         )
-        if mt in ("gemma2", "gemma3", "gemma3_text"):
-            kw.update(
-                logit_softcap=float(cfg.get("final_logit_softcapping") or 0.0),
-                attn_logit_softcap=float(cfg.get("attn_logit_softcapping") or 0.0),
-                query_pre_attn_scalar=cfg.get("query_pre_attn_scalar"),
-            )
+    elif mt in ("gemma2", "gemma3", "gemma3_text"):
+        # gemma2/3 use sandwich norms (post-attn/pre+post-ffw layernorms)
+        # and alternating sliding-window layers — not yet implemented
+        raise NotImplementedError(
+            f"model_type '{mt}' is not supported yet (sandwich norms / "
+            "alternating sliding-window layers unimplemented)"
+        )
+    else:
+        raise NotImplementedError(f"unknown model_type '{mt}'")
+    sc = kw.get("rope_scaling") or {}
+    rtype = (sc.get("rope_type") or sc.get("type") or "").lower()
+    if rtype not in ("", "default", "linear", "llama3", "yarn"):
+        raise NotImplementedError(
+            f"rope_scaling type '{rtype}' is not supported yet"
+        )
     kw["extra"] = {"model_type": mt}
     return LLMSpec(**kw)
 

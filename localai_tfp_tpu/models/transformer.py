@@ -203,13 +203,28 @@ def rope_inv_freq(spec: LLMSpec) -> jnp.ndarray:
     return inv
 
 
+def rope_attn_scale(spec: LLMSpec) -> float:
+    """YaRN attention scaling (mscale): HF multiplies cos/sin by
+    ``attention_factor`` (default 0.1*ln(factor)+1) for yarn-scaled models."""
+    sc = spec.rope_scaling or {}
+    rtype = (sc.get("rope_type") or sc.get("type") or "").lower()
+    if rtype != "yarn":
+        return 1.0
+    af = sc.get("attention_factor")
+    if af is not None:
+        return float(af)
+    return 0.1 * math.log(float(sc.get("factor", 1.0))) + 1.0
+
+
 def apply_rope(
-    x: jax.Array, positions: jax.Array, inv_freq: jax.Array, rotary_dim: int
+    x: jax.Array, positions: jax.Array, inv_freq: jax.Array, rotary_dim: int,
+    scale: float = 1.0,
 ) -> jax.Array:
-    """HF-convention rotate-half RoPE. x: [B, T, H, Dh]; positions: [B, T]."""
+    """HF-convention rotate-half RoPE. x: [B, T, H, Dh]; positions: [B, T].
+    ``scale`` is the YaRN mscale applied to cos/sin (1.0 otherwise)."""
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B,T,rd/2]
-    cos = jnp.cos(angles)[:, :, None, :]  # [B,T,1,rd/2]
-    sin = jnp.sin(angles)[:, :, None, :]
+    cos = jnp.cos(angles)[:, :, None, :] * scale  # [B,T,1,rd/2]
+    sin = jnp.sin(angles)[:, :, None, :] * scale
     rot, keep = x[..., :rotary_dim], x[..., rotary_dim:]
     x1, x2 = jnp.split(rot.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
@@ -270,7 +285,7 @@ def _act(spec: LLMSpec, x: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-def forward(
+def forward_hidden(
     spec: LLMSpec,
     params: Params,
     tokens: jax.Array,  # [B, T] int32
@@ -278,7 +293,10 @@ def forward(
     cache: KVCache,
     slot_ids: jax.Array,  # [B] int32: which cache slot each row occupies
 ) -> tuple[jax.Array, KVCache]:
-    """Run the stack; returns (logits [B, T, V] float32, updated cache).
+    """Run the stack up to (and including) the final norm; returns
+    (hidden [B, T, D], updated cache). The LM head lives in ``forward``;
+    this entry is the embeddings path (ref: transformers backend mean-pool,
+    backend/python/transformers/backend.py:286-324).
 
     Serves both phases: prefill passes T=chunk, decode passes T=1 with the
     full slot batch. Writes the new K/V into ``cache`` at rows ``slot_ids``
@@ -291,6 +309,7 @@ def forward(
 
     positions = pos0[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     inv_freq = rope_inv_freq(spec)
+    rope_scale = rope_attn_scale(spec)
     layer_keys = [k for k in params if params[k].ndim >= 1 and k not in (
         "embed", "final_norm_w", "final_norm_b", "lm_head", "lm_head_b")]
     stacked = {k: params[k] for k in layer_keys}
@@ -309,8 +328,8 @@ def forward(
         k = k.reshape(B, T, spec.n_kv_heads, spec.d_head)
         v = v.reshape(B, T, spec.n_kv_heads, spec.d_head)
         rd = spec.rotary_dim
-        q = apply_rope(q, positions, inv_freq, rd)
-        k = apply_rope(k, positions, inv_freq, rd)
+        q = apply_rope(q, positions, inv_freq, rd, rope_scale)
+        k = apply_rope(k, positions, inv_freq, rd, rope_scale)
 
         # scatter new kv into the slot rows at their offsets
         def write(cbuf, new):
@@ -349,6 +368,19 @@ def forward(
 
     if spec.final_norm:
         x = _norm(spec, x, params["final_norm_w"], params.get("final_norm_b"))
+    return x, KVCache(k=new_k, v=new_v)
+
+
+def forward(
+    spec: LLMSpec,
+    params: Params,
+    tokens: jax.Array,
+    pos0: jax.Array,
+    cache: KVCache,
+    slot_ids: jax.Array,
+) -> tuple[jax.Array, KVCache]:
+    """forward_hidden + LM head; returns (logits [B, T, V] f32, cache)."""
+    x, cache = forward_hidden(spec, params, tokens, pos0, cache, slot_ids)
     head = (
         params["embed"].T if spec.tie_word_embeddings else params["lm_head"]
     )
@@ -363,7 +395,7 @@ def forward(
         logits = logits + params["lm_head_b"].astype(jnp.float32)
     if spec.logit_softcap:
         logits = jnp.tanh(logits / spec.logit_softcap) * spec.logit_softcap
-    return logits, KVCache(k=new_k, v=new_v)
+    return logits, cache
 
 
 @partial(jax.jit, static_argnums=(0,), donate_argnums=(4,))

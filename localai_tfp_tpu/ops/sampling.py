@@ -1,0 +1,277 @@
+"""Batched token sampling for the decode hot loop (pure JAX, jit-fused).
+
+Capability counterpart of the reference's per-slot sampling
+(ref: backend/cpp/llama/grpc-server.cpp — `llama_sampling_sample` inside
+`update_slots` :2060, per-slot sampling params `llama_client_slot`
+:188-265; surface: core/schema/prediction.go PredictionOptions).
+
+TPU-first design: one compiled sampler handles the whole slot batch every
+step. All per-request knobs are *arrays* indexed by slot, not Python
+scalars — mixed temperature/top-k/top-p across slots never retrigger
+compilation, and the sampler fuses into the decode step dispatch.
+
+Penalty state (token counts over a sliding window of the last ``repeat_last_n``
+tokens) is carried as a dense [n_slots, vocab] count matrix updated
+incrementally on-device: O(1) per step instead of re-scanning history.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+@dataclass
+class SamplingState:
+    """Per-slot sampling parameters + PRNG + penalty state, all device arrays.
+
+    Shapes: everything leading dim ``n_slots``. A slot's row is rewritten
+    (host->device of a few scalars) when a request is admitted.
+    """
+
+    rng: jax.Array  # [S, 2] uint32 per-slot PRNG keys
+    temperature: jax.Array  # [S] f32; <=0 => greedy
+    top_k: jax.Array  # [S] i32; 0 => disabled
+    top_p: jax.Array  # [S] f32; >=1 => disabled
+    min_p: jax.Array  # [S] f32; 0 => disabled
+    repeat_penalty: jax.Array  # [S] f32; 0 or 1 => disabled
+    freq_penalty: jax.Array  # [S] f32
+    presence_penalty: jax.Array  # [S] f32
+    token_counts: jax.Array  # [S, V] i32 counts within penalty window
+    history: jax.Array  # [S, W] i32 ring buffer of recent tokens (-1 empty)
+    history_pos: jax.Array  # [S] i32 ring write cursor
+    repeat_last_n: jax.Array  # [S] i32 effective window size (<= W)
+
+    @classmethod
+    def create(cls, n_slots: int, vocab_size: int, window: int = 256,
+               seed: int = 0) -> "SamplingState":
+        keys = jax.random.split(jax.random.PRNGKey(seed), n_slots)
+        return cls(
+            rng=keys,
+            temperature=jnp.zeros((n_slots,), jnp.float32),
+            top_k=jnp.zeros((n_slots,), jnp.int32),
+            top_p=jnp.ones((n_slots,), jnp.float32),
+            min_p=jnp.zeros((n_slots,), jnp.float32),
+            repeat_penalty=jnp.zeros((n_slots,), jnp.float32),
+            freq_penalty=jnp.zeros((n_slots,), jnp.float32),
+            presence_penalty=jnp.zeros((n_slots,), jnp.float32),
+            token_counts=jnp.zeros((n_slots, vocab_size), jnp.int32),
+            history=jnp.full((n_slots, window), -1, jnp.int32),
+            history_pos=jnp.zeros((n_slots,), jnp.int32),
+            repeat_last_n=jnp.full((n_slots,), min(64, window), jnp.int32),
+        )
+
+    @property
+    def window(self) -> int:
+        return self.history.shape[1]
+
+    def reset_slot(self, slot: int, *, temperature: float = 0.0,
+                   top_k: int = 0, top_p: float = 1.0, min_p: float = 0.0,
+                   repeat_penalty: float = 0.0, freq_penalty: float = 0.0,
+                   presence_penalty: float = 0.0, repeat_last_n: int = 64,
+                   seed: Optional[int] = None) -> "SamplingState":
+        """Host-side: configure one slot for a new request."""
+        s = slot
+        st = self
+        rng = st.rng
+        if seed is not None:
+            rng = rng.at[s].set(jax.random.PRNGKey(seed))
+        return SamplingState(
+            rng=rng,
+            temperature=st.temperature.at[s].set(temperature),
+            top_k=st.top_k.at[s].set(top_k),
+            top_p=st.top_p.at[s].set(top_p),
+            min_p=st.min_p.at[s].set(min_p),
+            repeat_penalty=st.repeat_penalty.at[s].set(repeat_penalty),
+            freq_penalty=st.freq_penalty.at[s].set(freq_penalty),
+            presence_penalty=st.presence_penalty.at[s].set(presence_penalty),
+            token_counts=st.token_counts.at[s].set(0),
+            history=st.history.at[s].set(-1),
+            history_pos=st.history_pos.at[s].set(0),
+            repeat_last_n=st.repeat_last_n.at[s].set(
+                min(repeat_last_n if repeat_last_n > 0 else 64, st.window)
+            ),
+        )
+
+
+jax.tree_util.register_pytree_node(
+    SamplingState,
+    lambda s: (
+        (s.rng, s.temperature, s.top_k, s.top_p, s.min_p, s.repeat_penalty,
+         s.freq_penalty, s.presence_penalty, s.token_counts, s.history,
+         s.history_pos, s.repeat_last_n),
+        None,
+    ),
+    lambda _, ch: SamplingState(*ch),
+)
+
+
+def observe_tokens(state: SamplingState, slot_ids: jax.Array,
+                   tokens: jax.Array, valid: jax.Array) -> SamplingState:
+    """Record tokens (prompt or sampled) into the penalty window.
+
+    slot_ids/tokens/valid: [B]. Evicts the token falling out of each slot's
+    ring window from ``token_counts`` so counts always reflect exactly the
+    last ``repeat_last_n`` tokens (ref: llama.cpp penalize window
+    `repeat_last_n`, grpc-server.cpp slot sampling params).
+    """
+    W = state.window
+    pos = state.history_pos[slot_ids]  # [B]
+    n = state.repeat_last_n[slot_ids]  # [B] per-slot window size
+    # token leaving the last-n window (written n steps ago)
+    old = jnp.where(
+        pos >= n, state.history[slot_ids, (pos - n) % W], -1
+    )
+    counts = state.token_counts
+    # decrement evicted (only if a real token was there and op is valid)
+    dec = valid & (old >= 0)
+    counts = counts.at[slot_ids, jnp.where(old >= 0, old, 0)].add(
+        -dec.astype(jnp.int32)
+    )
+    inc = valid & (tokens >= 0)
+    counts = counts.at[slot_ids, jnp.where(tokens >= 0, tokens, 0)].add(
+        inc.astype(jnp.int32)
+    )
+    hist = state.history.at[slot_ids, pos % W].set(
+        jnp.where(valid, tokens, state.history[slot_ids, pos % W])
+    )
+    newpos = jnp.where(valid, pos + 1, pos)
+    return SamplingState(
+        rng=state.rng,
+        temperature=state.temperature,
+        top_k=state.top_k,
+        top_p=state.top_p,
+        min_p=state.min_p,
+        repeat_penalty=state.repeat_penalty,
+        freq_penalty=state.freq_penalty,
+        presence_penalty=state.presence_penalty,
+        token_counts=counts,
+        history=hist,
+        history_pos=state.history_pos.at[slot_ids].set(newpos),
+        repeat_last_n=state.repeat_last_n,
+    )
+
+
+@jax.jit
+def observe_sequence(state: SamplingState, slot_id: jax.Array,
+                     tokens: jax.Array, length: jax.Array) -> SamplingState:
+    """Sequentially record ``tokens[:length]`` (padded [T]) into one slot's
+    penalty window — used to seed the window with the prompt tail. A scan,
+    because successive tokens in one slot must update the ring in order."""
+
+    def body(st, tok_i):
+        tok, i = tok_i
+        return (
+            observe_tokens(st, slot_id[None], tok[None], (i < length)[None]),
+            None,
+        )
+
+    state, _ = lax.scan(
+        body, state, (tokens, jnp.arange(tokens.shape[0], dtype=jnp.int32))
+    )
+    return state
+
+
+def _apply_penalties(logits: jax.Array, counts: jax.Array,
+                     repeat_penalty: jax.Array, freq_penalty: jax.Array,
+                     presence_penalty: jax.Array) -> jax.Array:
+    """llama.cpp-convention penalties (ref: common/sampling in llama.cpp used
+    by grpc-server.cpp): repeat divides positive logits / multiplies
+    negative; frequency/presence are OpenAI-style subtractive."""
+    present = counts > 0
+    rp = jnp.where(repeat_penalty[:, None] > 0, repeat_penalty[:, None], 1.0)
+    penalized = jnp.where(logits > 0, logits / rp, logits * rp)
+    logits = jnp.where(present, penalized, logits)
+    logits = logits - counts.astype(jnp.float32) * freq_penalty[:, None]
+    logits = logits - present.astype(jnp.float32) * presence_penalty[:, None]
+    return logits
+
+
+def _mask_top_k(logits: jax.Array, k: jax.Array) -> jax.Array:
+    """Per-row dynamic top-k via sort threshold. k==0 disables."""
+    V = logits.shape[-1]
+    sorted_desc = -jnp.sort(-logits, axis=-1)  # [B, V]
+    kk = jnp.clip(jnp.where(k <= 0, V, k), 1, V)
+    thresh = jnp.take_along_axis(sorted_desc, (kk - 1)[:, None], axis=-1)
+    return jnp.where(logits >= thresh, logits, NEG_INF)
+
+
+def _mask_top_p(logits: jax.Array, p: jax.Array) -> jax.Array:
+    """Nucleus: keep the smallest prefix of desc-sorted probs with mass >= p."""
+    idx = jnp.argsort(-logits, axis=-1)
+    sorted_logits = jnp.take_along_axis(logits, idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep while cumulative mass *before* this token < p (always keep 1st)
+    keep_sorted = (cum - probs) < p[:, None]
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(logits.shape[0])[:, None], idx
+    ].set(keep_sorted)
+    return jnp.where(keep, logits, NEG_INF)
+
+
+def _mask_min_p(logits: jax.Array, min_p: jax.Array) -> jax.Array:
+    probs = jax.nn.softmax(logits, axis=-1)
+    thresh = probs.max(axis=-1, keepdims=True) * min_p[:, None]
+    return jnp.where(probs >= thresh, logits, NEG_INF)
+
+
+def sample(
+    state: SamplingState,
+    slot_ids: jax.Array,  # [B] i32 — which slot each logits row belongs to
+    logits: jax.Array,  # [B, V] f32 — last-position logits
+    mask: Optional[jax.Array] = None,  # [B, V] bool — grammar/logit-bias mask
+) -> tuple[jax.Array, SamplingState]:
+    """Sample one token per row; returns ([B] i32 tokens, updated state).
+
+    Greedy when temperature<=0 (reference behavior: temp==0 => argmax).
+    The token is recorded into the penalty window.
+    """
+    logits = logits.astype(jnp.float32)
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+
+    counts = state.token_counts[slot_ids]
+    logits = _apply_penalties(
+        logits, counts,
+        state.repeat_penalty[slot_ids],
+        state.freq_penalty[slot_ids],
+        state.presence_penalty[slot_ids],
+    )
+
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = state.temperature[slot_ids]
+    scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
+    scaled = _mask_top_k(scaled, state.top_k[slot_ids])
+    scaled = _mask_top_p(scaled, state.top_p[slot_ids])
+    scaled = _mask_min_p(scaled, state.min_p[slot_ids])
+
+    keys = state.rng[slot_ids]
+    split = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
+    new_keys, sample_keys = split[:, 0], split[:, 1]
+    gumbel = jax.vmap(
+        lambda k, row: jax.random.gumbel(k, row.shape, jnp.float32)
+    )(sample_keys, scaled)
+    sampled_tok = jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
+
+    tok = jnp.where(temp <= 0.0, greedy_tok, sampled_tok)
+
+    rng = state.rng.at[slot_ids].set(new_keys)
+    state = SamplingState(
+        rng=rng, temperature=state.temperature, top_k=state.top_k,
+        top_p=state.top_p, min_p=state.min_p,
+        repeat_penalty=state.repeat_penalty, freq_penalty=state.freq_penalty,
+        presence_penalty=state.presence_penalty,
+        token_counts=state.token_counts, history=state.history,
+        history_pos=state.history_pos, repeat_last_n=state.repeat_last_n,
+    )
+    valid = jnp.ones(tok.shape, bool)
+    state = observe_tokens(state, slot_ids, tok, valid)
+    return tok, state

@@ -1,0 +1,59 @@
+"""Device-mesh construction and named axes.
+
+The TPU-native replacement for the reference's three distribution planes
+(ref: SURVEY.md §2.5): llama.cpp tensor_split / vLLM tensor_parallel_size
+become a 'model' mesh axis; request-level parallelism becomes the 'data'
+axis; long-context sequence sharding rides the 'seq' axis. Collectives are
+inserted by XLA/GSPMD from sharding annotations — there is no NCCL/MPI
+analogue to manage (ref: backend.proto:185 TensorSplit,
+vllm/backend.py:106 tensor_parallel_size).
+
+Axis convention (shared by sharding.py and the serving engine):
+- "data"  — batch / slots (DP)
+- "seq"   — sequence dimension (SP/context parallel)
+- "model" — hidden/heads/vocab (TP over ICI)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "seq", "model")
+
+
+def make_mesh(shape: Optional[dict[str, int]] = None,
+              devices: Optional[list] = None) -> Mesh:
+    """Build a Mesh from an {axis: size} dict (config surface:
+    ModelConfig.mesh / ApplicationConfig.mesh_shape). Missing axes get
+    size 1; a single unspecified axis absorbs the remaining devices."""
+    devs = devices if devices is not None else jax.devices()
+    n = len(devs)
+    shape = dict(shape or {})
+    sizes = {ax: int(shape.get(ax, 0)) for ax in AXES}
+    known = math.prod(s for s in sizes.values() if s > 0)
+    unknown = [ax for ax in AXES if sizes[ax] <= 0]
+    if known > n or n % max(known, 1):
+        raise ValueError(
+            f"mesh {shape} incompatible with {n} devices"
+        )
+    rest = n // known
+    for ax in unknown:
+        sizes[ax] = 1
+    if unknown:
+        sizes[unknown[-1]] = rest  # default leftover → model axis if unset
+    if math.prod(sizes.values()) != n:
+        raise ValueError(
+            f"mesh sizes {sizes} do not multiply to device count {n}"
+        )
+    arr = np.array(devs).reshape(sizes["data"], sizes["seq"], sizes["model"])
+    return Mesh(arr, AXES)
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh({"data": 1, "seq": 1, "model": 1},
+                     devices=jax.devices()[:1])

@@ -1,0 +1,92 @@
+"""Sharding rules for the stacked-scan parameter layout.
+
+TP/DP/SP layout (the GSPMD counterpart of the reference's tensor_split /
+tensor_parallel_size knobs — ref: backend.proto:185, vllm/backend.py:106):
+
+- Column-parallel projections (wq/wk/wv/w_gate/w_up): shard the OUTPUT
+  feature dim over "model" — each chip computes its own head/ffw slice.
+- Row-parallel projections (wo/w_down): shard the INPUT feature dim over
+  "model" — XLA inserts the psum (all-reduce) after the matmul, the
+  classic Megatron pairing, riding ICI.
+- Embedding + lm_head: vocab-sharded over "model".
+- Norms/biases on the model dim: replicated (biases on sharded dims follow
+  their projection).
+- KV cache [L, slots, S, Hkv, Dh]: slots over "data", kv heads over
+  "model", seq over "seq" for context parallelism.
+
+All rules are expressed as PartitionSpecs keyed by parameter name so they
+apply to any LLMSpec without per-family code.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# name -> spec over [L, ...] stacked leaves
+PARAM_RULES: dict[str, P] = {
+    "embed": P("model", None),  # [V, D] vocab-sharded
+    "lm_head": P(None, "model"),  # [D, V]
+    "lm_head_b": P("model"),
+    "wq": P(None, None, "model"),  # [L, D, H*Dh] column-parallel
+    "wk": P(None, None, "model"),
+    "wv": P(None, None, "model"),
+    "bq": P(None, "model"),
+    "bk": P(None, "model"),
+    "bv": P(None, "model"),
+    "wo": P(None, "model", None),  # [L, H*Dh, D] row-parallel
+    "bo": P(None, None),
+    "w_gate": P(None, None, "model"),
+    "w_up": P(None, None, "model"),
+    "b_up": P(None, "model"),
+    "w_down": P(None, "model", None),  # [L, F, D] row-parallel
+    "b_down": P(None, None),
+    "ln1_w": P(None, None),
+    "ln1_b": P(None, None),
+    "ln2_w": P(None, None),
+    "ln2_b": P(None, None),
+    "final_norm_w": P(None),
+    "final_norm_b": P(None),
+}
+
+KV_CACHE_SPEC = P(None, "data", "seq", "model", None)
+TOKENS_SPEC = P("data", "seq")
+BATCH_SPEC = P("data")
+
+
+def param_specs(params: dict) -> dict[str, P]:
+    out = {}
+    for name in params:
+        spec = PARAM_RULES.get(name)
+        if spec is None:
+            spec = P(*([None] * params[name].ndim))
+        out[name] = spec
+    return out
+
+
+def shard_params(params: dict, mesh: Mesh) -> dict:
+    """Place parameters onto the mesh per PARAM_RULES. Dims that don't
+    divide the axis size fall back to replication on that dim."""
+    specs = param_specs(params)
+    out = {}
+    for name, arr in params.items():
+        spec = _divisible_spec(arr.shape, specs[name], mesh)
+        out[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+    return out
+
+
+def _divisible_spec(shape, spec: P, mesh: Mesh) -> P:
+    fixed = []
+    for dim, axis in zip(shape, tuple(spec) + (None,) * len(shape)):
+        if axis is None:
+            fixed.append(None)
+            continue
+        size = mesh.shape[axis]
+        fixed.append(axis if dim % size == 0 else None)
+    return P(*fixed)
+
+
+def logical_sharding(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)

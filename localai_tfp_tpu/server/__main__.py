@@ -1,0 +1,68 @@
+"""CLI entry: ``python -m localai_tfp_tpu.server``.
+
+Ref: core/cli/run.go RunCMD — the `local-ai run` surface. Flags cover the
+subset that applies on TPU; every flag also reads its LOCALAI_* env alias
+via ApplicationConfig.from_env (ref: run.go:22-72 env bindings).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from ..config.app_config import ApplicationConfig
+from .app import run
+from .state import Application
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser("localai_tfp_tpu.server")
+    ap.add_argument("--models-path", default=None)
+    ap.add_argument("--address", default=None)
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--api-key", action="append", default=None)
+    ap.add_argument("--debug", action="store_true")
+    ap.add_argument("--single-active-backend", action="store_true")
+    ap.add_argument("--enable-watchdog-idle", action="store_true")
+    ap.add_argument("--enable-watchdog-busy", action="store_true")
+    ap.add_argument("--watchdog-idle-timeout", type=float, default=None)
+    ap.add_argument("--watchdog-busy-timeout", type=float, default=None)
+    ap.add_argument("--disable-metrics", action="store_true")
+    ap.add_argument("--machine-tag", default=None)
+    args = ap.parse_args()
+
+    cfg = ApplicationConfig.from_env()
+    if args.models_path is not None:
+        cfg.models_path = args.models_path
+    if args.address is not None:
+        cfg.address = args.address
+    if args.port is not None:
+        cfg.port = args.port
+    if args.api_key:
+        cfg.api_keys = args.api_key
+    if args.debug:
+        cfg.debug = True
+    if args.single_active_backend:
+        cfg.single_active_backend = True
+    if args.enable_watchdog_idle:
+        cfg.enable_watchdog_idle = True
+    if args.enable_watchdog_busy:
+        cfg.enable_watchdog_busy = True
+    if args.watchdog_idle_timeout is not None:
+        cfg.watchdog_idle_timeout = args.watchdog_idle_timeout
+    if args.watchdog_busy_timeout is not None:
+        cfg.watchdog_busy_timeout = args.watchdog_busy_timeout
+    if args.disable_metrics:
+        cfg.disable_metrics = True
+    if args.machine_tag is not None:
+        cfg.machine_tag = args.machine_tag
+
+    logging.basicConfig(
+        level=logging.DEBUG if cfg.debug else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    run(Application(cfg))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""HTTP API assembly: middlewares + route registration.
+
+Ref: core/http/app.go:53-215 API() — error handling, API-key auth with
+exemptions (:139-174), Machine-Tag header (:94-100), Prometheus middleware
+(:123-135), static generated-content serving (:158-171), route groups
+(routes/openai.go, routes/localai.go, routes/elevenlabs.go, routes/jina.go,
+routes/health.go). All OpenAI routes are registered both with and without
+the /v1 prefix, as in the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import uuid
+
+from aiohttp import web
+
+from .state import Application
+from . import openai_routes, localai_routes
+
+log = logging.getLogger(__name__)
+
+# endpoints exempt from API-key auth (ref: app.go:139-174 default filters)
+AUTH_EXEMPT = {"/healthz", "/readyz", "/metrics", "/version"}
+
+
+def json_error(status: int, message: str, opaque: bool = False) -> web.Response:
+    if opaque:  # ref: app.go:64-88 opaque-error hardening
+        return web.json_response({"error": {"code": status}}, status=status)
+    return web.json_response(
+        {"error": {"code": status, "message": message, "type": ""}},
+        status=status,
+    )
+
+
+@web.middleware
+async def error_middleware(request: web.Request, handler):
+    app: Application = request.app["state"]
+    try:
+        return await handler(request)
+    except web.HTTPException:
+        raise
+    except NotImplementedError as e:
+        return json_error(501, f"not implemented: {e}",
+                          app.config.opaque_errors)
+    except Exception as e:
+        log.exception("handler error on %s", request.path)
+        return json_error(500, str(e), app.config.opaque_errors)
+
+
+@web.middleware
+async def auth_middleware(request: web.Request, handler):
+    app: Application = request.app["state"]
+    keys = app.config.api_keys
+    if keys and request.path not in AUTH_EXEMPT:
+        auth = request.headers.get("Authorization", "")
+        xkey = request.headers.get("x-api-key", "")
+        token = auth[7:] if auth.startswith("Bearer ") else xkey
+        if token not in keys:
+            return json_error(401, "unauthorized")
+    return await handler(request)
+
+
+@web.middleware
+async def telemetry_middleware(request: web.Request, handler):
+    """Machine-Tag + X-Correlation-ID headers and the api_call histogram
+    (ref: app.go:94-100, :123-135; chat.go:326 correlation id)."""
+    app: Application = request.app["state"]
+    t0 = time.perf_counter()
+    corr = request.headers.get("X-Correlation-ID") or uuid.uuid4().hex
+    request["correlation_id"] = corr
+    resp = None
+    try:
+        resp = await handler(request)
+        return resp
+    finally:
+        if not app.config.disable_metrics:
+            app.metrics.observe(
+                request.method, request.path, time.perf_counter() - t0
+            )
+        if resp is not None:
+            if app.config.machine_tag:
+                resp.headers["Machine-Tag"] = app.config.machine_tag
+            resp.headers["X-Correlation-ID"] = corr
+
+
+def build_app(state: Application) -> web.Application:
+    app = web.Application(
+        middlewares=[telemetry_middleware, auth_middleware, error_middleware],
+        client_max_size=state.config.upload_limit_mb * 1024 * 1024,
+    )
+    app["state"] = state
+
+    openai_routes.register(app)
+    localai_routes.register(app)
+
+    async def on_startup(app_):
+        state.startup()
+
+    async def on_cleanup(app_):
+        state.shutdown()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def run(state: Application) -> None:
+    app = build_app(state)
+    web.run_app(app, host=state.config.address, port=state.config.port)

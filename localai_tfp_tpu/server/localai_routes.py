@@ -1,0 +1,267 @@
+"""LocalAI-native endpoints.
+
+Ref: core/http/routes/localai.go — /tts, /vad, /rerank (jina), stores,
+/metrics, backend monitor/shutdown, /system, /version, health
+(routes/health.go), ElevenLabs adapters (routes/elevenlabs.go).
+Gallery REST lands with the gallery service.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from aiohttp import web
+
+from ..config.model_config import Usecase
+from ..version import __version__
+from ..workers.base import PredictOptions
+from .state import Application
+
+
+def register(app: web.Application) -> None:
+    r = app.router
+    r.add_get("/healthz", health)
+    r.add_get("/readyz", health)
+    r.add_get("/version", version)
+    r.add_get("/metrics", metrics)
+    r.add_get("/system", system)
+    r.add_get("/backend/monitor", backend_monitor)
+    r.add_post("/backend/shutdown", backend_shutdown)
+    r.add_post("/tts", tts)
+    for p in ("/vad", "/v1/vad"):
+        r.add_post(p, vad)
+    r.add_post("/v1/rerank", rerank)  # Jina-compatible (routes/jina.go)
+    # ElevenLabs-compatible (routes/elevenlabs.go:19-28)
+    r.add_post("/v1/text-to-speech/{voice_id}", tts_elevenlabs)
+    r.add_post("/v1/sound-generation", sound_generation)
+    for p in ("/stores/set", "/stores/delete", "/stores/get", "/stores/find"):
+        r.add_post(p, stores_dispatch)
+
+
+def _state(request: web.Request) -> Application:
+    return request.app["state"]
+
+
+async def _body(request: web.Request) -> dict:
+    try:
+        data = await request.json()
+    except Exception:
+        raise web.HTTPBadRequest(reason="invalid JSON body")
+    if not isinstance(data, dict):
+        raise web.HTTPBadRequest(reason="body must be a JSON object")
+    return data
+
+
+async def health(request: web.Request) -> web.Response:
+    return web.json_response({"status": "ok"})
+
+
+async def version(request: web.Request) -> web.Response:
+    return web.json_response({"version": __version__})
+
+
+async def metrics(request: web.Request) -> web.Response:
+    st = _state(request)
+    if st.config.disable_metrics:
+        raise web.HTTPNotFound()
+    return web.Response(text=st.metrics.render(),
+                        content_type="text/plain")
+
+
+async def system(request: web.Request) -> web.Response:
+    """ref: endpoints/localai/system.go — loaded models + capabilities."""
+    import jax
+
+    st = _state(request)
+    try:
+        devs = [str(d) for d in jax.devices()]
+    except RuntimeError:
+        devs = []
+    return web.json_response({
+        "backends": sorted(
+            set(__import__("localai_tfp_tpu.engine.loader",
+                           fromlist=["registry"]).registry.known())
+        ),
+        "loaded_models": st.model_loader.loaded_names(),
+        "devices": devs,
+        "uptime_s": time.time() - st.started_at,
+    })
+
+
+async def backend_monitor(request: web.Request) -> web.Response:
+    """ref: core/services/backend_monitor.go + endpoints /backend/monitor:
+    per-model status + process-level memory."""
+    import resource
+
+    st = _state(request)
+    body = await _body(request) if request.can_read_body else {}
+    name = body.get("model") or request.query.get("model")
+    if not name:
+        raise web.HTTPBadRequest(reason="model required")
+    lm = st.model_loader.get(name)
+    if lm is None:
+        raise web.HTTPNotFound(reason=f"model '{name}' not loaded")
+    status = lm.backend.status()
+    rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return web.json_response({
+        "memory_info": {"rss": rss_kb * 1024},
+        "status": status.state,
+        "backend": lm.backend_type,
+    })
+
+
+async def backend_shutdown(request: web.Request) -> web.Response:
+    st = _state(request)
+    body = await _body(request)
+    name = body.get("model")
+    if not name:
+        raise web.HTTPBadRequest(reason="model required")
+    ok = st.model_loader.shutdown_model(name)
+    if not ok:
+        raise web.HTTPNotFound(reason=f"model '{name}' not loaded")
+    return web.json_response({"success": True})
+
+
+# ---------------------------------------------------------------- media
+
+
+async def _tts_impl(request: web.Request, text: str, model_name,
+                    voice: str, language: str = "") -> web.Response:
+    st = _state(request)
+    cfg = st.config_loader.resolve(model_name, Usecase.TTS)
+    if cfg is None:
+        raise web.HTTPNotFound(reason="no TTS model available")
+    backend = await asyncio.get_running_loop().run_in_executor(
+        None, st.model_loader.load, cfg
+    )
+    import os
+    import uuid as _uuid
+
+    dst = os.path.join(st.config.generated_content_dir,
+                       f"tts-{_uuid.uuid4().hex}.wav")
+    res = backend.tts(text=text, voice=voice or cfg.tts.voice, dst=dst,
+                      language=language)
+    if not res.success:
+        raise web.HTTPInternalServerError(reason=res.message)
+    return web.FileResponse(dst)
+
+
+async def tts(request: web.Request) -> web.Response:
+    """ref: routes/localai.go:41 POST /tts."""
+    body = await _body(request)
+    return await _tts_impl(
+        request, body.get("input", ""), body.get("model"),
+        body.get("voice", ""), body.get("language", ""),
+    )
+
+
+async def tts_elevenlabs(request: web.Request) -> web.Response:
+    """ref: elevenlabs/tts.go — voice id in path, model in body."""
+    body = await _body(request)
+    return await _tts_impl(
+        request, body.get("text", ""), body.get("model_id"),
+        request.match_info["voice_id"],
+    )
+
+
+async def sound_generation(request: web.Request) -> web.Response:
+    body = await _body(request)
+    st = _state(request)
+    cfg = st.config_loader.resolve(body.get("model_id"),
+                                   Usecase.SOUND_GENERATION)
+    if cfg is None:
+        raise web.HTTPNotFound(reason="no sound-generation model available")
+    backend = await asyncio.get_running_loop().run_in_executor(
+        None, st.model_loader.load, cfg
+    )
+    import os
+    import uuid as _uuid
+
+    dst = os.path.join(st.config.generated_content_dir,
+                       f"sound-{_uuid.uuid4().hex}.wav")
+    res = backend.sound_generation(text=body.get("text", ""), dst=dst)
+    if not res.success:
+        raise web.HTTPInternalServerError(reason=res.message)
+    return web.FileResponse(dst)
+
+
+async def vad(request: web.Request) -> web.Response:
+    """ref: routes/localai.go:46-52; endpoints/localai/vad.go."""
+    body = await _body(request)
+    st = _state(request)
+    cfg = st.config_loader.resolve(body.get("model"), Usecase.VAD)
+    if cfg is None:
+        raise web.HTTPNotFound(reason="no VAD model available")
+    backend = await asyncio.get_running_loop().run_in_executor(
+        None, st.model_loader.load, cfg
+    )
+    res = backend.vad(body.get("audio") or [])
+    return web.json_response({
+        "segments": [{"start": s.start, "end": s.end} for s in res.segments]
+    })
+
+
+async def rerank(request: web.Request) -> web.Response:
+    """ref: jina/rerank.go — Jina-compatible POST /v1/rerank."""
+    body = await _body(request)
+    st = _state(request)
+    cfg = st.config_loader.resolve(body.get("model"), Usecase.RERANK)
+    if cfg is None:
+        raise web.HTTPNotFound(reason="no rerank model available")
+    backend = await asyncio.get_running_loop().run_in_executor(
+        None, st.model_loader.load, cfg
+    )
+    docs = body.get("documents") or []
+    res = await asyncio.get_running_loop().run_in_executor(
+        None, backend.rerank, body.get("query", ""), docs,
+        int(body.get("top_n") or len(docs)),
+    )
+    return web.json_response({
+        "model": cfg.name,
+        "usage": res.usage,
+        "results": [
+            {"index": d.index, "relevance_score": d.relevance_score,
+             "document": {"text": d.text}}
+            for d in res.results
+        ],
+    })
+
+
+# ---------------------------------------------------------------- stores
+
+
+async def stores_dispatch(request: web.Request) -> web.Response:
+    """ref: routes/localai.go:55-58 + endpoints/localai/stores.go — proxies
+    to the local-store backend."""
+    st = _state(request)
+    body = await _body(request)
+    cfg = st.config_loader.resolve(body.get("store") or "default-store",
+                                   Usecase.ANY)
+    if cfg is None:
+        from ..config.model_config import ModelConfig
+
+        cfg = ModelConfig.from_dict(
+            {"name": body.get("store") or "default-store",
+             "backend": "local-store"}
+        )
+        st.config_loader.register(cfg)
+    backend = await asyncio.get_running_loop().run_in_executor(
+        None, st.model_loader.load, cfg
+    )
+    op = request.path.rsplit("/", 1)[-1]
+    if op == "set":
+        backend.stores_set(body.get("keys") or [], body.get("values") or [])
+        return web.json_response({})
+    if op == "delete":
+        backend.stores_delete(body.get("keys") or [])
+        return web.json_response({})
+    if op == "get":
+        keys, values = backend.stores_get(body.get("keys") or [])
+        return web.json_response({"keys": keys, "values": values})
+    keys, values, sims = backend.stores_find(
+        body.get("key") or [], int(body.get("topk") or 10)
+    )
+    return web.json_response(
+        {"keys": keys, "values": values, "similarities": sims}
+    )

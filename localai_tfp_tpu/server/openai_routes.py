@@ -1,0 +1,572 @@
+"""OpenAI-compatible endpoints.
+
+Ref: core/http/routes/openai.go route table; endpoint behavior:
+- chat: core/http/endpoints/openai/chat.go:30-553 (streaming SSE, tool-call
+  orchestration, grammar injection, response_format json_schema→BNF)
+- completion: completion.go (208 LoC), edit: edit.go, embeddings:
+  embeddings.go, list: list.go
+- request→config merge: core/http/middleware/request.go:84-187
+
+Every route is registered both under /v1 and bare, as the reference does
+(routes/openai.go:25-126).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Any, Optional
+
+from aiohttp import web
+
+from ..config.model_config import ModelConfig, Usecase
+from ..grammars.json_schema import functions_grammar, schema_to_gbnf
+from ..grammars.parse import parse_function_call, parse_text_content
+from ..workers.base import Backend, PredictOptions, Reply
+from .state import Application
+
+
+def register(app: web.Application) -> None:
+    r = app.router
+    for prefix in ("/v1", ""):
+        r.add_post(f"{prefix}/chat/completions", chat_completions)
+        r.add_post(f"{prefix}/completions", completions)
+        r.add_post(f"{prefix}/edits", edits)
+        r.add_post(f"{prefix}/embeddings", embeddings)
+        r.add_post(f"{prefix}/engines/{{model}}/completions", completions)
+        r.add_post(f"{prefix}/engines/{{model}}/embeddings", embeddings)
+        r.add_get(f"{prefix}/models", list_models)
+    r.add_post("/v1/tokenize", tokenize)
+
+
+# --------------------------------------------------------------- helpers
+
+
+def _state(request: web.Request) -> Application:
+    return request.app["state"]
+
+
+async def _body(request: web.Request) -> dict:
+    try:
+        data = await request.json()
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        raise web.HTTPBadRequest(reason="invalid JSON body")
+    if not isinstance(data, dict):
+        raise web.HTTPBadRequest(reason="body must be a JSON object")
+    return data
+
+
+def _resolve_config(request: web.Request, body: dict,
+                    usecase: Usecase) -> ModelConfig:
+    """Model resolution: path param, body 'model', header, else first config
+    serving the usecase (ref: middleware/request.go:47-111)."""
+    st = _state(request)
+    name = (
+        request.match_info.get("model")
+        or body.get("model")
+        or request.headers.get("X-Model")
+    )
+    cfg = st.config_loader.resolve(name, usecase)
+    if cfg is None:
+        raise web.HTTPNotFound(
+            reason=f"model '{name}' not found" if name
+            else "no model available"
+        )
+    return cfg
+
+
+async def _load_backend(request: web.Request, cfg: ModelConfig) -> Backend:
+    st = _state(request)
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, st.model_loader.load, cfg)
+
+
+def _predict_options(cfg: ModelConfig, body: dict, prompt: str,
+                     correlation_id: str = "") -> PredictOptions:
+    """Merge request sampling over config defaults
+    (ref: middleware/request.go mergeOpenAIRequestAndBackendConfig :187+)."""
+    p = cfg.parameters
+
+    def pick(key: str, default, *aliases):
+        for k in (key, *aliases):
+            if body.get(k) is not None:
+                return body[k]
+        return default
+
+    stop = pick("stop", None)
+    if isinstance(stop, str):
+        stop = [stop]
+    stop = list(stop or []) + list(cfg.stopwords or [])
+
+    logit_bias = {}
+    for k, v in (body.get("logit_bias") or {}).items():
+        try:
+            logit_bias[int(k)] = float(v)
+        except (ValueError, TypeError):
+            pass
+
+    return PredictOptions(
+        prompt=prompt,
+        tokens=int(pick("max_tokens", p.max_tokens or 2048,
+                        "max_completion_tokens")),
+        temperature=float(pick("temperature", p.temperature or 0.0)),
+        top_p=float(pick("top_p", p.top_p if p.top_p is not None else 1.0)),
+        top_k=int(pick("top_k", p.top_k or 0)),
+        min_p=float(pick("min_p", p.min_p or 0.0)),
+        seed=body.get("seed", p.seed),
+        repeat_penalty=float(pick("repeat_penalty", p.repeat_penalty)),
+        repeat_last_n=int(pick("repeat_last_n", p.repeat_last_n)),
+        frequency_penalty=float(pick("frequency_penalty",
+                                     p.frequency_penalty)),
+        presence_penalty=float(pick("presence_penalty", p.presence_penalty)),
+        stop_prompts=stop,
+        ignore_eos=bool(pick("ignore_eos", p.ignore_eos)),
+        grammar=body.get("grammar", "") or cfg.grammar or "",
+        logit_bias=logit_bias,
+        correlation_id=correlation_id,
+    )
+
+
+def _usage(reply: Reply, extra_usage: bool) -> dict:
+    u = {
+        "prompt_tokens": reply.prompt_tokens,
+        "completion_tokens": reply.tokens,
+        "total_tokens": reply.prompt_tokens + reply.tokens,
+    }
+    if extra_usage:  # ref: chat.go:184 Extra-Usage header gate
+        u["timing_prompt_processing"] = reply.timing_prompt_processing
+        u["timing_token_generation"] = reply.timing_token_generation
+    return u
+
+
+def _grammar_for_request(cfg: ModelConfig, body: dict,
+                         tools: list[dict]) -> str:
+    """Grammar injection: tools → functions grammar; response_format
+    json_schema/json_object → schema grammar (ref: chat.go:216-294)."""
+    rf = body.get("response_format") or {}
+    if isinstance(rf, str):
+        rf = {"type": rf}
+    if rf.get("type") == "json_schema":
+        schema = (rf.get("json_schema") or {}).get("schema")
+        return schema_to_gbnf(schema)
+    if rf.get("type") == "json_object":
+        return schema_to_gbnf(None)
+    if tools:
+        opts = cfg.function.grammar_options()
+        if opts.get("disable"):
+            return ""
+        return functions_grammar(
+            tools,
+            parallel_calls=bool(opts.get("parallel_calls")),
+            mixed_mode=bool(opts.get("mixed_mode")),
+            prefix=opts.get("prefix", ""),
+            expect_strings_after_json=bool(
+                opts.get("expect_strings_after_json")
+            ),
+            prop_order=(opts.get("properties_order") or "").split(",")
+            if opts.get("properties_order") else None,
+            name_key=cfg.function.function_name_key or "name",
+            args_key=cfg.function.function_arguments_key or "arguments",
+        )
+    return ""
+
+
+def _extract_tools(body: dict) -> tuple[list[dict], bool]:
+    """Normalize tools[]/functions[] (ref: chat.go:250-294). Returns
+    (function defs, tools_requested)."""
+    tools = []
+    if body.get("tools"):
+        for t in body["tools"]:
+            if t.get("type") == "function" and t.get("function"):
+                tools.append(t["function"])
+    elif body.get("functions"):
+        tools = list(body["functions"])
+    choice = body.get("tool_choice") or body.get("function_call")
+    if choice == "none":
+        return [], False
+    if isinstance(choice, dict):
+        want = (choice.get("function") or choice).get("name")
+        tools = [t for t in tools if t.get("name") == want] or tools
+    return tools, bool(tools)
+
+
+def _tool_call_objects(calls) -> list[dict]:
+    return [
+        {
+            "id": f"call_{uuid.uuid4().hex[:12]}",
+            "type": "function",
+            "index": i,
+            "function": {"name": c.name, "arguments": c.arguments},
+        }
+        for i, c in enumerate(calls)
+    ]
+
+
+def _completion_id(prefix: str = "chatcmpl") -> str:
+    return f"{prefix}-{uuid.uuid4().hex[:28]}"
+
+
+async def _run_predict(backend: Backend, opts: PredictOptions) -> Reply:
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, backend.predict, opts)
+
+
+# ------------------------------------------------------------------- chat
+
+
+async def chat_completions(request: web.Request) -> web.StreamResponse:
+    st = _state(request)
+    body = await _body(request)
+    cfg = _resolve_config(request, body, Usecase.CHAT)
+    backend = await _load_backend(request, cfg)
+
+    messages = body.get("messages") or []
+    if not messages:
+        raise web.HTTPBadRequest(reason="messages required")
+
+    tools, tools_requested = _extract_tools(body)
+    grammar = _grammar_for_request(cfg, body, tools)
+
+    tokenizer = getattr(backend, "tokenizer", None)
+    prompt = st.evaluator.template_messages(
+        cfg, messages, tokenizer=tokenizer,
+        functions=tools or None, use_function_template=tools_requested,
+    )
+
+    opts = _predict_options(cfg, body, prompt,
+                            request.get("correlation_id", ""))
+    if grammar:
+        opts.grammar = grammar
+    extra_usage = "Extra-Usage" in request.headers
+    created = int(time.time())
+    cid = _completion_id()
+
+    st.model_loader.mark_busy(cfg.name)
+    try:
+        if body.get("stream"):
+            return await _stream_chat(
+                request, backend, opts, cfg, cid, created,
+                tools_requested, extra_usage,
+            )
+
+        reply = await _run_predict(backend, opts)
+        if reply.error:
+            raise web.HTTPInternalServerError(reason=reply.error)
+
+        message: dict[str, Any] = {"role": "assistant"}
+        finish = reply.finish_reason or "stop"
+        if tools_requested:
+            calls = parse_function_call(reply.message, cfg.function)
+            if calls:
+                message["tool_calls"] = _tool_call_objects(calls)
+                message["content"] = (
+                    parse_text_content(reply.message, cfg.function) or None
+                )
+                finish = "tool_calls"
+            else:
+                message["content"] = reply.message
+        else:
+            message["content"] = reply.message
+
+        return web.json_response({
+            "id": cid,
+            "object": "chat.completion",
+            "created": created,
+            "model": cfg.name,
+            "choices": [{
+                "index": 0,
+                "message": message,
+                "finish_reason": finish,
+            }],
+            "usage": _usage(reply, extra_usage),
+        })
+    finally:
+        st.model_loader.mark_idle(cfg.name)
+
+
+async def _stream_chat(
+    request: web.Request,
+    backend: Backend,
+    opts: PredictOptions,
+    cfg: ModelConfig,
+    cid: str,
+    created: int,
+    tools_requested: bool,
+    extra_usage: bool,
+) -> web.StreamResponse:
+    """SSE streaming (ref: chat.go:331-381 token chunks; tool-call streaming
+    chat.go:69-172: when tools are active the output is buffered, parsed,
+    and emitted as tool_call deltas)."""
+    resp = web.StreamResponse(headers={
+        "Content-Type": "text/event-stream",
+        "Cache-Control": "no-cache",
+        "Connection": "keep-alive",
+    })
+    await resp.prepare(request)
+
+    def chunk(delta: dict, finish: Optional[str] = None,
+              usage: Optional[dict] = None) -> bytes:
+        payload: dict[str, Any] = {
+            "id": cid,
+            "object": "chat.completion.chunk",
+            "created": created,
+            "model": cfg.name,
+            "choices": [{
+                "index": 0, "delta": delta, "finish_reason": finish,
+            }],
+        }
+        if usage is not None:
+            payload["usage"] = usage
+        return f"data: {json.dumps(payload)}\n\n".encode()
+
+    await resp.write(chunk({"role": "assistant", "content": ""}))
+
+    loop = asyncio.get_running_loop()
+    q: asyncio.Queue = asyncio.Queue()
+
+    def producer() -> None:
+        try:
+            for r in backend.predict_stream(opts):
+                loop.call_soon_threadsafe(q.put_nowait, r)
+        except Exception as e:  # surface engine errors as a final reply
+            loop.call_soon_threadsafe(
+                q.put_nowait, Reply(error=str(e), finish_reason="error")
+            )
+        loop.call_soon_threadsafe(q.put_nowait, None)
+
+    loop.run_in_executor(None, producer)
+
+    buffered = ""
+    final: Optional[Reply] = None
+    while True:
+        r = await q.get()
+        if r is None:
+            break
+        if r.finish_reason or r.error:
+            final = r
+            continue
+        if tools_requested:
+            buffered += r.message
+        elif r.message:
+            await resp.write(chunk({"content": r.message}))
+
+    finish = (final.finish_reason if final else "stop") or "stop"
+    if tools_requested and final is not None:
+        calls = parse_function_call(final.message, cfg.function)
+        if calls:
+            finish = "tool_calls"
+            for tc in _tool_call_objects(calls):
+                await resp.write(chunk({"tool_calls": [tc]}))
+        elif buffered:
+            await resp.write(chunk({"content": buffered}))
+    usage = _usage(final, extra_usage) if final is not None else None
+    await resp.write(chunk({}, finish=finish, usage=usage))
+    await resp.write(b"data: [DONE]\n\n")
+    await resp.write_eof()
+    return resp
+
+
+# ------------------------------------------------------------- completion
+
+
+async def completions(request: web.Request) -> web.StreamResponse:
+    st = _state(request)
+    body = await _body(request)
+    cfg = _resolve_config(request, body, Usecase.COMPLETION)
+    backend = await _load_backend(request, cfg)
+
+    prompts = body.get("prompt", "")
+    if isinstance(prompts, str):
+        prompts = [prompts]
+    if not prompts:
+        raise web.HTTPBadRequest(reason="prompt required")
+
+    extra_usage = "Extra-Usage" in request.headers
+    created = int(time.time())
+    cid = _completion_id("cmpl")
+
+    st.model_loader.mark_busy(cfg.name)
+    try:
+        if body.get("stream"):
+            templated = st.evaluator.evaluate_completion(cfg, prompts[0])
+            opts = _predict_options(cfg, body, templated,
+                                    request.get("correlation_id", ""))
+            return await _stream_completion(
+                request, backend, opts, cfg, cid, created, extra_usage
+            )
+
+        choices = []
+        total = Reply()
+        for i, prompt in enumerate(prompts):
+            templated = st.evaluator.evaluate_completion(cfg, prompt)
+            opts = _predict_options(cfg, body, templated,
+                                    request.get("correlation_id", ""))
+            reply = await _run_predict(backend, opts)
+            if reply.error:
+                raise web.HTTPInternalServerError(reason=reply.error)
+            text = reply.message
+            if body.get("echo"):
+                text = prompt + text
+            choices.append({
+                "index": i,
+                "text": text,
+                "finish_reason": reply.finish_reason or "stop",
+            })
+            total.prompt_tokens += reply.prompt_tokens
+            total.tokens += reply.tokens
+            total.timing_prompt_processing += reply.timing_prompt_processing
+            total.timing_token_generation += reply.timing_token_generation
+        return web.json_response({
+            "id": cid,
+            "object": "text_completion",
+            "created": created,
+            "model": cfg.name,
+            "choices": choices,
+            "usage": _usage(total, extra_usage),
+        })
+    finally:
+        st.model_loader.mark_idle(cfg.name)
+
+
+async def _stream_completion(request, backend, opts, cfg, cid, created,
+                             extra_usage) -> web.StreamResponse:
+    resp = web.StreamResponse(headers={
+        "Content-Type": "text/event-stream",
+        "Cache-Control": "no-cache",
+    })
+    await resp.prepare(request)
+    loop = asyncio.get_running_loop()
+    q: asyncio.Queue = asyncio.Queue()
+
+    def producer() -> None:
+        try:
+            for r in backend.predict_stream(opts):
+                loop.call_soon_threadsafe(q.put_nowait, r)
+        except Exception as e:
+            loop.call_soon_threadsafe(
+                q.put_nowait, Reply(error=str(e), finish_reason="error")
+            )
+        loop.call_soon_threadsafe(q.put_nowait, None)
+
+    loop.run_in_executor(None, producer)
+    final = None
+    while True:
+        r = await q.get()
+        if r is None:
+            break
+        if r.finish_reason or r.error:
+            final = r
+            continue
+        if r.message:
+            payload = {
+                "id": cid, "object": "text_completion", "created": created,
+                "model": cfg.name,
+                "choices": [{"index": 0, "text": r.message,
+                             "finish_reason": None}],
+            }
+            await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
+    payload = {
+        "id": cid, "object": "text_completion", "created": created,
+        "model": cfg.name,
+        "choices": [{"index": 0, "text": "",
+                     "finish_reason": (final.finish_reason if final
+                                       else "stop") or "stop"}],
+    }
+    if final is not None:
+        payload["usage"] = _usage(final, extra_usage)
+    await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
+    await resp.write(b"data: [DONE]\n\n")
+    await resp.write_eof()
+    return resp
+
+
+# ------------------------------------------------------------------- edit
+
+
+async def edits(request: web.Request) -> web.Response:
+    st = _state(request)
+    body = await _body(request)
+    cfg = _resolve_config(request, body, Usecase.EDIT)
+    backend = await _load_backend(request, cfg)
+
+    instruction = body.get("instruction", "")
+    inputs = body.get("input", "")
+    if isinstance(inputs, str):
+        inputs = [inputs]
+
+    choices = []
+    total = Reply()
+    for i, inp in enumerate(inputs):
+        prompt = st.evaluator.evaluate_edit(cfg, inp, instruction)
+        opts = _predict_options(cfg, body, prompt,
+                                request.get("correlation_id", ""))
+        reply = await _run_predict(backend, opts)
+        if reply.error:
+            raise web.HTTPInternalServerError(reason=reply.error)
+        choices.append({"index": i, "text": reply.message})
+        total.prompt_tokens += reply.prompt_tokens
+        total.tokens += reply.tokens
+    return web.json_response({
+        "object": "edit",
+        "created": int(time.time()),
+        "choices": choices,
+        "usage": _usage(total, "Extra-Usage" in request.headers),
+    })
+
+
+# ------------------------------------------------------------- embeddings
+
+
+async def embeddings(request: web.Request) -> web.Response:
+    st = _state(request)
+    body = await _body(request)
+    cfg = _resolve_config(request, body, Usecase.EMBEDDINGS)
+    backend = await _load_backend(request, cfg)
+
+    inputs = body.get("input", body.get("prompt", ""))
+    if isinstance(inputs, str):
+        inputs = [inputs]
+
+    loop = asyncio.get_running_loop()
+    data = []
+    for i, text in enumerate(inputs):
+        res = await loop.run_in_executor(
+            None, backend.embedding, PredictOptions(embeddings=str(text))
+        )
+        data.append({
+            "object": "embedding",
+            "index": i,
+            "embedding": res.embeddings,
+        })
+    return web.json_response({
+        "object": "list",
+        "model": cfg.name,
+        "data": data,
+        "usage": {"prompt_tokens": 0, "total_tokens": 0},
+    })
+
+
+# ------------------------------------------------------------------ misc
+
+
+async def list_models(request: web.Request) -> web.Response:
+    """ref: endpoints/openai/list.go — configs plus bare on-disk models."""
+    st = _state(request)
+    data = [
+        {"id": name, "object": "model", "owned_by": "localai_tfp_tpu"}
+        for name in st.config_loader.names()
+    ]
+    return web.json_response({"object": "list", "data": data})
+
+
+async def tokenize(request: web.Request) -> web.Response:
+    """ref: routes/localai.go:93-96 POST /v1/tokenize."""
+    body = await _body(request)
+    cfg = _resolve_config(request, body, Usecase.TOKENIZE)
+    backend = await _load_backend(request, cfg)
+    res = backend.tokenize_string(
+        PredictOptions(prompt=body.get("content", body.get("prompt", "")))
+    )
+    return web.json_response({"tokens": res.tokens})

@@ -1,0 +1,98 @@
+"""Application wiring: the four singletons + startup sequence.
+
+Ref: core/application/application.go:9-14 (Application holds
+BackendConfigLoader + ModelLoader + ApplicationConfig + templates.Evaluator)
+and startup.go:20-164 (New: mkdir, config load, watchdog start).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..config.app_config import ApplicationConfig
+from ..config.loader import ConfigLoader
+from ..engine.loader import ModelLoader, WatchDog, register_default_backends
+from ..engine.templating import Evaluator
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class MetricsStore:
+    """Prometheus-style api_call histogram data
+    (ref: core/services/metrics.go:13-46 — one histogram api_call
+    {method,path}; exposition at GET /metrics)."""
+
+    buckets: tuple[float, ...] = (
+        0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    )
+    counts: dict[tuple[str, str], list[int]] = field(default_factory=dict)
+    sums: dict[tuple[str, str], float] = field(default_factory=dict)
+    totals: dict[tuple[str, str], int] = field(default_factory=dict)
+
+    def observe(self, method: str, path: str, seconds: float) -> None:
+        key = (method, path)
+        if key not in self.counts:
+            self.counts[key] = [0] * (len(self.buckets) + 1)
+            self.sums[key] = 0.0
+            self.totals[key] = 0
+        row = self.counts[key]
+        for i, b in enumerate(self.buckets):
+            if seconds <= b:
+                row[i] += 1
+        row[-1] += 1  # +Inf
+        self.sums[key] += seconds
+        self.totals[key] += 1
+
+    def render(self) -> str:
+        lines = [
+            "# HELP api_call Api calls",
+            "# TYPE api_call histogram",
+        ]
+        for (method, path), row in sorted(self.counts.items()):
+            labels = f'method="{method}",path="{path}"'
+            for i, b in enumerate(self.buckets):
+                lines.append(
+                    f'api_call_bucket{{{labels},le="{b}"}} {row[i]}'
+                )
+            lines.append(f'api_call_bucket{{{labels},le="+Inf"}} {row[-1]}')
+            lines.append(f"api_call_sum{{{labels}}} {self.sums[(method, path)]}")
+            lines.append(f"api_call_count{{{labels}}} {self.totals[(method, path)]}")
+        return "\n".join(lines) + "\n"
+
+
+class Application:
+    """The singleton bundle handed to every route handler."""
+
+    def __init__(self, config: Optional[ApplicationConfig] = None) -> None:
+        self.config = config or ApplicationConfig.from_env()
+        self.config.ensure_dirs()
+        self.config_loader = ConfigLoader(self.config.models_path)
+        self.model_loader = ModelLoader(
+            str(self.config.models_path),
+            single_active_backend=self.config.single_active_backend,
+        )
+        self.evaluator = Evaluator(str(self.config.models_path))
+        self.metrics = MetricsStore()
+        self.started_at = time.time()
+        self.watchdog = WatchDog(
+            self.model_loader,
+            busy_timeout=self.config.watchdog_busy_timeout,
+            idle_timeout=self.config.watchdog_idle_timeout,
+            enable_busy=self.config.enable_watchdog_busy,
+            enable_idle=self.config.enable_watchdog_idle,
+        )
+
+    def startup(self) -> None:
+        register_default_backends()
+        n = self.config_loader.load_configs_from_path()
+        log.info("loaded %d model configs from %s", n,
+                 self.config.models_path)
+        self.watchdog.start()
+
+    def shutdown(self) -> None:
+        self.watchdog.stop()
+        self.model_loader.stop_all()

@@ -1,0 +1,161 @@
+"""In-memory vector store backend.
+
+Capability counterpart of the reference's local-store worker
+(ref: backend/go/stores/store.go:39-511 — columnar float32 keys + byte
+values, StoresSet :106, StoresGet :266, StoresDelete, StoresFindNormalized
+:373 with the normalized-keys fast path, topK selection :349).
+
+Design: contiguous numpy matrix of keys + parallel list of values. Cosine
+similarity is one matvec — on-device via jnp when the store is large enough
+to benefit, numpy below that threshold (host matvec beats a TPU dispatch
+for small stores).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..workers.base import Backend, ModelLoadOptions, Result
+
+_DEVICE_THRESHOLD = 50_000  # rows; above this the matvec moves to jnp
+
+
+class VectorStore:
+    def __init__(self) -> None:
+        self._keys = np.zeros((0, 0), np.float32)
+        self._norms = np.zeros((0,), np.float32)
+        self._values: list[list] = []
+        self._index: dict[bytes, int] = {}
+        self._normalized = True  # all keys unit-norm so far (ref :373)
+        self._lock = threading.RLock()
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    @staticmethod
+    def _kb(key: np.ndarray) -> bytes:
+        return np.ascontiguousarray(key, np.float32).tobytes()
+
+    def set(self, keys: np.ndarray, values: list) -> None:
+        """Upsert rows (ref: StoresSet :106 — replaces on same key)."""
+        keys = np.atleast_2d(np.asarray(keys, np.float32))
+        if len(values) != keys.shape[0]:
+            raise ValueError("keys and values length mismatch")
+        with self._lock:
+            if self._keys.size == 0 and keys.shape[0]:
+                self._keys = np.zeros((0, keys.shape[1]), np.float32)
+            if keys.shape[0] and keys.shape[1] != self._keys.shape[1]:
+                raise ValueError(
+                    f"key width {keys.shape[1]} != store width "
+                    f"{self._keys.shape[1]}"
+                )
+            new_rows = []
+            new_vals = []
+            for k, v in zip(keys, values):
+                kb = self._kb(k)
+                i = self._index.get(kb)
+                if i is not None:
+                    self._values[i] = v
+                else:
+                    self._index[kb] = len(self._values) + len(new_rows)
+                    new_rows.append(k)
+                    new_vals.append(v)
+            if new_rows:
+                block = np.stack(new_rows)
+                self._keys = np.concatenate([self._keys, block])
+                norms = np.linalg.norm(block, axis=1)
+                self._norms = np.concatenate([self._norms, norms])
+                self._values.extend(new_vals)
+                if not np.allclose(norms, 1.0, atol=1e-4):
+                    self._normalized = False
+
+    def get(self, keys: np.ndarray) -> tuple[np.ndarray, list]:
+        keys = np.atleast_2d(np.asarray(keys, np.float32))
+        out_k, out_v = [], []
+        with self._lock:
+            for k in keys:
+                i = self._index.get(self._kb(k))
+                if i is not None:
+                    out_k.append(k)
+                    out_v.append(self._values[i])
+        return (np.stack(out_k) if out_k else
+                np.zeros((0, keys.shape[1]), np.float32)), out_v
+
+    def delete(self, keys: np.ndarray) -> int:
+        keys = np.atleast_2d(np.asarray(keys, np.float32))
+        with self._lock:
+            drop = {self._index[self._kb(k)] for k in keys
+                    if self._kb(k) in self._index}
+            if not drop:
+                return 0
+            keep = [i for i in range(len(self._values)) if i not in drop]
+            self._keys = self._keys[keep]
+            self._norms = self._norms[keep]
+            self._values = [self._values[i] for i in keep]
+            self._index = {self._kb(k): i
+                           for i, k in enumerate(self._keys)}
+            return len(drop)
+
+    def find(self, key: np.ndarray, top_k: int
+             ) -> tuple[np.ndarray, list, np.ndarray]:
+        """Cosine top-K (ref: StoresFind :373 — dot product when all keys
+        normalized, full cosine otherwise)."""
+        key = np.asarray(key, np.float32).reshape(-1)
+        with self._lock:
+            if not len(self._values):
+                return np.zeros((0, key.shape[0]), np.float32), [], \
+                    np.zeros((0,), np.float32)
+            keys, norms = self._keys, self._norms
+            values = list(self._values)
+            normalized = self._normalized
+
+        if keys.shape[0] >= _DEVICE_THRESHOLD:
+            import jax.numpy as jnp
+
+            dots = np.asarray(jnp.asarray(keys) @ jnp.asarray(key))
+        else:
+            dots = keys @ key
+        if normalized:
+            sims = dots
+        else:
+            qn = np.linalg.norm(key)
+            sims = dots / np.maximum(norms * qn, 1e-12)
+        k = min(top_k, sims.shape[0])
+        top = np.argpartition(-sims, k - 1)[:k]
+        top = top[np.argsort(-sims[top])]
+        return keys[top], [values[i] for i in top], sims[top]
+
+
+class LocalStoreBackend(Backend):
+    """Worker wrapper speaking the Stores* RPC surface
+    (ref: backend.proto StoresSet/Delete/Get/Find)."""
+
+    def __init__(self) -> None:
+        self.store = VectorStore()
+
+    def load_model(self, opts: ModelLoadOptions) -> Result:
+        return Result(True, "store ready")
+
+    def health(self) -> bool:
+        return True
+
+    def stores_set(self, keys, values) -> Result:
+        self.store.set(np.asarray(keys, np.float32), list(values))
+        return Result(True)
+
+    def stores_delete(self, keys) -> Result:
+        self.store.delete(np.asarray(keys, np.float32))
+        return Result(True)
+
+    def stores_get(self, keys):
+        got_k, got_v = self.store.get(np.asarray(keys, np.float32))
+        return got_k.tolist(), got_v
+
+    def stores_find(self, key, top_k: int):
+        got_k, got_v, sims = self.store.find(
+            np.asarray(key, np.float32), top_k
+        )
+        return got_k.tolist(), got_v, sims.tolist()

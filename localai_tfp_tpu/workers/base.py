@@ -1,0 +1,234 @@
+"""Backend worker contract.
+
+Mirrors the reference's single shared gRPC contract that every backend
+implements (ref: backend/backend.proto:10-34 — 19 RPCs; Go interface
+pkg/grpc/backend.go:34-59). TPU-native difference: workers are in-process
+Python objects by default (one process owns the TPU runtime, so the
+reference's process-per-backend model becomes object-per-backend inside the
+server; the gRPC wire form is provided separately for external workers —
+ref: pkg/grpc's in-proc `Provide`/embed path is the analogue,
+backend.go:11-21, embed.go).
+
+All request/response shapes are plain dataclasses named after their proto
+counterparts so the wire layer is a thin mapping.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Optional
+
+
+# ---- request/response dataclasses (proto message counterparts) ----
+
+
+@dataclass
+class PredictOptions:
+    """ref: backend.proto PredictOptions (sampling + prompt surface)."""
+
+    prompt: str = ""
+    messages: list[dict] = field(default_factory=list)
+    tokens: int = 0  # max new tokens (proto: Tokens)
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    min_p: float = 0.0
+    typical_p: float = 1.0
+    seed: Optional[int] = None
+    repeat_penalty: float = 0.0
+    repeat_last_n: int = 64
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
+    penalty_prompt: str = ""
+    stop_prompts: list[str] = field(default_factory=list)
+    ignore_eos: bool = False
+    grammar: str = ""
+    logit_bias: dict[int, float] = field(default_factory=dict)
+    images: list[bytes] = field(default_factory=list)
+    audios: list[bytes] = field(default_factory=list)
+    videos: list[bytes] = field(default_factory=list)
+    embeddings: str = ""  # text to embed (proto: Embeddings)
+    n_keep: int = 0
+    mirostat: int = 0
+    mirostat_eta: float = 0.0
+    mirostat_tau: float = 0.0
+    prompt_cache_path: str = ""
+    prompt_cache_all: bool = False
+    correlation_id: str = ""
+    use_tokenizer_template: bool = False
+
+
+@dataclass
+class Reply:
+    """ref: backend.proto Reply (message + timing + usage)."""
+
+    message: str = ""
+    token_id: Optional[int] = None
+    tokens: int = 0  # completion tokens so far / total
+    prompt_tokens: int = 0
+    timing_prompt_processing: float = 0.0  # ms (proto:163)
+    timing_token_generation: float = 0.0  # ms (proto:164)
+    finish_reason: str = ""
+    error: str = ""
+
+
+@dataclass
+class ModelLoadOptions:
+    """ref: backend.proto ModelOptions (subset that matters on TPU; CUDA-only
+    knobs are accepted by the config layer and ignored upstream)."""
+
+    model: str = ""  # path or HF id
+    model_path: str = ""  # models dir
+    context_size: int = 4096
+    batch_slots: int = 8
+    dtype: str = "bfloat16"
+    kv_cache_dtype: str = ""
+    mesh: dict[str, int] = field(default_factory=dict)
+    threads: int = 0
+    embeddings: bool = False
+    options: list[str] = field(default_factory=list)
+    extra: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Result:
+    success: bool = True
+    message: str = ""
+
+
+@dataclass
+class EmbeddingResult:
+    embeddings: list[float] = field(default_factory=list)
+
+
+@dataclass
+class TranscriptSegment:
+    id: int = 0
+    start: float = 0.0
+    end: float = 0.0
+    text: str = ""
+    tokens: list[int] = field(default_factory=list)
+
+
+@dataclass
+class TranscriptResult:
+    segments: list[TranscriptSegment] = field(default_factory=list)
+    text: str = ""
+
+
+@dataclass
+class TokenizationResponse:
+    length: int = 0
+    tokens: list[int] = field(default_factory=list)
+
+
+@dataclass
+class StatusResponse:
+    state: str = "UNINITIALIZED"  # UNINITIALIZED|BUSY|READY|ERROR
+    memory: dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class MetricsResponse:
+    slot_id: int = 0
+    prompt_json_for_slot: str = ""
+    tokens_per_second: float = 0.0
+    tokens_generated: int = 0
+    prompt_tokens_processed: int = 0
+
+
+@dataclass
+class DocumentResult:
+    index: int = 0
+    text: str = ""
+    relevance_score: float = 0.0
+
+
+@dataclass
+class RerankResult:
+    results: list[DocumentResult] = field(default_factory=list)
+    usage: dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class VADSegment:
+    start: float = 0.0
+    end: float = 0.0
+
+
+@dataclass
+class VADResponse:
+    segments: list[VADSegment] = field(default_factory=list)
+
+
+class Backend(abc.ABC):
+    """The 19-RPC worker surface (ref: backend.proto:10-34). Concrete
+    workers override what they serve; the rest raise NotImplementedError,
+    mapped to a clean HTTP error by the server layer."""
+
+    def health(self) -> bool:
+        return True
+
+    def load_model(self, opts: ModelLoadOptions) -> Result:
+        raise NotImplementedError
+
+    def predict(self, opts: PredictOptions) -> Reply:
+        raise NotImplementedError
+
+    def predict_stream(self, opts: PredictOptions) -> Iterator[Reply]:
+        raise NotImplementedError
+
+    def embedding(self, opts: PredictOptions) -> EmbeddingResult:
+        raise NotImplementedError
+
+    def generate_image(self, **kw) -> Result:
+        raise NotImplementedError
+
+    def generate_video(self, **kw) -> Result:
+        raise NotImplementedError
+
+    def audio_transcription(self, audio_path: str, language: str = "",
+                            translate: bool = False) -> TranscriptResult:
+        raise NotImplementedError
+
+    def tts(self, text: str, voice: str = "", dst: str = "",
+            language: str = "") -> Result:
+        raise NotImplementedError
+
+    def sound_generation(self, text: str, dst: str = "", **kw) -> Result:
+        raise NotImplementedError
+
+    def tokenize_string(self, opts: PredictOptions) -> TokenizationResponse:
+        raise NotImplementedError
+
+    def status(self) -> StatusResponse:
+        return StatusResponse(state="READY")
+
+    def stores_set(self, keys, values) -> Result:
+        raise NotImplementedError
+
+    def stores_delete(self, keys) -> Result:
+        raise NotImplementedError
+
+    def stores_get(self, keys):
+        raise NotImplementedError
+
+    def stores_find(self, key, top_k: int):
+        raise NotImplementedError
+
+    def rerank(self, query: str, documents: list[str],
+               top_n: int = 0) -> RerankResult:
+        raise NotImplementedError
+
+    def get_metrics(self) -> MetricsResponse:
+        return MetricsResponse()
+
+    def vad(self, audio: list[float]) -> VADResponse:
+        raise NotImplementedError
+
+    def busy(self) -> bool:
+        return False
+
+    def shutdown(self) -> None:
+        pass

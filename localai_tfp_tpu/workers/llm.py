@@ -1,0 +1,193 @@
+"""JAX LLM worker: the TPU-native counterpart of the reference's llama.cpp
+gRPC backend (ref: backend/cpp/llama/grpc-server.cpp — LoadModel :2467,
+Predict :2542, PredictStream :2488, Embedding :2579, TokenizeString :2603,
+GetMetrics, Health :2461). One worker owns one LLMEngine over one loaded
+checkpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Iterator, Optional
+
+import jax.numpy as jnp
+
+from ..engine.engine import GenRequest, LLMEngine, StreamEvent
+from ..engine.tokenizer import Tokenizer, load_tokenizer
+from ..grammars.constrain import GrammarConstraint
+from ..models.hf_loader import load_params
+from ..models.llm_spec import LLMSpec
+from .base import (
+    Backend,
+    EmbeddingResult,
+    MetricsResponse,
+    ModelLoadOptions,
+    PredictOptions,
+    Reply,
+    Result,
+    StatusResponse,
+    TokenizationResponse,
+)
+
+_DTYPES = {
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "f32": jnp.float32,
+    "float16": jnp.bfloat16,  # fp16 is not a TPU-native dtype; use bf16
+    "f16": jnp.bfloat16,
+}
+
+
+class JaxLLMBackend(Backend):
+    """Serves chat/completion/embeddings/tokenize for HF checkpoints."""
+
+    def __init__(self) -> None:
+        self.engine: Optional[LLMEngine] = None
+        self.tokenizer: Optional[Tokenizer] = None
+        self.spec: Optional[LLMSpec] = None
+        self._state = "UNINITIALIZED"
+        self._grammar_cache: dict[str, GrammarConstraint] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def load_model(self, opts: ModelLoadOptions) -> Result:
+        with self._lock:
+            try:
+                self._state = "BUSY"
+                model_dir = opts.model
+                if not os.path.isabs(model_dir):
+                    model_dir = os.path.join(opts.model_path or "", model_dir)
+                if not os.path.isdir(model_dir):
+                    raise FileNotFoundError(
+                        f"model directory not found: {model_dir}"
+                    )
+                dtype = _DTYPES.get((opts.dtype or "bfloat16").lower(),
+                                    jnp.bfloat16)
+                self.spec, params = load_params(model_dir, dtype=dtype)
+                self.tokenizer = load_tokenizer(model_dir)
+                kv_dtype = _DTYPES.get(
+                    (opts.kv_cache_dtype or opts.dtype or "bfloat16").lower(),
+                    dtype,
+                )
+                self.engine = LLMEngine(
+                    self.spec,
+                    params,
+                    self.tokenizer,
+                    n_slots=max(1, opts.batch_slots),
+                    max_seq=opts.context_size,
+                    cache_dtype=kv_dtype,
+                )
+                self.engine.start()
+                self._state = "READY"
+                return Result(True, "model loaded")
+            except Exception as e:
+                self._state = "ERROR"
+                return Result(False, f"load failed: {e}")
+
+    def shutdown(self) -> None:
+        if self.engine is not None:
+            self.engine.close()
+            self.engine = None
+        self._state = "UNINITIALIZED"
+
+    def health(self) -> bool:
+        return self._state in ("READY", "BUSY")
+
+    def status(self) -> StatusResponse:
+        return StatusResponse(state=self._state)
+
+    def busy(self) -> bool:
+        return self.engine is not None and any(
+            s.active for s in self.engine.slots
+        )
+
+    # ------------------------------------------------------------- inference
+
+    def _to_request(self, opts: PredictOptions) -> GenRequest:
+        assert self.engine is not None and self.tokenizer is not None
+        prompt_ids = self.tokenizer.encode(opts.prompt, add_bos=True)
+        constraint = None
+        if opts.grammar:
+            constraint = self._grammar_cache.get(opts.grammar)
+            if constraint is None:
+                constraint = GrammarConstraint.from_gbnf(
+                    opts.grammar, self.tokenizer
+                )
+                if len(self._grammar_cache) < 32:
+                    self._grammar_cache[opts.grammar] = constraint
+        return GenRequest(
+            prompt_ids=prompt_ids,
+            max_tokens=opts.tokens or 2048,
+            temperature=opts.temperature,
+            top_k=opts.top_k,
+            top_p=opts.top_p,
+            min_p=opts.min_p,
+            repeat_penalty=opts.repeat_penalty,
+            repeat_last_n=opts.repeat_last_n,
+            frequency_penalty=opts.frequency_penalty,
+            presence_penalty=opts.presence_penalty,
+            seed=opts.seed,
+            stop=list(opts.stop_prompts),
+            ignore_eos=opts.ignore_eos,
+            logit_bias=opts.logit_bias or None,
+            constraint=constraint,
+            correlation_id=opts.correlation_id,
+        )
+
+    def predict(self, opts: PredictOptions) -> Reply:
+        if self.engine is None:
+            return Reply(error="model not loaded")
+        ev = self.engine.generate(self._to_request(opts))
+        return _final_reply(ev)
+
+    def predict_stream(self, opts: PredictOptions) -> Iterator[Reply]:
+        if self.engine is None:
+            yield Reply(error="model not loaded")
+            return
+        q = self.engine.submit(self._to_request(opts))
+        while True:
+            ev: StreamEvent = q.get()
+            if ev.done:
+                yield _final_reply(ev)
+                return
+            if ev.text:
+                yield Reply(message=ev.text, token_id=ev.token_id)
+
+    def tokenize_string(self, opts: PredictOptions) -> TokenizationResponse:
+        if self.tokenizer is None:
+            return TokenizationResponse()
+        ids = self.tokenizer.encode(opts.prompt)
+        return TokenizationResponse(length=len(ids), tokens=ids)
+
+    def embedding(self, opts: PredictOptions) -> EmbeddingResult:
+        if self.engine is None:
+            raise RuntimeError("model not loaded")
+        text = opts.embeddings or opts.prompt
+        vec = self.engine.embed(text)
+        return EmbeddingResult(embeddings=[float(x) for x in vec])
+
+    def get_metrics(self) -> MetricsResponse:
+        if self.engine is None:
+            return MetricsResponse()
+        m = self.engine.metrics
+        return MetricsResponse(
+            tokens_per_second=m.tokens_per_second,
+            tokens_generated=m.tokens_generated,
+            prompt_tokens_processed=m.prompt_tokens_processed,
+        )
+
+
+def _final_reply(ev: StreamEvent) -> Reply:
+    return Reply(
+        message=ev.full_text,
+        tokens=ev.completion_tokens,
+        prompt_tokens=ev.prompt_tokens,
+        timing_prompt_processing=ev.timing_prompt_processing_ms,
+        timing_token_generation=ev.timing_token_generation_ms,
+        finish_reason=ev.finish_reason,
+        error=ev.error,
+    )

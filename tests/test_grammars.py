@@ -1,0 +1,323 @@
+"""Grammar engine: GBNF parse/match, JSON-schema→GBNF, token constraints,
+function-call parsing (ref: pkg/functions/*_test.go test strategy)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from localai_tfp_tpu.config.model_config import FunctionsConfig
+from localai_tfp_tpu.engine.tokenizer import ByteTokenizer
+from localai_tfp_tpu.grammars.constrain import GrammarConstraint
+from localai_tfp_tpu.grammars.gbnf import GrammarMatcher, parse_gbnf
+from localai_tfp_tpu.grammars.json_schema import (
+    functions_grammar,
+    llama31_functions_grammar,
+    schema_to_gbnf,
+)
+from localai_tfp_tpu.grammars.parse import (
+    FuncCallResults,
+    apply_finetune,
+    parse_function_call,
+    parse_text_content,
+)
+
+
+# ---------------------------------------------------------------- GBNF core
+
+
+def _matcher(g: str) -> GrammarMatcher:
+    return GrammarMatcher(parse_gbnf(g))
+
+
+def test_gbnf_literals_and_alternates():
+    m = _matcher('root ::= "yes" | "no"')
+    assert m.matches("yes") and m.matches("no")
+    assert not m.matches("maybe") and not m.matches("ye")
+
+
+def test_gbnf_char_class_and_star():
+    m = _matcher("root ::= [a-z]+")
+    assert m.matches("abc") and not m.matches("") and not m.matches("aB")
+    m2 = _matcher('root ::= "a" [0-9]* "b"')
+    assert m2.matches("ab") and m2.matches("a123b") and not m2.matches("a12")
+
+
+def test_gbnf_nested_rules_and_recursion():
+    g = """
+root ::= expr
+expr ::= term ("+" term)*
+term ::= [0-9]+ | "(" expr ")"
+"""
+    m = _matcher(g)
+    assert m.matches("1+2+33")
+    assert m.matches("(1+(2+3))+4")
+    assert not m.matches("1+")
+    assert not m.matches("(1+2")
+
+
+def test_gbnf_negated_class_and_escape():
+    m = _matcher(r'root ::= "\"" [^"]* "\""')
+    assert m.matches('"hello"') and not m.matches('"a"b"')
+
+
+def test_gbnf_bounded_repetition():
+    m = _matcher("root ::= [0-9]{2,4}")
+    assert not m.matches("1")
+    assert m.matches("12") and m.matches("1234")
+    assert not m.matches("12345")
+
+
+def test_gbnf_optional():
+    m = _matcher('root ::= "-"? [0-9]+')
+    assert m.matches("-5") and m.matches("5")
+
+
+# ------------------------------------------------------- schema → grammar
+
+
+def _json_matcher(schema) -> GrammarMatcher:
+    return _matcher(schema_to_gbnf(schema))
+
+
+def test_schema_object_required():
+    schema = {
+        "type": "object",
+        "properties": {"a": {"type": "integer"}, "b": {"type": "string"}},
+        "required": ["a", "b"],
+    }
+    m = _json_matcher(schema)
+    assert m.matches('{"a": 1, "b": "x"}')
+    assert not m.matches('{"a": 1}')
+    assert not m.matches('{"a": "no", "b": "x"}')
+
+
+def test_schema_optional_subset():
+    schema = {
+        "type": "object",
+        "properties": {
+            "a": {"type": "integer"},
+            "b": {"type": "integer"},
+            "c": {"type": "integer"},
+        },
+        "required": ["a"],
+    }
+    m = _json_matcher(schema)
+    assert m.matches('{"a": 1}')
+    assert m.matches('{"a": 1, "b": 2}')
+    assert m.matches('{"a": 1, "c": 3}')  # skip b
+    assert m.matches('{"a": 1, "b": 2, "c": 3}')
+    assert not m.matches('{"b": 2}')
+
+
+def test_schema_enum_and_const():
+    m = _json_matcher({"enum": ["red", "green", 3]})
+    assert m.matches('"red"') and m.matches("3") and not m.matches('"blue"')
+    m2 = _json_matcher({"const": "fixed"})
+    assert m2.matches('"fixed"') and not m2.matches('"other"')
+
+
+def test_schema_array_and_nested():
+    schema = {
+        "type": "array",
+        "items": {"type": "object",
+                  "properties": {"x": {"type": "number"}},
+                  "required": ["x"]},
+    }
+    m = _json_matcher(schema)
+    assert m.matches("[]")
+    assert m.matches('[{"x": 1.5}, {"x": -2e3}]')
+    assert not m.matches('[{"y": 1}]')
+
+
+def test_schema_anyof_and_types_list():
+    m = _json_matcher({"anyOf": [{"type": "integer"}, {"type": "null"}]})
+    assert m.matches("42") and m.matches("null") and not m.matches('"s"')
+    m2 = _json_matcher({"type": ["boolean", "integer"]})
+    assert m2.matches("true") and m2.matches("7") and not m2.matches('"x"')
+
+
+def test_schema_refs():
+    schema = {
+        "$defs": {"pt": {"type": "object",
+                         "properties": {"x": {"type": "integer"}},
+                         "required": ["x"]}},
+        "type": "array",
+        "items": {"$ref": "#/$defs/pt"},
+    }
+    m = _json_matcher(schema)
+    assert m.matches('[{"x": 1}]')
+
+
+def test_unconstrained_schema_is_any_json():
+    m = _json_matcher({})
+    for doc in ('{"k": [1, null, {"n": true}]}', "[]", '"s"', "1.25"):
+        assert m.matches(doc), doc
+
+
+# ------------------------------------------------------ functions grammar
+
+
+TOOLS = [
+    {"type": "function", "function": {
+        "name": "get_weather",
+        "parameters": {"type": "object",
+                       "properties": {"city": {"type": "string"}},
+                       "required": ["city"]}}},
+    {"type": "function", "function": {
+        "name": "add",
+        "parameters": {"type": "object",
+                       "properties": {"a": {"type": "integer"},
+                                      "b": {"type": "integer"}},
+                       "required": ["a", "b"]}}},
+]
+
+
+def test_functions_grammar_single_call():
+    m = _matcher(functions_grammar(TOOLS))
+    assert m.matches('{"name": "get_weather", "arguments": {"city": "SF"}}')
+    assert m.matches('{"name": "add", "arguments": {"a": 1, "b": 2}}')
+    assert not m.matches('{"name": "nope", "arguments": {}}')
+    # wrong arguments shape for the named function
+    assert not m.matches('{"name": "add", "arguments": {"city": "SF"}}')
+
+
+def test_functions_grammar_parallel_calls():
+    m = _matcher(functions_grammar(TOOLS, parallel_calls=True))
+    assert m.matches(
+        '[{"name": "add", "arguments": {"a": 1, "b": 2}}, '
+        '{"name": "get_weather", "arguments": {"city": "X"}}]'
+    )
+
+
+def test_functions_grammar_prefix_and_mixed():
+    m = _matcher(functions_grammar(TOOLS, prefix="<tool_call>"))
+    assert m.matches(
+        '<tool_call>{"name": "add", "arguments": {"a": 1, "b": 2}}'
+    )
+    m2 = _matcher(functions_grammar(TOOLS, mixed_mode=True))
+    assert m2.matches("just plain text")
+    assert m2.matches('{"name": "add", "arguments": {"a": 1, "b": 2}}')
+
+
+def test_llama31_grammar():
+    m = _matcher(llama31_functions_grammar(TOOLS))
+    assert m.matches('<function=get_weather>{"city": "NY"}</function>')
+    assert not m.matches('<function=bogus>{}</function>')
+
+
+# -------------------------------------------------- token-level constraint
+
+
+def test_constraint_masks_and_completion():
+    tk = ByteTokenizer()
+    c = GrammarConstraint.from_gbnf('root ::= "ab" | "ac"', tk)
+    st = c.initial_state()
+    mask = c.next_mask(st)
+    assert mask[ord("a")] and not mask[ord("b")] and not mask[ord("x")]
+    assert not mask[257]  # eos not allowed before completion
+    st = c.advance(st, ord("a"))
+    mask = c.next_mask(st)
+    assert mask[ord("b")] and mask[ord("c")] and not mask[ord("a")]
+    st = c.advance(st, ord("b"))
+    mask = c.next_mask(st)
+    assert mask[257]  # grammar can end -> eos allowed
+    assert not mask[ord("a")]
+
+
+def test_constraint_json_generation_loop():
+    """Greedy-walk a schema grammar picking the first admissible byte each
+    step: the produced document must parse and conform."""
+    tk = ByteTokenizer()
+    schema = {"type": "object",
+              "properties": {"n": {"type": "integer"}},
+              "required": ["n"]}
+    c = GrammarConstraint.from_gbnf(schema_to_gbnf(schema), tk)
+    st = c.initial_state()
+    out = []
+    for _ in range(64):
+        mask = c.next_mask(st)
+        if mask[257] and len(out) > 2:
+            break
+        ids = np.nonzero(mask[:256])[0]
+        assert len(ids) > 0, "dead state"
+        tok = int(ids[0])
+        out.append(tok)
+        st = c.advance(st, tok)
+    doc = bytes(out).decode()
+    parsed = json.loads(doc)
+    assert isinstance(parsed["n"], int)
+
+
+# ------------------------------------------------------------ call parsing
+
+
+def test_parse_single_json_call():
+    out = parse_function_call(
+        '{"name": "add", "arguments": {"a": 1, "b": 2}}', FunctionsConfig()
+    )
+    assert out == [FuncCallResults("add", '{"a": 1, "b": 2}')]
+
+
+def test_parse_parallel_array():
+    out = parse_function_call(
+        '[{"name": "f1", "arguments": {}}, {"name": "f2", "arguments": {"x": 1}}]',
+        FunctionsConfig(),
+    )
+    assert [c.name for c in out] == ["f1", "f2"]
+
+
+def test_parse_embedded_json_in_text():
+    out = parse_function_call(
+        'Sure! I will call {"name": "add", "arguments": {"a": 3, "b": 4}} now.',
+        FunctionsConfig(),
+    )
+    assert out[0].name == "add"
+    assert json.loads(out[0].arguments) == {"a": 3, "b": 4}
+
+
+def test_parse_llama31_syntax():
+    out = parse_function_call(
+        '<function=get_weather>{"city": "SF"}</function>', FunctionsConfig()
+    )
+    assert out == [FuncCallResults("get_weather", '{"city": "SF"}')]
+
+
+def test_parse_custom_keys_and_string_args():
+    cfg = FunctionsConfig(function_name_key="function",
+                          function_arguments_key="params")
+    out = parse_function_call(
+        '{"function": "f", "params": {"k": "v"}}', cfg
+    )
+    assert out[0].name == "f" and json.loads(out[0].arguments) == {"k": "v"}
+
+
+def test_parse_response_regex():
+    cfg = FunctionsConfig(
+        response_regex=[r"call:(?P<name>\w+)\((?P<arguments>\{.*?\})\)"]
+    )
+    out = parse_function_call('call:add({"a": 1})', cfg)
+    assert out[0].name == "add" and out[0].arguments == '{"a": 1}'
+
+
+def test_parse_json_regex_match():
+    cfg = FunctionsConfig(json_regex_match=[r"<tool>(.*?)</tool>"])
+    out = parse_function_call(
+        '<tool>{"name": "f", "arguments": {}}</tool>', cfg
+    )
+    assert out[0].name == "f"
+
+
+def test_parse_text_content_capture():
+    cfg = FunctionsConfig(capture_llm_results=[r"(?s)^(.*?)<tool>"])
+    assert parse_text_content("thinking...<tool>x</tool>", cfg) == "thinking..."
+
+
+def test_finetune_pipeline():
+    # ref: core/backend/llm_test.go Finetune cases
+    assert apply_finetune("  hi  ", trimspace=[""]) == "hi"
+    assert apply_finetune("answer END", trimsuffix=["END"]) == "answer"
+    assert apply_finetune("a<unk>b", cutstrings=["<unk>"]) == "ab"
+    assert apply_finetune("x<r>42</r>y",
+                          extract_regex=[r"<r>\d+</r>"]) == "<r>42</r>"
+    assert apply_finetune("out", echo_prompt="in:") == "in:out"

@@ -1,0 +1,244 @@
+"""Model lifecycle: registry, load-or-reuse, watchdog, JAX LLM worker
+(ref: pkg/model/loader_test.go; watchdog.go semantics)."""
+
+import time
+
+import pytest
+
+from localai_tfp_tpu.config.model_config import ModelConfig
+from localai_tfp_tpu.engine.loader import (
+    ALIASES,
+    ModelLoader,
+    WatchDog,
+    registry,
+    register_default_backends,
+    resolve_backend,
+)
+from localai_tfp_tpu.workers.base import (
+    Backend,
+    ModelLoadOptions,
+    PredictOptions,
+    Result,
+)
+
+
+class FakeBackend(Backend):
+    instances = 0
+
+    def __init__(self):
+        FakeBackend.instances += 1
+        self.healthy = True
+        self.loaded_with = None
+        self.shut = False
+
+    def load_model(self, opts: ModelLoadOptions) -> Result:
+        self.loaded_with = opts
+        return Result(True)
+
+    def health(self):
+        return self.healthy
+
+    def shutdown(self):
+        self.shut = True
+
+
+@pytest.fixture(autouse=True)
+def fake_registry():
+    saved = dict(registry._factories)
+    registry._factories.clear()
+    registry.register("jax-llm", FakeBackend)
+    FakeBackend.instances = 0
+    yield
+    registry._factories.clear()
+    registry._factories.update(saved)
+
+
+def _cfg(name="m1", backend="") -> ModelConfig:
+    return ModelConfig.from_dict({"name": name, "backend": backend,
+                                  "parameters": {"model": "dir"}})
+
+
+def test_backend_aliasing():
+    assert resolve_backend("llama") == "jax-llm"
+    assert resolve_backend("vLLM") == "jax-llm"
+    assert resolve_backend("") == "jax-llm"
+    assert resolve_backend("piper") == "jax-tts"
+    assert resolve_backend("custom-thing") == "custom-thing"
+    assert "llama-cpp" in ALIASES
+
+
+def test_load_or_reuse():
+    ml = ModelLoader()
+    b1 = ml.load(_cfg())
+    b2 = ml.load(_cfg())
+    assert b1 is b2
+    assert FakeBackend.instances == 1
+
+
+def test_unhealthy_backend_rebuilt():
+    ml = ModelLoader()
+    b1 = ml.load(_cfg())
+    b1.healthy = False
+    b2 = ml.load(_cfg())
+    assert b2 is not b1
+    assert b1.shut  # old one shut down
+    assert FakeBackend.instances == 2
+
+
+def test_load_failure_raises():
+    class Failing(FakeBackend):
+        def load_model(self, opts):
+            return Result(False, "nope")
+
+    registry.register("bad", Failing)
+    ml = ModelLoader()
+    with pytest.raises(RuntimeError, match="nope"):
+        ml.load(_cfg(backend="bad"))
+    assert ml.loaded_names() == []
+
+
+def test_single_active_backend_evicts():
+    ml = ModelLoader(single_active_backend=True)
+    b1 = ml.load(_cfg("a"))
+    ml.load(_cfg("b"))
+    assert ml.loaded_names() == ["b"]
+    assert b1.shut
+
+
+def test_unknown_backend_lists_known():
+    ml = ModelLoader()
+    with pytest.raises(KeyError, match="jax-llm"):
+        ml.load(_cfg(backend="never-registered"))
+
+
+def test_watchdog_busy_kill():
+    ml = ModelLoader()
+    ml.load(_cfg("a"))
+    ml.mark_busy("a")
+    wd = WatchDog(ml, busy_timeout=10, enable_busy=True)
+    assert wd.check(time.monotonic() + 5) == []
+    assert wd.check(time.monotonic() + 11) == ["a"]
+    assert ml.loaded_names() == []
+
+
+def test_watchdog_idle_kill():
+    ml = ModelLoader()
+    ml.load(_cfg("a"))
+    ml.mark_idle("a")
+    wd = WatchDog(ml, idle_timeout=100, enable_idle=True)
+    assert wd.check(time.monotonic() + 50) == []
+    assert wd.check(time.monotonic() + 101) == ["a"]
+
+
+def test_watchdog_busy_not_idle_killed():
+    ml = ModelLoader()
+    ml.load(_cfg("a"))
+    ml.mark_busy("a")
+    wd = WatchDog(ml, idle_timeout=10, enable_idle=True)
+    assert wd.check(time.monotonic() + 1000) == []  # busy, not idle
+
+
+def test_stop_all():
+    ml = ModelLoader()
+    ml.load(_cfg("a"))
+    ml.load(_cfg("b"))
+    ml.stop_all()
+    assert ml.loaded_names() == []
+
+
+# ------------------------------------------------ real JAX worker end-to-end
+
+
+@pytest.fixture(scope="module")
+def tiny_ckpt(tmp_path_factory):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256,
+    ))
+    d = tmp_path_factory.mktemp("ckpt") / "tiny"
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+def test_jax_llm_worker_end_to_end(tiny_ckpt):
+    from localai_tfp_tpu.workers.llm import JaxLLMBackend
+
+    be = JaxLLMBackend()
+    res = be.load_model(ModelLoadOptions(
+        model=tiny_ckpt, context_size=128, batch_slots=2, dtype="float32",
+    ))
+    assert res.success, res.message
+    assert be.health()
+    assert be.status().state == "READY"
+
+    tok = be.tokenize_string(PredictOptions(prompt="abc"))
+    assert tok.length == 3
+
+    out = be.predict(PredictOptions(prompt="hi", tokens=4, ignore_eos=True))
+    assert out.error == ""
+    assert out.tokens == 4
+    assert out.prompt_tokens >= 2
+    assert out.timing_token_generation > 0
+
+    chunks = list(be.predict_stream(
+        PredictOptions(prompt="hi", tokens=4, ignore_eos=True)
+    ))
+    assert chunks[-1].finish_reason == "length"
+    streamed = "".join(c.message for c in chunks[:-1])
+    assert streamed == chunks[-1].message
+
+    emb = be.embedding(PredictOptions(embeddings="some text"))
+    assert len(emb.embeddings) == 64
+
+    m = be.get_metrics()
+    assert m.tokens_generated >= 8
+
+    be.shutdown()
+    assert not be.health()
+
+
+def test_jax_llm_worker_grammar_constrained(tmp_path):
+    # vocab must cover the ByteTokenizer fallback's eos id (257) so the
+    # grammar can terminate generation by admitting eos
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(1)
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=300, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256,
+    )).save_pretrained(tmp_path / "g", safe_serialization=True)
+
+    from localai_tfp_tpu.workers.llm import JaxLLMBackend
+
+    be = JaxLLMBackend()
+    assert be.load_model(ModelLoadOptions(
+        model=str(tmp_path / "g"), context_size=128, batch_slots=2,
+        dtype="float32",
+    )).success
+    out = be.predict(PredictOptions(
+        prompt="x", tokens=10, grammar='root ::= "yes" | "no"',
+    ))
+    assert out.message in ("yes", "no")
+    be.shutdown()
+
+
+def test_jax_llm_worker_missing_model_dir():
+    from localai_tfp_tpu.workers.llm import JaxLLMBackend
+
+    be = JaxLLMBackend()
+    res = be.load_model(ModelLoadOptions(model="/nonexistent/dir"))
+    assert not res.success and "not found" in res.message
+    assert be.status().state == "ERROR"
+
+
+def test_register_default_backends_idempotent():
+    register_default_backends()
+    assert "jax-llm" in registry.known()
+    register_default_backends()

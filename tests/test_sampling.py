@@ -1,0 +1,158 @@
+"""Sampling op semantics (ref surface: core/schema/prediction.go sampling
+params; llama.cpp per-slot sampling in grpc-server.cpp update_slots)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tfp_tpu.ops.sampling import (
+    SamplingState,
+    observe_tokens,
+    sample,
+)
+
+V = 32
+
+
+def _state(n_slots=4, **kw):
+    return SamplingState.create(n_slots, V, window=16, **kw)
+
+
+def _logits(rows):
+    return jnp.asarray(np.array(rows, dtype=np.float32))
+
+
+def test_greedy_picks_argmax():
+    st = _state()
+    row = np.zeros(V, np.float32)
+    row[7] = 5.0
+    tok, _ = sample(st, jnp.array([0]), _logits([row]))
+    assert int(tok[0]) == 7
+
+
+def test_temperature_sampling_valid_and_seeded():
+    st = _state()
+    st = st.reset_slot(1, temperature=1.0, seed=42)
+    row = np.full(V, -10.0, np.float32)
+    row[3] = 4.0
+    row[9] = 4.0
+    toks = set()
+    for _ in range(20):
+        tok, st = sample(st, jnp.array([1]), _logits([row]))
+        toks.add(int(tok[0]))
+    assert toks <= {3, 9} and len(toks) == 2  # both modes reachable
+
+
+def test_seed_reproducible():
+    outs = []
+    for _ in range(2):
+        st = _state().reset_slot(0, temperature=1.0, top_k=0, seed=123)
+        seq = []
+        for _ in range(8):
+            tok, st = sample(st, jnp.array([0]),
+                             _logits([np.zeros(V, np.float32)]))
+            seq.append(int(tok[0]))
+        outs.append(seq)
+    assert outs[0] == outs[1]
+
+
+def test_top_k_restricts_support():
+    st = _state().reset_slot(0, temperature=1.0, top_k=2, seed=0)
+    row = np.arange(V, dtype=np.float32)  # top-2 = {V-1, V-2}
+    for _ in range(15):
+        tok, st = sample(st, jnp.array([0]), _logits([row]))
+        assert int(tok[0]) in (V - 1, V - 2)
+
+
+def test_top_p_keeps_minimal_nucleus():
+    st = _state().reset_slot(0, temperature=1.0, top_p=0.5, seed=0)
+    row = np.full(V, -20.0, np.float32)
+    row[4] = 10.0  # ~all the mass
+    row[5] = 2.0
+    for _ in range(10):
+        tok, st = sample(st, jnp.array([0]), _logits([row]))
+        assert int(tok[0]) == 4
+
+
+def test_min_p_filters_low_prob():
+    st = _state().reset_slot(0, temperature=1.0, min_p=0.5, seed=0)
+    row = np.zeros(V, np.float32)
+    row[2] = 6.0
+    row[3] = 5.9  # within 0.5x of max prob
+    for _ in range(15):
+        tok, st = sample(st, jnp.array([0]), _logits([row]))
+        assert int(tok[0]) in (2, 3)
+
+
+def test_repeat_penalty_flips_choice():
+    st = _state().reset_slot(0, repeat_penalty=2.0)
+    row = np.zeros(V, np.float32)
+    row[5] = 2.0
+    row[6] = 1.5
+    # greedy without history -> 5
+    tok, st = sample(st, jnp.array([0]), _logits([row]))
+    assert int(tok[0]) == 5
+    # 5 is now in the window: 2.0/2.0 = 1.0 < 1.5 -> 6
+    tok, st = sample(st, jnp.array([0]), _logits([row]))
+    assert int(tok[0]) == 6
+
+
+def test_presence_and_frequency_penalty():
+    st = _state().reset_slot(0, freq_penalty=1.0, presence_penalty=1.0)
+    st = observe_tokens(st, jnp.array([0]), jnp.array([5]),
+                        jnp.array([True]))
+    st = observe_tokens(st, jnp.array([0]), jnp.array([5]),
+                        jnp.array([True]))
+    row = np.zeros(V, np.float32)
+    row[5] = 2.5  # 2.5 - 2*1.0(freq) - 1.0(presence) = -0.5 < 0
+    tok, _ = sample(st, jnp.array([0]), _logits([row]))
+    assert int(tok[0]) != 5
+
+
+def test_penalty_window_eviction():
+    st = _state().reset_slot(0, repeat_penalty=10.0, repeat_last_n=2)
+    ids = jnp.array([0])
+    t = jnp.array([True])
+    # push token 5, then two other tokens -> 5 evicted from window of 2
+    for tokv in (5, 1, 2):
+        st = observe_tokens(st, ids, jnp.array([tokv]), t)
+    counts = np.asarray(st.token_counts[0])
+    assert counts[5] == 0 and counts[1] == 1 and counts[2] == 1
+
+
+def test_mask_constrains_sampling():
+    st = _state()  # greedy
+    row = np.zeros(V, np.float32)
+    row[3] = 9.0
+    mask = np.zeros(V, bool)
+    mask[10] = True
+    tok, _ = sample(st, jnp.array([0]), _logits([row]),
+                    mask=jnp.asarray(mask)[None])
+    assert int(tok[0]) == 10
+
+
+def test_slots_are_independent():
+    st = _state()
+    st = st.reset_slot(0, temperature=0.0)
+    st = st.reset_slot(1, temperature=1.0, top_k=1, seed=7)
+    rows = np.zeros((2, V), np.float32)
+    rows[0, 4] = 3.0
+    rows[1, 8] = 3.0
+    tok, st = sample(st, jnp.array([0, 1]), _logits(rows))
+    assert int(tok[0]) == 4 and int(tok[1]) == 8
+    # penalty counts landed in the right slots
+    c = np.asarray(st.token_counts)
+    assert c[0, 4] == 1 and c[1, 8] == 1 and c[0, 8] == 0
+
+
+def test_sample_is_jittable():
+    st = _state()
+
+    @jax.jit
+    def step(state, ids, logits):
+        return sample(state, ids, logits)
+
+    row = np.zeros((1, V), np.float32)
+    row[0, 11] = 1.0
+    tok, st2 = step(st, jnp.array([0]), jnp.asarray(row))
+    assert int(tok[0]) == 11

@@ -1,0 +1,273 @@
+"""HTTP API integration tests: boot the whole Application + aiohttp app
+in-process against a tiny real checkpoint (the reference's app_test.go
+strategy scaled down — SURVEY.md §4 API integration tier).
+
+No async pytest plugin in the image, so a sync facade drives one event
+loop per module.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from localai_tfp_tpu.config.app_config import ApplicationConfig
+from localai_tfp_tpu.server.app import build_app
+from localai_tfp_tpu.server.state import Application
+
+
+class Resp:
+    def __init__(self, status, headers, body: bytes):
+        self.status = status
+        self.headers = headers
+        self.body = body
+
+    @property
+    def text(self) -> str:
+        return self.body.decode()
+
+    @property
+    def json(self):
+        return json.loads(self.body)
+
+
+class SyncClient:
+    def __init__(self, loop: asyncio.AbstractEventLoop, client: TestClient):
+        self._loop = loop
+        self._client = client
+
+    def _do(self, method: str, path: str, **kw) -> Resp:
+        async def go():
+            r = await self._client.request(method, path, **kw)
+            body = await r.read()
+            return Resp(r.status, r.headers, body)
+
+        return self._loop.run_until_complete(go())
+
+    def get(self, path: str, **kw) -> Resp:
+        return self._do("GET", path, **kw)
+
+    def post(self, path: str, **kw) -> Resp:
+        return self._do("POST", path, **kw)
+
+
+@pytest.fixture(scope="module")
+def workdir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("srv")
+    models = root / "models"
+    models.mkdir()
+
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=300, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256,
+    )).save_pretrained(models / "tiny-ckpt", safe_serialization=True)
+
+    (models / "tiny.yaml").write_text("""
+name: tiny
+backend: jax-llm
+parameters:
+  model: tiny-ckpt
+  temperature: 0.0
+  max_tokens: 8
+context_size: 128
+max_batch_slots: 2
+dtype: float32
+template:
+  completion: "{{.Input}}"
+  chat_message: "{{.RoleName}}: {{.Content}}"
+  chat: "{{.Input}}\\nassistant:"
+""")
+    return root
+
+
+@pytest.fixture(scope="module")
+def client(workdir):
+    loop = asyncio.new_event_loop()
+    cfg = ApplicationConfig(
+        models_path=str(workdir / "models"),
+        generated_content_dir=str(workdir / "generated"),
+        upload_dir=str(workdir / "uploads"),
+        config_dir=str(workdir / "configuration"),
+    )
+    state = Application(cfg)
+    app = build_app(state)
+    tc = TestClient(TestServer(app), loop=loop)
+    loop.run_until_complete(tc.start_server())
+    yield SyncClient(loop, tc)
+    loop.run_until_complete(tc.close())
+    loop.close()
+
+
+def test_healthz_and_version(client):
+    for path in ("/healthz", "/readyz"):
+        assert client.get(path).status == 200
+    assert client.get("/version").json["version"]
+
+
+def test_models_list(client):
+    r = client.get("/v1/models")
+    assert [m["id"] for m in r.json["data"]] == ["tiny"]
+    assert client.get("/models").status == 200  # bare-prefix registration
+
+
+def test_completion_non_stream(client):
+    r = client.post("/v1/completions", json={
+        "model": "tiny", "prompt": "abc", "max_tokens": 4,
+        "ignore_eos": True,
+    })
+    assert r.status == 200, r.text
+    data = r.json
+    assert data["object"] == "text_completion"
+    assert data["choices"][0]["finish_reason"] == "length"
+    assert data["usage"]["completion_tokens"] == 4
+    assert data["model"] == "tiny"
+
+
+def test_completion_default_model(client):
+    r = client.post("/v1/completions", json={
+        "prompt": "abc", "max_tokens": 2, "ignore_eos": True,
+    })
+    assert r.status == 200  # first COMPLETION-capable config used
+
+
+def test_chat_non_stream_with_usage_timings(client):
+    r = client.post("/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 4, "ignore_eos": True,
+    }, headers={"Extra-Usage": "1"})
+    assert r.status == 200, r.text
+    data = r.json
+    assert data["object"] == "chat.completion"
+    msg = data["choices"][0]["message"]
+    assert msg["role"] == "assistant"
+    assert "content" in msg
+    assert data["usage"]["timing_token_generation"] > 0
+    assert r.headers.get("X-Correlation-ID")
+
+
+def test_chat_streaming_sse(client):
+    r = client.post("/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 5, "ignore_eos": True, "stream": True,
+    })
+    assert r.status == 200
+    assert r.headers["Content-Type"].startswith("text/event-stream")
+    events = [line[6:] for line in r.text.splitlines()
+              if line.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    assert chunks[-1]["choices"][0]["finish_reason"] in ("length", "stop")
+    assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+
+
+def test_completion_streaming(client):
+    r = client.post("/v1/completions", json={
+        "model": "tiny", "prompt": "xy", "max_tokens": 3,
+        "ignore_eos": True, "stream": True,
+    })
+    events = [line[6:] for line in r.text.splitlines()
+              if line.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    final = json.loads(events[-2])
+    assert final["choices"][0]["finish_reason"] == "length"
+
+
+def test_edits(client):
+    r = client.post("/v1/edits", json={
+        "model": "tiny", "instruction": "fix", "input": "txt",
+        "max_tokens": 2, "ignore_eos": True,
+    })
+    assert r.status == 200
+    assert r.json["object"] == "edit" and len(r.json["choices"]) == 1
+
+
+def test_embeddings(client):
+    r = client.post("/v1/embeddings", json={
+        "model": "tiny", "input": ["one", "two"],
+    })
+    assert r.status == 200
+    data = r.json
+    assert len(data["data"]) == 2
+    assert len(data["data"][0]["embedding"]) == 64
+    assert data["data"][1]["index"] == 1
+
+
+def test_tokenize(client):
+    r = client.post("/v1/tokenize", json={"model": "tiny", "content": "abc"})
+    assert r.status == 200
+    assert len(r.json["tokens"]) >= 1
+
+
+def test_unknown_model_404(client):
+    r = client.post("/v1/completions", json={"model": "missing",
+                                             "prompt": "x"})
+    assert r.status == 404
+
+
+def test_bad_json_400(client):
+    r = client.post("/v1/chat/completions", data=b"not json",
+                    headers={"Content-Type": "application/json"})
+    assert r.status == 400
+
+
+def test_metrics_exposition(client):
+    client.get("/healthz")
+    r = client.get("/metrics")
+    assert "api_call_bucket" in r.text
+    assert 'path="/healthz"' in r.text
+
+
+def test_system_endpoint(client):
+    data = client.get("/system").json
+    assert "jax-llm" in data["backends"]
+    assert "tiny" in data["loaded_models"]
+
+
+def test_stores_roundtrip(client):
+    r = client.post("/stores/set", json={
+        "keys": [[1.0, 0.0], [0.0, 1.0], [0.7, 0.7]],
+        "values": ["a", "b", "c"],
+    })
+    assert r.status == 200, r.text
+    r = client.post("/stores/get", json={"keys": [[1.0, 0.0]]})
+    assert r.json["values"] == ["a"]
+    r = client.post("/stores/find", json={"key": [1.0, 0.1], "topk": 2})
+    data = r.json
+    assert data["values"][0] == "a"
+    assert len(data["keys"]) == 2
+    assert data["similarities"][0] >= data["similarities"][1]
+    r = client.post("/stores/delete", json={"keys": [[1.0, 0.0]]})
+    assert r.status == 200
+    assert client.post("/stores/get",
+                       json={"keys": [[1.0, 0.0]]}).json["values"] == []
+
+
+def test_grammar_constrained_chat(client):
+    r = client.post("/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "choose"}],
+        "grammar": 'root ::= "yes" | "no"',
+        "max_tokens": 8,
+    })
+    assert r.status == 200
+    content = r.json["choices"][0]["message"]["content"]
+    assert content in ("yes", "no")
+
+
+def test_backend_monitor_and_shutdown(client):
+    # runs last in file order after other tests have loaded 'tiny'
+    r = client.get("/backend/monitor?model=tiny")
+    assert r.status == 200
+    assert r.json["status"] == "READY"
+    r = client.post("/backend/shutdown", json={"model": "tiny"})
+    assert r.status == 200
+    assert client.get("/backend/monitor?model=tiny").status == 404

@@ -1,0 +1,85 @@
+"""Vector store semantics (ref: tests/integration/stores_test.go —
+normalized and unnormalized cosine paths, upsert, delete, topK)."""
+
+import numpy as np
+import pytest
+
+from localai_tfp_tpu.store.backend import LocalStoreBackend, VectorStore
+
+
+def test_set_get_delete_roundtrip():
+    s = VectorStore()
+    keys = np.eye(3, dtype=np.float32)
+    s.set(keys, ["a", "b", "c"])
+    assert len(s) == 3
+    got_k, got_v = s.get(keys[1:2])
+    assert got_v == ["b"]
+    assert np.allclose(got_k, keys[1:2])
+    assert s.delete(keys[0:1]) == 1
+    assert len(s) == 2
+    _, got_v = s.get(keys[0:1])
+    assert got_v == []
+
+
+def test_upsert_replaces_value():
+    s = VectorStore()
+    k = np.array([[1.0, 0.0]], np.float32)
+    s.set(k, ["old"])
+    s.set(k, ["new"])
+    assert len(s) == 1
+    assert s.get(k)[1] == ["new"]
+
+
+def test_find_normalized_fast_path():
+    s = VectorStore()
+    keys = np.array([[1, 0], [0, 1],
+                     [0.70710678, 0.70710678]], np.float32)
+    s.set(keys, ["x", "y", "xy"])
+    assert s._normalized
+    got_k, got_v, sims = s.find(np.array([1, 0.1], np.float32), 2)
+    assert got_v[0] == "x"
+    assert len(got_v) == 2
+    assert sims[0] >= sims[1]
+
+
+def test_find_unnormalized_cosine():
+    s = VectorStore()
+    keys = np.array([[10, 0], [0, 2]], np.float32)  # not unit norm
+    s.set(keys, ["big-x", "small-y"])
+    assert not s._normalized
+    # cosine must ignore magnitude: query along y picks small-y
+    _, got_v, sims = s.find(np.array([0, 1], np.float32), 1)
+    assert got_v == ["small-y"]
+    assert sims[0] == pytest.approx(1.0, abs=1e-5)
+
+
+def test_topk_clamps_to_size():
+    s = VectorStore()
+    s.set(np.eye(2, dtype=np.float32), ["a", "b"])
+    _, got_v, _ = s.find(np.array([1, 0], np.float32), 10)
+    assert len(got_v) == 2
+
+
+def test_find_empty_store():
+    s = VectorStore()
+    got_k, got_v, sims = s.find(np.array([1.0], np.float32), 5)
+    assert got_v == [] and len(sims) == 0
+
+
+def test_dim_mismatch_rejected():
+    s = VectorStore()
+    s.set(np.eye(2, dtype=np.float32), ["a", "b"])
+    with pytest.raises(ValueError, match="width"):
+        s.set(np.eye(3, dtype=np.float32), ["c", "d", "e"])
+
+
+def test_backend_wrapper():
+    be = LocalStoreBackend()
+    assert be.load_model(None).success
+    be.stores_set([[1.0, 0.0]], ["v"])
+    keys, values, sims = be.stores_find([1.0, 0.0], 1)
+    assert values == ["v"] and sims[0] == pytest.approx(1.0)
+    keys, values = be.stores_get([[1.0, 0.0]])
+    assert values == ["v"]
+    be.stores_delete([[1.0, 0.0]])
+    assert be.stores_get([[1.0, 0.0]])[1] == []
