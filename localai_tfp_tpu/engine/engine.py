@@ -485,7 +485,6 @@ class LLMEngine:
             s.n_past += 1
             s.t_decode_ms += dt_ms
             self._emit_token(s, int(toks_host[s.idx]))
-        self.metrics.tokens_generated += len(decoding)
         if now > t0:
             self.metrics.tokens_per_second = len(decoding) / (now - t0)
         self.metrics.slots_busy = sum(1 for s in self.slots if s.active)
@@ -502,6 +501,7 @@ class LLMEngine:
                 slot.constraint_state, token_id
             )
         slot.generated.append(token_id)
+        self.metrics.tokens_generated += 1
 
         if (not req.ignore_eos) and token_id in self.tokenizer.eos_ids:
             self._finish(slot, "stop")

@@ -156,7 +156,7 @@ class _Parser:
             sym = self._symbol(rulename)
             self._ws(newlines=False)
             c = self._peek()
-            if c in "*+?{":
+            if c and c in "*+?{":
                 sym = self._apply_repeat(rulename, sym, c)
             seq.append(sym)
         return tuple(seq)
