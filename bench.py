@@ -1,0 +1,88 @@
+"""End-of-round benchmark: streaming decode throughput of the serving
+engine (the metric behind BASELINE.md's ≥2000 tok/s/chip north star).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Runs the real continuous-batching engine (engine/engine.py) — scheduler,
+sampler, detokenizer and all — not a bare forward loop, so the number is
+the honest serving throughput a /v1/chat/completions client would see.
+Model weights are random-init (zero egress); throughput does not depend on
+weight values. On TPU a llama-3.2-1B-class config is used; on CPU (smoke
+runs) a tiny config.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_TOK_S = 2000.0  # BASELINE.md: ≥2000 tok/s/chip on v5e
+
+
+def main() -> None:
+    import jax
+
+    from localai_tfp_tpu.engine.engine import GenRequest, LLMEngine
+    from localai_tfp_tpu.engine.tokenizer import ByteTokenizer
+    from localai_tfp_tpu.models.llm_spec import LLMSpec, tiny_spec
+    from localai_tfp_tpu.models.transformer import init_params
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        spec = LLMSpec(
+            vocab_size=32000, d_model=2048, n_layers=16, n_heads=32,
+            n_kv_heads=8, d_head=64, d_ff=8192, max_position=4096,
+        )
+        n_slots, max_seq, gen_tokens = 8, 2048, 256
+    else:
+        spec = tiny_spec(vocab_size=258)
+        n_slots, max_seq, gen_tokens = 4, 256, 32
+
+    params = init_params(jax.random.PRNGKey(0), spec)
+    tok = ByteTokenizer()
+    eng = LLMEngine(
+        spec, params, tok, n_slots=n_slots, max_seq=max_seq,
+        autostart=False,
+    )
+    eng.start()
+
+    def run(n_req: int, n_tok: int) -> tuple[int, float]:
+        prompt = tok.encode("benchmark " * 12)
+        qs = [
+            eng.submit(GenRequest(
+                prompt_ids=prompt + [i % 200],
+                max_tokens=n_tok,
+                temperature=0.8,
+                top_k=40,
+                top_p=0.95,
+                ignore_eos=True,
+            ))
+            for i in range(n_req)
+        ]
+        t0 = time.perf_counter()
+        total = 0
+        for q in qs:
+            while True:
+                ev = q.get()
+                if ev.done:
+                    total += ev.completion_tokens
+                    break
+        return total, time.perf_counter() - t0
+
+    run(n_slots, 8)  # warmup: populate the jit cache
+    t0 = time.perf_counter()
+    total, _ = run(n_slots, gen_tokens)
+    dt = time.perf_counter() - t0
+    eng.close()
+
+    tok_s = total / dt
+    print(json.dumps({
+        "metric": "decode_throughput",
+        "value": round(tok_s, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_s / BASELINE_TOK_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

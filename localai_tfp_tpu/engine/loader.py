@@ -98,18 +98,28 @@ def register_default_backends() -> None:
     from ..workers.llm import JaxLLMBackend
 
     registry.register("jax-llm", JaxLLMBackend)
-    # additional workers (embeddings/rerank/whisper/diffusion/tts/vad/store)
-    # register themselves here as they land
-    try:
-        from ..workers.embeddings import JaxEmbeddingsBackend
+    from ..store.backend import LocalStoreBackend
+    from ..workers.embeddings import JaxEmbeddingsBackend
+    from ..workers.rerank import JaxRerankBackend
+    from ..workers.tts import JaxTTSBackend
+    from ..workers.vad import JaxVADBackend
 
-        registry.register("jax-embeddings", JaxEmbeddingsBackend)
+    registry.register("local-store", LocalStoreBackend)
+    registry.register("jax-embeddings", JaxEmbeddingsBackend)
+    registry.register("jax-rerank", JaxRerankBackend)
+    registry.register("jax-tts", JaxTTSBackend)
+    registry.register("jax-vad", JaxVADBackend)
+    # jax-whisper / jax-diffusion register as they land
+    try:
+        from ..workers.whisper import JaxWhisperBackend
+
+        registry.register("jax-whisper", JaxWhisperBackend)
     except ImportError:
         pass
     try:
-        from ..store.backend import LocalStoreBackend
+        from ..workers.diffusion import JaxDiffusionBackend
 
-        registry.register("local-store", LocalStoreBackend)
+        registry.register("jax-diffusion", JaxDiffusionBackend)
     except ImportError:
         pass
 

@@ -53,6 +53,14 @@ class ByteTokenizer:
     def decode(self, ids: list[int]) -> str:
         return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
 
+    def encode_special(self, text: str) -> list[int]:
+        """Counterpart of encoder-model encoding WITH special tokens."""
+        return [self.bos_id] + self.encode(text) + [next(iter(self.eos_ids))]
+
+    def encode_pair(self, a: str, b: str) -> list[int]:
+        sep = next(iter(self.eos_ids))
+        return [self.bos_id] + self.encode(a) + [sep] + self.encode(b) + [sep]
+
 
 class HFTokenizer:
     """HuggingFace fast tokenizer from a local checkpoint directory."""
@@ -98,6 +106,16 @@ class HFTokenizer:
 
     def decode(self, ids: list[int]) -> str:
         return self._tk.decode(ids, skip_special_tokens=False)
+
+    def encode_special(self, text: str) -> list[int]:
+        """Encode WITH special tokens ([CLS] ... [SEP] for BERT-family) —
+        required by encoder models whose pooling/classification expects
+        them (sentence-transformers / cross-encoder semantics)."""
+        return self._tk.encode(text, add_special_tokens=True)
+
+    def encode_pair(self, a: str, b: str) -> list[int]:
+        """[CLS] a [SEP] b [SEP] — the cross-encoder input convention."""
+        return self._tk.encode(a, b, add_special_tokens=True)
 
     def apply_chat_template(self, messages: list[dict], *,
                             add_generation_prompt: bool = True,

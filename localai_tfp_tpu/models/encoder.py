@@ -1,0 +1,258 @@
+"""TPU-native bidirectional text encoder (BERT/MiniLM family).
+
+Capability counterpart of the reference's sentence-transformers embedding
+path (ref: backend/python/transformers/backend.py:286-324 — mean-pool or
+SentenceTransformer encode) and the rerankers backend (ref:
+backend/python/rerankers/backend.py — cross-encoder relevance scores).
+
+Same TPU-first design as the decoder (models/transformer.py): layers are
+stacked on a leading axis and run under ``lax.scan``; shapes are static per
+(batch, length) bucket; bf16 matmuls with f32 accumulation.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+EncParams = dict[str, jax.Array]
+
+
+@dataclass(frozen=True, eq=False)  # identity hash => usable as jit static
+class EncoderSpec:
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    d_ff: int
+    max_position: int = 512
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+    n_classes: int = 0  # >0: cross-encoder classification head
+
+    @property
+    def d_head(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def spec_from_hf_config(cfg: dict[str, Any]) -> EncoderSpec:
+    return EncoderSpec(
+        vocab_size=cfg.get("vocab_size", 30522),
+        d_model=cfg.get("hidden_size", 384),
+        n_layers=cfg.get("num_hidden_layers", 6),
+        n_heads=cfg.get("num_attention_heads", 12),
+        d_ff=cfg.get("intermediate_size", 1536),
+        max_position=cfg.get("max_position_embeddings", 512),
+        type_vocab_size=cfg.get("type_vocab_size", 2),
+        norm_eps=float(cfg.get("layer_norm_eps", 1e-12)),
+    )
+
+
+def tiny_encoder_spec(**over: Any) -> EncoderSpec:
+    kw: dict[str, Any] = dict(
+        vocab_size=256, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        max_position=128,
+    )
+    kw.update(over)
+    return EncoderSpec(**kw)
+
+
+def init_encoder_params(
+    rng: jax.Array, spec: EncoderSpec, dtype: Any = jnp.float32
+) -> EncParams:
+    keys = iter(jax.random.split(rng, 24))
+
+    def dense(key, shape, scale=0.02):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+    L, D, F = spec.n_layers, spec.d_model, spec.d_ff
+    p: EncParams = {
+        "word_emb": dense(next(keys), (spec.vocab_size, D)),
+        "pos_emb": dense(next(keys), (spec.max_position, D)),
+        "type_emb": dense(next(keys), (spec.type_vocab_size, D)),
+        "emb_ln_w": jnp.ones((D,), dtype),
+        "emb_ln_b": jnp.zeros((D,), dtype),
+        "wq": dense(next(keys), (L, D, D)),
+        "bq": jnp.zeros((L, D), dtype),
+        "wk": dense(next(keys), (L, D, D)),
+        "bk": jnp.zeros((L, D), dtype),
+        "wv": dense(next(keys), (L, D, D)),
+        "bv": jnp.zeros((L, D), dtype),
+        "wo": dense(next(keys), (L, D, D)),
+        "bo": jnp.zeros((L, D), dtype),
+        "attn_ln_w": jnp.ones((L, D), dtype),
+        "attn_ln_b": jnp.zeros((L, D), dtype),
+        "w_up": dense(next(keys), (L, D, F)),
+        "b_up": jnp.zeros((L, F), dtype),
+        "w_down": dense(next(keys), (L, F, D)),
+        "b_down": jnp.zeros((L, D), dtype),
+        "out_ln_w": jnp.ones((L, D), dtype),
+        "out_ln_b": jnp.zeros((L, D), dtype),
+    }
+    if spec.n_classes:
+        p["pool_w"] = dense(next(keys), (D, D))
+        p["pool_b"] = jnp.zeros((D,), dtype)
+        p["cls_w"] = dense(next(keys), (D, spec.n_classes))
+        p["cls_b"] = jnp.zeros((spec.n_classes,), dtype)
+    return p
+
+
+def _ln(x: jax.Array, w: jax.Array, b: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mu) * lax.rsqrt(var + eps)
+    return (out * w.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def encode(
+    spec: EncoderSpec,
+    params: EncParams,
+    tokens: jax.Array,  # [B, T] int32
+    attn_mask: jax.Array,  # [B, T] 1 = real token
+) -> jax.Array:
+    """Full-stack bidirectional encode; returns hidden states [B, T, D]."""
+    B, T = tokens.shape
+    H, Dh = spec.n_heads, spec.d_head
+    x = (
+        params["word_emb"][tokens]
+        + params["pos_emb"][jnp.arange(T)][None, :, :]
+        + params["type_emb"][0][None, None, :]
+    )
+    x = _ln(x, params["emb_ln_w"], params["emb_ln_b"], spec.norm_eps)
+
+    bias = jnp.where(attn_mask[:, None, None, :].astype(bool), 0.0, -1e30)
+    prec = (
+        lax.Precision.HIGHEST if x.dtype == jnp.float32
+        else lax.Precision.DEFAULT
+    )
+    layer_keys = [k for k in params if params[k].ndim >= 1 and k.islower()
+                  and k not in ("word_emb", "pos_emb", "type_emb", "emb_ln_w",
+                                "emb_ln_b", "pool_w", "pool_b", "cls_w",
+                                "cls_b")]
+    stacked = {k: params[k] for k in layer_keys}
+
+    def body(x, lp):
+        q = (x @ lp["wq"] + lp["bq"]).reshape(B, T, H, Dh)
+        k = (x @ lp["wk"] + lp["bk"]).reshape(B, T, H, Dh)
+        v = (x @ lp["wv"] + lp["bv"]).reshape(B, T, H, Dh)
+        logits = jnp.einsum("bthd,bshd->bhts", q, k,
+                            preferred_element_type=jnp.float32,
+                            precision=prec) / math.sqrt(Dh)
+        probs = jax.nn.softmax(logits + bias, axis=-1)
+        ctx = jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v,
+                         preferred_element_type=jnp.float32, precision=prec)
+        ctx = ctx.reshape(B, T, H * Dh).astype(x.dtype)
+        x = _ln(x + (ctx @ lp["wo"] + lp["bo"]), lp["attn_ln_w"],
+                lp["attn_ln_b"], spec.norm_eps)
+        h = jax.nn.gelu(x @ lp["w_up"] + lp["b_up"], approximate=False)
+        x = _ln(x + (h @ lp["w_down"] + lp["b_down"]), lp["out_ln_w"],
+                lp["out_ln_b"], spec.norm_eps)
+        return x, None
+
+    x, _ = lax.scan(body, x, stacked)
+    return x
+
+
+def mean_pool(hidden: jax.Array, attn_mask: jax.Array,
+              normalize: bool = True) -> jax.Array:
+    """Masked mean over tokens (the sentence-transformers convention —
+    ref: transformers backend mean-pool, backend.py:286-324)."""
+    m = attn_mask[..., None].astype(jnp.float32)
+    s = jnp.sum(hidden.astype(jnp.float32) * m, axis=1)
+    emb = s / jnp.maximum(jnp.sum(m, axis=1), 1e-9)
+    if normalize:
+        emb = emb / jnp.maximum(
+            jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-12
+        )
+    return emb
+
+
+def classify(spec: EncoderSpec, params: EncParams, hidden: jax.Array
+             ) -> jax.Array:
+    """Cross-encoder head: tanh-pool over [CLS] then linear -> [B, C]
+    (the rerankers scoring path)."""
+    cls = hidden[:, 0, :]
+    if "pool_w" in params:
+        cls = jnp.tanh(cls @ params["pool_w"] + params["pool_b"])
+    return (cls @ params["cls_w"] + params["cls_b"]).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# HF checkpoint loading (BERT naming)
+# ---------------------------------------------------------------------------
+
+
+def load_encoder_params(
+    model_dir: str, dtype: Any = jnp.float32
+) -> tuple[EncoderSpec, EncParams]:
+    from .hf_loader import load_hf_state
+
+    config, get, names = load_hf_state(model_dir)
+    spec = spec_from_hf_config(config)
+    prefix = ""
+    for cand in ("bert.", "roberta.", ""):
+        if f"{cand}embeddings.word_embeddings.weight" in names:
+            prefix = cand
+            break
+    L = spec.n_layers
+
+    def cast(a: np.ndarray) -> jnp.ndarray:
+        return jnp.asarray(a).astype(dtype)
+
+    def t(name: str) -> np.ndarray:
+        return np.ascontiguousarray(get(name).T)
+
+    def stack(fn: Callable[[int], np.ndarray]) -> jnp.ndarray:
+        return cast(np.stack([fn(i) for i in range(L)]))
+
+    e = f"{prefix}embeddings."
+    lp = f"{prefix}encoder.layer." + "{i}."
+    p: EncParams = {
+        "word_emb": cast(get(e + "word_embeddings.weight")),
+        "pos_emb": cast(get(e + "position_embeddings.weight")),
+        "type_emb": cast(get(e + "token_type_embeddings.weight")),
+        "emb_ln_w": cast(get(e + "LayerNorm.weight")),
+        "emb_ln_b": cast(get(e + "LayerNorm.bias")),
+        "wq": stack(lambda i: t(lp.format(i=i) + "attention.self.query.weight")),
+        "bq": stack(lambda i: get(lp.format(i=i) + "attention.self.query.bias")),
+        "wk": stack(lambda i: t(lp.format(i=i) + "attention.self.key.weight")),
+        "bk": stack(lambda i: get(lp.format(i=i) + "attention.self.key.bias")),
+        "wv": stack(lambda i: t(lp.format(i=i) + "attention.self.value.weight")),
+        "bv": stack(lambda i: get(lp.format(i=i) + "attention.self.value.bias")),
+        "wo": stack(lambda i: t(lp.format(i=i) + "attention.output.dense.weight")),
+        "bo": stack(lambda i: get(lp.format(i=i) + "attention.output.dense.bias")),
+        "attn_ln_w": stack(
+            lambda i: get(lp.format(i=i) + "attention.output.LayerNorm.weight")),
+        "attn_ln_b": stack(
+            lambda i: get(lp.format(i=i) + "attention.output.LayerNorm.bias")),
+        "w_up": stack(lambda i: t(lp.format(i=i) + "intermediate.dense.weight")),
+        "b_up": stack(lambda i: get(lp.format(i=i) + "intermediate.dense.bias")),
+        "w_down": stack(lambda i: t(lp.format(i=i) + "output.dense.weight")),
+        "b_down": stack(lambda i: get(lp.format(i=i) + "output.dense.bias")),
+        "out_ln_w": stack(lambda i: get(lp.format(i=i) + "output.LayerNorm.weight")),
+        "out_ln_b": stack(lambda i: get(lp.format(i=i) + "output.LayerNorm.bias")),
+    }
+    n_classes = 0
+    if "classifier.weight" in names:  # cross-encoder checkpoint
+        if f"{prefix}pooler.dense.weight" in names:
+            p["pool_w"] = cast(t(f"{prefix}pooler.dense.weight"))
+            p["pool_b"] = cast(get(f"{prefix}pooler.dense.bias"))
+        p["cls_w"] = cast(t("classifier.weight"))
+        p["cls_b"] = cast(get("classifier.bias"))
+        n_classes = p["cls_w"].shape[-1]
+    if n_classes:
+        spec = EncoderSpec(
+            vocab_size=spec.vocab_size, d_model=spec.d_model,
+            n_layers=spec.n_layers, n_heads=spec.n_heads, d_ff=spec.d_ff,
+            max_position=spec.max_position,
+            type_vocab_size=spec.type_vocab_size, norm_eps=spec.norm_eps,
+            n_classes=n_classes,
+        )
+    return spec, p

@@ -284,6 +284,71 @@ def _act(spec: LLMSpec, x: jax.Array) -> jax.Array:
 # forward
 # ---------------------------------------------------------------------------
 
+_NON_LAYER_KEYS = ("embed", "final_norm_w", "final_norm_b", "lm_head",
+                   "lm_head_b")
+
+
+def _layer_body(spec, x, lp, positions, inv_freq, rope_scale, kv_fn):
+    """One transformer layer, shared by the serving (KV-cached) and training
+    (cache-free) paths. ``kv_fn(k, v) -> (k_eff, v_eff, carry)`` decides
+    where K/V come from: the cache rows after a scatter-write (serving) or
+    the current sequence (training)."""
+    B, T = x.shape[0], x.shape[1]
+    h = _norm(spec, x, lp["ln1_w"], lp.get("ln1_b"))
+    q = h @ lp["wq"]
+    k = h @ lp["wk"]
+    v = h @ lp["wv"]
+    if "bq" in lp:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(B, T, spec.n_heads, spec.d_head)
+    k = k.reshape(B, T, spec.n_kv_heads, spec.d_head)
+    v = v.reshape(B, T, spec.n_kv_heads, spec.d_head)
+    q = apply_rope(q, positions, inv_freq, spec.rotary_dim, rope_scale)
+    k = apply_rope(k, positions, inv_freq, spec.rotary_dim, rope_scale)
+    k_eff, v_eff, carry = kv_fn(k, v)
+    attn = _attend(spec, q, k_eff, v_eff, positions)
+    attn = attn @ lp["wo"]
+    if "bo" in lp:
+        attn = attn + lp["bo"]
+    mlp_in = h if spec.parallel_residual else None
+    if not spec.parallel_residual:
+        x = x + attn
+        mlp_in = _norm(spec, x, lp["ln2_w"], lp.get("ln2_b"))
+    up = mlp_in @ lp["w_up"]
+    if "b_up" in lp:
+        up = up + lp["b_up"]
+    if spec.gated_mlp:
+        up = _act(spec, mlp_in @ lp["w_gate"]) * up
+    else:
+        up = _act(spec, up)
+    mlp = up @ lp["w_down"]
+    if "b_down" in lp:
+        mlp = mlp + lp["b_down"]
+    out = (x + attn + mlp) if spec.parallel_residual else (x + mlp)
+    return out, carry
+
+
+def _embed_in(spec, params, tokens):
+    x = params["embed"][tokens]
+    if spec.embedding_multiplier != 1.0:
+        x = (x.astype(jnp.float32) * spec.embedding_multiplier).astype(x.dtype)
+    return x
+
+
+def _lm_head(spec, params, x):
+    head = params["embed"].T if spec.tie_word_embeddings else params["lm_head"]
+    prec = (
+        lax.Precision.HIGHEST if x.dtype == jnp.float32
+        else lax.Precision.DEFAULT
+    )
+    logits = jnp.einsum("btd,dv->btv", x, head,
+                        preferred_element_type=jnp.float32, precision=prec)
+    if "lm_head_b" in params:
+        logits = logits + params["lm_head_b"].astype(jnp.float32)
+    if spec.logit_softcap:
+        logits = jnp.tanh(logits / spec.logit_softcap) * spec.logit_softcap
+    return logits
+
 
 def forward_hidden(
     spec: LLMSpec,
@@ -302,67 +367,34 @@ def forward_hidden(
     full slot batch. Writes the new K/V into ``cache`` at rows ``slot_ids``
     columns ``pos0 + [0..T)``.
     """
-    B, T = tokens.shape
-    x = params["embed"][tokens]  # gather: [B, T, D]
-    if spec.embedding_multiplier != 1.0:
-        x = (x.astype(jnp.float32) * spec.embedding_multiplier).astype(x.dtype)
-
-    positions = pos0[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    x = _embed_in(spec, params, tokens)  # gather: [B, T, D]
+    positions = pos0[:, None] + jnp.arange(
+        tokens.shape[1], dtype=jnp.int32)[None, :]
     inv_freq = rope_inv_freq(spec)
     rope_scale = rope_attn_scale(spec)
-    layer_keys = [k for k in params if params[k].ndim >= 1 and k not in (
-        "embed", "final_norm_w", "final_norm_b", "lm_head", "lm_head_b")]
-    stacked = {k: params[k] for k in layer_keys}
+    stacked = {k: params[k] for k in params if k not in _NON_LAYER_KEYS}
 
     def body(x, scanned):
         lp, ck, cv = scanned  # layer params; cache slices [n_slots, S, Hkv, Dh]
-        h = _norm(spec, x, lp["ln1_w"], lp.get("ln1_b"))
-        q = h @ lp["wq"]
-        k = h @ lp["wk"]
-        v = h @ lp["wv"]
-        if "bq" in lp:
-            q = q + lp["bq"]
-            k = k + lp["bk"]
-            v = v + lp["bv"]
-        q = q.reshape(B, T, spec.n_heads, spec.d_head)
-        k = k.reshape(B, T, spec.n_kv_heads, spec.d_head)
-        v = v.reshape(B, T, spec.n_kv_heads, spec.d_head)
-        rd = spec.rotary_dim
-        q = apply_rope(q, positions, inv_freq, rd, rope_scale)
-        k = apply_rope(k, positions, inv_freq, rd, rope_scale)
 
-        # scatter new kv into the slot rows at their offsets
-        def write(cbuf, new):
-            def one(buf_row, new_row, off):
-                return lax.dynamic_update_slice(
-                    buf_row, new_row.astype(buf_row.dtype), (off, 0, 0)
-                )
-            rows = jax.vmap(one)(cbuf[slot_ids], new, pos0)
-            return cbuf.at[slot_ids].set(rows)
+        def kv_from_cache(k, v):
+            # scatter new kv into the slot rows at their offsets
+            def write(cbuf, new):
+                def one(buf_row, new_row, off):
+                    return lax.dynamic_update_slice(
+                        buf_row, new_row.astype(buf_row.dtype), (off, 0, 0)
+                    )
+                rows = jax.vmap(one)(cbuf[slot_ids], new, pos0)
+                return cbuf.at[slot_ids].set(rows)
 
-        ck = write(ck, k)
-        cv = write(cv, v)
-        attn = _attend(spec, q, ck[slot_ids], cv[slot_ids], positions)
-        attn = attn @ lp["wo"]
-        if "bo" in lp:
-            attn = attn + lp["bo"]
+            ck2 = write(ck, k)
+            cv2 = write(cv, v)
+            return ck2[slot_ids], cv2[slot_ids], (ck2, cv2)
 
-        mlp_in = h if spec.parallel_residual else None
-        if not spec.parallel_residual:
-            x = x + attn
-            mlp_in = _norm(spec, x, lp["ln2_w"], lp.get("ln2_b"))
-        up = mlp_in @ lp["w_up"]
-        if "b_up" in lp:
-            up = up + lp["b_up"]
-        if spec.gated_mlp:
-            up = _act(spec, mlp_in @ lp["w_gate"]) * up
-        else:
-            up = _act(spec, up)
-        mlp = up @ lp["w_down"]
-        if "b_down" in lp:
-            mlp = mlp + lp["b_down"]
-        x = (x + attn + mlp) if spec.parallel_residual else (x + mlp)
-        return x, (ck, cv)
+        x, (ck2, cv2) = _layer_body(
+            spec, x, lp, positions, inv_freq, rope_scale, kv_from_cache
+        )
+        return x, (ck2, cv2)
 
     x, (new_k, new_v) = lax.scan(body, x, (stacked, cache.k, cache.v))
 
@@ -381,23 +413,46 @@ def forward(
 ) -> tuple[jax.Array, KVCache]:
     """forward_hidden + LM head; returns (logits [B, T, V] f32, cache)."""
     x, cache = forward_hidden(spec, params, tokens, pos0, cache, slot_ids)
-    head = (
-        params["embed"].T if spec.tie_word_embeddings else params["lm_head"]
-    )
-    prec = (
-        lax.Precision.HIGHEST if x.dtype == jnp.float32 else lax.Precision.DEFAULT
-    )
-    logits = jnp.einsum(
-        "btd,dv->btv", x, head,
-        preferred_element_type=jnp.float32, precision=prec,
-    )
-    if "lm_head_b" in params:
-        logits = logits + params["lm_head_b"].astype(jnp.float32)
-    if spec.logit_softcap:
-        logits = jnp.tanh(logits / spec.logit_softcap) * spec.logit_softcap
-    return logits, cache
+    return _lm_head(spec, params, x), cache
 
 
 @partial(jax.jit, static_argnums=(0,), donate_argnums=(4,))
 def forward_jit(spec, params, tokens, pos0, cache, slot_ids):
     return forward(spec, params, tokens, pos0, cache, slot_ids)
+
+
+# ---------------------------------------------------------------------------
+# training forward (no KV cache)
+# ---------------------------------------------------------------------------
+
+
+def forward_train(
+    spec: LLMSpec, params: Params, tokens: jax.Array
+) -> jax.Array:
+    """Cache-free causal forward for training/fine-tuning; returns logits
+    [B, T, V] f32. Same stacked-scan body as the serving path, but K/V come
+    from the current sequence only and each layer is rematerialized
+    (``jax.checkpoint``) so activation memory stays O(sqrt(L)) — the TPU way
+    to trade FLOPs for HBM.
+    """
+    B, T = tokens.shape
+    x = _embed_in(spec, params, tokens)
+    positions = jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[None, :], (B, T)
+    )
+    inv_freq = rope_inv_freq(spec)
+    rope_scale = rope_attn_scale(spec)
+    stacked = {k: params[k] for k in params if k not in _NON_LAYER_KEYS}
+
+    @jax.checkpoint
+    def body(x, lp):
+        x, _ = _layer_body(
+            spec, x, lp, positions, inv_freq, rope_scale,
+            lambda k, v: (k, v, None),
+        )
+        return x, None
+
+    x, _ = lax.scan(body, x, stacked)
+    if spec.final_norm:
+        x = _norm(spec, x, params["final_norm_w"], params.get("final_norm_b"))
+    return _lm_head(spec, params, x)

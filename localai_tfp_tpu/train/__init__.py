@@ -1,0 +1,1 @@
+from .step import TrainState, make_train_step, train_shardings  # noqa: F401

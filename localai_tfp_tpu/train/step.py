@@ -1,0 +1,144 @@
+"""Sharded fine-tuning step for the stacked-scan transformer.
+
+The reference exposes no weight training (its `Finetune` is text
+post-processing — core/backend/llm.go:192-240); a TPU-native framework gets
+real fine-tuning nearly for free because the serving forward is already a
+pure function. This module provides the canonical SPMD training step:
+
+- loss: next-token cross-entropy with a padding mask, computed in f32.
+- grad + optax update under one ``jax.jit``; params/optimizer state are
+  sharded with the SAME PartitionSpecs as serving (parallel/sharding.py):
+  TP over "model", DP over "data" on the batch, SP over "seq" on the
+  sequence dimension. XLA/GSPMD inserts the psum/reduce-scatter collectives
+  over ICI — there is no hand-written NCCL analogue (SURVEY.md §2.5).
+- activation remat comes from ``forward_train``'s per-layer
+  ``jax.checkpoint``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llm_spec import LLMSpec
+from ..models.transformer import Params, forward_train, init_params
+from ..parallel.sharding import _divisible_spec, param_specs
+
+
+@dataclass
+class TrainState:
+    params: Params
+    opt_state: Any
+    step: jax.Array
+
+
+jax.tree_util.register_pytree_node(
+    TrainState,
+    lambda s: ((s.params, s.opt_state, s.step), None),
+    lambda _, c: TrainState(params=c[0], opt_state=c[1], step=c[2]),
+)
+
+
+def loss_fn(
+    spec: LLMSpec, params: Params, tokens: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """Mean next-token CE over positions where mask[:, 1:] is set."""
+    logits = forward_train(spec, params, tokens)  # [B, T, V] f32
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1, :], axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    m = mask[:, 1:].astype(jnp.float32)
+    return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+def train_shardings(
+    params: Params, mesh: Mesh
+) -> tuple[dict[str, NamedSharding], NamedSharding, NamedSharding]:
+    """(param shardings, token sharding, scalar sharding) for the mesh."""
+    specs = param_specs(params)
+    pshard = {
+        name: NamedSharding(
+            mesh, _divisible_spec(params[name].shape, specs[name], mesh)
+        )
+        for name in params
+    }
+    tok = NamedSharding(mesh, P("data", "seq"))
+    scalar = NamedSharding(mesh, P())
+    return pshard, tok, scalar
+
+
+def make_train_step(
+    spec: LLMSpec,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    mesh: Optional[Mesh] = None,
+) -> tuple[Callable[..., TrainState], Callable[..., tuple[TrainState, jax.Array]]]:
+    """Returns (init_fn(rng) -> TrainState, step_fn(state, tokens, mask) ->
+    (state, loss)). When ``mesh`` is given, both are jitted with explicit
+    NamedShardings so the state lives sharded on the mesh from step 0.
+    """
+    tx = optimizer or optax.adamw(1e-5, weight_decay=0.0)
+
+    def _init(rng: jax.Array) -> TrainState:
+        params = init_params(rng, spec)
+        return TrainState(
+            params=params,
+            opt_state=tx.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    def _step(
+        state: TrainState, tokens: jax.Array, mask: jax.Array
+    ) -> tuple[TrainState, jax.Array]:
+        if mesh is not None:
+            tokens = jax.lax.with_sharding_constraint(
+                tokens, NamedSharding(mesh, P("data", "seq"))
+            )
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(spec, p, tokens, mask)
+        )(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    if mesh is None:
+        return jax.jit(_init), jax.jit(_step)
+
+    # Shard the state from birth: params per serving rules; optimizer moments
+    # follow their parameter (optax state is a pytree whose array leaves are
+    # parameter-shaped), scalars replicated.
+    probe = jax.eval_shape(_init, jax.ShapeDtypeStruct((2,), jnp.uint32))
+    pshard, tok, scalar = train_shardings(
+        {k: v for k, v in probe.params.items()}, mesh
+    )
+
+    def _state_sharding(tree):
+        # optax states embed parameter-shaped sub-trees keyed by the same
+        # names as params (adam mu/nu etc.); anything else is replicated.
+        def leaf(path, x):
+            for entry in reversed(path):
+                key = getattr(entry, "key", None)
+                if key in pshard and getattr(x, "shape", None) == \
+                        probe.params[key].shape:
+                    return pshard[key]
+            return scalar
+
+        return jax.tree_util.tree_map_with_path(leaf, tree)
+
+    state_sh = TrainState(
+        params=pshard,
+        opt_state=_state_sharding(probe.opt_state),
+        step=scalar,
+    )
+    init_jit = jax.jit(_init, out_shardings=state_sh)
+    step_jit = jax.jit(
+        _step,
+        in_shardings=(state_sh, tok, tok),
+        out_shardings=(state_sh, scalar),
+        donate_argnums=(0,),
+    )
+    return init_jit, step_jit

@@ -1,0 +1,108 @@
+"""Embeddings worker (ref: the reference serves /v1/embeddings via
+sentence-transformers / mean-pooled causal LMs — backend/python/
+transformers/backend.py:286-324; routed from core/backend/embeddings.go).
+
+Loads a local checkpoint directory:
+- encoder checkpoints (bert/minilm family) -> models/encoder.py, masked
+  mean-pool + L2 normalize (sentence-transformers semantics);
+- anything else is served by the LLM worker's hidden-state path (the
+  loader aliases decoder-embedding configs there).
+
+Batched, bucketed encode: requests are padded to the next length bucket so
+the jit cache stays tiny.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.tokenizer import Tokenizer, load_tokenizer
+from ..models.encoder import (
+    EncoderSpec, EncParams, encode, load_encoder_params, mean_pool,
+)
+from .base import (
+    Backend, EmbeddingResult, ModelLoadOptions, PredictOptions, Result,
+    StatusResponse,
+)
+
+LEN_BUCKETS = (16, 64, 128, 256, 512)
+
+
+class JaxEmbeddingsBackend(Backend):
+    def __init__(self) -> None:
+        self.spec: Optional[EncoderSpec] = None
+        self.params: Optional[EncParams] = None
+        self.tokenizer: Optional[Tokenizer] = None
+        self._state = "UNINITIALIZED"
+        self._lock = threading.Lock()
+
+    def load_model(self, opts: ModelLoadOptions) -> Result:
+        with self._lock:
+            try:
+                model_dir = opts.model
+                if not os.path.isabs(model_dir):
+                    model_dir = os.path.join(opts.model_path or "", model_dir)
+                if not os.path.isdir(model_dir):
+                    raise FileNotFoundError(
+                        f"model directory not found: {model_dir}")
+                self.spec, self.params = load_encoder_params(model_dir)
+                self.tokenizer = load_tokenizer(model_dir)
+
+                @partial(jax.jit, static_argnums=())
+                def _encode(params, tokens, mask):
+                    hidden = encode(self.spec, params, tokens, mask)
+                    return mean_pool(hidden, mask)
+
+                self._encode = _encode
+                self._state = "READY"
+                return Result(True, "embeddings model loaded")
+            except Exception as e:
+                self._state = "ERROR"
+                return Result(False, f"load failed: {e}")
+
+    def health(self) -> bool:
+        return self._state == "READY"
+
+    def status(self) -> StatusResponse:
+        return StatusResponse(state=self._state)
+
+    def shutdown(self) -> None:
+        self.spec = self.params = self.tokenizer = None
+        self._state = "UNINITIALIZED"
+
+    # ------------------------------------------------------------- encoding
+
+    def _bucket(self, n: int) -> int:
+        cap = self.spec.max_position
+        for b in LEN_BUCKETS:
+            if n <= b <= cap:
+                return b
+        return cap
+
+    def embed_batch(self, texts: list[str]) -> np.ndarray:
+        assert self.spec and self.params is not None and self.tokenizer
+        ids = [self.tokenizer.encode_special(t)[: self.spec.max_position]
+               or [0] for t in texts]
+        T = self._bucket(max(len(x) for x in ids))
+        B = len(ids)
+        toks = np.zeros((B, T), np.int32)
+        mask = np.zeros((B, T), np.int32)
+        for r, x in enumerate(ids):
+            x = x[:T]
+            toks[r, : len(x)] = x
+            mask[r, : len(x)] = 1
+        out = self._encode(self.params, jnp.asarray(toks), jnp.asarray(mask))
+        return np.asarray(out, dtype=np.float32)
+
+    def embedding(self, opts: PredictOptions) -> EmbeddingResult:
+        if self._state != "READY":
+            raise RuntimeError("model not loaded")
+        vec = self.embed_batch([opts.embeddings or opts.prompt])[0]
+        return EmbeddingResult(embeddings=[float(x) for x in vec])

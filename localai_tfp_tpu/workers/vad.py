@@ -1,0 +1,116 @@
+"""Voice-activity detection worker (ref: the reference runs silero's ONNX
+VAD via onnxruntime — backend/go/vad/silero/, served at POST /vad,
+core/http/endpoints/localai/vad.go).
+
+TPU-native re-design: a windowed energy + spectral-flatness detector
+computed as one batched jitted JAX program (frames × FFT ride the VPU/MXU),
+with hysteresis and hangover smoothing on the host. This is a classical
+DSP detector, not a learned one — the capability contract (float PCM in,
+speech segments out, same JSON shape) is identical.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import (
+    Backend, ModelLoadOptions, Result, StatusResponse, VADResponse,
+    VADSegment,
+)
+
+SAMPLE_RATE = 16000
+FRAME = 512  # 32 ms
+HOP = 160  # 10 ms
+
+
+@jax.jit
+def _frame_features(audio: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[n] f32 -> (rms energy [F], spectral flatness [F]) per frame."""
+    n_frames = (audio.shape[0] - FRAME) // HOP + 1
+    idx = jnp.arange(n_frames)[:, None] * HOP + jnp.arange(FRAME)[None, :]
+    frames = audio[idx]  # [F, FRAME]
+    window = jnp.hanning(FRAME)
+    rms = jnp.sqrt(jnp.mean(jnp.square(frames), axis=-1) + 1e-12)
+    spec = jnp.abs(jnp.fft.rfft(frames * window, axis=-1)) + 1e-10
+    # speech is spectrally peaky (low flatness); noise is flat (~1)
+    flat = jnp.exp(jnp.mean(jnp.log(spec), axis=-1)) / jnp.mean(spec, axis=-1)
+    return rms, flat
+
+
+class JaxVADBackend(Backend):
+    def __init__(self) -> None:
+        self._state = "UNINITIALIZED"
+        self.threshold = 2.5  # over noise floor
+        self.min_speech_s = 0.25
+        self.min_silence_s = 0.25
+
+    def load_model(self, opts: ModelLoadOptions) -> Result:
+        for kv in opts.options:
+            k, _, v = kv.partition("=")
+            if k == "threshold":
+                self.threshold = float(v)
+            elif k == "min_speech_s":
+                self.min_speech_s = float(v)
+            elif k == "min_silence_s":
+                self.min_silence_s = float(v)
+        self._state = "READY"
+        return Result(True, "vad ready")
+
+    def health(self) -> bool:
+        return self._state == "READY"
+
+    def status(self) -> StatusResponse:
+        return StatusResponse(state=self._state)
+
+    def vad(self, audio: list[float]) -> VADResponse:
+        pcm = np.asarray(audio, np.float32)
+        if pcm.shape[0] < FRAME:
+            return VADResponse()
+        # pad to a power-of-two bucket so the jitted FFT program compiles
+        # once per bucket, not once per input length
+        n_valid = (pcm.shape[0] - FRAME) // HOP + 1
+        bucket = 1 << (pcm.shape[0] - 1).bit_length()
+        padded = np.zeros(bucket, np.float32)
+        padded[: pcm.shape[0]] = pcm
+        rms, flat = _frame_features(jnp.asarray(padded))
+        rms = np.asarray(rms)[:n_valid]
+        flat = np.asarray(flat)[:n_valid]
+        # adaptive noise floor: the quietest quarter of frames
+        floor = max(float(np.percentile(rms, 25)), 1e-6)
+        speech = (rms > floor * self.threshold) & (flat < 0.5)
+        segs = _smooth(speech, self.min_speech_s, self.min_silence_s)
+        return VADResponse(segments=[
+            VADSegment(start=round(s * HOP / SAMPLE_RATE, 3),
+                       end=round((e * HOP + FRAME) / SAMPLE_RATE, 3))
+            for s, e in segs
+        ])
+
+
+def _smooth(speech: np.ndarray, min_speech_s: float,
+            min_silence_s: float) -> list[tuple[int, int]]:
+    """Merge gaps < min_silence, drop islands < min_speech (the hangover
+    logic every practical VAD needs)."""
+    frames_per_s = SAMPLE_RATE / HOP
+    min_speech = int(min_speech_s * frames_per_s)
+    min_silence = int(min_silence_s * frames_per_s)
+    segs: list[tuple[int, int]] = []
+    start: Optional[int] = None
+    for i, on in enumerate(speech):
+        if on and start is None:
+            start = i
+        elif not on and start is not None:
+            segs.append((start, i - 1))
+            start = None
+    if start is not None:
+        segs.append((start, len(speech) - 1))
+    merged: list[tuple[int, int]] = []
+    for s, e in segs:
+        if merged and s - merged[-1][1] <= min_silence:
+            merged[-1] = (merged[-1][0], e)
+        else:
+            merged.append((s, e))
+    return [(s, e) for s, e in merged if e - s + 1 >= min_speech]

@@ -23,6 +23,15 @@ import pytest  # noqa: E402
 # backend's default matmul precision (oneDNN on CPU does exactly that).
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# A TPU plugin may be registered ahead of CPU (e.g. the axon platform in
+# the dev image) and would otherwise claim every un-annotated computation.
+# Tests are hermetic: pin the default device to CPU so the suite runs on
+# the virtual 8-device CPU mesh regardless of what hardware is attached.
+try:
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+except RuntimeError:
+    pass
+
 
 @pytest.fixture(scope="session")
 def cpu_devices():

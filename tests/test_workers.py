@@ -1,0 +1,166 @@
+"""Breadth-worker tests: encoder numerics parity vs HF torch, embeddings /
+rerank / VAD / TTS backends (SURVEY.md §2.4 backend coverage tier)."""
+
+import os
+import wave
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from localai_tfp_tpu.models.encoder import (
+    encode, init_encoder_params, load_encoder_params, mean_pool,
+    tiny_encoder_spec,
+)
+from localai_tfp_tpu.workers.base import ModelLoadOptions, PredictOptions
+from localai_tfp_tpu.workers.embeddings import JaxEmbeddingsBackend
+from localai_tfp_tpu.workers.rerank import JaxRerankBackend
+from localai_tfp_tpu.workers.tts import JaxTTSBackend
+from localai_tfp_tpu.workers.vad import FRAME, SAMPLE_RATE, JaxVADBackend
+
+
+@pytest.fixture(scope="module")
+def bert_dir(tmp_path_factory):
+    """Tiny random BertModel checkpoint (encoder naming, no prefix)."""
+    import torch
+    from transformers import BertConfig, BertModel
+
+    torch.manual_seed(0)
+    d = tmp_path_factory.mktemp("bert")
+    BertModel(BertConfig(
+        vocab_size=300, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=128,
+    )).save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def cross_dir(tmp_path_factory):
+    """Tiny cross-encoder (bert. prefix + classifier head)."""
+    import torch
+    from transformers import BertConfig, BertForSequenceClassification
+
+    torch.manual_seed(1)
+    d = tmp_path_factory.mktemp("cross")
+    BertForSequenceClassification(BertConfig(
+        vocab_size=300, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=128, num_labels=1,
+    )).save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+def test_encoder_matches_torch_bert(bert_dir):
+    import torch
+    from transformers import BertModel
+
+    spec, params = load_encoder_params(bert_dir)
+    ids = np.array([[5, 9, 42, 7, 0, 0], [17, 3, 0, 0, 0, 0]], np.int32)
+    mask = np.array([[1, 1, 1, 1, 0, 0], [1, 1, 0, 0, 0, 0]], np.int32)
+    ours = np.asarray(
+        encode(spec, params, jnp.asarray(ids), jnp.asarray(mask))
+    )
+    ref = BertModel.from_pretrained(bert_dir).eval()
+    with torch.no_grad():
+        theirs = ref(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+        ).last_hidden_state.numpy()
+    # only compare unmasked positions (masked ones see different garbage)
+    m = mask.astype(bool)
+    np.testing.assert_allclose(ours[m], theirs[m], rtol=2e-3, atol=2e-3)
+
+
+def test_mean_pool_normalized():
+    spec = tiny_encoder_spec()
+    params = init_encoder_params(jax.random.PRNGKey(0), spec)
+    ids = jnp.asarray(np.ones((2, 8), np.int32))
+    mask = jnp.asarray(np.ones((2, 8), np.int32))
+    emb = mean_pool(encode(spec, params, ids, mask), mask)
+    norms = np.linalg.norm(np.asarray(emb), axis=-1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-5)
+
+
+def test_embeddings_backend(bert_dir):
+    b = JaxEmbeddingsBackend()
+    res = b.load_model(ModelLoadOptions(model=bert_dir))
+    assert res.success, res.message
+    out = b.embedding(PredictOptions(embeddings="hello world"))
+    assert len(out.embeddings) == 32
+    # deterministic
+    out2 = b.embedding(PredictOptions(embeddings="hello world"))
+    np.testing.assert_allclose(out.embeddings, out2.embeddings)
+
+
+def test_rerank_cross_encoder(cross_dir):
+    b = JaxRerankBackend()
+    res = b.load_model(ModelLoadOptions(model=cross_dir))
+    assert res.success, res.message
+    assert b.spec.n_classes == 1
+    out = b.rerank("query text", ["doc one", "doc two", "doc three"], top_n=2)
+    assert len(out.results) == 2
+    assert out.usage["total_tokens"] > 0
+    scores = [r.relevance_score for r in out.results]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_rerank_biencoder_fallback(bert_dir):
+    b = JaxRerankBackend()
+    assert b.load_model(ModelLoadOptions(model=bert_dir)).success
+    assert b.spec.n_classes == 0
+    out = b.rerank("alpha", ["alpha", "beta"], top_n=2)
+    assert len(out.results) == 2
+
+
+def test_vad_detects_burst():
+    b = JaxVADBackend()
+    b.load_model(ModelLoadOptions())
+    sr = SAMPLE_RATE
+    t = np.arange(sr * 2) / sr
+    audio = np.zeros(sr * 2, np.float32)
+    seg = (t >= 0.5) & (t < 1.5)
+    audio[seg] = 0.5 * (
+        np.sin(2 * np.pi * 120 * t[seg]) + 0.5 * np.sin(2 * np.pi * 240 * t[seg])
+    )
+    audio += 0.003 * np.random.default_rng(0).standard_normal(len(audio))
+    res = b.vad(audio.tolist())
+    assert len(res.segments) == 1
+    assert abs(res.segments[0].start - 0.5) < 0.15
+    assert abs(res.segments[0].end - 1.5) < 0.15
+
+
+def test_vad_silence_empty():
+    b = JaxVADBackend()
+    b.load_model(ModelLoadOptions())
+    audio = (0.001 * np.random.default_rng(1).standard_normal(SAMPLE_RATE)
+             ).tolist()
+    assert b.vad(audio).segments == []
+
+
+def test_vad_short_input():
+    b = JaxVADBackend()
+    b.load_model(ModelLoadOptions())
+    assert b.vad([0.0] * (FRAME // 2)).segments == []
+
+
+def test_tts_writes_wav(tmp_path):
+    b = JaxTTSBackend()
+    b.load_model(ModelLoadOptions())
+    dst = str(tmp_path / "out.wav")
+    res = b.tts("hello world", voice="alloy", dst=dst)
+    assert res.success
+    with wave.open(dst) as w:
+        assert w.getframerate() == 16000
+        assert w.getnframes() > 1000
+
+
+def test_sound_generation_reproducible(tmp_path):
+    b = JaxTTSBackend()
+    b.load_model(ModelLoadOptions())
+    d1, d2 = str(tmp_path / "a.wav"), str(tmp_path / "b.wav")
+    b.sound_generation("rain on a roof", dst=d1)
+    b.sound_generation("rain on a roof", dst=d2)
+    with open(d1, "rb") as f1, open(d2, "rb") as f2:
+        assert f1.read() == f2.read()
