@@ -33,7 +33,7 @@ def main() -> None:
             vocab_size=32000, d_model=2048, n_layers=16, n_heads=32,
             n_kv_heads=8, d_head=64, d_ff=8192, max_position=4096,
         )
-        n_slots, max_seq, gen_tokens = 8, 2048, 256
+        n_slots, max_seq, gen_tokens = 32, 1024, 512
     else:
         spec = tiny_spec(vocab_size=258)
         n_slots, max_seq, gen_tokens = 4, 256, 32
@@ -42,6 +42,7 @@ def main() -> None:
     tok = ByteTokenizer()
     eng = LLMEngine(
         spec, params, tok, n_slots=n_slots, max_seq=max_seq,
+        decode_steps=32 if on_tpu else 8,
         autostart=False,
     )
     eng.start()

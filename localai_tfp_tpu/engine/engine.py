@@ -39,6 +39,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ..models.llm_spec import LLMSpec
 from ..models.transformer import KVCache, Params, forward, forward_hidden
@@ -140,6 +141,24 @@ def _common_prefix(a: list[int], b: list[int]) -> int:
     return n
 
 
+def _sel_active(active, new, old):
+    """Select new vs old leaves per slot (keeps inactive slots' state)."""
+    if new.ndim == 0:
+        return new
+    a = active
+    while a.ndim < new.ndim:
+        a = a[..., None]
+    return jnp.where(a, new, old)
+
+
+def _sample_masked(sampling, slot_ids, logits, active, masks):
+    toks, new_sampling = sample(sampling, slot_ids, logits, mask=masks)
+    merged = jax.tree_util.tree_map(
+        lambda new, old: _sel_active(active, new, old), new_sampling, sampling
+    )
+    return jnp.where(active, toks, 0), merged
+
+
 class LLMEngine:
     """Continuous-batching engine over one jitted model."""
 
@@ -154,8 +173,10 @@ class LLMEngine:
         prefill_buckets: tuple[int, ...] = DEFAULT_PREFILL_BUCKETS,
         cache_dtype: Any = jnp.bfloat16,
         penalty_window: int = 256,
+        decode_steps: int = 8,
         autostart: bool = True,
     ) -> None:
+        self.decode_steps = max(1, decode_steps)
         self._autostart = autostart
         self.spec = spec
         self.params = params
@@ -184,31 +205,15 @@ class LLMEngine:
         @partial(jax.jit, donate_argnums=(2, 5))
         def _decode(params, tokens, cache, pos0, slot_ids, sampling,
                     active, masks):
+            # slot_ids=None: decode batches every cache row in order, so the
+            # KV write is a per-row DUS, not a cache-sized scatter
             logits, cache = forward(
-                spec, params, tokens, pos0, cache, slot_ids
+                spec, params, tokens, pos0, cache, None
             )
             last = logits[:, -1, :]
             toks, sampling = _sample_masked(sampling, slot_ids, last,
                                             active, masks)
             return toks, cache, sampling
-
-        def _sample_masked(sampling, slot_ids, logits, active, masks):
-            toks, new_sampling = sample(sampling, slot_ids, logits,
-                                        mask=masks)
-            # keep inactive slots' sampler state untouched
-            merged = jax.tree_util.tree_map(
-                lambda new, old: _sel(active, new, old), new_sampling,
-                sampling,
-            )
-            return jnp.where(active, toks, 0), merged
-
-        def _sel(active, new, old):
-            if new.ndim == 0:
-                return new
-            a = active
-            while a.ndim < new.ndim:
-                a = a[..., None]
-            return jnp.where(a, new, old)
 
         @jax.jit
         def _sample_only(sampling, slot_ids, logits, masks):
@@ -222,6 +227,49 @@ class LLMEngine:
         self._decode_fn = _decode
         self._sample_fn = _sample_only
         self._hidden_fn = _hidden
+        self._decode_k_fns: dict[int, Any] = {}
+        # device-resident decode state (tokens/pos/active) reused across
+        # dispatches while no slot changes; _epoch invalidates it
+        self._epoch = 0
+        self._dev_epoch = -1
+        self._dev_tokens: Any = None
+        self._dev_pos: Any = None
+        self._dev_active: Any = None
+
+    def _decode_k_fn(self, k: int):
+        """Jitted k-step decode: ``lax.scan`` over k forward+sample steps so
+        one host dispatch yields k tokens per active slot. This hides
+        host<->device dispatch latency — the decisive factor when the chip
+        sits behind a network tunnel, and still a win locally (SURVEY.md §7
+        hard part #2: per-token host sync kills throughput)."""
+        fn = self._decode_k_fns.get(k)
+        if fn is not None:
+            return fn
+        spec = self.spec
+
+        @partial(jax.jit, donate_argnums=(2, 5))
+        def _decode_k(params, tokens, cache, pos0, slot_ids, sampling,
+                      active):
+            def step(carry, _):
+                tokens, pos, cache, sampling = carry
+                logits, cache = forward(
+                    spec, params, tokens, pos, cache, None
+                )
+                toks, sampling = _sample_masked(
+                    sampling, slot_ids, logits[:, -1, :], active, None
+                )
+                pos = jnp.where(active, pos + 1, pos)
+                return (toks[:, None], pos, cache, sampling), toks
+
+            (tok_next, pos_next, cache, sampling), toks_seq = lax.scan(
+                step, (tokens, pos0, cache, sampling), None, length=k
+            )
+            # tok_next/pos_next are returned so the next dispatch can chain
+            # on device state without a host round trip
+            return toks_seq.T, tok_next, pos_next, cache, sampling  # [S, k]
+
+        self._decode_k_fns[k] = _decode_k
+        return _decode_k
 
     # ------------------------------------------------------------------ API
 
@@ -344,6 +392,7 @@ class LLMEngine:
         slot.constraint_state = (
             req.constraint.initial_state() if req.constraint else None
         )
+        self._epoch += 1
         self.sampling = self.sampling.reset_slot(
             slot.idx,
             temperature=req.temperature,
@@ -411,6 +460,7 @@ class LLMEngine:
             self.metrics.prompt_tokens_processed += slot.n_prompt
             slot.state = SlotState.DECODE
             slot.t_last = time.perf_counter()
+            self._epoch += 1
             self._emit_token(slot, int(tok[0]))
         else:
             slot.t_prefill_ms += (time.perf_counter() - t0) * 1e3
@@ -446,9 +496,30 @@ class LLMEngine:
             return None
         return jnp.asarray(np.stack(rows))
 
+    def _multi_step_k(self, decoding: list[_Slot]) -> tuple[int, int]:
+        """(k, room): largest safe on-device step count — no grammar/
+        logit-bias slot (those need a host-side mask per token), and no
+        slot may cross the end of its context row mid-scan. ``room`` is the
+        shared context headroom that also gates pipeline depth."""
+        room = min(self.max_seq - 1 - s.n_past for s in decoding)
+        if self.decode_steps <= 1:
+            return 1, room
+        for s in decoding:
+            req = s.request
+            if req is not None and (req.constraint or req.logit_bias):
+                return 1, room
+        k = min(self.decode_steps, max(room, 1))
+        while k & (k - 1):  # round down to a power of two (tiny jit cache)
+            k &= k - 1
+        return max(k, 1), room
+
     def _decode_step(self, decoding: list[_Slot]) -> None:
-        """One batched decode step over every running slot
-        (ref: grpc-server.cpp:1688-1726 batching ongoing tokens)."""
+        """One batched decode dispatch over every running slot
+        (ref: grpc-server.cpp:1688-1726 batching ongoing tokens). Runs k
+        model steps on-device per dispatch when no slot needs per-token
+        host work; tokens generated past a slot's EOS/stop are discarded
+        host-side and its n_past rolled back (the over-written tail K/V sits
+        beyond the valid prefix, so it is never attended to)."""
         t0 = time.perf_counter()
         S = self.n_slots
         tokens = np.zeros((S, 1), np.int32)
@@ -465,28 +536,79 @@ class LLMEngine:
                 # park inactive rows at their own tail: K/V write lands past
                 # the valid prefix, preserving it for prefix reuse
                 pos0[s.idx] = min(s.n_past, self.max_seq - 1)
-        masks = self._constraint_mask_rows(self.slots)
-        toks, self.cache, self.sampling = self._decode_fn(
-            self.params,
-            jnp.asarray(tokens),
-            self.cache,
-            jnp.asarray(pos0),
-            self._all_slot_ids,
-            self.sampling,
-            jnp.asarray(active),
-            masks,
-        )
-        toks_host = np.asarray(toks)
-        now = time.perf_counter()
-        dt_ms = (now - t0) * 1e3
-        for s in decoding:
-            # the token just consumed becomes part of the cached sequence
-            s.cache_tokens.append(int(tokens[s.idx, 0]))
-            s.n_past += 1
-            s.t_decode_ms += dt_ms
-            self._emit_token(s, int(toks_host[s.idx]))
-        if now > t0:
-            self.metrics.tokens_per_second = len(decoding) / (now - t0)
+
+        k, room = self._multi_step_k(decoding)
+        if k > 1:
+            # Double-buffered k-step dispatches: the second scan chains on
+            # the first's device-resident carry, so its compute overlaps the
+            # first result's download (the tunnel/dispatch RTT — dominant
+            # cost; see SKILL.md gotcha). Tokens generated past a stop are
+            # discarded like any mid-scan finish.
+            depth = 2 if room >= 2 * k else 1
+            fn = self._decode_k_fn(k)
+            if self._dev_epoch == self._epoch:
+                tok_dev, pos_dev, act_dev = (
+                    self._dev_tokens, self._dev_pos, self._dev_active
+                )
+            else:
+                tok_dev = jnp.asarray(tokens)
+                pos_dev = jnp.asarray(pos0)
+                act_dev = jnp.asarray(active)
+            batches = []
+            epoch0 = self._epoch
+            for _ in range(depth):
+                toks, tok_dev, pos_dev, self.cache, self.sampling = fn(
+                    self.params, tok_dev, self.cache, pos_dev,
+                    self._all_slot_ids, self.sampling, act_dev,
+                )
+                batches.append(toks)
+            self._dev_tokens, self._dev_pos, self._dev_active = (
+                tok_dev, pos_dev, act_dev
+            )
+            emitted = 0
+            prev_last = {s.idx: int(tokens[s.idx, 0]) for s in decoding}
+            t_prev = t0
+            for toks in batches:
+                toks_host = np.asarray(toks)  # [S, k]
+                now = time.perf_counter()
+                dt_ms = (now - t_prev) * 1e3
+                t_prev = now
+                for s in decoding:
+                    consumed = [prev_last[s.idx]] + [
+                        int(t) for t in toks_host[s.idx, : k - 1]
+                    ]
+                    prev_last[s.idx] = int(toks_host[s.idx, k - 1])
+                    s.t_decode_ms += dt_ms
+                    for j in range(k):
+                        if s.state is not SlotState.DECODE:
+                            break  # finished: discard overshoot tokens
+                        s.cache_tokens.append(consumed[j])
+                        s.n_past += 1
+                        emitted += 1
+                        self._emit_token(s, int(toks_host[s.idx, j]))
+            # device carry stays valid only if nothing changed while emitting
+            self._dev_epoch = (
+                self._epoch if self._epoch == epoch0 else -1
+            )
+        else:
+            masks = self._constraint_mask_rows(self.slots)
+            toks, self.cache, self.sampling = self._decode_fn(
+                self.params, jnp.asarray(tokens), self.cache,
+                jnp.asarray(pos0), self._all_slot_ids, self.sampling,
+                jnp.asarray(active), masks,
+            )
+            toks_host = np.asarray(toks)
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            emitted = 0
+            for s in decoding:
+                s.cache_tokens.append(int(tokens[s.idx, 0]))
+                s.n_past += 1
+                s.t_decode_ms += dt_ms
+                emitted += 1
+                self._emit_token(s, int(toks_host[s.idx]))
+        dt = time.perf_counter() - t0
+        if dt > 0 and emitted:
+            self.metrics.tokens_per_second = emitted / dt
         self.metrics.slots_busy = sum(1 for s in self.slots if s.active)
 
     # ---------------------------------------------------- token → stream
@@ -560,6 +682,7 @@ class LLMEngine:
 
     def _release(self, slot: _Slot) -> None:
         # cache_tokens stay: they describe this row's reusable prefix
+        self._epoch += 1
         slot.state = SlotState.FREE
         slot.request = None
         slot.out = None

@@ -57,9 +57,13 @@ class ByteTokenizer:
         """Counterpart of encoder-model encoding WITH special tokens."""
         return [self.bos_id] + self.encode(text) + [next(iter(self.eos_ids))]
 
-    def encode_pair(self, a: str, b: str) -> list[int]:
+    def encode_pair(self, a: str, b: str) -> tuple[list[int], list[int]]:
+        """Returns (ids, segment/type ids) — segment 1 covers b + final sep."""
         sep = next(iter(self.eos_ids))
-        return [self.bos_id] + self.encode(a) + [sep] + self.encode(b) + [sep]
+        ea, eb = self.encode(a), self.encode(b)
+        ids = [self.bos_id] + ea + [sep] + eb + [sep]
+        types = [0] * (len(ea) + 2) + [1] * (len(eb) + 1)
+        return ids, types
 
 
 class HFTokenizer:
@@ -113,9 +117,13 @@ class HFTokenizer:
         them (sentence-transformers / cross-encoder semantics)."""
         return self._tk.encode(text, add_special_tokens=True)
 
-    def encode_pair(self, a: str, b: str) -> list[int]:
-        """[CLS] a [SEP] b [SEP] — the cross-encoder input convention."""
-        return self._tk.encode(a, b, add_special_tokens=True)
+    def encode_pair(self, a: str, b: str) -> tuple[list[int], list[int]]:
+        """[CLS] a [SEP] b [SEP] with segment ids — the cross-encoder input
+        convention (segment 1 on the b half, as BERT was trained)."""
+        out = self._tk(a, b, add_special_tokens=True)
+        ids = out["input_ids"]
+        types = out.get("token_type_ids") or [0] * len(ids)
+        return ids, types
 
     def apply_chat_template(self, messages: list[dict], *,
                             add_generation_prompt: bool = True,

@@ -116,14 +116,19 @@ def encode(
     params: EncParams,
     tokens: jax.Array,  # [B, T] int32
     attn_mask: jax.Array,  # [B, T] 1 = real token
+    type_ids: Optional[jax.Array] = None,  # [B, T] segment ids (pairs)
 ) -> jax.Array:
     """Full-stack bidirectional encode; returns hidden states [B, T, D]."""
     B, T = tokens.shape
     H, Dh = spec.n_heads, spec.d_head
+    type_emb = (
+        params["type_emb"][0][None, None, :] if type_ids is None
+        else params["type_emb"][jnp.clip(type_ids, 0, spec.type_vocab_size - 1)]
+    )
     x = (
         params["word_emb"][tokens]
         + params["pos_emb"][jnp.arange(T)][None, :, :]
-        + params["type_emb"][0][None, None, :]
+        + type_emb
     )
     x = _ln(x, params["emb_ln_w"], params["emb_ln_b"], spec.norm_eps)
 

@@ -193,33 +193,14 @@ def _apply_penalties(logits: jax.Array, counts: jax.Array,
     return logits
 
 
-def _mask_top_k(logits: jax.Array, k: jax.Array) -> jax.Array:
-    """Per-row dynamic top-k via sort threshold. k==0 disables."""
-    V = logits.shape[-1]
-    sorted_desc = -jnp.sort(-logits, axis=-1)  # [B, V]
-    kk = jnp.clip(jnp.where(k <= 0, V, k), 1, V)
-    thresh = jnp.take_along_axis(sorted_desc, (kk - 1)[:, None], axis=-1)
-    return jnp.where(logits >= thresh, logits, NEG_INF)
-
-
-def _mask_top_p(logits: jax.Array, p: jax.Array) -> jax.Array:
-    """Nucleus: keep the smallest prefix of desc-sorted probs with mass >= p."""
-    idx = jnp.argsort(-logits, axis=-1)
-    sorted_logits = jnp.take_along_axis(logits, idx, axis=-1)
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    # keep while cumulative mass *before* this token < p (always keep 1st)
-    keep_sorted = (cum - probs) < p[:, None]
-    keep = jnp.zeros_like(keep_sorted).at[
-        jnp.arange(logits.shape[0])[:, None], idx
-    ].set(keep_sorted)
-    return jnp.where(keep, logits, NEG_INF)
-
-
-def _mask_min_p(logits: jax.Array, min_p: jax.Array) -> jax.Array:
-    probs = jax.nn.softmax(logits, axis=-1)
-    thresh = probs.max(axis=-1, keepdims=True) * min_p[:, None]
-    return jnp.where(probs >= thresh, logits, NEG_INF)
+# Static candidate-set size for stochastic sampling. llama.cpp chains
+# samplers top_k (default 40) -> top_p -> min_p, so computing the
+# top-p/min-p cutoffs within the top-CAND candidates reproduces the
+# reference semantics whenever top_k <= CAND (llama.cpp default 40); with
+# top_k disabled it truncates the distribution's tail beyond the top-128,
+# which carries negligible mass at sane temperatures. A full-vocab sort
+# here would dominate the whole decode step on TPU (3 sorts x V=128k).
+CAND = 128
 
 
 def sample(
@@ -245,13 +226,27 @@ def sample(
         state.presence_penalty[slot_ids],
     )
 
-    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # one top-k over the vocab serves greedy (j=0) and the candidate set
+    K = min(CAND, logits.shape[-1])
+    vals, idx = lax.top_k(logits, K)  # [B, K] desc
+    greedy_tok = idx[:, 0].astype(jnp.int32)
 
     temp = state.temperature[slot_ids]
-    scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
-    scaled = _mask_top_k(scaled, state.top_k[slot_ids])
-    scaled = _mask_top_p(scaled, state.top_p[slot_ids])
-    scaled = _mask_min_p(scaled, state.min_p[slot_ids])
+    scaled = vals / jnp.maximum(temp, 1e-6)[:, None]
+    # top-k: candidates are sorted desc, so the mask is a rank compare
+    rank = jnp.arange(K, dtype=jnp.int32)[None, :]
+    k_eff = jnp.where(state.top_k[slot_ids] <= 0, K,
+                      state.top_k[slot_ids])[:, None]
+    scaled = jnp.where(rank < k_eff, scaled, NEG_INF)
+    # top-p within candidates (sorted => plain cumsum)
+    probs = jax.nn.softmax(scaled, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < state.top_p[slot_ids][:, None]  # keep 1st always
+    scaled = jnp.where(keep, scaled, NEG_INF)
+    # min-p relative to the max candidate prob
+    probs = jax.nn.softmax(scaled, axis=-1)
+    keep = probs >= probs[:, :1] * state.min_p[slot_ids][:, None]
+    scaled = jnp.where(keep, scaled, NEG_INF)
 
     keys = state.rng[slot_ids]
     split = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
@@ -259,7 +254,10 @@ def sample(
     gumbel = jax.vmap(
         lambda k, row: jax.random.gumbel(k, row.shape, jnp.float32)
     )(sample_keys, scaled)
-    sampled_tok = jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
+    j = jnp.argmax(scaled + gumbel, axis=-1)
+    sampled_tok = jnp.take_along_axis(idx, j[:, None], axis=-1)[:, 0].astype(
+        jnp.int32
+    )
 
     tok = jnp.where(temp <= 0.0, greedy_tok, sampled_tok)
 

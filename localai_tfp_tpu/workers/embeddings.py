@@ -14,90 +14,33 @@ the jit cache stays tiny.
 
 from __future__ import annotations
 
-import os
-import threading
-from functools import partial
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..engine.tokenizer import Tokenizer, load_tokenizer
-from ..models.encoder import (
-    EncoderSpec, EncParams, encode, load_encoder_params, mean_pool,
-)
-from .base import (
-    Backend, EmbeddingResult, ModelLoadOptions, PredictOptions, Result,
-    StatusResponse,
-)
-
-LEN_BUCKETS = (16, 64, 128, 256, 512)
+from ..models.encoder import encode, mean_pool
+from .base import EmbeddingResult, PredictOptions
+from .encoder_base import EncoderWorkerBase
 
 
-class JaxEmbeddingsBackend(Backend):
-    def __init__(self) -> None:
-        self.spec: Optional[EncoderSpec] = None
-        self.params: Optional[EncParams] = None
-        self.tokenizer: Optional[Tokenizer] = None
-        self._state = "UNINITIALIZED"
-        self._lock = threading.Lock()
+class JaxEmbeddingsBackend(EncoderWorkerBase):
+    LEN_BUCKETS = (16, 64, 128, 256, 512)
 
-    def load_model(self, opts: ModelLoadOptions) -> Result:
-        with self._lock:
-            try:
-                model_dir = opts.model
-                if not os.path.isabs(model_dir):
-                    model_dir = os.path.join(opts.model_path or "", model_dir)
-                if not os.path.isdir(model_dir):
-                    raise FileNotFoundError(
-                        f"model directory not found: {model_dir}")
-                self.spec, self.params = load_encoder_params(model_dir)
-                self.tokenizer = load_tokenizer(model_dir)
+    def _compile(self) -> None:
+        spec = self.spec
 
-                @partial(jax.jit, static_argnums=())
-                def _encode(params, tokens, mask):
-                    hidden = encode(self.spec, params, tokens, mask)
-                    return mean_pool(hidden, mask)
+        @jax.jit
+        def _encode(params, tokens, mask):
+            hidden = encode(spec, params, tokens, mask)
+            return mean_pool(hidden, mask)
 
-                self._encode = _encode
-                self._state = "READY"
-                return Result(True, "embeddings model loaded")
-            except Exception as e:
-                self._state = "ERROR"
-                return Result(False, f"load failed: {e}")
-
-    def health(self) -> bool:
-        return self._state == "READY"
-
-    def status(self) -> StatusResponse:
-        return StatusResponse(state=self._state)
-
-    def shutdown(self) -> None:
-        self.spec = self.params = self.tokenizer = None
-        self._state = "UNINITIALIZED"
-
-    # ------------------------------------------------------------- encoding
-
-    def _bucket(self, n: int) -> int:
-        cap = self.spec.max_position
-        for b in LEN_BUCKETS:
-            if n <= b <= cap:
-                return b
-        return cap
+        self._encode = _encode
 
     def embed_batch(self, texts: list[str]) -> np.ndarray:
         assert self.spec and self.params is not None and self.tokenizer
         ids = [self.tokenizer.encode_special(t)[: self.spec.max_position]
                or [0] for t in texts]
-        T = self._bucket(max(len(x) for x in ids))
-        B = len(ids)
-        toks = np.zeros((B, T), np.int32)
-        mask = np.zeros((B, T), np.int32)
-        for r, x in enumerate(ids):
-            x = x[:T]
-            toks[r, : len(x)] = x
-            mask[r, : len(x)] = 1
+        toks, mask, _ = self._batch(ids)
         out = self._encode(self.params, jnp.asarray(toks), jnp.asarray(mask))
         return np.asarray(out, dtype=np.float32)
 

@@ -1,0 +1,89 @@
+"""Shared scaffolding for encoder-model workers (embeddings, rerank).
+
+One place for checkpoint resolution, lifecycle state, and bucketed batch
+padding — the per-worker classes contribute only their jitted programs
+(counterpart of the reference's shared Python-backend scaffolding,
+backend/python/common/libbackend.sh).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..engine.tokenizer import Tokenizer, load_tokenizer
+from ..models.encoder import EncoderSpec, EncParams, load_encoder_params
+from .base import Backend, ModelLoadOptions, Result, StatusResponse
+
+
+class EncoderWorkerBase(Backend):
+    LEN_BUCKETS: tuple[int, ...] = (32, 128, 256, 512)
+
+    def __init__(self) -> None:
+        self.spec: Optional[EncoderSpec] = None
+        self.params: Optional[EncParams] = None
+        self.tokenizer: Optional[Tokenizer] = None
+        self._state = "UNINITIALIZED"
+        self._lock = threading.Lock()
+
+    def _compile(self) -> None:
+        """Build the worker's jitted programs; spec/params are loaded."""
+        raise NotImplementedError
+
+    def load_model(self, opts: ModelLoadOptions) -> Result:
+        with self._lock:
+            try:
+                model_dir = opts.model
+                if not os.path.isabs(model_dir):
+                    model_dir = os.path.join(opts.model_path or "", model_dir)
+                if not os.path.isdir(model_dir):
+                    raise FileNotFoundError(
+                        f"model directory not found: {model_dir}")
+                self.spec, self.params = load_encoder_params(model_dir)
+                self.tokenizer = load_tokenizer(model_dir)
+                self._compile()
+                self._state = "READY"
+                return Result(True, "encoder model loaded")
+            except Exception as e:
+                self._state = "ERROR"
+                return Result(False, f"load failed: {e}")
+
+    def health(self) -> bool:
+        return self._state == "READY"
+
+    def status(self) -> StatusResponse:
+        return StatusResponse(state=self._state)
+
+    def shutdown(self) -> None:
+        self.spec = self.params = self.tokenizer = None
+        self._state = "UNINITIALIZED"
+
+    # --------------------------------------------------------- batching
+
+    def _bucket(self, n: int) -> int:
+        cap = self.spec.max_position
+        for b in self.LEN_BUCKETS:
+            if n <= b <= cap:
+                return b
+        return cap
+
+    def _batch(
+        self, seqs: list[list[int]],
+        type_seqs: Optional[list[list[int]]] = None,
+    ) -> tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+        """Pad to the next length bucket -> (tokens, mask, type_ids?)."""
+        T = self._bucket(max(len(s) for s in seqs))
+        toks = np.zeros((len(seqs), T), np.int32)
+        mask = np.zeros((len(seqs), T), np.int32)
+        types = np.zeros((len(seqs), T), np.int32) if type_seqs else None
+        for r, s in enumerate(seqs):
+            s = s[:T]
+            toks[r, : len(s)] = s
+            mask[r, : len(s)] = 1
+            if types is not None:
+                ts = type_seqs[r][:T]
+                types[r, : len(ts)] = ts
+        return toks, mask, types

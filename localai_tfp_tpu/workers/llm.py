@@ -80,6 +80,7 @@ class JaxLLMBackend(Backend):
                     n_slots=max(1, opts.batch_slots),
                     max_seq=opts.context_size,
                     cache_dtype=kv_dtype,
+                    decode_steps=int(opts.extra.get("decode_steps", 8)),
                 )
                 self.engine.start()
                 self._state = "READY"

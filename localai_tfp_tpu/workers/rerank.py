@@ -3,98 +3,40 @@
 
 Two scoring modes, decided by the checkpoint:
 - cross-encoder (classifier head present): score = head([CLS] of
-  "[CLS] query [SEP] doc [SEP]") — the rerankers-library semantics;
+  "[CLS] query [SEP] doc [SEP]" with segment-1 ids on the doc half) —
+  the rerankers-library semantics;
 - bi-encoder fallback: cosine(query_emb, doc_emb) from masked mean-pool.
 """
 
 from __future__ import annotations
 
-import os
-import threading
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..engine.tokenizer import Tokenizer, load_tokenizer
-from ..models.encoder import (
-    EncoderSpec, EncParams, classify, encode, load_encoder_params, mean_pool,
-)
-from .base import (
-    Backend, DocumentResult, ModelLoadOptions, RerankResult, Result,
-    StatusResponse,
-)
-
-LEN_BUCKETS = (32, 128, 256, 512)
+from ..models.encoder import classify, encode, mean_pool
+from .base import DocumentResult, RerankResult
+from .encoder_base import EncoderWorkerBase
 
 
-class JaxRerankBackend(Backend):
-    def __init__(self) -> None:
-        self.spec: Optional[EncoderSpec] = None
-        self.params: Optional[EncParams] = None
-        self.tokenizer: Optional[Tokenizer] = None
-        self._state = "UNINITIALIZED"
-        self._lock = threading.Lock()
+class JaxRerankBackend(EncoderWorkerBase):
+    def _compile(self) -> None:
+        spec = self.spec
 
-    def load_model(self, opts: ModelLoadOptions) -> Result:
-        with self._lock:
-            try:
-                model_dir = opts.model
-                if not os.path.isabs(model_dir):
-                    model_dir = os.path.join(opts.model_path or "", model_dir)
-                if not os.path.isdir(model_dir):
-                    raise FileNotFoundError(
-                        f"model directory not found: {model_dir}")
-                self.spec, self.params = load_encoder_params(model_dir)
-                self.tokenizer = load_tokenizer(model_dir)
+        @jax.jit
+        def _cross(params, tokens, mask, types):
+            hidden = encode(spec, params, tokens, mask, types)
+            return classify(spec, params, hidden)
 
-                @jax.jit
-                def _cross(params, tokens, mask):
-                    hidden = encode(self.spec, params, tokens, mask)
-                    return classify(self.spec, params, hidden)
+        @jax.jit
+        def _embed(params, tokens, mask):
+            hidden = encode(spec, params, tokens, mask)
+            return mean_pool(hidden, mask)
 
-                @jax.jit
-                def _embed(params, tokens, mask):
-                    hidden = encode(self.spec, params, tokens, mask)
-                    return mean_pool(hidden, mask)
-
-                self._cross = _cross
-                self._embed = _embed
-                self._state = "READY"
-                return Result(True, "rerank model loaded")
-            except Exception as e:
-                self._state = "ERROR"
-                return Result(False, f"load failed: {e}")
-
-    def health(self) -> bool:
-        return self._state == "READY"
-
-    def status(self) -> StatusResponse:
-        return StatusResponse(state=self._state)
-
-    def shutdown(self) -> None:
-        self.spec = self.params = self.tokenizer = None
-        self._state = "UNINITIALIZED"
+        self._cross = _cross
+        self._embed = _embed
 
     # --------------------------------------------------------------- scoring
-
-    def _bucket(self, n: int) -> int:
-        cap = self.spec.max_position
-        for b in LEN_BUCKETS:
-            if n <= b <= cap:
-                return b
-        return cap
-
-    def _batch(self, seqs: list[list[int]]) -> tuple[np.ndarray, np.ndarray]:
-        T = self._bucket(max(len(s) for s in seqs))
-        toks = np.zeros((len(seqs), T), np.int32)
-        mask = np.zeros((len(seqs), T), np.int32)
-        for r, s in enumerate(seqs):
-            s = s[:T]
-            toks[r, : len(s)] = s
-            mask[r, : len(s)] = 1
-        return toks, mask
 
     def _scores(self, query: str,
                 documents: list[str]) -> tuple[np.ndarray, int]:
@@ -103,17 +45,27 @@ class JaxRerankBackend(Backend):
         tk = self.tokenizer
         if self.spec.n_classes:  # cross-encoder path: [CLS] q [SEP] d [SEP]
             pairs = [tk.encode_pair(query, d) for d in documents]
-            toks, mask = self._batch(pairs)
+            toks, mask, types = self._batch(
+                [p[0] for p in pairs], [p[1] for p in pairs]
+            )
             logits = self._cross(
-                self.params, jnp.asarray(toks), jnp.asarray(mask))
+                self.params, jnp.asarray(toks), jnp.asarray(mask),
+                jnp.asarray(types))
             logits = np.asarray(logits, np.float32)
-            n_tok = sum(len(p) for p in pairs)
-            # single-logit heads score directly; 2-class heads use P(relevant)
-            score = logits[:, -1] if logits.shape[1] <= 2 else logits.max(-1)
+            n_tok = sum(len(p[0]) for p in pairs)
+            if logits.shape[1] == 1:
+                score = logits[:, 0]
+            else:
+                # margin of the "relevant" (last) class against the rest —
+                # monotone in P(relevant), unlike the raw class logit
+                rest = logits[:, :-1]
+                m = rest.max(axis=-1)
+                lse = m + np.log(np.exp(rest - m[:, None]).sum(axis=-1))
+                score = logits[:, -1] - lse
             return score, n_tok
         seqs = [tk.encode_special(query)] + [
             tk.encode_special(d) for d in documents]
-        toks, mask = self._batch(seqs)
+        toks, mask, _ = self._batch(seqs)
         embs = np.asarray(self._embed(
             self.params, jnp.asarray(toks), jnp.asarray(mask)), np.float32)
         return embs[1:] @ embs[0], sum(len(s) for s in seqs)
