@@ -191,6 +191,7 @@ class LLMEngine:
             n_slots, spec.vocab_size, window=penalty_window
         )
         self.slots = [_Slot(i) for i in range(n_slots)]
+        self._use_kernel = self._kernel_eligible()
         self._pending: list[tuple[GenRequest, queue.SimpleQueue]] = []
         self._lock = threading.Condition()
         self._stop = False
@@ -208,7 +209,7 @@ class LLMEngine:
             # slot_ids=None: decode batches every cache row in order, so the
             # KV write is a per-row DUS, not a cache-sized scatter
             logits, cache = forward(
-                spec, params, tokens, pos0, cache, None
+                spec, params, tokens, pos0, cache, None, self._use_kernel
             )
             last = logits[:, -1, :]
             toks, sampling = _sample_masked(sampling, slot_ids, last,
@@ -236,13 +237,40 @@ class LLMEngine:
         self._dev_pos: Any = None
         self._dev_active: Any = None
 
-    def _decode_k_fn(self, k: int):
+    def _kernel_eligible(self) -> bool:
+        """Use the Pallas ragged decode kernels when the mosaic path is
+        available and shapes qualify (ops/decode_attention.py). Env
+        override: LOCALAI_DECODE_KERNEL=0/1."""
+        import os
+
+        from ..ops.decode_attention import PAGE, _interpret
+
+        env = os.environ.get("LOCALAI_DECODE_KERNEL")
+        if env is None:
+            # default OFF: measured on v5e, the per-page pallas dispatch
+            # overhead currently loses to the windowed XLA path below;
+            # flip on once the kernels fuse the layer loop
+            return False
+        return env not in ("0", "false", "off") and (
+            not _interpret()
+            and self.max_seq % PAGE == 0
+            and self.spec.kv_dim % 128 == 0
+            and not self.spec.attn_logit_softcap
+        )
+
+    def _decode_k_fn(self, k: int, window: int):
         """Jitted k-step decode: ``lax.scan`` over k forward+sample steps so
         one host dispatch yields k tokens per active slot. This hides
         host<->device dispatch latency — the decisive factor when the chip
         sits behind a network tunnel, and still a win locally (SURVEY.md §7
-        hard part #2: per-token host sync kills throughput)."""
-        fn = self._decode_k_fns.get(k)
+        hard part #2: per-token host sync kills throughput).
+
+        ``window`` (static) slices the KV cache to the live-context bucket
+        for the whole scan: per-step attention traffic scales with actual
+        context use, not max_seq — the XLA stand-in for ragged paged
+        attention. The slice/write-back happens once per dispatch, inside
+        the jit, so XLA keeps it in place on the donated buffer."""
+        fn = self._decode_k_fns.get((k, window))
         if fn is not None:
             return fn
         spec = self.spec
@@ -250,10 +278,18 @@ class LLMEngine:
         @partial(jax.jit, donate_argnums=(2, 5))
         def _decode_k(params, tokens, cache, pos0, slot_ids, sampling,
                       active):
+            full = cache
+            if window < self.max_seq:
+                L, S, _, F = cache.k.shape
+                cache = KVCache(
+                    k=lax.slice(cache.k, (0, 0, 0, 0), (L, S, window, F)),
+                    v=lax.slice(cache.v, (0, 0, 0, 0), (L, S, window, F)),
+                )
+
             def step(carry, _):
                 tokens, pos, cache, sampling = carry
                 logits, cache = forward(
-                    spec, params, tokens, pos, cache, None
+                    spec, params, tokens, pos, cache, None, self._use_kernel
                 )
                 toks, sampling = _sample_masked(
                     sampling, slot_ids, logits[:, -1, :], active, None
@@ -264,11 +300,16 @@ class LLMEngine:
             (tok_next, pos_next, cache, sampling), toks_seq = lax.scan(
                 step, (tokens, pos0, cache, sampling), None, length=k
             )
+            if window < self.max_seq:
+                cache = KVCache(
+                    k=lax.dynamic_update_slice(full.k, cache.k, (0, 0, 0, 0)),
+                    v=lax.dynamic_update_slice(full.v, cache.v, (0, 0, 0, 0)),
+                )
             # tok_next/pos_next are returned so the next dispatch can chain
             # on device state without a host round trip
             return toks_seq.T, tok_next, pos_next, cache, sampling  # [S, k]
 
-        self._decode_k_fns[k] = _decode_k
+        self._decode_k_fns[(k, window)] = _decode_k
         return _decode_k
 
     # ------------------------------------------------------------------ API
@@ -522,6 +563,22 @@ class LLMEngine:
         beyond the valid prefix, so it is never attended to)."""
         t0 = time.perf_counter()
         S = self.n_slots
+        k, room = self._multi_step_k(decoding)
+        depth = 2 if k > 1 and room >= 2 * k else 1
+        # live-context window bucket for this dispatch (see _decode_k_fn)
+        need = max(s.n_past for s in decoding) + depth * k + 1
+        window = 256
+        while window < need:
+            window *= 2
+        window = min(window, self.max_seq)
+        # prefer an already-compiled window >= need over compiling a new
+        # exact bucket (a cold jit costs seconds; reading a slightly larger
+        # window costs microseconds)
+        compiled = [w for (kk, w) in self._decode_k_fns
+                    if kk == k and window <= w]
+        if compiled:
+            window = min(compiled)
+
         tokens = np.zeros((S, 1), np.int32)
         pos0 = np.zeros((S,), np.int32)
         active = np.zeros((S,), bool)
@@ -534,18 +591,22 @@ class LLMEngine:
                 active[s.idx] = True
             else:
                 # park inactive rows at their own tail: K/V write lands past
-                # the valid prefix, preserving it for prefix reuse
+                # the valid prefix, preserving it for prefix reuse. In the
+                # windowed (k>1) path, a row whose prefix out-sizes the
+                # window gets clamped: its reusable prefix is truncated to
+                # what the window keeps. The k==1 path uses the full cache.
+                if k > 1 and s.n_past >= window:
+                    s.n_past = window - 1
+                    s.cache_tokens = s.cache_tokens[: window - 1]
                 pos0[s.idx] = min(s.n_past, self.max_seq - 1)
 
-        k, room = self._multi_step_k(decoding)
         if k > 1:
             # Double-buffered k-step dispatches: the second scan chains on
             # the first's device-resident carry, so its compute overlaps the
             # first result's download (the tunnel/dispatch RTT — dominant
             # cost; see SKILL.md gotcha). Tokens generated past a stop are
             # discarded like any mid-scan finish.
-            depth = 2 if room >= 2 * k else 1
-            fn = self._decode_k_fn(k)
+            fn = self._decode_k_fn(k, window)
             if self._dev_epoch == self._epoch:
                 tok_dev, pos_dev, act_dev = (
                     self._dev_tokens, self._dev_pos, self._dev_active
@@ -574,6 +635,8 @@ class LLMEngine:
                 dt_ms = (now - t_prev) * 1e3
                 t_prev = now
                 for s in decoding:
+                    if s.state is not SlotState.DECODE:
+                        continue  # finished in an earlier batch
                     consumed = [prev_last[s.idx]] + [
                         int(t) for t in toks_host[s.idx, : k - 1]
                     ]

@@ -294,11 +294,11 @@ _NON_LAYER_KEYS = ("embed", "final_norm_w", "final_norm_b", "lm_head",
                    "lm_head_b")
 
 
-def _layer_body(spec, x, lp, positions, inv_freq, rope_scale, kv_fn):
-    """One transformer layer, shared by the serving (KV-cached) and training
-    (cache-free) paths. ``kv_fn(k, v) -> (k_eff, v_eff, carry)`` decides
-    where K/V come from: the cache rows after a scatter-write (serving) or
-    the current sequence (training)."""
+def _layer_body(spec, x, lp, positions, inv_freq, rope_scale, attn_fn):
+    """One transformer layer, shared by the serving (KV-cached), training
+    (cache-free) and Pallas-kernel decode paths. ``attn_fn(q, k, v) ->
+    (attn [B, T, H*Dh], carry)`` owns both where K/V live and the
+    attention contraction."""
     B, T = x.shape[0], x.shape[1]
     h = _norm(spec, x, lp["ln1_w"], lp.get("ln1_b"))
     q = h @ lp["wq"]
@@ -311,8 +311,7 @@ def _layer_body(spec, x, lp, positions, inv_freq, rope_scale, kv_fn):
     v = v.reshape(B, T, spec.n_kv_heads, spec.d_head)
     q = apply_rope(q, positions, inv_freq, spec.rotary_dim, rope_scale)
     k = apply_rope(k, positions, inv_freq, spec.rotary_dim, rope_scale)
-    k_eff, v_eff, carry = kv_fn(k, v)
-    attn = _attend(spec, q, k_eff, v_eff, positions)
+    attn, carry = attn_fn(q, k, v)
     attn = attn @ lp["wo"]
     if "bo" in lp:
         attn = attn + lp["bo"]
@@ -364,6 +363,8 @@ def forward_hidden(
     cache: KVCache,
     slot_ids: Optional[jax.Array],  # [B] i32 cache row per batch row;
     # None => identity (row b == slot b), the batched-decode hot path
+    decode_kernel: bool = False,  # T==1 identity path via Pallas paged
+    # append/attend kernels (ragged cache reads; ops/decode_attention.py)
 ) -> tuple[jax.Array, KVCache]:
     """Run the stack up to (and including) the final norm; returns
     (hidden [B, T, D], updated cache). The LM head lives in ``forward``;
@@ -384,7 +385,25 @@ def forward_hidden(
     identity = slot_ids is None  # batch row b IS cache row b (decode path)
 
     def body(x, scanned):
-        lp, ck, cv = scanned  # layer params; cache slices [n_slots, S, Hkv, Dh]
+        lp, ck, cv = scanned  # layer params; cache slices [n_slots, S, kv_dim]
+
+        def kernel_attn(q, k, v):
+            # Pallas path: append one page per slot, attend over valid
+            # pages only (ragged reads — the decode bandwidth win)
+            from ..ops.decode_attention import decode_attention, paged_append
+
+            ck2 = paged_append(ck, k.reshape(B, spec.kv_dim), pos0)
+            cv2 = paged_append(cv, v.reshape(B, spec.kv_dim), pos0)
+            scale = (
+                1.0 / math.sqrt(spec.query_pre_attn_scalar)
+                if spec.query_pre_attn_scalar
+                else 1.0 / math.sqrt(spec.d_head)
+            )
+            out = decode_attention(
+                q[:, 0], ck2, cv2, pos0 + 1, spec.n_kv_heads,
+                scale=scale, sliding_window=spec.sliding_window,
+            )
+            return out[:, None, :].astype(x.dtype), (ck2, cv2)
 
         def kv_from_cache(k, v):
             # cache rows are head-FLAT [seq, kv_dim] (see KVCache); heads are
@@ -429,8 +448,14 @@ def forward_hidden(
                 cv2 = write(cv, vf)
             return split(ck2[slot_ids]), split(cv2[slot_ids]), (ck2, cv2)
 
+        def xla_attn(q, k, v):
+            k_eff, v_eff, carry = kv_from_cache(k, v)
+            return _attend(spec, q, k_eff, v_eff, positions), carry
+
+        use_kernel = decode_kernel and identity and x.shape[1] == 1
         x, (ck2, cv2) = _layer_body(
-            spec, x, lp, positions, inv_freq, rope_scale, kv_from_cache
+            spec, x, lp, positions, inv_freq, rope_scale,
+            kernel_attn if use_kernel else xla_attn,
         )
         return x, (ck2, cv2)
 
@@ -447,10 +472,13 @@ def forward(
     tokens: jax.Array,
     pos0: jax.Array,
     cache: KVCache,
-    slot_ids: jax.Array,
+    slot_ids: Optional[jax.Array],
+    decode_kernel: bool = False,
 ) -> tuple[jax.Array, KVCache]:
     """forward_hidden + LM head; returns (logits [B, T, V] f32, cache)."""
-    x, cache = forward_hidden(spec, params, tokens, pos0, cache, slot_ids)
+    x, cache = forward_hidden(
+        spec, params, tokens, pos0, cache, slot_ids, decode_kernel
+    )
     return _lm_head(spec, params, x), cache
 
 
@@ -486,7 +514,7 @@ def forward_train(
     def body(x, lp):
         x, _ = _layer_body(
             spec, x, lp, positions, inv_freq, rope_scale,
-            lambda k, v: (k, v, None),
+            lambda q, k, v: (_attend(spec, q, k, v, positions), None),
         )
         return x, None
 

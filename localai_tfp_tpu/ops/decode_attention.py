@@ -1,0 +1,241 @@
+"""Pallas TPU kernels for the batched-decode attention hot path.
+
+The XLA decode path reads every KV-cache position (max_seq) for every slot
+on every step — the measured throughput ceiling on v5e once dispatch RTT
+is amortized. These kernels make the cache access *ragged*: only the pages
+covering each slot's valid prefix are DMA'd (TPU counterpart of the
+reference's per-slot `cache_tokens` raggedness, backend/cpp/llama/
+grpc-server.cpp:188-385 — and of its paged llama.cpp KV cache).
+
+Design notes (see /opt/skills/guides/pallas_guide.md):
+- cache layout stays head-FLAT [n_slots, max_seq, kv_dim]: full 128-lane
+  rows (kv_dim >= 512), no (H, 64) register padding, no relayouts.
+- attention uses a block-diagonal q matrix ``wq [kv_dim, n_q_heads]``
+  (column h carries q-head h's vector in the 64-lane band of its GQA kv
+  head), so logits are ONE full-lane MXU matmul ``k_page @ wq`` — the 8x
+  FLOP overhead is irrelevant at decode (bandwidth-bound).
+- pages beyond a slot's valid length are clamped in the index_map, so
+  Mosaic's block pipeline re-uses the resident block and skips the DMA;
+  compute is skipped with @pl.when. Flash-style (m, l, acc) accumulation
+  across pages; output emitted on each slot's last valid page.
+- the append kernel touches exactly ONE page per slot (input/output
+  aliased), replacing a full-cache dynamic_update_slice copy.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+PAGE = 256
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    """Mosaic-compile on TPU; interpret elsewhere (CPU tests). The default
+    *device* wins over the default backend: a registered TPU plugin does
+    not mean this computation runs on it (tests pin jax_default_device to
+    CPU)."""
+    dd = jax.config.jax_default_device
+    if dd is not None:
+        return dd.platform != "tpu"
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# append: write this step's k/v row into the page containing `pos`
+# ---------------------------------------------------------------------------
+
+
+def _append_kernel(pos_ref, new_ref, page_in_ref, page_out_ref):
+    b = pl.program_id(0)
+    off = pos_ref[b] % PAGE
+    # masked whole-page write: mosaic cannot do dynamic sublane-unaligned
+    # stores (`ref[ds(off,1)] = ...` needs off % 8 == 0), a lane-wise select
+    # costs nothing extra (the page is already resident in VMEM)
+    row = jax.lax.broadcasted_iota(jnp.int32, (PAGE, 1), 0)
+    page_out_ref[0] = jnp.where(row == off, new_ref[0], page_in_ref[0])
+
+
+def paged_append(cache: jax.Array, new: jax.Array,
+                 pos: jax.Array) -> jax.Array:
+    """cache [S, SEQ, F] <- new [S, F] at per-slot positions pos [S].
+
+    Only the target page per slot is read+written (2*PAGE*F bytes/slot vs
+    the whole cache row for a fused XLA DUS inside a scan)."""
+    S, SEQ, F = cache.shape
+    page_map = lambda b, pos: (b, pos[b] // PAGE, 0)  # noqa: E731
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(S,),
+        in_specs=[
+            # [S, 1, F] with block (1, 1, F): trailing block dims equal the
+            # array dims, satisfying mosaic's (8, 128) block-divisibility
+            pl.BlockSpec((1, 1, F), lambda b, pos: (b, 0, 0)),  # new row
+            pl.BlockSpec((1, PAGE, F), page_map),  # aliased cache page
+        ],
+        out_specs=pl.BlockSpec((1, PAGE, F), page_map),
+    )
+    return pl.pallas_call(
+        _append_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(cache.shape, cache.dtype),
+        input_output_aliases={2: 0},  # cache operand -> out (in-place page)
+        interpret=_interpret(),
+    )(pos, new[:, None, :], cache)
+
+
+# ---------------------------------------------------------------------------
+# attend: flash accumulation over valid pages only
+# ---------------------------------------------------------------------------
+
+
+def _attend_kernel(len_ref, wq_ref, k_ref, v_ref, out_ref,
+                   acc_ref, m_ref, l_ref, *, scale: float,
+                   sliding_window: Optional[int]):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    n = len_ref[b]
+    n_pages = jax.lax.div(n + PAGE - 1, PAGE)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(p < n_pages)
+    def _page():
+        k = k_ref[0]  # [PAGE, F]
+        wq = wq_ref[0]  # [F, H]
+        logits = jax.lax.dot_general(
+            k, wq, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [PAGE, H]
+        row = p * PAGE + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 0
+        )
+        valid = row < n
+        if sliding_window is not None:
+            valid &= row > (n - 1 - sliding_window)
+        logits = jnp.where(valid, logits, NEG_INF)
+
+        m_prev = m_ref[...]  # [1, H]
+        m_page = jnp.max(logits, axis=0, keepdims=True)  # [1, H]
+        m_new = jnp.maximum(m_prev, m_page)
+        alpha = jnp.exp(m_prev - m_new)  # [1, H]
+        pexp = jnp.exp(logits - m_new)  # [PAGE, H]
+        pexp = jnp.where(valid, pexp, 0.0)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(pexp, 0, keepdims=True)
+        v = v_ref[0]  # [PAGE, F]
+        pv = jax.lax.dot_general(
+            pexp, v, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [H, F]
+        acc_ref[...] = acc_ref[...] * alpha.T + pv
+        m_ref[...] = m_new
+
+    @pl.when(p == n_pages - 1)
+    def _emit():
+        out_ref[0] = (
+            acc_ref[...] / jnp.maximum(l_ref[...].T, 1e-30)
+        ).astype(out_ref.dtype)
+
+
+def paged_attend(
+    wq: jax.Array,  # [S, F, H] block-diagonal q matrices
+    cache_k: jax.Array,  # [S, SEQ, F]
+    cache_v: jax.Array,  # [S, SEQ, F]
+    lengths: jax.Array,  # [S] valid positions (incl. current token)
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """Returns [S, H, F] f32: per q-head weighted V rows (still flat; the
+    caller extracts each head's 64-lane band)."""
+    S, SEQ, F = cache_k.shape
+    H = wq.shape[-1]
+    n_pages = SEQ // PAGE
+
+    def page_map(b, p, lens):
+        last = jax.lax.div(lens[b] + PAGE - 1, PAGE) - 1
+        return (b, jnp.minimum(p, last), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(S, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, F, H), lambda b, p, lens: (b, 0, 0)),
+            pl.BlockSpec((1, PAGE, F), page_map),
+            pl.BlockSpec((1, PAGE, F), page_map),
+        ],
+        out_specs=pl.BlockSpec((1, H, F), lambda b, p, lens: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, F), jnp.float32),
+            pltpu.VMEM((1, H), jnp.float32),
+            pltpu.VMEM((1, H), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _attend_kernel, scale=scale, sliding_window=sliding_window
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, F), jnp.float32),
+        interpret=_interpret(),
+    )(lengths, wq, cache_k, cache_v)
+
+
+# ---------------------------------------------------------------------------
+# XLA-side glue: block-diagonal q construction + head-band extraction
+# ---------------------------------------------------------------------------
+
+
+def build_block_diag_q(q: jax.Array, n_kv_heads: int) -> jax.Array:
+    """q [S, H, Dh] -> wq [S, n_kv*Dh, H] with column h occupying the
+    64-lane band of its GQA kv head (h // group)."""
+    S, H, Dh = q.shape
+    group = H // n_kv_heads
+    qr = q.reshape(S, n_kv_heads, group, Dh)
+    eye = jnp.eye(n_kv_heads, dtype=q.dtype)
+    # [S, kv2, Dh, kv, g] = q[s, kv, g, d] * eye[kv, kv2]
+    w = jnp.einsum("skgd,kK->sKdkg", qr, eye)
+    return w.reshape(S, n_kv_heads * Dh, H)
+
+
+def extract_head_bands(out: jax.Array, n_kv_heads: int,
+                       d_head: int) -> jax.Array:
+    """out [S, H, F] -> [S, H, Dh]: take q-head h's band (its kv head's
+    64 lanes) from the flat F axis."""
+    S, H, F = out.shape
+    group = H // n_kv_heads
+    outr = out.reshape(S, n_kv_heads, group, n_kv_heads, d_head)
+    # select diag over the two kv axes
+    idx = jnp.arange(n_kv_heads)
+    return outr[:, idx, :, idx, :].transpose(1, 0, 2, 3).reshape(S, H, d_head)
+
+
+def decode_attention(
+    q: jax.Array,  # [S, H, Dh] (post-rope)
+    cache_k: jax.Array,  # [S, SEQ, F]
+    cache_v: jax.Array,
+    lengths: jax.Array,  # [S]
+    n_kv_heads: int,
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """Full ragged decode attention; returns [S, H * Dh]."""
+    S, H, Dh = q.shape
+    wq = build_block_diag_q(q, n_kv_heads)
+    out = paged_attend(
+        wq, cache_k, cache_v, lengths,
+        scale=scale, sliding_window=sliding_window,
+    )
+    return extract_head_bands(out, n_kv_heads, Dh).reshape(S, H * Dh)
