@@ -1,0 +1,221 @@
+"""TPU-native denoising-diffusion image generator (UNet + DDIM).
+
+Capability counterpart of the reference's diffusers backend
+(backend/python/diffusers/backend.py:304-350 GenerateImage — pipeline
+switch, scheduler enum :82-133) and the stablediffusion-ggml cgo worker
+(backend/go/image/stablediffusion-ggml). Serves /v1/images/generations.
+
+The architecture is a classic conditional UNet2D: resnet blocks with
+timestep embedding, self-attention at the lowest resolution, and
+cross-attention over a text-conditioning sequence, sampled with DDIM.
+Everything is jitted; the full sampling loop is ONE ``lax.scan`` on
+device (same dispatch-amortization rationale as the LLM decode loop).
+HF diffusers-format weight import is a planned follow-up; random-init
+weights exercise the full pipeline end-to-end today.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@dataclass(frozen=True, eq=False)
+class DiffusionSpec:
+    channels: tuple[int, ...] = (64, 128)
+    d_cond: int = 64  # text-conditioning width
+    n_res: int = 1  # resnet blocks per level
+    t_emb: int = 128
+    img_channels: int = 3
+    steps_train: int = 1000
+
+
+def tiny_diffusion_spec(**over: Any) -> DiffusionSpec:
+    kw: dict[str, Any] = dict(channels=(16, 32), d_cond=16, t_emb=32)
+    kw.update(over)
+    return DiffusionSpec(**kw)
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+
+def _conv_init(key, kh, kw, cin, cout, scale=None):
+    scale = scale or 1.0 / math.sqrt(kh * kw * cin)
+    return jax.random.normal(key, (kh, kw, cin, cout), jnp.float32) * scale
+
+
+def init_diffusion_params(rng: jax.Array, spec: DiffusionSpec) -> dict:
+    keys = iter(jax.random.split(rng, 200))
+    C = spec.channels
+
+    def res_block(cin, cout):
+        return {
+            "conv1": _conv_init(next(keys), 3, 3, cin, cout),
+            "b1": jnp.zeros((cout,)),
+            "conv2": _conv_init(next(keys), 3, 3, cout, cout),
+            "b2": jnp.zeros((cout,)),
+            "temb": jax.random.normal(next(keys), (spec.t_emb, cout)) * 0.02,
+            "skip": (_conv_init(next(keys), 1, 1, cin, cout)
+                     if cin != cout else None),
+        }
+
+    def attn_block(c):
+        return {
+            "wq": jax.random.normal(next(keys), (c, c)) * (c ** -0.5),
+            "wk": jax.random.normal(next(keys), (spec.d_cond, c)) * 0.02,
+            "wv": jax.random.normal(next(keys), (spec.d_cond, c)) * 0.02,
+            "wo": jax.random.normal(next(keys), (c, c)) * 0.02,
+            "self_wk": jax.random.normal(next(keys), (c, c)) * (c ** -0.5),
+            "self_wv": jax.random.normal(next(keys), (c, c)) * 0.02,
+        }
+
+    p: dict = {
+        "in_conv": _conv_init(next(keys), 3, 3, spec.img_channels, C[0]),
+        "t_w1": jax.random.normal(next(keys), (spec.t_emb, spec.t_emb)) * 0.02,
+        "t_w2": jax.random.normal(next(keys), (spec.t_emb, spec.t_emb)) * 0.02,
+        "out_conv": _conv_init(next(keys), 3, 3, C[0], spec.img_channels,
+                               scale=1e-4),
+        "down": [], "up": [],
+        "mid_res": res_block(C[-1], C[-1]),
+        "mid_attn": attn_block(C[-1]),
+        "mid_res2": res_block(C[-1], C[-1]),
+    }
+    cin = C[0]
+    for c in C:
+        p["down"].append({
+            "res": [res_block(cin if i == 0 else c, c)
+                    for i in range(spec.n_res)],
+            "pool": _conv_init(next(keys), 3, 3, c, c),
+        })
+        cin = c
+    cprev = C[-1]
+    for c in reversed(C):
+        p["up"].append({
+            "res": [res_block(c * 2 if i == 0 else c, c)
+                    for i in range(spec.n_res)],
+            "upconv": _conv_init(next(keys), 3, 3, cprev, c),
+        })
+        cprev = c
+    return p
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _conv(x, w, b=None, stride=1):
+    out = lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return out + b if b is not None else out
+
+
+def _gn(x, groups=8):
+    B, H, W, C = x.shape
+    g = min(groups, C)
+    xr = x.reshape(B, H, W, g, C // g)
+    mu = xr.mean((1, 2, 4), keepdims=True)
+    var = xr.var((1, 2, 4), keepdims=True)
+    return ((xr - mu) * lax.rsqrt(var + 1e-5)).reshape(B, H, W, C)
+
+
+def _res(p, x, temb):
+    h = _conv(jax.nn.silu(_gn(x)), p["conv1"], p["b1"])
+    h = h + (temb @ p["temb"])[:, None, None, :]
+    h = _conv(jax.nn.silu(_gn(h)), p["conv2"], p["b2"])
+    skip = _conv(x, p["skip"]) if p["skip"] is not None else x
+    return h + skip
+
+
+def _attn(p, x, cond):
+    """Self-attention + cross-attention over cond [B, Tc, d_cond]."""
+    B, H, W, C = x.shape
+    q = x.reshape(B, H * W, C) @ p["wq"]
+    ks = x.reshape(B, H * W, C) @ p["self_wk"]
+    vs = x.reshape(B, H * W, C) @ p["self_wv"]
+    a = jax.nn.softmax(q @ ks.transpose(0, 2, 1) / math.sqrt(C), -1)
+    out = a @ vs
+    kc = cond @ p["wk"]
+    vc = cond @ p["wv"]
+    a = jax.nn.softmax(q @ kc.transpose(0, 2, 1) / math.sqrt(C), -1)
+    out = out + a @ vc
+    return x + (out @ p["wo"]).reshape(B, H, W, C)
+
+
+def _timestep_embedding(t, dim):
+    half = dim // 2
+    freqs = jnp.exp(-math.log(10000) * jnp.arange(half) / half)
+    args = t[:, None].astype(jnp.float32) * freqs[None]
+    return jnp.concatenate([jnp.sin(args), jnp.cos(args)], -1)
+
+
+def unet(spec: DiffusionSpec, params: dict, x: jax.Array, t: jax.Array,
+         cond: jax.Array) -> jax.Array:
+    """Predict noise eps for x_t. x [B,H,W,3], t [B], cond [B,Tc,d_cond]."""
+    temb = _timestep_embedding(t, spec.t_emb)
+    temb = jax.nn.silu(temb @ params["t_w1"]) @ params["t_w2"]
+    h = _conv(x, params["in_conv"])
+    skips = []
+    for lvl in params["down"]:
+        for r in lvl["res"]:
+            h = _res(r, h, temb)
+        skips.append(h)
+        h = _conv(h, lvl["pool"], stride=2)
+    h = _res(params["mid_res"], h, temb)
+    h = _attn(params["mid_attn"], h, cond)
+    h = _res(params["mid_res2"], h, temb)
+    for lvl, skip in zip(params["up"], reversed(skips)):
+        B, Hh, Ww, C = h.shape
+        h = jax.image.resize(h, (B, Hh * 2, Ww * 2, C), "nearest")
+        h = _conv(h, lvl["upconv"])
+        h = jnp.concatenate([h, skip], -1)
+        for r in lvl["res"]:
+            h = _res(r, h, temb)
+    return _conv(jax.nn.silu(_gn(h)), params["out_conv"])
+
+
+# ---------------------------------------------------------------------------
+# DDIM sampling (ref scheduler enum: diffusers backend.py:82-133 — DDIM is
+# the deterministic default here; others are follow-ups)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnums=(0, 4, 5, 6))
+def ddim_sample(spec: DiffusionSpec, params: dict, cond: jax.Array,
+                rng: jax.Array, height: int, width: int,
+                steps: int = 20, guidance: float = 3.0) -> jax.Array:
+    """Classifier-free-guided DDIM; the whole sampler is one lax.scan."""
+    B = cond.shape[0]
+    betas = jnp.linspace(1e-4, 0.02, spec.steps_train)
+    alphas = jnp.cumprod(1.0 - betas)
+    ts = jnp.linspace(spec.steps_train - 1, 0, steps).astype(jnp.int32)
+    x = jax.random.normal(rng, (B, height, width, spec.img_channels))
+    uncond = jnp.zeros_like(cond)
+
+    def step(x, i):
+        t = ts[i]
+        t_prev = jnp.where(i + 1 < steps, ts[jnp.minimum(i + 1, steps - 1)], 0)
+        a_t = alphas[t]
+        a_prev = jnp.where(i + 1 < steps, alphas[t_prev], 1.0)
+        tb = jnp.full((B,), t)
+        eps_c = unet(spec, params, x, tb, cond)
+        eps_u = unet(spec, params, x, tb, uncond)
+        eps = eps_u + guidance * (eps_c - eps_u)
+        x0 = (x - jnp.sqrt(1 - a_t) * eps) / jnp.sqrt(a_t)
+        x0 = jnp.clip(x0, -1.5, 1.5)
+        x = jnp.sqrt(a_prev) * x0 + jnp.sqrt(1 - a_prev) * eps
+        return x, None
+
+    x, _ = lax.scan(step, x, jnp.arange(steps))
+    return jnp.clip(x, -1, 1)

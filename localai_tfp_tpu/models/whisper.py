@@ -1,0 +1,401 @@
+"""TPU-native Whisper (speech-to-text encoder-decoder).
+
+Capability counterpart of the reference's STT backends (whisper.cpp cgo
+worker — backend/go/transcribe/whisper/; faster-whisper —
+backend/python/faster-whisper/backend.py:99), serving
+POST /v1/audio/transcriptions.
+
+TPU-first design mirrors the LLM core: encoder/decoder layers stacked on a
+leading axis under ``lax.scan``; the greedy decode loop runs ON DEVICE as
+one ``lax.scan`` over a fixed token budget with a finished mask — a single
+dispatch per 30s audio chunk instead of a host round trip per token
+(decisive under dispatch latency; same rationale as engine/engine.py).
+Weights load from HF whisper checkpoints (model.encoder.conv1... naming).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# audio front-end constants (whisper convention)
+SAMPLE_RATE = 16000
+N_FFT = 400
+HOP = 160
+N_MELS = 80
+CHUNK_S = 30
+N_FRAMES = CHUNK_S * SAMPLE_RATE // HOP  # 3000
+
+
+@dataclass(frozen=True, eq=False)
+class WhisperSpec:
+    vocab_size: int = 51865
+    d_model: int = 384
+    n_audio_layers: int = 4
+    n_text_layers: int = 4
+    n_heads: int = 6
+    d_ff: int = 1536
+    max_source: int = N_FRAMES // 2  # after stride-2 conv
+    max_target: int = 448
+    norm_eps: float = 1e-5
+    # special ids (HF whisper tokenizer defaults)
+    sot: int = 50258
+    eot: int = 50257
+    no_timestamps: int = 50363
+    timestamp_begin: int = 50364
+    lang_base: int = 50259  # <|en|>
+    task_transcribe: int = 50359
+    task_translate: int = 50358
+
+    @property
+    def d_head(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def spec_from_hf_config(cfg: dict[str, Any]) -> WhisperSpec:
+    return WhisperSpec(
+        vocab_size=cfg.get("vocab_size", 51865),
+        d_model=cfg.get("d_model", 384),
+        n_audio_layers=cfg.get("encoder_layers", 4),
+        n_text_layers=cfg.get("decoder_layers", 4),
+        n_heads=cfg.get("encoder_attention_heads", 6),
+        d_ff=cfg.get("encoder_ffn_dim", 1536),
+        max_target=cfg.get("max_target_positions", 448),
+        sot=cfg.get("decoder_start_token_id", 50258),
+        eot=cfg.get("eos_token_id", 50257),
+    )
+
+
+def tiny_whisper_spec(**over: Any) -> WhisperSpec:
+    kw: dict[str, Any] = dict(
+        vocab_size=1000, d_model=64, n_audio_layers=2, n_text_layers=2,
+        n_heads=4, d_ff=128, max_target=64,
+        sot=997, eot=998, no_timestamps=999, timestamp_begin=999,
+        lang_base=996, task_transcribe=995, task_translate=994,
+    )
+    kw.update(over)
+    return WhisperSpec(**kw)
+
+
+# ---------------------------------------------------------------------------
+# audio front-end: log-mel spectrogram
+# ---------------------------------------------------------------------------
+
+
+def mel_filterbank(n_mels: int = N_MELS, n_fft: int = N_FFT,
+                   sr: int = SAMPLE_RATE) -> np.ndarray:
+    """Slaney-normalized mel filter matrix [n_mels, n_fft//2+1] (the
+    librosa convention whisper's feature extractor uses)."""
+
+    def hz_to_mel(f):
+        f = np.asarray(f, np.float64)
+        mel = 3.0 * f / 200.0
+        log_region = f >= 1000.0
+        mel = np.where(
+            log_region,
+            15.0 + np.log(np.maximum(f, 1e-10) / 1000.0) / (np.log(6.4) / 27.0),
+            mel,
+        )
+        return mel
+
+    def mel_to_hz(m):
+        m = np.asarray(m, np.float64)
+        f = 200.0 * m / 3.0
+        log_region = m >= 15.0
+        f = np.where(log_region, 1000.0 * np.exp((np.log(6.4) / 27.0) * (m - 15.0)), f)
+        return f
+
+    fft_freqs = np.fft.rfftfreq(n_fft, 1.0 / sr)
+    mel_pts = mel_to_hz(np.linspace(hz_to_mel(0.0), hz_to_mel(sr / 2), n_mels + 2))
+    weights = np.zeros((n_mels, len(fft_freqs)))
+    for i in range(n_mels):
+        lo, ctr, hi = mel_pts[i], mel_pts[i + 1], mel_pts[i + 2]
+        up = (fft_freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - fft_freqs) / max(hi - ctr, 1e-10)
+        weights[i] = np.maximum(0.0, np.minimum(up, down))
+        weights[i] *= 2.0 / (hi - lo)  # slaney area norm
+    return weights.astype(np.float32)
+
+
+_MEL: Optional[np.ndarray] = None
+
+
+def log_mel_spectrogram(audio: np.ndarray) -> np.ndarray:
+    """float PCM [n] -> log-mel [N_MELS, N_FRAMES] for one 30s chunk
+    (pad/trim), matching whisper's normalization."""
+    global _MEL
+    if _MEL is None:
+        _MEL = mel_filterbank()
+    n = CHUNK_S * SAMPLE_RATE
+    a = np.zeros(n, np.float32)
+    a[: min(len(audio), n)] = audio[:n]
+    window = np.hanning(N_FFT + 1)[:-1].astype(np.float32)
+    frames = np.lib.stride_tricks.sliding_window_view(
+        np.pad(a, (N_FFT // 2, N_FFT // 2), mode="reflect"), N_FFT
+    )[::HOP][:N_FRAMES]
+    stft = np.fft.rfft(frames * window, axis=-1)
+    power = np.abs(stft) ** 2
+    mel = _MEL @ power.T  # [N_MELS, frames]
+    logmel = np.log10(np.maximum(mel, 1e-10))
+    logmel = np.maximum(logmel, logmel.max() - 8.0)
+    return ((logmel + 4.0) / 4.0).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# parameters
+# ---------------------------------------------------------------------------
+
+
+def _sinusoids(length: int, channels: int) -> np.ndarray:
+    log_timescale = math.log(10000) / (channels // 2 - 1)
+    inv = np.exp(-log_timescale * np.arange(channels // 2))
+    t = np.arange(length)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(t), np.cos(t)], axis=1).astype(np.float32)
+
+
+def init_whisper_params(rng: jax.Array, spec: WhisperSpec,
+                        dtype: Any = jnp.float32) -> dict:
+    keys = iter(jax.random.split(rng, 40))
+
+    def dense(shape, scale=0.02):
+        return (jax.random.normal(next(keys), shape, jnp.float32)
+                * scale).astype(dtype)
+
+    D, F = spec.d_model, spec.d_ff
+    La, Lt = spec.n_audio_layers, spec.n_text_layers
+
+    def attn_block(L, cross=False):
+        p = {
+            "wq": dense((L, D, D)), "bq": jnp.zeros((L, D), dtype),
+            "wk": dense((L, D, D)),
+            "wv": dense((L, D, D)), "bv": jnp.zeros((L, D), dtype),
+            "wo": dense((L, D, D)), "bo": jnp.zeros((L, D), dtype),
+            "ln_w": jnp.ones((L, D), dtype), "ln_b": jnp.zeros((L, D), dtype),
+        }
+        return p
+
+    def mlp_block(L):
+        return {
+            "w_up": dense((L, D, F)), "b_up": jnp.zeros((L, F), dtype),
+            "w_down": dense((L, F, D)), "b_down": jnp.zeros((L, D), dtype),
+            "ln_w": jnp.ones((L, D), dtype), "ln_b": jnp.zeros((L, D), dtype),
+        }
+
+    return {
+        "conv1_w": dense((3, N_MELS, D)), "conv1_b": jnp.zeros((D,), dtype),
+        "conv2_w": dense((3, D, D)), "conv2_b": jnp.zeros((D,), dtype),
+        "enc_pos": jnp.asarray(_sinusoids(spec.max_source, D), dtype),
+        "enc_attn": attn_block(La),
+        "enc_mlp": mlp_block(La),
+        "enc_ln_w": jnp.ones((D,), dtype), "enc_ln_b": jnp.zeros((D,), dtype),
+        "tok_emb": dense((spec.vocab_size, D)),
+        "dec_pos": dense((spec.max_target, D)),
+        "dec_self": attn_block(Lt),
+        "dec_cross": attn_block(Lt),
+        "dec_mlp": mlp_block(Lt),
+        "dec_ln_w": jnp.ones((D,), dtype), "dec_ln_b": jnp.zeros((D,), dtype),
+    }
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _ln(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    return (((xf - mu) * lax.rsqrt(var + eps)) * w + b).astype(x.dtype)
+
+
+def _mha(spec, lp, q_in, kv_in, mask=None):
+    """Pre-LN omitted (caller); q/k/v projections per whisper (k has no
+    bias)."""
+    B, Tq, D = q_in.shape
+    Tk = kv_in.shape[1]
+    H, Dh = spec.n_heads, spec.d_head
+    q = (q_in @ lp["wq"] + lp["bq"]).reshape(B, Tq, H, Dh)
+    k = (kv_in @ lp["wk"]).reshape(B, Tk, H, Dh)
+    v = (kv_in @ lp["wv"] + lp["bv"]).reshape(B, Tk, H, Dh)
+    logits = jnp.einsum("bthd,bshd->bhts", q, k,
+                        preferred_element_type=jnp.float32)
+    logits = logits / math.sqrt(Dh)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, Tq, D).astype(q_in.dtype) @ lp["wo"] + lp["bo"]
+
+
+def encode_audio(spec: WhisperSpec, params: dict,
+                 mel: jax.Array) -> jax.Array:
+    """mel [B, N_MELS, N_FRAMES] -> encoder states [B, T_src, D]."""
+    x = mel.transpose(0, 2, 1)  # [B, frames, mels]
+    x = jax.nn.gelu(
+        lax.conv_general_dilated(
+            x, params["conv1_w"], (1,), "SAME",
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        ) + params["conv1_b"]
+    )
+    x = jax.nn.gelu(
+        lax.conv_general_dilated(
+            x, params["conv2_w"], (2,), "SAME",
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        ) + params["conv2_b"]
+    )
+    x = x + params["enc_pos"][None, : x.shape[1]]
+
+    def body(x, lp):
+        a, m = lp
+        h = _ln(x, a["ln_w"], a["ln_b"], spec.norm_eps)
+        x = x + _mha(spec, a, h, h)
+        h = _ln(x, m["ln_w"], m["ln_b"], spec.norm_eps)
+        x = x + jax.nn.gelu(h @ m["w_up"] + m["b_up"]) @ m["w_down"] + m["b_down"]
+        return x, None
+
+    x, _ = lax.scan(body, x, (params["enc_attn"], params["enc_mlp"]))
+    return _ln(x, params["enc_ln_w"], params["enc_ln_b"], spec.norm_eps)
+
+
+def decode_logits(spec: WhisperSpec, params: dict, tokens: jax.Array,
+                  enc: jax.Array) -> jax.Array:
+    """Teacher-forced decoder: tokens [B, T] -> logits [B, T, V]."""
+    B, T = tokens.shape
+    x = params["tok_emb"][tokens] + params["dec_pos"][None, :T]
+    pos = jnp.arange(T)
+    causal = (pos[None, None, :, None] >= pos[None, None, None, :])
+
+    def body(x, lp):
+        sa, ca, m = lp
+        h = _ln(x, sa["ln_w"], sa["ln_b"], spec.norm_eps)
+        x = x + _mha(spec, sa, h, h, mask=causal)
+        h = _ln(x, ca["ln_w"], ca["ln_b"], spec.norm_eps)
+        x = x + _mha(spec, ca, h, enc)
+        h = _ln(x, m["ln_w"], m["ln_b"], spec.norm_eps)
+        x = x + jax.nn.gelu(h @ m["w_up"] + m["b_up"]) @ m["w_down"] + m["b_down"]
+        return x, None
+
+    x, _ = lax.scan(
+        body, x, (params["dec_self"], params["dec_cross"], params["dec_mlp"])
+    )
+    x = _ln(x, params["dec_ln_w"], params["dec_ln_b"], spec.norm_eps)
+    return jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
+                      params["tok_emb"].astype(jnp.float32))
+
+
+@partial(jax.jit, static_argnums=(0, 3))
+def greedy_transcribe(spec: WhisperSpec, params: dict, mel: jax.Array,
+                      max_new: int, prompt: jax.Array) -> jax.Array:
+    """One on-device dispatch: encode + scan greedy decode.
+
+    prompt: [P] forced prefix (sot/lang/task/notimestamps). Returns
+    [max_new] generated ids (eot-padded). Teacher-forced full-sequence
+    logits each step would be O(T^2) — instead we re-run the decoder on
+    the fixed [P+max_new] buffer once per step via masked scan; for
+    whisper-scale targets (<=448) this single fused scan still beats
+    per-token host dispatch by orders of magnitude under RTT.
+    """
+    P = prompt.shape[0]
+    total = P + max_new
+    enc = encode_audio(spec, params, mel)
+    buf = jnp.full((1, total), spec.eot, jnp.int32)
+    buf = lax.dynamic_update_slice(buf, prompt[None], (0, 0))
+
+    def step(carry, i):
+        buf, done = carry
+        logits = decode_logits(spec, params, buf, enc)  # [1, total, V]
+        nxt = jnp.argmax(logits[0, P + i - 1], -1).astype(jnp.int32)
+        nxt = jnp.where(done, spec.eot, nxt)
+        buf = lax.dynamic_update_slice(buf, nxt[None, None], (0, P + i))
+        done = done | (nxt == spec.eot)
+        return (buf, done), nxt
+
+    (buf, _), toks = lax.scan(
+        step, (buf, jnp.zeros((), bool)),
+        jnp.arange(max_new, dtype=jnp.int32),
+    )
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# HF checkpoint loading
+# ---------------------------------------------------------------------------
+
+
+def load_whisper_params(model_dir: str, dtype: Any = jnp.float32
+                        ) -> tuple[WhisperSpec, dict]:
+    from .hf_loader import load_hf_state
+
+    config, get, names = load_hf_state(model_dir)
+    spec = spec_from_hf_config(config)
+    pre = "model." if "model.encoder.conv1.weight" in names else ""
+
+    def cast(a):
+        return jnp.asarray(a).astype(dtype)
+
+    def t(name):
+        return np.ascontiguousarray(get(name).T)
+
+    def stack(fmt, L, fn):
+        return cast(np.stack([fn(fmt.format(i=i)) for i in range(L)]))
+
+    La, Lt = spec.n_audio_layers, spec.n_text_layers
+
+    def attn(base, L, kind):
+        return {
+            "wq": stack(base + "{i}." + kind + ".q_proj.weight", L, t),
+            "bq": stack(base + "{i}." + kind + ".q_proj.bias", L, get),
+            "wk": stack(base + "{i}." + kind + ".k_proj.weight", L, t),
+            "wv": stack(base + "{i}." + kind + ".v_proj.weight", L, t),
+            "bv": stack(base + "{i}." + kind + ".v_proj.bias", L, get),
+            "wo": stack(base + "{i}." + kind + ".out_proj.weight", L, t),
+            "bo": stack(base + "{i}." + kind + ".out_proj.bias", L, get),
+            "ln_w": stack(
+                base + "{i}." + kind.replace("attn", "attn_layer_norm")
+                + ".weight", L, get),
+            "ln_b": stack(
+                base + "{i}." + kind.replace("attn", "attn_layer_norm")
+                + ".bias", L, get),
+        }
+
+    def mlp(base, L):
+        return {
+            "w_up": stack(base + "{i}.fc1.weight", L, t),
+            "b_up": stack(base + "{i}.fc1.bias", L, get),
+            "w_down": stack(base + "{i}.fc2.weight", L, t),
+            "b_down": stack(base + "{i}.fc2.bias", L, get),
+            "ln_w": stack(base + "{i}.final_layer_norm.weight", L, get),
+            "ln_b": stack(base + "{i}.final_layer_norm.bias", L, get),
+        }
+
+    e = f"{pre}encoder.layers."
+    d = f"{pre}decoder.layers."
+    # conv weights: torch [out, in, k] -> [k, in, out]
+    conv1 = get(f"{pre}encoder.conv1.weight").transpose(2, 1, 0)
+    conv2 = get(f"{pre}encoder.conv2.weight").transpose(2, 1, 0)
+    params = {
+        "conv1_w": cast(conv1), "conv1_b": cast(get(f"{pre}encoder.conv1.bias")),
+        "conv2_w": cast(conv2), "conv2_b": cast(get(f"{pre}encoder.conv2.bias")),
+        "enc_pos": cast(get(f"{pre}encoder.embed_positions.weight")),
+        "enc_attn": attn(e, La, "self_attn"),
+        "enc_mlp": mlp(e, La),
+        "enc_ln_w": cast(get(f"{pre}encoder.layer_norm.weight")),
+        "enc_ln_b": cast(get(f"{pre}encoder.layer_norm.bias")),
+        "tok_emb": cast(get(f"{pre}decoder.embed_tokens.weight")),
+        "dec_pos": cast(get(f"{pre}decoder.embed_positions.weight")),
+        "dec_self": attn(d, Lt, "self_attn"),
+        "dec_cross": attn(d, Lt, "encoder_attn"),
+        "dec_mlp": mlp(d, Lt),
+        "dec_ln_w": cast(get(f"{pre}decoder.layer_norm.weight")),
+        "dec_ln_b": cast(get(f"{pre}decoder.layer_norm.bias")),
+    }
+    return spec, params

@@ -52,9 +52,10 @@ def _interpret() -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _append_kernel(pos_ref, new_ref, page_in_ref, page_out_ref):
+def _append_kernel(pos_ref, new_ref, page_in_ref, page_out_ref, *,
+                   max_pos: int):
     b = pl.program_id(0)
-    off = pos_ref[b] % PAGE
+    off = jnp.minimum(pos_ref[b], max_pos) % PAGE
     # masked whole-page write: mosaic cannot do dynamic sublane-unaligned
     # stores (`ref[ds(off,1)] = ...` needs off % 8 == 0), a lane-wise select
     # costs nothing extra (the page is already resident in VMEM)
@@ -69,7 +70,12 @@ def paged_append(cache: jax.Array, new: jax.Array,
     Only the target page per slot is read+written (2*PAGE*F bytes/slot vs
     the whole cache row for a fused XLA DUS inside a scan)."""
     S, SEQ, F = cache.shape
-    page_map = lambda b, pos: (b, pos[b] // PAGE, 0)  # noqa: E731
+    # clamp like lax.dynamic_update_slice does: an out-of-range position
+    # (defensive — the engine guarantees pos < SEQ) writes at the last row
+    # instead of producing an out-of-range page index (undefined in mosaic)
+    page_map = (  # noqa: E731
+        lambda b, pos: (b, jnp.minimum(pos[b], SEQ - 1) // PAGE, 0)
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(S,),
@@ -82,7 +88,7 @@ def paged_append(cache: jax.Array, new: jax.Array,
         out_specs=pl.BlockSpec((1, PAGE, F), page_map),
     )
     return pl.pallas_call(
-        _append_kernel,
+        functools.partial(_append_kernel, max_pos=SEQ - 1),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(cache.shape, cache.dtype),
         input_output_aliases={2: 0},  # cache operand -> out (in-place page)

@@ -18,7 +18,7 @@ import uuid
 from aiohttp import web
 
 from .state import Application
-from . import openai_routes, localai_routes
+from . import media_routes, openai_routes, localai_routes
 
 log = logging.getLogger(__name__)
 
@@ -95,6 +95,16 @@ def build_app(state: Application) -> web.Application:
 
     openai_routes.register(app)
     localai_routes.register(app)
+    media_routes.register(app)
+
+    # static generated-content serving (ref: app.go:158-171)
+    import os
+
+    gen = state.config.generated_content_dir
+    os.makedirs(gen, exist_ok=True)
+    for mount in ("/generated-images", "/generated-audio",
+                  "/generated-videos"):
+        app.router.add_static(mount, gen)
 
     async def on_startup(app_):
         state.startup()

@@ -1,0 +1,61 @@
+"""Shared route helpers: model resolution, backend loading, busy marking.
+
+One implementation for every route module (openai/localai/media), so
+watchdog busy-accounting and error semantics cannot drift between
+endpoints (ref: middleware/request.go:47-111 model resolution;
+pkg/grpc/client.go watchdog mark/unmark around every RPC).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+from typing import Optional
+
+from aiohttp import web
+
+from ..config.model_config import ModelConfig, Usecase
+from ..workers.base import Backend
+from .state import Application
+
+
+def state_of(request: web.Request) -> Application:
+    return request.app["state"]
+
+
+def resolve_config(request: web.Request, name: Optional[str],
+                   usecase: Usecase) -> ModelConfig:
+    st = state_of(request)
+    cfg = st.config_loader.resolve(name, usecase)
+    if cfg is None:
+        raise web.HTTPNotFound(
+            reason=f"model '{name}' not found" if name
+            else "no model available")
+    return cfg
+
+
+async def load_backend(request: web.Request, cfg: ModelConfig) -> Backend:
+    st = state_of(request)
+    return await asyncio.get_running_loop().run_in_executor(
+        None, st.model_loader.load, cfg)
+
+
+async def acquire(request: web.Request, name: Optional[str],
+                  usecase: Usecase) -> tuple[ModelConfig, Backend]:
+    cfg = resolve_config(request, name, usecase)
+    return cfg, await load_backend(request, cfg)
+
+
+@contextlib.contextmanager
+def busy(st: Application, model_name: str):
+    """Watchdog busy window around an inference call (ref: the gRPC
+    client's watchdog Mark/UnMark pairing, pkg/grpc/client.go)."""
+    st.model_loader.mark_busy(model_name)
+    try:
+        yield
+    finally:
+        st.model_loader.mark_idle(model_name)
+
+
+async def run_blocking(fn, *args):
+    return await asyncio.get_running_loop().run_in_executor(None, fn, *args)

@@ -1,0 +1,173 @@
+"""OpenAI media endpoints: audio transcription/speech, image & video
+generation.
+
+Ref: core/http/routes/openai.go — /v1/audio/transcriptions (:104,
+endpoints/openai/transcription.go:79), /v1/audio/speech (:111),
+/v1/images/generations (:118, image.go 245); /video (routes/localai.go:64).
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import uuid
+
+from aiohttp import web
+
+from ..config.model_config import Usecase
+from .common import acquire, busy, run_blocking, state_of
+
+
+def register(app: web.Application) -> None:
+    r = app.router
+    for prefix in ("/v1", ""):
+        r.add_post(f"{prefix}/audio/transcriptions", transcriptions)
+        r.add_post(f"{prefix}/audio/speech", speech)
+        r.add_post(f"{prefix}/images/generations", images)
+    r.add_post("/video", video)
+
+
+_state = state_of
+_run = run_blocking
+_load = acquire
+
+
+async def transcriptions(request: web.Request) -> web.Response:
+    """multipart: file=<audio>, model, language, translate,
+    response_format (json|verbose_json|text)."""
+    st = _state(request)
+    reader = await request.multipart()
+    fields: dict[str, str] = {}
+    audio_path = None
+    while True:
+        part = await reader.next()
+        if part is None:
+            break
+        if part.name == "file":
+            os.makedirs(st.config.upload_dir, exist_ok=True)
+            fname = os.path.basename(part.filename or "audio.wav")
+            audio_path = os.path.join(
+                st.config.upload_dir, f"{uuid.uuid4().hex}-{fname}")
+            with open(audio_path, "wb") as f:
+                while True:
+                    chunk = await part.read_chunk()
+                    if not chunk:
+                        break
+                    f.write(chunk)
+        else:
+            fields[part.name] = (await part.read()).decode()
+    if audio_path is None:
+        raise web.HTTPBadRequest(reason="missing audio 'file' part")
+    try:
+        cfg, backend = await _load(
+            request, fields.get("model"), Usecase.TRANSCRIPT)
+
+        def call():
+            with busy(st, cfg.name):
+                return backend.audio_transcription(
+                    audio_path,
+                    language=fields.get("language", ""),
+                    translate=fields.get("translate", "") in ("1", "true"),
+                )
+
+        res = await _run(call)
+    finally:
+        try:
+            os.unlink(audio_path)
+        except OSError:
+            pass
+    fmt = fields.get("response_format", "json")
+    if fmt == "text":
+        return web.Response(text=res.text, content_type="text/plain")
+    out: dict = {"text": res.text}
+    if fmt == "verbose_json":
+        out["segments"] = [
+            {"id": s.id, "start": s.start, "end": s.end, "text": s.text,
+             "tokens": s.tokens}
+            for s in res.segments
+        ]
+        out["duration"] = res.segments[-1].end if res.segments else 0.0
+    return web.json_response(out)
+
+
+async def speech(request: web.Request) -> web.Response:
+    """OpenAI /v1/audio/speech: {model, input, voice} -> audio bytes."""
+    body = await request.json()
+    st = _state(request)
+    cfg, backend = await _load(request, body.get("model"), Usecase.TTS)
+    dst = os.path.join(st.config.generated_content_dir,
+                       f"speech-{uuid.uuid4().hex}.wav")
+
+    def call():
+        with busy(st, cfg.name):
+            return backend.tts(
+                text=body.get("input", ""),
+                voice=body.get("voice", "") or cfg.tts.voice,
+                dst=dst,
+            )
+
+    res = await _run(call)
+    if not res.success:
+        raise web.HTTPInternalServerError(reason=res.message)
+    return web.FileResponse(dst)
+
+
+async def images(request: web.Request) -> web.Response:
+    """OpenAI /v1/images/generations; b64_json or url response formats
+    (ref: endpoints/openai/image.go — url serves from generated dir)."""
+    body = await request.json()
+    st = _state(request)
+    cfg, backend = await _load(request, body.get("model"), Usecase.IMAGE)
+    size = body.get("size") or "256x256"
+    try:
+        w, h = (int(x) for x in size.lower().split("x"))
+    except ValueError:
+        raise web.HTTPBadRequest(reason=f"invalid size '{size}'")
+    n = int(body.get("n") or 1)
+    data = []
+    for _ in range(n):
+        fname = f"img-{uuid.uuid4().hex}.png"
+        dst = os.path.join(st.config.generated_content_dir, fname)
+
+        def call(dst=dst):
+            with busy(st, cfg.name):
+                return backend.generate_image(
+                    prompt=body.get("prompt", ""),
+                    negative_prompt=body.get("negative_prompt", ""),
+                    width=w, height=h, dst=dst,
+                    step=int(body.get("step") or 0) or None,
+                    seed=body.get("seed"),
+                )
+
+        res = await _run(call)
+        if not res.success:
+            raise web.HTTPInternalServerError(reason=res.message)
+        if (body.get("response_format") or "url") == "b64_json":
+            with open(dst, "rb") as f:
+                data.append({"b64_json": base64.b64encode(f.read()).decode()})
+        else:
+            data.append({"url": f"/generated-images/{fname}"})
+    import time as _time
+
+    return web.json_response({"created": int(_time.time()), "data": data})
+
+
+async def video(request: web.Request) -> web.Response:
+    """ref: routes/localai.go:64 POST /video; endpoints/localai/video.go."""
+    body = await request.json()
+    st = _state(request)
+    cfg, backend = await _load(request, body.get("model"), Usecase.VIDEO)
+    fname = f"video-{uuid.uuid4().hex}.mp4"
+    dst = os.path.join(st.config.generated_content_dir, fname)
+
+    def call():
+        with busy(st, cfg.name):
+            return backend.generate_video(
+                prompt=body.get("prompt", ""), dst=dst,
+                num_frames=int(body.get("num_frames") or 0) or None,
+            )
+
+    res = await _run(call)
+    if not res.success:
+        raise web.HTTPInternalServerError(reason=res.message)
+    return web.json_response({"url": f"/generated-videos/{fname}"})

@@ -1,0 +1,168 @@
+"""Whisper STT worker (ref: backend/go/transcribe/whisper for whisper.cpp,
+backend/python/faster-whisper/backend.py — gRPC `AudioTranscription`,
+served at POST /v1/audio/transcriptions, core/backend/transcript.go).
+
+Audio intake mirrors the reference's ffmpeg conversion path
+(pkg/utils/ffmpeg.go:55): non-WAV inputs are shelled through ffmpeg to
+16kHz mono PCM when available; WAV is decoded natively.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import wave
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.whisper import (
+    CHUNK_S, SAMPLE_RATE, WhisperSpec, greedy_transcribe,
+    load_whisper_params, log_mel_spectrogram,
+)
+from .base import (
+    Backend, ModelLoadOptions, Result, StatusResponse, TranscriptResult,
+    TranscriptSegment,
+)
+
+
+def load_pcm(path: str) -> np.ndarray:
+    """Decode an audio file to float32 mono 16kHz PCM."""
+    if path.lower().endswith(".wav"):
+        with wave.open(path) as w:
+            sr = w.getframerate()
+            n_ch = w.getnchannels()
+            width = w.getsampwidth()
+            raw = w.readframes(w.getnframes())
+        if width == 1:  # 8-bit WAV is UNSIGNED, silence at 128
+            pcm = (np.frombuffer(raw, np.uint8).astype(np.float32)
+                   - 128.0) / 128.0
+        elif width == 2:
+            pcm = np.frombuffer(raw, np.int16).astype(np.float32) / 32768.0
+        elif width == 3:  # 24-bit packed little-endian
+            b = np.frombuffer(raw, np.uint8).reshape(-1, 3)
+            val = (b[:, 0].astype(np.int32)
+                   | (b[:, 1].astype(np.int32) << 8)
+                   | (b[:, 2].astype(np.int32) << 16))
+            val = np.where(val >= 1 << 23, val - (1 << 24), val)
+            pcm = val.astype(np.float32) / float(1 << 23)
+        elif width == 4:
+            pcm = np.frombuffer(raw, np.int32).astype(np.float32) / float(
+                1 << 31)
+        else:
+            raise ValueError(f"unsupported WAV sample width {width}")
+        if n_ch > 1:
+            pcm = pcm.reshape(-1, n_ch).mean(axis=1)
+        if sr != SAMPLE_RATE:
+            idx = np.linspace(0, len(pcm) - 1, int(len(pcm) * SAMPLE_RATE / sr))
+            pcm = np.interp(idx, np.arange(len(pcm)), pcm).astype(np.float32)
+        return pcm
+    # non-wav: ffmpeg shell-out (ref: utils/ffmpeg.go audioToWav)
+    out = subprocess.run(
+        ["ffmpeg", "-i", path, "-f", "f32le", "-ac", "1",
+         "-ar", str(SAMPLE_RATE), "-"],
+        capture_output=True, check=True,
+    )
+    return np.frombuffer(out.stdout, np.float32)
+
+
+class JaxWhisperBackend(Backend):
+    def __init__(self) -> None:
+        self.spec: Optional[WhisperSpec] = None
+        self.params = None
+        self.tokenizer = None
+        self._state = "UNINITIALIZED"
+        self._lock = threading.Lock()
+
+    def load_model(self, opts: ModelLoadOptions) -> Result:
+        with self._lock:
+            try:
+                model_dir = opts.model
+                if not os.path.isabs(model_dir):
+                    model_dir = os.path.join(opts.model_path or "", model_dir)
+                if not os.path.isdir(model_dir):
+                    raise FileNotFoundError(
+                        f"model directory not found: {model_dir}")
+                self.spec, self.params = load_whisper_params(model_dir)
+                try:
+                    from transformers import AutoTokenizer
+
+                    self.tokenizer = AutoTokenizer.from_pretrained(model_dir)
+                except Exception:
+                    self.tokenizer = None
+                self._state = "READY"
+                return Result(True, "whisper model loaded")
+            except Exception as e:
+                self._state = "ERROR"
+                return Result(False, f"load failed: {e}")
+
+    def health(self) -> bool:
+        return self._state == "READY"
+
+    def status(self) -> StatusResponse:
+        return StatusResponse(state=self._state)
+
+    def shutdown(self) -> None:
+        self.spec = self.params = self.tokenizer = None
+        self._state = "UNINITIALIZED"
+
+    # ---------------------------------------------------------- transcribe
+
+    def _prompt(self, language: str, translate: bool) -> list[int]:
+        sp = self.spec
+        lang_id = None
+        if self.tokenizer is not None and language:
+            lid = self.tokenizer.convert_tokens_to_ids(f"<|{language}|>")
+            if lid is not None and lid != getattr(
+                    self.tokenizer, "unk_token_id", None):
+                lang_id = lid
+        ids = [sp.sot]
+        ids.append(lang_id if lang_id is not None else sp.lang_base)
+        ids.append(sp.task_translate if translate else sp.task_transcribe)
+        ids.append(sp.no_timestamps)
+        return ids
+
+    def _decode_text(self, ids: list[int]) -> str:
+        sp = self.spec
+        clean = [i for i in ids if i < sp.eot or (
+            sp.eot < sp.sot and i < sp.sot)]
+        clean = [i for i in clean if i not in (sp.sot, sp.eot)
+                 and not (sp.timestamp_begin <= i)]
+        if self.tokenizer is not None:
+            return self.tokenizer.decode(clean, skip_special_tokens=True)
+        return " ".join(str(i) for i in clean)
+
+    def audio_transcription(self, audio_path: str, language: str = "",
+                            translate: bool = False) -> TranscriptResult:
+        if self._state != "READY":
+            raise RuntimeError("model not loaded")
+        pcm = load_pcm(audio_path)
+        duration = len(pcm) / SAMPLE_RATE
+        prompt = jnp.asarray(self._prompt(language, translate), jnp.int32)
+        segments: list[TranscriptSegment] = []
+        texts = []
+        chunk = CHUNK_S * SAMPLE_RATE
+        n_chunks = max(1, (len(pcm) + chunk - 1) // chunk)
+        max_new = min(224, self.spec.max_target - prompt.shape[0] - 1)
+        for ci in range(n_chunks):
+            mel = log_mel_spectrogram(pcm[ci * chunk : (ci + 1) * chunk])
+            toks = greedy_transcribe(
+                self.spec, self.params, jnp.asarray(mel)[None],
+                max_new, prompt,
+            )
+            ids = [int(t) for t in np.asarray(toks)]
+            if self.spec.eot in ids:
+                ids = ids[: ids.index(self.spec.eot)]
+            text = self._decode_text(ids).strip()
+            start = ci * CHUNK_S
+            end = min((ci + 1) * CHUNK_S, duration)
+            segments.append(TranscriptSegment(
+                id=ci, start=float(start), end=float(end), text=text,
+                tokens=ids,
+            ))
+            texts.append(text)
+        return TranscriptResult(segments=segments, text=" ".join(
+            t for t in texts if t).strip())

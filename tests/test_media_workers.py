@@ -1,0 +1,133 @@
+"""Whisper STT and diffusion image-gen workers (SURVEY.md §2.3/§2.4 media
+backend coverage): HF-checkpoint parity for whisper, full-pipeline smoke
+for diffusion."""
+
+import os
+import wave
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from localai_tfp_tpu.models.whisper import (
+    decode_logits, encode_audio, load_whisper_params, log_mel_spectrogram,
+)
+from localai_tfp_tpu.workers.base import ModelLoadOptions
+from localai_tfp_tpu.workers.diffusion import JaxDiffusionBackend, write_png
+from localai_tfp_tpu.workers.whisper import JaxWhisperBackend, load_pcm
+
+
+@pytest.fixture(scope="module")
+def whisper_dir(tmp_path_factory):
+    import torch
+    from transformers import WhisperConfig, WhisperForConditionalGeneration
+
+    torch.manual_seed(0)
+    d = tmp_path_factory.mktemp("whisper")
+    cfg = WhisperConfig(
+        vocab_size=1000, d_model=64, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=128, decoder_ffn_dim=128,
+        max_source_positions=1500, max_target_positions=448,
+        num_mel_bins=80, decoder_start_token_id=997, eos_token_id=998,
+        pad_token_id=998, bos_token_id=998,
+    )
+    WhisperForConditionalGeneration(cfg).save_pretrained(
+        d, safe_serialization=True)
+    return str(d)
+
+
+def _wav(path, seconds=1.0, freq=440.0):
+    sr = 16000
+    t = np.arange(int(sr * seconds)) / sr
+    pcm = (0.4 * np.sin(2 * np.pi * freq * t) * 32767).astype("<i2")
+    with wave.open(path, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(sr)
+        w.writeframes(pcm.tobytes())
+
+
+def test_whisper_matches_torch(whisper_dir):
+    import torch
+    from transformers import WhisperForConditionalGeneration
+
+    spec, params = load_whisper_params(whisper_dir)
+    rng = np.random.default_rng(0)
+    mel = rng.standard_normal((1, 80, 3000)).astype(np.float32) * 0.1
+    dec_ids = np.array([[997, 5, 9, 11]], np.int64)
+
+    enc = encode_audio(spec, params, jnp.asarray(mel))
+    ours = np.asarray(decode_logits(
+        spec, params, jnp.asarray(dec_ids, jnp.int32), enc))
+
+    ref = WhisperForConditionalGeneration.from_pretrained(whisper_dir).eval()
+    with torch.no_grad():
+        theirs = ref(
+            input_features=torch.tensor(mel),
+            decoder_input_ids=torch.tensor(dec_ids),
+        ).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=3e-3, atol=3e-3)
+
+
+def test_whisper_backend_transcribes(whisper_dir, tmp_path):
+    b = JaxWhisperBackend()
+    res = b.load_model(ModelLoadOptions(model=whisper_dir))
+    assert res.success, res.message
+    wav = str(tmp_path / "t.wav")
+    _wav(wav, seconds=0.5)
+    out = b.audio_transcription(wav)
+    assert len(out.segments) == 1
+    assert out.segments[0].start == 0.0
+    assert abs(out.segments[0].end - 0.5) < 0.05
+    assert isinstance(out.text, str)
+
+
+def test_load_pcm_resamples(tmp_path):
+    path = str(tmp_path / "a.wav")
+    sr = 8000
+    t = np.arange(sr) / sr
+    pcm = (0.2 * np.sin(2 * np.pi * 100 * t) * 32767).astype("<i2")
+    with wave.open(path, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(sr)
+        w.writeframes(pcm.tobytes())
+    out = load_pcm(path)
+    assert abs(len(out) - 16000) <= 2
+
+
+def test_log_mel_shape():
+    mel = log_mel_spectrogram(np.zeros(16000, np.float32))
+    assert mel.shape == (80, 3000)
+    assert np.isfinite(mel).all()
+
+
+def test_diffusion_generates_png(tmp_path):
+    b = JaxDiffusionBackend()
+    assert b.load_model(ModelLoadOptions(options=["steps=2"])).success
+    dst = str(tmp_path / "img.png")
+    res = b.generate_image(prompt="a red square", width=32, height=32,
+                           dst=dst, seed=7)
+    assert res.success
+    data = open(dst, "rb").read()
+    assert data[:8] == b"\x89PNG\r\n\x1a\n"
+    # deterministic for a fixed seed
+    dst2 = str(tmp_path / "img2.png")
+    b.generate_image(prompt="a red square", width=32, height=32,
+                     dst=dst2, seed=7)
+    assert open(dst2, "rb").read() == data
+
+
+def test_write_png_roundtrip(tmp_path):
+    img = np.arange(4 * 4 * 3, dtype=np.uint8).reshape(4, 4, 3)
+    p = str(tmp_path / "x.png")
+    write_png(p, img)
+    try:
+        from PIL import Image
+
+        back = np.asarray(Image.open(p).convert("RGB"))
+        np.testing.assert_array_equal(back, img)
+    except ImportError:
+        assert open(p, "rb").read()[:4] == b"\x89PNG"
