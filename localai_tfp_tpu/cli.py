@@ -1,0 +1,328 @@
+"""Command-line interface.
+
+Ref: core/cli — subcommand tree cli.go:8-21 (run / federated / models /
+tts / sound-generation / transcript / worker / util / explorer) and the
+~50 env-bound run flags (run.go:19-73; every flag has a LOCALAI_* env
+alias, main.go:36-52 .env autoload).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+
+def _load_dotenv() -> None:
+    """.env autoload from cwd / $HOME / /etc/localai.env
+    (ref: main.go:36-52)."""
+    for path in (".env", "localai.env",
+                 os.path.expanduser("~/.config/localai.env"),
+                 "/etc/localai.env"):
+        if not os.path.isfile(path):
+            continue
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#") or "=" not in line:
+                    continue
+                k, _, v = line.partition("=")
+                os.environ.setdefault(k.strip(), v.strip().strip('"'))
+        break
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="localai-tpu",
+        description="TPU-native LocalAI-compatible inference server",
+    )
+    sub = p.add_subparsers(dest="command")
+
+    run = sub.add_parser("run", help="start the API server")
+    run.add_argument("models", nargs="*",
+                     help="models to preload (gallery name, URL, or path)")
+    run.add_argument("--models-path", default=None)
+    run.add_argument("--address", default=None)
+    run.add_argument("--port", type=int, default=None)
+    run.add_argument("--api-keys", default=None,
+                     help="comma-separated API keys")
+    run.add_argument("--context-size", type=int, default=None)
+    run.add_argument("--threads", type=int, default=None)
+    run.add_argument("--galleries", default=None,
+                     help='JSON list [{"name":..,"url":..}]')
+    run.add_argument("--single-active-backend", action="store_true")
+    run.add_argument("--parallel-requests", action="store_true")
+    run.add_argument("--enable-watchdog-idle", action="store_true")
+    run.add_argument("--enable-watchdog-busy", action="store_true")
+    run.add_argument("--watchdog-idle-timeout", type=float, default=None)
+    run.add_argument("--watchdog-busy-timeout", type=float, default=None)
+    run.add_argument("--upload-limit", type=int, default=None)
+    run.add_argument("--disable-metrics", action="store_true")
+    run.add_argument("--opaque-errors", action="store_true")
+    run.add_argument("--machine-tag", default=None)
+    run.add_argument("--debug", action="store_true")
+    run.add_argument("--mesh", default=None,
+                     help="device mesh, e.g. data=2,model=4")
+    run.add_argument("--p2p-token", default=None)
+    run.add_argument("--federated-server", default=None,
+                     help="balancer URL to announce this instance to")
+    run.add_argument("--advertise-address", default=None)
+
+    models = sub.add_parser("models", help="list or install models")
+    msub = models.add_subparsers(dest="models_command")
+    mlist = msub.add_parser("list", help="list installed + gallery models")
+    mlist.add_argument("--models-path", default=None)
+    mlist.add_argument("--galleries", default=None)
+    minst = msub.add_parser("install", help="install a model")
+    minst.add_argument("name", help="gallery model name or config URL")
+    minst.add_argument("--models-path", default=None)
+    minst.add_argument("--galleries", default=None)
+
+    tts = sub.add_parser("tts", help="synthesize speech to a WAV")
+    tts.add_argument("text", nargs="+")
+    tts.add_argument("--model", default="")
+    tts.add_argument("--voice", default="")
+    tts.add_argument("--output-file", default="tts.wav")
+    tts.add_argument("--models-path", default=None)
+
+    sg = sub.add_parser("sound-generation", help="generate a sound effect")
+    sg.add_argument("text", nargs="+")
+    sg.add_argument("--model", default="")
+    sg.add_argument("--output-file", default="sound.wav")
+    sg.add_argument("--duration", type=float, default=3.0)
+    sg.add_argument("--models-path", default=None)
+
+    tr = sub.add_parser("transcript", help="transcribe an audio file")
+    tr.add_argument("filename")
+    tr.add_argument("--model", default="")
+    tr.add_argument("--language", default="")
+    tr.add_argument("--translate", action="store_true")
+    tr.add_argument("--models-path", default=None)
+
+    fed = sub.add_parser("federated",
+                         help="run the federation load balancer")
+    fed.add_argument("--address", default="0.0.0.0")
+    fed.add_argument("--port", type=int, default=8080)
+    fed.add_argument("--p2p-token", default=None)
+    fed.add_argument("--strategy", default="least-used",
+                     choices=["least-used", "random"])
+
+    worker = sub.add_parser(
+        "worker", help="run a worker that joins a federation")
+    worker.add_argument("--p2p-token", required=False, default=None)
+    worker.add_argument("--federated-server", required=True)
+    worker.add_argument("--port", type=int, default=8081)
+    worker.add_argument("--models-path", default=None)
+
+    util = sub.add_parser("util", help="utilities")
+    usub = util.add_subparsers(dest="util_command")
+    usub.add_parser("version")
+    usub.add_parser("new-token", help="generate a federation join token")
+
+    return p
+
+
+def _app_config(args) -> "ApplicationConfig":
+    from .config.app_config import ApplicationConfig
+
+    cfg = ApplicationConfig.from_env()
+    mapping = {
+        "models_path": "models_path", "address": "address", "port": "port",
+        "context_size": "context_size", "threads": "threads",
+        "watchdog_idle_timeout": "watchdog_idle_timeout",
+        "watchdog_busy_timeout": "watchdog_busy_timeout",
+        "upload_limit": "upload_limit_mb", "machine_tag": "machine_tag",
+        "p2p_token": "p2p_token", "federated_server": "federated_server_url",
+        "advertise_address": "advertise_address",
+    }
+    for arg_name, cfg_name in mapping.items():
+        v = getattr(args, arg_name, None)
+        if v is not None:
+            setattr(cfg, cfg_name, v)
+    for flag in ("single_active_backend", "enable_watchdog_idle",
+                 "enable_watchdog_busy", "disable_metrics",
+                 "opaque_errors", "debug"):
+        if getattr(args, flag, False):
+            setattr(cfg, flag, True)
+    if getattr(args, "parallel_requests", False):
+        cfg.parallel_requests = True
+    if getattr(args, "api_keys", None):
+        cfg.api_keys = [k.strip() for k in args.api_keys.split(",")]
+    if getattr(args, "galleries", None):
+        cfg.galleries = json.loads(args.galleries)
+    if getattr(args, "mesh", None):
+        cfg.mesh_shape = {
+            k: int(v) for k, v in
+            (kv.split("=") for kv in args.mesh.split(","))
+        }
+    if getattr(args, "models", None):
+        cfg.preload_models = list(args.models)
+    return cfg
+
+
+def _galleries(args) -> list[dict]:
+    if getattr(args, "galleries", None):
+        return json.loads(args.galleries)
+    env = os.environ.get("LOCALAI_GALLERIES") or os.environ.get("GALLERIES")
+    return json.loads(env) if env else []
+
+
+def _load_backend_for(args, usecase_attr: str):
+    """Boot a minimal Application and load the model for a one-shot CLI
+    task (ref: core/cli/tts.go, transcript.go pattern)."""
+    from .config.model_config import Usecase
+    from .server.state import Application
+
+    cfg = _app_config(args)
+    app = Application(cfg)
+    app.startup()
+    mcfg = app.config_loader.resolve(
+        getattr(args, "model", "") or None, getattr(Usecase, usecase_attr))
+    if mcfg is None:
+        sys.exit(f"error: no model available for {usecase_attr.lower()}")
+    return app, app.model_loader.load(mcfg), mcfg
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    _load_dotenv()
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.command in (None, "run"):
+        if args.command is None:
+            args = parser.parse_args(["run"])
+        from .server.app import run as run_server
+        from .server.state import Application
+
+        cfg = _app_config(args)
+        state = Application(cfg)
+        _preload(state, cfg.preload_models)
+        run_server(state)
+
+    elif args.command == "models":
+        _cmd_models(args)
+
+    elif args.command == "tts":
+        app, backend, mcfg = _load_backend_for(args, "TTS")
+        res = backend.tts(" ".join(args.text), voice=args.voice,
+                          dst=args.output_file)
+        print(res.message if res.success else f"error: {res.message}")
+
+    elif args.command == "sound-generation":
+        app, backend, mcfg = _load_backend_for(args, "SOUND_GENERATION")
+        res = backend.sound_generation(
+            " ".join(args.text), dst=args.output_file,
+            duration=args.duration)
+        print(res.message if res.success else f"error: {res.message}")
+
+    elif args.command == "transcript":
+        app, backend, mcfg = _load_backend_for(args, "TRANSCRIPT")
+        out = backend.audio_transcription(
+            args.filename, language=args.language,
+            translate=args.translate)
+        for seg in out.segments:
+            print(f"[{seg.start:7.2f} - {seg.end:7.2f}] {seg.text}")
+
+    elif args.command == "federated":
+        from aiohttp import web as _web
+
+        from .parallel.federated import FederatedServer, generate_token
+
+        token = args.p2p_token or os.environ.get("LOCALAI_P2P_TOKEN") \
+            or os.environ.get("TOKEN")
+        if not token:
+            token = generate_token()
+            print(f"generated federation token:\n{token}")
+        srv = FederatedServer(token, strategy=args.strategy)
+        _web.run_app(srv.build_app(), host=args.address, port=args.port)
+
+    elif args.command == "worker":
+        # a worker IS a full instance that announces itself to the balancer
+        from .server.app import run as run_server
+        from .server.state import Application
+
+        cfg = _app_config(args)
+        cfg.port = args.port
+        cfg.federated_server_url = args.federated_server
+        if args.p2p_token:
+            cfg.p2p_token = args.p2p_token
+        if not cfg.p2p_token:
+            sys.exit("error: worker needs --p2p-token (or LOCALAI_P2P_TOKEN)"
+                     " to join a federation")
+        run_server(Application(cfg))
+
+    elif args.command == "util":
+        if args.util_command == "new-token":
+            from .parallel.federated import generate_token
+
+            print(generate_token())
+        else:
+            from .version import __version__
+
+            print(__version__)
+
+
+def _cmd_models(args) -> None:
+    from .config.app_config import ApplicationConfig
+    from .gallery.service import GalleryOp, GalleryService
+
+    base = ApplicationConfig.from_env()
+    mp = getattr(args, "models_path", None) or base.models_path
+    svc = GalleryService(mp, _galleries(args))
+    if args.models_command == "install":
+        import time
+
+        name = args.name
+        op = (GalleryOp(config_url=name) if "://" in name or
+              name.endswith((".yaml", ".yml")) else
+              GalleryOp(gallery_model_name=name))
+        job = svc.submit(op)
+        while True:
+            st = svc.status(job)
+            if st and st.processed:
+                break
+            if st:
+                print(f"\r{st.progress:5.1f}%", end="", flush=True)
+            time.sleep(0.2)
+        print()
+        if st.error:
+            sys.exit(f"error: {st.error}")
+        print("installed")
+    else:  # list
+        import os as _os
+
+        installed = sorted(
+            _os.path.splitext(f)[0] for f in (_os.listdir(mp)
+                                              if _os.path.isdir(mp) else [])
+            if f.endswith((".yaml", ".yml")))
+        print("installed models:")
+        for n in installed:
+            print(f"  * {n}")
+        avail = svc.available_models()
+        if avail:
+            print("gallery models:")
+            for m in avail:
+                mark = "*" if m.installed else " "
+                print(f"  {mark} {m.name} — {m.description[:60]}")
+
+
+def _preload(state, models: list[str]) -> None:
+    """ref: pkg/startup/model_preload.go InstallModels — gallery name /
+    URL / embedded config resolution for CLI model args."""
+    from .gallery.service import GalleryOp
+
+    for m in models:
+        mp = state.config.models_path
+        if (os.path.exists(os.path.join(mp, m))
+                or os.path.exists(os.path.join(mp, f"{m}.yaml"))
+                or state.config_loader.get(m) is not None):
+            continue  # already installed (config present)
+        op = (GalleryOp(config_url=m) if "://" in m
+              else GalleryOp(gallery_model_name=m))
+        state.gallery.submit(op, config_loader=state.config_loader)
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,11 @@ class ApplicationConfig:
     disable_metrics: bool = False
     opaque_errors: bool = False
     machine_tag: str = ""
+    # federation (ref: run.go p2p flags; core/p2p token/network id)
+    p2p_token: str = ""
+    federated_server_url: str = ""  # balancer to announce to
+    advertise_address: str = ""  # how the balancer should reach us
+    node_name: str = ""
     # TPU-native:
     mesh_shape: dict[str, int] = field(default_factory=dict)
     compilation_cache_dir: str = ""
@@ -86,6 +91,12 @@ class ApplicationConfig:
         cfg.compilation_cache_dir = _env(
             "COMPILATION_CACHE_DIR", cfg.compilation_cache_dir
         )
+        cfg.p2p_token = _env("P2P_TOKEN", cfg.p2p_token)
+        cfg.federated_server_url = _env(
+            "FEDERATED_SERVER", cfg.federated_server_url)
+        cfg.advertise_address = _env(
+            "ADVERTISE_ADDRESS", cfg.advertise_address)
+        cfg.node_name = _env("NODE_NAME", cfg.node_name)
         return cfg
 
     def ensure_dirs(self) -> None:

@@ -172,7 +172,8 @@ class Usecase(enum.IntFlag):
 
 
 # Backends that serve text-generation usecases by default.
-_LLM_BACKENDS = {"jax-llm", "llama", "vllm", "transformers", ""}
+_LLM_BACKENDS = {"jax-llm", "llama", "llama-cpp", "llama-grpc", "vllm",
+                 "transformers", "exllama2", ""}
 
 
 @dataclass
@@ -314,17 +315,20 @@ class ModelConfig:
     def _guess_usecases(self) -> Usecase:
         flags = Usecase.ANY
         b = (self.backend or "").lower()
-        if self.embeddings or b in ("sentencetransformers", "embeddings"):
+        if self.embeddings or b in ("sentencetransformers", "embeddings",
+                                    "huggingface-embeddings",
+                                    "jax-embeddings"):
             flags |= Usecase.EMBEDDINGS
-        if b in ("rerankers", "rerank"):
+        if b in ("rerankers", "rerank", "jax-rerank"):
             flags |= Usecase.RERANK
-        if b in ("diffusers", "stablediffusion", "flux"):
+        if b in ("diffusers", "stablediffusion", "flux", "jax-diffusion"):
             flags |= Usecase.IMAGE | Usecase.VIDEO
-        if b in ("whisper", "faster-whisper"):
+        if b in ("whisper", "faster-whisper", "jax-whisper"):
             flags |= Usecase.TRANSCRIPT
-        if b in ("tts", "piper", "bark", "coqui", "kokoro"):
+        if b in ("tts", "piper", "bark", "bark-cpp", "coqui", "kokoro",
+                 "jax-tts"):
             flags |= Usecase.TTS | Usecase.SOUND_GENERATION
-        if b in ("silero-vad", "vad"):
+        if b in ("silero-vad", "vad", "jax-vad"):
             flags |= Usecase.VAD
         if b in _LLM_BACKENDS:
             flags |= (

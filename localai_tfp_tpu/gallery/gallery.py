@@ -1,0 +1,154 @@
+"""Model gallery: marketplace index -> installed model configs.
+
+Ref: core/gallery — GalleryModel schema (models.go:44-100), install =
+download files w/ sha256 + progress + write config with mergo-style
+overrides (InstallModel), delete; gallery list YAML fetched from
+gallery.url; pkg/startup/model_preload.go resolves CLI model args
+(gallery name / URL / local).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import yaml
+
+from .downloader import URI, ProgressCb
+
+
+@dataclass
+class GalleryFile:
+    filename: str
+    uri: str
+    sha256: str = ""
+
+
+@dataclass
+class GalleryModel:
+    """One marketplace entry (ref: core/gallery/gallery.go GalleryModel)."""
+
+    name: str
+    description: str = ""
+    license: str = ""
+    urls: list[str] = field(default_factory=list)
+    tags: list[str] = field(default_factory=list)
+    gallery_name: str = ""
+    # config: inline dict, or a URL to a YAML config
+    config: dict = field(default_factory=dict)
+    config_url: str = ""
+    files: list[GalleryFile] = field(default_factory=list)
+    overrides: dict = field(default_factory=dict)
+    installed: bool = False
+
+    @classmethod
+    def from_dict(cls, d: dict, gallery_name: str = "") -> "GalleryModel":
+        files = [
+            GalleryFile(
+                filename=f.get("filename", ""),
+                uri=f.get("uri", "") or f.get("url", ""),
+                sha256=f.get("sha256", "") or f.get("sha", ""),
+            )
+            for f in d.get("files") or []
+        ]
+        return cls(
+            name=d.get("name", ""),
+            description=d.get("description", ""),
+            license=d.get("license", ""),
+            urls=list(d.get("urls") or []),
+            tags=list(d.get("tags") or []),
+            gallery_name=gallery_name,
+            config=dict(d.get("config") or {}),
+            config_url=d.get("config_url", "") or d.get("url", ""),
+            files=files,
+            overrides=dict(d.get("overrides") or {}),
+        )
+
+
+def _deep_merge(base: dict, over: dict) -> dict:
+    """mergo-equivalent: override wins, dicts merge recursively
+    (ref: gallery/models.go apply overrides via mergo)."""
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def load_gallery_index(url: str, gallery_name: str = "") -> list[GalleryModel]:
+    """Fetch a gallery index YAML (list of models)."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        path = URI(url).download(os.path.join(td, "index.yaml"))
+        with open(path) as f:
+            docs = yaml.safe_load(f) or []
+    return [GalleryModel.from_dict(d, gallery_name) for d in docs
+            if isinstance(d, dict)]
+
+
+def install_model(
+    model: GalleryModel,
+    models_path: str,
+    *,
+    name_override: str = "",
+    extra_overrides: Optional[dict] = None,
+    progress: Optional[ProgressCb] = None,
+) -> str:
+    """Download files + write the model's config YAML; returns the config
+    path (ref: core/gallery/models.go InstallModel)."""
+    os.makedirs(models_path, exist_ok=True)
+    total = len(model.files)
+    for i, f in enumerate(model.files):
+        dst = os.path.join(models_path, f.filename)
+        if os.path.sep in f.filename or f.filename.startswith("."):
+            raise ValueError(f"unsafe gallery filename: {f.filename}")
+
+        def scaled(done, tot, i=i):
+            if progress and tot:
+                progress(int((i + done / tot) / max(total, 1) * 100), 100)
+
+        URI(f.uri).download(dst, sha256=f.sha256, progress=scaled)
+
+    cfg = dict(model.config)
+    if not cfg and model.config_url:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            p = URI(model.config_url).download(os.path.join(td, "cfg.yaml"))
+            with open(p) as fh:
+                cfg = yaml.safe_load(fh) or {}
+    cfg = _deep_merge(cfg, model.overrides)
+    if extra_overrides:
+        cfg = _deep_merge(cfg, extra_overrides)
+    name = name_override or cfg.get("name") or model.name
+    cfg["name"] = name
+    cfg_path = os.path.join(models_path, f"{name}.yaml")
+    with open(cfg_path, "w") as fh:
+        yaml.safe_dump(cfg, fh, sort_keys=False)
+    if progress:
+        progress(100, 100)
+    return cfg_path
+
+
+def delete_model(name: str, models_path: str) -> bool:
+    """Remove a model's config + the files it references
+    (ref: core/gallery DeleteModelFromSystem)."""
+    cfg_path = os.path.join(models_path, f"{name}.yaml")
+    if not os.path.exists(cfg_path):
+        return False
+    try:
+        with open(cfg_path) as f:
+            cfg = yaml.safe_load(f) or {}
+    except Exception:
+        cfg = {}
+    os.unlink(cfg_path)
+    model_file = (cfg.get("parameters") or {}).get("model") or cfg.get("model")
+    if model_file and os.path.sep not in str(model_file):
+        p = os.path.join(models_path, str(model_file))
+        if os.path.isfile(p):
+            os.unlink(p)
+    return True

@@ -1,0 +1,150 @@
+"""Async gallery job queue (ref: core/services/gallery.go:18-120 —
+GalleryService: op channel, per-job status map with progress/error,
+UpdateStatus/GetStatus/GetAllStatus).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .gallery import (
+    GalleryModel, delete_model, install_model, load_gallery_index,
+)
+
+
+@dataclass
+class JobStatus:
+    """ref: gallery.GalleryOpStatus."""
+
+    deletion: bool = False
+    file_name: str = ""
+    error: str = ""
+    processed: bool = False
+    message: str = ""
+    progress: float = 0.0
+    gallery_model_name: str = ""
+
+
+@dataclass
+class GalleryOp:
+    """ref: services/gallery.go GalleryOp."""
+
+    id: str = field(default_factory=lambda: uuid.uuid4().hex)
+    gallery_model_name: str = ""
+    delete: bool = False
+    config_url: str = ""
+    overrides: dict = field(default_factory=dict)
+
+
+class GalleryService:
+    def __init__(self, models_path: str,
+                 galleries: Optional[list[dict]] = None) -> None:
+        self.models_path = models_path
+        self.galleries = list(galleries or [])
+        self._status: dict[str, JobStatus] = {}
+        self._lock = threading.Lock()
+        self._index_cache: Optional[list[GalleryModel]] = None
+
+    # ------------------------------------------------------------ catalog
+
+    def available_models(self, refresh: bool = False) -> list[GalleryModel]:
+        with self._lock:
+            if self._index_cache is not None and not refresh:
+                return self._index_cache
+        models: list[GalleryModel] = []
+        for g in self.galleries:
+            try:
+                models.extend(load_gallery_index(
+                    g.get("url", ""), g.get("name", "")))
+            except Exception:
+                continue  # unreachable gallery must not break the list
+        import os
+
+        installed = set()
+        if os.path.isdir(self.models_path):
+            installed = {os.path.splitext(f)[0]
+                         for f in os.listdir(self.models_path)
+                         if f.endswith((".yaml", ".yml"))}
+        for m in models:
+            m.installed = m.name in installed
+        with self._lock:
+            self._index_cache = models
+        return models
+
+    def invalidate_index(self) -> None:
+        """Drop the catalog cache (gallery list changed / model installed)."""
+        with self._lock:
+            self._index_cache = None
+
+    def find(self, name: str) -> Optional[GalleryModel]:
+        gal = ""
+        if "@" in name:  # gallery@model addressing (ref: gallery.go)
+            gal, name = name.split("@", 1)
+        for m in self.available_models():
+            if m.name == name and (not gal or m.gallery_name == gal):
+                return m
+        return None
+
+    # --------------------------------------------------------------- jobs
+
+    def status(self, job_id: str) -> Optional[JobStatus]:
+        with self._lock:
+            return self._status.get(job_id)
+
+    def all_status(self) -> dict[str, JobStatus]:
+        with self._lock:
+            return dict(self._status)
+
+    def _update(self, job_id: str, **kw) -> None:
+        with self._lock:
+            st = self._status.setdefault(job_id, JobStatus())
+            for k, v in kw.items():
+                setattr(st, k, v)
+
+    def submit(self, op: GalleryOp, *, config_loader=None) -> str:
+        """Start an install/delete job in a worker thread; returns job id."""
+        self._update(op.id, gallery_model_name=op.gallery_model_name,
+                     deletion=op.delete, message="processing")
+
+        def work():
+            try:
+                if op.delete:
+                    ok = delete_model(op.gallery_model_name, self.models_path)
+                    if config_loader is not None and ok:
+                        config_loader.remove(op.gallery_model_name)
+                    if not ok:
+                        raise FileNotFoundError(
+                            f"model '{op.gallery_model_name}' not installed")
+                else:
+                    model = None
+                    if op.config_url:
+                        model = GalleryModel(
+                            name=op.gallery_model_name or "remote-model",
+                            config_url=op.config_url,
+                            overrides=op.overrides)
+                    else:
+                        model = self.find(op.gallery_model_name)
+                    if model is None:
+                        raise FileNotFoundError(
+                            f"no gallery model '{op.gallery_model_name}'")
+                    cfg_path = install_model(
+                        model, self.models_path,
+                        extra_overrides=op.overrides,
+                        progress=lambda d, t: self._update(
+                            op.id, progress=100.0 * d / max(t, 1)),
+                    )
+                    if config_loader is not None:
+                        config_loader.load_config_file(cfg_path)
+                self._update(op.id, processed=True, progress=100.0,
+                             message="completed")
+                self.invalidate_index()  # refresh 'installed' flags
+            except Exception as e:
+                self._update(op.id, processed=True, error=str(e),
+                             message="error")
+
+        threading.Thread(target=work, daemon=True,
+                         name=f"gallery-{op.id[:8]}").start()
+        return op.id

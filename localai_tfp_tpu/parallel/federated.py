@@ -1,0 +1,216 @@
+"""Federated serving: node registry + HTTP request load balancer.
+
+TPU-native replacement of the reference's libp2p/edgevpn federation
+(core/p2p/federated.go:20-118 SelectLeastUsedServer/RandomServer,
+federated_server.go:17-130 proxy loop; worker announce p2p.go:319-365 —
+gossip ledger with LastSeen, offline nodes skipped). Re-design rationale
+(SURVEY.md §2.5): inside a pod ICI/DCN collectives replace tensor
+transport, so what remains for federation is a *control plane* + an HTTP
+request router across independent LocalAI instances. That needs no DHT:
+a shared-token registry with heartbeats and an HTTP reverse proxy give
+the same operator surface (token join, /api/p2p introspection,
+least-used/random balancing).
+
+Token UX kept from the reference: one opaque base64 string carries
+network id + shared secret (ref: p2p.go:33-66 GenerateToken).
+"""
+
+from __future__ import annotations
+
+import base64
+import hmac
+import json
+import os
+import secrets
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from aiohttp import ClientSession, ClientTimeout, web
+
+HEARTBEAT_S = 20.0  # ref: announce every 20s (p2p.go:350-362)
+STALE_S = 60.0  # ref: FailureThreshold on LastSeen
+
+
+def generate_token(network_id: str = "") -> str:
+    """Opaque join token: base64 JSON {network_id, secret}."""
+    payload = {
+        "network_id": network_id or secrets.token_hex(8),
+        "secret": secrets.token_hex(16),
+    }
+    return base64.urlsafe_b64encode(
+        json.dumps(payload).encode()).decode()
+
+
+def parse_token(token: str) -> dict:
+    try:
+        return json.loads(base64.urlsafe_b64decode(token.encode()))
+    except Exception:
+        raise ValueError("invalid federation token")
+
+
+@dataclass
+class Node:
+    """ref: p2p.NodeData {Name, ID, TunnelAddress, LastSeen}."""
+
+    id: str
+    name: str
+    address: str  # http(s)://host:port of the member instance
+    last_seen: float = field(default_factory=time.monotonic)
+    in_flight: int = 0
+    requests_served: int = 0
+
+    def online(self, now: Optional[float] = None) -> bool:
+        return (now or time.monotonic()) - self.last_seen < STALE_S
+
+
+class NodeRegistry:
+    """Token-guarded membership table (the gossip-ledger equivalent)."""
+
+    def __init__(self, token: str) -> None:
+        self.token_payload = parse_token(token)
+        self._nodes: dict[str, Node] = {}
+
+    def _authorized(self, token: str) -> bool:
+        try:
+            other = parse_token(token)
+        except ValueError:
+            return False
+        return hmac.compare_digest(
+            other.get("secret", ""), self.token_payload.get("secret", ""))
+
+    def announce(self, token: str, node_id: str, name: str,
+                 address: str) -> bool:
+        if not self._authorized(token):
+            return False
+        n = self._nodes.get(node_id)
+        if n is None:
+            self._nodes[node_id] = Node(id=node_id, name=name,
+                                        address=address)
+        else:
+            n.address = address
+            n.last_seen = time.monotonic()
+        return True
+
+    def nodes(self, online_only: bool = False) -> list[Node]:
+        now = time.monotonic()
+        out = sorted(self._nodes.values(), key=lambda n: n.id)
+        return [n for n in out if n.online(now)] if online_only else out
+
+    # ---- selection (ref: federated.go SelectLeastUsedServer :78,
+    #      RandomServer :39) ----
+
+    def pick(self, strategy: str = "least-used") -> Optional[Node]:
+        online = self.nodes(online_only=True)
+        if not online:
+            return None
+        if strategy == "random":
+            import random
+
+            return random.choice(online)
+        return min(online, key=lambda n: (n.in_flight, n.requests_served))
+
+
+class FederatedServer:
+    """HTTP front door balancing whole requests across member instances
+    (ref: federated_server.go proxy loop — whole-connection forwarding,
+    least-used default)."""
+
+    HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding",
+                   "upgrade", "proxy-authorization", "te", "trailer"}
+
+    def __init__(self, token: str, *, strategy: str = "least-used") -> None:
+        self.registry = NodeRegistry(token)
+        self.token = token
+        self.strategy = strategy
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/federation/register", self.handle_register)
+        app.router.add_get("/federation/nodes", self.handle_nodes)
+        app.router.add_route("*", "/{tail:.*}", self.handle_proxy)
+        app.cleanup_ctx.append(self._client_ctx)
+        return app
+
+    async def _client_ctx(self, app):
+        self._client = ClientSession(timeout=ClientTimeout(total=600))
+        yield
+        await self._client.close()
+
+    async def handle_register(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        ok = self.registry.announce(
+            body.get("token", ""), body.get("id", ""),
+            body.get("name", ""), body.get("address", ""))
+        if not ok:
+            raise web.HTTPUnauthorized(reason="bad federation token")
+        return web.json_response({"ok": True,
+                                  "heartbeat_s": HEARTBEAT_S})
+
+    async def handle_nodes(self, request: web.Request) -> web.Response:
+        return web.json_response([
+            {"id": n.id, "name": n.name, "address": n.address,
+             "online": n.online(), "in_flight": n.in_flight,
+             "requests_served": n.requests_served}
+            for n in self.registry.nodes()
+        ])
+
+    async def handle_proxy(self, request: web.Request) -> web.StreamResponse:
+        node = self.registry.pick(self.strategy)
+        if node is None:
+            raise web.HTTPServiceUnavailable(
+                reason="no federation nodes online")
+        node.in_flight += 1
+        try:
+            url = node.address.rstrip("/") + "/" + request.match_info["tail"]
+            if request.query_string:
+                url += "?" + request.query_string
+            headers = {k: v for k, v in request.headers.items()
+                       if k.lower() not in self.HOP_HEADERS
+                       and k.lower() != "host"}
+            data = await request.read()
+            async with self._client.request(
+                request.method, url, headers=headers,
+                data=data or None, allow_redirects=False,
+            ) as upstream:
+                resp = web.StreamResponse(status=upstream.status)
+                for k, v in upstream.headers.items():
+                    if k.lower() not in self.HOP_HEADERS | {"content-length"}:
+                        resp.headers[k] = v
+                await resp.prepare(request)
+                async for chunk in upstream.content.iter_chunked(1 << 16):
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+        finally:
+            node.in_flight -= 1
+            node.requests_served += 1
+
+
+async def announce_forever(balancer_url: str, token: str, node_id: str,
+                           name: str, address: str) -> None:
+    """Worker-side heartbeat loop (ref: ExposeService announce ticker)."""
+    import asyncio
+    import logging
+
+    log = logging.getLogger(__name__)
+    async with ClientSession(timeout=ClientTimeout(total=10)) as client:
+        while True:
+            try:
+                async with client.post(
+                    balancer_url.rstrip("/") + "/federation/register",
+                    json={"token": token, "id": node_id, "name": name,
+                          "address": address},
+                ) as resp:
+                    if resp.status == 401:
+                        log.error(
+                            "federation register rejected (bad token) by "
+                            "%s — this node will NOT receive traffic",
+                            balancer_url,
+                        )
+                    elif resp.status != 200:
+                        log.warning("federation register -> HTTP %s",
+                                    resp.status)
+            except Exception as e:
+                log.warning("federation register failed: %s", e)
+            await asyncio.sleep(HEARTBEAT_S)

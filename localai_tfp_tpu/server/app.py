@@ -18,7 +18,7 @@ import uuid
 from aiohttp import web
 
 from .state import Application
-from . import media_routes, openai_routes, localai_routes
+from . import assistants_routes, media_routes, openai_routes, localai_routes
 
 log = logging.getLogger(__name__)
 
@@ -96,6 +96,7 @@ def build_app(state: Application) -> web.Application:
     openai_routes.register(app)
     localai_routes.register(app)
     media_routes.register(app)
+    assistants_routes.register(app)
 
     # static generated-content serving (ref: app.go:158-171)
     import os
@@ -108,8 +109,35 @@ def build_app(state: Application) -> web.Application:
 
     async def on_startup(app_):
         state.startup()
+        cfg = state.config
+        if cfg.federated_server_url and cfg.p2p_token:
+            import asyncio
+            import uuid as _uuid
+
+            from ..parallel.federated import announce_forever
+
+            addr = cfg.advertise_address
+            if not addr:
+                # loopback is meaningless to a remote balancer; fall back
+                # to the host's name and say so
+                import socket
+
+                addr = f"http://{socket.gethostname()}:{cfg.port}"
+                log.warning(
+                    "no --advertise-address set; announcing %s — set it "
+                    "explicitly if the balancer cannot resolve this host",
+                    addr,
+                )
+            app_["announce_task"] = asyncio.create_task(announce_forever(
+                cfg.federated_server_url, cfg.p2p_token,
+                _uuid.uuid4().hex[:12], cfg.node_name or "localai-node",
+                addr,
+            ))
 
     async def on_cleanup(app_):
+        task = app_.get("announce_task")
+        if task is not None:
+            task.cancel()
         state.shutdown()
 
     app.on_startup.append(on_startup)

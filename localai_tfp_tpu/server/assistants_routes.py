@@ -1,0 +1,340 @@
+"""OpenAI assistants + files APIs, file-backed.
+
+Ref: core/http/endpoints/openai/assistant.go (522 LoC CRUD + pagination,
+JSON persisted to disk — app.go:192-195), assistant_files (194), files.go
+(194: upload/list/retrieve/delete/content with purpose field).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+from aiohttp import web
+
+from .common import state_of
+
+
+def register(app: web.Application) -> None:
+    r = app.router
+    for p in ("/v1", ""):
+        r.add_post(f"{p}/files", files_upload)
+        r.add_get(f"{p}/files", files_list)
+        r.add_get(f"{p}/files/{{id}}", files_get)
+        r.add_delete(f"{p}/files/{{id}}", files_delete)
+        r.add_get(f"{p}/files/{{id}}/content", files_content)
+        r.add_post(f"{p}/assistants", assistants_create)
+        r.add_get(f"{p}/assistants", assistants_list)
+        r.add_get(f"{p}/assistants/{{id}}", assistants_get)
+        r.add_post(f"{p}/assistants/{{id}}", assistants_modify)
+        r.add_delete(f"{p}/assistants/{{id}}", assistants_delete)
+        r.add_post(f"{p}/assistants/{{id}}/files", afiles_create)
+        r.add_get(f"{p}/assistants/{{id}}/files", afiles_list)
+        r.add_get(f"{p}/assistants/{{id}}/files/{{file_id}}", afiles_get)
+        r.add_delete(f"{p}/assistants/{{id}}/files/{{file_id}}",
+                     afiles_delete)
+
+
+class JsonStore:
+    """Tiny durable JSON collection (the reference persists assistants and
+    file metadata as JSON files in the config dir — app.go:192-195)."""
+
+    _locks: dict[str, threading.Lock] = {}
+    _guard = threading.Lock()
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        with JsonStore._guard:
+            self.lock = JsonStore._locks.setdefault(path, threading.Lock())
+
+    def load(self) -> list[dict]:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return []
+
+    def save(self, items: list[dict]) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(items, f, indent=1)
+        os.replace(tmp, self.path)
+
+
+def _files_store(request: web.Request) -> JsonStore:
+    st = state_of(request)
+    return JsonStore(os.path.join(st.config.config_dir, "files.json"))
+
+
+def _assistants_store(request: web.Request) -> JsonStore:
+    st = state_of(request)
+    return JsonStore(os.path.join(st.config.config_dir, "assistants.json"))
+
+
+def _afiles_store(request: web.Request) -> JsonStore:
+    st = state_of(request)
+    return JsonStore(
+        os.path.join(st.config.config_dir, "assistant_files.json"))
+
+
+# ------------------------------------------------------------------ files
+
+
+async def files_upload(request: web.Request) -> web.Response:
+    st = state_of(request)
+    reader = await request.multipart()
+    purpose = ""
+    stored: Optional[dict] = None
+    while True:
+        part = await reader.next()
+        if part is None:
+            break
+        if part.name == "purpose":
+            purpose = (await part.read()).decode()
+        elif part.name == "file":
+            fid = f"file-{uuid.uuid4().hex[:24]}"
+            fname = os.path.basename(part.filename or "upload")
+            os.makedirs(st.config.upload_dir, exist_ok=True)
+            dst = os.path.join(st.config.upload_dir, fid)
+            size = 0
+            with open(dst, "wb") as f:
+                while True:
+                    chunk = await part.read_chunk()
+                    if not chunk:
+                        break
+                    size += len(chunk)
+                    f.write(chunk)
+            stored = {
+                "id": fid, "object": "file", "bytes": size,
+                "created_at": int(time.time()), "filename": fname,
+                "purpose": purpose,
+            }
+    if stored is None:
+        raise web.HTTPBadRequest(reason="missing 'file' part")
+    stored["purpose"] = stored["purpose"] or purpose
+    store = _files_store(request)
+    with store.lock:
+        items = store.load()
+        items.append(stored)
+        store.save(items)
+    return web.json_response(stored)
+
+
+async def files_list(request: web.Request) -> web.Response:
+    store = _files_store(request)
+    with store.lock:
+        items = store.load()
+    purpose = request.query.get("purpose")
+    if purpose:
+        items = [i for i in items if i.get("purpose") == purpose]
+    return web.json_response({"object": "list", "data": items})
+
+
+def _find_file(request: web.Request) -> dict:
+    store = _files_store(request)
+    fid = request.match_info["id"]
+    with store.lock:
+        for item in store.load():
+            if item["id"] == fid:
+                return item
+    raise web.HTTPNotFound(reason=f"file '{fid}' not found")
+
+
+async def files_get(request: web.Request) -> web.Response:
+    return web.json_response(_find_file(request))
+
+
+async def files_delete(request: web.Request) -> web.Response:
+    st = state_of(request)
+    store = _files_store(request)
+    fid = request.match_info["id"]
+    with store.lock:
+        items = store.load()
+        keep = [i for i in items if i["id"] != fid]
+        if len(keep) == len(items):
+            raise web.HTTPNotFound(reason=f"file '{fid}' not found")
+        store.save(keep)
+    try:
+        os.unlink(os.path.join(st.config.upload_dir, fid))
+    except OSError:
+        pass
+    return web.json_response(
+        {"id": fid, "object": "file", "deleted": True})
+
+
+async def files_content(request: web.Request) -> web.Response:
+    st = state_of(request)
+    item = _find_file(request)
+    path = os.path.join(st.config.upload_dir, item["id"])
+    if not os.path.exists(path):
+        raise web.HTTPNotFound(reason="file content missing")
+    return web.FileResponse(path)
+
+
+# -------------------------------------------------------------- assistants
+
+
+def _paginate(items: list[dict],
+              request: web.Request) -> tuple[list[dict], bool]:
+    """limit/order/after/before; returns (page, has_more) where has_more
+    means entries remain AFTER this page in cursor order (the OpenAI
+    cursor contract — ref: assistant.go ListAssistants)."""
+    order = request.query.get("order", "desc")
+    items = sorted(items, key=lambda a: a.get("created_at", 0),
+                   reverse=(order == "desc"))
+    after = request.query.get("after")
+    before = request.query.get("before")
+    if after:
+        ids = [a["id"] for a in items]
+        if after in ids:
+            items = items[ids.index(after) + 1:]
+    if before:
+        ids = [a["id"] for a in items]
+        if before in ids:
+            items = items[: ids.index(before)]
+    limit = int(request.query.get("limit", 20))
+    return items[:limit], len(items) > limit
+
+
+async def assistants_create(request: web.Request) -> web.Response:
+    body = await request.json()
+    if not body.get("model"):
+        raise web.HTTPBadRequest(reason="'model' required")
+    a = {
+        "id": f"asst_{uuid.uuid4().hex[:24]}",
+        "object": "assistant",
+        "created_at": int(time.time()),
+        "model": body["model"],
+        "name": body.get("name"),
+        "description": body.get("description"),
+        "instructions": body.get("instructions"),
+        "tools": body.get("tools") or [],
+        "file_ids": body.get("file_ids") or [],
+        "metadata": body.get("metadata") or {},
+    }
+    store = _assistants_store(request)
+    with store.lock:
+        items = store.load()
+        items.append(a)
+        store.save(items)
+    return web.json_response(a)
+
+
+async def assistants_list(request: web.Request) -> web.Response:
+    store = _assistants_store(request)
+    with store.lock:
+        items = store.load()
+    page, has_more = _paginate(items, request)
+    return web.json_response({
+        "object": "list", "data": page,
+        "first_id": page[0]["id"] if page else None,
+        "last_id": page[-1]["id"] if page else None,
+        "has_more": has_more,
+    })
+
+
+def _find_assistant(store: JsonStore, aid: str) -> tuple[list[dict], dict]:
+    items = store.load()
+    for a in items:
+        if a["id"] == aid:
+            return items, a
+    raise web.HTTPNotFound(reason=f"assistant '{aid}' not found")
+
+
+async def assistants_get(request: web.Request) -> web.Response:
+    store = _assistants_store(request)
+    with store.lock:
+        _, a = _find_assistant(store, request.match_info["id"])
+    return web.json_response(a)
+
+
+async def assistants_modify(request: web.Request) -> web.Response:
+    body = await request.json()
+    store = _assistants_store(request)
+    with store.lock:
+        items, a = _find_assistant(store, request.match_info["id"])
+        for k in ("model", "name", "description", "instructions", "tools",
+                  "file_ids", "metadata"):
+            if k in body:
+                a[k] = body[k]
+        store.save(items)
+    return web.json_response(a)
+
+
+async def assistants_delete(request: web.Request) -> web.Response:
+    store = _assistants_store(request)
+    aid = request.match_info["id"]
+    with store.lock:
+        items, a = _find_assistant(store, aid)
+        store.save([x for x in items if x["id"] != aid])
+    return web.json_response(
+        {"id": aid, "object": "assistant.deleted", "deleted": True})
+
+
+# --------------------------------------------------------- assistant files
+
+
+async def afiles_create(request: web.Request) -> web.Response:
+    body = await request.json()
+    fid = body.get("file_id")
+    if not fid:
+        raise web.HTTPBadRequest(reason="'file_id' required")
+    aid = request.match_info["id"]
+    astore = _assistants_store(request)
+    with astore.lock:
+        _find_assistant(astore, aid)
+    fstore = _files_store(request)
+    with fstore.lock:
+        if not any(f["id"] == fid for f in fstore.load()):
+            raise web.HTTPNotFound(reason=f"file '{fid}' not found")
+    rec = {
+        "id": fid, "object": "assistant.file",
+        "created_at": int(time.time()), "assistant_id": aid,
+    }
+    store = _afiles_store(request)
+    with store.lock:
+        items = store.load()
+        if not any(i["id"] == fid and i["assistant_id"] == aid
+                   for i in items):
+            items.append(rec)
+            store.save(items)
+    return web.json_response(rec)
+
+
+async def afiles_list(request: web.Request) -> web.Response:
+    aid = request.match_info["id"]
+    store = _afiles_store(request)
+    with store.lock:
+        items = [i for i in store.load() if i["assistant_id"] == aid]
+    return web.json_response({"object": "list", "data": items})
+
+
+async def afiles_get(request: web.Request) -> web.Response:
+    aid = request.match_info["id"]
+    fid = request.match_info["file_id"]
+    store = _afiles_store(request)
+    with store.lock:
+        for i in store.load():
+            if i["assistant_id"] == aid and i["id"] == fid:
+                return web.json_response(i)
+    raise web.HTTPNotFound(reason="assistant file not found")
+
+
+async def afiles_delete(request: web.Request) -> web.Response:
+    aid = request.match_info["id"]
+    fid = request.match_info["file_id"]
+    store = _afiles_store(request)
+    with store.lock:
+        items = store.load()
+        keep = [i for i in items
+                if not (i["assistant_id"] == aid and i["id"] == fid)]
+        if len(keep) == len(items):
+            raise web.HTTPNotFound(reason="assistant file not found")
+        store.save(keep)
+    return web.json_response(
+        {"id": fid, "object": "assistant.file.deleted", "deleted": True})

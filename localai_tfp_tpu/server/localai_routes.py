@@ -37,6 +37,19 @@ def register(app: web.Application) -> None:
     r.add_post("/v1/sound-generation", sound_generation)
     for p in ("/stores/set", "/stores/delete", "/stores/get", "/stores/find"):
         r.add_post(p, stores_dispatch)
+    # p2p/federation introspection (ref: routes/localai.go:79-82)
+    r.add_get("/api/p2p", p2p_nodes)
+    r.add_get("/api/p2p/token", p2p_token)
+    r.add_post("/federation/register", federation_register)
+    # gallery management (ref: routes/localai.go:27-38)
+    r.add_post("/models/apply", models_apply)
+    r.add_post("/models/delete/{name}", models_delete)
+    r.add_get("/models/available", models_available)
+    r.add_get("/models/galleries", models_galleries)
+    r.add_post("/models/galleries", galleries_add)
+    r.add_delete("/models/galleries", galleries_remove)
+    r.add_get("/models/jobs/{uuid}", models_job)
+    r.add_get("/models/jobs", models_jobs)
 
 
 def _state(request: web.Request) -> Application:
@@ -225,6 +238,146 @@ async def rerank(request: web.Request) -> web.Response:
              "document": {"text": d.text}}
             for d in res.results
         ],
+    })
+
+
+# ------------------------------------------------------------ federation
+
+
+async def p2p_nodes(request: web.Request) -> web.Response:
+    """ref: endpoints/localai/p2p.go ShowP2PNodes — swarm members."""
+    st = _state(request)
+    nodes = []
+    if st.registry is not None:
+        nodes = [
+            {"id": n.id, "name": n.name, "address": n.address,
+             "online": n.online(), "requests_served": n.requests_served}
+            for n in st.registry.nodes()
+        ]
+    return web.json_response({
+        "enabled": st.registry is not None,
+        "nodes": nodes,
+    })
+
+
+async def p2p_token(request: web.Request) -> web.Response:
+    """ref: endpoints/localai/p2p.go ShowP2PToken."""
+    return web.json_response({"token": _state(request).config.p2p_token})
+
+
+async def federation_register(request: web.Request) -> web.Response:
+    """Accept worker announcements when this instance carries a token —
+    every instance can act as a registry (the gossip-ledger analogue)."""
+    st = _state(request)
+    if st.registry is None:
+        raise web.HTTPNotFound(reason="federation not enabled")
+    body = await _body(request)
+    ok = st.registry.announce(
+        body.get("token", ""), body.get("id", ""), body.get("name", ""),
+        body.get("address", ""))
+    if not ok:
+        raise web.HTTPUnauthorized(reason="bad federation token")
+    from ..parallel.federated import HEARTBEAT_S
+
+    return web.json_response({"ok": True, "heartbeat_s": HEARTBEAT_S})
+
+
+# --------------------------------------------------------------- gallery
+
+
+async def models_apply(request: web.Request) -> web.Response:
+    """ref: endpoints/localai/gallery.go ApplyModelGalleryEndpoint —
+    body: {id: "gallery@model"} or {url: config-url}, optional overrides;
+    returns {uuid, status} with the job-status poll URL."""
+    from ..gallery.service import GalleryOp
+
+    st = _state(request)
+    body = await _body(request)
+    op = GalleryOp(
+        gallery_model_name=body.get("id") or body.get("name") or "",
+        config_url=body.get("url") or body.get("config_url") or "",
+        overrides=body.get("overrides") or {},
+    )
+    if not op.gallery_model_name and not op.config_url:
+        raise web.HTTPBadRequest(reason="'id' or 'url' required")
+    job = st.gallery.submit(op, config_loader=st.config_loader)
+    return web.json_response(
+        {"uuid": job, "status": f"/models/jobs/{job}"})
+
+
+async def models_delete(request: web.Request) -> web.Response:
+    from ..gallery.service import GalleryOp
+
+    st = _state(request)
+    name = request.match_info["name"]
+    st.model_loader.shutdown_model(name)
+    job = st.gallery.submit(
+        GalleryOp(gallery_model_name=name, delete=True),
+        config_loader=st.config_loader,
+    )
+    return web.json_response(
+        {"uuid": job, "status": f"/models/jobs/{job}"})
+
+
+async def models_available(request: web.Request) -> web.Response:
+    st = _state(request)
+    models = await asyncio.get_running_loop().run_in_executor(
+        None, st.gallery.available_models)
+    return web.json_response([
+        {
+            "name": m.name, "description": m.description,
+            "license": m.license, "urls": m.urls, "tags": m.tags,
+            "gallery": {"name": m.gallery_name}, "installed": m.installed,
+        }
+        for m in models
+    ])
+
+
+async def models_galleries(request: web.Request) -> web.Response:
+    return web.json_response(_state(request).gallery.galleries)
+
+
+async def galleries_add(request: web.Request) -> web.Response:
+    st = _state(request)
+    body = await _body(request)
+    if not body.get("url"):
+        raise web.HTTPBadRequest(reason="'url' required")
+    st.gallery.galleries.append(
+        {"name": body.get("name", ""), "url": body["url"]})
+    st.gallery.invalidate_index()
+    return web.json_response(st.gallery.galleries)
+
+
+async def galleries_remove(request: web.Request) -> web.Response:
+    st = _state(request)
+    body = await _body(request)
+    st.gallery.galleries = [
+        g for g in st.gallery.galleries
+        if g.get("name") != body.get("name") and g.get("url") != body.get("url")
+    ]
+    st.gallery.invalidate_index()
+    return web.json_response(st.gallery.galleries)
+
+
+async def models_job(request: web.Request) -> web.Response:
+    st = _state(request)
+    status = st.gallery.status(request.match_info["uuid"])
+    if status is None:
+        raise web.HTTPNotFound(reason="no such job")
+    return web.json_response({
+        "deletion": status.deletion, "file_name": status.file_name,
+        "error": status.error or None, "processed": status.processed,
+        "message": status.message, "progress": status.progress,
+        "gallery_model_name": status.gallery_model_name,
+    })
+
+
+async def models_jobs(request: web.Request) -> web.Response:
+    st = _state(request)
+    return web.json_response({
+        jid: {"processed": s.processed, "progress": s.progress,
+              "error": s.error or None, "message": s.message}
+        for jid, s in st.gallery.all_status().items()
     })
 
 

@@ -76,7 +76,17 @@ class Application:
             single_active_backend=self.config.single_active_backend,
         )
         self.evaluator = Evaluator(str(self.config.models_path))
+        from ..gallery.service import GalleryService
+
+        self.gallery = GalleryService(
+            str(self.config.models_path), self.config.galleries
+        )
         self.metrics = MetricsStore()
+        self.registry = None  # federation membership (when p2p_token set)
+        if self.config.p2p_token:
+            from ..parallel.federated import NodeRegistry
+
+            self.registry = NodeRegistry(self.config.p2p_token)
         self.started_at = time.time()
         self.watchdog = WatchDog(
             self.model_loader,

@@ -1,0 +1,129 @@
+"""Assistants + files API tests (ref: assistant_test.go / files_test.go
+behavior: CRUD, pagination, content round-trip)."""
+
+import asyncio
+import io
+import json
+
+import pytest
+from aiohttp import FormData
+from aiohttp.test_utils import TestClient, TestServer
+
+from localai_tfp_tpu.config.app_config import ApplicationConfig
+from localai_tfp_tpu.server.app import build_app
+from localai_tfp_tpu.server.state import Application
+
+
+@pytest.fixture(scope="module")
+def client(tmp_path_factory):
+    root = tmp_path_factory.mktemp("asst")
+    (root / "models").mkdir()
+    loop = asyncio.new_event_loop()
+    cfg = ApplicationConfig(
+        models_path=str(root / "models"),
+        generated_content_dir=str(root / "generated"),
+        upload_dir=str(root / "uploads"),
+        config_dir=str(root / "configuration"),
+    )
+    app = build_app(Application(cfg))
+    tc = TestClient(TestServer(app), loop=loop)
+    loop.run_until_complete(tc.start_server())
+
+    class Sync:
+        def req(self, method, path, **kw):
+            async def go():
+                r = await tc.request(method, path, **kw)
+                body = await r.read()
+                return r.status, (json.loads(body) if body and
+                                  body[:1] in (b"{", b"[") else body)
+            return loop.run_until_complete(go())
+
+    yield Sync()
+    loop.run_until_complete(tc.close())
+    loop.close()
+
+
+def _upload(client, content=b"hello file", purpose="assistants"):
+    form = FormData()
+    form.add_field("purpose", purpose)
+    form.add_field("file", io.BytesIO(content), filename="notes.txt")
+    return client.req("POST", "/v1/files", data=form)
+
+
+def test_file_upload_list_content_delete(client):
+    status, f = _upload(client)
+    assert status == 200 and f["object"] == "file"
+    assert f["bytes"] == 10 and f["filename"] == "notes.txt"
+
+    status, lst = client.req("GET", "/v1/files")
+    assert any(x["id"] == f["id"] for x in lst["data"])
+
+    status, lst2 = client.req("GET", "/v1/files?purpose=other")
+    assert all(x["purpose"] == "other" for x in lst2["data"])
+
+    status, got = client.req("GET", f"/v1/files/{f['id']}")
+    assert got["id"] == f["id"]
+
+    status, content = client.req("GET", f"/v1/files/{f['id']}/content")
+    assert content == b"hello file"
+
+    status, d = client.req("DELETE", f"/v1/files/{f['id']}")
+    assert d["deleted"] is True
+    status, _ = client.req("GET", f"/v1/files/{f['id']}")
+    assert status == 404
+
+
+def test_assistant_crud_and_pagination(client):
+    ids = []
+    for i in range(3):
+        status, a = client.req("POST", "/v1/assistants", json={
+            "model": "tiny", "name": f"a{i}", "instructions": "be helpful",
+        })
+        assert status == 200
+        ids.append(a["id"])
+
+    status, _ = client.req("POST", "/v1/assistants", json={})
+    assert status == 400
+
+    status, lst = client.req("GET", "/v1/assistants?limit=2&order=asc")
+    assert [a["name"] for a in lst["data"]][:2] == ["a0", "a1"]
+
+    status, got = client.req("GET", f"/v1/assistants/{ids[1]}")
+    assert got["name"] == "a1"
+
+    status, mod = client.req("POST", f"/v1/assistants/{ids[1]}", json={
+        "name": "renamed", "metadata": {"k": "v"}})
+    assert mod["name"] == "renamed" and mod["metadata"] == {"k": "v"}
+
+    status, d = client.req("DELETE", f"/v1/assistants/{ids[0]}")
+    assert d["deleted"] is True
+    status, _ = client.req("GET", f"/v1/assistants/{ids[0]}")
+    assert status == 404
+
+
+def test_assistant_files(client):
+    _, f = _upload(client, b"attach me")
+    _, a = client.req("POST", "/v1/assistants", json={"model": "tiny"})
+
+    status, rec = client.req(
+        "POST", f"/v1/assistants/{a['id']}/files",
+        json={"file_id": f["id"]})
+    assert status == 200 and rec["assistant_id"] == a["id"]
+
+    status, _ = client.req(
+        "POST", f"/v1/assistants/{a['id']}/files",
+        json={"file_id": "file-missing"})
+    assert status == 404
+
+    status, lst = client.req("GET", f"/v1/assistants/{a['id']}/files")
+    assert len(lst["data"]) == 1
+
+    status, got = client.req(
+        "GET", f"/v1/assistants/{a['id']}/files/{f['id']}")
+    assert got["id"] == f["id"]
+
+    status, d = client.req(
+        "DELETE", f"/v1/assistants/{a['id']}/files/{f['id']}")
+    assert d["deleted"] is True
+    status, lst = client.req("GET", f"/v1/assistants/{a['id']}/files")
+    assert lst["data"] == []

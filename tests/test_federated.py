@@ -1,0 +1,146 @@
+"""Federation: token auth, registry liveness, load balancing, proxying
+(SURVEY.md §2.5 — the reference has NO automated multi-node tests; we add
+an in-process two-instance federation test)."""
+
+import asyncio
+import json
+import time
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from localai_tfp_tpu.parallel.federated import (
+    FederatedServer, Node, NodeRegistry, generate_token, parse_token,
+)
+
+
+def test_token_roundtrip():
+    tok = generate_token("mynet")
+    payload = parse_token(tok)
+    assert payload["network_id"] == "mynet"
+    assert len(payload["secret"]) == 32
+    with pytest.raises(ValueError):
+        parse_token("not-base64!!")
+
+
+def test_registry_auth_and_liveness():
+    tok = generate_token()
+    reg = NodeRegistry(tok)
+    assert reg.announce(tok, "n1", "node-1", "http://a:1")
+    assert not reg.announce(generate_token(), "n2", "evil", "http://b:2")
+    assert [n.id for n in reg.nodes(online_only=True)] == ["n1"]
+    # stale nodes drop out of the online view
+    reg._nodes["n1"].last_seen -= 120
+    assert reg.nodes(online_only=True) == []
+    assert [n.id for n in reg.nodes()] == ["n1"]
+
+
+def test_least_used_and_random_selection():
+    tok = generate_token()
+    reg = NodeRegistry(tok)
+    reg.announce(tok, "a", "a", "http://a")
+    reg.announce(tok, "b", "b", "http://b")
+    reg._nodes["a"].requests_served = 5
+    assert reg.pick("least-used").id == "b"
+    reg._nodes["b"].in_flight = 2
+    assert reg.pick("least-used").id == "a"
+    assert reg.pick("random").id in ("a", "b")
+    reg._nodes["a"].last_seen -= 120
+    reg._nodes["b"].last_seen -= 120
+    assert reg.pick() is None
+
+
+def test_federated_proxy_end_to_end():
+    """Balancer forwards requests to the least-used member instance."""
+    loop = asyncio.new_event_loop()
+
+    async def go():
+        hits = {"m1": 0, "m2": 0}
+
+        def member(name):
+            async def handler(request):
+                hits[name] += 1
+                body = await request.text()
+                return web.json_response(
+                    {"member": name, "path": request.path, "body": body})
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", handler)
+            return app
+
+        m1 = TestServer(member("m1"))
+        m2 = TestServer(member("m2"))
+        await m1.start_server()
+        await m2.start_server()
+
+        tok = generate_token()
+        fed = FederatedServer(tok)
+        fs = TestServer(fed.build_app())
+        client = TestClient(fs)
+        await client.start_server()
+
+        # no nodes yet -> 503
+        r = await client.post("/v1/chat/completions", json={})
+        assert r.status == 503
+
+        for i, m in enumerate((m1, m2)):
+            r = await client.post("/federation/register", json={
+                "token": tok, "id": f"m{i+1}", "name": f"m{i+1}",
+                "address": f"http://127.0.0.1:{m.port}",
+            })
+            assert r.status == 200
+
+        # bad token refused
+        r = await client.post("/federation/register", json={
+            "token": generate_token(), "id": "x", "name": "x",
+            "address": "http://nope"})
+        assert r.status == 401
+
+        r = await client.get("/federation/nodes")
+        assert len(await r.json()) == 2
+
+        for _ in range(4):
+            r = await client.post("/v1/models", data=b"payload")
+            assert r.status == 200
+            out = await r.json()
+            assert out["path"] == "/v1/models"
+            assert out["body"] == "payload"
+        # least-used alternates across members
+        assert hits["m1"] == 2 and hits["m2"] == 2
+
+        await client.close()
+        await m1.close()
+        await m2.close()
+
+    loop.run_until_complete(go())
+    loop.close()
+
+
+def test_cli_parser_and_token():
+    from localai_tfp_tpu.cli import build_parser
+
+    p = build_parser()
+    args = p.parse_args(["run", "--port", "9090", "--mesh", "data=2,model=4"])
+    assert args.port == 9090 and args.mesh == "data=2,model=4"
+    args = p.parse_args(["models", "install", "foo@bar"])
+    assert args.name == "foo@bar"
+    args = p.parse_args(["federated", "--strategy", "random"])
+    assert args.strategy == "random"
+    args = p.parse_args(["util", "new-token"])
+    assert args.util_command == "new-token"
+
+
+def test_app_config_from_cli_args():
+    from localai_tfp_tpu.cli import _app_config, build_parser
+
+    args = build_parser().parse_args([
+        "run", "--models-path", "/m", "--api-keys", "k1,k2",
+        "--mesh", "data=2,model=4", "--single-active-backend",
+        "--galleries", json.dumps([{"name": "g", "url": "file:///x"}]),
+    ])
+    cfg = _app_config(args)
+    assert cfg.models_path == "/m"
+    assert cfg.api_keys == ["k1", "k2"]
+    assert cfg.mesh_shape == {"data": 2, "model": 4}
+    assert cfg.single_active_backend
+    assert cfg.galleries[0]["name"] == "g"

@@ -1,0 +1,137 @@
+"""Gallery + downloader tests (offline: file:// URIs only — SURVEY.md §4
+notes the reference tests gallery installs from a file:// gallery,
+tests/fixtures/gallery_simple.yaml)."""
+
+import hashlib
+import os
+import time
+
+import pytest
+import yaml
+
+from localai_tfp_tpu.gallery.downloader import URI, _sha256
+from localai_tfp_tpu.gallery.gallery import (
+    GalleryModel, _deep_merge, delete_model, install_model,
+    load_gallery_index,
+)
+from localai_tfp_tpu.gallery.service import GalleryOp, GalleryService
+
+
+def test_uri_scheme_parsing():
+    assert URI("huggingface://org/repo/f.gguf").scheme == "huggingface"
+    assert URI("github:org/repo/path/x.yaml@main").scheme == "github"
+    assert URI("oci://reg/repo:tag").scheme == "oci"
+    assert URI("ollama://gemma:2b").scheme == "ollama"
+    assert URI("https://x/y").scheme == "https"
+    assert URI("file:///tmp/x").scheme == "file"
+
+
+def test_uri_resolution():
+    assert URI("huggingface://TheBloke/repo/model.gguf").resolve_url() == (
+        "https://huggingface.co/TheBloke/repo/resolve/main/model.gguf")
+    assert URI("huggingface://o/r/sub/dir/f.bin@br").resolve_url() == (
+        "https://huggingface.co/o/r/resolve/br/sub/dir/f.bin")
+    assert URI("github:go-skynet/gallery/x.yaml@main").resolve_url() == (
+        "https://raw.githubusercontent.com/go-skynet/gallery/main/x.yaml")
+    with pytest.raises(ValueError):
+        URI("huggingface://only/two").resolve_url()
+
+
+def test_download_file_uri_and_sha(tmp_path):
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"model-bytes")
+    sha = hashlib.sha256(b"model-bytes").hexdigest()
+    dst = str(tmp_path / "out" / "dst.bin")
+    out = URI(f"file://{src}").download(dst, sha256=sha)
+    assert open(out, "rb").read() == b"model-bytes"
+    # wrong sha removes the partial and raises
+    with pytest.raises(ValueError):
+        URI(f"file://{src}").download(str(tmp_path / "bad.bin"),
+                                      sha256="0" * 64)
+    assert not os.path.exists(str(tmp_path / "bad.bin"))
+
+
+def test_deep_merge():
+    assert _deep_merge({"a": 1, "b": {"x": 1, "y": 2}},
+                       {"b": {"y": 3}, "c": 4}) == {
+        "a": 1, "b": {"x": 1, "y": 3}, "c": 4}
+
+
+@pytest.fixture()
+def gallery_dir(tmp_path):
+    blob = tmp_path / "weights.bin"
+    blob.write_bytes(b"w" * 64)
+    sha = hashlib.sha256(b"w" * 64).hexdigest()
+    index = [{
+        "name": "tiny-model",
+        "description": "a tiny test model",
+        "license": "mit",
+        "files": [{
+            "filename": "weights.bin",
+            "uri": f"file://{blob}",
+            "sha256": sha,
+        }],
+        "config": {
+            "name": "tiny-model",
+            "backend": "jax-llm",
+            "parameters": {"model": "weights.bin"},
+        },
+        "overrides": {"context_size": 512},
+    }]
+    idx = tmp_path / "index.yaml"
+    idx.write_text(yaml.safe_dump(index))
+    return tmp_path, idx
+
+
+def test_install_and_delete(gallery_dir, tmp_path):
+    root, idx = gallery_dir
+    models = load_gallery_index(f"file://{idx}", "test")
+    assert len(models) == 1 and models[0].name == "tiny-model"
+    mp = str(tmp_path / "models")
+    cfg_path = install_model(models[0], mp)
+    cfg = yaml.safe_load(open(cfg_path))
+    assert cfg["context_size"] == 512  # override applied
+    assert os.path.exists(os.path.join(mp, "weights.bin"))
+    assert delete_model("tiny-model", mp)
+    assert not os.path.exists(cfg_path)
+    assert not os.path.exists(os.path.join(mp, "weights.bin"))
+    assert not delete_model("tiny-model", mp)
+
+
+def test_gallery_service_job_flow(gallery_dir, tmp_path):
+    root, idx = gallery_dir
+    mp = str(tmp_path / "models")
+    svc = GalleryService(mp, [{"name": "test", "url": f"file://{idx}"}])
+    avail = svc.available_models()
+    assert [m.name for m in avail] == ["tiny-model"]
+    assert not avail[0].installed
+
+    job = svc.submit(GalleryOp(gallery_model_name="tiny-model"))
+    for _ in range(100):
+        st = svc.status(job)
+        if st and st.processed:
+            break
+        time.sleep(0.05)
+    assert st.processed and not st.error, st
+    assert st.progress == 100.0
+    assert os.path.exists(os.path.join(mp, "tiny-model.yaml"))
+    # installed flag refreshes
+    assert svc.available_models(refresh=True)[0].installed
+
+    # unknown model -> error status, not an exception
+    job2 = svc.submit(GalleryOp(gallery_model_name="nope"))
+    for _ in range(100):
+        st2 = svc.status(job2)
+        if st2 and st2.processed:
+            break
+        time.sleep(0.05)
+    assert st2.error
+
+
+def test_gallery_at_addressing(gallery_dir, tmp_path):
+    root, idx = gallery_dir
+    svc = GalleryService(str(tmp_path / "m"),
+                         [{"name": "test", "url": f"file://{idx}"}])
+    assert svc.find("test@tiny-model") is not None
+    assert svc.find("other@tiny-model") is None
+    assert svc.find("tiny-model").name == "tiny-model"
