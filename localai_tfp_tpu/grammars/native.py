@@ -1,0 +1,127 @@
+"""ctypes binding for the native GBNF mask engine (native/gbnf_mask.cpp).
+
+Same contract as grammars/constrain.py GrammarConstraint (the engine
+accepts either). States are plain ints interned inside the C++ engine, so
+the per-token host cost is one FFI call for advance and one for the mask
+fill — the decode scheduler's grammar budget (SURVEY.md §7 hard part #3).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..native import load_library
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    lib.gbnf_new.restype = c.c_void_p
+    lib.gbnf_new.argtypes = [c.c_char_p, c.c_char_p, c.c_int]
+    lib.gbnf_free.argtypes = [c.c_void_p]
+    lib.gbnf_set_vocab.argtypes = [c.c_void_p, c.c_int]
+    lib.gbnf_add_token.argtypes = [c.c_void_p, c.c_int, c.c_char_p, c.c_int]
+    lib.gbnf_add_eos.argtypes = [c.c_void_p, c.c_int]
+    lib.gbnf_initial.restype = c.c_int
+    lib.gbnf_initial.argtypes = [c.c_void_p]
+    lib.gbnf_advance.restype = c.c_int
+    lib.gbnf_advance.argtypes = [c.c_void_p, c.c_int, c.c_int]
+    lib.gbnf_accept_text.restype = c.c_int
+    lib.gbnf_accept_text.argtypes = [c.c_void_p, c.c_int, c.c_char_p,
+                                     c.c_int]
+    lib.gbnf_can_end.restype = c.c_int
+    lib.gbnf_can_end.argtypes = [c.c_void_p, c.c_int]
+    lib.gbnf_is_dead.restype = c.c_int
+    lib.gbnf_is_dead.argtypes = [c.c_void_p, c.c_int]
+    lib.gbnf_mask.argtypes = [c.c_void_p, c.c_int,
+                              np.ctypeslib.ndpointer(np.uint8)]
+    return lib
+
+
+def available() -> bool:
+    if os.environ.get("LOCALAI_NATIVE_GBNF", "1") in ("0", "false", "off"):
+        return False
+    return load_library("gbnf", auto_build=True) is not None
+
+
+class NativeGrammarConstraint:
+    """Drop-in for GrammarConstraint backed by the C++ engine."""
+
+    def __init__(self, gbnf_text: str, tokenizer) -> None:
+        lib = load_library("gbnf", auto_build=True)
+        if lib is None:
+            raise RuntimeError("native gbnf library unavailable")
+        self._lib = _bind(lib)
+        err = ctypes.create_string_buffer(256)
+        self._h = self._lib.gbnf_new(gbnf_text.encode(), err, 256)
+        if not self._h:
+            raise ValueError(f"gbnf parse error: {err.value.decode()}")
+        self.vocab_size = tokenizer.vocab_size
+        self._lib.gbnf_set_vocab(self._h, self.vocab_size)
+        for tid in range(self.vocab_size):
+            try:
+                s = tokenizer.decode([tid])
+            except Exception:
+                continue
+            if s and "�" not in s:
+                b = s.encode("utf-8")
+                self._lib.gbnf_add_token(self._h, tid, b, len(b))
+        for e in getattr(tokenizer, "eos_ids", ()) or ():
+            self._lib.gbnf_add_eos(self._h, int(e))
+        self._mask_cache: dict[int, np.ndarray] = {}
+
+    def __del__(self) -> None:
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.gbnf_free(h)
+            self._h = None
+
+    # --- engine contract (engine/engine.py GenRequest.constraint) ---
+
+    def initial_state(self) -> int:
+        return self._lib.gbnf_initial(self._h)
+
+    def advance(self, state: int, token_id: int) -> int:
+        return self._lib.gbnf_advance(self._h, state, token_id)
+
+    def next_mask(self, state: int) -> np.ndarray:
+        cached = self._mask_cache.get(state)
+        if cached is not None:
+            return cached
+        out = np.zeros(self.vocab_size, np.uint8)
+        self._lib.gbnf_mask(self._h, state, out)
+        mask = out.astype(bool)
+        if len(self._mask_cache) < 4096:
+            self._mask_cache[state] = mask
+        return mask
+
+    # --- test/introspection helpers mirroring GrammarMatcher ---
+
+    def accept_text(self, state: int, text: str) -> int:
+        b = text.encode("utf-8")
+        return self._lib.gbnf_accept_text(self._h, state, b, len(b))
+
+    def can_end(self, state: int) -> bool:
+        return bool(self._lib.gbnf_can_end(self._h, state))
+
+    def is_dead(self, state: int) -> bool:
+        return bool(self._lib.gbnf_is_dead(self._h, state))
+
+    def matches(self, text: str) -> bool:
+        st = self.accept_text(self.initial_state(), text)
+        return self.can_end(st)
+
+
+def make_constraint(gbnf_text: str, tokenizer):
+    """Factory: native engine when built, Python fallback otherwise."""
+    if available():
+        try:
+            return NativeGrammarConstraint(gbnf_text, tokenizer)
+        except (RuntimeError, ValueError):
+            pass
+    from .constrain import GrammarConstraint
+
+    return GrammarConstraint.from_gbnf(gbnf_text, tokenizer)

@@ -1,0 +1,51 @@
+"""Native (C++) components: build + ctypes loading.
+
+The reference keeps its hot paths in C++ (backend/cpp/llama); here the
+TPU compute path is XLA, and the native pieces are the host-side hot
+paths: the GBNF token-mask engine (per-decode-step work under grammar
+constraints) and the vector store scan. Every native component has a
+pure-Python fallback — `load_library` returns None when the .so is absent
+and callers degrade gracefully.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+BUILD_DIR = os.path.join(_DIR, "build")
+
+_cache: dict[str, Optional[ctypes.CDLL]] = {}
+
+
+def build(quiet: bool = True) -> bool:
+    """Invoke make; returns True if the libraries are present after."""
+    try:
+        subprocess.run(
+            ["make", "-C", _DIR],
+            capture_output=quiet, check=True,
+        )
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
+def load_library(name: str, auto_build: bool = False) -> Optional[ctypes.CDLL]:
+    """Load build/lib<name>.so; optionally build it first. None if
+    unavailable (callers fall back to Python)."""
+    if name in _cache:
+        return _cache[name]
+    path = os.path.join(BUILD_DIR, f"lib{name}.so")
+    if not os.path.exists(path) and auto_build:
+        build()
+    lib: Optional[ctypes.CDLL] = None
+    if os.path.exists(path):
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            lib = None
+    _cache[name] = lib
+    return lib

@@ -13,6 +13,8 @@ for small stores).
 
 from __future__ import annotations
 
+import ctypes
+import os
 import threading
 from typing import Optional
 
@@ -21,6 +23,114 @@ import numpy as np
 from ..workers.base import Backend, ModelLoadOptions, Result
 
 _DEVICE_THRESHOLD = 50_000  # rows; above this the matvec moves to jnp
+
+
+class NativeVectorStore:
+    """ctypes wrapper over native/vecstore.cpp — same surface as
+    VectorStore; key storage + similarity scan live in C++, values stay
+    here keyed by row id."""
+
+    def __init__(self) -> None:
+        from ..native import load_library
+
+        lib = load_library("vecstore", auto_build=True)
+        if lib is None:
+            raise RuntimeError("native vecstore unavailable")
+        c = ctypes
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        lib.vs_new.restype = c.c_void_p
+        lib.vs_free.argtypes = [c.c_void_p]
+        lib.vs_len.restype = c.c_int64
+        lib.vs_len.argtypes = [c.c_void_p]
+        lib.vs_set.restype = c.c_int64
+        lib.vs_set.argtypes = [c.c_void_p, f32p, c.c_int64, c.c_int, i64p]
+        lib.vs_get.argtypes = [c.c_void_p, f32p, c.c_int64, i64p]
+        lib.vs_delete.restype = c.c_int64
+        lib.vs_delete.argtypes = [c.c_void_p, f32p, c.c_int64, i64p]
+        lib.vs_find.restype = c.c_int64
+        lib.vs_find.argtypes = [c.c_void_p, f32p, c.c_int64, i64p,
+                                np.ctypeslib.ndpointer(np.float32)]
+        lib.vs_row_key.argtypes = [c.c_void_p, c.c_int64, f32p]
+        lib.vs_dim.restype = c.c_int
+        lib.vs_dim.argtypes = [c.c_void_p]
+        self._lib = lib
+        self._h = lib.vs_new()
+        self._values: list = []
+        self._lock = threading.RLock()
+
+    def __del__(self) -> None:
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.vs_free(h)
+            self._h = None
+
+    def __len__(self) -> int:
+        return int(self._lib.vs_len(self._h))
+
+    def set(self, keys: np.ndarray, values: list) -> None:
+        keys = np.ascontiguousarray(np.atleast_2d(keys), np.float32)
+        if len(values) != keys.shape[0]:
+            raise ValueError("keys and values length mismatch")
+        with self._lock:
+            rows = np.zeros(keys.shape[0], np.int64)
+            total = self._lib.vs_set(
+                self._h, keys, keys.shape[0], keys.shape[1], rows)
+            if total < 0:
+                raise ValueError(
+                    f"key width {keys.shape[1]} != store width "
+                    f"{self._lib.vs_dim(self._h)}")
+            for r, v in zip(rows, values):
+                if r < len(self._values):
+                    self._values[r] = v
+                else:
+                    self._values.append(v)
+
+    def get(self, keys: np.ndarray) -> tuple[np.ndarray, list]:
+        keys = np.ascontiguousarray(np.atleast_2d(keys), np.float32)
+        with self._lock:
+            rows = np.zeros(keys.shape[0], np.int64)
+            self._lib.vs_get(self._h, keys, keys.shape[0], rows)
+            hit = rows >= 0
+            return keys[hit], [self._values[r] for r in rows[hit]]
+
+    def delete(self, keys: np.ndarray) -> int:
+        keys = np.ascontiguousarray(np.atleast_2d(keys), np.float32)
+        with self._lock:
+            remap = np.zeros(max(len(self._values), 1), np.int64)
+            dropped = self._lib.vs_delete(
+                self._h, keys, keys.shape[0], remap)
+            if dropped:
+                self._values = [
+                    v for r, v in enumerate(self._values) if remap[r] >= 0
+                ]
+            return int(dropped)
+
+    def find(self, key: np.ndarray, top_k: int
+             ) -> tuple[np.ndarray, list, np.ndarray]:
+        key = np.ascontiguousarray(np.asarray(key, np.float32).reshape(-1))
+        with self._lock:
+            n = len(self._values)
+            if not n:
+                return (np.zeros((0, key.shape[0]), np.float32), [],
+                        np.zeros((0,), np.float32))
+            rows = np.zeros(min(top_k, n), np.int64)
+            sims = np.zeros(min(top_k, n), np.float32)
+            k = self._lib.vs_find(self._h, key, top_k, rows, sims)
+            out_keys = np.zeros((k, key.shape[0]), np.float32)
+            for j in range(k):
+                self._lib.vs_row_key(self._h, rows[j], out_keys[j])
+            return out_keys, [self._values[r] for r in rows[:k]], sims[:k]
+
+
+def make_store():
+    """Native store when built (unless LOCALAI_NATIVE_STORE=0)."""
+    if os.environ.get("LOCALAI_NATIVE_STORE", "1") not in ("0", "false"):
+        try:
+            return NativeVectorStore()
+        except RuntimeError:
+            pass
+    return VectorStore()
 
 
 class VectorStore:
@@ -134,7 +244,7 @@ class LocalStoreBackend(Backend):
     (ref: backend.proto StoresSet/Delete/Get/Find)."""
 
     def __init__(self) -> None:
-        self.store = VectorStore()
+        self.store = make_store()
 
     def load_model(self, opts: ModelLoadOptions) -> Result:
         return Result(True, "store ready")

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 
 from ..engine.engine import GenRequest, LLMEngine, StreamEvent
 from ..engine.tokenizer import Tokenizer, load_tokenizer
-from ..grammars.constrain import GrammarConstraint
+from ..grammars.native import make_constraint
 from ..models.hf_loader import load_params
 from ..models.llm_spec import LLMSpec
 from .base import (
@@ -49,7 +49,7 @@ class JaxLLMBackend(Backend):
         self.tokenizer: Optional[Tokenizer] = None
         self.spec: Optional[LLMSpec] = None
         self._state = "UNINITIALIZED"
-        self._grammar_cache: dict[str, GrammarConstraint] = {}
+        self._grammar_cache: dict[str, object] = {}
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------- lifecycle
@@ -115,9 +115,8 @@ class JaxLLMBackend(Backend):
         if opts.grammar:
             constraint = self._grammar_cache.get(opts.grammar)
             if constraint is None:
-                constraint = GrammarConstraint.from_gbnf(
-                    opts.grammar, self.tokenizer
-                )
+                # native C++ engine when built; Python fallback otherwise
+                constraint = make_constraint(opts.grammar, self.tokenizer)
                 if len(self._grammar_cache) < 32:
                     self._grammar_cache[opts.grammar] = constraint
         return GenRequest(

@@ -1,0 +1,134 @@
+"""Native C++ components vs their Python references (the native pieces
+are the host-side hot paths: GBNF masks and the vector-store scan)."""
+
+import numpy as np
+import pytest
+
+from localai_tfp_tpu.engine.tokenizer import ByteTokenizer
+from localai_tfp_tpu.grammars.constrain import GrammarConstraint
+from localai_tfp_tpu.grammars.json_schema import schema_to_gbnf
+from localai_tfp_tpu.native import build, load_library
+from localai_tfp_tpu.store.backend import NativeVectorStore, VectorStore
+
+pytestmark = pytest.mark.skipif(
+    not build(), reason="no C++ toolchain available"
+)
+
+JSON_GBNF = schema_to_gbnf(None)  # free-form JSON grammar
+
+SIMPLE = 'root ::= "yes" | "no" | digits\ndigits ::= [0-9]+\n'
+
+
+def _native(text, tok):
+    from localai_tfp_tpu.grammars.native import NativeGrammarConstraint
+
+    return NativeGrammarConstraint(text, tok)
+
+
+def test_native_gbnf_matches_python_masks():
+    tok = ByteTokenizer()
+    py = GrammarConstraint.from_gbnf(SIMPLE, tok)
+    nat = _native(SIMPLE, tok)
+
+    ps, ns = py.initial_state(), nat.initial_state()
+    pm, nm = py.next_mask(ps), nat.next_mask(ns)
+    np.testing.assert_array_equal(pm, nm)
+
+    # walk "y" -> "e" -> "s" and compare masks at every step
+    for ch in "yes":
+        tid = ord(ch)
+        assert pm[tid] and nm[tid]
+        ps, ns = py.advance(ps, tid), nat.advance(ns, tid)
+        pm, nm = py.next_mask(ps), nat.next_mask(ns)
+        np.testing.assert_array_equal(pm, nm)
+    # at end: eos admitted in both
+    eos = next(iter(tok.eos_ids))
+    assert pm[eos] and nm[eos]
+
+
+def test_native_gbnf_json_grammar_walk():
+    tok = ByteTokenizer()
+    py = GrammarConstraint.from_gbnf(JSON_GBNF, tok)
+    nat = _native(JSON_GBNF, tok)
+    text = '{"a": [1, 2.5, true, null], "b": "x"}'
+    ps, ns = py.initial_state(), nat.initial_state()
+    for ch in text:
+        pm, nm = py.next_mask(ps), nat.next_mask(ns)
+        np.testing.assert_array_equal(
+            pm, nm, err_msg=f"mask divergence before {ch!r}")
+        tid = ord(ch)
+        assert pm[tid], f"python rejects {ch!r}"
+        ps, ns = py.advance(ps, tid), nat.advance(ns, tid)
+    assert py.matcher.can_end(ps) and nat.can_end(ns)
+
+
+def test_native_gbnf_rejects_bad_input():
+    tok = ByteTokenizer()
+    nat = _native(SIMPLE, tok)
+    st = nat.accept_text(nat.initial_state(), "maybe")
+    assert nat.is_dead(st)
+    assert nat.matches("42")
+    assert not nat.matches("4a")
+
+
+def test_native_gbnf_parse_error():
+    from localai_tfp_tpu.grammars.native import NativeGrammarConstraint
+
+    with pytest.raises(ValueError):
+        NativeGrammarConstraint("root = missing-assign", ByteTokenizer())
+
+
+# ------------------------------------------------------------------ store
+
+
+def _fill(store, n=50, dim=8, seed=0):
+    rng = np.random.default_rng(seed)
+    keys = rng.standard_normal((n, dim)).astype(np.float32)
+    values = [f"v{i}" for i in range(n)]
+    store.set(keys, values)
+    return keys, values
+
+
+def test_native_store_matches_python():
+    nat = NativeVectorStore()
+    py = VectorStore()
+    keys, values = _fill(nat)
+    _fill(py)
+    assert len(nat) == len(py) == 50
+
+    q = keys[7] + 0.01
+    nk, nv, ns = nat.find(q, 5)
+    pk, pv, ps = py.find(q, 5)
+    assert nv == pv
+    np.testing.assert_allclose(ns, ps, rtol=1e-5)
+    np.testing.assert_allclose(nk, pk, rtol=1e-6)
+
+    # get / upsert / delete parity
+    gk, gv = nat.get(keys[:3])
+    assert gv == values[:3]
+    nat.set(keys[:1], ["replaced"])
+    assert nat.get(keys[:1])[1] == ["replaced"]
+    assert len(nat) == 50
+
+    assert nat.delete(keys[10:20]) == 10
+    assert len(nat) == 40
+    assert nat.get(keys[10:11])[1] == []
+    assert nat.get(keys[25:26])[1] == ["v25"]
+
+
+def test_native_store_normalized_fast_path():
+    nat = NativeVectorStore()
+    rng = np.random.default_rng(1)
+    keys = rng.standard_normal((10, 4)).astype(np.float32)
+    keys /= np.linalg.norm(keys, axis=1, keepdims=True)
+    nat.set(keys, list(range(10)))
+    _, vals, sims = nat.find(keys[3], 1)
+    assert vals == [3]
+    assert sims[0] == pytest.approx(1.0, abs=1e-5)
+
+
+def test_native_store_dim_mismatch():
+    nat = NativeVectorStore()
+    nat.set(np.zeros((1, 4), np.float32), ["a"])
+    with pytest.raises(ValueError):
+        nat.set(np.zeros((1, 8), np.float32), ["b"])
