@@ -44,14 +44,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
 def available() -> bool:
     if os.environ.get("LOCALAI_NATIVE_GBNF", "1") in ("0", "false", "off"):
         return False
-    return load_library("gbnf", auto_build=True) is not None
+    return load_library("gbnf") is not None
 
 
 class NativeGrammarConstraint:
     """Drop-in for GrammarConstraint backed by the C++ engine."""
 
     def __init__(self, gbnf_text: str, tokenizer) -> None:
-        lib = load_library("gbnf", auto_build=True)
+        lib = load_library("gbnf")
         if lib is None:
             raise RuntimeError("native gbnf library unavailable")
         self._lib = _bind(lib)

@@ -538,13 +538,23 @@ void *gbnf_new(const char *grammar_text, char *errbuf, int errlen) {
             strncpy(errbuf, "grammar has no 'root' rule", errlen - 1);
         return nullptr;
     }
+    // a rule that was referenced but never defined has zero alternates
+    // (rid() auto-creates it empty); the Python engine raises KeyError for
+    // this — surface the same error instead of a silently-dead grammar
+    for (size_t r = 0; r < p.rules.size(); r++) {
+        if (p.rules[r].empty()) {
+            if (errbuf && errlen > 0) {
+                std::string msg = "undefined rule '" + p.rule_names[r] + "'";
+                strncpy(errbuf, msg.c_str(), errlen - 1);
+                errbuf[errlen - 1] = 0;
+            }
+            return nullptr;
+        }
+    }
     auto *e = new Engine();
     e->rules = std::move(p.rules);
     e->classes = std::move(p.classes);
     e->root = it->second;
-    // undefined rule refs -> empty rules (dead), matching Python KeyError
-    // avoidance is NOT done: flag as error instead
-    for (auto &r : e->rules) (void)r;
     return e;
 }
 

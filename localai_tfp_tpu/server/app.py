@@ -18,7 +18,10 @@ import uuid
 from aiohttp import web
 
 from .state import Application
-from . import assistants_routes, media_routes, openai_routes, localai_routes
+from . import (
+    assistants_routes, media_routes, openai_routes, localai_routes,
+    ui_routes,
+)
 
 log = logging.getLogger(__name__)
 
@@ -97,6 +100,7 @@ def build_app(state: Application) -> web.Application:
     localai_routes.register(app)
     media_routes.register(app)
     assistants_routes.register(app)
+    ui_routes.register(app)
 
     # static generated-content serving (ref: app.go:158-171)
     import os

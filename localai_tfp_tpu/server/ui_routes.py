@@ -1,0 +1,284 @@
+"""Web UI + swagger.
+
+Ref: core/http/routes/ui.go:91-540 (htmx + Go templates: home, chat,
+text2image, tts, browse gallery w/ install + job progress, p2p dashboard)
+and /swagger (app.go:23). Re-design: dependency-free vanilla-JS pages
+talking to the same public REST API the CLI uses — no server-side state
+beyond the existing endpoints.
+"""
+
+from __future__ import annotations
+
+import json
+
+from aiohttp import web
+
+from ..config.model_config import Usecase
+from ..version import __version__
+from .common import state_of
+
+
+def register(app: web.Application) -> None:
+    r = app.router
+    r.add_get("/", home)
+    r.add_get("/browse", browse)
+    r.add_get("/chat/{model}", chat)
+    r.add_get("/chat/", chat)
+    r.add_get("/text2image/{model}", text2image)
+    r.add_get("/tts/{model}", tts_page)
+    r.add_get("/talk/", talk)
+    r.add_get("/p2p", p2p_page)
+    r.add_get("/swagger/index.html", swagger_ui)
+    r.add_get("/swagger/doc.json", swagger_json)
+
+
+_STYLE = """
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem auto;max-width:60rem;
+      padding:0 1rem;background:#10141a;color:#e6e6e6}
+ a{color:#7ab7ff} h1{font-size:1.4rem} h2{font-size:1.1rem}
+ .card{background:#1a212b;border-radius:8px;padding:1rem;margin:.6rem 0}
+ input,textarea,select{width:100%;box-sizing:border-box;background:#0d1117;
+      color:#e6e6e6;border:1px solid #333;border-radius:6px;padding:.5rem}
+ button{background:#2d6cdf;color:#fff;border:0;border-radius:6px;
+      padding:.5rem 1rem;cursor:pointer;margin-top:.5rem}
+ pre{white-space:pre-wrap;word-break:break-word}
+ .muted{color:#8a93a2;font-size:.85rem}
+ nav a{margin-right:1rem}
+</style>
+"""
+
+
+def _page(title: str, body: str) -> web.Response:
+    html = f"""<!doctype html><html><head><meta charset="utf-8">
+<title>{title} — LocalAI-TPU</title>{_STYLE}</head><body>
+<nav><a href="/">home</a><a href="/browse">gallery</a>
+<a href="/p2p">federation</a><a href="/swagger/index.html">api</a></nav>
+<h1>{title}</h1>{body}
+<p class="muted">localai_tfp_tpu {__version__}</p></body></html>"""
+    return web.Response(text=html, content_type="text/html")
+
+
+async def home(request: web.Request) -> web.Response:
+    st = state_of(request)
+    rows = []
+    for cfg in st.config_loader.all():
+        links = [f'<a href="/chat/{cfg.name}">chat</a>']
+        if cfg.has_usecase(Usecase.IMAGE):
+            links.append(f'<a href="/text2image/{cfg.name}">image</a>')
+        if cfg.has_usecase(Usecase.TTS):
+            links.append(f'<a href="/tts/{cfg.name}">tts</a>')
+        loaded = st.model_loader.get(cfg.name) is not None
+        rows.append(
+            f'<div class="card"><b>{cfg.name}</b> '
+            f'<span class="muted">backend={cfg.backend or "auto"}'
+            f'{" · loaded" if loaded else ""}</span><br>'
+            + " ".join(links) + "</div>"
+        )
+    body = "".join(rows) or "<p>No models installed — try the gallery.</p>"
+    return _page("Models", body)
+
+
+async def chat(request: web.Request) -> web.Response:
+    model = request.match_info.get("model", "")
+    body = f"""
+<div class="card"><div id="log"></div>
+<textarea id="msg" rows="3" placeholder="Say something"></textarea>
+<button onclick="send()">Send</button></div>
+<script>
+const model={json.dumps(model)};
+let history=[];
+async function send(){{
+ const text=document.getElementById('msg').value;
+ if(!text)return;
+ history.push({{role:'user',content:text}});
+ log('user',text);
+ document.getElementById('msg').value='';
+ const r=await fetch('/v1/chat/completions',{{method:'POST',
+   headers:{{'Content-Type':'application/json'}},
+   body:JSON.stringify({{model:model||undefined,messages:history,
+                         stream:true}})}});
+ const reader=r.body.getReader();const dec=new TextDecoder();
+ let acc='';const el=log('assistant','');
+ for(;;){{const{{done,value}}=await reader.read();if(done)break;
+  for(const line of dec.decode(value).split('\\n')){{
+   if(!line.startsWith('data: ')||line.includes('[DONE]'))continue;
+   try{{const d=JSON.parse(line.slice(6));
+    acc+=(d.choices[0].delta&&d.choices[0].delta.content)||'';
+    el.textContent=acc;}}catch(e){{}}}}}}
+ history.push({{role:'assistant',content:acc}});
+}}
+function log(role,text){{const d=document.createElement('pre');
+ d.innerHTML='<b>'+role+':</b> ';const s=document.createElement('span');
+ s.textContent=text;d.appendChild(s);
+ document.getElementById('log').appendChild(d);return s;}}
+</script>"""
+    return _page(f"Chat — {model or 'default model'}", body)
+
+
+async def text2image(request: web.Request) -> web.Response:
+    model = request.match_info["model"]
+    body = f"""
+<div class="card"><input id="prompt" placeholder="a sunset over the sea">
+<button onclick="gen()">Generate</button><div id="out"></div></div>
+<script>
+async function gen(){{
+ const r=await fetch('/v1/images/generations',{{method:'POST',
+  headers:{{'Content-Type':'application/json'}},
+  body:JSON.stringify({{model:{json.dumps(model)},
+   prompt:document.getElementById('prompt').value,size:'256x256'}})}});
+ const d=await r.json();
+ document.getElementById('out').innerHTML=
+  d.data?d.data.map(x=>'<img src="'+x.url+'" width=256>').join(''):
+  '<pre>'+JSON.stringify(d)+'</pre>';
+}}
+</script>"""
+    return _page(f"Text to image — {model}", body)
+
+
+async def tts_page(request: web.Request) -> web.Response:
+    model = request.match_info["model"]
+    body = f"""
+<div class="card"><input id="text" placeholder="Hello world">
+<button onclick="speak()">Speak</button><div id="out"></div></div>
+<script>
+async function speak(){{
+ const r=await fetch('/v1/audio/speech',{{method:'POST',
+  headers:{{'Content-Type':'application/json'}},
+  body:JSON.stringify({{model:{json.dumps(model)},
+   input:document.getElementById('text').value}})}});
+ const b=await r.blob();
+ document.getElementById('out').innerHTML=
+  '<audio controls autoplay src="'+URL.createObjectURL(b)+'"></audio>';
+}}
+</script>"""
+    return _page(f"TTS — {model}", body)
+
+
+async def talk(request: web.Request) -> web.Response:
+    body = """
+<div class="card"><p>Record, transcribe, answer, speak
+(chat + whisper + tts round trip).</p>
+<button id="rec" onclick="toggle()">Start recording</button>
+<div id="out"></div></div>
+<script>
+let mr,chunks=[];
+async function toggle(){
+ const b=document.getElementById('rec');
+ if(mr&&mr.state==='recording'){mr.stop();b.textContent='Start recording';return;}
+ const stream=await navigator.mediaDevices.getUserMedia({audio:true});
+ mr=new MediaRecorder(stream);chunks=[];
+ mr.ondataavailable=e=>chunks.push(e.data);
+ mr.onstop=run; mr.start(); b.textContent='Stop';
+}
+async function run(){
+ const form=new FormData();
+ form.append('file',new Blob(chunks),'audio.webm');
+ const t=await (await fetch('/v1/audio/transcriptions',
+   {method:'POST',body:form})).json();
+ const out=document.getElementById('out');
+ out.innerHTML='<pre>you: '+t.text+'</pre>';
+ const c=await (await fetch('/v1/chat/completions',{method:'POST',
+  headers:{'Content-Type':'application/json'},
+  body:JSON.stringify({messages:[{role:'user',content:t.text}]})})).json();
+ const reply=c.choices[0].message.content;
+ out.innerHTML+='<pre>assistant: '+reply+'</pre>';
+ const a=await (await fetch('/v1/audio/speech',{method:'POST',
+  headers:{'Content-Type':'application/json'},
+  body:JSON.stringify({input:reply})})).blob();
+ out.innerHTML+='<audio controls autoplay src="'
+   +URL.createObjectURL(a)+'"></audio>';
+}
+</script>"""
+    return _page("Talk", body)
+
+
+async def browse(request: web.Request) -> web.Response:
+    body = """
+<div class="card"><input id="q" placeholder="filter..."
+ oninput="render()"><div id="list">loading…</div></div>
+<script>
+let models=[];
+async function load(){
+ models=await (await fetch('/models/available')).json();render();}
+function render(){
+ const q=document.getElementById('q').value.toLowerCase();
+ document.getElementById('list').innerHTML=models
+  .filter(m=>m.name.toLowerCase().includes(q))
+  .map(m=>'<div class="card"><b>'+m.name+'</b> '+
+   (m.installed?'<span class="muted">installed</span>':
+    '<button onclick="install(\\''+m.name+'\\',this)">install</button>')+
+   '<br><span class="muted">'+(m.description||'')+'</span></div>')
+  .join('')||'<p>No gallery models (configure galleries).</p>';}
+async function install(name,btn){
+ btn.disabled=true;
+ const r=await (await fetch('/models/apply',{method:'POST',
+  headers:{'Content-Type':'application/json'},
+  body:JSON.stringify({id:name})})).json();
+ poll(r.uuid,btn);}
+async function poll(id,btn){
+ const s=await (await fetch('/models/jobs/'+id)).json();
+ btn.textContent=s.processed?(s.error?'error':'done')
+   :(s.progress|0)+'%';
+ if(!s.processed)setTimeout(()=>poll(id,btn),700);else load();}
+load();
+</script>"""
+    return _page("Model gallery", body)
+
+
+async def p2p_page(request: web.Request) -> web.Response:
+    body = """
+<div class="card"><div id="out">loading…</div></div>
+<script>
+async function load(){
+ const d=await (await fetch('/api/p2p')).json();
+ document.getElementById('out').innerHTML=
+  (d.enabled?'':'<p>Federation disabled (no token configured).</p>')+
+  (d.nodes||[]).map(n=>'<div class="card"><b>'+n.name+'</b> '+n.address+
+   ' — '+(n.online?'online':'offline')+
+   ' · served '+n.requests_served+'</div>').join('');}
+load();setInterval(load,5000);
+</script>"""
+    return _page("Federation", body)
+
+
+# ----------------------------------------------------------------- swagger
+
+
+async def swagger_json(request: web.Request) -> web.Response:
+    """Machine-readable API description assembled from the live router."""
+    paths: dict = {}
+    for route in request.app.router.routes():
+        info = route.resource.get_info() if route.resource else {}
+        path = info.get("path") or info.get("formatter")
+        if not path or path.startswith("/swagger"):
+            continue
+        method = route.method.lower()
+        if method in ("head", "options", "*"):
+            continue
+        handler_doc = (route.handler.__doc__ or "").strip().split("\n")[0]
+        paths.setdefault(path, {})[method] = {
+            "summary": handler_doc,
+            "responses": {"200": {"description": "OK"}},
+        }
+    return web.json_response({
+        "openapi": "3.0.0",
+        "info": {"title": "LocalAI-TPU API", "version": __version__},
+        "paths": dict(sorted(paths.items())),
+    })
+
+
+async def swagger_ui(request: web.Request) -> web.Response:
+    body = """
+<div class="card"><div id="out">loading…</div></div>
+<script>
+async function load(){
+ const d=await (await fetch('/swagger/doc.json')).json();
+ document.getElementById('out').innerHTML=Object.entries(d.paths)
+  .map(([p,ms])=>'<div class="card"><b>'+p+'</b><br>'+
+    Object.entries(ms).map(([m,i])=>m.toUpperCase()+
+      ' <span class="muted">'+(i.summary||'')+'</span>').join('<br>')+
+   '</div>').join('');}
+load();
+</script>"""
+    return _page("API", body)

@@ -33,7 +33,7 @@ class NativeVectorStore:
     def __init__(self) -> None:
         from ..native import load_library
 
-        lib = load_library("vecstore", auto_build=True)
+        lib = load_library("vecstore")
         if lib is None:
             raise RuntimeError("native vecstore unavailable")
         c = ctypes
@@ -68,6 +68,14 @@ class NativeVectorStore:
     def __len__(self) -> int:
         return int(self._lib.vs_len(self._h))
 
+    def _check_dim(self, keys: np.ndarray) -> None:
+        """The C side trusts the caller's width; enforce it here (the
+        Python fallback raises the same way)."""
+        dim = self._lib.vs_dim(self._h)
+        if dim and keys.shape[-1] != dim:
+            raise ValueError(
+                f"key width {keys.shape[-1]} != store width {dim}")
+
     def set(self, keys: np.ndarray, values: list) -> None:
         keys = np.ascontiguousarray(np.atleast_2d(keys), np.float32)
         if len(values) != keys.shape[0]:
@@ -89,6 +97,7 @@ class NativeVectorStore:
     def get(self, keys: np.ndarray) -> tuple[np.ndarray, list]:
         keys = np.ascontiguousarray(np.atleast_2d(keys), np.float32)
         with self._lock:
+            self._check_dim(keys)
             rows = np.zeros(keys.shape[0], np.int64)
             self._lib.vs_get(self._h, keys, keys.shape[0], rows)
             hit = rows >= 0
@@ -97,6 +106,7 @@ class NativeVectorStore:
     def delete(self, keys: np.ndarray) -> int:
         keys = np.ascontiguousarray(np.atleast_2d(keys), np.float32)
         with self._lock:
+            self._check_dim(keys)
             remap = np.zeros(max(len(self._values), 1), np.int64)
             dropped = self._lib.vs_delete(
                 self._h, keys, keys.shape[0], remap)
@@ -110,6 +120,7 @@ class NativeVectorStore:
              ) -> tuple[np.ndarray, list, np.ndarray]:
         key = np.ascontiguousarray(np.asarray(key, np.float32).reshape(-1))
         with self._lock:
+            self._check_dim(key[None])
             n = len(self._values)
             if not n:
                 return (np.zeros((0, key.shape[0]), np.float32), [],
@@ -125,7 +136,8 @@ class NativeVectorStore:
 
 def make_store():
     """Native store when built (unless LOCALAI_NATIVE_STORE=0)."""
-    if os.environ.get("LOCALAI_NATIVE_STORE", "1") not in ("0", "false"):
+    if os.environ.get("LOCALAI_NATIVE_STORE", "1") not in ("0", "false",
+                                                           "off"):
         try:
             return NativeVectorStore()
         except RuntimeError:

@@ -1,0 +1,64 @@
+"""Web UI + swagger route tests (ref: routes/ui.go surface)."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from localai_tfp_tpu.config.app_config import ApplicationConfig
+from localai_tfp_tpu.server.app import build_app
+from localai_tfp_tpu.server.state import Application
+
+
+@pytest.fixture(scope="module")
+def client(tmp_path_factory):
+    root = tmp_path_factory.mktemp("ui")
+    (root / "models").mkdir()
+    (root / "models" / "voice.yaml").write_text(
+        "name: voice\nbackend: jax-tts\n")
+    loop = asyncio.new_event_loop()
+    cfg = ApplicationConfig(
+        models_path=str(root / "models"),
+        generated_content_dir=str(root / "generated"),
+        upload_dir=str(root / "uploads"),
+        config_dir=str(root / "configuration"),
+    )
+    app = build_app(Application(cfg))
+    tc = TestClient(TestServer(app), loop=loop)
+    loop.run_until_complete(tc.start_server())
+
+    def get(path):
+        async def go():
+            r = await tc.get(path)
+            return r.status, await r.read()
+        return loop.run_until_complete(go())
+
+    yield get
+    loop.run_until_complete(tc.close())
+    loop.close()
+
+
+@pytest.mark.parametrize("path", [
+    "/", "/browse", "/chat/voice", "/chat/", "/text2image/voice",
+    "/tts/voice", "/talk/", "/p2p", "/swagger/index.html",
+])
+def test_ui_pages_render(client, path):
+    status, body = client(path)
+    assert status == 200
+    assert b"<html" in body
+
+
+def test_home_lists_models(client):
+    _, body = client("/")
+    assert b"voice" in body
+
+
+def test_swagger_doc_covers_api(client):
+    status, body = client("/swagger/doc.json")
+    assert status == 200
+    doc = json.loads(body)
+    for path in ("/v1/chat/completions", "/v1/embeddings", "/tts",
+                 "/v1/rerank", "/models/apply", "/v1/audio/transcriptions",
+                 "/v1/images/generations", "/v1/assistants"):
+        assert path in doc["paths"], path
