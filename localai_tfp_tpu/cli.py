@@ -116,6 +116,12 @@ def build_parser() -> argparse.ArgumentParser:
     worker.add_argument("--port", type=int, default=8081)
     worker.add_argument("--models-path", default=None)
 
+    exp = sub.add_parser("explorer", help="run the network directory")
+    exp.add_argument("--address", default="0.0.0.0")
+    exp.add_argument("--port", type=int, default=8080)
+    exp.add_argument("--db", default="explorer.json")
+    exp.add_argument("--interval", type=float, default=60.0)
+
     util = sub.add_parser("util", help="utilities")
     usub = util.add_subparsers(dest="util_command")
     usub.add_parser("version")
@@ -193,9 +199,18 @@ def main(argv: Optional[list[str]] = None) -> None:
     if args.command in (None, "run"):
         if args.command is None:
             args = parser.parse_args(["run"])
+        from .parallel import distributed
         from .server.app import run as run_server
         from .server.state import Application
 
+        if distributed.initialize():
+            # multi-host slice: rank 0 serves; the follower dispatch loop
+            # (SURVEY.md §7 hard part #5) is not implemented yet — refuse
+            # loudly rather than deadlock the collectives
+            if not distributed.is_coordinator():
+                sys.exit(
+                    "error: multi-host follower serving is not implemented "
+                    "yet; run the server on the coordinator host only")
         cfg = _app_config(args)
         state = Application(cfg)
         _preload(state, cfg.preload_models)
@@ -252,6 +267,19 @@ def main(argv: Optional[list[str]] = None) -> None:
             sys.exit("error: worker needs --p2p-token (or LOCALAI_P2P_TOKEN)"
                      " to join a federation")
         run_server(Application(cfg))
+
+    elif args.command == "explorer":
+        from aiohttp import web as _web
+
+        from .parallel.explorer import (
+            DiscoveryServer, ExplorerDB, build_app as build_explorer,
+        )
+
+        db = ExplorerDB(args.db)
+        disc = DiscoveryServer(db, interval=args.interval)
+        disc.start()
+        _web.run_app(build_explorer(db, disc), host=args.address,
+                     port=args.port)
 
     elif args.command == "util":
         if args.util_command == "new-token":

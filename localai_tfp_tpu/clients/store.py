@@ -1,0 +1,55 @@
+"""Typed client for the vector-stores REST API.
+
+Ref: core/clients/store.go (151 LoC) — SetCols/GetCols/DeleteCols/Find
+over /stores/{set,get,delete,find}. Pure stdlib.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Optional, Sequence
+
+
+class StoreClient:
+    def __init__(self, base_url: str, api_key: str = "",
+                 store: str = "") -> None:
+        self.base_url = base_url.rstrip("/")
+        self.api_key = api_key
+        self.store = store
+
+    def _post(self, path: str, payload: dict) -> dict:
+        if self.store:
+            payload.setdefault("store", self.store)
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=json.dumps(payload).encode(),
+            headers={
+                "Content-Type": "application/json",
+                **({"Authorization": f"Bearer {self.api_key}"}
+                   if self.api_key else {}),
+            },
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            body = r.read()
+        return json.loads(body) if body else {}
+
+    def set(self, keys: Sequence[Sequence[float]],
+            values: Sequence[str]) -> None:
+        self._post("/stores/set", {"keys": [list(k) for k in keys],
+                                   "values": list(values)})
+
+    def get(self, keys: Sequence[Sequence[float]]
+            ) -> tuple[list[list[float]], list[str]]:
+        out = self._post("/stores/get", {"keys": [list(k) for k in keys]})
+        return out.get("keys") or [], out.get("values") or []
+
+    def delete(self, keys: Sequence[Sequence[float]]) -> None:
+        self._post("/stores/delete", {"keys": [list(k) for k in keys]})
+
+    def find(self, key: Sequence[float], topk: int = 10
+             ) -> tuple[list[list[float]], list[str], list[float]]:
+        out = self._post("/stores/find",
+                         {"key": list(key), "topk": topk})
+        return (out.get("keys") or [], out.get("values") or [],
+                out.get("similarities") or [])

@@ -1,0 +1,78 @@
+"""Dynamic-config hot reload.
+
+Ref: core/application/config_file_watcher.go (180 LoC) — fsnotify (with a
+poll fallback) on the configuration dir, hot-reloading ``api_keys.json``
+and ``external_backends.json``. Here the poll path IS the implementation
+(no inotify dependency; 2s mtime polling is the reference's own fallback
+behavior).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+Handler = Callable[[object], None]  # receives parsed JSON
+
+
+class ConfigWatcher:
+    def __init__(self, config_dir: str, *, interval: float = 2.0) -> None:
+        self.config_dir = config_dir
+        self.interval = interval
+        self._handlers: dict[str, Handler] = {}
+        self._mtimes: dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def watch(self, filename: str, handler: Handler) -> None:
+        self._handlers[filename] = handler
+
+    def start(self) -> None:
+        for fname in self._handlers:  # apply current contents at boot
+            self._check(fname, first=True)
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="config-watcher", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            for fname in self._handlers:
+                self._check(fname)
+
+    def _check(self, fname: str, first: bool = False) -> None:
+        path = os.path.join(self.config_dir, fname)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            if self._mtimes.pop(fname, None) is not None:
+                self._fire(fname, None)  # file removed
+            return
+        if not first and self._mtimes.get(fname) == mtime:
+            return
+        self._mtimes[fname] = mtime
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            log.warning("ignoring unparseable %s: %s", fname, e)
+            return
+        self._fire(fname, data)
+
+    def _fire(self, fname: str, data) -> None:
+        try:
+            self._handlers[fname](data)
+            log.info("reloaded %s", fname)
+        except Exception as e:
+            log.warning("handler for %s failed: %s", fname, e)

@@ -174,9 +174,12 @@ class LLMEngine:
         cache_dtype: Any = jnp.bfloat16,
         penalty_window: int = 256,
         decode_steps: int = 8,
+        mesh: Any = None,  # jax.sharding.Mesh: TP/DP serving (the GSPMD
+        # counterpart of tensor_split / tensor_parallel_size — SURVEY §2.5)
         autostart: bool = True,
     ) -> None:
         self.decode_steps = max(1, decode_steps)
+        self.mesh = mesh
         self._autostart = autostart
         self.spec = spec
         self.params = params
@@ -190,6 +193,13 @@ class LLMEngine:
         self.sampling = SamplingState.create(
             n_slots, spec.vocab_size, window=penalty_window
         )
+        if mesh is not None:
+            from ..parallel.sharding import shard_engine_state, shard_params
+
+            self.params = shard_params(self.params, mesh)
+            self.cache, self.sampling = shard_engine_state(
+                self.cache, self.sampling, mesh
+            )
         self.slots = [_Slot(i) for i in range(n_slots)]
         self._use_kernel = self._kernel_eligible()
         self._pending: list[tuple[GenRequest, queue.SimpleQueue]] = []
@@ -253,6 +263,7 @@ class LLMEngine:
             return False
         return env not in ("0", "false", "off") and (
             not _interpret()
+            and self.mesh is None  # kernels need shard_map under a mesh
             and self.max_seq % PAGE == 0
             and self.spec.kv_dim % 128 == 0
             and not self.spec.attn_logit_softcap

@@ -1,0 +1,70 @@
+"""Multi-host topology: jax.distributed wiring + serving coordinator.
+
+The reference distributes across machines with llama.cpp RPC workers
+discovered over libp2p (SURVEY.md §2.5 row 3: worker_p2p.go, ggml RPC) —
+per-tensor-op network round trips. The TPU-native shape is different and
+strictly stronger: every host in a slice runs the SAME SPMD program;
+XLA moves data over ICI/DCN collectives, and only ONE host (rank 0)
+serves HTTP while the others follow the identical dispatch sequence
+(SURVEY.md §7 hard part #5).
+
+`initialize()` wires `jax.distributed`; `is_coordinator()` gates the HTTP
+listener; `global_mesh()` builds a mesh over all hosts' devices. The
+driver validates the single-host multi-chip path via __graft_entry__;
+multi-host needs real DCN and is exercised operationally.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+from .mesh import make_mesh
+
+log = logging.getLogger(__name__)
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize jax.distributed from args or the standard env vars
+    (LOCALAI_COORDINATOR / JAX_COORDINATOR_ADDRESS etc.). Returns True if
+    a multi-process runtime was set up, False for single-host."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "LOCALAI_COORDINATOR") or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not coordinator_address:
+        return False
+    kwargs = {}
+    if num_processes is None and os.environ.get("LOCALAI_NUM_HOSTS"):
+        num_processes = int(os.environ["LOCALAI_NUM_HOSTS"])
+    if process_id is None and os.environ.get("LOCALAI_HOST_ID"):
+        process_id = int(os.environ["LOCALAI_HOST_ID"])
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(coordinator_address, **kwargs)
+    log.info(
+        "jax.distributed initialized: process %d / %d, %d local of %d "
+        "global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+    return True
+
+
+def is_coordinator() -> bool:
+    """Rank 0 serves HTTP; followers run the same SPMD dispatches."""
+    return jax.process_index() == 0
+
+
+def global_mesh(shape: Optional[dict[str, int]] = None):
+    """Mesh over every device of every host. Axis sizes follow the
+    config surface (ApplicationConfig.mesh_shape / ModelConfig.mesh),
+    defaulting the leftover to the model (TP) axis."""
+    return make_mesh(shape, devices=jax.devices())

@@ -1,0 +1,162 @@
+"""Federation explorer: a public directory of federated networks.
+
+Ref: core/explorer — DiscoveryServer crawls registered networks, tracks
+dial failures and deletes networks after a failure threshold
+(discovery.go:16-30), persists a JSON database (database.go:125), and
+serves a dashboard endpoint. Here a "network" is a balancer URL (+ its
+join token); liveness = the balancer's /federation/nodes answering.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+FAILURE_THRESHOLD = 3  # ref: explorer deletes after N failed dials
+
+
+@dataclass
+class NetworkEntry:
+    name: str
+    url: str  # balancer address
+    token: str = ""
+    description: str = ""
+    failures: int = 0
+    nodes_online: int = 0
+    last_checked: float = 0.0
+
+
+class ExplorerDB:
+    """JSON-file-backed network directory (ref: explorer/database.go)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._lock = threading.Lock()
+        self._entries: dict[str, NetworkEntry] = {}
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path) as f:
+                for d in json.load(f):
+                    e = NetworkEntry(**d)
+                    self._entries[e.name] = e
+        except (OSError, ValueError, TypeError):
+            pass
+
+    def _save_locked(self) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump([asdict(e) for e in self._entries.values()], f,
+                      indent=1)
+        os.replace(tmp, self.path)
+
+    def add(self, entry: NetworkEntry) -> None:
+        with self._lock:
+            self._entries[entry.name] = entry
+            self._save_locked()
+
+    def remove(self, name: str) -> bool:
+        with self._lock:
+            e = self._entries.pop(name, None)
+            if e is not None:
+                self._save_locked()
+            return e is not None
+
+    def all(self) -> list[NetworkEntry]:
+        with self._lock:
+            return sorted(self._entries.values(), key=lambda e: e.name)
+
+    def update(self, name: str, **kw) -> None:
+        with self._lock:
+            e = self._entries.get(name)
+            if e is None:
+                return
+            for k, v in kw.items():
+                setattr(e, k, v)
+            self._save_locked()
+
+
+class DiscoveryServer:
+    """Periodic crawler (ref: explorer/discovery.go DiscoveryServer)."""
+
+    def __init__(self, db: ExplorerDB, *, interval: float = 60.0) -> None:
+        self.db = db
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def check_network(self, entry: NetworkEntry) -> int:
+        """Dial the balancer; returns online node count (raises on error)."""
+        with urllib.request.urlopen(
+            entry.url.rstrip("/") + "/federation/nodes", timeout=10
+        ) as r:
+            nodes = json.load(r)
+        return sum(1 for n in nodes if n.get("online"))
+
+    def sweep(self) -> None:
+        for e in self.db.all():
+            try:
+                online = self.check_network(e)
+                self.db.update(e.name, failures=0, nodes_online=online,
+                               last_checked=time.time())
+            except Exception:
+                failures = e.failures + 1
+                if failures >= FAILURE_THRESHOLD:
+                    self.db.remove(e.name)
+                else:
+                    self.db.update(e.name, failures=failures,
+                                   last_checked=time.time())
+
+    def start(self) -> None:
+        if self._thread is None:
+            def run():
+                while not self._stop.wait(self.interval):
+                    self.sweep()
+
+            self._thread = threading.Thread(
+                target=run, name="explorer-discovery", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def build_app(db: ExplorerDB, discovery: DiscoveryServer):
+    """Dashboard + registration API (ref: explorer dashboard endpoint)."""
+    from aiohttp import web
+
+    async def networks(request):
+        return web.json_response([asdict(e) for e in db.all()])
+
+    async def add(request):
+        body = await request.json()
+        if not body.get("name") or not body.get("url"):
+            raise web.HTTPBadRequest(reason="'name' and 'url' required")
+        db.add(NetworkEntry(
+            name=body["name"], url=body["url"],
+            token=body.get("token", ""),
+            description=body.get("description", ""),
+        ))
+        return web.json_response({"ok": True})
+
+    async def remove(request):
+        ok = db.remove(request.match_info["name"])
+        if not ok:
+            raise web.HTTPNotFound()
+        return web.json_response({"ok": True})
+
+    app = web.Application()
+    app.router.add_get("/networks", networks)
+    app.router.add_post("/network", add)
+    app.router.add_delete("/network/{name}", remove)
+    return app

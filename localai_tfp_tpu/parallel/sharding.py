@@ -11,8 +11,9 @@ tensor_parallel_size knobs — ref: backend.proto:185, vllm/backend.py:106):
 - Embedding + lm_head: vocab-sharded over "model".
 - Norms/biases on the model dim: replicated (biases on sharded dims follow
   their projection).
-- KV cache [L, slots, S, Hkv, Dh]: slots over "data", kv heads over
-  "model", seq over "seq" for context parallelism.
+- KV cache [L, slots, max_seq, kv_dim] (head-flat): slots over "data",
+  the flat head dim over "model". Sequence-dim sharding lives in
+  ring_attention.py (prefill/training), not in the serving cache.
 
 All rules are expressed as PartitionSpecs keyed by parameter name so they
 apply to any LLMSpec without per-family code.
@@ -51,9 +52,31 @@ PARAM_RULES: dict[str, P] = {
     "final_norm_b": P(None),
 }
 
-KV_CACHE_SPEC = P(None, "data", "seq", "model", None)
+# KV cache is [L, n_slots, max_seq, kv_dim] (head-flat — models/transformer
+# KVCache): slots ride "data", the flat head dim rides "model"
+KV_CACHE_SPEC = P(None, "data", None, "model")
 TOKENS_SPEC = P("data", "seq")
 BATCH_SPEC = P("data")
+
+
+def shard_engine_state(cache, sampling, mesh: Mesh):
+    """Place the serving engine's device state on the mesh: KV cache rows
+    over "data"/"model", per-slot sampler state over "data" (scalars and
+    vocab-width rows follow their leading slot dim)."""
+    def put(arr, spec):
+        fixed = _divisible_spec(arr.shape, spec, mesh)
+        return jax.device_put(arr, NamedSharding(mesh, fixed))
+
+    cache = type(cache)(
+        k=put(cache.k, KV_CACHE_SPEC), v=put(cache.v, KV_CACHE_SPEC)
+    )
+    leaves, treedef = jax.tree_util.tree_flatten(sampling)
+    out = []
+    for leaf in leaves:
+        spec = P(*(("data",) + (None,) * (leaf.ndim - 1))) if leaf.ndim \
+            else P()
+        out.append(put(leaf, spec))
+    return cache, jax.tree_util.tree_unflatten(treedef, out)
 
 
 def param_specs(params: dict) -> dict[str, P]:

@@ -104,7 +104,11 @@ async def system(request: web.Request) -> web.Response:
 
 async def backend_monitor(request: web.Request) -> web.Response:
     """ref: core/services/backend_monitor.go + endpoints /backend/monitor:
-    per-model status + process-level memory."""
+    per-model status + process memory/CPU (gopsutil equivalent via
+    /proc; workers are in-process here, so process stats are the backend
+    stats)."""
+    import asyncio as _asyncio
+    import os
     import resource
 
     st = _state(request)
@@ -117,10 +121,33 @@ async def backend_monitor(request: web.Request) -> web.Response:
         raise web.HTTPNotFound(reason=f"model '{name}' not loaded")
     status = lm.backend.status()
     rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+    def cpu_times() -> float:
+        r = resource.getrusage(resource.RUSAGE_SELF)
+        return r.ru_utime + r.ru_stime
+
+    t0, c0 = _asyncio.get_running_loop().time(), cpu_times()
+    await _asyncio.sleep(0.1)
+    dt = _asyncio.get_running_loop().time() - t0
+    cpu_percent = 100.0 * (cpu_times() - c0) / max(dt, 1e-6)
+    rss_now = 0
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    rss_now = int(line.split()[1]) * 1024
+                    break
+    except OSError:
+        pass
     return web.json_response({
-        "memory_info": {"rss": rss_kb * 1024},
+        "memory_info": {"rss": rss_now or rss_kb * 1024,
+                        "peak_rss": rss_kb * 1024,
+                        **status.memory},
+        "cpu_percent": round(cpu_percent, 2),
+        "pid": os.getpid(),
         "status": status.state,
         "backend": lm.backend_type,
+        "busy": lm.busy_since is not None,
     })
 
 

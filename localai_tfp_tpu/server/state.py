@@ -102,7 +102,58 @@ class Application:
         log.info("loaded %d model configs from %s", n,
                  self.config.models_path)
         self.watchdog.start()
+        self._start_config_watcher()
+
+    def _start_config_watcher(self) -> None:
+        """Hot-reload of api_keys.json / external_backends.json
+        (ref: core/application/config_file_watcher.go)."""
+        from ..config.watcher import ConfigWatcher
+
+        self.config_watcher = ConfigWatcher(str(self.config.config_dir))
+        startup_keys = list(self.config.api_keys)
+
+        def on_api_keys(data) -> None:
+            # file keys EXTEND the startup keys; removal restores them
+            # (ref: config_file_watcher.go readApiKeysJson — never lets a
+            # dropped file disable auth that was configured at boot)
+            file_keys = [str(k) for k in data] if isinstance(data, list) \
+                else []
+            self.config.api_keys = startup_keys + [
+                k for k in file_keys if k not in startup_keys
+            ]
+
+        def on_external_backends(data) -> None:
+            from ..engine.loader import ALIASES, registry
+            from ..workers.remote import RemoteOpenAIBackend
+
+            for name, spec in (data or {}).items():
+                if isinstance(spec, str):
+                    spec = {"base_url": spec}
+                url = spec.get("base_url") or spec.get("uri") or ""
+                key = spec.get("api_key", "")
+                lname = name.strip().lower()
+                if lname in ALIASES:  # would shadow/alias a builtin
+                    log.warning(
+                        "external backend name '%s' collides with a "
+                        "builtin alias; skipping", name)
+                    continue
+                # lookups lowercase via resolve_backend, so register the
+                # lowercased name
+                registry.register(
+                    lname,
+                    lambda url=url, key=key: RemoteOpenAIBackend(url, key),
+                )
+                log.info("registered external backend '%s' -> %s",
+                         name, url)
+
+        self.config_watcher.watch("api_keys.json", on_api_keys)
+        self.config_watcher.watch("external_backends.json",
+                                  on_external_backends)
+        self.config_watcher.start()
 
     def shutdown(self) -> None:
+        watcher = getattr(self, "config_watcher", None)
+        if watcher is not None:
+            watcher.stop()
         self.watchdog.stop()
         self.model_loader.stop_all()

@@ -12,6 +12,7 @@ import threading
 import time
 from typing import Iterator, Optional
 
+import jax
 import jax.numpy as jnp
 
 from ..engine.engine import GenRequest, LLMEngine, StreamEvent
@@ -73,6 +74,11 @@ class JaxLLMBackend(Backend):
                     (opts.kv_cache_dtype or opts.dtype or "bfloat16").lower(),
                     dtype,
                 )
+                mesh = None
+                if opts.mesh:
+                    from ..parallel.mesh import make_mesh
+
+                    mesh = make_mesh(opts.mesh)
                 self.engine = LLMEngine(
                     self.spec,
                     params,
@@ -81,6 +87,7 @@ class JaxLLMBackend(Backend):
                     max_seq=opts.context_size,
                     cache_dtype=kv_dtype,
                     decode_steps=int(opts.extra.get("decode_steps", 8)),
+                    mesh=mesh,
                 )
                 self.engine.start()
                 self._state = "READY"
@@ -99,7 +106,21 @@ class JaxLLMBackend(Backend):
         return self._state in ("READY", "BUSY")
 
     def status(self) -> StatusResponse:
-        return StatusResponse(state=self._state)
+        """State + memory breakdown (ref: backend.proto StatusResponse
+        memory fields served by /backend/monitor)."""
+        mem: dict[str, int] = {}
+        if self.engine is not None:
+            try:
+                mem["kv_cache_bytes"] = int(
+                    self.engine.cache.k.size * self.engine.cache.k.dtype.itemsize
+                ) * 2
+                mem["params_bytes"] = int(sum(
+                    int(p.size) * p.dtype.itemsize
+                    for p in jax.tree_util.tree_leaves(self.engine.params)
+                ))
+            except Exception:
+                pass
+        return StatusResponse(state=self._state, memory=mem)
 
     def busy(self) -> bool:
         return self.engine is not None and any(

@@ -1,0 +1,164 @@
+"""Config hot-reload, external/remote backends, explorer, store client
+(ref: config_file_watcher.go, external backends, core/explorer,
+core/clients/store.go)."""
+
+import asyncio
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from localai_tfp_tpu.config.watcher import ConfigWatcher
+from localai_tfp_tpu.parallel.explorer import (
+    DiscoveryServer, ExplorerDB, NetworkEntry,
+)
+from localai_tfp_tpu.workers.base import ModelLoadOptions, PredictOptions
+from localai_tfp_tpu.workers.remote import RemoteOpenAIBackend
+
+
+def test_watcher_detects_changes(tmp_path):
+    seen = []
+    w = ConfigWatcher(str(tmp_path), interval=0.05)
+    w.watch("api_keys.json", lambda d: seen.append(d))
+    (tmp_path / "api_keys.json").write_text('["k1"]')
+    w.start()
+    try:
+        time.sleep(0.1)
+        assert seen and seen[-1] == ["k1"]
+        # rewrite -> change fires (ensure mtime moves)
+        time.sleep(0.05)
+        p = tmp_path / "api_keys.json"
+        p.write_text('["k1", "k2"]')
+        os.utime(p, (time.time() + 2, time.time() + 2))
+        deadline = time.time() + 3
+        while time.time() < deadline and (not seen or
+                                          seen[-1] != ["k1", "k2"]):
+            time.sleep(0.05)
+        assert seen[-1] == ["k1", "k2"]
+        # deletion -> handler gets None
+        p.unlink()
+        deadline = time.time() + 3
+        while time.time() < deadline and seen[-1] is not None:
+            time.sleep(0.05)
+        assert seen[-1] is None
+    finally:
+        w.stop()
+
+
+def test_watcher_ignores_bad_json(tmp_path):
+    seen = []
+    w = ConfigWatcher(str(tmp_path), interval=0.05)
+    w.watch("api_keys.json", lambda d: seen.append(d))
+    (tmp_path / "api_keys.json").write_text("{not json")
+    w.start()
+    time.sleep(0.2)
+    w.stop()
+    assert seen == []
+
+
+# ------------------------------------------------------------ remote backend
+
+
+@pytest.fixture()
+def upstream():
+    """A minimal OpenAI-compatible upstream served in a thread."""
+    loop = asyncio.new_event_loop()
+
+    async def completions(request):
+        body = await request.json()
+        if body.get("stream"):
+            resp = web.StreamResponse()
+            resp.headers["Content-Type"] = "text/event-stream"
+            await resp.prepare(request)
+            for tok in ("he", "llo"):
+                await resp.write(
+                    b"data: " + json.dumps(
+                        {"choices": [{"text": tok}]}).encode() + b"\n\n")
+            await resp.write(
+                b"data: " + json.dumps(
+                    {"choices": [{"text": "",
+                                  "finish_reason": "stop"}]}).encode()
+                + b"\n\ndata: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+        return web.json_response({
+            "choices": [{"text": f"echo:{body.get('prompt')}",
+                         "finish_reason": "stop"}],
+            "usage": {"completion_tokens": 2, "prompt_tokens": 3},
+        })
+
+    async def embeddings(request):
+        return web.json_response(
+            {"data": [{"embedding": [0.1, 0.2, 0.3]}]})
+
+    app = web.Application()
+    app.router.add_post("/v1/completions", completions)
+    app.router.add_post("/v1/embeddings", embeddings)
+    server = TestServer(app)
+    loop.run_until_complete(server.start_server())
+    url = f"http://127.0.0.1:{server.port}"
+
+    done = threading.Event()
+
+    def pump():  # keep the loop alive for sync urllib callers
+        async def wait():
+            while not done.is_set():
+                await asyncio.sleep(0.02)
+        loop.run_until_complete(wait())
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    yield url
+    done.set()
+    t.join(timeout=5)
+    loop.run_until_complete(server.close())
+    loop.close()
+
+
+def test_remote_backend_predict(upstream):
+    b = RemoteOpenAIBackend()
+    res = b.load_model(ModelLoadOptions(
+        model="m", extra={"base_url": upstream}))
+    assert res.success, res.message
+    out = b.predict(PredictOptions(prompt="hi", tokens=4))
+    assert out.message == "echo:hi"
+    assert out.prompt_tokens == 3
+
+    chunks = list(b.predict_stream(PredictOptions(prompt="x")))
+    text = "".join(c.message for c in chunks)
+    assert text == "hello"
+    assert chunks[-1].finish_reason == "stop"
+
+    emb = b.embedding(PredictOptions(embeddings="v"))
+    assert emb.embeddings == [0.1, 0.2, 0.3]
+
+
+def test_remote_backend_requires_url():
+    b = RemoteOpenAIBackend()
+    assert not b.load_model(ModelLoadOptions(model="m")).success
+
+
+# ---------------------------------------------------------------- explorer
+
+
+def test_explorer_db_roundtrip(tmp_path):
+    db = ExplorerDB(str(tmp_path / "explorer.json"))
+    db.add(NetworkEntry(name="net1", url="http://x", description="d"))
+    db2 = ExplorerDB(str(tmp_path / "explorer.json"))
+    assert [e.name for e in db2.all()] == ["net1"]
+    assert db2.remove("net1")
+    assert not db2.remove("net1")
+
+
+def test_explorer_discovery_failure_eviction(tmp_path):
+    db = ExplorerDB(str(tmp_path / "e.json"))
+    db.add(NetworkEntry(name="dead", url="http://127.0.0.1:1"))
+    disc = DiscoveryServer(db)
+    for _ in range(3):
+        disc.sweep()
+    assert db.all() == []  # evicted after FAILURE_THRESHOLD failures
