@@ -6,9 +6,9 @@ over /stores/{set,get,delete,find}. Pure stdlib.
 
 from __future__ import annotations
 
-import json
-import urllib.request
-from typing import Optional, Sequence
+from typing import Sequence
+
+from ..utils.http import json_post
 
 
 class StoreClient:
@@ -21,18 +21,8 @@ class StoreClient:
     def _post(self, path: str, payload: dict) -> dict:
         if self.store:
             payload.setdefault("store", self.store)
-        req = urllib.request.Request(
-            self.base_url + path,
-            data=json.dumps(payload).encode(),
-            headers={
-                "Content-Type": "application/json",
-                **({"Authorization": f"Bearer {self.api_key}"}
-                   if self.api_key else {}),
-            },
-        )
-        with urllib.request.urlopen(req, timeout=60) as r:
-            body = r.read()
-        return json.loads(body) if body else {}
+        return json_post(self.base_url + path, payload,
+                         api_key=self.api_key, timeout=60)
 
     def set(self, keys: Sequence[Sequence[float]],
             values: Sequence[str]) -> None:

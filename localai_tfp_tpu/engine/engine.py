@@ -295,6 +295,12 @@ class LLMEngine:
                 cache = KVCache(
                     k=lax.slice(cache.k, (0, 0, 0, 0), (L, S, window, F)),
                     v=lax.slice(cache.v, (0, 0, 0, 0), (L, S, window, F)),
+                    k_scale=(lax.slice(cache.k_scale, (0, 0, 0),
+                                       (L, S, window))
+                             if cache.quantized else None),
+                    v_scale=(lax.slice(cache.v_scale, (0, 0, 0),
+                                       (L, S, window))
+                             if cache.quantized else None),
                 )
 
             def step(carry, _):
@@ -315,6 +321,12 @@ class LLMEngine:
                 cache = KVCache(
                     k=lax.dynamic_update_slice(full.k, cache.k, (0, 0, 0, 0)),
                     v=lax.dynamic_update_slice(full.v, cache.v, (0, 0, 0, 0)),
+                    k_scale=(lax.dynamic_update_slice(
+                        full.k_scale, cache.k_scale, (0, 0, 0))
+                        if cache.quantized else None),
+                    v_scale=(lax.dynamic_update_slice(
+                        full.v_scale, cache.v_scale, (0, 0, 0))
+                        if cache.quantized else None),
                 )
             # tok_next/pos_next are returned so the next dispatch can chain
             # on device state without a host round trip

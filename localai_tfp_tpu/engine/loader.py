@@ -76,6 +76,9 @@ class _Registry:
     def register(self, name: str, factory: BackendFactory) -> None:
         self._factories[name] = factory
 
+    def unregister(self, name: str) -> bool:
+        return self._factories.pop(name, None) is not None
+
     def create(self, name: str) -> Backend:
         f = self._factories.get(name)
         if f is None:

@@ -50,6 +50,10 @@ class KVCache:
 
     k: jax.Array
     v: jax.Array
+    # int8 mode (ref: llama.cpp cache_type_k/v q8 — grpc-server.cpp
+    # :2337-2342): per-(layer, slot, position) row scales; None = raw
+    k_scale: Any = None  # [L, n_slots, max_seq] f32
+    v_scale: Any = None
 
     @classmethod
     def create(
@@ -61,7 +65,19 @@ class KVCache:
     ) -> "KVCache":
         shape = (spec.n_layers, n_slots, max_seq,
                  spec.n_kv_heads * spec.d_head)
+        if dtype in (jnp.int8, "int8", "q8", "q8_0"):
+            sshape = shape[:3]
+            return cls(
+                k=jnp.zeros(shape, jnp.int8),
+                v=jnp.zeros(shape, jnp.int8),
+                k_scale=jnp.zeros(sshape, jnp.float32),
+                v_scale=jnp.zeros(sshape, jnp.float32),
+            )
         return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+    @property
+    def quantized(self) -> bool:
+        return self.k.dtype == jnp.int8
 
     @property
     def n_slots(self) -> int:
@@ -74,9 +90,17 @@ class KVCache:
 
 jax.tree_util.register_pytree_node(
     KVCache,
-    lambda c: ((c.k, c.v), None),
-    lambda _, kv: KVCache(k=kv[0], v=kv[1]),
+    lambda c: ((c.k, c.v, c.k_scale, c.v_scale), None),
+    lambda _, ch: KVCache(k=ch[0], v=ch[1], k_scale=ch[2], v_scale=ch[3]),
 )
+
+
+def _quantize_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """[..., F] -> (int8 rows, per-row f32 scales)."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1) / 127.0 + 1e-8
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
 
 
 # ---------------------------------------------------------------------------
@@ -383,9 +407,14 @@ def forward_hidden(
     rope_scale = rope_attn_scale(spec)
     stacked = {k: params[k] for k in params if k not in _NON_LAYER_KEYS}
     identity = slot_ids is None  # batch row b IS cache row b (decode path)
+    quant = cache.quantized  # int8 rows + per-row scales
 
     def body(x, scanned):
-        lp, ck, cv = scanned  # layer params; cache slices [n_slots, S, kv_dim]
+        if quant:
+            lp, ck, cv, ks, vs = scanned
+        else:
+            lp, ck, cv = scanned  # layer params; cache [n_slots, S, kv_dim]
+            ks = vs = None
 
         def kernel_attn(q, k, v):
             # Pallas path: append one page per slot, attend over valid
@@ -411,59 +440,101 @@ def forward_hidden(
             T = k.shape[1]
             kf = k.reshape(B, T, spec.kv_dim)
             vf = v.reshape(B, T, spec.kv_dim)
+            if quant:
+                kq, ksc = _quantize_rows(kf)  # int8 [B,T,F], f32 [B,T]
+                vq, vsc = _quantize_rows(vf)
+            else:
+                kq, vq, ksc, vsc = kf, vf, None, None
 
-            def split(buf):  # [B, S, kv_dim] -> [B, S, Hkv, Dh]
-                return buf.reshape(
+            def split(buf, scales):
+                # [B, S, kv_dim](+scales [B, S]) -> [B, S, Hkv, Dh] compute
+                out = buf.reshape(
                     buf.shape[0], buf.shape[1], spec.n_kv_heads, spec.d_head
                 )
+                if scales is not None:  # dequantize; XLA fuses the convert
+                    out = out.astype(x.dtype) * scales[
+                        :, :, None, None].astype(x.dtype)
+                return out
+
+            def one_row(buf_row, new_row, off):
+                return lax.dynamic_update_slice(
+                    buf_row, new_row.astype(buf_row.dtype), (off, 0)
+                )
+
+            def one_scale(srow, val, off):
+                return lax.dynamic_update_slice(srow, val, (off,))
 
             if identity:
                 # hot path: per-row dynamic_update_slice, no gather/scatter
                 # (a cross-slot scatter would copy the whole cache layer
                 # every decode step — ~GBs/step at serving shapes)
-                def one(buf_row, new_row, off):
-                    return lax.dynamic_update_slice(
-                        buf_row, new_row.astype(buf_row.dtype), (off, 0)
-                    )
-                ck2 = jax.vmap(one)(ck, kf, pos0)
-                cv2 = jax.vmap(one)(cv, vf, pos0)
-                return split(ck2), split(cv2), (ck2, cv2)
+                ck2 = jax.vmap(one_row)(ck, kq, pos0)
+                cv2 = jax.vmap(one_row)(cv, vq, pos0)
+                if quant:
+                    ks2 = jax.vmap(one_scale)(ks, ksc, pos0)
+                    vs2 = jax.vmap(one_scale)(vs, vsc, pos0)
+                    return (split(ck2, ks2), split(cv2, vs2),
+                            (ck2, cv2, ks2, vs2))
+                return split(ck2, None), split(cv2, None), (ck2, cv2)
             if B == 1:
                 # single-row update (prefill/embed): DUS straight into the
                 # 3D buffer at (slot, pos, 0)
                 ck2 = lax.dynamic_update_slice(
-                    ck, kf.astype(ck.dtype), (slot_ids[0], pos0[0], 0))
+                    ck, kq.astype(ck.dtype), (slot_ids[0], pos0[0], 0))
                 cv2 = lax.dynamic_update_slice(
-                    cv, vf.astype(cv.dtype), (slot_ids[0], pos0[0], 0))
+                    cv, vq.astype(cv.dtype), (slot_ids[0], pos0[0], 0))
+                if quant:
+                    ks2 = lax.dynamic_update_slice(
+                        ks, ksc, (slot_ids[0], pos0[0]))
+                    vs2 = lax.dynamic_update_slice(
+                        vs, vsc, (slot_ids[0], pos0[0]))
             else:
                 def write(cbuf, new):
-                    def one(buf_row, new_row, off):
-                        return lax.dynamic_update_slice(
-                            buf_row, new_row.astype(buf_row.dtype), (off, 0)
-                        )
-                    rows = jax.vmap(one)(cbuf[slot_ids], new, pos0)
+                    rows = jax.vmap(one_row)(cbuf[slot_ids], new, pos0)
                     return cbuf.at[slot_ids].set(rows)
 
-                ck2 = write(ck, kf)
-                cv2 = write(cv, vf)
-            return split(ck2[slot_ids]), split(cv2[slot_ids]), (ck2, cv2)
+                ck2 = write(ck, kq)
+                cv2 = write(cv, vq)
+                if quant:
+                    def wscale(sbuf, val):
+                        rows = jax.vmap(one_scale)(sbuf[slot_ids], val, pos0)
+                        return sbuf.at[slot_ids].set(rows)
+
+                    ks2 = wscale(ks, ksc)
+                    vs2 = wscale(vs, vsc)
+            if quant:
+                return (split(ck2[slot_ids], ks2[slot_ids]),
+                        split(cv2[slot_ids], vs2[slot_ids]),
+                        (ck2, cv2, ks2, vs2))
+            return (split(ck2[slot_ids], None), split(cv2[slot_ids], None),
+                    (ck2, cv2))
 
         def xla_attn(q, k, v):
             k_eff, v_eff, carry = kv_from_cache(k, v)
             return _attend(spec, q, k_eff, v_eff, positions), carry
 
-        use_kernel = decode_kernel and identity and x.shape[1] == 1
-        x, (ck2, cv2) = _layer_body(
+        use_kernel = (decode_kernel and identity and x.shape[1] == 1
+                      and not quant)
+        x, out = _layer_body(
             spec, x, lp, positions, inv_freq, rope_scale,
             kernel_attn if use_kernel else xla_attn,
         )
-        return x, (ck2, cv2)
+        return x, out
 
-    x, (new_k, new_v) = lax.scan(body, x, (stacked, cache.k, cache.v))
+    if quant:
+        x, (new_k, new_v, new_ks, new_vs) = lax.scan(
+            body, x,
+            (stacked, cache.k, cache.v, cache.k_scale, cache.v_scale),
+        )
+        new_cache = KVCache(k=new_k, v=new_v, k_scale=new_ks,
+                            v_scale=new_vs)
+    else:
+        x, (new_k, new_v) = lax.scan(body, x, (stacked, cache.k, cache.v))
+        new_cache = KVCache(k=new_k, v=new_v)
 
     if spec.final_norm:
         x = _norm(spec, x, params["final_norm_w"], params.get("final_norm_b"))
-    return x, KVCache(k=new_k, v=new_v)
+    return x, new_cache
 
 
 def forward(

@@ -139,8 +139,12 @@ def build_app(db: ExplorerDB, discovery: DiscoveryServer):
         return web.json_response([asdict(e) for e in db.all()])
 
     async def add(request):
-        body = await request.json()
-        if not body.get("name") or not body.get("url"):
+        try:
+            body = await request.json()
+        except ValueError:
+            raise web.HTTPBadRequest(reason="invalid JSON body")
+        if not isinstance(body, dict) or not body.get("name") \
+                or not body.get("url"):
             raise web.HTTPBadRequest(reason="'name' and 'url' required")
         db.add(NetworkEntry(
             name=body["name"], url=body["url"],

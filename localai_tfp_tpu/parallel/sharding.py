@@ -67,8 +67,13 @@ def shard_engine_state(cache, sampling, mesh: Mesh):
         fixed = _divisible_spec(arr.shape, spec, mesh)
         return jax.device_put(arr, NamedSharding(mesh, fixed))
 
+    scale_spec = P(None, "data", None)  # [L, slots, seq] row scales
     cache = type(cache)(
-        k=put(cache.k, KV_CACHE_SPEC), v=put(cache.v, KV_CACHE_SPEC)
+        k=put(cache.k, KV_CACHE_SPEC), v=put(cache.v, KV_CACHE_SPEC),
+        k_scale=(put(cache.k_scale, scale_spec)
+                 if cache.quantized else None),
+        v_scale=(put(cache.v_scale, scale_spec)
+                 if cache.quantized else None),
     )
     leaves, treedef = jax.tree_util.tree_flatten(sampling)
     out = []

@@ -122,20 +122,28 @@ class Application:
                 k for k in file_keys if k not in startup_keys
             ]
 
+        external_names: set[str] = set()  # names THIS handler registered
+
         def on_external_backends(data) -> None:
             from ..engine.loader import ALIASES, registry
             from ..workers.remote import RemoteOpenAIBackend
 
+            wanted: set[str] = set()
             for name, spec in (data or {}).items():
                 if isinstance(spec, str):
                     spec = {"base_url": spec}
                 url = spec.get("base_url") or spec.get("uri") or ""
                 key = spec.get("api_key", "")
                 lname = name.strip().lower()
-                if lname in ALIASES:  # would shadow/alias a builtin
+                # refuse to shadow anything that isn't ours: alias names
+                # AND already-registered builtin factories
+                if lname in ALIASES or (
+                    lname in registry.known()
+                    and lname not in external_names
+                ):
                     log.warning(
                         "external backend name '%s' collides with a "
-                        "builtin alias; skipping", name)
+                        "builtin backend; skipping", name)
                     continue
                 # lookups lowercase via resolve_backend, so register the
                 # lowercased name
@@ -143,8 +151,16 @@ class Application:
                     lname,
                     lambda url=url, key=key: RemoteOpenAIBackend(url, key),
                 )
+                wanted.add(lname)
                 log.info("registered external backend '%s' -> %s",
                          name, url)
+            # entries dropped from the file (or the whole file removed)
+            # are deregistered — a hot-reload removal must actually remove
+            for stale in external_names - wanted:
+                registry.unregister(stale)
+                log.info("removed external backend '%s'", stale)
+            external_names.clear()
+            external_names.update(wanted)
 
         self.config_watcher.watch("api_keys.json", on_api_keys)
         self.config_watcher.watch("external_backends.json",

@@ -41,6 +41,11 @@ _DTYPES = {
     "f16": jnp.bfloat16,
 }
 
+# KV-cache-only dtypes (ref: cache_type_k/v q8/f16 — grpc-server.cpp
+# :2337-2342): int8 rows with per-row scales
+_KV_DTYPES = {**_DTYPES, "int8": jnp.int8, "i8": jnp.int8,
+              "q8": jnp.int8, "q8_0": jnp.int8}
+
 
 class JaxLLMBackend(Backend):
     """Serves chat/completion/embeddings/tokenize for HF checkpoints."""
@@ -70,7 +75,7 @@ class JaxLLMBackend(Backend):
                                     jnp.bfloat16)
                 self.spec, params = load_params(model_dir, dtype=dtype)
                 self.tokenizer = load_tokenizer(model_dir)
-                kv_dtype = _DTYPES.get(
+                kv_dtype = _KV_DTYPES.get(
                     (opts.kv_cache_dtype or opts.dtype or "bfloat16").lower(),
                     dtype,
                 )

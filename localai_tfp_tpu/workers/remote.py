@@ -14,9 +14,9 @@ from __future__ import annotations
 
 import json
 import urllib.error
-import urllib.request
 from typing import Iterator, Optional
 
+from ..utils.http import json_request
 from .base import (
     Backend, EmbeddingResult, ModelLoadOptions, PredictOptions, Reply,
     Result, StatusResponse, TokenizationResponse,
@@ -34,17 +34,9 @@ class RemoteOpenAIBackend(Backend):
 
     # ------------------------------------------------------------ plumbing
 
-    def _req(self, path: str, payload: dict, stream: bool = False):
-        req = urllib.request.Request(
-            self.base_url + path,
-            data=json.dumps(payload).encode(),
-            headers={
-                "Content-Type": "application/json",
-                **({"Authorization": f"Bearer {self.api_key}"}
-                   if self.api_key else {}),
-            },
-        )
-        return urllib.request.urlopen(req, timeout=600)
+    def _req(self, path: str, payload: dict):
+        return json_request(self.base_url + path, payload,
+                            api_key=self.api_key)
 
     # ----------------------------------------------------------- lifecycle
 
