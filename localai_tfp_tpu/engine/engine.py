@@ -213,6 +213,22 @@ class LLMEngine:
         def _prefill(params, tokens, cache, pos0, slot_ids):
             return forward(spec, params, tokens, pos0, cache, slot_ids)
 
+        @partial(jax.jit, donate_argnums=(2, 4))
+        def _prefill_final(params, tokens, cache, pos0, sampling, slot_id,
+                           n_chunk, tail, tail_len, masks):
+            """Last prompt chunk + penalty-window seed + first-token sample
+            in ONE dispatch — TTFT pays one host round trip, not three
+            (SURVEY.md §7 hard part #2)."""
+            logits, cache = forward(
+                spec, params, tokens, pos0, cache, slot_id[None]
+            )
+            sampling = observe_sequence(sampling, slot_id, tail, tail_len)
+            last = lax.dynamic_slice_in_dim(
+                logits, n_chunk - 1, 1, axis=1
+            )[:, 0, :]  # [1, V] logits at the chunk's true last position
+            tok, sampling = sample(sampling, slot_id[None], last, mask=masks)
+            return tok, cache, sampling
+
         @partial(jax.jit, donate_argnums=(2, 5))
         def _decode(params, tokens, cache, pos0, slot_ids, sampling,
                     active, masks):
@@ -235,6 +251,7 @@ class LLMEngine:
             return forward_hidden(spec, params, tokens, pos0, cache, slot_ids)
 
         self._prefill_fn = _prefill
+        self._prefill_final_fn = _prefill_final
         self._decode_fn = _decode
         self._sample_fn = _sample_only
         self._hidden_fn = _hidden
@@ -487,39 +504,33 @@ class LLMEngine:
         bucket = self._bucket(len(chunk))
         toks = np.zeros((1, bucket), np.int32)
         toks[0, : len(chunk)] = chunk
-        logits, self.cache = self._prefill_fn(
-            self.params,
-            jnp.asarray(toks),
-            self.cache,
-            jnp.asarray([slot.n_past], jnp.int32),
-            jnp.asarray([slot.idx], jnp.int32),
-        )
+        done = slot.n_past + len(chunk) >= slot.n_prompt
         # note: positions beyond len(chunk) write garbage K/V at
         # [n_past+len(chunk), n_past+bucket) — harmless: they're beyond the
         # valid prefix and get overwritten when real tokens arrive (causal
         # mask keeps them invisible to attention reads at these positions).
-        slot.n_past += len(chunk)
-        slot.cache_tokens.extend(chunk)
-        done = slot.n_past >= slot.n_prompt
         if done:
-            # feed prompt into the penalty window (ref: llama.cpp penalizes
-            # over the last-n of prompt+generation)
+            # final chunk: prefill + penalty-window seed + first-token
+            # sample fused into one dispatch (TTFT = one RTT)
             W = self.sampling.window
             tail = req.prompt_ids[-W:]
             padded = np.zeros((W,), np.int32)
             padded[: len(tail)] = tail
-            self.sampling = observe_sequence(
+            masks = self._constraint_mask_rows([slot])
+            tok, self.cache, self.sampling = self._prefill_final_fn(
+                self.params,
+                jnp.asarray(toks),
+                self.cache,
+                jnp.asarray([slot.n_past], jnp.int32),
                 self.sampling,
                 jnp.asarray(slot.idx, jnp.int32),
+                jnp.asarray(len(chunk), jnp.int32),
                 jnp.asarray(padded),
                 jnp.asarray(len(tail), jnp.int32),
-            )
-            last = logits[:, len(chunk) - 1, :]  # [1, V]
-            masks = self._constraint_mask_rows([slot])
-            tok, self.sampling = self._sample_fn(
-                self.sampling, jnp.asarray([slot.idx], jnp.int32), last,
                 masks,
             )
+            slot.n_past += len(chunk)
+            slot.cache_tokens.extend(chunk)
             slot.t_prefill_ms += (time.perf_counter() - t0) * 1e3
             self.metrics.prompt_tokens_processed += slot.n_prompt
             slot.state = SlotState.DECODE
@@ -527,6 +538,15 @@ class LLMEngine:
             self._epoch += 1
             self._emit_token(slot, int(tok[0]))
         else:
+            _, self.cache = self._prefill_fn(
+                self.params,
+                jnp.asarray(toks),
+                self.cache,
+                jnp.asarray([slot.n_past], jnp.int32),
+                jnp.asarray([slot.idx], jnp.int32),
+            )
+            slot.n_past += len(chunk)
+            slot.cache_tokens.extend(chunk)
             slot.t_prefill_ms += (time.perf_counter() - t0) * 1e3
 
     def _constraint_mask_rows(self, slots: list[_Slot]) -> Optional[jax.Array]:
