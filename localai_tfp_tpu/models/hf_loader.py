@@ -150,10 +150,26 @@ def load_params(
         p["w_up"] = stack(lambda i: t(lp.format(i=i) + "mlp.up_proj.weight"))
     p["w_down"] = stack(lambda i: t(lp.format(i=i) + "mlp.down_proj.weight"))
 
+    if spec.qk_norm:  # qwen3 per-head q/k norms
+        p["q_norm_w"] = stack(
+            lambda i: get(lp.format(i=i) + "self_attn.q_norm.weight"))
+        p["k_norm_w"] = stack(
+            lambda i: get(lp.format(i=i) + "self_attn.k_norm.weight"))
+
     p["ln1_w"] = stack(lambda i: get(lp.format(i=i) + "input_layernorm.weight"))
-    p["ln2_w"] = stack(
-        lambda i: get(lp.format(i=i) + "post_attention_layernorm.weight")
-    )
+    if spec.sandwich_norms:
+        # gemma2: post_attention_layernorm is the POST-attn sandwich norm;
+        # the pre-FFW norm has its own name
+        p["ln_post_attn_w"] = stack(
+            lambda i: get(lp.format(i=i) + "post_attention_layernorm.weight"))
+        p["ln2_w"] = stack(
+            lambda i: get(lp.format(i=i) + "pre_feedforward_layernorm.weight"))
+        p["ln_post_ffw_w"] = stack(
+            lambda i: get(lp.format(i=i) + "post_feedforward_layernorm.weight"))
+    else:
+        p["ln2_w"] = stack(
+            lambda i: get(lp.format(i=i) + "post_attention_layernorm.weight")
+        )
     p["final_norm_w"] = _cast(get(f"{prefix}norm.weight"), dtype)
     if not spec.tie_word_embeddings:
         if "lm_head.weight" in names:

@@ -53,6 +53,8 @@ class LLMSpec:
     parallel_residual: bool = False  # phi: x + attn(ln(x)) + mlp(ln(x))
     tie_word_embeddings: bool = False
     final_norm: bool = True
+    qk_norm: bool = False  # qwen3: per-head RMSNorm on q/k before rope
+    sandwich_norms: bool = False  # gemma2/3: post-attn + pre/post-ffw norms
 
     # scaling oddities
     embedding_multiplier: float = 1.0  # gemma: sqrt(d_model)
@@ -62,6 +64,9 @@ class LLMSpec:
 
     # sliding window attention (mistral); None = full causal
     sliding_window: Optional[int] = None
+    # gemma2/3: every Nth layer is GLOBAL (full attention), the rest use
+    # sliding_window; 0 = uniform window on all layers
+    sliding_window_pattern: int = 0
 
     extra: dict = field(default_factory=dict)
 
@@ -124,12 +129,12 @@ def spec_from_hf_config(cfg: dict[str, Any]) -> LLMSpec:
         pass
     elif mt in ("qwen2", "qwen2_5"):
         kw["qkv_bias"] = True
-    elif mt in ("qwen3", "qwen2_moe"):
-        # qwen3 needs per-head q/k RMSNorm, qwen2_moe needs expert MLPs —
-        # refuse rather than silently emit wrong logits
+    elif mt == "qwen3":
+        kw["qk_norm"] = True  # per-head RMSNorm on q/k before rope
+    elif mt == "qwen2_moe":
+        # expert MLPs unimplemented — refuse rather than emit wrong logits
         raise NotImplementedError(
-            f"model_type '{mt}' is not supported yet (qwen3 q/k-norm and "
-            "qwen2_moe expert MLPs are unimplemented)"
+            f"model_type '{mt}' is not supported yet (expert MLPs)"
         )
     elif mt == "phi":
         kw.update(
@@ -152,12 +157,25 @@ def spec_from_hf_config(cfg: dict[str, Any]) -> LLMSpec:
             embedding_multiplier=float(d_model) ** 0.5,
             tie_word_embeddings=True,
         )
-    elif mt in ("gemma2", "gemma3", "gemma3_text"):
-        # gemma2/3 use sandwich norms (post-attn/pre+post-ffw layernorms)
-        # and alternating sliding-window layers — not yet implemented
+    elif mt == "gemma2":
+        kw.update(
+            norm_weight_plus_one=True,
+            hidden_act="gelu_tanh",
+            embedding_multiplier=float(d_model) ** 0.5,
+            tie_word_embeddings=True,
+            sandwich_norms=True,
+            attn_logit_softcap=float(cfg.get("attn_logit_softcapping")
+                                     or 0.0),
+            logit_softcap=float(cfg.get("final_logit_softcapping") or 0.0),
+            query_pre_attn_scalar=float(
+                cfg.get("query_pre_attn_scalar") or d_head),
+            # every other layer is sliding, odd layers are global
+            sliding_window_pattern=2,
+        )
+    elif mt in ("gemma3", "gemma3_text"):
+        # gemma3 adds per-layer rope bases (local vs global) — not yet
         raise NotImplementedError(
-            f"model_type '{mt}' is not supported yet (sandwich norms / "
-            "alternating sliding-window layers unimplemented)"
+            f"model_type '{mt}' is not supported yet (dual rope bases)"
         )
     else:
         raise NotImplementedError(f"unknown model_type '{mt}'")

@@ -133,6 +133,12 @@ def init_params(
         p["w_gate"] = dense(next(keys), (L, D, F))
     if not spec.parallel_residual:
         p["ln2_w"] = jnp.ones((L, D), dtype)
+    if spec.qk_norm:
+        p["q_norm_w"] = jnp.ones((L, spec.d_head), dtype)
+        p["k_norm_w"] = jnp.ones((L, spec.d_head), dtype)
+    if spec.sandwich_norms:
+        p["ln_post_attn_w"] = jnp.ones((L, D), dtype)
+        p["ln_post_ffw_w"] = jnp.ones((L, D), dtype)
     if spec.norm_type == "layernorm":
         p["ln1_b"] = jnp.zeros((L, D), dtype)
         if "ln2_w" in p:
@@ -267,6 +273,8 @@ def _attend(
     k: jax.Array,  # [B, S, Hkv, Dh]
     v: jax.Array,  # [B, S, Hkv, Dh]
     q_pos: jax.Array,  # [B, T] absolute positions of queries
+    window: Optional[jax.Array] = None,  # per-layer scalar; 0/neg = full
+    # (gemma2 alternates sliding/global layers — traced through the scan)
 ) -> jax.Array:
     B, T, H, Dh = q.shape
     S = k.shape[1]
@@ -291,7 +299,9 @@ def _attend(
     kv_pos = lax.broadcasted_iota(jnp.int32, (1, 1, 1, 1, S), 4)
     qp = q_pos[:, None, :, None, None]  # [B,1,T,1,1]
     mask = kv_pos <= qp
-    if spec.sliding_window:
+    if window is not None:
+        mask &= (window <= 0) | (kv_pos > qp - window)
+    elif spec.sliding_window and not spec.sliding_window_pattern:
         mask &= kv_pos > qp - spec.sliding_window
     logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
@@ -333,12 +343,17 @@ def _layer_body(spec, x, lp, positions, inv_freq, rope_scale, attn_fn):
     q = q.reshape(B, T, spec.n_heads, spec.d_head)
     k = k.reshape(B, T, spec.n_kv_heads, spec.d_head)
     v = v.reshape(B, T, spec.n_kv_heads, spec.d_head)
+    if "q_norm_w" in lp:  # qwen3: per-head RMSNorm before rope
+        q = _norm(spec, q, lp["q_norm_w"], None)
+        k = _norm(spec, k, lp["k_norm_w"], None)
     q = apply_rope(q, positions, inv_freq, spec.rotary_dim, rope_scale)
     k = apply_rope(k, positions, inv_freq, spec.rotary_dim, rope_scale)
     attn, carry = attn_fn(q, k, v)
     attn = attn @ lp["wo"]
     if "bo" in lp:
         attn = attn + lp["bo"]
+    if "ln_post_attn_w" in lp:  # gemma2 sandwich: norm the branch output
+        attn = _norm(spec, attn, lp["ln_post_attn_w"], None)
     mlp_in = h if spec.parallel_residual else None
     if not spec.parallel_residual:
         x = x + attn
@@ -353,8 +368,22 @@ def _layer_body(spec, x, lp, positions, inv_freq, rope_scale, attn_fn):
     mlp = up @ lp["w_down"]
     if "b_down" in lp:
         mlp = mlp + lp["b_down"]
+    if "ln_post_ffw_w" in lp:  # gemma2 sandwich
+        mlp = _norm(spec, mlp, lp["ln_post_ffw_w"], None)
     out = (x + attn + mlp) if spec.parallel_residual else (x + mlp)
     return out, carry
+
+
+def _layer_windows(spec):
+    """Per-layer sliding windows for alternating-window models (gemma2):
+    [L] i32, 0 = full attention for that layer; None when uniform."""
+    if not (spec.sliding_window_pattern and spec.sliding_window):
+        return None
+    return jnp.asarray(
+        [0 if (l + 1) % spec.sliding_window_pattern == 0
+         else spec.sliding_window for l in range(spec.n_layers)],
+        jnp.int32,
+    )
 
 
 def _embed_in(spec, params, tokens):
@@ -406,6 +435,9 @@ def forward_hidden(
     inv_freq = rope_inv_freq(spec)
     rope_scale = rope_attn_scale(spec)
     stacked = {k: params[k] for k in params if k not in _NON_LAYER_KEYS}
+    win = _layer_windows(spec)
+    if win is not None:
+        stacked = {**stacked, "_window": win}
     identity = slot_ids is None  # batch row b IS cache row b (decode path)
     quant = cache.quantized  # int8 rows + per-row scales
 
@@ -511,10 +543,11 @@ def forward_hidden(
 
         def xla_attn(q, k, v):
             k_eff, v_eff, carry = kv_from_cache(k, v)
-            return _attend(spec, q, k_eff, v_eff, positions), carry
+            return _attend(spec, q, k_eff, v_eff, positions,
+                           lp.get("_window")), carry
 
         use_kernel = (decode_kernel and identity and x.shape[1] == 1
-                      and not quant)
+                      and not quant and not spec.sliding_window_pattern)
         x, out = _layer_body(
             spec, x, lp, positions, inv_freq, rope_scale,
             kernel_attn if use_kernel else xla_attn,
@@ -580,12 +613,16 @@ def forward_train(
     inv_freq = rope_inv_freq(spec)
     rope_scale = rope_attn_scale(spec)
     stacked = {k: params[k] for k in params if k not in _NON_LAYER_KEYS}
+    win = _layer_windows(spec)
+    if win is not None:
+        stacked = {**stacked, "_window": win}
 
     @jax.checkpoint
     def body(x, lp):
         x, _ = _layer_body(
             spec, x, lp, positions, inv_freq, rope_scale,
-            lambda q, k, v: (_attend(spec, q, k, v, positions), None),
+            lambda q, k, v: (
+                _attend(spec, q, k, v, positions, lp.get("_window")), None),
         )
         return x, None
 

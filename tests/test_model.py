@@ -36,6 +36,18 @@ def _save_tiny(tmp_path, family: str) -> str:
         model = LlamaForCausalLM(LlamaConfig(**common))
     elif family == "qwen2":
         model = Qwen2ForCausalLM(Qwen2Config(**common))
+    elif family == "qwen3":
+        from transformers import Qwen3Config, Qwen3ForCausalLM
+
+        model = Qwen3ForCausalLM(Qwen3Config(**common, head_dim=16))
+    elif family == "gemma2":
+        from transformers import Gemma2Config, Gemma2ForCausalLM
+
+        model = Gemma2ForCausalLM(Gemma2Config(
+            **common, head_dim=16, query_pre_attn_scalar=16,
+            attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+            sliding_window=8, hidden_activation="gelu_pytorch_tanh",
+        ))
     elif family == "phi":
         cfg = dict(common)
         cfg["num_key_value_heads"] = 4  # phi has no GQA by default
@@ -58,7 +70,8 @@ def _hf_logits(model_dir: str, tokens: np.ndarray) -> np.ndarray:
     return out.numpy()
 
 
-@pytest.mark.parametrize("family", ["llama", "qwen2", "phi"])
+@pytest.mark.parametrize("family", ["llama", "qwen2", "qwen3", "gemma2",
+                                    "phi"])
 def test_logits_match_hf(tmp_path, family):
     from localai_tfp_tpu.models.hf_loader import load_params
     from localai_tfp_tpu.models.transformer import KVCache, forward
