@@ -43,7 +43,7 @@ from jax import lax
 
 from ..models.llm_spec import LLMSpec
 from ..models.transformer import KVCache, Params, forward, forward_hidden
-from ..ops.sampling import SamplingState, observe_sequence, sample
+from ..ops.sampling import SamplingState, observe_tokens, sample
 from .tokenizer import StreamDecoder, Tokenizer
 
 DEFAULT_PREFILL_BUCKETS = (32, 128, 512, 2048)
@@ -214,20 +214,33 @@ class LLMEngine:
             return forward(spec, params, tokens, pos0, cache, slot_ids)
 
         @partial(jax.jit, donate_argnums=(2, 4))
-        def _prefill_final(params, tokens, cache, pos0, sampling, slot_id,
-                           n_chunk, tail, tail_len, masks):
-            """Last prompt chunk + penalty-window seed + first-token sample
-            in ONE dispatch — TTFT pays one host round trip, not three
-            (SURVEY.md §7 hard part #2)."""
+        def _prefill_final(params, tokens, cache, pos0, sampling, slot_ids,
+                           n_chunk, tails, tail_lens, masks):
+            """Final prompt chunks for a BATCH of slots + penalty-window
+            seed + first-token sample in ONE dispatch — concurrent prompts
+            share the round trip instead of paying one each, and TTFT pays
+            one RTT, not three (SURVEY.md §7 hard part #2).
+
+            tokens [B, bucket]; slot_ids/pos0/n_chunk/tail_lens [B];
+            tails [B, W]."""
             logits, cache = forward(
-                spec, params, tokens, pos0, cache, slot_id[None]
+                spec, params, tokens, pos0, cache, slot_ids
             )
-            sampling = observe_sequence(sampling, slot_id, tail, tail_len)
-            last = lax.dynamic_slice_in_dim(
-                logits, n_chunk - 1, 1, axis=1
-            )[:, 0, :]  # [1, V] logits at the chunk's true last position
-            tok, sampling = sample(sampling, slot_id[None], last, mask=masks)
-            return tok, cache, sampling
+
+            def seed(st, i):
+                return observe_tokens(
+                    st, slot_ids, tails[:, i], i < tail_lens
+                ), None
+
+            sampling, _ = lax.scan(
+                seed, sampling,
+                jnp.arange(tails.shape[1], dtype=jnp.int32),
+            )
+            last = jax.vmap(
+                lambda lg, n: lax.dynamic_slice_in_dim(lg, n - 1, 1, 0)[0]
+            )(logits, n_chunk)  # [B, V] at each chunk's true last position
+            toks, sampling = sample(sampling, slot_ids, last, mask=masks)
+            return toks, cache, sampling
 
         @partial(jax.jit, donate_argnums=(2, 5))
         def _decode(params, tokens, cache, pos0, slot_ids, sampling,
@@ -427,7 +440,18 @@ class LLMEngine:
         self._admit()
         prefilling = [s for s in self.slots if s.state is SlotState.PREFILL]
         if prefilling:
-            self._prefill_step(prefilling[0])
+            # batch final chunks of the same bucket together (one dispatch
+            # for the whole admission wave); long prompts chunk one by one
+            finals: dict[int, list[_Slot]] = {}
+            for s in prefilling:
+                rem = s.n_prompt - s.n_past
+                if rem <= self.prefill_buckets[-1]:
+                    finals.setdefault(self._bucket(rem), []).append(s)
+            if finals:
+                bucket, group = max(finals.items(), key=lambda kv: len(kv[1]))
+                self._prefill_final_step(group, bucket)
+            else:
+                self._prefill_step(prefilling[0])
             return
         decoding = [s for s in self.slots if s.state is SlotState.DECODE]
         if decoding:
@@ -504,50 +528,65 @@ class LLMEngine:
         bucket = self._bucket(len(chunk))
         toks = np.zeros((1, bucket), np.int32)
         toks[0, : len(chunk)] = chunk
-        done = slot.n_past + len(chunk) >= slot.n_prompt
         # note: positions beyond len(chunk) write garbage K/V at
         # [n_past+len(chunk), n_past+bucket) — harmless: they're beyond the
         # valid prefix and get overwritten when real tokens arrive (causal
         # mask keeps them invisible to attention reads at these positions).
-        if done:
-            # final chunk: prefill + penalty-window seed + first-token
-            # sample fused into one dispatch (TTFT = one RTT)
-            W = self.sampling.window
+        _, self.cache = self._prefill_fn(
+            self.params,
+            jnp.asarray(toks),
+            self.cache,
+            jnp.asarray([slot.n_past], jnp.int32),
+            jnp.asarray([slot.idx], jnp.int32),
+        )
+        slot.n_past += len(chunk)
+        slot.cache_tokens.extend(chunk)
+        slot.t_prefill_ms += (time.perf_counter() - t0) * 1e3
+
+    def _prefill_final_step(self, group: list[_Slot], bucket: int) -> None:
+        """Finish a batch of same-bucket prompts: one fused dispatch runs
+        the final chunks, seeds the penalty windows, and samples each
+        slot's first token (group size rounded down to a power of two to
+        bound the jit-shape cache; the remainder goes next iteration)."""
+        B = 1 << (len(group).bit_length() - 1)
+        group = group[:B]
+        t0 = time.perf_counter()
+        W = self.sampling.window
+        toks = np.zeros((B, bucket), np.int32)
+        pos0 = np.zeros((B,), np.int32)
+        slot_ids = np.zeros((B,), np.int32)
+        n_chunk = np.zeros((B,), np.int32)
+        tails = np.zeros((B, W), np.int32)
+        tail_lens = np.zeros((B,), np.int32)
+        for r, s in enumerate(group):
+            req = s.request
+            chunk = req.prompt_ids[s.n_past:]
+            toks[r, : len(chunk)] = chunk
+            pos0[r] = s.n_past
+            slot_ids[r] = s.idx
+            n_chunk[r] = len(chunk)
             tail = req.prompt_ids[-W:]
-            padded = np.zeros((W,), np.int32)
-            padded[: len(tail)] = tail
-            masks = self._constraint_mask_rows([slot])
-            tok, self.cache, self.sampling = self._prefill_final_fn(
-                self.params,
-                jnp.asarray(toks),
-                self.cache,
-                jnp.asarray([slot.n_past], jnp.int32),
-                self.sampling,
-                jnp.asarray(slot.idx, jnp.int32),
-                jnp.asarray(len(chunk), jnp.int32),
-                jnp.asarray(padded),
-                jnp.asarray(len(tail), jnp.int32),
-                masks,
-            )
-            slot.n_past += len(chunk)
-            slot.cache_tokens.extend(chunk)
-            slot.t_prefill_ms += (time.perf_counter() - t0) * 1e3
-            self.metrics.prompt_tokens_processed += slot.n_prompt
-            slot.state = SlotState.DECODE
-            slot.t_last = time.perf_counter()
+            tails[r, : len(tail)] = tail
+            tail_lens[r] = len(tail)
+        masks = self._constraint_mask_rows(group)
+        toks_out, self.cache, self.sampling = self._prefill_final_fn(
+            self.params, jnp.asarray(toks), self.cache, jnp.asarray(pos0),
+            self.sampling, jnp.asarray(slot_ids), jnp.asarray(n_chunk),
+            jnp.asarray(tails), jnp.asarray(tail_lens), masks,
+        )
+        toks_host = np.asarray(toks_out)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        now = time.perf_counter()
+        for r, s in enumerate(group):
+            ln = int(n_chunk[r])
+            s.cache_tokens.extend(s.request.prompt_ids[s.n_past:s.n_past + ln])
+            s.n_past += ln
+            s.t_prefill_ms += dt_ms
+            self.metrics.prompt_tokens_processed += s.n_prompt
+            s.state = SlotState.DECODE
+            s.t_last = now
             self._epoch += 1
-            self._emit_token(slot, int(tok[0]))
-        else:
-            _, self.cache = self._prefill_fn(
-                self.params,
-                jnp.asarray(toks),
-                self.cache,
-                jnp.asarray([slot.n_past], jnp.int32),
-                jnp.asarray([slot.idx], jnp.int32),
-            )
-            slot.n_past += len(chunk)
-            slot.cache_tokens.extend(chunk)
-            slot.t_prefill_ms += (time.perf_counter() - t0) * 1e3
+            self._emit_token(s, int(toks_host[r]))
 
     def _constraint_mask_rows(self, slots: list[_Slot]) -> Optional[jax.Array]:
         """Build [B, V] bool masks for grammar-constrained slots (host-side
