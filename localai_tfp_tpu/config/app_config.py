@@ -91,6 +91,24 @@ class ApplicationConfig:
         cfg.compilation_cache_dir = _env(
             "COMPILATION_CACHE_DIR", cfg.compilation_cache_dir
         )
+        galleries = _env("GALLERIES", None)
+        if galleries:
+            import json
+
+            try:
+                cfg.galleries = json.loads(galleries)
+            except ValueError:
+                pass
+        preload = _env("PRELOAD_MODELS", None)
+        if preload:
+            cfg.preload_models = [m.strip() for m in preload.split(",")
+                                  if m.strip()]
+        ctx = _env("CONTEXT_SIZE", None)
+        if ctx is not None:
+            cfg.context_size = int(ctx)
+        threads = _env("THREADS", None)
+        if threads is not None:
+            cfg.threads = int(threads)
         cfg.p2p_token = _env("P2P_TOKEN", cfg.p2p_token)
         cfg.federated_server_url = _env(
             "FEDERATED_SERVER", cfg.federated_server_url)

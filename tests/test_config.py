@@ -98,3 +98,22 @@ def test_sampling_merge():
     merged = cfg.parameters.merged_with({"temperature": 0.7, "top_k": None})
     assert merged.temperature == 0.7
     assert merged.top_k == 5
+
+
+def test_app_config_from_env(monkeypatch):
+    """LOCALAI_* env parsing incl. galleries/preload (the run command's
+    env surface — ref: core/cli/run.go env-bound flags)."""
+    from localai_tfp_tpu.config.app_config import ApplicationConfig
+
+    monkeypatch.setenv("LOCALAI_MODELS_PATH", "/mp")
+    monkeypatch.setenv("LOCALAI_GALLERIES",
+                       '[{"name": "g", "url": "file:///idx.yaml"}]')
+    monkeypatch.setenv("LOCALAI_PRELOAD_MODELS", "m1, m2")
+    monkeypatch.setenv("LOCALAI_CONTEXT_SIZE", "2048")
+    monkeypatch.setenv("LOCALAI_API_KEY", "k1,k2")
+    cfg = ApplicationConfig.from_env()
+    assert cfg.models_path == "/mp"
+    assert cfg.galleries == [{"name": "g", "url": "file:///idx.yaml"}]
+    assert cfg.preload_models == ["m1", "m2"]
+    assert cfg.context_size == 2048
+    assert cfg.api_keys == ["k1", "k2"]
