@@ -44,7 +44,7 @@ def main() -> None:
 
     eng = LLMEngine(
         spec, params, tok, n_slots=n_slots, max_seq=max_seq,
-        decode_steps=32 if on_tpu else 8,
+        decode_steps=64 if on_tpu else 8,
         # int8 KV is supported (cache_type q8 parity) but measured slower
         # here: the dequant doesn't fuse into attention on this toolchain,
         # so the bf16 window read wins
@@ -79,7 +79,7 @@ def main() -> None:
     run(n_slots, gen_tokens)  # warmup: populate the jit cache (all window
     # buckets the measured run will touch)
     tok_s = 0.0
-    for _ in range(2):  # best-of-2: the (virtualized) chip's throughput
+    for _ in range(3):  # best-of-3: the (virtualized) chip throughput
         # fluctuates run to run; take the cleaner measurement
         t0 = time.perf_counter()
         total, _ = run(n_slots, gen_tokens)
