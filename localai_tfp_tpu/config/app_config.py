@@ -84,6 +84,9 @@ class ApplicationConfig:
         cfg.enable_watchdog_busy = _env(
             "WATCHDOG_BUSY", cfg.enable_watchdog_busy, bool
         )
+        cfg.cors = _env("CORS", cfg.cors, bool)
+        cfg.cors_allow_origins = _env(
+            "CORS_ALLOW_ORIGINS", cfg.cors_allow_origins)
         cfg.disable_metrics = _env("DISABLE_METRICS", cfg.disable_metrics, bool)
         cfg.opaque_errors = _env("OPAQUE_ERRORS", cfg.opaque_errors, bool)
         cfg.machine_tag = _env("MACHINE_TAG", cfg.machine_tag)

@@ -68,32 +68,70 @@ async def auth_middleware(request: web.Request, handler):
 
 @web.middleware
 async def telemetry_middleware(request: web.Request, handler):
-    """Machine-Tag + X-Correlation-ID headers and the api_call histogram
-    (ref: app.go:94-100, :123-135; chat.go:326 correlation id)."""
+    """api_call histogram + correlation-id capture (ref: app.go:123-135;
+    chat.go:326). Response headers are injected in ``on_response_prepare``
+    so they reach error AND streamed responses."""
     app: Application = request.app["state"]
     t0 = time.perf_counter()
-    corr = request.headers.get("X-Correlation-ID") or uuid.uuid4().hex
-    request["correlation_id"] = corr
-    resp = None
+    request["correlation_id"] = (
+        request.headers.get("X-Correlation-ID") or uuid.uuid4().hex
+    )
     try:
-        resp = await handler(request)
-        return resp
+        return await handler(request)
     finally:
         if not app.config.disable_metrics:
             app.metrics.observe(
                 request.method, request.path, time.perf_counter() - t0
             )
-        if resp is not None:
-            if app.config.machine_tag:
-                resp.headers["Machine-Tag"] = app.config.machine_tag
-            resp.headers["X-Correlation-ID"] = corr
+
+
+async def _prepare_headers(request: web.Request, response) -> None:
+    """Runs for EVERY response (incl. web.HTTPException and prepared
+    stream responses) just before headers go out: Machine-Tag,
+    X-Correlation-ID (ref: app.go:94-100) and opt-in CORS
+    (ref: app.go:176-190 — matching-origin echo + Vary)."""
+    app: Application = request.app["state"]
+    if app.config.machine_tag:
+        response.headers["Machine-Tag"] = app.config.machine_tag
+    corr = request.get("correlation_id")
+    if corr:
+        response.headers["X-Correlation-ID"] = corr
+    if app.config.cors:
+        allowed = [o.strip() for o in
+                   (app.config.cors_allow_origins or "*").split(",")]
+        origin = request.headers.get("Origin", "")
+        if "*" in allowed:
+            grant = "*"
+        elif origin in allowed:
+            grant = origin
+        else:
+            grant = ""
+        if grant:
+            response.headers["Access-Control-Allow-Origin"] = grant
+            response.headers["Vary"] = "Origin"
+            response.headers["Access-Control-Allow-Methods"] = \
+                "GET, POST, PUT, DELETE, OPTIONS"
+            response.headers["Access-Control-Allow-Headers"] = \
+                "Authorization, Content-Type, X-Correlation-ID, X-Model"
+
+
+@web.middleware
+async def cors_preflight_middleware(request: web.Request, handler):
+    """Answer CORS preflights (headers come from _prepare_headers)."""
+    if request.method == "OPTIONS":
+        return web.Response(status=204)
+    return await handler(request)
 
 
 def build_app(state: Application) -> web.Application:
+    middlewares = [telemetry_middleware, auth_middleware, error_middleware]
+    if state.config.cors:
+        middlewares.insert(0, cors_preflight_middleware)
     app = web.Application(
-        middlewares=[telemetry_middleware, auth_middleware, error_middleware],
+        middlewares=middlewares,
         client_max_size=state.config.upload_limit_mb * 1024 * 1024,
     )
+    app.on_response_prepare.append(_prepare_headers)
     app["state"] = state
 
     openai_routes.register(app)

@@ -9,6 +9,7 @@ Gallery REST lands with the gallery service.
 from __future__ import annotations
 
 import asyncio
+import json
 import time
 
 from aiohttp import web
@@ -49,6 +50,7 @@ def register(app: web.Application) -> None:
     r.add_post("/models/galleries", galleries_add)
     r.add_delete("/models/galleries", galleries_remove)
     r.add_get("/models/jobs/{uuid}", models_job)
+    r.add_get("/models/jobs/{uuid}/stream", models_job_stream)
     r.add_get("/models/jobs", models_jobs)
 
 
@@ -397,6 +399,35 @@ async def models_job(request: web.Request) -> web.Response:
         "message": status.message, "progress": status.progress,
         "gallery_model_name": status.gallery_model_name,
     })
+
+
+async def models_job_stream(request: web.Request) -> web.StreamResponse:
+    """SSE job progress (ref: the reference's browse UI streams install
+    progress over SSE — routes/ui.go job progress)."""
+    st = _state(request)
+    jid = request.match_info["uuid"]
+    if st.gallery.status(jid) is None:
+        raise web.HTTPNotFound(reason="no such job")
+    resp = web.StreamResponse()
+    resp.headers["Content-Type"] = "text/event-stream"
+    resp.headers["Cache-Control"] = "no-cache"
+    await resp.prepare(request)
+    try:
+        while True:
+            s = st.gallery.status(jid)
+            payload = {
+                "processed": s.processed, "progress": s.progress,
+                "error": s.error or None, "message": s.message,
+            }
+            await resp.write(
+                b"data: " + json.dumps(payload).encode() + b"\n\n")
+            if s.processed:
+                break
+            await asyncio.sleep(0.5)
+        await resp.write_eof()
+    except (ConnectionResetError, ConnectionError):
+        pass  # client went away mid-install: a routine event, not an error
+    return resp
 
 
 async def models_jobs(request: web.Request) -> web.Response:

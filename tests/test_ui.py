@@ -62,3 +62,47 @@ def test_swagger_doc_covers_api(client):
                  "/v1/rerank", "/models/apply", "/v1/audio/transcriptions",
                  "/v1/images/generations", "/v1/assistants"):
         assert path in doc["paths"], path
+
+
+def test_cors_middleware(tmp_path_factory):
+    import asyncio as _asyncio
+
+    from aiohttp.test_utils import TestClient as TC, TestServer as TS
+
+    root = tmp_path_factory.mktemp("cors")
+    (root / "models").mkdir()
+    loop = _asyncio.new_event_loop()
+    cfg = ApplicationConfig(
+        models_path=str(root / "models"),
+        generated_content_dir=str(root / "generated"),
+        upload_dir=str(root / "uploads"),
+        config_dir=str(root / "configuration"),
+        cors=True, cors_allow_origins="https://app.example",
+    )
+    app = build_app(Application(cfg))
+    tc = TC(TS(app), loop=loop)
+    loop.run_until_complete(tc.start_server())
+
+    hdr = {"Origin": "https://app.example"}
+
+    async def go():
+        r = await tc.request("OPTIONS", "/v1/models", headers=hdr)
+        pre = (r.status, r.headers.get("Access-Control-Allow-Origin"))
+        r2 = await tc.get("/healthz", headers=hdr)
+        # error responses must carry CORS headers too (browsers hide the
+        # error entirely otherwise)
+        r3 = await tc.get("/no-such-route", headers=hdr)
+        # unlisted origins get no grant
+        r4 = await tc.get("/healthz", headers={"Origin": "https://evil"})
+        return (pre, r2.headers.get("Access-Control-Allow-Origin"),
+                (r3.status, r3.headers.get("Access-Control-Allow-Origin")),
+                r4.headers.get("Access-Control-Allow-Origin"))
+
+    (status, origin), origin2, (e_status, e_origin), evil = \
+        loop.run_until_complete(go())
+    assert status == 204 and origin == "https://app.example"
+    assert origin2 == "https://app.example"
+    assert e_status == 404 and e_origin == "https://app.example"
+    assert evil is None
+    loop.run_until_complete(tc.close())
+    loop.close()
