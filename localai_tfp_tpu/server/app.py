@@ -111,8 +111,10 @@ async def _prepare_headers(request: web.Request, response) -> None:
             response.headers["Vary"] = "Origin"
             response.headers["Access-Control-Allow-Methods"] = \
                 "GET, POST, PUT, DELETE, OPTIONS"
-            response.headers["Access-Control-Allow-Headers"] = \
-                "Authorization, Content-Type, X-Correlation-ID, X-Model"
+            response.headers["Access-Control-Allow-Headers"] = (
+                "Authorization, Content-Type, X-Correlation-ID, X-Model, "
+                "x-api-key, Extra-Usage"
+            )
 
 
 @web.middleware

@@ -204,6 +204,21 @@ def _tool_call_objects(calls) -> list[dict]:
     ]
 
 
+def _n_choices(body: dict, streaming: bool) -> int:
+    """Validated `n` (choice count). Streaming supports n=1 only —
+    reject rather than silently drop the extra choices."""
+    try:
+        n = int(body.get("n") or 1)
+    except (TypeError, ValueError):
+        raise web.HTTPBadRequest(reason="'n' must be an integer")
+    if n < 1 or n > 16:
+        raise web.HTTPBadRequest(reason="'n' must be between 1 and 16")
+    if streaming and n > 1:
+        raise web.HTTPBadRequest(
+            reason="'n' > 1 is not supported with streaming")
+    return n
+
+
 def _completion_id(prefix: str = "chatcmpl") -> str:
     return f"{prefix}-{uuid.uuid4().hex[:28]}"
 
@@ -243,6 +258,7 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
     created = int(time.time())
     cid = _completion_id()
 
+    n = _n_choices(body, bool(body.get("stream")))
     st.model_loader.mark_busy(cfg.name)
     try:
         if body.get("stream"):
@@ -251,36 +267,47 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                 tools_requested, extra_usage,
             )
 
-        reply = await _run_predict(backend, opts)
-        if reply.error:
-            raise web.HTTPInternalServerError(reason=reply.error)
-
-        message: dict[str, Any] = {"role": "assistant"}
-        finish = reply.finish_reason or "stop"
-        if tools_requested:
-            calls = parse_function_call(reply.message, cfg.function)
-            if calls:
-                message["tool_calls"] = _tool_call_objects(calls)
-                message["content"] = (
-                    parse_text_content(reply.message, cfg.function) or None
-                )
-                finish = "tool_calls"
+        # n>1: the choices run CONCURRENTLY — the continuous-batching
+        # engine serves them from parallel slots (ref: ComputeChoices,
+        # endpoints/openai/inference.go:11-60 loops n)
+        replies = await asyncio.gather(*[
+            _run_predict(backend, opts) for _ in range(n)
+        ])
+        choices = []
+        total = Reply()
+        for i, reply in enumerate(replies):
+            if reply.error:
+                raise web.HTTPInternalServerError(reason=reply.error)
+            message: dict[str, Any] = {"role": "assistant"}
+            finish = reply.finish_reason or "stop"
+            if tools_requested:
+                calls = parse_function_call(reply.message, cfg.function)
+                if calls:
+                    message["tool_calls"] = _tool_call_objects(calls)
+                    message["content"] = (
+                        parse_text_content(reply.message, cfg.function)
+                        or None
+                    )
+                    finish = "tool_calls"
+                else:
+                    message["content"] = reply.message
             else:
                 message["content"] = reply.message
-        else:
-            message["content"] = reply.message
+            choices.append({
+                "index": i, "message": message, "finish_reason": finish,
+            })
+            total.prompt_tokens += reply.prompt_tokens
+            total.tokens += reply.tokens
+            total.timing_prompt_processing += reply.timing_prompt_processing
+            total.timing_token_generation += reply.timing_token_generation
 
         return web.json_response({
             "id": cid,
             "object": "chat.completion",
             "created": created,
             "model": cfg.name,
-            "choices": [{
-                "index": 0,
-                "message": message,
-                "finish_reason": finish,
-            }],
-            "usage": _usage(reply, extra_usage),
+            "choices": choices,
+            "usage": _usage(total, extra_usage),
         })
     finally:
         st.model_loader.mark_idle(cfg.name)
@@ -397,13 +424,23 @@ async def completions(request: web.Request) -> web.StreamResponse:
                 request, backend, opts, cfg, cid, created, extra_usage
             )
 
-        choices = []
-        total = Reply()
-        for i, prompt in enumerate(prompts):
+        # prompts x n choices, all concurrent: the continuous-batching
+        # engine fans them across slots (ref: ComputeChoices loops n).
+        # Build every (prompt, opts) pair BEFORE creating coroutines so a
+        # template error cannot strand un-awaited coroutines.
+        n = _n_choices(body, False)
+        jobs = []
+        for prompt in prompts:
             templated = st.evaluator.evaluate_completion(cfg, prompt)
             opts = _predict_options(cfg, body, templated,
                                     request.get("correlation_id", ""))
-            reply = await _run_predict(backend, opts)
+            jobs.extend((prompt, opts) for _ in range(n))
+        replies = await asyncio.gather(*[
+            _run_predict(backend, o) for _, o in jobs
+        ])
+        choices = []
+        total = Reply()
+        for i, ((prompt, _), reply) in enumerate(zip(jobs, replies)):
             if reply.error:
                 raise web.HTTPInternalServerError(reason=reply.error)
             text = reply.message

@@ -271,3 +271,40 @@ def test_backend_monitor_and_shutdown(client):
     r = client.post("/backend/shutdown", json={"model": "tiny"})
     assert r.status == 200
     assert client.get("/backend/monitor?model=tiny").status == 404
+
+
+def test_chat_n_choices(client):
+    r = client.post("/v1/chat/completions", json={
+        "model": "tiny", "n": 3, "max_tokens": 4,
+        "messages": [{"role": "user", "content": "hi"}],
+    })
+    assert r.status == 200
+    out = r.json
+    assert len(out["choices"]) == 3
+    assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+    assert out["usage"]["completion_tokens"] == 12  # 3 x 4
+
+
+def test_completion_multi_prompt_and_n(client):
+    r = client.post("/v1/completions", json={
+        "model": "tiny", "prompt": ["a", "b"], "n": 2, "max_tokens": 3,
+    })
+    assert r.status == 200
+    out = r.json
+    assert len(out["choices"]) == 4
+    assert out["usage"]["completion_tokens"] == 12  # 4 x 3
+
+
+def test_n_validation(client):
+    r = client.post("/v1/chat/completions", json={
+        "model": "tiny", "n": "two",
+        "messages": [{"role": "user", "content": "x"}]})
+    assert r.status == 400
+    r = client.post("/v1/chat/completions", json={
+        "model": "tiny", "n": 99,
+        "messages": [{"role": "user", "content": "x"}]})
+    assert r.status == 400
+    r = client.post("/v1/chat/completions", json={
+        "model": "tiny", "n": 2, "stream": True,
+        "messages": [{"role": "user", "content": "x"}]})
+    assert r.status == 400
