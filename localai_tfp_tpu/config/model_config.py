@@ -228,6 +228,12 @@ class ModelConfig:
     n_draft: int = 0
     step: int = 0
     cfg_scale: float = 0.0
+    # LoRA (ref: backend_config.go:132-136 LoraAdapter/LoraAdapters/Scales)
+    lora_adapter: str = ""
+    lora_base: str = ""
+    lora_adapters: list[str] = field(default_factory=list)
+    lora_scales: list[float] = field(default_factory=list)
+    lora_scale: float = 0.0
     known_usecases: Optional[list[str]] = None
     download_files: list[dict] = field(default_factory=list)
     options: list[str] = field(default_factory=list)

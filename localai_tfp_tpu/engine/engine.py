@@ -70,6 +70,11 @@ class GenRequest:
     # grammar-constrained decoding: object with next_mask(state)->np.bool_[V]
     # and advance(state, token)->state (see grammars/constrain.py)
     constraint: Optional[Any] = None
+    # on-disk prompt cache (ref: backend.proto:135-141 PromptCachePath/
+    # PromptCacheAll/PromptCacheRO — llama.cpp prompt state save/restore)
+    prompt_cache_path: str = ""
+    prompt_cache_all: bool = False
+    prompt_cache_ro: bool = False
     correlation_id: str = ""
     id: str = field(default_factory=lambda: uuid.uuid4().hex)
 
@@ -109,6 +114,7 @@ class _Slot:
     decoder: Optional[StreamDecoder] = None
     pending_text: str = ""  # withheld tail that may begin a stop string
     constraint_state: Any = None
+    cache_loaded: Any = None  # (path, n) the on-disk prompt cache holds
     t_start: float = 0.0
     t_prefill_ms: float = 0.0
     t_decode_ms: float = 0.0
@@ -477,8 +483,106 @@ class LLMEngine:
             free, key=lambda s: _common_prefix(s.cache_tokens, req.prompt_ids)
         )
 
+    # ------------------------------------------------- on-disk prompt cache
+
+    def _try_load_prompt_cache(self, slot: _Slot, req: GenRequest) -> None:
+        """Restore a saved prompt's KV rows into the slot when the file's
+        token prefix beats the slot's resident prefix (ref: llama.cpp
+        prompt cache restore via PromptCachePath)."""
+        import os
+
+        path = req.prompt_cache_path
+        if not path or not os.path.exists(path):
+            return
+        try:
+            data = np.load(path)
+            cached_tokens = [int(t) for t in data["tokens"]]
+            L, _, _, F = self.cache.k.shape
+            k_all, v_all = data["k"], data["v"]
+            # a cache written by a different model/dtype config must be
+            # ignored, not crash the scheduler or corrupt KV
+            if (k_all.shape[0] != L or k_all.shape[2] != F
+                    or v_all.shape != k_all.shape):
+                return
+            if self.cache.quantized != (k_all.dtype == np.int8):
+                return
+            if self.cache.quantized and "k_scale" not in data:
+                return
+            common = _common_prefix(cached_tokens, req.prompt_ids)
+            if common <= _common_prefix(slot.cache_tokens, req.prompt_ids):
+                return
+            n = min(common, len(cached_tokens), self.max_seq - 1,
+                    k_all.shape[1])
+            ck = self.cache.k.at[:, slot.idx, :n].set(
+                jnp.asarray(k_all[:, :n]).astype(self.cache.k.dtype))
+            cv = self.cache.v.at[:, slot.idx, :n].set(
+                jnp.asarray(v_all[:, :n]).astype(self.cache.v.dtype))
+            ks, vs = self.cache.k_scale, self.cache.v_scale
+            if self.cache.quantized:
+                ks = ks.at[:, slot.idx, :n].set(
+                    jnp.asarray(data["k_scale"][:, :n]))
+                vs = vs.at[:, slot.idx, :n].set(
+                    jnp.asarray(data["v_scale"][:, :n]))
+        except Exception:
+            return  # unreadable/incompatible cache: prefill normally
+        self.cache = KVCache(k=ck, v=cv, k_scale=ks, v_scale=vs)
+        slot.cache_tokens = cached_tokens[:n]
+        slot.n_past = n
+        slot.cache_loaded = (path, n)
+        self._epoch += 1
+
+    def _maybe_save_prompt_cache(self, slot: _Slot) -> None:
+        """Persist the slot's prefix rows (ref: llama.cpp prompt cache
+        save; PromptCacheAll includes the generation)."""
+        import os
+
+        req = slot.request
+        if req is None or not req.prompt_cache_path or req.prompt_cache_ro:
+            return
+        n = slot.n_past if req.prompt_cache_all else min(
+            slot.n_past, slot.n_prompt)
+        if n <= 0:
+            return
+        if slot.cache_loaded == (req.prompt_cache_path, n):
+            return  # the file already holds exactly this prefix
+        # snapshot the (immutable) device arrays now; the transfer +
+        # write happens OFF the scheduler thread so a finishing request
+        # never stalls other slots' decoding
+        k_rows = self.cache.k[:, slot.idx, :n]
+        v_rows = self.cache.v[:, slot.idx, :n]
+        scales = ((self.cache.k_scale[:, slot.idx, :n],
+                   self.cache.v_scale[:, slot.idx, :n])
+                  if self.cache.quantized else None)
+        tokens = np.asarray(slot.cache_tokens[:n], np.int32)
+        path = req.prompt_cache_path
+
+        def persist():
+            def host(arr):  # bf16 has no portable numpy encoding
+                out = np.asarray(arr)
+                return out if out.dtype in (np.int8, np.float32) \
+                    else out.astype(np.float32)
+
+            payload = {"tokens": tokens, "k": host(k_rows),
+                       "v": host(v_rows)}
+            if scales is not None:
+                payload["k_scale"] = np.asarray(scales[0])
+                payload["v_scale"] = np.asarray(scales[1])
+            tmp = path + ".tmp"
+            try:
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                with open(tmp, "wb") as f:
+                    np.savez(f, **payload)
+                os.replace(tmp, path)
+            except OSError:
+                pass  # cache persistence is best-effort
+
+        threading.Thread(target=persist, daemon=True,
+                         name="prompt-cache-save").start()
+
     def _assign(self, slot: _Slot, req: GenRequest,
                 out: queue.SimpleQueue) -> None:
+        slot.cache_loaded = None
+        self._try_load_prompt_cache(slot, req)
         common = _common_prefix(slot.cache_tokens, req.prompt_ids)
         if common == len(req.prompt_ids):
             common -= 1  # reprocess last token to get logits (ref :1882-1890)
@@ -799,6 +903,7 @@ class LLMEngine:
 
     def _finish(self, slot: _Slot, reason: str) -> None:
         req = slot.request
+        self._maybe_save_prompt_cache(slot)
         full = slot.decoder.text if slot.decoder else ""
         if req is not None and req.stop:
             for st in req.stop:

@@ -208,6 +208,13 @@ class ModelLoader:
             mesh=cfg.mesh,
             threads=cfg.threads or 0,
             embeddings=cfg.embeddings,
+            lora_adapters=(
+                list(cfg.lora_adapters)
+                or ([cfg.lora_adapter] if cfg.lora_adapter else [])
+            ),
+            lora_scales=list(cfg.lora_scales) or (
+                [cfg.lora_scale] if cfg.lora_scale else []
+            ),
             options=cfg.options,
             extra=cfg.extra,
         )

@@ -1,0 +1,144 @@
+"""LoRA adapter loading and merging.
+
+Capability counterpart of the reference's LoRA support (ref: llama.cpp
+LoRA hot-apply plumbed through grpc-server.cpp LoadModel — SURVEY.md
+§2.3; proto fields LoraAdapter/LoraBase/LoraScale). TPU-native form:
+adapters are merged into the stacked-scan parameter leaves at load (or
+hot-apply) time — W += scale * (alpha/r) * B @ A — so serving keeps the
+exact same compiled program; applying/removing an adapter is a weight
+swap, never a recompile.
+
+Adapter files are HF/PEFT-format safetensors:
+``base_model.model.model.layers.{i}.self_attn.q_proj.lora_A.weight``
+(A: [r, in], B: [out, r]) with alpha/r in ``adapter_config.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .llm_spec import LLMSpec
+
+# projection name -> (stacked param leaf, fused-split handling)
+_PROJ_TO_LEAF = {
+    "q_proj": "wq",
+    "k_proj": "wk",
+    "v_proj": "wv",
+    "o_proj": "wo",
+    "gate_proj": "w_gate",
+    "up_proj": "w_up",
+    "down_proj": "w_down",
+}
+
+
+def load_adapter(adapter_dir: str) -> tuple[dict[str, np.ndarray], float]:
+    """Read a PEFT adapter dir -> (tensors by name, alpha/r scaling)."""
+    cfg_path = os.path.join(adapter_dir, "adapter_config.json")
+    scaling = 1.0
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+        r = float(cfg.get("r") or cfg.get("lora_rank") or 1)
+        alpha = float(cfg.get("lora_alpha") or r)
+        scaling = alpha / max(r, 1.0)
+    tensors: dict[str, np.ndarray] = {}
+    for fname in ("adapter_model.safetensors", "adapter_model.bin"):
+        path = os.path.join(adapter_dir, fname)
+        if not os.path.exists(path):
+            continue
+        if fname.endswith(".safetensors"):
+            from safetensors import safe_open
+
+            with safe_open(path, framework="np") as f:
+                for name in f.keys():
+                    tensors[name] = f.get_tensor(name)
+        else:
+            import torch
+
+            for name, t in torch.load(
+                path, map_location="cpu", weights_only=True
+            ).items():
+                tensors[name] = t.to(torch.float32).numpy()
+        break
+    if not tensors:
+        raise FileNotFoundError(
+            f"no adapter_model.safetensors/.bin in {adapter_dir}")
+    return tensors, scaling
+
+
+def _layer_index(name: str) -> Optional[int]:
+    parts = name.split(".")
+    for i, p in enumerate(parts):
+        if p == "layers" and i + 1 < len(parts):
+            try:
+                return int(parts[i + 1])
+            except ValueError:
+                return None
+    return None
+
+
+def _proj_name(name: str) -> Optional[str]:
+    for proj in _PROJ_TO_LEAF:
+        if f".{proj}." in name:
+            return proj
+    return None
+
+
+def merge_lora(
+    spec: LLMSpec,
+    params: dict[str, Any],
+    adapter_dir: str,
+    scale: float = 1.0,
+    sign: float = 1.0,
+) -> tuple[dict[str, Any], int]:
+    """Merge (sign=+1) or unmerge (sign=-1) an adapter into stacked params.
+
+    Returns (new params, number of projection sites touched). Deltas are
+    computed in f32 and cast to the leaf dtype; hot-apply = merge, hot-
+    remove = unmerge with the same scale.
+    """
+    tensors, scaling = load_adapter(adapter_dir)
+    scaling *= scale * sign
+
+    # collect (leaf, layer, A, B)
+    touched = 0
+    deltas: dict[str, dict[int, np.ndarray]] = {}
+    for name, a in tensors.items():
+        if ".lora_A." not in name:
+            continue
+        b_name = name.replace(".lora_A.", ".lora_B.")
+        b = tensors.get(b_name)
+        if b is None:
+            continue
+        layer = _layer_index(name)
+        proj = _proj_name(name)
+        if layer is None or proj is None:
+            continue
+        leaf = _PROJ_TO_LEAF[proj]
+        if leaf not in params:
+            continue
+        # torch linears: A [r, in], B [out, r]; our leaves are [L, in, out]
+        delta = (b.astype(np.float64) @ a.astype(np.float64)).T * scaling
+        deltas.setdefault(leaf, {})[layer] = delta.astype(np.float32)
+        touched += 1
+    if not touched:
+        raise ValueError(
+            f"adapter {adapter_dir} matched no parameters "
+            "(unsupported naming or fused projections)")
+
+    out = dict(params)
+    for leaf, by_layer in deltas.items():
+        arr = np.array(out[leaf], np.float32)  # mutable copy
+        for layer, delta in by_layer.items():
+            if layer >= arr.shape[0] or delta.shape != arr.shape[1:]:
+                raise ValueError(
+                    f"adapter shape mismatch on {leaf}[{layer}]: "
+                    f"{delta.shape} vs {arr.shape[1:]}")
+            arr[layer] += delta
+        out[leaf] = jnp.asarray(arr).astype(params[leaf].dtype)
+    return out, touched

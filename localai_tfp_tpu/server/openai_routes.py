@@ -296,7 +296,8 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
             choices.append({
                 "index": i, "message": message, "finish_reason": finish,
             })
-            total.prompt_tokens += reply.prompt_tokens
+            if i == 0:  # one shared prompt — count it once, like OpenAI
+                total.prompt_tokens = reply.prompt_tokens
             total.tokens += reply.tokens
             total.timing_prompt_processing += reply.timing_prompt_processing
             total.timing_token_generation += reply.timing_token_generation
@@ -414,9 +415,14 @@ async def completions(request: web.Request) -> web.StreamResponse:
     created = int(time.time())
     cid = _completion_id("cmpl")
 
+    streaming = bool(body.get("stream"))
+    n = _n_choices(body, streaming)
+    if streaming and len(prompts) > 1:
+        raise web.HTTPBadRequest(
+            reason="multiple prompts are not supported with streaming")
     st.model_loader.mark_busy(cfg.name)
     try:
-        if body.get("stream"):
+        if streaming:
             templated = st.evaluator.evaluate_completion(cfg, prompts[0])
             opts = _predict_options(cfg, body, templated,
                                     request.get("correlation_id", ""))
@@ -428,7 +434,6 @@ async def completions(request: web.Request) -> web.StreamResponse:
         # engine fans them across slots (ref: ComputeChoices loops n).
         # Build every (prompt, opts) pair BEFORE creating coroutines so a
         # template error cannot strand un-awaited coroutines.
-        n = _n_choices(body, False)
         jobs = []
         for prompt in prompts:
             templated = st.evaluator.evaluate_completion(cfg, prompt)
@@ -451,7 +456,8 @@ async def completions(request: web.Request) -> web.StreamResponse:
                 "text": text,
                 "finish_reason": reply.finish_reason or "stop",
             })
-            total.prompt_tokens += reply.prompt_tokens
+            if i % n == 0:  # count each distinct prompt once, not x n
+                total.prompt_tokens += reply.prompt_tokens
             total.tokens += reply.tokens
             total.timing_prompt_processing += reply.timing_prompt_processing
             total.timing_token_generation += reply.timing_token_generation

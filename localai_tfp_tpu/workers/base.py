@@ -55,6 +55,7 @@ class PredictOptions:
     mirostat_tau: float = 0.0
     prompt_cache_path: str = ""
     prompt_cache_all: bool = False
+    prompt_cache_ro: bool = False
     correlation_id: str = ""
     use_tokenizer_template: bool = False
 
@@ -87,6 +88,8 @@ class ModelLoadOptions:
     mesh: dict[str, int] = field(default_factory=dict)
     threads: int = 0
     embeddings: bool = False
+    lora_adapters: list[str] = field(default_factory=list)
+    lora_scales: list[float] = field(default_factory=list)
     options: list[str] = field(default_factory=list)
     extra: dict[str, Any] = field(default_factory=dict)
 

@@ -19,6 +19,7 @@ from ..engine.engine import GenRequest, LLMEngine, StreamEvent
 from ..engine.tokenizer import Tokenizer, load_tokenizer
 from ..grammars.native import make_constraint
 from ..models.hf_loader import load_params
+from ..models.lora import merge_lora
 from ..models.llm_spec import LLMSpec
 from .base import (
     Backend,
@@ -74,6 +75,19 @@ class JaxLLMBackend(Backend):
                 dtype = _DTYPES.get((opts.dtype or "bfloat16").lower(),
                                     jnp.bfloat16)
                 self.spec, params = load_params(model_dir, dtype=dtype)
+                # merge LoRA adapters at load (ref: llama.cpp LoRA apply
+                # via LoadModel — proto LoraAdapter/LoraScale)
+                for i, adir in enumerate(opts.lora_adapters):
+                    if not os.path.isabs(adir):
+                        adir = os.path.join(opts.model_path or "", adir)
+                    # an explicit 0.0 scale disables the adapter; only a
+                    # MISSING entry defaults to 1.0
+                    scale = (float(opts.lora_scales[i])
+                             if i < len(opts.lora_scales) else 1.0)
+                    if scale == 0.0:
+                        continue
+                    params, n = merge_lora(self.spec, params, adir,
+                                           scale=scale)
                 self.tokenizer = load_tokenizer(model_dir)
                 kv_dtype = _KV_DTYPES.get(
                     (opts.kv_cache_dtype or opts.dtype or "bfloat16").lower(),
@@ -161,6 +175,9 @@ class JaxLLMBackend(Backend):
             ignore_eos=opts.ignore_eos,
             logit_bias=opts.logit_bias or None,
             constraint=constraint,
+            prompt_cache_path=opts.prompt_cache_path,
+            prompt_cache_all=opts.prompt_cache_all,
+            prompt_cache_ro=opts.prompt_cache_ro,
             correlation_id=opts.correlation_id,
         )
 
@@ -195,6 +212,26 @@ class JaxLLMBackend(Backend):
         text = opts.embeddings or opts.prompt
         vec = self.engine.embed(text)
         return EmbeddingResult(embeddings=[float(x) for x in vec])
+
+    def apply_lora(self, adapter_dir: str, scale: float = 1.0) -> int:
+        """Hot-apply a LoRA adapter to the RUNNING engine (ref: llama.cpp
+        LoRA hot-apply). Weight swap only — no recompilation; in-flight
+        scans finish on the old weights, the next dispatch uses the new."""
+        if self.engine is None or self.spec is None:
+            raise RuntimeError("model not loaded")
+        params, n = merge_lora(self.spec, self.engine.params, adapter_dir,
+                               scale=scale)
+        self.engine.params = params
+        return n
+
+    def remove_lora(self, adapter_dir: str, scale: float = 1.0) -> int:
+        """Hot-unmerge a previously applied adapter (same scale)."""
+        if self.engine is None or self.spec is None:
+            raise RuntimeError("model not loaded")
+        params, n = merge_lora(self.spec, self.engine.params, adapter_dir,
+                               scale=scale, sign=-1.0)
+        self.engine.params = params
+        return n
 
     def get_metrics(self) -> MetricsResponse:
         if self.engine is None:

@@ -1,0 +1,122 @@
+"""LoRA adapter merge/unmerge (ref: llama.cpp LoRA hot-apply;
+backend_config.go:132-136 lora_adapter(s)/scales)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from localai_tfp_tpu.models.llm_spec import tiny_spec
+from localai_tfp_tpu.models.lora import merge_lora
+from localai_tfp_tpu.models.transformer import KVCache, forward, init_params
+
+
+def _save_adapter(d, spec, rank=2, alpha=4.0, layers=(0,), seed=0):
+    """PEFT-format adapter: q_proj + v_proj deltas on given layers."""
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(seed)
+    tensors = {}
+    for layer in layers:
+        for proj, out_dim in (("q_proj", spec.q_dim),
+                              ("v_proj", spec.kv_dim)):
+            base = (f"base_model.model.model.layers.{layer}."
+                    f"self_attn.{proj}")
+            tensors[f"{base}.lora_A.weight"] = rng.standard_normal(
+                (rank, spec.d_model)).astype(np.float32) * 0.1
+            tensors[f"{base}.lora_B.weight"] = rng.standard_normal(
+                (out_dim, rank)).astype(np.float32) * 0.1
+    save_file(tensors, str(d / "adapter_model.safetensors"))
+    (d / "adapter_config.json").write_text(json.dumps(
+        {"r": rank, "lora_alpha": alpha}))
+    return tensors, alpha / rank
+
+
+def test_merge_matches_manual_delta(tmp_path):
+    spec = tiny_spec()
+    params = init_params(jax.random.PRNGKey(0), spec, dtype=jnp.float32)
+    tensors, scaling = _save_adapter(tmp_path, spec, layers=(0, 1))
+
+    merged, n = merge_lora(spec, params, str(tmp_path))
+    assert n == 4  # 2 layers x 2 projections
+    a = tensors["base_model.model.model.layers.1.self_attn.q_proj"
+                ".lora_A.weight"]
+    b = tensors["base_model.model.model.layers.1.self_attn.q_proj"
+                ".lora_B.weight"]
+    want = np.asarray(params["wq"][1]) + (b @ a).T * scaling
+    np.testing.assert_allclose(np.asarray(merged["wq"][1]), want,
+                               rtol=1e-5, atol=1e-5)
+    # untouched leaves stay identical
+    np.testing.assert_array_equal(np.asarray(merged["wk"]),
+                                  np.asarray(params["wk"]))
+
+
+def test_merge_changes_logits_and_unmerge_restores(tmp_path):
+    spec = tiny_spec()
+    params = init_params(jax.random.PRNGKey(1), spec, dtype=jnp.float32)
+    _save_adapter(tmp_path, spec)
+    tokens = jnp.asarray([[3, 5, 7]], jnp.int32)
+
+    def logits(p):
+        cache = KVCache.create(spec, 1, 8, jnp.float32)
+        out, _ = forward(spec, p, tokens, jnp.zeros((1,), jnp.int32),
+                         cache, jnp.zeros((1,), jnp.int32))
+        return np.asarray(out)
+
+    base = logits(params)
+    merged, _ = merge_lora(spec, params, str(tmp_path))
+    assert not np.allclose(logits(merged), base)
+    restored, _ = merge_lora(spec, merged, str(tmp_path), sign=-1.0)
+    np.testing.assert_allclose(logits(restored), base, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_merge_scale_and_errors(tmp_path):
+    spec = tiny_spec()
+    params = init_params(jax.random.PRNGKey(2), spec, dtype=jnp.float32)
+    _save_adapter(tmp_path, spec)
+    m1, _ = merge_lora(spec, params, str(tmp_path), scale=2.0)
+    m2, _ = merge_lora(spec, params, str(tmp_path), scale=1.0)
+    d1 = np.asarray(m1["wq"]) - np.asarray(params["wq"])
+    d2 = np.asarray(m2["wq"]) - np.asarray(params["wq"])
+    np.testing.assert_allclose(d1, 2 * d2, rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(FileNotFoundError):
+        merge_lora(spec, params, str(tmp_path / "nope"))
+
+
+def test_worker_loads_with_adapter(tmp_path):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from localai_tfp_tpu.workers.base import ModelLoadOptions, PredictOptions
+    from localai_tfp_tpu.workers.llm import JaxLLMBackend
+
+    torch.manual_seed(0)
+    ckpt = tmp_path / "ckpt"
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=300, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256,
+    )).save_pretrained(ckpt, safe_serialization=True)
+    adapter = tmp_path / "adapter"
+    adapter.mkdir()
+    from localai_tfp_tpu.models.hf_loader import load_params
+
+    spec, _ = load_params(str(ckpt), dtype=jnp.float32)
+    _save_adapter(adapter, spec)
+
+    b = JaxLLMBackend()
+    res = b.load_model(ModelLoadOptions(
+        model=str(ckpt), context_size=128, batch_slots=2, dtype="float32",
+        lora_adapters=[str(adapter)], lora_scales=[1.0],
+    ))
+    assert res.success, res.message
+    out = b.predict(PredictOptions(prompt="hi", tokens=4))
+    assert not out.error
+    # hot-remove then hot-apply round-trips
+    assert b.remove_lora(str(adapter)) == 2
+    assert b.apply_lora(str(adapter)) == 2
+    b.shutdown()

@@ -1,0 +1,94 @@
+"""On-disk prompt cache (ref: backend.proto:135-141 PromptCachePath/All/RO
+— llama.cpp prompt state save + restore)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tfp_tpu.engine.engine import GenRequest, LLMEngine
+from localai_tfp_tpu.engine.tokenizer import ByteTokenizer
+from localai_tfp_tpu.models.llm_spec import tiny_spec
+from localai_tfp_tpu.models.transformer import init_params
+
+PROMPT = "the quick brown fox jumps over the lazy dog " * 3
+
+
+def _engine(params, spec, **kw):
+    return LLMEngine(spec, params, ByteTokenizer(), n_slots=2, max_seq=256,
+                     cache_dtype=jnp.float32, autostart=False, **kw)
+
+
+def _gen(eng, path="", all_=False, ro=False, max_tokens=8):
+    req = GenRequest(
+        prompt_ids=eng.tokenizer.encode(PROMPT, add_bos=True),
+        max_tokens=max_tokens, temperature=0.0, ignore_eos=True,
+        prompt_cache_path=path, prompt_cache_all=all_, prompt_cache_ro=ro,
+    )
+    ev = eng.generate(req)
+    assert ev.finish_reason == "length", ev.error
+    return ev
+
+
+def test_prompt_cache_save_and_restore(tmp_path):
+    spec = tiny_spec()
+    params = init_params(jax.random.PRNGKey(0), spec, dtype=jnp.float32)
+    path = str(tmp_path / "prompt.cache")
+
+    eng1 = _engine(params, spec)
+    eng1.start()
+    ev1 = _gen(eng1, path)
+    eng1.close()
+    assert os.path.exists(path)
+    data = np.load(path)
+    n_prompt = len(ByteTokenizer().encode(PROMPT)) + 1
+    assert data["k"].shape[1] <= n_prompt  # prompt-only rows saved
+    assert data["k"].dtype == np.float32
+
+    # a FRESH engine restores the prefix: prompt_tokens processed by
+    # prefill should shrink to ~1 (only the relogit token), and the
+    # output must be identical
+    eng2 = _engine(params, spec)
+    eng2.start()
+    ev2 = _gen(eng2, path)
+    eng2.close()
+    assert ev2.full_text == ev1.full_text
+    # restored prefix means prefill touched at most one bucket of tokens
+    assert eng2.metrics.prompt_tokens_processed <= n_prompt
+
+
+def test_prompt_cache_ro_does_not_write(tmp_path):
+    spec = tiny_spec()
+    params = init_params(jax.random.PRNGKey(1), spec, dtype=jnp.float32)
+    path = str(tmp_path / "ro.cache")
+    eng = _engine(params, spec)
+    eng.start()
+    _gen(eng, path, ro=True)
+    eng.close()
+    assert not os.path.exists(path)
+
+
+def test_prompt_cache_all_includes_generation(tmp_path):
+    spec = tiny_spec()
+    params = init_params(jax.random.PRNGKey(2), spec, dtype=jnp.float32)
+    path = str(tmp_path / "all.cache")
+    eng = _engine(params, spec)
+    eng.start()
+    _gen(eng, path, all_=True, max_tokens=6)
+    eng.close()
+    data = np.load(path)
+    n_prompt = len(ByteTokenizer().encode(PROMPT)) + 1
+    assert data["tokens"].shape[0] > n_prompt  # generation rows included
+
+
+def test_corrupt_cache_ignored(tmp_path):
+    spec = tiny_spec()
+    params = init_params(jax.random.PRNGKey(3), spec, dtype=jnp.float32)
+    path = str(tmp_path / "bad.cache")
+    open(path, "wb").write(b"not-an-npz")
+    eng = _engine(params, spec)
+    eng.start()
+    ev = _gen(eng, path)  # must not crash; falls back to normal prefill
+    eng.close()
+    assert ev.completion_tokens == 8
