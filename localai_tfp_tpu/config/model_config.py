@@ -228,9 +228,10 @@ class ModelConfig:
     n_draft: int = 0
     step: int = 0
     cfg_scale: float = 0.0
-    # LoRA (ref: backend_config.go:132-136 LoraAdapter/LoraAdapters/Scales)
+    # LoRA (ref: backend_config.go:132-136 LoraAdapter/LoraAdapters/Scales;
+    # lora_base is a llama.cpp-quantization concern — accepted via `extra`
+    # and ignored like other non-applicable fields)
     lora_adapter: str = ""
-    lora_base: str = ""
     lora_adapters: list[str] = field(default_factory=list)
     lora_scales: list[float] = field(default_factory=list)
     lora_scale: float = 0.0

@@ -136,6 +136,8 @@ class EngineMetrics:
     tokens_per_second: float = 0.0
     prompt_tokens_per_second: float = 0.0
     slots_busy: int = 0
+    spec_tokens: int = 0  # tokens emitted via speculative decoding
+    spec_dispatches: int = 0
 
 
 def _common_prefix(a: list[int], b: list[int]) -> int:
@@ -182,10 +184,15 @@ class LLMEngine:
         decode_steps: int = 8,
         mesh: Any = None,  # jax.sharding.Mesh: TP/DP serving (the GSPMD
         # counterpart of tensor_split / tensor_parallel_size — SURVEY §2.5)
+        draft: Optional[tuple[LLMSpec, Params]] = None,  # speculative
+        # decoding draft model (ref: proto DraftModel/NDraft plumbing)
+        n_draft: int = 4,
         autostart: bool = True,
     ) -> None:
         self.decode_steps = max(1, decode_steps)
         self.mesh = mesh
+        self.draft = draft
+        self.n_draft = max(2, n_draft)
         self._autostart = autostart
         self.spec = spec
         self.params = params
@@ -196,6 +203,10 @@ class LLMEngine:
             b for b in sorted(prefill_buckets) if b <= max_seq
         ) or (max_seq,)
         self.cache = KVCache.create(spec, n_slots, max_seq, cache_dtype)
+        self.draft_cache = (
+            KVCache.create(draft[0], n_slots, max_seq, cache_dtype)
+            if draft is not None else None
+        )
         self.sampling = SamplingState.create(
             n_slots, spec.vocab_size, window=penalty_window
         )
@@ -274,7 +285,7 @@ class LLMEngine:
         self._decode_fn = _decode
         self._sample_fn = _sample_only
         self._hidden_fn = _hidden
-        self._decode_k_fns: dict[int, Any] = {}
+        self._decode_k_fns: dict[tuple, Any] = {}  # ("decode", k, W) | ("spec", kd, rounds) | ("draft_prefill",)
         # device-resident decode state (tokens/pos/active) reused across
         # dispatches while no slot changes; _epoch invalidates it
         self._epoch = 0
@@ -305,6 +316,150 @@ class LLMEngine:
             and not self.spec.attn_logit_softcap
         )
 
+    def _spec_decode_fn(self, kd: int, rounds: int):
+        """Jitted speculative decoding: ``rounds`` iterations of
+        (draft kd-1 greedy tokens -> ONE main verify forward of T=kd ->
+        on-device cumulative acceptance) per host dispatch. Greedy
+        acceptance reproduces the main model's greedy sequence EXACTLY
+        while paying ~1 main forward per accepted run instead of per
+        token (ref: the proto's DraftModel/NDraft surface; greenfield on
+        TPU). Rejected-draft cache rows land beyond the valid prefix and
+        are rewritten next round — the same invariant the multi-step
+        overshoot discard relies on."""
+        key = ("spec", kd, rounds)
+        fn = self._decode_k_fns.get(key)
+        if fn is not None:
+            return fn
+        spec = self.spec
+        dspec = self.draft[0]  # static; draft params passed per call
+
+        @partial(jax.jit, donate_argnums=(2, 3))
+        def _spec(params, dparams, cache, dcache, tokens, pos0, active):
+            def round_(carry, _):
+                tok, pos, cache, dcache = carry
+
+                def dstep(c, _):
+                    t, p, dc = c
+                    lg, dc = forward(dspec, dparams, t, p, dc, None)
+                    nt = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
+                    p2 = jnp.where(active, p + 1, p)
+                    return (nt[:, None], p2, dc), nt
+
+                # kd steps (not kd-1): the extra step's sampled token is
+                # discarded, but it writes d_{kd-1}'s K/V so the draft
+                # cache covers the full accepted prefix after a clean
+                # round (otherwise a stale row sits inside the draft's
+                # attended prefix and quietly kills the acceptance rate)
+                (_, _, dcache2), dts = lax.scan(
+                    dstep, (tok, pos, dcache), None, length=kd)
+                d_toks = dts[: kd - 1].T  # [S, kd-1]
+                xin = jnp.concatenate([tok, d_toks], axis=1)  # [S, kd]
+                lg, cache2 = forward(spec, params, xin, pos, cache, None)
+                m_toks = jnp.argmax(lg, -1).astype(jnp.int32)  # [S, kd]
+                ok = (m_toks[:, : kd - 1] == d_toks).astype(jnp.int32)
+                j = 1 + jnp.cumprod(ok, axis=1).sum(1)  # [S] in 1..kd
+                j = jnp.where(active, j, 0)
+                last = jnp.take_along_axis(
+                    m_toks, (jnp.maximum(j, 1) - 1)[:, None], axis=1)
+                pos2 = jnp.where(active, pos + j, pos)
+                return (last, pos2, cache2, dcache2), (d_toks, m_toks, j)
+
+            (tok_f, pos_f, cache, dcache), (D, Mt, J) = lax.scan(
+                round_, (tokens, pos0, cache, dcache), None, length=rounds)
+            return D, Mt, J, tok_f, pos_f, cache, dcache
+
+        self._decode_k_fns[key] = _spec
+        return _spec
+
+    def _draft_prefill_fn(self):
+        """Draft-model prefill (the draft cache must mirror the main
+        cache's token positions for speculative decoding)."""
+        fn = self._decode_k_fns.get(("draft_prefill",))
+        if fn is not None:
+            return fn
+        dspec = self.draft[0]
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def _dp(dparams, tokens, dcache, pos0, slot_ids):
+            _, dcache = forward(dspec, dparams, tokens, pos0, dcache,
+                                slot_ids)
+            return dcache
+
+        self._decode_k_fns[("draft_prefill",)] = _dp
+        return _dp
+
+    def _spec_eligible(self, decoding: list[_Slot]) -> bool:
+        """Speculative decoding serves pure-greedy requests (temp<=0, no
+        grammar/bias/penalties — those need per-token sampler state)."""
+        if self.draft is None:
+            return False
+        for s in decoding:
+            r = s.request
+            if r is None or r.temperature > 0 or r.constraint \
+                    or r.logit_bias or r.repeat_penalty not in (0.0, 1.0) \
+                    or r.frequency_penalty or r.presence_penalty:
+                return False
+        return True
+
+    def _spec_decode_step(self, decoding: list[_Slot]) -> None:
+        """One speculative dispatch (see _spec_decode_fn)."""
+        t0 = time.perf_counter()
+        S = self.n_slots
+        kd = self.n_draft
+        room = min(self.max_seq - 1 - s.n_past for s in decoding)
+        rounds = max(1, min(self.decode_steps // kd,
+                            max(room // kd, 1)))
+        span = rounds * kd
+        tokens = np.zeros((S, 1), np.int32)
+        pos0 = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
+        for s in self.slots:
+            if s.state is SlotState.DECODE:
+                tokens[s.idx, 0] = (s.generated[-1] if s.generated
+                                    else s.request.prompt_ids[-1])
+                pos0[s.idx] = s.n_past
+                active[s.idx] = True
+            else:
+                # parked rows must not run off the row end mid-scan
+                limit = max(self.max_seq - 1 - span, 0)
+                if s.n_past > limit:
+                    s.n_past = limit
+                    s.cache_tokens = s.cache_tokens[:limit]
+                pos0[s.idx] = s.n_past
+        fn = self._spec_decode_fn(kd, rounds)
+        D, Mt, J, _, _, self.cache, self.draft_cache = fn(
+            self.params, self.draft[1], self.cache, self.draft_cache,
+            jnp.asarray(tokens), jnp.asarray(pos0), jnp.asarray(active),
+        )
+        D = np.asarray(D)  # [rounds, S, kd-1] draft candidates
+        Mt = np.asarray(Mt)  # [rounds, S, kd] main greedy tokens
+        J = np.asarray(J)  # [rounds, S] emitted counts
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        emitted_total = 0
+        for s in decoding:
+            s.t_decode_ms += dt_ms
+            prev_last = int(tokens[s.idx, 0])
+            for r in range(rounds):
+                if s.state is not SlotState.DECODE:
+                    break
+                j = int(J[r, s.idx])
+                emitted = [int(t) for t in D[r, s.idx, : j - 1]]
+                emitted.append(int(Mt[r, s.idx, j - 1]))
+                for tok_out in emitted:
+                    if s.state is not SlotState.DECODE:
+                        break
+                    s.cache_tokens.append(prev_last)
+                    s.n_past += 1
+                    prev_last = tok_out
+                    emitted_total += 1
+                    self._emit_token(s, tok_out)
+        self.metrics.spec_tokens += emitted_total
+        self.metrics.spec_dispatches += 1
+        dt = time.perf_counter() - t0
+        if dt > 0 and emitted_total:
+            self.metrics.tokens_per_second = emitted_total / dt
+        self.metrics.slots_busy = sum(1 for s in self.slots if s.active)
+
     def _decode_k_fn(self, k: int, window: int):
         """Jitted k-step decode: ``lax.scan`` over k forward+sample steps so
         one host dispatch yields k tokens per active slot. This hides
@@ -317,7 +472,7 @@ class LLMEngine:
         context use, not max_seq — the XLA stand-in for ragged paged
         attention. The slice/write-back happens once per dispatch, inside
         the jit, so XLA keeps it in place on the donated buffer."""
-        fn = self._decode_k_fns.get((k, window))
+        fn = self._decode_k_fns.get(("decode", k, window))
         if fn is not None:
             return fn
         spec = self.spec
@@ -368,7 +523,7 @@ class LLMEngine:
             # on device state without a host round trip
             return toks_seq.T, tok_next, pos_next, cache, sampling  # [S, k]
 
-        self._decode_k_fns[(k, window)] = _decode_k
+        self._decode_k_fns[("decode", k, window)] = _decode_k
         return _decode_k
 
     # ------------------------------------------------------------------ API
@@ -494,6 +649,8 @@ class LLMEngine:
         path = req.prompt_cache_path
         if not path or not os.path.exists(path):
             return
+        if self.draft is not None:
+            return  # restored rows would leave the draft cache stale
         try:
             data = np.load(path)
             cached_tokens = [int(t) for t in data["tokens"]]
@@ -567,7 +724,9 @@ class LLMEngine:
             if scales is not None:
                 payload["k_scale"] = np.asarray(scales[0])
                 payload["v_scale"] = np.asarray(scales[1])
-            tmp = path + ".tmp"
+            # unique temp name: concurrent saves to one path must not
+            # truncate each other's half-written file before os.replace
+            tmp = f"{path}.tmp.{uuid.uuid4().hex[:8]}"
             try:
                 os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
                 with open(tmp, "wb") as f:
@@ -643,6 +802,12 @@ class LLMEngine:
             jnp.asarray([slot.n_past], jnp.int32),
             jnp.asarray([slot.idx], jnp.int32),
         )
+        if self.draft is not None:
+            self.draft_cache = self._draft_prefill_fn()(
+                self.draft[1], jnp.asarray(toks), self.draft_cache,
+                jnp.asarray([slot.n_past], jnp.int32),
+                jnp.asarray([slot.idx], jnp.int32),
+            )
         slot.n_past += len(chunk)
         slot.cache_tokens.extend(chunk)
         slot.t_prefill_ms += (time.perf_counter() - t0) * 1e3
@@ -678,6 +843,11 @@ class LLMEngine:
             self.sampling, jnp.asarray(slot_ids), jnp.asarray(n_chunk),
             jnp.asarray(tails), jnp.asarray(tail_lens), masks,
         )
+        if self.draft is not None:
+            self.draft_cache = self._draft_prefill_fn()(
+                self.draft[1], jnp.asarray(toks), self.draft_cache,
+                jnp.asarray(pos0), jnp.asarray(slot_ids),
+            )
         toks_host = np.asarray(toks_out)
         dt_ms = (time.perf_counter() - t0) * 1e3
         now = time.perf_counter()
@@ -747,6 +917,13 @@ class LLMEngine:
         host work; tokens generated past a slot's EOS/stop are discarded
         host-side and its n_past rolled back (the over-written tail K/V sits
         beyond the valid prefix, so it is never attended to)."""
+        if self._spec_eligible(decoding) and min(
+                self.max_seq - 1 - s.n_past for s in decoding
+        ) >= self.n_draft:
+            # near the context wall the kd-token verify forward would
+            # clamp its KV writes onto valid rows; normal path instead
+            self._spec_decode_step(decoding)
+            return
         t0 = time.perf_counter()
         S = self.n_slots
         k, room = self._multi_step_k(decoding)
@@ -760,8 +937,9 @@ class LLMEngine:
         # prefer an already-compiled window >= need over compiling a new
         # exact bucket (a cold jit costs seconds; reading a slightly larger
         # window costs microseconds)
-        compiled = [w for (kk, w) in self._decode_k_fns
-                    if kk == k and window <= w]
+        compiled = [key[2] for key in self._decode_k_fns
+                    if key[0] == "decode" and key[1] == k
+                    and window <= key[2]]
         if compiled:
             window = min(compiled)
 

@@ -208,6 +208,8 @@ class ModelLoader:
             mesh=cfg.mesh,
             threads=cfg.threads or 0,
             embeddings=cfg.embeddings,
+            draft_model=cfg.draft_model,
+            n_draft=cfg.n_draft or 0,
             lora_adapters=(
                 list(cfg.lora_adapters)
                 or ([cfg.lora_adapter] if cfg.lora_adapter else [])

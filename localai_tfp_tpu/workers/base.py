@@ -88,6 +88,8 @@ class ModelLoadOptions:
     mesh: dict[str, int] = field(default_factory=dict)
     threads: int = 0
     embeddings: bool = False
+    draft_model: str = ""  # speculative decoding (proto DraftModel)
+    n_draft: int = 0
     lora_adapters: list[str] = field(default_factory=list)
     lora_scales: list[float] = field(default_factory=list)
     options: list[str] = field(default_factory=list)

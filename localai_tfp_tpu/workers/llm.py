@@ -98,6 +98,13 @@ class JaxLLMBackend(Backend):
                     from ..parallel.mesh import make_mesh
 
                     mesh = make_mesh(opts.mesh)
+                draft = None
+                if opts.draft_model:
+                    ddir = opts.draft_model
+                    if not os.path.isabs(ddir):
+                        ddir = os.path.join(opts.model_path or "", ddir)
+                    dspec, dparams = load_params(ddir, dtype=dtype)
+                    draft = (dspec, dparams)
                 self.engine = LLMEngine(
                     self.spec,
                     params,
@@ -107,6 +114,8 @@ class JaxLLMBackend(Backend):
                     cache_dtype=kv_dtype,
                     decode_steps=int(opts.extra.get("decode_steps", 8)),
                     mesh=mesh,
+                    draft=draft,
+                    n_draft=opts.n_draft or 4,
                 )
                 self.engine.start()
                 self._state = "READY"
@@ -221,7 +230,7 @@ class JaxLLMBackend(Backend):
             raise RuntimeError("model not loaded")
         params, n = merge_lora(self.spec, self.engine.params, adapter_dir,
                                scale=scale)
-        self.engine.params = params
+        self.engine.params = self._reshard(params)
         return n
 
     def remove_lora(self, adapter_dir: str, scale: float = 1.0) -> int:
@@ -230,8 +239,18 @@ class JaxLLMBackend(Backend):
             raise RuntimeError("model not loaded")
         params, n = merge_lora(self.spec, self.engine.params, adapter_dir,
                                scale=scale, sign=-1.0)
-        self.engine.params = params
+        self.engine.params = self._reshard(params)
         return n
+
+    def _reshard(self, params):
+        """merge_lora round-trips leaves through host memory; under a mesh
+        the merged leaves must go back to their NamedShardings or XLA
+        replicates them on every chip."""
+        if self.engine is not None and self.engine.mesh is not None:
+            from ..parallel.sharding import shard_params
+
+            return shard_params(params, self.engine.mesh)
+        return params
 
     def get_metrics(self) -> MetricsResponse:
         if self.engine is None:

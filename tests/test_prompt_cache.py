@@ -15,6 +15,17 @@ from localai_tfp_tpu.models.transformer import init_params
 PROMPT = "the quick brown fox jumps over the lazy dog " * 3
 
 
+def _wait_for(path, timeout=10.0):
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(path):
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"prompt cache {path} never appeared")
+
+
 def _engine(params, spec, **kw):
     return LLMEngine(spec, params, ByteTokenizer(), n_slots=2, max_seq=256,
                      cache_dtype=jnp.float32, autostart=False, **kw)
@@ -40,7 +51,7 @@ def test_prompt_cache_save_and_restore(tmp_path):
     eng1.start()
     ev1 = _gen(eng1, path)
     eng1.close()
-    assert os.path.exists(path)
+    _wait_for(path)  # persistence runs on a background thread
     data = np.load(path)
     n_prompt = len(ByteTokenizer().encode(PROMPT)) + 1
     assert data["k"].shape[1] <= n_prompt  # prompt-only rows saved
@@ -77,6 +88,7 @@ def test_prompt_cache_all_includes_generation(tmp_path):
     eng.start()
     _gen(eng, path, all_=True, max_tokens=6)
     eng.close()
+    _wait_for(path)
     data = np.load(path)
     n_prompt = len(ByteTokenizer().encode(PROMPT)) + 1
     assert data["tokens"].shape[0] > n_prompt  # generation rows included

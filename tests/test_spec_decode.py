@@ -1,0 +1,98 @@
+"""Speculative decoding: greedy acceptance must reproduce the main
+model's greedy sequence EXACTLY, for any draft model (the acceptance rule
+only ever emits main-model argmax tokens)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from localai_tfp_tpu.engine.engine import GenRequest, LLMEngine
+from localai_tfp_tpu.engine.tokenizer import ByteTokenizer
+from localai_tfp_tpu.models.llm_spec import tiny_spec
+from localai_tfp_tpu.models.transformer import init_params
+
+
+def _engines(seed_main=0, seed_draft=99, n_draft=4):
+    spec = tiny_spec()
+    params = init_params(jax.random.PRNGKey(seed_main), spec,
+                         dtype=jnp.float32)
+    dspec = tiny_spec(d_model=32, n_layers=1, d_ff=64)
+    dparams = init_params(jax.random.PRNGKey(seed_draft), dspec,
+                          dtype=jnp.float32)
+    tok = ByteTokenizer()
+    plain = LLMEngine(spec, params, tok, n_slots=2, max_seq=256,
+                      cache_dtype=jnp.float32, autostart=False)
+    spec_eng = LLMEngine(spec, params, tok, n_slots=2, max_seq=256,
+                         cache_dtype=jnp.float32, autostart=False,
+                         draft=(dspec, dparams), n_draft=n_draft,
+                         decode_steps=16)
+    return plain, spec_eng
+
+
+def _greedy(eng, prompt, n=24):
+    ev = eng.generate(GenRequest(
+        prompt_ids=eng.tokenizer.encode(prompt, add_bos=True),
+        max_tokens=n, temperature=0.0, ignore_eos=True))
+    assert ev.finish_reason == "length", ev.error
+    return ev.full_text
+
+
+def test_spec_decode_matches_plain_greedy():
+    plain, spec_eng = _engines()
+    plain.start()
+    spec_eng.start()
+    try:
+        for prompt in ("hello world", "the quick brown fox", "a"):
+            assert _greedy(plain, prompt) == _greedy(spec_eng, prompt)
+        assert spec_eng.metrics.spec_dispatches > 0
+        assert spec_eng.metrics.spec_tokens > 0
+    finally:
+        plain.close()
+        spec_eng.close()
+
+
+def test_spec_decode_concurrent_and_prefix_reuse():
+    plain, spec_eng = _engines(n_draft=3)
+    plain.start()
+    spec_eng.start()
+    try:
+        import queue as _q
+
+        outs = {}
+        for eng in (plain, spec_eng):
+            qs = [eng.submit(GenRequest(
+                prompt_ids=eng.tokenizer.encode(f"prompt {i}",
+                                                add_bos=True),
+                max_tokens=10, temperature=0.0, ignore_eos=True,
+            )) for i in range(3)]
+            texts = []
+            for q in qs:
+                while True:
+                    ev = q.get()
+                    if ev.done:
+                        texts.append(ev.full_text)
+                        break
+            outs[id(eng)] = texts
+        assert outs[id(plain)] == outs[id(spec_eng)]
+        # prefix reuse after finish still coherent (draft cache mirrors)
+        a = _greedy(spec_eng, "prompt 0", n=6)
+        b = _greedy(plain, "prompt 0", n=6)
+        assert a == b
+    finally:
+        plain.close()
+        spec_eng.close()
+
+
+def test_sampled_requests_fall_back_to_normal_path():
+    _, spec_eng = _engines()
+    spec_eng.start()
+    try:
+        ev = spec_eng.generate(GenRequest(
+            prompt_ids=spec_eng.tokenizer.encode("hi", add_bos=True),
+            max_tokens=8, temperature=0.8, top_k=20, seed=1,
+            ignore_eos=True))
+        assert ev.finish_reason == "length", ev.error
+        assert spec_eng.metrics.spec_dispatches == 0  # sampled: no spec
+    finally:
+        spec_eng.close()
