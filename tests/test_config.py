@@ -117,3 +117,28 @@ def test_app_config_from_env(monkeypatch):
     assert cfg.preload_models == ["m1", "m2"]
     assert cfg.context_size == 2048
     assert cfg.api_keys == ["k1", "k2"]
+
+
+def test_compilation_cache_wiring(tmp_path, monkeypatch):
+    """compilation_cache_dir turns on jax's persistent compile cache."""
+    import jax
+
+    from localai_tfp_tpu.config.app_config import ApplicationConfig
+    from localai_tfp_tpu.server.state import Application
+
+    cache_dir = str(tmp_path / "xla-cache")
+    cfg = ApplicationConfig(
+        models_path=str(tmp_path / "models"),
+        generated_content_dir=str(tmp_path / "gen"),
+        upload_dir=str(tmp_path / "up"),
+        config_dir=str(tmp_path / "conf"),
+        compilation_cache_dir=cache_dir,
+    )
+    app = Application(cfg)
+    old = jax.config.jax_compilation_cache_dir
+    try:
+        app.startup()
+        assert jax.config.jax_compilation_cache_dir == cache_dir
+    finally:
+        app.shutdown()
+        jax.config.update("jax_compilation_cache_dir", old)
