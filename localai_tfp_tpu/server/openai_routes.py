@@ -254,7 +254,9 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                             request.get("correlation_id", ""))
     if grammar:
         opts.grammar = grammar
-    extra_usage = "Extra-Usage" in request.headers
+    extra_usage = ("Extra-Usage" in request.headers
+                   or bool((body.get("stream_options") or {})
+                           .get("include_usage")))
     created = int(time.time())
     cid = _completion_id()
 
@@ -411,7 +413,9 @@ async def completions(request: web.Request) -> web.StreamResponse:
     if not prompts:
         raise web.HTTPBadRequest(reason="prompt required")
 
-    extra_usage = "Extra-Usage" in request.headers
+    extra_usage = ("Extra-Usage" in request.headers
+                   or bool((body.get("stream_options") or {})
+                           .get("include_usage")))
     created = int(time.time())
     cid = _completion_id("cmpl")
 
