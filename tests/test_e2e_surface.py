@@ -1,0 +1,190 @@
+"""One-pass E2E sweep over the whole REST surface against a single live
+in-process server with every worker family mounted (the reference's
+e2e-aio suite shape — tests/e2e-aio/e2e_test.go:19-263 exercises every
+endpoint against the shipped container)."""
+
+import asyncio
+import hashlib
+import io
+import json
+import wave
+
+import numpy as np
+import pytest
+import yaml
+from aiohttp import FormData
+from aiohttp.test_utils import TestClient, TestServer
+
+from localai_tfp_tpu.config.app_config import ApplicationConfig
+from localai_tfp_tpu.server.app import build_app
+from localai_tfp_tpu.server.state import Application
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    root = tmp_path_factory.mktemp("aio")
+    models = root / "models"
+    models.mkdir()
+
+    import torch
+    from transformers import (
+        BertConfig, BertModel, LlamaConfig, LlamaForCausalLM,
+        WhisperConfig, WhisperForConditionalGeneration,
+    )
+
+    torch.manual_seed(0)
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=300, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256,
+    )).save_pretrained(models / "llm-ckpt", safe_serialization=True)
+    BertModel(BertConfig(
+        vocab_size=300, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=128,
+    )).save_pretrained(models / "bert-ckpt", safe_serialization=True)
+    WhisperForConditionalGeneration(WhisperConfig(
+        vocab_size=1000, d_model=64, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=128, decoder_ffn_dim=128,
+        max_source_positions=1500, max_target_positions=448,
+        num_mel_bins=80, decoder_start_token_id=997, eos_token_id=998,
+        pad_token_id=998, bos_token_id=998,
+    )).save_pretrained(models / "whisper-ckpt", safe_serialization=True)
+
+    for name, cfg in {
+        "llm": {"backend": "jax-llm",
+                "parameters": {"model": "llm-ckpt", "max_tokens": 6},
+                "context_size": 128, "max_batch_slots": 2,
+                "dtype": "float32",
+                "template": {"completion": "{{.Input}}",
+                             "chat": "{{.Input}}"}},
+        "emb": {"backend": "jax-embeddings",
+                "parameters": {"model": "bert-ckpt"}},
+        "rr": {"backend": "jax-rerank", "parameters": {"model": "bert-ckpt"}},
+        "stt": {"backend": "jax-whisper",
+                "parameters": {"model": "whisper-ckpt"}},
+        "voice": {"backend": "jax-tts"},
+        "vadm": {"backend": "jax-vad"},
+        "img": {"backend": "jax-diffusion", "options": ["steps=2"]},
+    }.items():
+        (models / f"{name}.yaml").write_text(
+            yaml.safe_dump({"name": name, **cfg}))
+
+    loop = asyncio.new_event_loop()
+    cfg = ApplicationConfig(
+        models_path=str(models),
+        generated_content_dir=str(root / "generated"),
+        upload_dir=str(root / "uploads"),
+        config_dir=str(root / "configuration"),
+    )
+    app = build_app(Application(cfg))
+    tc = TestClient(TestServer(app), loop=loop)
+    loop.run_until_complete(tc.start_server())
+
+    def req(method, path, **kw):
+        async def go():
+            r = await tc.request(method, path, **kw)
+            body = await r.read()
+            return r.status, body
+        return loop.run_until_complete(go())
+
+    yield req
+    loop.run_until_complete(tc.close())
+    loop.close()
+
+
+def _json(body):
+    return json.loads(body)
+
+
+def test_models_and_system(srv):
+    status, body = srv("GET", "/v1/models")
+    assert status == 200
+    names = {m["id"] for m in _json(body)["data"]}
+    assert {"llm", "emb", "rr", "stt", "voice", "vadm", "img"} <= names
+    assert srv("GET", "/system")[0] == 200
+    assert srv("GET", "/metrics")[0] == 200
+    assert srv("GET", "/version")[0] == 200
+
+
+def test_chat_completion_embeddings(srv):
+    status, body = srv("POST", "/v1/chat/completions", json={
+        "model": "llm", "max_tokens": 4,
+        "messages": [{"role": "user", "content": "hi"}]})
+    assert status == 200 and _json(body)["choices"]
+    status, body = srv("POST", "/v1/completions", json={
+        "model": "llm", "prompt": "abc", "max_tokens": 4})
+    assert status == 200
+    status, body = srv("POST", "/v1/embeddings", json={
+        "model": "emb", "input": "hello"})
+    assert status == 200
+    assert len(_json(body)["data"][0]["embedding"]) == 32
+    status, body = srv("POST", "/v1/tokenize", json={
+        "model": "llm", "content": "hello"})
+    assert status == 200
+
+
+def test_rerank(srv):
+    status, body = srv("POST", "/v1/rerank", json={
+        "model": "rr", "query": "q", "documents": ["a", "b"],
+        "top_n": 2})
+    assert status == 200 and len(_json(body)["results"]) == 2
+
+
+def test_audio_roundtrip(srv):
+    sr = 16000
+    t = np.arange(sr // 2) / sr
+    pcm = (0.4 * np.sin(2 * np.pi * 440 * t) * 32767).astype("<i2")
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(sr)
+        w.writeframes(pcm.tobytes())
+    form = FormData()
+    form.add_field("model", "stt")
+    form.add_field("file", buf.getvalue(), filename="a.wav")
+    status, body = srv("POST", "/v1/audio/transcriptions", data=form)
+    assert status == 200 and "text" in _json(body)
+
+    status, body = srv("POST", "/v1/audio/speech", json={
+        "model": "voice", "input": "hello"})
+    assert status == 200 and body[:4] == b"RIFF"
+    status, body = srv("POST", "/v1/text-to-speech/alloy", json={
+        "model_id": "voice", "text": "hey"})
+    assert status == 200 and body[:4] == b"RIFF"
+
+    audio = np.zeros(sr, np.float32)
+    audio[sr // 4: sr // 2] = 0.5 * np.sin(
+        2 * np.pi * 120 * t[: sr // 4])
+    status, body = srv("POST", "/vad", json={
+        "model": "vadm", "audio": audio.tolist()})
+    assert status == 200 and "segments" in _json(body)
+
+
+def test_images(srv):
+    status, body = srv("POST", "/v1/images/generations", json={
+        "model": "img", "prompt": "a tree", "size": "32x32",
+        "response_format": "b64_json"})
+    assert status == 200
+    import base64
+
+    png = base64.b64decode(_json(body)["data"][0]["b64_json"])
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+def test_stores_roundtrip(srv):
+    assert srv("POST", "/stores/set", json={
+        "keys": [[1.0, 0.0], [0.0, 1.0]], "values": ["a", "b"]})[0] == 200
+    status, body = srv("POST", "/stores/find", json={
+        "key": [1.0, 0.1], "topk": 1})
+    assert status == 200 and _json(body)["values"] == ["a"]
+
+
+def test_backend_monitor_and_shutdown(srv):
+    status, body = srv("GET", "/backend/monitor?model=llm")
+    assert status == 200
+    out = _json(body)
+    assert out["backend"] == "jax-llm" and "cpu_percent" in out
+    assert srv("POST", "/backend/shutdown", json={"model": "vadm"})[0] == 200
