@@ -134,7 +134,25 @@ def load_params(
             p["bv"] = stack(lambda i: get(lp.format(i=i) + "self_attn.v_proj.bias"))
     p["wo"] = stack(lambda i: t(lp.format(i=i) + "self_attn.o_proj.weight"))
 
-    if fused_gate:
+    if spec.n_experts:
+        # mixtral: block_sparse_moe.gate [E,D] router + per-expert
+        # w1 (gate) / w3 (up) / w2 (down), stacked [L, E, in, out]
+        E = spec.n_experts
+
+        def experts(i, name):
+            return np.stack([
+                np.ascontiguousarray(get(
+                    lp.format(i=i)
+                    + f"block_sparse_moe.experts.{e}.{name}.weight").T)
+                for e in range(E)
+            ])
+
+        p["router"] = stack(
+            lambda i: t(lp.format(i=i) + "block_sparse_moe.gate.weight"))
+        p["moe_gate"] = stack(lambda i: experts(i, "w1"))
+        p["moe_up"] = stack(lambda i: experts(i, "w3"))
+        p["moe_down"] = stack(lambda i: experts(i, "w2"))
+    elif fused_gate:
         F = spec.d_ff
 
         def split_gate(i, part):
@@ -148,7 +166,8 @@ def load_params(
         if spec.gated_mlp:
             p["w_gate"] = stack(lambda i: t(lp.format(i=i) + "mlp.gate_proj.weight"))
         p["w_up"] = stack(lambda i: t(lp.format(i=i) + "mlp.up_proj.weight"))
-    p["w_down"] = stack(lambda i: t(lp.format(i=i) + "mlp.down_proj.weight"))
+    if not spec.n_experts:
+        p["w_down"] = stack(lambda i: t(lp.format(i=i) + "mlp.down_proj.weight"))
 
     if spec.qk_norm:  # qwen3 per-head q/k norms
         p["q_norm_w"] = stack(

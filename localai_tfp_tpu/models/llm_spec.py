@@ -42,6 +42,9 @@ class LLMSpec:
     # mlp
     gated_mlp: bool = True  # llama-style gate*up; False => single up (phi)
     hidden_act: str = "silu"  # silu | gelu | gelu_tanh
+    # mixture-of-experts (mixtral): 0 = dense MLP
+    n_experts: int = 0
+    experts_per_token: int = 2
 
     # biases
     qkv_bias: bool = False  # qwen2, phi
@@ -67,6 +70,11 @@ class LLMSpec:
     # gemma2/3: every Nth layer is GLOBAL (full attention), the rest use
     # sliding_window; 0 = uniform window on all layers
     sliding_window_pattern: int = 0
+    # explicit per-layer kinds ("sliding_attention"/"full_attention") —
+    # HF layer_types; wins over the pattern when present
+    layer_types: Optional[tuple[str, ...]] = None
+    # gemma3: sliding layers rope on a separate (local) base frequency
+    rope_local_base_freq: float = 0.0
 
     extra: dict = field(default_factory=dict)
 
@@ -127,6 +135,11 @@ def spec_from_hf_config(cfg: dict[str, Any]) -> LLMSpec:
 
     if mt in ("llama", "mistral", ""):
         pass
+    elif mt == "mixtral":
+        kw.update(
+            n_experts=int(cfg.get("num_local_experts") or 8),
+            experts_per_token=int(cfg.get("num_experts_per_tok") or 2),
+        )
     elif mt in ("qwen2", "qwen2_5"):
         kw["qkv_bias"] = True
     elif mt == "qwen3":
@@ -173,12 +186,25 @@ def spec_from_hf_config(cfg: dict[str, Any]) -> LLMSpec:
             sliding_window_pattern=2,
         )
     elif mt in ("gemma3", "gemma3_text"):
-        # gemma3 adds per-layer rope bases (local vs global) — not yet
-        raise NotImplementedError(
-            f"model_type '{mt}' is not supported yet (dual rope bases)"
+        kw.update(
+            norm_weight_plus_one=True,
+            hidden_act="gelu_tanh",
+            embedding_multiplier=float(d_model) ** 0.5,
+            tie_word_embeddings=True,
+            sandwich_norms=True,
+            qk_norm=True,
+            query_pre_attn_scalar=float(
+                cfg.get("query_pre_attn_scalar") or d_head),
+            rope_local_base_freq=float(
+                cfg.get("rope_local_base_freq") or 10000.0),
+            sliding_window_pattern=int(
+                cfg.get("sliding_window_pattern") or 6),
+            norm_eps=float(cfg.get("rms_norm_eps") or 1e-6),
         )
     else:
         raise NotImplementedError(f"unknown model_type '{mt}'")
+    if isinstance(cfg.get("layer_types"), list):
+        kw["layer_types"] = tuple(cfg["layer_types"])
     sc = kw.get("rope_scaling") or {}
     rtype = (sc.get("rope_type") or sc.get("type") or "").lower()
     if rtype not in ("", "default", "linear", "llama3", "yarn"):

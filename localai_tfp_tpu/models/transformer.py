@@ -125,12 +125,19 @@ def init_params(
         "wk": dense(next(keys), (L, D, spec.kv_dim)),
         "wv": dense(next(keys), (L, D, spec.kv_dim)),
         "wo": dense(next(keys), (L, spec.q_dim, D)),
-        "w_up": dense(next(keys), (L, D, F)),
-        "w_down": dense(next(keys), (L, F, D)),
         "ln1_w": jnp.ones((L, D), dtype),
     }
-    if spec.gated_mlp:
-        p["w_gate"] = dense(next(keys), (L, D, F))
+    if spec.n_experts:
+        E = spec.n_experts
+        p["router"] = dense(next(keys), (L, D, E), 0.02)
+        p["moe_gate"] = dense(next(keys), (L, E, D, F))
+        p["moe_up"] = dense(next(keys), (L, E, D, F))
+        p["moe_down"] = dense(next(keys), (L, E, F, D))
+    else:
+        p["w_up"] = dense(next(keys), (L, D, F))
+        p["w_down"] = dense(next(keys), (L, F, D))
+        if spec.gated_mlp:
+            p["w_gate"] = dense(next(keys), (L, D, F))
     if not spec.parallel_residual:
         p["ln2_w"] = jnp.ones((L, D), dtype)
     if spec.qk_norm:
@@ -343,11 +350,12 @@ def _layer_body(spec, x, lp, positions, inv_freq, rope_scale, attn_fn):
     q = q.reshape(B, T, spec.n_heads, spec.d_head)
     k = k.reshape(B, T, spec.n_kv_heads, spec.d_head)
     v = v.reshape(B, T, spec.n_kv_heads, spec.d_head)
-    if "q_norm_w" in lp:  # qwen3: per-head RMSNorm before rope
+    if "q_norm_w" in lp:  # qwen3/gemma3: per-head RMSNorm before rope
         q = _norm(spec, q, lp["q_norm_w"], None)
         k = _norm(spec, k, lp["k_norm_w"], None)
-    q = apply_rope(q, positions, inv_freq, spec.rotary_dim, rope_scale)
-    k = apply_rope(k, positions, inv_freq, spec.rotary_dim, rope_scale)
+    inv_f = lp.get("_inv_freq", inv_freq)  # gemma3: dual rope bases
+    q = apply_rope(q, positions, inv_f, spec.rotary_dim, rope_scale)
+    k = apply_rope(k, positions, inv_f, spec.rotary_dim, rope_scale)
     attn, carry = attn_fn(q, k, v)
     attn = attn @ lp["wo"]
     if "bo" in lp:
@@ -358,32 +366,80 @@ def _layer_body(spec, x, lp, positions, inv_freq, rope_scale, attn_fn):
     if not spec.parallel_residual:
         x = x + attn
         mlp_in = _norm(spec, x, lp["ln2_w"], lp.get("ln2_b"))
-    up = mlp_in @ lp["w_up"]
-    if "b_up" in lp:
-        up = up + lp["b_up"]
-    if spec.gated_mlp:
-        up = _act(spec, mlp_in @ lp["w_gate"]) * up
+    if "router" in lp:  # mixture of experts (mixtral)
+        mlp = _moe_mlp(spec, lp, mlp_in)
     else:
-        up = _act(spec, up)
-    mlp = up @ lp["w_down"]
-    if "b_down" in lp:
-        mlp = mlp + lp["b_down"]
+        up = mlp_in @ lp["w_up"]
+        if "b_up" in lp:
+            up = up + lp["b_up"]
+        if spec.gated_mlp:
+            up = _act(spec, mlp_in @ lp["w_gate"]) * up
+        else:
+            up = _act(spec, up)
+        mlp = up @ lp["w_down"]
+        if "b_down" in lp:
+            mlp = mlp + lp["b_down"]
     if "ln_post_ffw_w" in lp:  # gemma2 sandwich
         mlp = _norm(spec, mlp, lp["ln_post_ffw_w"], None)
     out = (x + attn + mlp) if spec.parallel_residual else (x + mlp)
     return out, carry
 
 
+def _moe_mlp(spec, lp, x):
+    """Top-k mixture of experts (ref: the reference serves Mixtral via its
+    vLLM/llama.cpp backends). Dense formulation: every expert is evaluated
+    and combined with the (renormalized) top-k router weights — exact,
+    compiler-friendly, and correct for any k; a dispatch/capacity kernel
+    is the planned optimization for large E (dense costs E/k extra FLOPs).
+    Router math in f32 (routing is precision-sensitive)."""
+    E, K = spec.n_experts, spec.experts_per_token
+    logits = (x.astype(jnp.float32) @ lp["router"].astype(jnp.float32))
+    vals, idx = lax.top_k(logits, K)  # [B,T,K]
+    w = jax.nn.softmax(vals, axis=-1)  # softmax over the selected k
+    gate = jnp.sum(jax.nn.one_hot(idx, E, dtype=jnp.float32)
+                   * w[..., None], axis=-2)  # [B,T,E]
+    g = jnp.einsum("btd,edf->btef", x, lp["moe_gate"])
+    u = jnp.einsum("btd,edf->btef", x, lp["moe_up"])
+    y = jnp.einsum("btef,efd->bted", _act(spec, g) * u, lp["moe_down"])
+    return jnp.einsum("bted,bte->btd", y,
+                      gate.astype(y.dtype)).astype(x.dtype)
+
+
+def _layer_is_sliding(spec) -> Optional[list[bool]]:
+    """Per-layer sliding flags; HF layer_types wins over the pattern."""
+    if spec.layer_types is not None:
+        return [t == "sliding_attention" for t in spec.layer_types]
+    if spec.sliding_window_pattern and spec.sliding_window:
+        return [(l + 1) % spec.sliding_window_pattern != 0
+                for l in range(spec.n_layers)]
+    return None
+
+
 def _layer_windows(spec):
-    """Per-layer sliding windows for alternating-window models (gemma2):
+    """Per-layer sliding windows for alternating-window models (gemma2/3):
     [L] i32, 0 = full attention for that layer; None when uniform."""
-    if not (spec.sliding_window_pattern and spec.sliding_window):
+    sliding = _layer_is_sliding(spec)
+    if sliding is None or not spec.sliding_window:
         return None
     return jnp.asarray(
-        [0 if (l + 1) % spec.sliding_window_pattern == 0
-         else spec.sliding_window for l in range(spec.n_layers)],
-        jnp.int32,
+        [spec.sliding_window if s else 0 for s in sliding], jnp.int32
     )
+
+
+def _layer_inv_freqs(spec):
+    """Per-layer rotary inverse frequencies for dual-base models (gemma3:
+    sliding layers rope on rope_local_base_freq UNSCALED, global layers on
+    rope_theta with rope_scaling): [L, rd/2] f32; None when uniform."""
+    sliding = _layer_is_sliding(spec)
+    if sliding is None or not spec.rope_local_base_freq:
+        return None
+    rd = spec.rotary_dim
+    local = 1.0 / (
+        spec.rope_local_base_freq
+        ** (jnp.arange(0, rd, 2, dtype=jnp.float32) / rd)
+    )
+    global_ = rope_inv_freq(spec)
+    return jnp.stack([local if s else global_ for s in sliding])
 
 
 def _embed_in(spec, params, tokens):
@@ -438,6 +494,9 @@ def forward_hidden(
     win = _layer_windows(spec)
     if win is not None:
         stacked = {**stacked, "_window": win}
+    freqs = _layer_inv_freqs(spec)
+    if freqs is not None:
+        stacked = {**stacked, "_inv_freq": freqs}
     identity = slot_ids is None  # batch row b IS cache row b (decode path)
     quant = cache.quantized  # int8 rows + per-row scales
 
@@ -616,6 +675,9 @@ def forward_train(
     win = _layer_windows(spec)
     if win is not None:
         stacked = {**stacked, "_window": win}
+    freqs = _layer_inv_freqs(spec)
+    if freqs is not None:
+        stacked = {**stacked, "_inv_freq": freqs}
 
     @jax.checkpoint
     def body(x, lp):

@@ -44,6 +44,13 @@ PARAM_RULES: dict[str, P] = {
     "b_up": P(None, "model"),
     "w_down": P(None, "model", None),  # [L, F, D] row-parallel
     "b_down": P(None, None),
+    # MoE (mixtral): experts sharded over "model" = expert parallelism;
+    # the gate-combine einsum contracts the expert dim, so XLA inserts
+    # the psum over ICI
+    "router": P(None, None, None),
+    "moe_gate": P(None, "model", None, None),  # [L, E, D, F]
+    "moe_up": P(None, "model", None, None),
+    "moe_down": P(None, "model", None, None),
     "ln1_w": P(None, None),
     "ln1_b": P(None, None),
     "ln2_w": P(None, None),

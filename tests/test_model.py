@@ -48,6 +48,21 @@ def _save_tiny(tmp_path, family: str) -> str:
             attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
             sliding_window=8, hidden_activation="gelu_pytorch_tanh",
         ))
+    elif family == "gemma3":
+        from transformers import Gemma3TextConfig, Gemma3ForCausalLM
+
+        cfg = dict(common)
+        cfg["num_hidden_layers"] = 7  # crosses a 5-local+1-global boundary
+        model = Gemma3ForCausalLM(Gemma3TextConfig(
+            **cfg, head_dim=16, query_pre_attn_scalar=16,
+            sliding_window=8, rope_local_base_freq=10000.0,
+            rope_theta=1000000.0,
+        ))
+    elif family == "mixtral":
+        from transformers import MixtralConfig, MixtralForCausalLM
+
+        model = MixtralForCausalLM(MixtralConfig(
+            **common, num_local_experts=4, num_experts_per_tok=2))
     elif family == "phi":
         cfg = dict(common)
         cfg["num_key_value_heads"] = 4  # phi has no GQA by default
@@ -71,7 +86,7 @@ def _hf_logits(model_dir: str, tokens: np.ndarray) -> np.ndarray:
 
 
 @pytest.mark.parametrize("family", ["llama", "qwen2", "qwen3", "gemma2",
-                                    "phi"])
+                                    "gemma3", "mixtral", "phi"])
 def test_logits_match_hf(tmp_path, family):
     from localai_tfp_tpu.models.hf_loader import load_params
     from localai_tfp_tpu.models.transformer import KVCache, forward

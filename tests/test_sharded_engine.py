@@ -74,3 +74,28 @@ def test_sharded_engine_concurrent_slots():
         assert all(o.completion_tokens == 8 for o in outs)
     finally:
         eng.close()
+
+
+def test_moe_expert_parallel_forward():
+    """Mixtral-class MoE with experts sharded over the model axis (EP):
+    sharded forward must equal the single-device forward."""
+    from localai_tfp_tpu.models.transformer import KVCache, forward
+    from localai_tfp_tpu.parallel.sharding import shard_params
+
+    spec = tiny_spec(n_experts=4, experts_per_token=2)
+    params = init_params(jax.random.PRNGKey(5), spec, dtype=jnp.float32)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, spec.vocab_size, (1, 10)),
+        jnp.int32)
+    cache = KVCache.create(spec, 1, 16, jnp.float32)
+    ref, _ = forward(spec, params, tokens, jnp.zeros((1,), jnp.int32),
+                     cache, jnp.zeros((1,), jnp.int32))
+
+    mesh = make_mesh({"data": 1, "seq": 1, "model": 4},
+                     devices=jax.devices("cpu")[:4])
+    sharded = shard_params(params, mesh)
+    cache2 = KVCache.create(spec, 1, 16, jnp.float32)
+    out, _ = forward(spec, sharded, tokens, jnp.zeros((1,), jnp.int32),
+                     cache2, jnp.zeros((1,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
