@@ -82,7 +82,12 @@ def load_params(
         return np.ascontiguousarray(get(name).T)
 
     p: dict[str, Any] = {}
-    prefix = "model." if "model.embed_tokens.weight" in names else ""
+    prefix = ""
+    for cand in ("language_model.model.", "model.language_model.",
+                 "model."):
+        if f"{cand}embed_tokens.weight" in names:
+            prefix = cand
+            break
     p["embed"] = _cast(get(f"{prefix}embed_tokens.weight"), dtype)
 
     def stack(fn: Callable[[int], np.ndarray]) -> jnp.ndarray:

@@ -101,6 +101,11 @@ def spec_from_hf_config(cfg: dict[str, Any]) -> LLMSpec:
     ref: core/config/gguf.go:36-123).
     """
     mt = (cfg.get("model_type") or "").lower()
+    if mt == "gemma3" and isinstance(cfg.get("text_config"), dict):
+        # multimodal gemma3 checkpoints nest the text params; the vision
+        # tower is not served here, only the language model
+        cfg = {**cfg["text_config"], "model_type": "gemma3_text"}
+        mt = "gemma3_text"
     d_model = cfg.get("hidden_size") or cfg.get("n_embd") or 2048
     n_heads = cfg.get("num_attention_heads") or cfg.get("n_head") or 16
     n_kv = cfg.get("num_key_value_heads") or n_heads

@@ -393,7 +393,12 @@ def _moe_mlp(spec, lp, x):
     is the planned optimization for large E (dense costs E/k extra FLOPs).
     Router math in f32 (routing is precision-sensitive)."""
     E, K = spec.n_experts, spec.experts_per_token
-    logits = (x.astype(jnp.float32) @ lp["router"].astype(jnp.float32))
+    logits = jnp.einsum(
+        "btd,de->bte", x.astype(jnp.float32),
+        lp["router"].astype(jnp.float32),
+        precision=lax.Precision.HIGHEST,  # near-tie routing must not be
+        # decided by bf16 truncation (same convention as _attend)
+    )
     vals, idx = lax.top_k(logits, K)  # [B,T,K]
     w = jax.nn.softmax(vals, axis=-1)  # softmax over the selected k
     gate = jnp.sum(jax.nn.one_hot(idx, E, dtype=jnp.float32)
@@ -606,7 +611,7 @@ def forward_hidden(
                            lp.get("_window")), carry
 
         use_kernel = (decode_kernel and identity and x.shape[1] == 1
-                      and not quant and not spec.sliding_window_pattern)
+                      and not quant and win is None)  # uniform windows only
         x, out = _layer_body(
             spec, x, lp, positions, inv_freq, rope_scale,
             kernel_attn if use_kernel else xla_attn,
