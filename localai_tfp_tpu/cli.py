@@ -203,18 +203,29 @@ def main(argv: Optional[list[str]] = None) -> None:
         from .server.app import run as run_server
         from .server.state import Application
 
+        from .parallel import multihost
+
         if distributed.initialize():
-            # multi-host slice: rank 0 serves; the follower dispatch loop
-            # (SURVEY.md §7 hard part #5) is not implemented yet — refuse
-            # loudly rather than deadlock the collectives
+            # multi-host slice: rank 0 serves HTTP and publishes a dispatch
+            # record per device dispatch; every other rank replays them so
+            # all hosts run the identical SPMD program (SURVEY.md §7 hard
+            # part #5; parallel/multihost.py)
             if not distributed.is_coordinator():
-                sys.exit(
-                    "error: multi-host follower serving is not implemented "
-                    "yet; run the server on the coordinator host only")
-        cfg = _app_config(args)
-        state = Application(cfg)
-        _preload(state, cfg.preload_models)
-        run_server(state)
+                multihost.follower_main()
+                return
+            multihost.enable(multihost.JaxBroadcastChannel(), "leader")
+        try:
+            cfg = _app_config(args)
+            state = Application(cfg)
+            _preload(state, cfg.preload_models)
+            run_server(state)
+        finally:
+            ch = multihost.active_channel()
+            if ch is not None:
+                # release every follower from its recv() collective; any
+                # coordinator exit — including a startup failure — must
+                # not strand rank>0 hosts in a dangling broadcast
+                ch.publish("stop", None)
 
     elif args.command == "models":
         _cmd_models(args)

@@ -140,6 +140,22 @@ class EngineMetrics:
     spec_dispatches: int = 0
 
 
+def _pack_masks(masks: Optional[np.ndarray]) -> Optional[dict]:
+    """[B, V] bool → bit-packed record payload (multihost dispatch records
+    must stay small; a dense 256k-vocab mask is 8x the packed size)."""
+    if masks is None:
+        return None
+    return {"bits": np.packbits(masks, axis=1), "v": masks.shape[1]}
+
+
+def _unpack_masks(p: Optional[dict]) -> Optional[jax.Array]:
+    if p is None:
+        return None
+    return jnp.asarray(
+        np.unpackbits(p["bits"], axis=1, count=p["v"]).astype(bool)
+    )
+
+
 def _common_prefix(a: list[int], b: list[int]) -> int:
     n = 0
     for x, y in zip(a, b):
@@ -188,7 +204,20 @@ class LLMEngine:
         # decoding draft model (ref: proto DraftModel/NDraft plumbing)
         n_draft: int = 4,
         autostart: bool = True,
+        channel: Any = None,  # multihost dispatch publisher (leader side);
+        # every device dispatch is published as a (kind, payload) record
+        # before executing so follower hosts replay the identical SPMD
+        # program (parallel/multihost.py, SURVEY.md §7 hard part #5)
+        follower: bool = False,  # replay-only engine: no scheduler thread,
+        # device ops arrive via _dev_exec from the follower loop
+        tag: str = "",  # model tag routing this engine's records when
+        # several models publish on one channel
     ) -> None:
+        self.channel = channel
+        self.follower = follower
+        self.tag = tag
+        if follower:
+            autostart = False
         self.decode_steps = max(1, decode_steps)
         self.mesh = mesh
         self.draft = draft
@@ -426,11 +455,10 @@ class LLMEngine:
                     s.n_past = limit
                     s.cache_tokens = s.cache_tokens[:limit]
                 pos0[s.idx] = s.n_past
-        fn = self._spec_decode_fn(kd, rounds)
-        D, Mt, J, _, _, self.cache, self.draft_cache = fn(
-            self.params, self.draft[1], self.cache, self.draft_cache,
-            jnp.asarray(tokens), jnp.asarray(pos0), jnp.asarray(active),
-        )
+        D, Mt, J = self._run("spec", {
+            "kd": kd, "rounds": rounds, "tokens": tokens, "pos0": pos0,
+            "active": active,
+        })
         D = np.asarray(D)  # [rounds, S, kd-1] draft candidates
         Mt = np.asarray(Mt)  # [rounds, S, kd] main greedy tokens
         J = np.asarray(J)  # [rounds, S] emitted counts
@@ -526,9 +554,110 @@ class LLMEngine:
         self._decode_k_fns[("decode", k, window)] = _decode_k
         return _decode_k
 
+    # ------------------------------------------- multihost dispatch funnel
+
+    def _run(self, kind: str, payload: dict) -> Any:
+        """Publish-then-execute: every device dispatch flows through here
+        so a multihost leader's followers can replay the identical XLA
+        program (parallel/multihost.py). Payloads carry only small host
+        inputs; device state advances in place on every host."""
+        ch = self.channel
+        if ch is not None and not self.follower:
+            # publish + device-enqueue under ONE critical section: the
+            # follower replays records in published order, so the leader's
+            # own XLA dispatch order must match it exactly or the
+            # cross-host collectives inside the programs deadlock
+            with ch.order_lock:
+                ch.publish(kind, {"model": self.tag, "data": payload})
+                return self._dev_exec(kind, payload)
+        return self._dev_exec(kind, payload)
+
+    def _dev_exec(self, kind: str, p: dict) -> Any:
+        """Device-only work for one dispatch record. MUST be fully
+        determined by (kind, payload) + engine construction — no reads of
+        leader-side scheduler state — so follower replay stays lockstep."""
+        if kind == "reset":
+            self.sampling = self.sampling.reset_slot(p["slot"], **p["params"])
+            return None
+        if kind == "prefill":
+            toks = jnp.asarray(p["toks"])
+            pos0 = jnp.asarray(p["pos0"])
+            sids = jnp.asarray(p["slot_ids"])
+            _, self.cache = self._prefill_fn(
+                self.params, toks, self.cache, pos0, sids
+            )
+            if self.draft is not None:
+                self.draft_cache = self._draft_prefill_fn()(
+                    self.draft[1], toks, self.draft_cache, pos0, sids
+                )
+            return None
+        if kind == "prefill_final":
+            toks = jnp.asarray(p["toks"])
+            pos0 = jnp.asarray(p["pos0"])
+            sids = jnp.asarray(p["slot_ids"])
+            masks = _unpack_masks(p["masks"])
+            toks_out, self.cache, self.sampling = self._prefill_final_fn(
+                self.params, toks, self.cache, pos0, self.sampling, sids,
+                jnp.asarray(p["n_chunk"]), jnp.asarray(p["tails"]),
+                jnp.asarray(p["tail_lens"]), masks,
+            )
+            if self.draft is not None:
+                self.draft_cache = self._draft_prefill_fn()(
+                    self.draft[1], toks, self.draft_cache, pos0, sids
+                )
+            return toks_out
+        if kind == "decode1":
+            masks = _unpack_masks(p["masks"])
+            toks, self.cache, self.sampling = self._decode_fn(
+                self.params, jnp.asarray(p["tokens"]), self.cache,
+                jnp.asarray(p["pos0"]), self._all_slot_ids, self.sampling,
+                jnp.asarray(p["active"]), masks,
+            )
+            return toks
+        if kind == "decodek":
+            fn = self._decode_k_fn(p["k"], p["window"])
+            if p["carry"] and self._dev_tokens is not None:
+                tok_dev, pos_dev, act_dev = (
+                    self._dev_tokens, self._dev_pos, self._dev_active
+                )
+            else:
+                tok_dev = jnp.asarray(p["tokens"])
+                pos_dev = jnp.asarray(p["pos0"])
+                act_dev = jnp.asarray(p["active"])
+            batches = []
+            for _ in range(p["depth"]):
+                toks, tok_dev, pos_dev, self.cache, self.sampling = fn(
+                    self.params, tok_dev, self.cache, pos_dev,
+                    self._all_slot_ids, self.sampling, act_dev,
+                )
+                batches.append(toks)
+            self._dev_tokens, self._dev_pos, self._dev_active = (
+                tok_dev, pos_dev, act_dev
+            )
+            return batches
+        if kind == "spec":
+            fn = self._spec_decode_fn(p["kd"], p["rounds"])
+            D, Mt, J, _, _, self.cache, self.draft_cache = fn(
+                self.params, self.draft[1], self.cache, self.draft_cache,
+                jnp.asarray(p["tokens"]), jnp.asarray(p["pos0"]),
+                jnp.asarray(p["active"]),
+            )
+            return D, Mt, J
+        if kind == "embed":
+            cache = KVCache.create(self.spec, 1, p["bucket"],
+                                   self.cache.k.dtype)
+            zeros = jnp.zeros((1,), jnp.int32)
+            hidden, _ = self._hidden_fn(
+                self.params, jnp.asarray(p["toks"]), cache, zeros, zeros
+            )
+            return hidden
+        raise ValueError(f"unknown dispatch record kind: {kind!r}")
+
     # ------------------------------------------------------------------ API
 
     def start(self) -> None:
+        if self.follower:
+            return  # replay-only: the follower loop drives _dev_exec
         if self._thread is None:
             self._thread = threading.Thread(
                 target=self._loop, name="llm-engine", daemon=True
@@ -646,6 +775,10 @@ class LLMEngine:
         prompt cache restore via PromptCachePath)."""
         import os
 
+        if self.channel is not None:
+            # multihost: row restores would need the KV payload broadcast
+            # to every follower; prefix reuse still works, on-disk cache off
+            return
         path = req.prompt_cache_path
         if not path or not os.path.exists(path):
             return
@@ -694,7 +827,8 @@ class LLMEngine:
         import os
 
         req = slot.request
-        if req is None or not req.prompt_cache_path or req.prompt_cache_ro:
+        if req is None or not req.prompt_cache_path or req.prompt_cache_ro \
+                or self.channel is not None:
             return
         n = slot.n_past if req.prompt_cache_all else min(
             slot.n_past, slot.n_prompt)
@@ -761,8 +895,7 @@ class LLMEngine:
             req.constraint.initial_state() if req.constraint else None
         )
         self._epoch += 1
-        self.sampling = self.sampling.reset_slot(
-            slot.idx,
+        self._run("reset", {"slot": slot.idx, "params": dict(
             temperature=req.temperature,
             top_k=req.top_k,
             top_p=req.top_p,
@@ -772,7 +905,7 @@ class LLMEngine:
             presence_penalty=req.presence_penalty,
             repeat_last_n=req.repeat_last_n,
             seed=req.seed,
-        )
+        )})
 
     def _bucket(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -795,19 +928,11 @@ class LLMEngine:
         # [n_past+len(chunk), n_past+bucket) — harmless: they're beyond the
         # valid prefix and get overwritten when real tokens arrive (causal
         # mask keeps them invisible to attention reads at these positions).
-        _, self.cache = self._prefill_fn(
-            self.params,
-            jnp.asarray(toks),
-            self.cache,
-            jnp.asarray([slot.n_past], jnp.int32),
-            jnp.asarray([slot.idx], jnp.int32),
-        )
-        if self.draft is not None:
-            self.draft_cache = self._draft_prefill_fn()(
-                self.draft[1], jnp.asarray(toks), self.draft_cache,
-                jnp.asarray([slot.n_past], jnp.int32),
-                jnp.asarray([slot.idx], jnp.int32),
-            )
+        self._run("prefill", {
+            "toks": toks,
+            "pos0": np.asarray([slot.n_past], np.int32),
+            "slot_ids": np.asarray([slot.idx], np.int32),
+        })
         slot.n_past += len(chunk)
         slot.cache_tokens.extend(chunk)
         slot.t_prefill_ms += (time.perf_counter() - t0) * 1e3
@@ -838,16 +963,11 @@ class LLMEngine:
             tails[r, : len(tail)] = tail
             tail_lens[r] = len(tail)
         masks = self._constraint_mask_rows(group)
-        toks_out, self.cache, self.sampling = self._prefill_final_fn(
-            self.params, jnp.asarray(toks), self.cache, jnp.asarray(pos0),
-            self.sampling, jnp.asarray(slot_ids), jnp.asarray(n_chunk),
-            jnp.asarray(tails), jnp.asarray(tail_lens), masks,
-        )
-        if self.draft is not None:
-            self.draft_cache = self._draft_prefill_fn()(
-                self.draft[1], jnp.asarray(toks), self.draft_cache,
-                jnp.asarray(pos0), jnp.asarray(slot_ids),
-            )
+        toks_out = self._run("prefill_final", {
+            "toks": toks, "pos0": pos0, "slot_ids": slot_ids,
+            "n_chunk": n_chunk, "tails": tails, "tail_lens": tail_lens,
+            "masks": _pack_masks(masks),
+        })
         toks_host = np.asarray(toks_out)
         dt_ms = (time.perf_counter() - t0) * 1e3
         now = time.perf_counter()
@@ -862,7 +982,7 @@ class LLMEngine:
             self._epoch += 1
             self._emit_token(s, int(toks_host[r]))
 
-    def _constraint_mask_rows(self, slots: list[_Slot]) -> Optional[jax.Array]:
+    def _constraint_mask_rows(self, slots: list[_Slot]) -> Optional[np.ndarray]:
         """Build [B, V] bool masks for grammar-constrained slots (host-side
         automaton, mask shipped to device — SURVEY.md §7 hard part #3)."""
         rows = []
@@ -891,7 +1011,7 @@ class LLMEngine:
             rows.append(mask if mask is not None else np.ones(V, bool))
         if not any_mask:
             return None
-        return jnp.asarray(np.stack(rows))
+        return np.stack(rows)
 
     def _multi_step_k(self, decoding: list[_Slot]) -> tuple[int, int]:
         """(k, room): largest safe on-device step count — no grammar/
@@ -970,26 +1090,12 @@ class LLMEngine:
             # first result's download (the tunnel/dispatch RTT — dominant
             # cost; see SKILL.md gotcha). Tokens generated past a stop are
             # discarded like any mid-scan finish.
-            fn = self._decode_k_fn(k, window)
-            if self._dev_epoch == self._epoch:
-                tok_dev, pos_dev, act_dev = (
-                    self._dev_tokens, self._dev_pos, self._dev_active
-                )
-            else:
-                tok_dev = jnp.asarray(tokens)
-                pos_dev = jnp.asarray(pos0)
-                act_dev = jnp.asarray(active)
-            batches = []
             epoch0 = self._epoch
-            for _ in range(depth):
-                toks, tok_dev, pos_dev, self.cache, self.sampling = fn(
-                    self.params, tok_dev, self.cache, pos_dev,
-                    self._all_slot_ids, self.sampling, act_dev,
-                )
-                batches.append(toks)
-            self._dev_tokens, self._dev_pos, self._dev_active = (
-                tok_dev, pos_dev, act_dev
-            )
+            batches = self._run("decodek", {
+                "k": k, "window": window, "depth": depth,
+                "carry": self._dev_epoch == self._epoch,
+                "tokens": tokens, "pos0": pos0, "active": active,
+            })
             emitted = 0
             prev_last = {s.idx: int(tokens[s.idx, 0]) for s in decoding}
             t_prev = t0
@@ -1019,11 +1125,10 @@ class LLMEngine:
             )
         else:
             masks = self._constraint_mask_rows(self.slots)
-            toks, self.cache, self.sampling = self._decode_fn(
-                self.params, jnp.asarray(tokens), self.cache,
-                jnp.asarray(pos0), self._all_slot_ids, self.sampling,
-                jnp.asarray(active), masks,
-            )
+            toks = self._run("decode1", {
+                "tokens": tokens, "pos0": pos0, "active": active,
+                "masks": _pack_masks(masks),
+            })
             toks_host = np.asarray(toks)
             dt_ms = (time.perf_counter() - t0) * 1e3
             emitted = 0
@@ -1133,11 +1238,7 @@ class LLMEngine:
         bucket = self._bucket(len(ids))
         toks = np.zeros((1, bucket), np.int32)
         toks[0, : len(ids)] = ids
-        cache = KVCache.create(self.spec, 1, bucket, self.cache.k.dtype)
-        hidden, _ = self._hidden_fn(
-            self.params, jnp.asarray(toks), cache,
-            jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32),
-        )
+        hidden = self._run("embed", {"toks": toks, "bucket": bucket})
         h = np.asarray(hidden[0, : len(ids)], dtype=np.float32)
         return h.mean(axis=0)
 

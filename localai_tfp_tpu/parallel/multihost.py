@@ -1,0 +1,250 @@
+"""Coordinator→follower dispatch replay for multi-host SPMD serving.
+
+The reference distributes one model across machines by shipping tensor
+ops to llama.cpp RPC workers (SURVEY.md §2.5: worker_p2p.go, ggml RPC —
+one network round trip per op). On TPU the model is sharded with GSPMD
+over a multi-host mesh instead, which imposes the multi-controller rule:
+EVERY host must issue the SAME jitted dispatches in the SAME order, while
+only rank 0 sees HTTP traffic (SURVEY.md §7 hard part #5: "coordinator
+serves, others follow").
+
+This module is the control plane that makes that true. The coordinator's
+engine publishes a compact *dispatch record* — ``(kind, payload)`` where
+the payload is the tiny host-side input (token ids, positions, flags) —
+immediately before every device dispatch; follower hosts replay the
+records through the same ``LLMEngine._dev_exec`` entry point, so each
+host's XLA dispatch sequence is identical and collectives line up. Device
+state (params, KV cache, sampler) never crosses the wire: each host holds
+its own shard and advances it by replaying.
+
+Transports:
+  * ``JaxBroadcastChannel`` — real multi-host path over
+    ``multihost_utils.broadcast_one_to_all`` (rides DCN/ICI). Records are
+    pickled and padded to power-of-two sizes so the broadcast compiles a
+    bounded number of shapes.
+  * ``LocalChannel`` — in-process queue fan-out used by the test suite to
+    prove leader/follower replay equivalence without a second process.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import queue
+import threading
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+Record = Tuple[str, Any]
+
+# ---------------------------------------------------------------- encoding
+
+
+def encode_record(kind: str, payload: Any) -> tuple[np.ndarray, np.ndarray]:
+    """(header [n, padded], padded uint8 buffer) for a record."""
+    raw = pickle.dumps((kind, payload), protocol=pickle.HIGHEST_PROTOCOL)
+    n = len(raw)
+    padded = 1 << max(10, (n - 1).bit_length())
+    buf = np.zeros(padded, np.uint8)
+    buf[:n] = np.frombuffer(raw, np.uint8)
+    return np.array([n, padded], np.int64), buf
+
+
+def decode_record(n: int, buf: np.ndarray) -> Record:
+    return pickle.loads(bytes(bytearray(buf[:n])))
+
+
+# --------------------------------------------------------------- transports
+
+
+class LocalChannel:
+    """In-process fan-out channel: one leader, N follower ends (tests)."""
+
+    is_leader = True
+
+    def __init__(self) -> None:
+        self._ends: list["LocalFollowerEnd"] = []
+        # publishers hold order_lock across publish+device-enqueue so the
+        # follower's replay order equals the leader's XLA dispatch order
+        # (RLock: publish() re-acquires under _run's critical section)
+        self.order_lock = threading.RLock()
+
+    def follower_end(self) -> "LocalFollowerEnd":
+        end = LocalFollowerEnd()
+        self._ends.append(end)
+        return end
+
+    def publish(self, kind: str, payload: Any) -> None:
+        # pickle round trip: followers must see a snapshot, not objects
+        # the leader's scheduler thread keeps mutating
+        with self.order_lock:
+            hdr, buf = encode_record(kind, payload)
+            rec = decode_record(int(hdr[0]), buf)
+            for end in self._ends:
+                end._q.put(rec)
+
+
+class LocalFollowerEnd:
+    def __init__(self) -> None:
+        self._q: "queue.SimpleQueue[Record]" = queue.SimpleQueue()
+
+    def recv(self, timeout: Optional[float] = None) -> Record:
+        return self._q.get(timeout=timeout)
+
+
+class JaxBroadcastChannel:
+    """Multi-host transport over XLA collectives.
+
+    ``publish``/``recv`` are two matched ``broadcast_one_to_all`` calls
+    (fixed-size header, then the padded record). All hosts must make the
+    same sequence of calls — the publish lock keeps the coordinator's
+    threads (engine scheduler, model loader) from interleaving records.
+    """
+
+    def __init__(self) -> None:
+        import jax
+        from jax.experimental import multihost_utils
+
+        self._mh = multihost_utils
+        self.is_leader = jax.process_index() == 0
+        self.order_lock = threading.RLock()
+
+    def publish(self, kind: str, payload: Any) -> None:
+        hdr, buf = encode_record(kind, payload)
+        with self.order_lock:
+            self._mh.broadcast_one_to_all(hdr)
+            self._mh.broadcast_one_to_all(buf)
+
+    def recv(self, timeout: Optional[float] = None) -> Record:
+        hdr = self._mh.broadcast_one_to_all(np.zeros(2, np.int64))
+        n, padded = int(hdr[0]), int(hdr[1])
+        buf = self._mh.broadcast_one_to_all(np.zeros(padded, np.uint8))
+        return decode_record(n, np.asarray(buf))
+
+
+# ------------------------------------------------------------ global wiring
+
+_CHANNEL: Optional[Any] = None
+_ROLE = "solo"  # solo | leader | follower
+
+
+def enable(channel: Any, role: str) -> None:
+    """Install the process-wide channel (called from the CLI once
+    jax.distributed is up; tests install a LocalChannel)."""
+    global _CHANNEL, _ROLE
+    _CHANNEL = channel
+    _ROLE = role
+
+
+def disable() -> None:
+    global _CHANNEL, _ROLE
+    _CHANNEL = None
+    _ROLE = "solo"
+
+
+def active_channel() -> Optional[Any]:
+    return _CHANNEL
+
+
+def role() -> str:
+    return _ROLE
+
+
+# ------------------------------------------------------------ follower loops
+
+
+class Replayer:
+    """Shared engine-record executor for follower loops: runs _dev_exec
+    and drains the device queue every DRAIN records so replay can't race
+    unboundedly ahead of execution."""
+
+    DRAIN = 64
+
+    def __init__(self) -> None:
+        self._n = 0
+
+    def exec(self, engine: Any, kind: str, payload: Any) -> None:
+        engine._dev_exec(kind, payload)
+        self._n += 1
+        if self._n % self.DRAIN == 0:
+            import jax
+
+            jax.block_until_ready(engine.cache.k)
+
+
+def run_follower_engine(engine: Any, end: Any,
+                        timeout: Optional[float] = None) -> None:
+    """Replay engine-scoped records until a ``stop`` record arrives.
+
+    ``engine`` is an ``LLMEngine`` built with ``follower=True`` over the
+    SAME checkpoint/config as the coordinator's; ``end`` is any object
+    with ``recv()``. Model-lifecycle records are ignored — this loop (used
+    by tests and embedders of a single engine) replays exactly one
+    engine's dispatch stream."""
+    rp = Replayer()
+    while True:
+        kind, rec = end.recv(timeout=timeout)
+        if kind == "stop":
+            return
+        if kind in ("load", "unload"):
+            continue
+        rp.exec(engine, kind, rec["data"])
+
+
+def follower_main() -> None:
+    """Whole-process follower loop for `localai-tpu run` on rank>0 hosts.
+
+    Mirrors the coordinator's model lifecycle: a ``load`` record carries
+    the coordinator's ModelLoadOptions, the follower loads the identical
+    checkpoint from its own disk (paths must match across hosts, as with
+    any SPMD launcher) and routes engine records to the matching model
+    until ``unload`` or process ``stop``. Multiple live models replay
+    side by side, keyed by the records' model tag."""
+    channel = JaxBroadcastChannel()
+    enable(channel, "follower")
+    backends: dict[str, Any] = {}
+    rp = Replayer()
+    log.info("follower dispatch loop up; waiting for coordinator records")
+    while True:
+        kind, rec = channel.recv()
+        if kind == "stop":
+            break
+        if kind == "load":
+            from ..workers.llm import JaxLLMBackend
+
+            tag = rec.model
+            old = backends.pop(tag, None)
+            if old is not None:  # leader reloaded the same model
+                old.shutdown()
+            backend = JaxLLMBackend(role="follower")
+            res = backend.load_model(rec)
+            if res.success:
+                backends[tag] = backend
+            else:
+                # refuse LOUDLY: silently dropping this model's dispatch
+                # records would leave the leader's cross-host collectives
+                # waiting forever with no diagnostic. A dead follower
+                # process is visible to the operator and to the leader's
+                # next broadcast.
+                log.critical(
+                    "follower load of %r failed (%s); terminating so the "
+                    "slice fails loudly instead of deadlocking",
+                    tag, res.message)
+                raise SystemExit(1)
+        elif kind == "unload":
+            backend = backends.pop(rec["model"], None)
+            if backend is not None:
+                backend.shutdown()
+        else:
+            backend = backends.get(rec["model"])
+            if backend is not None and backend.engine is not None:
+                rp.exec(backend.engine, kind, rec["data"])
+            else:
+                log.warning("follower dropped %r for unknown model %r",
+                            kind, rec.get("model"))
+    for backend in backends.values():
+        backend.shutdown()
+    log.info("follower dispatch loop stopped")

@@ -51,18 +51,32 @@ _KV_DTYPES = {**_DTYPES, "int8": jnp.int8, "i8": jnp.int8,
 class JaxLLMBackend(Backend):
     """Serves chat/completion/embeddings/tokenize for HF checkpoints."""
 
-    def __init__(self) -> None:
+    def __init__(self, role: Optional[str] = None) -> None:
         self.engine: Optional[LLMEngine] = None
         self.tokenizer: Optional[Tokenizer] = None
         self.spec: Optional[LLMSpec] = None
         self._state = "UNINITIALIZED"
         self._grammar_cache: dict[str, object] = {}
         self._lock = threading.Lock()
+        # multihost role override ("leader"/"follower"/"solo"); None reads
+        # the process-wide multihost.role()
+        self._role = role
 
     # ------------------------------------------------------------- lifecycle
 
     def load_model(self, opts: ModelLoadOptions) -> Result:
+        from ..parallel import multihost
+
+        channel = multihost.active_channel()
+        role = self._role or multihost.role()
         with self._lock:
+            if channel is not None and role == "leader":
+                # followers load the identical checkpoint from their own
+                # disk (in parallel with ours) and then replay this
+                # engine's dispatch records. Published under _lock so
+                # concurrent reloads keep one total load order; a failure
+                # below publishes a compensating unload.
+                channel.publish("load", opts)
             try:
                 self._state = "BUSY"
                 model_dir = opts.model
@@ -116,18 +130,34 @@ class JaxLLMBackend(Backend):
                     mesh=mesh,
                     draft=draft,
                     n_draft=opts.n_draft or 4,
+                    channel=channel if role == "leader" else None,
+                    follower=role == "follower",
+                    tag=opts.model,
                 )
                 self.engine.start()
                 self._state = "READY"
                 return Result(True, "model loaded")
             except Exception as e:
                 self._state = "ERROR"
+                if channel is not None and role == "leader":
+                    # release the followers' (possibly successful) copy;
+                    # leader and followers must agree the model is absent
+                    channel.publish("unload", {"model": opts.model})
                 return Result(False, f"load failed: {e}")
 
     def shutdown(self) -> None:
+        from ..parallel import multihost
+
+        tag = self.engine.tag if self.engine is not None else ""
         if self.engine is not None:
+            # close BEFORE broadcasting unload: the scheduler thread must
+            # drain so no dispatch record trails the followers' teardown
             self.engine.close()
             self.engine = None
+        channel = multihost.active_channel()
+        if channel is not None and tag and \
+                (self._role or multihost.role()) == "leader":
+            channel.publish("unload", {"model": tag})
         self._state = "UNINITIALIZED"
 
     def health(self) -> bool:
