@@ -148,12 +148,16 @@ def _pack_masks(masks: Optional[np.ndarray]) -> Optional[dict]:
     return {"bits": np.packbits(masks, axis=1), "v": masks.shape[1]}
 
 
-def _unpack_masks(p: Optional[dict]) -> Optional[jax.Array]:
+def _unpack_masks(p) -> Optional[jax.Array]:
+    """Accepts None, a raw [B, V] bool array (solo mode, no wire), or a
+    bit-packed record from _pack_masks (multihost replay)."""
     if p is None:
         return None
-    return jnp.asarray(
-        np.unpackbits(p["bits"], axis=1, count=p["v"]).astype(bool)
-    )
+    if isinstance(p, dict):
+        return jnp.asarray(
+            np.unpackbits(p["bits"], axis=1, count=p["v"]).astype(bool)
+        )
+    return jnp.asarray(p)
 
 
 def _common_prefix(a: list[int], b: list[int]) -> int:
@@ -563,12 +567,17 @@ class LLMEngine:
         inputs; device state advances in place on every host."""
         ch = self.channel
         if ch is not None and not self.follower:
+            # dense masks are bit-packed for the wire only; the local exec
+            # keeps the raw ndarray (solo mode never pays the pack cost)
+            wire = payload
+            if isinstance(payload.get("masks"), np.ndarray):
+                wire = {**payload, "masks": _pack_masks(payload["masks"])}
             # publish + device-enqueue under ONE critical section: the
             # follower replays records in published order, so the leader's
             # own XLA dispatch order must match it exactly or the
             # cross-host collectives inside the programs deadlock
             with ch.order_lock:
-                ch.publish(kind, {"model": self.tag, "data": payload})
+                ch.publish(kind, {"model": self.tag, "data": wire})
                 return self._dev_exec(kind, payload)
         return self._dev_exec(kind, payload)
 
@@ -966,7 +975,7 @@ class LLMEngine:
         toks_out = self._run("prefill_final", {
             "toks": toks, "pos0": pos0, "slot_ids": slot_ids,
             "n_chunk": n_chunk, "tails": tails, "tail_lens": tail_lens,
-            "masks": _pack_masks(masks),
+            "masks": masks,
         })
         toks_host = np.asarray(toks_out)
         dt_ms = (time.perf_counter() - t0) * 1e3
@@ -1127,7 +1136,7 @@ class LLMEngine:
             masks = self._constraint_mask_rows(self.slots)
             toks = self._run("decode1", {
                 "tokens": tokens, "pos0": pos0, "active": active,
-                "masks": _pack_masks(masks),
+                "masks": masks,
             })
             toks_host = np.asarray(toks)
             dt_ms = (time.perf_counter() - t0) * 1e3

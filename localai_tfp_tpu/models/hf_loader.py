@@ -139,7 +139,50 @@ def load_params(
             p["bv"] = stack(lambda i: get(lp.format(i=i) + "self_attn.v_proj.bias"))
     p["wo"] = stack(lambda i: t(lp.format(i=i) + "self_attn.o_proj.weight"))
 
-    if spec.n_experts:
+    if spec.n_experts and mt == "qwen2_moe":
+        # qwen2_moe: mlp.gate [E,D] router + mlp.experts.{e}.gate/up/down
+        # + always-on mlp.shared_expert (scaled by mlp.shared_expert_gate
+        # [1,D]); mlp_only/off-step layers carry a plain dense MLP, which
+        # lands in the shared slots with zeroed expert/router weights (the
+        # _dense_only flag in transformer.py forces their gate to 1)
+        E, D = spec.n_experts, spec.d_model
+        Fm = spec.moe_d_ff or spec.d_ff
+        Fs = spec.moe_shared_d_ff or spec.d_ff
+        dense_set = set(spec.moe_dense_layers)
+        if dense_set and Fs != spec.d_ff:
+            raise NotImplementedError(
+                "qwen2_moe with dense layers requires "
+                "shared_expert_intermediate_size == intermediate_size"
+            )
+
+        def experts(i, name):
+            if i in dense_set:
+                shape = (E, Fm, D) if name == "down_proj" else (E, D, Fm)
+                return np.zeros(shape, np.float32)
+            return np.stack([
+                np.ascontiguousarray(get(
+                    lp.format(i=i)
+                    + f"mlp.experts.{e}.{name}.weight").T)
+                for e in range(E)
+            ])
+
+        def shared(i, name):
+            base = "mlp." if i in dense_set else "mlp.shared_expert."
+            return t(lp.format(i=i) + base + f"{name}.weight")
+
+        p["router"] = stack(
+            lambda i: np.zeros((D, E), np.float32) if i in dense_set
+            else t(lp.format(i=i) + "mlp.gate.weight"))
+        p["moe_gate"] = stack(lambda i: experts(i, "gate_proj"))
+        p["moe_up"] = stack(lambda i: experts(i, "up_proj"))
+        p["moe_down"] = stack(lambda i: experts(i, "down_proj"))
+        p["shared_gate"] = stack(lambda i: shared(i, "gate_proj"))
+        p["shared_up"] = stack(lambda i: shared(i, "up_proj"))
+        p["shared_down"] = stack(lambda i: shared(i, "down_proj"))
+        p["shared_router"] = stack(
+            lambda i: np.zeros((D,), np.float32) if i in dense_set
+            else get(lp.format(i=i) + "mlp.shared_expert_gate.weight")[0])
+    elif spec.n_experts:
         # mixtral: block_sparse_moe.gate [E,D] router + per-expert
         # w1 (gate) / w3 (up) / w2 (down), stacked [L, E, in, out]
         E = spec.n_experts

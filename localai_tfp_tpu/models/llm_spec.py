@@ -42,9 +42,21 @@ class LLMSpec:
     # mlp
     gated_mlp: bool = True  # llama-style gate*up; False => single up (phi)
     hidden_act: str = "silu"  # silu | gelu | gelu_tanh
-    # mixture-of-experts (mixtral): 0 = dense MLP
+    # mixture-of-experts (mixtral, qwen2_moe): 0 = dense MLP
     n_experts: int = 0
     experts_per_token: int = 2
+    moe_d_ff: int = 0  # expert intermediate size; 0 = d_ff (mixtral)
+    # qwen2_moe: always-on shared expert, scaled by sigmoid(router·x)
+    moe_shared_expert: bool = False
+    moe_shared_d_ff: int = 0  # shared expert intermediate size; 0 = d_ff
+    # True (mixtral): renormalize the top-k router weights to sum to 1.
+    # False (qwen2_moe norm_topk_prob=false): keep raw softmax-over-all-E
+    # probabilities for the selected experts.
+    moe_norm_topk: bool = True
+    # qwen2_moe decoder_sparse_step / mlp_only_layers: these layer indices
+    # use a plain dense MLP (stored in the shared-expert slots, gate
+    # forced to 1, expert weights zeroed) instead of the sparse mixture
+    moe_dense_layers: tuple[int, ...] = ()
 
     # biases
     qkv_bias: bool = False  # qwen2, phi
@@ -150,9 +162,26 @@ def spec_from_hf_config(cfg: dict[str, Any]) -> LLMSpec:
     elif mt == "qwen3":
         kw["qk_norm"] = True  # per-head RMSNorm on q/k before rope
     elif mt == "qwen2_moe":
-        # expert MLPs unimplemented — refuse rather than emit wrong logits
-        raise NotImplementedError(
-            f"model_type '{mt}' is not supported yet (expert MLPs)"
+        # qwen1.5/qwen2 MoE (HF Qwen2MoeForCausalLM): top-k sparse experts
+        # + an always-on shared expert gated by sigmoid(x·g); layers listed
+        # in mlp_only_layers (or off the decoder_sparse_step grid) fall
+        # back to a plain dense MLP
+        step = int(cfg.get("decoder_sparse_step") or 1)
+        mlp_only = {int(x) for x in (cfg.get("mlp_only_layers") or [])}
+        dense_layers = tuple(sorted(
+            layer for layer in range(n_layers)
+            if layer in mlp_only or (step > 0 and (layer + 1) % step != 0)
+        ))
+        kw.update(
+            qkv_bias=True,
+            n_experts=int(cfg.get("num_experts") or 60),
+            experts_per_token=int(cfg.get("num_experts_per_tok") or 4),
+            moe_d_ff=int(cfg.get("moe_intermediate_size") or d_ff),
+            moe_shared_expert=True,
+            moe_shared_d_ff=int(
+                cfg.get("shared_expert_intermediate_size") or d_ff),
+            moe_norm_topk=bool(cfg.get("norm_topk_prob", False)),
+            moe_dense_layers=dense_layers,
         )
     elif mt == "phi":
         kw.update(

@@ -129,10 +129,17 @@ def init_params(
     }
     if spec.n_experts:
         E = spec.n_experts
+        Fm = spec.moe_d_ff or F
         p["router"] = dense(next(keys), (L, D, E), 0.02)
-        p["moe_gate"] = dense(next(keys), (L, E, D, F))
-        p["moe_up"] = dense(next(keys), (L, E, D, F))
-        p["moe_down"] = dense(next(keys), (L, E, F, D))
+        p["moe_gate"] = dense(next(keys), (L, E, D, Fm))
+        p["moe_up"] = dense(next(keys), (L, E, D, Fm))
+        p["moe_down"] = dense(next(keys), (L, E, Fm, D))
+        if spec.moe_shared_expert:
+            Fs = spec.moe_shared_d_ff or F
+            p["shared_gate"] = dense(next(keys), (L, D, Fs))
+            p["shared_up"] = dense(next(keys), (L, D, Fs))
+            p["shared_down"] = dense(next(keys), (L, Fs, D))
+            p["shared_router"] = dense(next(keys), (L, D), 0.02)
     else:
         p["w_up"] = dense(next(keys), (L, D, F))
         p["w_down"] = dense(next(keys), (L, F, D))
@@ -386,12 +393,17 @@ def _layer_body(spec, x, lp, positions, inv_freq, rope_scale, attn_fn):
 
 
 def _moe_mlp(spec, lp, x):
-    """Top-k mixture of experts (ref: the reference serves Mixtral via its
-    vLLM/llama.cpp backends). Dense formulation: every expert is evaluated
-    and combined with the (renormalized) top-k router weights — exact,
+    """Top-k mixture of experts (ref: the reference serves Mixtral/Qwen-MoE
+    via its vLLM/llama.cpp backends). Dense formulation: every expert is
+    evaluated and combined with the top-k router weights — exact,
     compiler-friendly, and correct for any k; a dispatch/capacity kernel
     is the planned optimization for large E (dense costs E/k extra FLOPs).
-    Router math in f32 (routing is precision-sensitive)."""
+    Router math in f32 (routing is precision-sensitive).
+
+    qwen2_moe extras: a shared expert scaled by sigmoid(x·g) added to the
+    mixture, un-renormalized top-k weights (norm_topk_prob=false), and
+    dense-only layers (``_dense_only`` flag) where the shared slot holds a
+    plain MLP whose gate is forced to 1 and the expert term is dropped."""
     E, K = spec.n_experts, spec.experts_per_token
     logits = jnp.einsum(
         "btd,de->bte", x.astype(jnp.float32),
@@ -399,15 +411,43 @@ def _moe_mlp(spec, lp, x):
         precision=lax.Precision.HIGHEST,  # near-tie routing must not be
         # decided by bf16 truncation (same convention as _attend)
     )
-    vals, idx = lax.top_k(logits, K)  # [B,T,K]
-    w = jax.nn.softmax(vals, axis=-1)  # softmax over the selected k
+    if spec.moe_norm_topk:
+        vals, idx = lax.top_k(logits, K)  # [B,T,K]
+        w = jax.nn.softmax(vals, axis=-1)  # renormalize over the selected k
+    else:
+        probs = jax.nn.softmax(logits, axis=-1)
+        w, idx = lax.top_k(probs, K)  # raw probabilities, sum < 1
     gate = jnp.sum(jax.nn.one_hot(idx, E, dtype=jnp.float32)
                    * w[..., None], axis=-2)  # [B,T,E]
     g = jnp.einsum("btd,edf->btef", x, lp["moe_gate"])
     u = jnp.einsum("btd,edf->btef", x, lp["moe_up"])
     y = jnp.einsum("btef,efd->bted", _act(spec, g) * u, lp["moe_down"])
-    return jnp.einsum("bted,bte->btd", y,
-                      gate.astype(y.dtype)).astype(x.dtype)
+    out = jnp.einsum("bted,bte->btd", y, gate.astype(y.dtype))
+    if "shared_gate" in lp:
+        s = (_act(spec, x @ lp["shared_gate"]) * (x @ lp["shared_up"])) \
+            @ lp["shared_down"]
+        sg = jax.nn.sigmoid(jnp.einsum(
+            "btd,d->bt", x.astype(jnp.float32),
+            lp["shared_router"].astype(jnp.float32),
+        ))[..., None]  # [B,T,1]
+        dense_only = lp.get("_dense_only")  # per-layer scalar via the scan
+        if dense_only is not None:
+            sg = jnp.where(dense_only > 0, 1.0, sg)
+            out = out * (1.0 - dense_only)
+        out = out + s.astype(jnp.float32) * sg
+    return out.astype(x.dtype)
+
+
+def _layer_dense_only(spec) -> Optional[jnp.ndarray]:
+    """[L] f32 flags marking qwen2_moe dense-MLP layers; None when every
+    layer is sparse (mixtral) or the model has no experts."""
+    if not spec.n_experts or not spec.moe_dense_layers:
+        return None
+    dense = set(spec.moe_dense_layers)
+    return jnp.asarray(
+        [1.0 if layer in dense else 0.0 for layer in range(spec.n_layers)],
+        jnp.float32,
+    )
 
 
 def _layer_is_sliding(spec) -> Optional[list[bool]]:
@@ -502,6 +542,9 @@ def forward_hidden(
     freqs = _layer_inv_freqs(spec)
     if freqs is not None:
         stacked = {**stacked, "_inv_freq": freqs}
+    dense_only = _layer_dense_only(spec)
+    if dense_only is not None:
+        stacked = {**stacked, "_dense_only": dense_only}
     identity = slot_ids is None  # batch row b IS cache row b (decode path)
     quant = cache.quantized  # int8 rows + per-row scales
 
@@ -683,6 +726,9 @@ def forward_train(
     freqs = _layer_inv_freqs(spec)
     if freqs is not None:
         stacked = {**stacked, "_inv_freq": freqs}
+    dense_only = _layer_dense_only(spec)
+    if dense_only is not None:
+        stacked = {**stacked, "_dense_only": dense_only}
 
     @jax.checkpoint
     def body(x, lp):

@@ -24,6 +24,14 @@ Transports:
     bounded number of shapes.
   * ``LocalChannel`` — in-process queue fan-out used by the test suite to
     prove leader/follower replay equivalence without a second process.
+
+Known limitation: lifecycle and engine records share ONE lockstep stream,
+and publish is a blocking collective. While a follower is inside a slow
+``load`` (minutes for a big checkpoint), the leader's next publish for an
+ALREADY-SERVING model waits until the follower returns to recv() — i.e.
+loading a second model pauses in-flight generation on the slice for the
+load duration. Per-model record streams (one broadcast channel per tag)
+are the planned fix if mixed-model multi-host serving becomes hot.
 """
 
 from __future__ import annotations
@@ -118,7 +126,10 @@ class JaxBroadcastChannel:
             self._mh.broadcast_one_to_all(hdr)
             self._mh.broadcast_one_to_all(buf)
 
-    def recv(self, timeout: Optional[float] = None) -> Record:
+    def recv(self) -> Record:
+        # no timeout parameter by design: a collective cannot time out
+        # partially — callers must not assume a bounded wait on this
+        # transport (LocalFollowerEnd.recv does honor one, tests only)
         hdr = self._mh.broadcast_one_to_all(np.zeros(2, np.int64))
         n, padded = int(hdr[0]), int(hdr[1])
         buf = self._mh.broadcast_one_to_all(np.zeros(padded, np.uint8))
@@ -206,6 +217,7 @@ def follower_main() -> None:
     channel = JaxBroadcastChannel()
     enable(channel, "follower")
     backends: dict[str, Any] = {}
+    failed: set[str] = set()
     rp = Replayer()
     log.info("follower dispatch loop up; waiting for coordinator records")
     while True:
@@ -222,19 +234,19 @@ def follower_main() -> None:
             backend = JaxLLMBackend(role="follower")
             res = backend.load_model(rec)
             if res.success:
+                failed.discard(tag)
                 backends[tag] = backend
             else:
-                # refuse LOUDLY: silently dropping this model's dispatch
-                # records would leave the leader's cross-host collectives
-                # waiting forever with no diagnostic. A dead follower
-                # process is visible to the operator and to the leader's
-                # next broadcast.
-                log.critical(
-                    "follower load of %r failed (%s); terminating so the "
-                    "slice fails loudly instead of deadlocking",
-                    tag, res.message)
-                raise SystemExit(1)
+                # symmetric failures (bad checkpoint on every host) are
+                # recoverable: the leader's own load fails too and it
+                # publishes a compensating unload. Only an ASYMMETRIC
+                # failure — engine records arriving for a model this host
+                # could not load — is fatal (below).
+                log.error("follower load of %r failed: %s", tag,
+                          res.message)
+                failed.add(tag)
         elif kind == "unload":
+            failed.discard(rec["model"])
             backend = backends.pop(rec["model"], None)
             if backend is not None:
                 backend.shutdown()
@@ -242,6 +254,17 @@ def follower_main() -> None:
             backend = backends.get(rec["model"])
             if backend is not None and backend.engine is not None:
                 rp.exec(backend.engine, kind, rec["data"])
+            elif rec.get("model") in failed:
+                # the leader IS serving this model but this host has no
+                # engine for it: the SPMD programs have already diverged.
+                # Die loudly — a dead follower is visible to the operator;
+                # silently dropping records would hang the leader's
+                # collectives with no diagnostic.
+                log.critical(
+                    "follower received %r for model %r it failed to load; "
+                    "terminating so the divergence fails loudly", kind,
+                    rec.get("model"))
+                raise SystemExit(1)
             else:
                 log.warning("follower dropped %r for unknown model %r",
                             kind, rec.get("model"))

@@ -51,6 +51,12 @@ PARAM_RULES: dict[str, P] = {
     "moe_gate": P(None, "model", None, None),  # [L, E, D, F]
     "moe_up": P(None, "model", None, None),
     "moe_down": P(None, "model", None, None),
+    # qwen2_moe shared expert: Megatron column/row pairing like the dense
+    # MLP; the scalar-gate vector stays replicated
+    "shared_gate": P(None, None, "model"),
+    "shared_up": P(None, None, "model"),
+    "shared_down": P(None, "model", None),
+    "shared_router": P(None, None),
     "ln1_w": P(None, None),
     "ln1_b": P(None, None),
     "ln2_w": P(None, None),

@@ -70,6 +70,17 @@ class JaxLLMBackend(Backend):
         channel = multihost.active_channel()
         role = self._role or multihost.role()
         with self._lock:
+            model_dir = opts.model
+            if not os.path.isabs(model_dir):
+                model_dir = os.path.join(opts.model_path or "", model_dir)
+            if not os.path.isdir(model_dir):
+                # validate BEFORE broadcasting: a typo'd model name must
+                # stay leader-local, not fan a doomed load out to the slice
+                self._state = "ERROR"
+                return Result(
+                    False,
+                    f"load failed: model directory not found: {model_dir}",
+                )
             if channel is not None and role == "leader":
                 # followers load the identical checkpoint from their own
                 # disk (in parallel with ours) and then replay this
@@ -79,13 +90,6 @@ class JaxLLMBackend(Backend):
                 channel.publish("load", opts)
             try:
                 self._state = "BUSY"
-                model_dir = opts.model
-                if not os.path.isabs(model_dir):
-                    model_dir = os.path.join(opts.model_path or "", model_dir)
-                if not os.path.isdir(model_dir):
-                    raise FileNotFoundError(
-                        f"model directory not found: {model_dir}"
-                    )
                 dtype = _DTYPES.get((opts.dtype or "bfloat16").lower(),
                                     jnp.bfloat16)
                 self.spec, params = load_params(model_dir, dtype=dtype)

@@ -63,6 +63,16 @@ def _save_tiny(tmp_path, family: str) -> str:
 
         model = MixtralForCausalLM(MixtralConfig(
             **common, num_local_experts=4, num_experts_per_tok=2))
+    elif family == "qwen2_moe":
+        from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
+
+        # mlp_only_layers=[1]: layer 0 sparse + shared expert, layer 1
+        # plain dense MLP — exercises the per-layer sparse/dense mix
+        model = Qwen2MoeForCausalLM(Qwen2MoeConfig(
+            **common, num_experts=4, num_experts_per_tok=2,
+            moe_intermediate_size=96, shared_expert_intermediate_size=128,
+            decoder_sparse_step=1, mlp_only_layers=[1],
+        ))
     elif family == "phi":
         cfg = dict(common)
         cfg["num_key_value_heads"] = 4  # phi has no GQA by default
@@ -86,7 +96,8 @@ def _hf_logits(model_dir: str, tokens: np.ndarray) -> np.ndarray:
 
 
 @pytest.mark.parametrize("family", ["llama", "qwen2", "qwen3", "gemma2",
-                                    "gemma3", "mixtral", "phi"])
+                                    "gemma3", "mixtral", "qwen2_moe",
+                                    "phi"])
 def test_logits_match_hf(tmp_path, family):
     from localai_tfp_tpu.models.hf_loader import load_params
     from localai_tfp_tpu.models.transformer import KVCache, forward
