@@ -404,6 +404,120 @@ class LLMEngine:
         self._decode_k_fns[key] = _spec
         return _spec
 
+    def _spec_sampled_fn(self, kd: int, rounds: int):
+        """Jitted speculative REJECTION sampling (Leviathan et al.): the
+        draft SAMPLES kd-1 tokens from its filtered distribution q, one
+        main forward computes the filtered p at every position, and each
+        draft token is accepted with prob min(1, p(t)/q(t)); the first
+        rejection resamples from norm(max(p-q, 0)), and a fully-accepted
+        run samples its last token from p directly. This reproduces exact
+        samples from the main model's distribution — the sampled-path
+        counterpart of the greedy _spec_decode_fn (ref: the proto's
+        DraftModel/NDraft surface; greenfield on TPU). Temp<=0 slots
+        collapse to exact one-hot distributions, so mixed greedy/sampled
+        batches stay correct. RNG rides SamplingState.rng per slot with a
+        static number of draws per round."""
+        key = ("spec_s", kd, rounds)
+        fn = self._decode_k_fns.get(key)
+        if fn is not None:
+            return fn
+        from ..ops.sampling import NEG_INF, filtered_candidates
+
+        spec = self.spec
+        dspec = self.draft[0]
+        S = self.n_slots
+
+        def split_rows(rng):  # [S, 2] -> (carry keys, use keys)
+            s = jax.vmap(jax.random.split)(rng)
+            return s[:, 0], s[:, 1]
+
+        def gumbel_pick(keys, probs):  # [R,2], [R,C] -> [R] candidate idx
+            logp = jnp.where(probs > 0,
+                             jnp.log(jnp.maximum(probs, 1e-30)), NEG_INF)
+            g = jax.vmap(
+                lambda k, row: jax.random.gumbel(k, row.shape, jnp.float32)
+            )(keys, logp)
+            return jnp.argmax(logp + g, axis=-1)
+
+        @partial(jax.jit, donate_argnums=(3, 4))
+        def _spec_s(params, dparams, sampling, cache, dcache, tokens, pos0,
+                    active):
+            all_slots = jnp.arange(S, dtype=jnp.int32)
+            rep_slots = jnp.repeat(all_slots, kd)
+
+            def round_(carry, _):
+                tok, pos, cache, dcache, rng = carry
+
+                def dstep(c, _):
+                    t, p, dc, rng = c
+                    lg, dc = forward(dspec, dparams, t, p, dc, None)
+                    qp, qidx = filtered_candidates(
+                        sampling, all_slots, lg[:, -1])
+                    rng, k1 = split_rows(rng)
+                    j = gumbel_pick(k1, qp)
+                    nt = jnp.take_along_axis(
+                        qidx, j[:, None], 1)[:, 0].astype(jnp.int32)
+                    qsel = jnp.take_along_axis(qp, j[:, None], 1)[:, 0]
+                    p2 = jnp.where(active, p + 1, p)
+                    return (nt[:, None], p2, dc, rng), (nt, qsel, qp, qidx)
+
+                # kd steps like the greedy path: the last step's K/V write
+                # keeps the draft cache covering the full accepted prefix
+                (_, _, dcache2, rng), (dts, qsel, qps, qidxs) = lax.scan(
+                    dstep, (tok, pos, dcache, rng), None, length=kd)
+                d_toks = dts[: kd - 1].T  # [S, kd-1]
+                xin = jnp.concatenate([tok, d_toks], axis=1)  # [S, kd]
+                lg, cache2 = forward(spec, params, xin, pos, cache, None)
+                pp, pidx = filtered_candidates(
+                    sampling, rep_slots, lg.reshape(S * kd, -1))
+                C = pp.shape[-1]
+                pp = pp.reshape(S, kd, C)
+                pidx = pidx.reshape(S, kd, C)
+                qps_t = qps.transpose(1, 0, 2)  # [S, kd, C]
+                qidxs_t = qidxs.transpose(1, 0, 2)
+                d_all = dts.T  # [S, kd]
+                # p_i(d_i): main filtered prob of each draft token
+                p_at_d = jnp.sum(
+                    pp * (pidx == d_all[:, :, None]), axis=-1)  # [S, kd]
+                rng, ku = split_rows(rng)
+                u = jax.vmap(
+                    lambda k: jax.random.uniform(k, (kd - 1,))
+                )(ku)  # [S, kd-1]
+                ratio = p_at_d[:, : kd - 1] / jnp.maximum(
+                    qsel.T[:, : kd - 1], 1e-30)
+                ok = (u < jnp.minimum(ratio, 1.0)).astype(jnp.int32)
+                j = 1 + jnp.cumprod(ok, axis=1).sum(1)  # [S] in 1..kd
+                j = jnp.where(active, j, 0)
+                # replacement token per position: residual norm(max(p-q,0))
+                # at a rejection, p itself at the bonus position kd-1
+                match = (qidxs_t[:, :, :, None] == pidx[:, :, None, :])
+                q_on_p = jnp.sum(qps_t[:, :, :, None] * match, 2)  # [S,kd,C]
+                residual = jnp.maximum(pp - q_on_p, 0.0)
+                rsum = residual.sum(-1, keepdims=True)
+                res_dist = jnp.where(rsum > 1e-9, residual / rsum, pp)
+                is_bonus = (jnp.arange(kd) == kd - 1)[None, :, None]
+                dist = jnp.where(is_bonus, pp, res_dist)
+                rng, kr = split_rows(rng)
+                kr_all = jax.vmap(
+                    lambda k: jax.random.split(k, kd))(kr)  # [S, kd, 2]
+                fj = gumbel_pick(
+                    kr_all.reshape(S * kd, 2), dist.reshape(S * kd, C))
+                fin = jnp.take_along_axis(
+                    pidx.reshape(S * kd, C), fj[:, None], 1
+                )[:, 0].astype(jnp.int32).reshape(S, kd)
+                last = jnp.take_along_axis(
+                    fin, (jnp.maximum(j, 1) - 1)[:, None], axis=1)
+                pos2 = jnp.where(active, pos + j, pos)
+                return (last, pos2, cache2, dcache2, rng), (d_toks, fin, j)
+
+            (_, _, cache, dcache, rng), (D, Fin, J) = lax.scan(
+                round_, (tokens, pos0, cache, dcache, sampling.rng),
+                None, length=rounds)
+            return D, Fin, J, rng, cache, dcache
+
+        self._decode_k_fns[key] = _spec_s
+        return _spec_s
+
     def _draft_prefill_fn(self):
         """Draft-model prefill (the draft cache must mirror the main
         cache's token positions for speculative decoding)."""
@@ -421,21 +535,29 @@ class LLMEngine:
         self._decode_k_fns[("draft_prefill",)] = _dp
         return _dp
 
-    def _spec_eligible(self, decoding: list[_Slot]) -> bool:
-        """Speculative decoding serves pure-greedy requests (temp<=0, no
-        grammar/bias/penalties — those need per-token sampler state)."""
+    def _spec_mode(self, decoding: list[_Slot]) -> Optional[str]:
+        """Speculative decoding serves penalty-free requests (grammar/
+        bias/penalties need per-token sampler state): "greedy" when every
+        slot is temp<=0 (exact argmax replay), "sampled" when any slot
+        samples (rejection sampling reproduces the main model's
+        distribution exactly), None when ineligible."""
         if self.draft is None:
-            return False
+            return None
+        sampled = False
         for s in decoding:
             r = s.request
-            if r is None or r.temperature > 0 or r.constraint \
+            if r is None or r.constraint \
                     or r.logit_bias or r.repeat_penalty not in (0.0, 1.0) \
                     or r.frequency_penalty or r.presence_penalty:
-                return False
-        return True
+                return None
+            if r.temperature > 0:
+                sampled = True
+        return "sampled" if sampled else "greedy"
 
-    def _spec_decode_step(self, decoding: list[_Slot]) -> None:
-        """One speculative dispatch (see _spec_decode_fn)."""
+    def _spec_decode_step(self, decoding: list[_Slot],
+                          mode: str = "greedy") -> None:
+        """One speculative dispatch (see _spec_decode_fn /
+        _spec_sampled_fn)."""
         t0 = time.perf_counter()
         S = self.n_slots
         kd = self.n_draft
@@ -459,12 +581,13 @@ class LLMEngine:
                     s.n_past = limit
                     s.cache_tokens = s.cache_tokens[:limit]
                 pos0[s.idx] = s.n_past
-        D, Mt, J = self._run("spec", {
+        D, Mt, J = self._run("spec_s" if mode == "sampled" else "spec", {
             "kd": kd, "rounds": rounds, "tokens": tokens, "pos0": pos0,
             "active": active,
         })
         D = np.asarray(D)  # [rounds, S, kd-1] draft candidates
-        Mt = np.asarray(Mt)  # [rounds, S, kd] main greedy tokens
+        Mt = np.asarray(Mt)  # [rounds, S, kd] main tokens (greedy verify
+        # choices, or rejection-resample/bonus tokens on the sampled path)
         J = np.asarray(J)  # [rounds, S] emitted counts
         dt_ms = (time.perf_counter() - t0) * 1e3
         emitted_total = 0
@@ -652,6 +775,17 @@ class LLMEngine:
                 jnp.asarray(p["active"]),
             )
             return D, Mt, J
+        if kind == "spec_s":
+            import dataclasses
+
+            fn = self._spec_sampled_fn(p["kd"], p["rounds"])
+            D, Fin, J, rng, self.cache, self.draft_cache = fn(
+                self.params, self.draft[1], self.sampling, self.cache,
+                self.draft_cache, jnp.asarray(p["tokens"]),
+                jnp.asarray(p["pos0"]), jnp.asarray(p["active"]),
+            )
+            self.sampling = dataclasses.replace(self.sampling, rng=rng)
+            return D, Fin, J
         if kind == "embed":
             cache = KVCache.create(self.spec, 1, p["bucket"],
                                    self.cache.k.dtype)
@@ -1046,12 +1180,13 @@ class LLMEngine:
         host work; tokens generated past a slot's EOS/stop are discarded
         host-side and its n_past rolled back (the over-written tail K/V sits
         beyond the valid prefix, so it is never attended to)."""
-        if self._spec_eligible(decoding) and min(
+        spec_mode = self._spec_mode(decoding)
+        if spec_mode and min(
                 self.max_seq - 1 - s.n_past for s in decoding
         ) >= self.n_draft:
             # near the context wall the kd-token verify forward would
             # clamp its KV writes onto valid rows; normal path instead
-            self._spec_decode_step(decoding)
+            self._spec_decode_step(decoding, spec_mode)
             return
         t0 = time.perf_counter()
         S = self.n_slots

@@ -203,6 +203,38 @@ def _apply_penalties(logits: jax.Array, counts: jax.Array,
 CAND = 128
 
 
+def filtered_candidates(
+    state: SamplingState,
+    slot_ids: jax.Array,  # [B] i32
+    logits: jax.Array,  # [B, V] f32
+) -> tuple[jax.Array, jax.Array]:
+    """Per-row candidate DISTRIBUTION after the temperature/top-k/top-p/
+    min-p chain — the same llama.cpp sampler pipeline as ``sample`` minus
+    penalties (callers enforce penalty-free eligibility). Returns
+    (probs [B, CAND], vocab idx [B, CAND]); temp<=0 rows are an exact
+    one-hot on the argmax. Used by speculative REJECTION sampling, which
+    needs both models' filtered distributions, not just a draw."""
+    logits = logits.astype(jnp.float32)
+    K = min(CAND, logits.shape[-1])
+    vals, idx = lax.top_k(logits, K)  # [B, K] desc
+    temp = state.temperature[slot_ids]
+    scaled = vals / jnp.maximum(temp, 1e-6)[:, None]
+    rank = jnp.arange(K, dtype=jnp.int32)[None, :]
+    k_eff = jnp.where(state.top_k[slot_ids] <= 0, K,
+                      state.top_k[slot_ids])[:, None]
+    scaled = jnp.where(rank < k_eff, scaled, NEG_INF)
+    probs = jax.nn.softmax(scaled, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < state.top_p[slot_ids][:, None]
+    scaled = jnp.where(keep, scaled, NEG_INF)
+    probs = jax.nn.softmax(scaled, axis=-1)
+    keep = probs >= probs[:, :1] * state.min_p[slot_ids][:, None]
+    scaled = jnp.where(keep, scaled, NEG_INF)
+    probs = jax.nn.softmax(scaled, axis=-1)
+    greedy = (rank == 0).astype(jnp.float32)  # candidates sorted desc
+    return jnp.where((temp <= 0.0)[:, None], greedy, probs), idx
+
+
 def sample(
     state: SamplingState,
     slot_ids: jax.Array,  # [B] i32 — which slot each logits row belongs to
@@ -226,35 +258,23 @@ def sample(
         state.presence_penalty[slot_ids],
     )
 
-    # one top-k over the vocab serves greedy (j=0) and the candidate set
-    K = min(CAND, logits.shape[-1])
-    vals, idx = lax.top_k(logits, K)  # [B, K] desc
-    greedy_tok = idx[:, 0].astype(jnp.int32)
-
+    # the shared filter chain: ONE implementation feeds both this sampler
+    # and speculative rejection sampling, so their distributions can never
+    # drift apart
+    probs, idx = filtered_candidates(state, slot_ids, logits)
+    greedy_tok = idx[:, 0].astype(jnp.int32)  # candidates sorted desc
     temp = state.temperature[slot_ids]
-    scaled = vals / jnp.maximum(temp, 1e-6)[:, None]
-    # top-k: candidates are sorted desc, so the mask is a rank compare
-    rank = jnp.arange(K, dtype=jnp.int32)[None, :]
-    k_eff = jnp.where(state.top_k[slot_ids] <= 0, K,
-                      state.top_k[slot_ids])[:, None]
-    scaled = jnp.where(rank < k_eff, scaled, NEG_INF)
-    # top-p within candidates (sorted => plain cumsum)
-    probs = jax.nn.softmax(scaled, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep = (cum - probs) < state.top_p[slot_ids][:, None]  # keep 1st always
-    scaled = jnp.where(keep, scaled, NEG_INF)
-    # min-p relative to the max candidate prob
-    probs = jax.nn.softmax(scaled, axis=-1)
-    keep = probs >= probs[:, :1] * state.min_p[slot_ids][:, None]
-    scaled = jnp.where(keep, scaled, NEG_INF)
 
     keys = state.rng[slot_ids]
     split = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
     new_keys, sample_keys = split[:, 0], split[:, 1]
+    # gumbel-max over log probs == over filtered logits (per-row constant
+    # shift preserves the argmax), so draws match the pre-refactor sampler
+    logp = jnp.where(probs > 0, jnp.log(jnp.maximum(probs, 1e-30)), NEG_INF)
     gumbel = jax.vmap(
         lambda k, row: jax.random.gumbel(k, row.shape, jnp.float32)
-    )(sample_keys, scaled)
-    j = jnp.argmax(scaled + gumbel, axis=-1)
+    )(sample_keys, logp)
+    j = jnp.argmax(logp + gumbel, axis=-1)
     sampled_tok = jnp.take_along_axis(idx, j[:, None], axis=-1)[:, 0].astype(
         jnp.int32
     )

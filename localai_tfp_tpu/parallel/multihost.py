@@ -100,6 +100,8 @@ class LocalFollowerEnd:
         self._q: "queue.SimpleQueue[Record]" = queue.SimpleQueue()
 
     def recv(self, timeout: Optional[float] = None) -> Record:
+        # timeout supported here (queue-backed); the collective transport's
+        # recv() is bare by design — see JaxBroadcastChannel.recv
         return self._q.get(timeout=timeout)
 
 
@@ -197,7 +199,10 @@ def run_follower_engine(engine: Any, end: Any,
     engine's dispatch stream."""
     rp = Replayer()
     while True:
-        kind, rec = end.recv(timeout=timeout)
+        # collective transports (JaxBroadcastChannel) expose a bare
+        # recv(); only pass a timeout to ends that can honor one
+        kind, rec = end.recv() if timeout is None \
+            else end.recv(timeout=timeout)
         if kind == "stop":
             return
         if kind in ("load", "unload"):

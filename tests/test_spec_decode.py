@@ -84,7 +84,9 @@ def test_spec_decode_concurrent_and_prefix_reuse():
         spec_eng.close()
 
 
-def test_sampled_requests_fall_back_to_normal_path():
+def test_sampled_requests_use_rejection_sampling_spec_path():
+    """temp>0 without penalties rides the rejection-sampling spec kernel
+    (exact samples from the main model's distribution)."""
     _, spec_eng = _engines()
     spec_eng.start()
     try:
@@ -93,6 +95,76 @@ def test_sampled_requests_fall_back_to_normal_path():
             max_tokens=8, temperature=0.8, top_k=20, seed=1,
             ignore_eos=True))
         assert ev.finish_reason == "length", ev.error
-        assert spec_eng.metrics.spec_dispatches == 0  # sampled: no spec
+        assert spec_eng.metrics.spec_dispatches > 0
     finally:
+        spec_eng.close()
+
+
+def test_penalized_requests_fall_back_to_normal_path():
+    """Penalties need per-token sampler state — no speculative path."""
+    _, spec_eng = _engines()
+    spec_eng.start()
+    try:
+        ev = spec_eng.generate(GenRequest(
+            prompt_ids=spec_eng.tokenizer.encode("hi", add_bos=True),
+            max_tokens=8, temperature=0.8, repeat_penalty=1.3, seed=1,
+            ignore_eos=True))
+        assert ev.finish_reason == "length", ev.error
+        assert spec_eng.metrics.spec_dispatches == 0
+    finally:
+        spec_eng.close()
+
+
+def test_sampled_spec_draft_equals_main_accepts_everything():
+    """With draft == main, p == q at every position, so min(1, p/q) = 1 and
+    EVERY draft token is accepted: 24 tokens (1 from prefill + 23 decode)
+    must arrive in exactly ceil(23/16) = 2 spec dispatches."""
+    spec = tiny_spec()
+    params = init_params(jax.random.PRNGKey(0), spec, dtype=jnp.float32)
+    tok = ByteTokenizer()
+    eng = LLMEngine(spec, params, tok, n_slots=2, max_seq=256,
+                    cache_dtype=jnp.float32, autostart=False,
+                    draft=(spec, params), n_draft=4, decode_steps=16)
+    eng.start()
+    try:
+        ev = eng.generate(GenRequest(
+            prompt_ids=tok.encode("accept all", add_bos=True),
+            max_tokens=24, temperature=1.0, seed=5, ignore_eos=True))
+        assert ev.finish_reason == "length", ev.error
+        assert len(eng.tokenizer.encode(ev.full_text)) > 0
+        assert eng.metrics.spec_dispatches == 2, (
+            eng.metrics.spec_dispatches, eng.metrics.spec_tokens)
+    finally:
+        eng.close()
+
+
+def test_mixed_batch_greedy_slot_stays_exact_under_sampled_spec():
+    """A temp=0 slot batched with a sampled slot goes through the
+    rejection-sampling kernel as an exact one-hot distribution — its
+    output must equal the plain greedy engine's byte for byte."""
+    plain, spec_eng = _engines()
+    plain.start()
+    try:
+        want = _greedy(plain, "mixed batch probe", n=16)
+        qs = [
+            spec_eng.submit(GenRequest(
+                prompt_ids=spec_eng.tokenizer.encode(
+                    "mixed batch probe", add_bos=True),
+                max_tokens=16, temperature=0.0, ignore_eos=True)),
+            spec_eng.submit(GenRequest(
+                prompt_ids=spec_eng.tokenizer.encode("noise", add_bos=True),
+                max_tokens=16, temperature=0.9, seed=3, ignore_eos=True)),
+        ]
+        spec_eng.start()
+        texts = []
+        for q in qs:
+            while True:
+                ev = q.get()
+                if ev.done:
+                    texts.append(ev.full_text)
+                    break
+        assert texts[0] == want
+        assert spec_eng.metrics.spec_dispatches > 0
+    finally:
+        plain.close()
         spec_eng.close()
