@@ -76,6 +76,12 @@ class GenRequest:
     prompt_cache_all: bool = False
     prompt_cache_ro: bool = False
     correlation_id: str = ""
+    # multimodal soft tokens (ref: llava mmproj embedding path,
+    # grpc-server.cpp:1476-1502): precomputed embeddings [N, d_model] f32
+    # replacing the prompt tokens at soft_positions (absolute indices into
+    # prompt_ids — usually the <image_soft_token> runs)
+    soft_embeds: Optional[np.ndarray] = None
+    soft_positions: Optional[np.ndarray] = None
     id: str = field(default_factory=lambda: uuid.uuid4().hex)
 
 
@@ -260,12 +266,13 @@ class LLMEngine:
         self._all_slot_ids = jnp.arange(n_slots, dtype=jnp.int32)
 
         @partial(jax.jit, donate_argnums=(2,))
-        def _prefill(params, tokens, cache, pos0, slot_ids):
-            return forward(spec, params, tokens, pos0, cache, slot_ids)
+        def _prefill(params, tokens, cache, pos0, slot_ids, soft=None):
+            return forward(spec, params, tokens, pos0, cache, slot_ids,
+                           soft=soft)
 
         @partial(jax.jit, donate_argnums=(2, 4))
         def _prefill_final(params, tokens, cache, pos0, sampling, slot_ids,
-                           n_chunk, tails, tail_lens, masks):
+                           n_chunk, tails, tail_lens, masks, soft=None):
             """Final prompt chunks for a BATCH of slots + penalty-window
             seed + first-token sample in ONE dispatch — concurrent prompts
             share the round trip instead of paying one each, and TTFT pays
@@ -274,7 +281,7 @@ class LLMEngine:
             tokens [B, bucket]; slot_ids/pos0/n_chunk/tail_lens [B];
             tails [B, W]."""
             logits, cache = forward(
-                spec, params, tokens, pos0, cache, slot_ids
+                spec, params, tokens, pos0, cache, slot_ids, soft=soft
             )
 
             def seed(st, i):
@@ -548,7 +555,9 @@ class LLMEngine:
             r = s.request
             if r is None or r.constraint \
                     or r.logit_bias or r.repeat_penalty not in (0.0, 1.0) \
-                    or r.frequency_penalty or r.presence_penalty:
+                    or r.frequency_penalty or r.presence_penalty \
+                    or r.soft_embeds is not None:
+                # (mm: the draft cache never saw the image soft tokens)
                 return None
             if r.temperature > 0:
                 sampled = True
@@ -715,8 +724,9 @@ class LLMEngine:
             toks = jnp.asarray(p["toks"])
             pos0 = jnp.asarray(p["pos0"])
             sids = jnp.asarray(p["slot_ids"])
+            soft = self._soft_dense(p.get("soft"), *p["toks"].shape)
             _, self.cache = self._prefill_fn(
-                self.params, toks, self.cache, pos0, sids
+                self.params, toks, self.cache, pos0, sids, soft
             )
             if self.draft is not None:
                 self.draft_cache = self._draft_prefill_fn()(
@@ -728,10 +738,11 @@ class LLMEngine:
             pos0 = jnp.asarray(p["pos0"])
             sids = jnp.asarray(p["slot_ids"])
             masks = _unpack_masks(p["masks"])
+            soft = self._soft_dense(p.get("soft"), *p["toks"].shape)
             toks_out, self.cache, self.sampling = self._prefill_final_fn(
                 self.params, toks, self.cache, pos0, self.sampling, sids,
                 jnp.asarray(p["n_chunk"]), jnp.asarray(p["tails"]),
-                jnp.asarray(p["tail_lens"]), masks,
+                jnp.asarray(p["tail_lens"]), masks, soft,
             )
             if self.draft is not None:
                 self.draft_cache = self._draft_prefill_fn()(
@@ -1018,10 +1029,13 @@ class LLMEngine:
     def _assign(self, slot: _Slot, req: GenRequest,
                 out: queue.SimpleQueue) -> None:
         slot.cache_loaded = None
-        self._try_load_prompt_cache(slot, req)
-        common = _common_prefix(slot.cache_tokens, req.prompt_ids)
-        if common == len(req.prompt_ids):
-            common -= 1  # reprocess last token to get logits (ref :1882-1890)
+        if req.soft_embeds is not None:
+            common = 0  # image-conditioned K/V: no token-id prefix reuse
+        else:
+            self._try_load_prompt_cache(slot, req)
+            common = _common_prefix(slot.cache_tokens, req.prompt_ids)
+            if common == len(req.prompt_ids):
+                common -= 1  # reprocess last token for logits (ref :1882-1890)
         slot.request = req
         slot.out = out
         slot.state = SlotState.PREFILL
@@ -1075,6 +1089,7 @@ class LLMEngine:
             "toks": toks,
             "pos0": np.asarray([slot.n_past], np.int32),
             "slot_ids": np.asarray([slot.idx], np.int32),
+            "soft": self._soft_payload([slot], [slot.n_past], bucket),
         })
         slot.n_past += len(chunk)
         slot.cache_tokens.extend(chunk)
@@ -1110,6 +1125,7 @@ class LLMEngine:
             "toks": toks, "pos0": pos0, "slot_ids": slot_ids,
             "n_chunk": n_chunk, "tails": tails, "tail_lens": tail_lens,
             "masks": masks,
+            "soft": self._soft_payload(group, pos0, bucket),
         })
         toks_host = np.asarray(toks_out)
         dt_ms = (time.perf_counter() - t0) * 1e3
@@ -1124,6 +1140,39 @@ class LLMEngine:
             s.t_last = now
             self._epoch += 1
             self._emit_token(s, int(toks_host[r]))
+
+    def _soft_payload(self, group: list[_Slot], pos0: Any,
+                      bucket: int) -> Optional[list]:
+        """Compact multimodal rows for a prefill dispatch: [(batch row,
+        chunk-relative positions, embeds [k, D])] for every slot whose
+        soft tokens fall inside this chunk; None when text-only (the
+        common case pays nothing)."""
+        rows = []
+        for r, s in enumerate(group):
+            req = s.request
+            if req is None or req.soft_embeds is None:
+                continue
+            sp = np.asarray(req.soft_positions)
+            sel = (sp >= int(pos0[r])) & (sp < int(pos0[r]) + bucket)
+            if not sel.any():
+                continue
+            rows.append((r, (sp[sel] - int(pos0[r])).astype(np.int32),
+                         np.asarray(req.soft_embeds)[sel]
+                         .astype(np.float32)))
+        return rows or None
+
+    def _soft_dense(self, rows: Optional[list], B: int,
+                    T: int) -> Optional[tuple]:
+        """Materialize a compact soft payload into the (embeds [B,T,D],
+        mask [B,T]) override the forward pass consumes."""
+        if not rows:
+            return None
+        emb = np.zeros((B, T, self.spec.d_model), np.float32)
+        mask = np.zeros((B, T), bool)
+        for r, idxs, vals in rows:
+            emb[r, idxs] = vals
+            mask[r, idxs] = True
+        return jnp.asarray(emb), jnp.asarray(mask)
 
     def _constraint_mask_rows(self, slots: list[_Slot]) -> Optional[np.ndarray]:
         """Build [B, V] bool masks for grammar-constrained slots (host-side
@@ -1358,7 +1407,12 @@ class LLMEngine:
         self._release(slot)
 
     def _release(self, slot: _Slot) -> None:
-        # cache_tokens stay: they describe this row's reusable prefix
+        # cache_tokens stay: they describe this row's reusable prefix.
+        # Exception: multimodal rows — soft tokens share one id across
+        # DIFFERENT images, so their K/V must never be prefix-matched
+        if slot.request is not None and slot.request.soft_embeds is not None:
+            slot.cache_tokens = []
+            slot.n_past = 0
         self._epoch += 1
         slot.state = SlotState.FREE
         slot.request = None

@@ -149,10 +149,18 @@ class Evaluator:
         tokenizer: Any = None,
         functions: Optional[list[dict]] = None,
         use_function_template: bool = False,
+        media: Optional[list] = None,  # out-param: image parts collected
+        # here get [img-N] markers in the flattened text
     ) -> str:
         """Assemble the full chat prompt (ref: evaluator.go TemplateMessages
         :128+). Precedence: tokenizer chat template (if requested or no
         explicit template), else per-message template + chat template."""
+        if media is not None:
+            messages = [
+                {**m, "content": _content_to_text(m.get("content"), media)}
+                if not isinstance(m.get("content"), str) else m
+                for m in messages
+            ]
         use_tok = cfg.template.use_tokenizer_template or not (
             cfg.template.chat or cfg.template.chat_message
         )
@@ -213,10 +221,13 @@ class Evaluator:
         return combined
 
 
-def _content_to_text(content: Any) -> str:
+def _content_to_text(content: Any, media: Optional[list] = None) -> str:
     """OpenAI message content may be a string or multimodal part list
     (ref: core/schema/openai.go content parts; middleware/request.go
-    :302-329 media handling — media slots handled by the caller)."""
+    :302-329 media handling). When ``media`` is given, image parts are
+    collected into it and replaced by ``[img-N]`` markers in the text —
+    the reference's multimodal tag convention (pkg/templates/
+    multimodal.go) that the LLM worker later expands into soft tokens."""
     if content is None:
         return ""
     if isinstance(content, str):
@@ -224,8 +235,14 @@ def _content_to_text(content: Any) -> str:
     if isinstance(content, list):
         parts = []
         for part in content:
-            if isinstance(part, dict) and part.get("type") == "text":
+            if not isinstance(part, dict):
+                continue
+            ptype = part.get("type")
+            if ptype == "text":
                 parts.append(part.get("text", ""))
+            elif ptype in ("image_url", "image") and media is not None:
+                media.append(part)
+                parts.append(f"[img-{len(media) - 1}]")
         return "".join(parts)
     return str(content)
 

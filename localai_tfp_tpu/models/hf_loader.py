@@ -67,13 +67,49 @@ def _cast(a: np.ndarray, dtype) -> jnp.ndarray:
     return x.astype(dtype)
 
 
+def load_multimodal(model_dir: str, dtype: Any = jnp.bfloat16,
+                    state: Optional[tuple] = None):
+    """Load the vision tower of a multimodal checkpoint (gemma3 SigLIP).
+
+    Returns (VisionSpec, VisionParams, mm_info) or None for text-only
+    checkpoints. mm_info carries the image-token protocol ids from the
+    outer HF config: boi/eoi/image token indices and tokens-per-image
+    (ref: the reference's mmproj path — grpc-server.cpp :1476-1502 llava
+    embedding; config `mmproj` backend_config.go)."""
+    from .vision import load_vision_params, vision_spec_from_hf
+
+    config, get, names = state or load_hf_state(model_dir)
+    vcfg = config.get("vision_config")
+    if not isinstance(vcfg, dict):
+        return None
+    tcfg = config.get("text_config") or {}
+    mm_tokens = int(config.get("mm_tokens_per_image") or 256)
+    vspec = vision_spec_from_hf(
+        vcfg, mm_tokens,
+        int(tcfg.get("hidden_size") or config.get("hidden_size") or 0),
+    )
+    vparams = load_vision_params(get, names, dtype, vspec)
+    if vparams is None:
+        return None
+    mm_info = {
+        "boi_token": int(config.get("boi_token_index") or 255999),
+        "eoi_token": int(config.get("eoi_token_index") or 256000),
+        "image_token": int(config.get("image_token_index") or 262144),
+        "mm_tokens": mm_tokens,
+        "image_size": vspec.image_size,
+    }
+    return vspec, vparams, mm_info
+
+
 def load_params(
     model_dir: str,
     dtype: Any = jnp.bfloat16,
     spec_override: Optional[LLMSpec] = None,
+    state: Optional[tuple] = None,  # pre-read load_hf_state result, so a
+    # caller loading text + vision opens the checkpoint index once
 ) -> tuple[LLMSpec, Params]:
     """Load an HF checkpoint directory -> (spec, stacked params)."""
-    config, get, names = load_hf_state(model_dir)
+    config, get, names = state or load_hf_state(model_dir)
     spec = spec_override or spec_from_hf_config(config)
     mt = (config.get("model_type") or "").lower()
     L = spec.n_layers

@@ -519,6 +519,9 @@ def forward_hidden(
     # None => identity (row b == slot b), the batched-decode hot path
     decode_kernel: bool = False,  # T==1 identity path via Pallas paged
     # append/attend kernels (ragged cache reads; ops/decode_attention.py)
+    soft: Optional[tuple] = None,  # multimodal: (embeds [B,T,D],
+    # mask [B,T]) — rows where mask is True REPLACE the token embedding
+    # (post-multiplier, matching HF's masked_scatter of image features)
 ) -> tuple[jax.Array, KVCache]:
     """Run the stack up to (and including) the final norm; returns
     (hidden [B, T, D], updated cache). The LM head lives in ``forward``;
@@ -530,6 +533,9 @@ def forward_hidden(
     columns ``pos0 + [0..T)``.
     """
     x = _embed_in(spec, params, tokens)  # gather: [B, T, D]
+    if soft is not None:
+        emb, emb_mask = soft
+        x = jnp.where(emb_mask[..., None], emb.astype(x.dtype), x)
     B = tokens.shape[0]
     positions = pos0[:, None] + jnp.arange(
         tokens.shape[1], dtype=jnp.int32)[None, :]
@@ -685,10 +691,11 @@ def forward(
     cache: KVCache,
     slot_ids: Optional[jax.Array],
     decode_kernel: bool = False,
+    soft: Optional[tuple] = None,
 ) -> tuple[jax.Array, KVCache]:
     """forward_hidden + LM head; returns (logits [B, T, V] f32, cache)."""
     x, cache = forward_hidden(
-        spec, params, tokens, pos0, cache, slot_ids, decode_kernel
+        spec, params, tokens, pos0, cache, slot_ids, decode_kernel, soft
     )
     return _lm_head(spec, params, x), cache
 

@@ -1,0 +1,244 @@
+"""SigLIP vision tower + Gemma3 multimodal projector (pure JAX).
+
+Capability counterpart of the reference's multimodal path — llama.cpp's
+LLaVA/mmproj image embedding in the C++ engine (ref: grpc-server.cpp
+:1476-1502 llava image embedding, `llava_embd_batch` :420) and the vLLM
+backend's image inputs (ref: backend/python/vllm/backend.py multimodal
+b64 → PIL). Here the vision encoder is the gemma3 family's SigLIP tower;
+its pooled+projected soft tokens are spliced into the language model's
+embedding sequence (models/transformer.py ``soft`` override).
+
+TPU-first notes: the patch conv is expressed as a patchify+matmul (one
+big MXU contraction instead of a small-window conv), the encoder layers
+run under a stacked ``lax.scan`` like the text stack, and everything jits
+once per image-shape bucket (one fixed image size per checkpoint).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+VisionParams = dict[str, jax.Array]
+
+
+@dataclass(frozen=True, eq=False)  # identity hash for jit static args
+class VisionSpec:
+    hidden: int
+    n_layers: int
+    n_heads: int
+    d_ff: int
+    image_size: int
+    patch_size: int
+    channels: int = 3
+    eps: float = 1e-6
+    # gemma3 projector: pooled tokens per image and the text-model width
+    mm_tokens: int = 256
+    text_d_model: int = 0
+
+    @property
+    def d_head(self) -> int:
+        return self.hidden // self.n_heads
+
+    @property
+    def patches_per_side(self) -> int:
+        return self.image_size // self.patch_size
+
+    @property
+    def n_patches(self) -> int:
+        return self.patches_per_side ** 2
+
+    @property
+    def tokens_per_side(self) -> int:
+        return int(math.isqrt(self.mm_tokens))
+
+
+def vision_spec_from_hf(cfg: dict[str, Any],
+                        mm_tokens: int, text_d_model: int) -> VisionSpec:
+    """Map an HF ``vision_config`` block (SiglipVisionConfig) to VisionSpec."""
+    return VisionSpec(
+        hidden=int(cfg.get("hidden_size") or 1152),
+        n_layers=int(cfg.get("num_hidden_layers") or 27),
+        n_heads=int(cfg.get("num_attention_heads") or 16),
+        d_ff=int(cfg.get("intermediate_size") or 4304),
+        image_size=int(cfg.get("image_size") or 896),
+        patch_size=int(cfg.get("patch_size") or 14),
+        channels=int(cfg.get("num_channels") or 3),
+        eps=float(cfg.get("layer_norm_eps") or 1e-6),
+        mm_tokens=mm_tokens,
+        text_d_model=text_d_model,
+    )
+
+
+def _ln(x: jax.Array, w: jax.Array, b: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mu) * lax.rsqrt(var + eps)
+    return (out * w.astype(jnp.float32)
+            + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def vision_encode(spec: VisionSpec, vp: VisionParams,
+                  pixels: jax.Array) -> jax.Array:
+    """SigLIP vision transformer: pixels [B, C, H, W] f32 (normalized) ->
+    patch features [B, n_patches, hidden].
+
+    Mirrors HF SiglipVisionTransformer: patch conv + learned position
+    embeddings, pre-LN encoder layers (biased MHA, gelu_tanh MLP), final
+    post-layernorm. The conv runs as patchify+matmul on the MXU.
+    """
+    B = pixels.shape[0]
+    P, C = spec.patch_size, spec.channels
+    G = spec.patches_per_side
+    # [B, C, G, P, G, P] -> [B, G, G, C, P, P] -> [B, G*G, C*P*P]
+    x = pixels.reshape(B, C, G, P, G, P).transpose(0, 2, 4, 1, 3, 5)
+    x = x.reshape(B, G * G, C * P * P)
+    x = x @ vp["patch_w"] + vp["patch_b"]  # [B, N, D]
+    x = x + vp["pos_embed"][None]
+    prec = (lax.Precision.HIGHEST if x.dtype == jnp.float32
+            else lax.Precision.DEFAULT)
+    scale = 1.0 / math.sqrt(spec.d_head)
+    H, Dh = spec.n_heads, spec.d_head
+    N = x.shape[1]
+
+    def layer(x, lp):
+        h = _ln(x, lp["ln1_w"], lp["ln1_b"], spec.eps)
+        q = (h @ lp["wq"] + lp["bq"]).reshape(B, N, H, Dh)
+        k = (h @ lp["wk"] + lp["bk"]).reshape(B, N, H, Dh)
+        v = (h @ lp["wv"] + lp["bv"]).reshape(B, N, H, Dh)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32,
+                            precision=prec) * scale
+        probs = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32, precision=prec)
+        attn = attn.reshape(B, N, H * Dh).astype(x.dtype)
+        x = x + (attn @ lp["wo"] + lp["bo"])
+        h = _ln(x, lp["ln2_w"], lp["ln2_b"], spec.eps)
+        h = jax.nn.gelu(h @ lp["fc1_w"] + lp["fc1_b"], approximate=True)
+        x = x + (h @ lp["fc2_w"] + lp["fc2_b"])
+        return x, None
+
+    x, _ = lax.scan(layer, x, vp["layers"])
+    return _ln(x, vp["post_ln_w"], vp["post_ln_b"], spec.eps)
+
+
+def gemma3_project(spec: VisionSpec, vp: VisionParams,
+                   feats: jax.Array) -> jax.Array:
+    """Gemma3MultiModalProjector: [B, n_patches, hidden] -> [B, mm_tokens,
+    text_d_model]. Avg-pool the patch grid to tokens_per_side², RMSNorm
+    ((1+w) gemma convention, vision eps), project with the (untransposed)
+    mm_input_projection matrix."""
+    B = feats.shape[0]
+    G, T = spec.patches_per_side, spec.tokens_per_side
+    K = G // T
+    grid = feats.reshape(B, G, G, spec.hidden)
+    pooled = grid.reshape(B, T, K, T, K, spec.hidden).mean(axis=(2, 4))
+    pooled = pooled.reshape(B, T * T, spec.hidden)
+    xf = pooled.astype(jnp.float32)
+    normed = xf * lax.rsqrt(
+        jnp.mean(jnp.square(xf), axis=-1, keepdims=True) + spec.eps
+    ) * (1.0 + vp["mm_norm_w"].astype(jnp.float32))
+    prec = (lax.Precision.HIGHEST if feats.dtype == jnp.float32
+            else lax.Precision.DEFAULT)
+    out = jnp.einsum("btd,de->bte", normed.astype(feats.dtype),
+                     vp["mm_proj"], precision=prec)
+    return out
+
+
+def encode_images(spec: VisionSpec, vp: VisionParams,
+                  pixels: jax.Array) -> jax.Array:
+    """pixels [B, C, H, W] -> soft tokens [B, mm_tokens, text_d_model]."""
+    return gemma3_project(spec, vp, vision_encode(spec, vp, pixels))
+
+
+encode_images_jit = jax.jit(encode_images, static_argnums=(0,))
+
+
+# --------------------------------------------------------------- preprocess
+
+
+def preprocess_image(data: bytes, image_size: int) -> np.ndarray:
+    """Decode + resize + normalize one image to [C, H, W] f32, matching
+    Gemma3ImageProcessor: bilinear resize to the square image_size,
+    rescale 1/255, normalize mean=0.5 std=0.5 per channel."""
+    import io
+
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(data)).convert("RGB")
+    img = img.resize((image_size, image_size), Image.BILINEAR)
+    arr = np.asarray(img, dtype=np.float32) / 255.0  # [H, W, C]
+    arr = (arr - 0.5) / 0.5
+    return np.ascontiguousarray(arr.transpose(2, 0, 1))
+
+
+# ------------------------------------------------------------------- loader
+
+
+def load_vision_params(
+    get, names: list[str], dtype: Any,
+    spec: VisionSpec,
+) -> Optional[VisionParams]:
+    """Load the SigLIP tower + gemma3 projector from an HF multimodal
+    checkpoint (tensors under model.vision_tower.vision_model.* and
+    model.multi_modal_projector.*). Returns None when absent."""
+    for pref in ("model.vision_tower.vision_model.",
+                 "vision_tower.vision_model."):
+        if f"{pref}embeddings.patch_embedding.weight" in names:
+            break
+    else:
+        return None
+    proj_pref = ("model.multi_modal_projector."
+                 if "model.multi_modal_projector.mm_input_projection_weight"
+                 in names else "multi_modal_projector.")
+
+    def cast(a):
+        return jnp.asarray(np.ascontiguousarray(a)).astype(dtype)
+
+    D = spec.hidden
+    conv = get(pref + "embeddings.patch_embedding.weight")  # [D, C, P, P]
+    p: VisionParams = {
+        "patch_w": cast(conv.reshape(D, -1).T),  # [C*P*P, D]
+        "patch_b": cast(get(pref + "embeddings.patch_embedding.bias")),
+        "pos_embed": cast(get(pref + "embeddings.position_embedding.weight")),
+        "post_ln_w": cast(get(pref + "post_layernorm.weight")),
+        "post_ln_b": cast(get(pref + "post_layernorm.bias")),
+        "mm_proj": cast(get(proj_pref + "mm_input_projection_weight")),
+        "mm_norm_w": cast(get(proj_pref + "mm_soft_emb_norm.weight")),
+    }
+    lp = pref + "encoder.layers.{i}."
+
+    def stack(name, transpose):
+        rows = []
+        for i in range(spec.n_layers):
+            w = get(lp.format(i=i) + name)
+            rows.append(np.ascontiguousarray(w.T) if transpose else w)
+        return cast(np.stack(rows))
+
+    p["layers"] = {
+        "ln1_w": stack("layer_norm1.weight", False),
+        "ln1_b": stack("layer_norm1.bias", False),
+        "wq": stack("self_attn.q_proj.weight", True),
+        "bq": stack("self_attn.q_proj.bias", False),
+        "wk": stack("self_attn.k_proj.weight", True),
+        "bk": stack("self_attn.k_proj.bias", False),
+        "wv": stack("self_attn.v_proj.weight", True),
+        "bv": stack("self_attn.v_proj.bias", False),
+        "wo": stack("self_attn.out_proj.weight", True),
+        "bo": stack("self_attn.out_proj.bias", False),
+        "ln2_w": stack("layer_norm2.weight", False),
+        "ln2_b": stack("layer_norm2.bias", False),
+        "fc1_w": stack("mlp.fc1.weight", True),
+        "fc1_b": stack("mlp.fc1.bias", False),
+        "fc2_w": stack("mlp.fc2.weight", True),
+        "fc2_b": stack("mlp.fc2.bias", False),
+    }
+    return p

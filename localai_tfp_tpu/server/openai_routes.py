@@ -83,6 +83,64 @@ async def _load_backend(request: web.Request, cfg: ModelConfig) -> Backend:
     return await loop.run_in_executor(None, st.model_loader.load, cfg)
 
 
+_MEDIA_MAX_BYTES = 32 << 20  # cap per fetched image
+
+
+async def _fetch_media_all(parts: list[dict]) -> list[bytes]:
+    """Image parts -> raw bytes, fetched concurrently over one session
+    (ref: middleware/request.go:302-329 getContentURIAsBase64)."""
+    import aiohttp
+
+    remote = any(_media_url(p).startswith(("http://", "https://"))
+                 for p in parts)
+    sess = aiohttp.ClientSession() if remote else None
+    try:
+        return list(await asyncio.gather(
+            *(_fetch_media(p, sess) for p in parts)))
+    finally:
+        if sess is not None:
+            await sess.close()
+
+
+def _media_url(part: dict) -> str:
+    url = ""
+    if isinstance(part.get("image_url"), dict):
+        url = part["image_url"].get("url") or ""
+    elif isinstance(part.get("image_url"), str):
+        url = part["image_url"]
+    return url or part.get("url") or part.get("data") or ""
+
+
+async def _fetch_media(part: dict, sess) -> bytes:
+    """One image part -> raw bytes. Accepts data: URLs, bare base64, and
+    http(s) URLs."""
+    import base64
+
+    url = _media_url(part)
+    if not url:
+        raise web.HTTPBadRequest(reason="image part has no url")
+    if url.startswith("data:"):
+        b64 = url.split(",", 1)[-1]
+        return base64.b64decode(b64)
+    if url.startswith(("http://", "https://")):
+        async with sess.get(url) as resp:
+            if resp.status != 200:
+                raise web.HTTPBadRequest(
+                    reason=f"could not fetch image: {url}")
+            body = await resp.content.read(_MEDIA_MAX_BYTES + 1)
+            if len(body) > _MEDIA_MAX_BYTES:
+                raise web.HTTPRequestEntityTooLarge(
+                    max_size=_MEDIA_MAX_BYTES, actual_size=len(body))
+            return body
+    try:
+        out = base64.b64decode(url, validate=True)
+    except Exception:
+        raise web.HTTPBadRequest(reason="unsupported image reference")
+    if not out:
+        raise web.HTTPBadRequest(reason="unsupported image reference")
+    return out
+
+
 def _predict_options(cfg: ModelConfig, body: dict, prompt: str,
                      correlation_id: str = "") -> PredictOptions:
     """Merge request sampling over config defaults
@@ -245,13 +303,19 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
     grammar = _grammar_for_request(cfg, body, tools)
 
     tokenizer = getattr(backend, "tokenizer", None)
+    media: list = []
     prompt = st.evaluator.template_messages(
         cfg, messages, tokenizer=tokenizer,
         functions=tools or None, use_function_template=tools_requested,
+        media=media,
     )
 
     opts = _predict_options(cfg, body, prompt,
                             request.get("correlation_id", ""))
+    if media:
+        # image parts -> raw bytes (data: URLs decoded inline, http(s)
+        # downloaded — ref: middleware/request.go:302-329 base64-ification)
+        opts.images = await _fetch_media_all(media)
     if grammar:
         opts.grammar = grammar
     extra_usage = ("Extra-Usage" in request.headers

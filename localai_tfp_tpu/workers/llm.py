@@ -61,6 +61,9 @@ class JaxLLMBackend(Backend):
         # multihost role override ("leader"/"follower"/"solo"); None reads
         # the process-wide multihost.role()
         self._role = role
+        # multimodal: (VisionSpec, VisionParams, mm_info) for checkpoints
+        # with a vision tower (gemma3), else None
+        self.vision: Any = None
 
     # ------------------------------------------------------------- lifecycle
 
@@ -92,7 +95,11 @@ class JaxLLMBackend(Backend):
                 self._state = "BUSY"
                 dtype = _DTYPES.get((opts.dtype or "bfloat16").lower(),
                                     jnp.bfloat16)
-                self.spec, params = load_params(model_dir, dtype=dtype)
+                from ..models.hf_loader import load_hf_state
+
+                hf_state = load_hf_state(model_dir)
+                self.spec, params = load_params(model_dir, dtype=dtype,
+                                                state=hf_state)
                 # merge LoRA adapters at load (ref: llama.cpp LoRA apply
                 # via LoadModel — proto LoraAdapter/LoraScale)
                 for i, adir in enumerate(opts.lora_adapters):
@@ -107,6 +114,22 @@ class JaxLLMBackend(Backend):
                     params, n = merge_lora(self.spec, params, adir,
                                            scale=scale)
                 self.tokenizer = load_tokenizer(model_dir)
+                try:
+                    from ..models.hf_loader import load_multimodal
+
+                    self.vision = load_multimodal(model_dir, dtype=dtype,
+                                                  state=hf_state)
+                except Exception as ve:
+                    # text-only serving still works, but a genuinely
+                    # multimodal checkpoint losing its tower must be
+                    # operator-visible, not silent
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "vision tower load failed for %s: %r — serving "
+                        "text-only, image parts will be ignored",
+                        model_dir, ve)
+                    self.vision = None
                 kv_dtype = _KV_DTYPES.get(
                     (opts.kv_cache_dtype or opts.dtype or "bfloat16").lower(),
                     dtype,
@@ -191,9 +214,67 @@ class JaxLLMBackend(Backend):
 
     # ------------------------------------------------------------- inference
 
+    def _splice_images(self, prompt: str, images: list[bytes]):
+        """Expand [img-N] markers into <boi> + mm_tokens soft tokens +
+        <eoi> id runs and encode the images through the vision tower
+        (ref: the llava mmproj embedding path, grpc-server.cpp:1476-1502;
+        marker convention: pkg/templates/multimodal.go). Returns
+        (prompt_ids, soft_embeds [n_soft, D] f32, soft_positions [n_soft])."""
+        import re as _re
+
+        import numpy as np
+
+        from ..models.vision import encode_images_jit, preprocess_image
+
+        vspec, vparams, mm = self.vision
+        pix = np.stack([
+            preprocess_image(b, mm["image_size"]) for b in images
+        ])
+        dtype = self.engine.params["embed"].dtype
+        soft_all = np.asarray(
+            encode_images_jit(vspec, vparams,
+                              jnp.asarray(pix).astype(dtype))
+            .astype(jnp.float32)
+        )  # [n_images, mm_tokens, D]
+        parts = _re.split(r"\[img-(\d+)\]", prompt)
+        if len(parts) == 1:
+            # no markers (template didn't place them): prepend the images
+            parts = [""]
+            for i in range(len(images)):
+                parts += [str(i), prompt if i == len(images) - 1 else ""]
+        ids = self.tokenizer.encode(parts[0], add_bos=True)
+        positions: list[int] = []
+        rows: list[np.ndarray] = []
+        for j in range(1, len(parts), 2):
+            img_i = int(parts[j])
+            text = parts[j + 1]
+            if img_i >= len(images):
+                # user-typed [img-N] with no such image: keep it (and the
+                # text after it) as literal prompt text, never drop input
+                ids.extend(self.tokenizer.encode(
+                    f"[img-{parts[j]}]" + text, add_bos=False))
+                continue
+            ids.append(mm["boi_token"])
+            start = len(ids)
+            ids.extend([mm["image_token"]] * mm["mm_tokens"])
+            positions.extend(range(start, start + mm["mm_tokens"]))
+            rows.append(soft_all[img_i])
+            ids.append(mm["eoi_token"])
+            if text:
+                ids.extend(self.tokenizer.encode(text, add_bos=False))
+        if not rows:  # only bogus markers: plain text request
+            return ids, None, None
+        return (ids, np.concatenate(rows).astype(np.float32),
+                np.asarray(positions, np.int32))
+
     def _to_request(self, opts: PredictOptions) -> GenRequest:
         assert self.engine is not None and self.tokenizer is not None
-        prompt_ids = self.tokenizer.encode(opts.prompt, add_bos=True)
+        soft_embeds = soft_positions = None
+        if opts.images and self.vision is not None:
+            prompt_ids, soft_embeds, soft_positions = self._splice_images(
+                opts.prompt, opts.images)
+        else:
+            prompt_ids = self.tokenizer.encode(opts.prompt, add_bos=True)
         constraint = None
         if opts.grammar:
             constraint = self._grammar_cache.get(opts.grammar)
@@ -222,6 +303,8 @@ class JaxLLMBackend(Backend):
             prompt_cache_all=opts.prompt_cache_all,
             prompt_cache_ro=opts.prompt_cache_ro,
             correlation_id=opts.correlation_id,
+            soft_embeds=soft_embeds,
+            soft_positions=soft_positions,
         )
 
     def predict(self, opts: PredictOptions) -> Reply:
