@@ -146,6 +146,19 @@ class EngineMetrics:
     spec_dispatches: int = 0
 
 
+def _soft_expand(tokens: jax.Array, rows: jax.Array, brow: jax.Array,
+                 bpos: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Inside jit: compact multimodal rows -> the dense (embeds [B,T,D],
+    mask [B,T]) override forward() consumes. Padding entries carry an
+    out-of-range batch row and are dropped by the scatter, so the host
+    ships only R×D real bytes instead of a B×T×D zero sea."""
+    B, T = tokens.shape
+    emb = jnp.zeros((B, T, rows.shape[-1]), rows.dtype)
+    emb = emb.at[brow, bpos].set(rows, mode="drop")
+    mask = jnp.zeros((B, T), bool).at[brow, bpos].set(True, mode="drop")
+    return emb, mask
+
+
 def _pack_masks(masks: Optional[np.ndarray]) -> Optional[dict]:
     """[B, V] bool → bit-packed record payload (multihost dispatch records
     must stay small; a dense 256k-vocab mask is 8x the packed size)."""
@@ -267,6 +280,8 @@ class LLMEngine:
 
         @partial(jax.jit, donate_argnums=(2,))
         def _prefill(params, tokens, cache, pos0, slot_ids, soft=None):
+            if soft is not None:
+                soft = _soft_expand(tokens, *soft)
             return forward(spec, params, tokens, pos0, cache, slot_ids,
                            soft=soft)
 
@@ -280,6 +295,8 @@ class LLMEngine:
 
             tokens [B, bucket]; slot_ids/pos0/n_chunk/tail_lens [B];
             tails [B, W]."""
+            if soft is not None:
+                soft = _soft_expand(tokens, *soft)
             logits, cache = forward(
                 spec, params, tokens, pos0, cache, slot_ids, soft=soft
             )
@@ -1163,16 +1180,27 @@ class LLMEngine:
 
     def _soft_dense(self, rows: Optional[list], B: int,
                     T: int) -> Optional[tuple]:
-        """Materialize a compact soft payload into the (embeds [B,T,D],
-        mask [B,T]) override the forward pass consumes."""
+        """Compact soft payload -> padded device arrays (emb [Rp, D],
+        brow [Rp], bpos [Rp]) for _soft_expand inside the jitted prefill.
+        Rp is the token count rounded to a power of two (bounded jit
+        cache); padding rows point at batch row B, which the scatter
+        drops."""
         if not rows:
             return None
-        emb = np.zeros((B, T, self.spec.d_model), np.float32)
-        mask = np.zeros((B, T), bool)
+        R = sum(len(idxs) for _, idxs, _ in rows)
+        Rp = 1 << max(R - 1, 0).bit_length()
+        D = self.spec.d_model
+        emb = np.zeros((Rp, D), np.float32)
+        brow = np.full((Rp,), B, np.int32)
+        bpos = np.zeros((Rp,), np.int32)
+        off = 0
         for r, idxs, vals in rows:
-            emb[r, idxs] = vals
-            mask[r, idxs] = True
-        return jnp.asarray(emb), jnp.asarray(mask)
+            n = len(idxs)
+            emb[off:off + n] = vals
+            brow[off:off + n] = r
+            bpos[off:off + n] = idxs
+            off += n
+        return jnp.asarray(emb), jnp.asarray(brow), jnp.asarray(bpos)
 
     def _constraint_mask_rows(self, slots: list[_Slot]) -> Optional[np.ndarray]:
         """Build [B, V] bool masks for grammar-constrained slots (host-side

@@ -1,0 +1,657 @@
+"""VITS text-to-speech in pure JAX (HF `VitsModel` checkpoint compatible).
+
+Capability counterpart of the reference's piper TTS backend — piper IS a
+VITS runtime (ref: backend/go/tts/piper.go:49, espeak-ng phonemes +
+VITS onnx) — and of the coqui/MMS neural-TTS paths of the transformers
+backend (ref: backend/python/transformers/backend.py TTS :529). Serves
+`/tts`, `/v1/audio/speech` and the ElevenLabs route through
+workers/tts.py.
+
+Inference graph (mirrors HF VitsModel.forward exactly, so facebook/mms-tts-*
+and other VitsModel checkpoints load directly):
+  text encoder (relative-window attention + conv FFN)
+  -> stochastic duration predictor run in REVERSE (spline flows)
+  -> length regulation (host-side expansion; padded/bucketed for jit)
+  -> residual-coupling flow in REVERSE (mean-only couplings over WaveNet)
+  -> HiFiGAN decoder (transposed-conv upsampling + dilated resblocks).
+
+Everything on-device is [B, C, T] like the reference implementation, so
+weights load untransposed; convs run via lax.conv_general_dilated.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+VitsParams = dict[str, Any]
+
+
+@dataclass(frozen=True, eq=False)
+class VitsSpec:
+    vocab_size: int
+    hidden: int
+    n_layers: int
+    n_heads: int
+    ffn_dim: int
+    ffn_kernel: int = 3
+    window: int = 4
+    flow_size: int = 192
+    spec_bins: int = 513
+    # duration predictor
+    dp_kernel: int = 3
+    dp_layers: int = 3  # depth_separable_num_layers
+    dp_flows: int = 4
+    dp_bins: int = 10
+    dp_tail: float = 5.0
+    dds_channels: int = 2  # depth_separable_channels
+    # prior flow
+    flow_layers: int = 4  # prior_encoder_num_flows
+    wn_layers: int = 4  # prior_encoder_num_wavenet_layers
+    wn_kernel: int = 5
+    wn_dilation: int = 1
+    # hifigan
+    upsample_rates: tuple[int, ...] = (8, 8, 2, 2)
+    upsample_kernels: tuple[int, ...] = (16, 16, 4, 4)
+    upsample_initial: int = 512
+    resblock_kernels: tuple[int, ...] = (3, 7, 11)
+    resblock_dilations: tuple[tuple[int, ...], ...] = ((1, 3, 5),) * 3
+    leaky_slope: float = 0.1
+    # sampling defaults (config noise_scale / noise_scale_duration /
+    # speaking_rate)
+    noise_scale: float = 0.667
+    noise_scale_duration: float = 0.8
+    speaking_rate: float = 1.0
+    sampling_rate: int = 16000
+
+    @property
+    def upsample_factor(self) -> int:
+        out = 1
+        for r in self.upsample_rates:
+            out *= r
+        return out
+
+
+def vits_spec_from_hf(cfg: dict[str, Any]) -> VitsSpec:
+    def tup(x):
+        return tuple(tuple(v) if isinstance(v, list) else v for v in x)
+
+    return VitsSpec(
+        vocab_size=int(cfg.get("vocab_size") or 38),
+        hidden=int(cfg.get("hidden_size") or 192),
+        n_layers=int(cfg.get("num_hidden_layers") or 6),
+        n_heads=int(cfg.get("num_attention_heads") or 2),
+        ffn_dim=int(cfg.get("ffn_dim") or 768),
+        ffn_kernel=int(cfg.get("ffn_kernel_size") or 3),
+        window=int(cfg.get("window_size") or 4),
+        flow_size=int(cfg.get("flow_size") or 192),
+        spec_bins=int(cfg.get("spectrogram_bins") or 513),
+        dp_kernel=int(cfg.get("duration_predictor_kernel_size") or 3),
+        dp_layers=int(cfg.get("depth_separable_num_layers") or 3),
+        dp_flows=int(cfg.get("duration_predictor_num_flows") or 4),
+        dp_bins=int(cfg.get("duration_predictor_flow_bins") or 10),
+        dp_tail=float(cfg.get("duration_predictor_tail_bound") or 5.0),
+        dds_channels=int(cfg.get("depth_separable_channels") or 2),
+        flow_layers=int(cfg.get("prior_encoder_num_flows") or 4),
+        wn_layers=int(cfg.get("prior_encoder_num_wavenet_layers") or 4),
+        wn_kernel=int(cfg.get("wavenet_kernel_size") or 5),
+        wn_dilation=int(cfg.get("wavenet_dilation_rate") or 1),
+        upsample_rates=tuple(cfg.get("upsample_rates") or (8, 8, 2, 2)),
+        upsample_kernels=tuple(
+            cfg.get("upsample_kernel_sizes") or (16, 16, 4, 4)),
+        upsample_initial=int(cfg.get("upsample_initial_channel") or 512),
+        resblock_kernels=tuple(cfg.get("resblock_kernel_sizes") or (3, 7, 11)),
+        resblock_dilations=tup(cfg.get("resblock_dilation_sizes")
+                               or ((1, 3, 5),) * 3),
+        leaky_slope=float(cfg.get("leaky_relu_slope") or 0.1),
+        noise_scale=float(cfg.get("noise_scale", 0.667)),
+        noise_scale_duration=float(cfg.get("noise_scale_duration", 0.8)),
+        speaking_rate=float(cfg.get("speaking_rate", 1.0)),
+        sampling_rate=int(cfg.get("sampling_rate") or 16000),
+    )
+
+
+# ------------------------------------------------------------------ ops
+
+
+def _conv1d(x, w, b=None, pad=0, dilation=1, groups=1):
+    """torch Conv1d semantics: x [B,C,T], w [O,I/g,K], explicit padding."""
+    out = lax.conv_general_dilated(
+        x, w, (1,), [(pad, pad)], rhs_dilation=(dilation,),
+        dimension_numbers=("NCH", "OIH", "NCH"), feature_group_count=groups,
+    )
+    return out + b[None, :, None] if b is not None else out
+
+
+def _conv_transpose1d(x, w, b, stride, pad):
+    """torch ConvTranspose1d: w [I,O,K]; out len = (T-1)*s - 2p + K."""
+    k = w.shape[-1]
+    w_conv = jnp.flip(w, -1).transpose(1, 0, 2)  # -> [O, I, K]
+    out = lax.conv_general_dilated(
+        x, w_conv, (1,), [(k - 1 - pad, k - 1 - pad)],
+        lhs_dilation=(stride,),
+        dimension_numbers=("NCH", "OIH", "NCH"),
+    )
+    return out + b[None, :, None] if b is not None else out
+
+
+def _ln_cl(x, w, b, eps=1e-5):
+    """LayerNorm over the channel dim of [B,C,T] (HF transposes to apply
+    nn.LayerNorm on the last dim; this is the same math in place)."""
+    mu = x.mean(1, keepdims=True)
+    var = ((x - mu) ** 2).mean(1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * w[None, :, None] \
+        + b[None, :, None]
+
+
+# -------------------------------------------------------------- encoder
+
+
+def _rel_shift_to_abs(x):
+    """[H, T, 2T-1] relative logits -> [H, T, T] absolute."""
+    h, t, _ = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (0, 1)))
+    x = x.reshape(h, t * 2 * t)
+    x = jnp.pad(x, ((0, 0), (0, t - 1)))
+    x = x.reshape(h, t + 1, 2 * t - 1)
+    return x[:, :t, t - 1:]
+
+
+def _abs_to_rel(x):
+    """[H, T, T] -> [H, T, 2T-1]."""
+    h, t, _ = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (0, t - 1)))
+    x = x.reshape(h, t * (2 * t - 1))
+    x = jnp.pad(x, ((0, 0), (t, 0)))
+    return x.reshape(h, t, 2 * t)[:, :, 1:]
+
+
+def _rel_embed(emb, window, t):
+    """Slice/pad the [2w+1, d] table to [2t-1, d]."""
+    pad = max(t - (window + 1), 0)
+    if pad > 0:
+        emb = jnp.pad(emb, ((pad, pad), (0, 0)))
+    start = max((window + 1) - t, 0)
+    return lax.dynamic_slice_in_dim(emb, start, 2 * t - 1, 0)
+
+
+def _enc_attention(spec: VitsSpec, p, x, attn_bias):
+    """Relative-window MHA on [B, T, C] (B=1 path vectorized over heads)."""
+    B, T, C = x.shape
+    H = spec.n_heads
+    Dh = C // H
+    scale = Dh ** -0.5
+    q = (x @ p["wq"].T + p["bq"]) * scale
+    k = x @ p["wk"].T + p["bk"]
+    v = x @ p["wv"].T + p["bv"]
+
+    def one(qb, kb, vb):
+        qh = qb.reshape(T, H, Dh).transpose(1, 0, 2)  # [H, T, Dh]
+        kh = kb.reshape(T, H, Dh).transpose(1, 0, 2)
+        vh = vb.reshape(T, H, Dh).transpose(1, 0, 2)
+        logits = qh @ kh.transpose(0, 2, 1)  # [H, T, T]
+        rel_k = _rel_embed(p["emb_rel_k"][0], spec.window, T)  # [2T-1, Dh]
+        logits = logits + _rel_shift_to_abs(qh @ rel_k.T)
+        if attn_bias is not None:
+            logits = logits + attn_bias
+        probs = jax.nn.softmax(logits, -1)
+        out = probs @ vh  # [H, T, Dh]
+        rel_v = _rel_embed(p["emb_rel_v"][0], spec.window, T)
+        out = out + _abs_to_rel(probs) @ rel_v
+        return out.transpose(1, 0, 2).reshape(T, C)
+
+    out = jax.vmap(one)(q, k, v)
+    return out @ p["wo"].T + p["bo"]
+
+
+def text_encoder(spec: VitsSpec, p: VitsParams, ids: jax.Array,
+                 mask: jax.Array):
+    """ids [B, T], mask [B, T] (1=valid) -> (hidden [B,C,T],
+    prior_means [B,T,F], prior_log_var [B,T,F])."""
+    x = p["embed"][ids] * math.sqrt(spec.hidden)  # [B, T, C]
+    attn_bias = jnp.where(mask[0][None, None, :] > 0, 0.0, -1e9) \
+        if mask is not None else None
+    mb = mask[:, None, :]  # [B,1,T]
+    kf = spec.ffn_kernel
+    pad_l, pad_r = (kf - 1) // 2, kf // 2
+    for lp in p["layers"]:
+        attn = _enc_attention(spec, lp, x, attn_bias)
+        x = _ln_cl((x + attn).transpose(0, 2, 1), lp["ln1_w"], lp["ln1_b"])
+        h = x * mb
+        h = jnp.pad(h, ((0, 0), (0, 0), (pad_l, pad_r))) if kf > 1 else h
+        h = jax.nn.relu(_conv1d(h, lp["ff1_w"], lp["ff1_b"]))
+        h = h * mb
+        h = jnp.pad(h, ((0, 0), (0, 0), (pad_l, pad_r))) if kf > 1 else h
+        h = _conv1d(h, lp["ff2_w"], lp["ff2_b"]) * mb
+        x = _ln_cl(x + h, lp["ln2_w"], lp["ln2_b"])
+        x = x.transpose(0, 2, 1)  # back to [B, T, C]
+    hidden = x.transpose(0, 2, 1)  # [B, C, T]
+    stats = _conv1d(hidden, p["proj_w"], p["proj_b"]) \
+        * mb  # [B, 2F, T]
+    means, log_var = jnp.split(stats.transpose(0, 2, 1), 2, axis=2)
+    return hidden, means, log_var
+
+
+# ---------------------------------------------- stochastic duration (rev)
+
+
+def _dds(spec: VitsSpec, p, x, mask, cond=None):
+    """VitsDilatedDepthSeparableConv (depthwise dilated + pointwise)."""
+    if cond is not None:
+        x = x + cond
+    C = x.shape[1]
+    k = spec.dp_kernel
+    for i, lp in enumerate(p):
+        d = k ** i
+        pad = (k * d - d) // 2
+        h = _conv1d(x * mask, lp["dw_w"], lp["dw_b"], pad=pad, dilation=d,
+                    groups=C)
+        h = jax.nn.gelu(_ln_cl(h, lp["n1_w"], lp["n1_b"]), approximate=False)
+        h = _conv1d(h, lp["pw_w"], lp["pw_b"])
+        h = jax.nn.gelu(_ln_cl(h, lp["n2_w"], lp["n2_b"]), approximate=False)
+        x = x + h
+    return x * mask
+
+
+def _rqs_reverse_or_forward(inputs, uw, uh, ud, reverse, tail, bins):
+    """Piecewise rational-quadratic spline (HF
+    _unconstrained_rational_quadratic_spline), vectorized with where()
+    instead of boolean indexing. inputs [...], u* [..., bins(/+1)]."""
+    min_bin = 1e-3
+    min_deriv = 1e-3
+    inside = (inputs >= -tail) & (inputs <= tail)
+    x = jnp.clip(inputs, -tail, tail)
+
+    const = math.log(math.exp(1 - min_deriv) - 1)
+    ud = jnp.pad(ud, [(0, 0)] * (ud.ndim - 1) + [(1, 1)],
+                 constant_values=const)
+
+    widths = jax.nn.softmax(uw, -1)
+    widths = min_bin + (1 - min_bin * bins) * widths
+    cumw = jnp.cumsum(widths, -1)
+    cumw = jnp.pad(cumw, [(0, 0)] * (cumw.ndim - 1) + [(1, 0)])
+    cumw = 2 * tail * cumw - tail
+    cumw = cumw.at[..., 0].set(-tail).at[..., -1].set(tail)
+    widths = cumw[..., 1:] - cumw[..., :-1]
+
+    derivs = min_deriv + jax.nn.softplus(ud)
+
+    heights = jax.nn.softmax(uh, -1)
+    heights = min_bin + (1 - min_bin * bins) * heights
+    cumh = jnp.cumsum(heights, -1)
+    cumh = jnp.pad(cumh, [(0, 0)] * (cumh.ndim - 1) + [(1, 0)])
+    cumh = 2 * tail * cumh - tail
+    cumh = cumh.at[..., 0].set(-tail).at[..., -1].set(tail)
+    heights = cumh[..., 1:] - cumh[..., :-1]
+
+    locs = cumh if reverse else cumw
+    locs = locs.at[..., -1].add(1e-6)
+    idx = jnp.sum((x[..., None] >= locs).astype(jnp.int32), -1) - 1
+    idx = jnp.clip(idx, 0, bins - 1)[..., None]
+
+    def g(arr):
+        return jnp.take_along_axis(arr, idx, -1)[..., 0]
+
+    in_cumw, in_w = g(cumw), g(widths)
+    in_cumh = g(cumh)
+    delta = heights / widths
+    in_delta = g(delta)
+    in_d = g(derivs)
+    in_d1 = g(derivs[..., 1:])
+    in_h = g(heights)
+    i1 = in_d + in_d1 - 2 * in_delta
+    if not reverse:
+        theta = (x - in_cumw) / in_w
+        t1 = theta * (1 - theta)
+        num = in_h * (in_delta * theta ** 2 + in_d * t1)
+        den = in_delta + i1 * t1
+        out = in_cumh + num / den
+    else:
+        i2 = x - in_cumh
+        i3 = i2 * i1
+        a = in_h * (in_delta - in_d) + i3
+        b = in_h * in_d - i3
+        c = -in_delta * i2
+        disc = jnp.maximum(b ** 2 - 4 * a * c, 0.0)
+        root = (2 * c) / (-b - jnp.sqrt(disc))
+        out = root * in_w + in_cumw
+    return jnp.where(inside, out, inputs)
+
+
+def _conv_flow_reverse(spec: VitsSpec, p, z, mask, cond):
+    half = spec.dds_channels // 2
+    first, second = z[:, :half], z[:, half:]
+    h = _conv1d(first, p["pre_w"], p["pre_b"])
+    h = _dds(spec, p["dds"], h, mask, cond)
+    h = _conv1d(h, p["proj_w"], p["proj_b"]) * mask
+    B, _, T = first.shape
+    h = h.reshape(B, half, -1, T).transpose(0, 1, 3, 2)  # [B,half,T,3b-1]
+    nb = spec.dp_bins
+    scale = math.sqrt(spec.hidden)
+    second = _rqs_reverse_or_forward(
+        second, h[..., :nb] / scale, h[..., nb:2 * nb] / scale,
+        h[..., 2 * nb:], True, spec.dp_tail, nb,
+    )
+    return jnp.concatenate([first, second], 1) * mask
+
+
+def duration_reverse(spec: VitsSpec, p: VitsParams, hidden, mask,
+                     noise, cond=None):
+    """Stochastic duration predictor in reverse: log durations [B,1,T].
+    ``noise`` [B, 2, T] (zeros => deterministic mode)."""
+    x = _conv1d(hidden, p["pre_w"], p["pre_b"])
+    if cond is not None:
+        x = x + _conv1d(cond, p["cond_w"], p["cond_b"])
+    x = _dds(spec, p["dds"], x, mask)
+    x = _conv1d(x, p["proj_w"], p["proj_b"]) * mask
+
+    # flows = [affine, conv_flow x dp_flows]; reversed drops the last
+    # conv flow before the affine ("remove a useless vflow" in HF)
+    flows = [("affine", p["affine"])] + [("conv", f) for f in p["flows"]]
+    rev = flows[::-1]
+    rev = rev[:-2] + [rev[-1]]
+    z = noise
+    for kind, fp in rev:
+        z = jnp.flip(z, 1)
+        if kind == "affine":
+            z = (z - fp["translate"][None]) * jnp.exp(-fp["log_scale"][None])
+            z = z * mask
+        else:
+            z = _conv_flow_reverse(spec, fp, z, mask, x)
+    return z[:, :1]
+
+
+# ------------------------------------------------------- prior flow (rev)
+
+
+def _wavenet(spec: VitsSpec, p, x, mask, cond=None):
+    out = jnp.zeros_like(x)
+    C = x.shape[1]
+    k = spec.wn_kernel
+    gl = _conv1d(cond, p["cond_w"], p["cond_b"]) if cond is not None else None
+    for i, lp in enumerate(p["layers"]):
+        d = spec.wn_dilation ** i
+        pad = (k * d - d) // 2
+        h = _conv1d(x, lp["in_w"], lp["in_b"], pad=pad, dilation=d)
+        if gl is not None:
+            g = gl[:, i * 2 * C:(i + 1) * 2 * C]
+        else:
+            g = jnp.zeros_like(h)
+        ht = jnp.tanh(h[:, :C] + g[:, :C]) * jax.nn.sigmoid(
+            h[:, C:] + g[:, C:])
+        rs = _conv1d(ht, lp["rs_w"], lp["rs_b"])
+        if i < len(p["layers"]) - 1:
+            x = (x + rs[:, :C]) * mask
+            out = out + rs[:, C:]
+        else:
+            out = out + rs
+    return out * mask
+
+
+def flow_reverse(spec: VitsSpec, p: VitsParams, z, mask, cond=None):
+    """Residual coupling block reversed (mean-only couplings)."""
+    half = spec.flow_size // 2
+    for fp in reversed(p):
+        z = jnp.flip(z, 1)
+        first, second = z[:, :half], z[:, half:]
+        h = _conv1d(first, fp["pre_w"], fp["pre_b"]) * mask
+        h = _wavenet(spec, fp["wn"], h, mask, cond)
+        mean = _conv1d(h, fp["post_w"], fp["post_b"]) * mask
+        second = (second - mean) * mask
+        z = jnp.concatenate([first, second], 1)
+    return z
+
+
+# ------------------------------------------------------------- hifigan
+
+
+def hifigan(spec: VitsSpec, p: VitsParams, spectro, cond=None):
+    """spectrogram [B, flow_size, T] -> waveform [B, T*upsample_factor]."""
+    x = _conv1d(spectro, p["pre_w"], p["pre_b"], pad=3)
+    if cond is not None:
+        x = x + _conv1d(cond, p["cond_w"], p["cond_b"])
+    nk = len(spec.resblock_kernels)
+    for i, (r, k) in enumerate(zip(spec.upsample_rates,
+                                   spec.upsample_kernels)):
+        x = jnp.where(x >= 0, x, x * spec.leaky_slope)
+        up = p["ups"][i]
+        x = _conv_transpose1d(x, up["w"], up["b"], r, (k - r) // 2)
+        acc = None
+        for j in range(nk):
+            rb = p["resblocks"][i * nk + j]
+            h = x
+            kk = spec.resblock_kernels[j]
+            for c1, c2, d in zip(rb["c1"], rb["c2"],
+                                 spec.resblock_dilations[j]):
+                t = jnp.where(h >= 0, h, h * spec.leaky_slope)
+                t = _conv1d(t, c1["w"], c1["b"], pad=d * (kk - 1) // 2,
+                            dilation=d)
+                t = jnp.where(t >= 0, t, t * spec.leaky_slope)
+                t = _conv1d(t, c2["w"], c2["b"], pad=(kk - 1) // 2)
+                h = h + t
+            acc = h if acc is None else acc + h
+        x = acc / nk
+    x = jnp.where(x >= 0, x, x * 0.01)  # functional default slope
+    x = _conv1d(x, p["post_w"], None, pad=3)
+    return jnp.tanh(x)[:, 0]
+
+
+# ------------------------------------------------------------ synthesis
+
+
+def synthesize(spec: VitsSpec, p: VitsParams, ids: np.ndarray,
+               *, noise_scale: Optional[float] = None,
+               noise_scale_duration: Optional[float] = None,
+               speaking_rate: Optional[float] = None,
+               seed: int = 0) -> np.ndarray:
+    """Full VITS inference for one utterance; returns waveform f32 [n].
+
+    The duration-dependent length regulation runs host-side (numpy), the
+    heavy graph pieces run in JAX — batch-1 TTS is latency-, not
+    throughput-bound, and this keeps every piece shape-static."""
+    ns = spec.noise_scale if noise_scale is None else noise_scale
+    nsd = (spec.noise_scale_duration if noise_scale_duration is None
+           else noise_scale_duration)
+    rate = spec.speaking_rate if speaking_rate is None else speaking_rate
+    rng = np.random.default_rng(seed)
+
+    ids_j = jnp.asarray(ids[None], jnp.int32)
+    T = ids.shape[0]
+    mask = jnp.ones((1, T), jnp.float32)
+    hidden, means, log_var = text_encoder(spec, p["text_encoder"], ids_j,
+                                          mask)
+    mask_c = mask[:, None, :]
+    dnoise = jnp.asarray(
+        rng.standard_normal((1, 2, T)).astype(np.float32) * nsd)
+    log_dur = duration_reverse(spec, p["duration"], hidden, mask_c, dnoise)
+    dur = np.ceil(np.exp(np.asarray(log_dur[0, 0])) * rate ** -1)
+    dur = np.maximum(dur, 0).astype(np.int64)
+    frames = int(max(dur.sum(), 1))
+
+    # length regulation: repeat each phone's prior stats by its duration
+    idx = np.repeat(np.arange(T), dur)
+    means_e = np.asarray(means[0])[idx]  # [frames, F]
+    logv_e = np.asarray(log_var[0])[idx]
+
+    z = means_e + rng.standard_normal(means_e.shape).astype(np.float32) \
+        * np.exp(logv_e) * ns
+    z = jnp.asarray(z.T[None])  # [1, F, frames]
+    fmask = jnp.ones((1, 1, frames), jnp.float32)
+    latents = flow_reverse(spec, p["flow"], z, fmask)
+    wave = hifigan(spec, p["decoder"], latents)
+    return np.asarray(wave[0], np.float32)
+
+
+# --------------------------------------------------------------- loader
+
+
+def load_vits(model_dir: str) -> tuple[VitsSpec, VitsParams]:
+    """Load an HF VitsModel checkpoint directory (config.json +
+    safetensors/bin) into the nested param dict this module consumes.
+    WaveNet conv weights are stored weight-normed
+    (parametrizations.weight.original0/1 or weight_g/weight_v) and are
+    reconstructed to plain weights here."""
+    from .hf_loader import load_hf_state
+
+    config, get, names = load_hf_state(model_dir)
+    spec = vits_spec_from_hf(config)
+    nameset = set(names)
+
+    def t(name):
+        return np.asarray(get(name), np.float32)
+
+    def wn_weight(prefix):
+        # weight-norm: w = g * v / ||v|| (norm over dims 1..)
+        for g_n, v_n in ((prefix + ".parametrizations.weight.original0",
+                          prefix + ".parametrizations.weight.original1"),
+                         (prefix + ".weight_g", prefix + ".weight_v")):
+            if g_n in nameset:
+                g, v = t(g_n), t(v_n)
+                norm = np.sqrt((v ** 2).sum(axis=tuple(range(1, v.ndim)),
+                                            keepdims=True))
+                return g * v / np.maximum(norm, 1e-12)
+        return t(prefix + ".weight")
+
+    def conv(prefix, bias=True, weightnorm=False):
+        w = wn_weight(prefix) if weightnorm else t(prefix + ".weight")
+        out = {"w": jnp.asarray(w)}
+        if bias and prefix + ".bias" in nameset:
+            out["b"] = jnp.asarray(t(prefix + ".bias"))
+        else:
+            out["b"] = None
+        return out
+
+    p: VitsParams = {}
+
+    # text encoder
+    enc = {"embed": jnp.asarray(t("text_encoder.embed_tokens.weight")),
+           "proj_w": jnp.asarray(t("text_encoder.project.weight")),
+           "proj_b": jnp.asarray(t("text_encoder.project.bias")),
+           "layers": []}
+    for i in range(spec.n_layers):
+        lp = f"text_encoder.encoder.layers.{i}."
+        enc["layers"].append({
+            "wq": jnp.asarray(t(lp + "attention.q_proj.weight")),
+            "bq": jnp.asarray(t(lp + "attention.q_proj.bias")),
+            "wk": jnp.asarray(t(lp + "attention.k_proj.weight")),
+            "bk": jnp.asarray(t(lp + "attention.k_proj.bias")),
+            "wv": jnp.asarray(t(lp + "attention.v_proj.weight")),
+            "bv": jnp.asarray(t(lp + "attention.v_proj.bias")),
+            "wo": jnp.asarray(t(lp + "attention.out_proj.weight")),
+            "bo": jnp.asarray(t(lp + "attention.out_proj.bias")),
+            "emb_rel_k": jnp.asarray(t(lp + "attention.emb_rel_k")),
+            "emb_rel_v": jnp.asarray(t(lp + "attention.emb_rel_v")),
+            "ln1_w": jnp.asarray(t(lp + "layer_norm.weight")),
+            "ln1_b": jnp.asarray(t(lp + "layer_norm.bias")),
+            "ff1_w": jnp.asarray(t(lp + "feed_forward.conv_1.weight")),
+            "ff1_b": jnp.asarray(t(lp + "feed_forward.conv_1.bias")),
+            "ff2_w": jnp.asarray(t(lp + "feed_forward.conv_2.weight")),
+            "ff2_b": jnp.asarray(t(lp + "feed_forward.conv_2.bias")),
+            "ln2_w": jnp.asarray(t(lp + "final_layer_norm.weight")),
+            "ln2_b": jnp.asarray(t(lp + "final_layer_norm.bias")),
+        })
+    p["text_encoder"] = enc
+
+    def dds(prefix, n):
+        out = []
+        for i in range(n):
+            out.append({
+                "dw_w": jnp.asarray(t(f"{prefix}.convs_dilated.{i}.weight")),
+                "dw_b": jnp.asarray(t(f"{prefix}.convs_dilated.{i}.bias")),
+                "pw_w": jnp.asarray(
+                    t(f"{prefix}.convs_pointwise.{i}.weight")),
+                "pw_b": jnp.asarray(t(f"{prefix}.convs_pointwise.{i}.bias")),
+                "n1_w": jnp.asarray(t(f"{prefix}.norms_1.{i}.weight")),
+                "n1_b": jnp.asarray(t(f"{prefix}.norms_1.{i}.bias")),
+                "n2_w": jnp.asarray(t(f"{prefix}.norms_2.{i}.weight")),
+                "n2_b": jnp.asarray(t(f"{prefix}.norms_2.{i}.bias")),
+            })
+        return out
+
+    dp = "duration_predictor"
+    dur: VitsParams = {
+        "pre_w": jnp.asarray(t(f"{dp}.conv_pre.weight")),
+        "pre_b": jnp.asarray(t(f"{dp}.conv_pre.bias")),
+        "proj_w": jnp.asarray(t(f"{dp}.conv_proj.weight")),
+        "proj_b": jnp.asarray(t(f"{dp}.conv_proj.bias")),
+        "dds": dds(f"{dp}.conv_dds", spec.dp_layers),
+        "affine": {
+            "translate": jnp.asarray(t(f"{dp}.flows.0.translate")),
+            "log_scale": jnp.asarray(t(f"{dp}.flows.0.log_scale")),
+        },
+        "flows": [],
+    }
+    if f"{dp}.cond.weight" in nameset:
+        dur["cond_w"] = jnp.asarray(t(f"{dp}.cond.weight"))
+        dur["cond_b"] = jnp.asarray(t(f"{dp}.cond.bias"))
+    for i in range(1, spec.dp_flows + 1):
+        fp = f"{dp}.flows.{i}"
+        dur["flows"].append({
+            "pre_w": jnp.asarray(t(f"{fp}.conv_pre.weight")),
+            "pre_b": jnp.asarray(t(f"{fp}.conv_pre.bias")),
+            "proj_w": jnp.asarray(t(f"{fp}.conv_proj.weight")),
+            "proj_b": jnp.asarray(t(f"{fp}.conv_proj.bias")),
+            "dds": dds(f"{fp}.conv_dds", spec.dp_layers),
+        })
+    p["duration"] = dur
+
+    def wavenet(prefix, n_layers):
+        out = {"layers": []}
+        if f"{prefix}.cond_layer.bias" in nameset or \
+                f"{prefix}.cond_layer.parametrizations.weight.original0" \
+                in nameset:
+            out["cond_w"] = jnp.asarray(wn_weight(f"{prefix}.cond_layer"))
+            out["cond_b"] = jnp.asarray(t(f"{prefix}.cond_layer.bias"))
+        for i in range(n_layers):
+            out["layers"].append({
+                "in_w": jnp.asarray(wn_weight(f"{prefix}.in_layers.{i}")),
+                "in_b": jnp.asarray(t(f"{prefix}.in_layers.{i}.bias")),
+                "rs_w": jnp.asarray(
+                    wn_weight(f"{prefix}.res_skip_layers.{i}")),
+                "rs_b": jnp.asarray(t(f"{prefix}.res_skip_layers.{i}.bias")),
+            })
+        return out
+
+    flows = []
+    for i in range(spec.flow_layers):
+        fp = f"flow.flows.{i}"
+        flows.append({
+            "pre_w": jnp.asarray(t(f"{fp}.conv_pre.weight")),
+            "pre_b": jnp.asarray(t(f"{fp}.conv_pre.bias")),
+            "post_w": jnp.asarray(t(f"{fp}.conv_post.weight")),
+            "post_b": (jnp.asarray(t(f"{fp}.conv_post.bias"))
+                       if f"{fp}.conv_post.bias" in nameset else None),
+            "wn": wavenet(f"{fp}.wavenet", spec.wn_layers),
+        })
+    p["flow"] = flows
+
+    dec: VitsParams = {
+        "pre_w": jnp.asarray(t("decoder.conv_pre.weight")),
+        "pre_b": jnp.asarray(t("decoder.conv_pre.bias")),
+        "post_w": jnp.asarray(t("decoder.conv_post.weight")),
+        "ups": [], "resblocks": [],
+    }
+    if "decoder.cond.weight" in nameset:
+        dec["cond_w"] = jnp.asarray(t("decoder.cond.weight"))
+        dec["cond_b"] = jnp.asarray(t("decoder.cond.bias"))
+    for i in range(len(spec.upsample_rates)):
+        dec["ups"].append({
+            "w": jnp.asarray(t(f"decoder.upsampler.{i}.weight")),
+            "b": jnp.asarray(t(f"decoder.upsampler.{i}.bias")),
+        })
+    n_res = len(spec.upsample_rates) * len(spec.resblock_kernels)
+    for i in range(n_res):
+        rp = f"decoder.resblocks.{i}"
+        n_d = len(spec.resblock_dilations[i % len(spec.resblock_kernels)])
+        dec["resblocks"].append({
+            "c1": [conv(f"{rp}.convs1.{j}") for j in range(n_d)],
+            "c2": [conv(f"{rp}.convs2.{j}") for j in range(n_d)],
+        })
+    p["decoder"] = dec
+    return spec, p

@@ -121,7 +121,13 @@ async def _fetch_media(part: dict, sess) -> bytes:
         raise web.HTTPBadRequest(reason="image part has no url")
     if url.startswith("data:"):
         b64 = url.split(",", 1)[-1]
-        return base64.b64decode(b64)
+        try:
+            out = base64.b64decode(b64)
+        except Exception:
+            raise web.HTTPBadRequest(reason="invalid data: URL base64")
+        if not out:
+            raise web.HTTPBadRequest(reason="empty data: URL")
+        return out
     if url.startswith(("http://", "https://")):
         async with sess.get(url) as resp:
             if resp.status != 200:
@@ -303,7 +309,11 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
     grammar = _grammar_for_request(cfg, body, tools)
 
     tokenizer = getattr(backend, "tokenizer", None)
-    media: list = []
+    # collect image parts only for backends with a vision tower; for
+    # text-only models image parts are dropped from the flattened text
+    # (no [img-N] markers, no downloads) as before
+    media: Optional[list] = (
+        [] if getattr(backend, "vision", None) is not None else None)
     prompt = st.evaluator.template_messages(
         cfg, messages, tokenizer=tokenizer,
         functions=tools or None, use_function_template=tools_requested,

@@ -127,10 +127,41 @@ def write_wav(path: str, audio: np.ndarray, sr: int = SR) -> None:
 
 
 class JaxTTSBackend(Backend):
+    """Neural VITS when the model dir holds an HF VitsModel checkpoint
+    (facebook/mms-tts-* class — the reference's piper engine IS a VITS
+    runtime, backend/go/tts/piper.go); formant-synth fallback otherwise
+    so `/tts` always works with zero model files."""
+
     def __init__(self) -> None:
         self._state = "UNINITIALIZED"
+        self._vits = None  # (spec, params, tokenizer-or-None)
 
     def load_model(self, opts: ModelLoadOptions) -> Result:
+        model_dir = opts.model
+        if model_dir and not os.path.isabs(model_dir):
+            model_dir = os.path.join(opts.model_path or "", model_dir)
+        cfg_path = os.path.join(model_dir or "", "config.json")
+        if model_dir and os.path.exists(cfg_path):
+            import json
+
+            try:
+                with open(cfg_path) as f:
+                    mtype = (json.load(f).get("model_type") or "").lower()
+                if mtype == "vits":
+                    from ..models.vits import load_vits
+
+                    spec, params = load_vits(model_dir)
+                    tok = None
+                    try:
+                        from transformers import AutoTokenizer
+
+                        tok = AutoTokenizer.from_pretrained(model_dir)
+                    except Exception:
+                        tok = None  # byte fallback below
+                    self._vits = (spec, params, tok)
+            except Exception as e:
+                self._state = "ERROR"
+                return Result(False, f"vits load failed: {e}")
         self._state = "READY"
         return Result(True, "tts ready")
 
@@ -140,8 +171,26 @@ class JaxTTSBackend(Backend):
     def status(self) -> StatusResponse:
         return StatusResponse(state=self._state)
 
+    def _vits_ids(self, text: str) -> np.ndarray:
+        spec, _, tok = self._vits
+        if tok is not None:
+            ids = tok(text)["input_ids"]
+            if ids:
+                return np.asarray(ids, np.int32)
+        return np.asarray(
+            [b % spec.vocab_size for b in text.encode()] or [0], np.int32)
+
     def tts(self, text: str, voice: str = "", dst: str = "",
             language: str = "") -> Result:
+        if self._vits is not None:
+            from ..models.vits import synthesize
+
+            spec, params, _ = self._vits
+            _, speed = VOICES.get(voice.lower(), VOICES[""])
+            audio = synthesize(spec, params, self._vits_ids(text),
+                               speaking_rate=spec.speaking_rate * speed)
+            write_wav(dst, audio, sr=spec.sampling_rate)
+            return Result(True, dst)
         pitch, speed = VOICES.get(voice.lower(), VOICES[""])
         audio = _render(_g2p(text), pitch, speed)
         write_wav(dst, audio)
