@@ -55,17 +55,19 @@ def main() -> None:
 
     def run(n_req: int, n_tok: int) -> tuple[int, float]:
         prompt = tok.encode("benchmark " * 12)
-        qs = [
-            eng.submit(GenRequest(
+        # one admission wave => deterministic prefill group shapes: the
+        # warmup run compiles exactly what the measured runs execute
+        qs = eng.submit_many([
+            GenRequest(
                 prompt_ids=prompt + [i % 200],
                 max_tokens=n_tok,
                 temperature=0.8,
                 top_k=40,
                 top_p=0.95,
                 ignore_eos=True,
-            ))
+            )
             for i in range(n_req)
-        ]
+        ])
         t0 = time.perf_counter()
         total = 0
         for q in qs:

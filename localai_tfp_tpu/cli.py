@@ -126,6 +126,18 @@ def build_parser() -> argparse.ArgumentParser:
     usub = util.add_subparsers(dest="util_command")
     usub.add_parser("version")
     usub.add_parser("new-token", help="generate a federation join token")
+    dl = usub.add_parser(
+        "download-assets",
+        help="download an asset list YAML (filename/url/sha256) into a "
+             "directory (ref: core/dependencies_manager)")
+    dl.add_argument("assets_yaml")
+    dl.add_argument("dest_dir")
+    fit = usub.add_parser(
+        "hbm-fit", help="estimate whether a checkpoint fits device memory")
+    fit.add_argument("model_dir")
+    fit.add_argument("--context-size", type=int, default=4096)
+    fit.add_argument("--batch-slots", type=int, default=8)
+    fit.add_argument("--dtype", default="bfloat16")
 
     return p
 
@@ -297,6 +309,43 @@ def main(argv: Optional[list[str]] = None) -> None:
             from .parallel.federated import generate_token
 
             print(generate_token())
+        elif args.util_command == "download-assets":
+            # ref: core/dependencies_manager/manager.go:19-40 — fetch a
+            # YAML list of {filename, url, sha256} into a directory
+            import yaml
+
+            from .gallery.downloader import URI
+
+            with open(args.assets_yaml) as f:
+                assets = yaml.safe_load(f) or []
+            os.makedirs(args.dest_dir, exist_ok=True)
+            if not isinstance(assets, list):
+                sys.exit(f"error: {args.assets_yaml} must be a YAML list "
+                         "of {filename, url, sha256} entries")
+            for a in assets:
+                if not isinstance(a, dict):
+                    print(f"skipping malformed asset entry: {a!r}")
+                    continue
+                name = a.get("filename") or a.get("name")
+                url = a.get("url") or a.get("uri")
+                if not name or not url:
+                    print(f"skipping malformed asset entry: {a!r}")
+                    continue
+                dst = os.path.join(args.dest_dir, name)
+                URI(url).download(
+                    dst, sha256=a.get("sha256") or a.get("sha") or "")
+                print(f"downloaded {name}")
+        elif args.util_command == "hbm-fit":
+            import json as _json
+
+            from .utils.sysinfo import estimate_model_bytes, fits_in_memory
+
+            est = estimate_model_bytes(
+                args.model_dir, dtype=args.dtype,
+                context_size=args.context_size,
+                batch_slots=args.batch_slots)
+            est["fits"] = fits_in_memory(args.model_dir, est=est)
+            print(_json.dumps(est, indent=2))
         else:
             from .version import __version__
 

@@ -845,24 +845,35 @@ class LLMEngine:
 
     def submit(self, req: GenRequest) -> queue.SimpleQueue:
         """Queue a request; returns the event stream queue."""
-        out: queue.SimpleQueue = queue.SimpleQueue()
-        if len(req.prompt_ids) >= self.max_seq:
-            out.put(StreamEvent(
-                done=True, finish_reason="error",
-                error=f"prompt ({len(req.prompt_ids)} tokens) exceeds context "
-                      f"size {self.max_seq}",
-            ))
-            return out
-        if not req.prompt_ids:
-            out.put(StreamEvent(done=True, finish_reason="error",
-                                error="empty prompt"))
-            return out
+        return self.submit_many([req])[0]
+
+    def submit_many(self, reqs: list[GenRequest]) -> list[queue.SimpleQueue]:
+        """Queue a burst of requests under ONE lock acquisition, so the
+        scheduler admits them as a single wave. Beyond fairness, this
+        makes the batched final-prefill group size deterministic (the
+        per-request submit path can race admission into odd-sized groups,
+        each a fresh jit shape)."""
+        outs: list[queue.SimpleQueue] = []
+        ok: list[tuple[GenRequest, queue.SimpleQueue]] = []
+        for req in reqs:
+            out: queue.SimpleQueue = queue.SimpleQueue()
+            outs.append(out)
+            if len(req.prompt_ids) >= self.max_seq:
+                out.put(StreamEvent(
+                    done=True, finish_reason="error",
+                    error=f"prompt ({len(req.prompt_ids)} tokens) exceeds "
+                          f"context size {self.max_seq}"))
+            elif not req.prompt_ids:
+                out.put(StreamEvent(done=True, finish_reason="error",
+                                    error="empty prompt"))
+            else:
+                ok.append((req, out))
         with self._lock:
-            self._pending.append((req, out))
+            self._pending.extend(ok)
             self._lock.notify_all()
         if self._autostart:
             self.start()
-        return out
+        return outs
 
     def generate(self, req: GenRequest) -> StreamEvent:
         """Blocking helper: drain the stream, return the final event."""

@@ -155,12 +155,14 @@ class Evaluator:
         """Assemble the full chat prompt (ref: evaluator.go TemplateMessages
         :128+). Precedence: tokenizer chat template (if requested or no
         explicit template), else per-message template + chat template."""
-        if media is not None:
-            messages = [
-                {**m, "content": _content_to_text(m.get("content"), media)}
-                if not isinstance(m.get("content"), str) else m
-                for m in messages
-            ]
+        # ALWAYS flatten part-list contents to strings (tokenizer chat
+        # templates choke on raw lists); media controls only whether image
+        # parts become [img-N] markers (collected) or are dropped
+        messages = [
+            {**m, "content": _content_to_text(m.get("content"), media)}
+            if not isinstance(m.get("content"), str) else m
+            for m in messages
+        ]
         use_tok = cfg.template.use_tokenizer_template or not (
             cfg.template.chat or cfg.template.chat_message
         )

@@ -88,6 +88,8 @@ async def system(request: web.Request) -> web.Response:
     """ref: endpoints/localai/system.go — loaded models + capabilities."""
     import jax
 
+    from ..utils.sysinfo import device_memory
+
     st = _state(request)
     try:
         devs = [str(d) for d in jax.devices()]
@@ -100,6 +102,9 @@ async def system(request: web.Request) -> web.Response:
         ),
         "loaded_models": st.model_loader.loaded_names(),
         "devices": devs,
+        # per-device HBM stats + model-fit surface (ref: pkg/xsysinfo
+        # GPU/VRAM enumeration behind /system)
+        "device_memory": device_memory(),
         "uptime_s": time.time() - st.started_at,
     })
 

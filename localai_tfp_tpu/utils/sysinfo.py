@@ -1,0 +1,115 @@
+"""Device + model memory introspection (ref: pkg/xsysinfo — CPU caps,
+GPU enumeration, VRAM-fit estimate for gguf, gguf.go:52). The TPU
+counterpart reports per-device HBM stats and estimates whether an HF
+checkpoint fits before committing to a load."""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Any, Optional
+
+_DTYPE_BYTES = {
+    "F64": 8, "F32": 4, "F16": 2, "BF16": 2,
+    "I64": 8, "I32": 4, "I16": 2, "I8": 1, "U8": 1, "BOOL": 1,
+}
+
+
+def device_memory() -> list[dict[str, Any]]:
+    """Per-device memory stats (bytes_limit/bytes_in_use when the backend
+    exposes them — TPU does; CPU returns placeholders)."""
+    import jax
+
+    out = []
+    try:
+        devices = jax.devices()
+    except RuntimeError:
+        return out
+    for d in devices:
+        row: dict[str, Any] = {"id": d.id, "platform": d.platform,
+                               "kind": getattr(d, "device_kind", "")}
+        try:
+            stats = d.memory_stats() or {}
+            row["bytes_limit"] = int(stats.get("bytes_limit", 0))
+            row["bytes_in_use"] = int(stats.get("bytes_in_use", 0))
+        except Exception:
+            pass
+        out.append(row)
+    return out
+
+
+def _safetensors_param_count(path: str) -> int:
+    """Count ELEMENTS from a safetensors header WITHOUT reading the
+    payload (the header is a length-prefixed JSON index; per-tensor dtype
+    converts stored bytes to element counts)."""
+    with open(path, "rb") as f:
+        (n,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(n))
+    total = 0
+    for name, meta in header.items():
+        if name == "__metadata__":
+            continue
+        a, b = meta["data_offsets"]
+        per = _DTYPE_BYTES.get(str(meta.get("dtype", "F32")).upper(), 4)
+        total += (b - a) // per
+    return total
+
+
+def estimate_model_bytes(model_dir: str, dtype: str = "bfloat16",
+                         context_size: int = 4096,
+                         batch_slots: int = 8) -> dict[str, int]:
+    """HBM footprint estimate for an HF checkpoint dir: element counts
+    from the safetensors headers times the SERVING dtype width (disk
+    dtype is irrelevant once loaded), KV cache at the given shape, and a
+    fudge for activations/compiler scratch (ref: xsysinfo gguf
+    VRAM-fit)."""
+    n_params = 0
+    for f in os.listdir(model_dir):
+        if f.endswith(".safetensors") and not f.startswith("."):
+            n_params += _safetensors_param_count(os.path.join(model_dir, f))
+        elif f.endswith(".bin") and "training" not in f:
+            # torch .bin shards are f32 by convention
+            n_params += os.path.getsize(os.path.join(model_dir, f)) // 4
+    per = 2 if dtype.lower() in ("bfloat16", "bf16", "float16", "f16") else 4
+    params = n_params * per
+    kv = 0
+    cfg_path = os.path.join(model_dir, "config.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+        if isinstance(cfg.get("text_config"), dict):
+            cfg = cfg["text_config"]
+        layers = int(cfg.get("num_hidden_layers") or 0)
+        heads = int(cfg.get("num_key_value_heads")
+                    or cfg.get("num_attention_heads") or 0)
+        d_head = int(cfg.get("head_dim")
+                     or (cfg.get("hidden_size") or 0)
+                     // max(cfg.get("num_attention_heads") or 1, 1))
+        kv = 2 * layers * batch_slots * context_size * heads * d_head * 2
+    total = params + kv
+    return {
+        "param_bytes": int(params),
+        "kv_cache_bytes": int(kv),
+        "overhead_bytes": int(total * 0.15),
+        "total_bytes": int(total * 1.15),
+    }
+
+
+def fits_in_memory(model_dir: str, dtype: str = "bfloat16",
+                   context_size: int = 4096,
+                   batch_slots: int = 8,
+                   est: Optional[dict[str, int]] = None) -> Optional[bool]:
+    """True/False when device memory limits are known, None otherwise.
+    Pass a precomputed ``est`` to skip re-reading the checkpoint headers."""
+    try:
+        if est is None:
+            est = estimate_model_bytes(model_dir, dtype, context_size,
+                                       batch_slots)
+    except Exception:
+        return None
+    limits = [d.get("bytes_limit", 0) for d in device_memory()]
+    usable = sum(x for x in limits if x)
+    if not usable:
+        return None
+    return est["total_bytes"] <= usable

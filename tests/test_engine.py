@@ -237,3 +237,28 @@ def test_sampled_generation_terminates(model):
     eng.close()
     assert ev.finish_reason == "length"
     assert ev.completion_tokens == 10
+
+
+def test_submit_many_single_wave(model):
+    eng = _engine(model)
+    eng.start()
+    try:
+        good = [GenRequest(prompt_ids=[2, 5, 9], max_tokens=4,
+                           ignore_eos=True) for _ in range(3)]
+        bad = [GenRequest(prompt_ids=[], max_tokens=4),
+               GenRequest(prompt_ids=list(range(500)), max_tokens=4)]
+        qs = eng.submit_many(good + bad)
+        assert len(qs) == 5
+        outs = []
+        for q in qs:
+            while True:
+                ev = q.get(timeout=60)
+                if ev.done:
+                    outs.append(ev)
+                    break
+        assert all(o.finish_reason == "length" for o in outs[:3])
+        assert all(o.finish_reason == "error" for o in outs[3:])
+        # identical prompts in one wave must produce identical greedy text
+        assert outs[0].full_text == outs[1].full_text == outs[2].full_text
+    finally:
+        eng.close()

@@ -1,0 +1,76 @@
+"""xsysinfo parity: HBM-fit estimation + /system device memory + the
+dependencies-manager asset downloader (ref: pkg/xsysinfo gguf.go:52,
+core/dependencies_manager/manager.go)."""
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+
+def _tiny_ckpt(tmp_path):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    d = tmp_path / "ckpt"
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256,
+    )).save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+def test_estimate_model_bytes(tmp_path):
+    from localai_tfp_tpu.utils.sysinfo import estimate_model_bytes
+
+    d = _tiny_ckpt(tmp_path)
+    est = estimate_model_bytes(d, context_size=256, batch_slots=2)
+    # tiny checkpoint is f32 on disk; serving at bf16 halves the bytes
+    disk = sum(os.path.getsize(os.path.join(d, f))
+               for f in os.listdir(d) if f.endswith(".safetensors"))
+    assert 0 < est["param_bytes"] < disk
+    est32 = estimate_model_bytes(d, dtype="float32",
+                                 context_size=256, batch_slots=2)
+    assert est32["param_bytes"] == 2 * est["param_bytes"]
+    # KV: 2 * L2 * slots2 * ctx256 * kv(2*16) * 2B
+    assert est["kv_cache_bytes"] == 2 * 2 * 2 * 256 * 32 * 2
+    assert est["total_bytes"] > est["param_bytes"]
+
+
+def test_device_memory_reports_rows():
+    from localai_tfp_tpu.utils.sysinfo import device_memory
+
+    rows = device_memory()
+    assert rows and all("platform" in r for r in rows)
+
+
+def test_cli_download_assets(tmp_path):
+    import yaml
+
+    from localai_tfp_tpu.cli import main
+
+    src = tmp_path / "asset.bin"
+    payload = b"hello assets"
+    src.write_bytes(payload)
+    sha = hashlib.sha256(payload).hexdigest()
+    lst = tmp_path / "assets.yaml"
+    lst.write_text(yaml.safe_dump([
+        {"filename": "asset.bin", "url": f"file://{src}", "sha256": sha},
+        {"bogus": True},
+    ]))
+    dest = tmp_path / "out"
+    main(["util", "download-assets", str(lst), str(dest)])
+    assert (dest / "asset.bin").read_bytes() == payload
+
+
+def test_cli_hbm_fit(tmp_path, capsys):
+    from localai_tfp_tpu.cli import main
+
+    d = _tiny_ckpt(tmp_path)
+    main(["util", "hbm-fit", d, "--context-size", "256",
+          "--batch-slots", "2"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["total_bytes"] > 0 and "fits" in out

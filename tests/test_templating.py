@@ -137,3 +137,22 @@ def test_function_template_used_for_tools():
         functions=[{"name": "f1"}], use_function_template=True,
     )
     assert got == "F(1):m"
+
+
+def test_part_list_content_flattens_without_media():
+    """Text-only backends (media=None) must still flatten multimodal part
+    lists to strings — tokenizer chat templates choke on raw lists."""
+    from localai_tfp_tpu.config.model_config import ModelConfig
+    from localai_tfp_tpu.engine.templating import Evaluator
+
+    cfg = ModelConfig(name="m")
+    cfg.template.chat_message = "{{.RoleName}}: {{.Content}}"
+    cfg.template.chat = "{{.Input}}"
+    out = Evaluator().template_messages(cfg, [
+        {"role": "user", "content": [
+            {"type": "text", "text": "hello"},
+            {"type": "image_url", "image_url": {"url": "data:x"}},
+        ]},
+    ])
+    assert "hello" in out
+    assert "[img-" not in out and "image_url" not in out
