@@ -227,7 +227,19 @@ async def sound_generation(request: web.Request) -> web.Response:
 
     dst = os.path.join(st.config.generated_content_dir,
                        f"sound-{_uuid.uuid4().hex}.wav")
-    res = backend.sound_generation(text=body.get("text", ""), dst=dst)
+    dur = body.get("duration_seconds")
+    if dur is None:
+        dur = body.get("duration")
+    temp = body.get("temperature")
+    res = await asyncio.get_running_loop().run_in_executor(
+        None, lambda: backend.sound_generation(
+            text=body.get("text", ""), dst=dst,
+            duration=dur,
+            temperature=1.0 if temp is None else float(temp),
+            # explicit temperature 0 means deterministic, not "unset"
+            do_sample=body.get("do_sample",
+                               temp is None or float(temp) > 0),
+        ))
     if not res.success:
         raise web.HTTPInternalServerError(reason=res.message)
     return web.FileResponse(dst)

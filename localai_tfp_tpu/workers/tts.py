@@ -105,6 +105,15 @@ def _render(phonemes: list[str], pitch_hz: float, speed: float) -> np.ndarray:
     return (audio / peak * 0.8).astype(np.float32)
 
 
+def _try_tokenizer(model_dir: str):
+    try:
+        from transformers import AutoTokenizer
+
+        return AutoTokenizer.from_pretrained(model_dir)
+    except Exception:
+        return None  # byte fallback at call sites
+
+
 VOICES = {  # voice id -> (pitch_hz, speed)
     "": (120.0, 1.0),
     "alloy": (120.0, 1.0),
@@ -135,6 +144,7 @@ class JaxTTSBackend(Backend):
     def __init__(self) -> None:
         self._state = "UNINITIALIZED"
         self._vits = None  # (spec, params, tokenizer-or-None)
+        self._musicgen = None  # (bundle, tokenizer-or-None)
 
     def load_model(self, opts: ModelLoadOptions) -> Result:
         model_dir = opts.model
@@ -144,6 +154,7 @@ class JaxTTSBackend(Backend):
         if model_dir and os.path.exists(cfg_path):
             import json
 
+            mtype = ""
             try:
                 with open(cfg_path) as f:
                     mtype = (json.load(f).get("model_type") or "").lower()
@@ -151,17 +162,17 @@ class JaxTTSBackend(Backend):
                     from ..models.vits import load_vits
 
                     spec, params = load_vits(model_dir)
-                    tok = None
-                    try:
-                        from transformers import AutoTokenizer
+                    self._vits = (spec, params, _try_tokenizer(model_dir))
+                elif mtype == "musicgen":
+                    # ref: transformers backend SoundGeneration :452 —
+                    # MusicgenForConditionalGeneration
+                    from ..models.musicgen import load_musicgen
 
-                        tok = AutoTokenizer.from_pretrained(model_dir)
-                    except Exception:
-                        tok = None  # byte fallback below
-                    self._vits = (spec, params, tok)
+                    self._musicgen = (load_musicgen(model_dir),
+                                      _try_tokenizer(model_dir))
             except Exception as e:
                 self._state = "ERROR"
-                return Result(False, f"vits load failed: {e}")
+                return Result(False, f"{mtype or 'tts'} load failed: {e}")
         self._state = "READY"
         return Result(True, "tts ready")
 
@@ -197,10 +208,39 @@ class JaxTTSBackend(Backend):
         return Result(True, dst)
 
     def sound_generation(self, text: str, dst: str = "", **kw) -> Result:
-        """Procedural sound-effect synthesis (ref: ElevenLabs
-        /v1/sound-generation, served by MusicGen in the reference —
-        transformers/backend.py:452): seeded noise-band + envelope texture
-        derived from the prompt hash, so identical prompts reproduce."""
+        """Neural MusicGen when a musicgen checkpoint is loaded (ref:
+        ElevenLabs /v1/sound-generation, served by MusicGen in the
+        reference — transformers/backend.py:452); otherwise a procedural
+        seeded noise-band texture so the endpoint works with zero model
+        files."""
+        if self._musicgen is not None:
+            from ..models.musicgen import mg_generate
+
+            bundle, tok = self._musicgen
+            meta = bundle[6]
+            if tok is not None:
+                ids = np.asarray(tok(text)["input_ids"], np.int32)
+            else:
+                t5_vocab = bundle[0].vocab_size
+                ids = np.asarray(
+                    [b % t5_vocab for b in text.encode()] or [0], np.int32)
+            dur = float(kw.get("duration") or 5.0)
+            # cap the clip: step cost grows superlinearly in frames (no
+            # KV cache yet) and logits scale with the padded prefix — an
+            # uncapped client duration would be a one-request DoS
+            dur = min(max(dur, 0.0), 30.0)
+            frames = max(int(dur * meta["frame_rate"]), 8)
+            audio = mg_generate(
+                bundle, ids,
+                max_new_tokens=frames + bundle[2].n_codebooks - 1,
+                do_sample=bool(kw.get("do_sample", True)),
+                temperature=float(1.0 if kw.get("temperature") is None
+                                  else kw["temperature"]),
+                guidance_scale=float(kw.get("guidance_scale") or 3.0),
+                seed=int(kw.get("seed") or 0),
+            )
+            write_wav(dst, audio, sr=meta["sampling_rate"])
+            return Result(True, dst)
         import hashlib
 
         seed = int.from_bytes(

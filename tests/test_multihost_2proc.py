@@ -78,7 +78,11 @@ def test_two_process_leader_follower_bitwise_identical(tmp_path):
                         "PALLAS_AXON_POOL_IPS")}
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = repo
-    port = "19741"
+    import socket
+
+    with socket.socket() as s:  # ephemeral port: concurrent runs must
+        s.bind(("127.0.0.1", 0))  # not collide on a fixed coordinator
+        port = str(s.getsockname()[1])
     procs = [subprocess.Popen(
         [sys.executable, str(script), str(i), port],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
