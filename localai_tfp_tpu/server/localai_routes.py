@@ -17,6 +17,7 @@ from aiohttp import web
 from ..config.model_config import Usecase
 from ..version import __version__
 from ..workers.base import PredictOptions
+from . import schema
 from .state import Application
 
 
@@ -197,6 +198,7 @@ async def _tts_impl(request: web.Request, text: str, model_name,
 async def tts(request: web.Request) -> web.Response:
     """ref: routes/localai.go:41 POST /tts."""
     body = await _body(request)
+    schema.TTSRequest.validate(body)
     return await _tts_impl(
         request, body.get("input", ""), body.get("model"),
         body.get("voice", ""), body.get("language", ""),
@@ -214,6 +216,7 @@ async def tts_elevenlabs(request: web.Request) -> web.Response:
 
 async def sound_generation(request: web.Request) -> web.Response:
     body = await _body(request)
+    req = schema.SoundGenerationRequest.validate(body)
     st = _state(request)
     cfg = st.config_loader.resolve(body.get("model_id"),
                                    Usecase.SOUND_GENERATION)
@@ -227,18 +230,16 @@ async def sound_generation(request: web.Request) -> web.Response:
 
     dst = os.path.join(st.config.generated_content_dir,
                        f"sound-{_uuid.uuid4().hex}.wav")
-    dur = body.get("duration_seconds")
-    if dur is None:
-        dur = body.get("duration")
-    temp = body.get("temperature")
     res = await asyncio.get_running_loop().run_in_executor(
         None, lambda: backend.sound_generation(
-            text=body.get("text", ""), dst=dst,
-            duration=dur,
-            temperature=1.0 if temp is None else float(temp),
+            text=req.text, dst=dst,
+            duration=req.duration,
+            temperature=1.0 if req.temperature is None
+            else req.temperature,
             # explicit temperature 0 means deterministic, not "unset"
             do_sample=body.get("do_sample",
-                               temp is None or float(temp) > 0),
+                               req.temperature is None
+                               or req.temperature > 0),
         ))
     if not res.success:
         raise web.HTTPInternalServerError(reason=res.message)
@@ -264,6 +265,7 @@ async def vad(request: web.Request) -> web.Response:
 async def rerank(request: web.Request) -> web.Response:
     """ref: jina/rerank.go — Jina-compatible POST /v1/rerank."""
     body = await _body(request)
+    schema.RerankRequest.validate(body)
     st = _state(request)
     cfg = st.config_loader.resolve(body.get("model"), Usecase.RERANK)
     if cfg is None:

@@ -25,6 +25,7 @@ from ..config.model_config import ModelConfig, Usecase
 from ..grammars.json_schema import functions_grammar, schema_to_gbnf
 from ..grammars.parse import parse_function_call, parse_text_content
 from ..workers.base import Backend, PredictOptions, Reply
+from . import schema
 from .state import Application
 
 
@@ -298,6 +299,7 @@ async def _run_predict(backend: Backend, opts: PredictOptions) -> Reply:
 async def chat_completions(request: web.Request) -> web.StreamResponse:
     st = _state(request)
     body = await _body(request)
+    schema.ChatCompletionRequest.validate(body)  # typed 400s (core/schema)
     cfg = _resolve_config(request, body, Usecase.CHAT)
     backend = await _load_backend(request, cfg)
 
@@ -478,6 +480,7 @@ async def _stream_chat(
 async def completions(request: web.Request) -> web.StreamResponse:
     st = _state(request)
     body = await _body(request)
+    schema.CompletionRequest.validate(body)
     cfg = _resolve_config(request, body, Usecase.COMPLETION)
     backend = await _load_backend(request, cfg)
 
@@ -609,6 +612,7 @@ async def _stream_completion(request, backend, opts, cfg, cid, created,
 async def edits(request: web.Request) -> web.Response:
     st = _state(request)
     body = await _body(request)
+    schema.EditRequest.validate(body)
     cfg = _resolve_config(request, body, Usecase.EDIT)
     backend = await _load_backend(request, cfg)
 
@@ -643,6 +647,7 @@ async def edits(request: web.Request) -> web.Response:
 async def embeddings(request: web.Request) -> web.Response:
     st = _state(request)
     body = await _body(request)
+    schema.EmbeddingsRequest.validate(body)
     cfg = _resolve_config(request, body, Usecase.EMBEDDINGS)
     backend = await _load_backend(request, cfg)
 

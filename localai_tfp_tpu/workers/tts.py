@@ -224,7 +224,8 @@ class JaxTTSBackend(Backend):
                 t5_vocab = bundle[0].vocab_size
                 ids = np.asarray(
                     [b % t5_vocab for b in text.encode()] or [0], np.int32)
-            dur = float(kw.get("duration") or 5.0)
+            dur = kw.get("duration")
+            dur = 5.0 if dur is None else float(dur)
             # cap the clip: step cost grows superlinearly in frames (no
             # KV cache yet) and logits scale with the padded prefix — an
             # uncapped client duration would be a one-request DoS

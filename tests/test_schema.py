@@ -1,0 +1,65 @@
+"""Typed request validation (ref: core/schema request structs): malformed
+bodies must 400 with the offending field named, not 500 from deep inside
+an endpoint."""
+
+import pytest
+from aiohttp import web
+
+from localai_tfp_tpu.server import schema
+
+
+def test_chat_request_valid():
+    req = schema.ChatCompletionRequest.validate({
+        "messages": [{"role": "user", "content": "hi"},
+                     {"role": "user", "content": [{"type": "text",
+                                                   "text": "x"}]}],
+        "temperature": 0.5, "max_tokens": 10, "stop": ["a"],
+        "logit_bias": {"5": -100},
+    })
+    assert len(req.messages) == 2
+
+
+@pytest.mark.parametrize("body", [
+    {},  # missing messages
+    {"messages": "hi"},
+    {"messages": [{"role": 3, "content": "x"}]},
+    {"messages": [{"content": 42}]},
+    {"messages": [{"content": "x"}], "temperature": "hot"},
+    {"messages": [{"content": "x"}], "max_tokens": 1.5},
+    {"messages": [{"content": "x"}], "max_tokens": True},
+    {"messages": [{"content": "x"}], "stop": [1]},
+    {"messages": [{"content": "x"}], "logit_bias": [1]},
+    {"messages": [{"content": "x"}], "stream": "yes"},
+    {"messages": [{"content": "x"}], "tools": "t"},
+])
+def test_chat_request_invalid(body):
+    with pytest.raises(web.HTTPBadRequest):
+        schema.ChatCompletionRequest.validate(body)
+
+
+def test_completion_and_embeddings_and_rerank():
+    schema.CompletionRequest.validate({"prompt": ["a", "b"], "top_k": 4})
+    schema.EmbeddingsRequest.validate({"input": ["x", "y"]})
+    schema.RerankRequest.validate({"query": "q", "documents": ["d"],
+                                   "top_n": 1})
+    for body, cls in [
+        ({"prompt": {"bad": 1}}, schema.CompletionRequest),
+        ({"input": 42}, schema.EmbeddingsRequest),
+        ({"query": 1, "documents": ["d"]}, schema.RerankRequest),
+        ({"query": "q", "documents": "d"}, schema.RerankRequest),
+        ({"query": "q", "documents": ["d"], "top_n": "one"},
+         schema.RerankRequest),
+    ]:
+        with pytest.raises(web.HTTPBadRequest):
+            cls.validate(body)
+
+
+def test_sound_generation_duration_aliases():
+    r = schema.SoundGenerationRequest.validate(
+        {"text": "x", "duration_seconds": 2.5})
+    assert r.duration == 2.5
+    r = schema.SoundGenerationRequest.validate(
+        {"text": "x", "duration": 1, "temperature": 0})
+    assert r.duration == 1.0 and r.temperature == 0.0
+    with pytest.raises(web.HTTPBadRequest):
+        schema.SoundGenerationRequest.validate({"duration_seconds": "long"})
