@@ -16,6 +16,7 @@ import hashlib
 import json
 import os
 import shutil
+import urllib.parse
 import urllib.request
 from dataclasses import dataclass
 from typing import Callable, Optional
@@ -76,7 +77,8 @@ class URI:
     # ---------------------------------------------------------- download
 
     def download(self, dst: str, sha256: str = "",
-                 progress: Optional[ProgressCb] = None) -> str:
+                 progress: Optional[ProgressCb] = None,
+                 headers: Optional[dict] = None) -> str:
         """Fetch to ``dst`` with ``.partial`` resume and sha verification
         (ref: uri.go DownloadFile: partial suffix, sha mismatch redownload).
         """
@@ -88,7 +90,7 @@ class URI:
         partial = dst + ".partial"
         os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
         offset = os.path.getsize(partial) if os.path.exists(partial) else 0
-        req = urllib.request.Request(url)
+        req = urllib.request.Request(url, headers=dict(headers or {}))
         if offset:
             req.add_header("Range", f"bytes={offset}-")
         mode = "ab" if offset else "wb"
@@ -132,12 +134,97 @@ def _sha256(path: str) -> str:
 OLLAMA_REGISTRY = "https://registry.ollama.ai"
 
 
+_MANIFEST_ACCEPT = (
+    "application/vnd.docker.distribution.manifest.v2+json,"
+    "application/vnd.oci.image.manifest.v1+json,"
+    "application/vnd.oci.image.index.v1+json,"
+    "application/vnd.docker.distribution.manifest.list.v2+json"
+)
+
+
+# registry origin -> bearer token, for the duration of the process: one
+# 401->token round trip per registry, not per request
+_TOKEN_CACHE: dict[str, str] = {}
+
+
+def _registry_token(registry: str) -> Optional[str]:
+    return _TOKEN_CACHE.get(registry)
+
+
+def _registry_get(url: str, accept: str = "", registry: str = "",
+                  retried: bool = False):
+    """GET with the OCI distribution bearer-token dance: a 401 carrying
+    Www-Authenticate: Bearer realm=...,service=...,scope=... fetches a
+    token from the realm, caches it per registry, and retries (ref:
+    pkg/oci via go-containerregistry, which does the same flow)."""
+    import urllib.error
+
+    headers = {}
+    if accept:
+        headers["Accept"] = accept
+    token = _registry_token(registry)
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(url, headers=headers)
+    try:
+        return urllib.request.urlopen(req)
+    except urllib.error.HTTPError as e:
+        if e.code != 401 or retried:
+            raise
+        challenge = e.headers.get("Www-Authenticate", "")
+        if not challenge.lower().startswith("bearer"):
+            raise
+        fields = dict(
+            part.split("=", 1)
+            for part in challenge[len("Bearer "):].split(",")
+            if "=" in part
+        )
+        realm = (fields.get("realm") or "").strip('"')
+        if not realm:
+            raise
+        q = []
+        for key in ("service", "scope"):
+            val = (fields.get(key) or "").strip('"')
+            if val:
+                q.append(f"{key}={urllib.parse.quote(val, safe=':/')}")
+        with urllib.request.urlopen(f"{realm}?{'&'.join(q)}") as tr:
+            tok = json.load(tr)
+        _TOKEN_CACHE[registry] = (tok.get("token")
+                                  or tok.get("access_token") or "")
+        return _registry_get(url, accept, registry, retried=True)
+
+
+def _resolve_manifest(registry: str, repo: str, ref: str) -> dict:
+    """Fetch a manifest; an image INDEX resolves to the linux/amd64 (or
+    first) platform manifest."""
+    url = f"{registry}/v2/{repo}/manifests/{ref}"
+    with _registry_get(url, _MANIFEST_ACCEPT, registry) as resp:
+        manifest = json.load(resp)
+    entries = manifest.get("manifests")
+    if entries:  # an index/manifest-list, not an image manifest
+        pick = None
+        for m in entries:
+            plat = m.get("platform") or {}
+            if plat.get("os") == "linux" and \
+                    plat.get("architecture") == "amd64":
+                pick = m
+                break
+        pick = pick or entries[0]
+        return _resolve_manifest(registry, repo, pick["digest"])
+    return manifest
+
+
 def pull_oci_model(raw: str, dst: str,
                    progress: Optional[ProgressCb] = None) -> str:
-    """Pull a model blob from an OCI registry. ollama://model[:tag] uses
-    the ollama registry's manifest schema (largest layer = the gguf blob);
-    oci://host/repo[:tag] takes the largest layer of a standard manifest.
-    """
+    """Pull a model from an OCI registry (ref: pkg/oci image.go:153
+    ExtractOCIImage + ollama.go:88 OllamaFetchModel).
+
+    ollama://model[:tag]: the layer whose mediaType is the ollama MODEL
+    layer (falling back to the largest) is the artifact. oci://host/
+    repo[:tag]: image indexes resolve by platform; a single-layer image
+    (the ORAS model-artifact convention) downloads that blob to ``dst``;
+    multi-layer images extract every tar layer into ``dst`` as a
+    directory (the image-filesystem case the reference extracts)."""
     if raw.startswith("ollama://"):
         name = raw[len("ollama://"):]
         tag = "latest"
@@ -148,24 +235,75 @@ def pull_oci_model(raw: str, dst: str,
         registry, repo = OLLAMA_REGISTRY, name
     else:
         body = raw[len("oci://"):]
+        scheme = "https"
+        if body.startswith(("http://", "https://")):  # explicit scheme
+            scheme, body = body.split("://", 1)
         tag = "latest"
-        if ":" in body.split("/")[-1]:
+        if "@" in body.split("/")[-1]:  # digest-pinned: repo@sha256:<hex>
+            body, tag = body.rsplit("@", 1)
+        elif ":" in body.split("/")[-1]:
             body, tag = body.rsplit(":", 1)
         host, _, repo = body.partition("/")
-        registry = f"https://{host}"
-    mani_url = f"{registry}/v2/{repo}/manifests/{tag}"
-    req = urllib.request.Request(mani_url, headers={
-        "Accept": "application/vnd.docker.distribution.manifest.v2+json,"
-                  "application/vnd.oci.image.manifest.v1+json",
-    })
-    with urllib.request.urlopen(req) as resp:
-        manifest = json.load(resp)
+        registry = f"{scheme}://{host}"
+    manifest = _resolve_manifest(registry, repo, tag)
     layers = manifest.get("layers") or []
     if not layers:
         raise ValueError(f"no layers in manifest for {raw}")
-    blob = max(layers, key=lambda l: l.get("size", 0))
-    digest = blob["digest"]
-    blob_url = f"{registry}/v2/{repo}/blobs/{digest}"
-    uri = URI(blob_url)
-    sha = digest.split(":", 1)[1] if digest.startswith("sha256:") else ""
-    return uri.download(dst, sha256=sha, progress=progress)
+
+    def blob_to(layer: dict, out: str) -> str:
+        # URI.download (resume + sha verify) carrying the registry's
+        # bearer token — registries require auth on blob fetches too
+        digest = layer["digest"]
+        sha = digest.split(":", 1)[1] if digest.startswith("sha256:") else ""
+        token = _registry_token(registry)
+        headers = {"Authorization": f"Bearer {token}"} if token else None
+        return URI(f"{registry}/v2/{repo}/blobs/{digest}").download(
+            out, sha256=sha, progress=progress, headers=headers)
+
+    if raw.startswith("ollama://"):
+        model = next(
+            (l for l in layers
+             if "model" in (l.get("mediaType") or "")), None,
+        ) or max(layers, key=lambda l: l.get("size", 0))
+        return blob_to(model, dst)
+    if len(layers) == 1:
+        return blob_to(layers[0], dst)
+    # multi-layer image: extract each tar layer into dst/ in order
+    import tarfile
+    import tempfile
+
+    os.makedirs(dst, exist_ok=True)
+    for layer in layers:
+        with tempfile.NamedTemporaryFile(delete=False) as tmp:
+            tmp_path = tmp.name
+        try:
+            blob_to(layer, tmp_path)
+            mode = "r:gz" if (layer.get("mediaType") or "").endswith(
+                ("gzip", "tar+gzip")) else "r:*"
+            with tarfile.open(tmp_path, mode) as tf:
+                for member in tf.getmembers():
+                    base = os.path.basename(member.name)
+                    if base.startswith(".wh."):
+                        # OCI whiteout: the upper layer deletes this path
+                        victim = os.path.join(os.path.dirname(
+                            os.path.join(dst, member.name)), base[4:])
+                        victim = os.path.realpath(victim)
+                        if victim.startswith(
+                                os.path.realpath(dst) + os.sep):
+                            if os.path.isdir(victim):
+                                shutil.rmtree(victim, ignore_errors=True)
+                            elif os.path.exists(victim):
+                                os.unlink(victim)
+                        continue
+                    try:
+                        # 'data' filter rejects abs paths, traversal,
+                        # escaping links and device nodes — the same
+                        # sanitization go-containerregistry applies
+                        tf.extract(member, dst, filter="data")
+                    except tarfile.FilterError:
+                        continue  # skip unsafe members, keep the rest
+        finally:
+            for leftover in (tmp_path, tmp_path + ".partial"):
+                if os.path.exists(leftover):
+                    os.unlink(leftover)
+    return dst

@@ -1,0 +1,187 @@
+"""OCI registry pulls against a LOCAL fake distribution server: bearer
+token auth, image-index platform resolution, ollama model-layer choice,
+and multi-layer tar extraction (ref: pkg/oci image.go/ollama.go; the
+reference tests these via go-containerregistry fakes)."""
+
+import gzip
+import hashlib
+import io
+import json
+import tarfile
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+
+def _tar_bytes(files: dict[str, bytes], gz: bool = False,
+               symlinks: dict[str, str] | None = None) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for name, data in files.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+        for name, target in (symlinks or {}).items():
+            info = tarfile.TarInfo(name)
+            info.type = tarfile.SYMTYPE
+            info.linkname = target
+            tf.addfile(info)
+    raw = buf.getvalue()
+    return gzip.compress(raw) if gz else raw
+
+
+@pytest.fixture(scope="module")
+def registry():
+    blobs: dict[str, bytes] = {}
+
+    def add_blob(data: bytes) -> dict:
+        digest = "sha256:" + hashlib.sha256(data).hexdigest()
+        blobs[digest] = data
+        return {"digest": digest, "size": len(data)}
+
+    model_blob = b"GGUF-fake-model-bytes"
+    small_blob = b"tiny"
+    layer1 = _tar_bytes({"config.json": b"{}"})
+    layer2 = _tar_bytes({"weights.bin": b"W" * 64,
+                         "../escape.txt": b"nope",
+                         ".wh.config.json": b""}, gz=True,
+                        symlinks={"evil.bin": "/etc/passwd"})
+
+    manifests = {}
+    # ollama: model layer by mediaType (NOT the largest)
+    big = add_blob(b"Z" * 100)
+    big["mediaType"] = "application/vnd.ollama.image.template"
+    mod = add_blob(model_blob)
+    mod["mediaType"] = "application/vnd.ollama.image.model"
+    manifests[("library/tinymodel", "latest")] = {
+        "schemaVersion": 2, "layers": [big, mod]}
+    # single-layer ORAS artifact
+    single = add_blob(small_blob)
+    manifests[("acme/artifact", "v1")] = {
+        "schemaVersion": 2, "layers": [single]}
+    # image index -> platform manifest -> multi tar layers
+    l1, l2 = add_blob(layer1), add_blob(layer2)
+    l2["mediaType"] = "application/vnd.oci.image.layer.v1.tar+gzip"
+    plat = {"schemaVersion": 2, "layers": [l1, l2]}
+    plat_bytes = json.dumps(plat).encode()
+    plat_digest = "sha256:" + hashlib.sha256(plat_bytes).hexdigest()
+    manifests[("acme/image", plat_digest)] = plat
+    manifests[("acme/image", "latest")] = {
+        "schemaVersion": 2,
+        "manifests": [
+            {"digest": "sha256:deadbeef",
+             "platform": {"os": "windows", "architecture": "amd64"}},
+            {"digest": plat_digest,
+             "platform": {"os": "linux", "architecture": "amd64"}},
+        ],
+    }
+
+    state = {"token_issued": 0}
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path.startswith("/token"):
+                state["token_issued"] += 1
+                body = json.dumps({"token": "tok123"}).encode()
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if self.headers.get("Authorization") != "Bearer tok123":
+                self.send_response(401)
+                self.send_header(
+                    "Www-Authenticate",
+                    f'Bearer realm="http://127.0.0.1:{port}/token",'
+                    f'service="reg",scope="repository:x:pull"')
+                self.end_headers()
+                return
+            parts = self.path.split("/")
+            # /v2/<repo...>/manifests/<ref> or /v2/<repo...>/blobs/<digest>
+            kind = parts[-2]
+            ref = parts[-1]
+            repo = "/".join(parts[2:-2])
+            if kind == "manifests":
+                m = manifests.get((repo, ref))
+                if m is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = json.dumps(m).encode()
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(body)
+            elif kind == "blobs":
+                data = blobs.get(ref)
+                if data is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    port = srv.server_port
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}", model_blob, small_blob, state
+    srv.shutdown()
+
+
+def test_ollama_pull_prefers_model_layer(registry, tmp_path, monkeypatch):
+    import localai_tfp_tpu.gallery.downloader as dl
+
+    base, model_blob, _, state = registry
+    monkeypatch.setattr(dl, "OLLAMA_REGISTRY", base)
+    out = dl.pull_oci_model("ollama://tinymodel", str(tmp_path / "m.gguf"))
+    assert open(out, "rb").read() == model_blob
+    assert state["token_issued"] >= 1  # bearer dance exercised
+
+
+def test_oci_single_layer_artifact(registry, tmp_path):
+    import localai_tfp_tpu.gallery.downloader as dl
+
+    base, _, small_blob, _ = registry
+    out = dl.pull_oci_model(f"oci://{base}/acme/artifact:v1",
+                            str(tmp_path / "artifact.bin"))
+    assert open(out, "rb").read() == small_blob
+
+
+def test_oci_index_multilayer_extracts(registry, tmp_path):
+    import localai_tfp_tpu.gallery.downloader as dl
+
+    base, *_ = registry
+    dst = tmp_path / "img"
+    out = dl.pull_oci_model(f"oci://{base}/acme/image:latest", str(dst))
+    assert (dst / "weights.bin").read_bytes() == b"W" * 64
+    assert not (tmp_path / "escape.txt").exists()  # traversal guard
+    assert not (dst / "config.json").exists()  # whiteout in upper layer
+    assert not (dst / ".wh.config.json").exists()  # marker not extracted
+    assert not (dst / "evil.bin").exists()  # absolute symlink rejected
+
+
+def test_oci_digest_pinned_reference(registry, tmp_path):
+    import hashlib as _h
+
+    import localai_tfp_tpu.gallery.downloader as dl
+
+    base, _, small_blob, _ = registry
+    # the fixture registered ("acme/artifact", "v1"); resolve its digest
+    # form through the same manifest bytes the server serves
+    manifest = {"schemaVersion": 2, "layers": [
+        {"digest": "sha256:" + _h.sha256(small_blob).hexdigest(),
+         "size": len(small_blob)}]}
+    # a digest-pinned ref must parse repo/tag correctly (repo@sha256:...)
+    # — the fixture has no digest-keyed manifest, so 404 (HTTPError), NOT
+    # a mangled-URL crash
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError):
+        dl.pull_oci_model(
+            f"oci://{base}/acme/artifact@sha256:{'0' * 64}",
+            str(tmp_path / "x.bin"))
