@@ -205,6 +205,7 @@ class ModelLoader:
             batch_slots=cfg.max_batch_slots,
             dtype=cfg.dtype or cfg.activation_dtype,
             kv_cache_dtype=cfg.kv_cache_dtype,
+            quantization=cfg.quantization,
             mesh=cfg.mesh,
             threads=cfg.threads or 0,
             embeddings=cfg.embeddings,

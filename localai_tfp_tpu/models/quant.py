@@ -1,0 +1,67 @@
+"""Weight-only int8 quantization for serving (ref: the reference serves
+quantized checkpoints as its default mode — llama.cpp Q4/Q8 GGUFs and the
+exllama2 EXL2 backend; config surface `quantization`
+backend_config.go/vllm fields).
+
+TPU-first shape: per-output-channel symmetric int8 with an f32 scale.
+Weights live in HBM at half the bf16 footprint; the matmul reads int8 and
+upcasts inline (XLA fuses the convert into the MXU feed), so decode —
+weight-bandwidth-bound at serving batch sizes — reads half the bytes.
+Activations, norms, embeddings, lm_head and the MoE expert stacks stay
+high-precision (quality-sensitive or gather-heavy paths)."""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QTensor(NamedTuple):
+    """int8 weight + per-output-channel scale. A NamedTuple, so it is a
+    pytree: jit/scan/donation see two leaves, and lax.scan slices the
+    leading (layer) axis of both together."""
+
+    q: jax.Array  # int8 [..., in, out]
+    scale: jax.Array  # f32 [..., out]
+
+
+# stacked projection leaves worth quantizing (the decode bandwidth hogs);
+# MoE/shared-expert stacks are excluded: routing is precision-sensitive
+# and their einsums contract the expert dim separately
+QUANTIZABLE = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_tensor(w: jax.Array) -> QTensor:
+    """Symmetric per-output-channel int8: scale over the INPUT dim
+    (axis -2), so dequantization is one multiply on the matmul output."""
+    wf = w.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(wf), axis=-2) / 127.0 + 1e-12  # [..., out]
+    q = jnp.clip(jnp.round(wf / scale[..., None, :]), -127, 127)
+    return QTensor(q=q.astype(jnp.int8), scale=scale)
+
+
+def quantize_params(params: dict[str, Any]) -> dict[str, Any]:
+    """Quantize the eligible projection stacks in place of their bf16
+    leaves. Everything else passes through untouched."""
+    out = dict(params)
+    for name in QUANTIZABLE:
+        if name in out and not isinstance(out[name], QTensor):
+            out[name] = quantize_tensor(out[name])
+    return out
+
+
+def mm(x: jax.Array, w: Any):
+    """x @ w for plain arrays OR QTensor (int8 upcast inline + one
+    per-channel multiply on the output)."""
+    if isinstance(w, QTensor):
+        y = x @ w.q.astype(x.dtype)
+        return y * w.scale.astype(x.dtype)
+    return x @ w
+
+
+def dequantize(w: Any) -> jax.Array:
+    if isinstance(w, QTensor):
+        return w.q.astype(jnp.float32) * w.scale[..., None, :]
+    return w

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .llm_spec import LLMSpec
+from .quant import mm as _mm  # plain or int8-QTensor matmul
 
 Params = dict[str, jax.Array]
 
@@ -349,9 +350,9 @@ def _layer_body(spec, x, lp, positions, inv_freq, rope_scale, attn_fn):
     attention contraction."""
     B, T = x.shape[0], x.shape[1]
     h = _norm(spec, x, lp["ln1_w"], lp.get("ln1_b"))
-    q = h @ lp["wq"]
-    k = h @ lp["wk"]
-    v = h @ lp["wv"]
+    q = _mm(h, lp["wq"])
+    k = _mm(h, lp["wk"])
+    v = _mm(h, lp["wv"])
     if "bq" in lp:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = q.reshape(B, T, spec.n_heads, spec.d_head)
@@ -364,7 +365,7 @@ def _layer_body(spec, x, lp, positions, inv_freq, rope_scale, attn_fn):
     q = apply_rope(q, positions, inv_f, spec.rotary_dim, rope_scale)
     k = apply_rope(k, positions, inv_f, spec.rotary_dim, rope_scale)
     attn, carry = attn_fn(q, k, v)
-    attn = attn @ lp["wo"]
+    attn = _mm(attn, lp["wo"])
     if "bo" in lp:
         attn = attn + lp["bo"]
     if "ln_post_attn_w" in lp:  # gemma2 sandwich: norm the branch output
@@ -376,14 +377,14 @@ def _layer_body(spec, x, lp, positions, inv_freq, rope_scale, attn_fn):
     if "router" in lp:  # mixture of experts (mixtral)
         mlp = _moe_mlp(spec, lp, mlp_in)
     else:
-        up = mlp_in @ lp["w_up"]
+        up = _mm(mlp_in, lp["w_up"])
         if "b_up" in lp:
             up = up + lp["b_up"]
         if spec.gated_mlp:
-            up = _act(spec, mlp_in @ lp["w_gate"]) * up
+            up = _act(spec, _mm(mlp_in, lp["w_gate"])) * up
         else:
             up = _act(spec, up)
-        mlp = up @ lp["w_down"]
+        mlp = _mm(up, lp["w_down"])
         if "b_down" in lp:
             mlp = mlp + lp["b_down"]
     if "ln_post_ffw_w" in lp:  # gemma2 sandwich

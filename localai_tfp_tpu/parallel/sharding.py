@@ -102,18 +102,37 @@ def param_specs(params: dict) -> dict[str, P]:
     for name in params:
         spec = PARAM_RULES.get(name)
         if spec is None:
-            spec = P(*([None] * params[name].ndim))
+            ndim = getattr(params[name], "ndim", None)
+            if ndim is None:  # QTensor outside the rule table
+                ndim = params[name].q.ndim
+            spec = P(*([None] * ndim))
         out[name] = spec
     return out
 
 
 def shard_params(params: dict, mesh: Mesh) -> dict:
     """Place parameters onto the mesh per PARAM_RULES. Dims that don't
-    divide the axis size fall back to replication on that dim."""
+    divide the axis size fall back to replication on that dim. int8
+    QTensor leaves shard their q like the full-precision rule and their
+    per-output-channel scale on the matching output dim."""
+    from ..models.quant import QTensor
+
     specs = param_specs(params)
     out = {}
     for name, arr in params.items():
-        spec = _divisible_spec(arr.shape, specs[name], mesh)
+        rule = specs.get(name) or P()
+        if isinstance(arr, QTensor):
+            qspec = _divisible_spec(arr.q.shape, rule, mesh)
+            # scale [..., out] follows [..., in, out] minus the in dim
+            dims = tuple(rule) + (None,) * (arr.q.ndim - len(tuple(rule)))
+            sspec = _divisible_spec(
+                arr.scale.shape, P(*(dims[:-2] + (dims[-1],))), mesh)
+            out[name] = QTensor(
+                q=jax.device_put(arr.q, NamedSharding(mesh, qspec)),
+                scale=jax.device_put(arr.scale, NamedSharding(mesh, sspec)),
+            )
+            continue
+        spec = _divisible_spec(arr.shape, rule, mesh)
         out[name] = jax.device_put(arr, NamedSharding(mesh, spec))
     return out
 

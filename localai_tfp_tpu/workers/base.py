@@ -85,6 +85,8 @@ class ModelLoadOptions:
     batch_slots: int = 8
     dtype: str = "bfloat16"
     kv_cache_dtype: str = ""
+    quantization: str = ""  # "int8": weight-only per-channel (ref: vLLM
+    # Quantization knob / llama.cpp quantized GGUF serving)
     mesh: dict[str, int] = field(default_factory=dict)
     threads: int = 0
     embeddings: bool = False

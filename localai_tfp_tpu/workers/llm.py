@@ -64,6 +64,7 @@ class JaxLLMBackend(Backend):
         # multimodal: (VisionSpec, VisionParams, mm_info) for checkpoints
         # with a vision tower (gemma3), else None
         self.vision: Any = None
+        self._quantized = False  # int8 weight-only serving mode
 
     # ------------------------------------------------------------- lifecycle
 
@@ -73,6 +74,17 @@ class JaxLLMBackend(Backend):
         channel = multihost.active_channel()
         role = self._role or multihost.role()
         with self._lock:
+            # cheap validations FIRST: a typo'd knob must fail in
+            # milliseconds, before checkpoint IO and before the multihost
+            # load broadcast fans the doomed load out to followers
+            quant = (opts.quantization or "").lower()
+            if quant and quant not in ("int8", "q8", "q8_0", "w8", "none",
+                                       "f16", "fp16", "bf16", "bfloat16"):
+                self._state = "ERROR"
+                return Result(
+                    False,
+                    f"load failed: unsupported quantization "
+                    f"'{opts.quantization}' (supported: int8)")
             model_dir = opts.model
             if not os.path.isabs(model_dir):
                 model_dir = os.path.join(opts.model_path or "", model_dir)
@@ -134,6 +146,13 @@ class JaxLLMBackend(Backend):
                     (opts.kv_cache_dtype or opts.dtype or "bfloat16").lower(),
                     dtype,
                 )
+                self._quantized = quant in ("int8", "q8", "q8_0", "w8")
+                if self._quantized:
+                    # AFTER LoRA merge: adapters fold into full-precision
+                    # weights first, then the projections quantize
+                    from ..models.quant import quantize_params
+
+                    params = quantize_params(params)
                 mesh = None
                 if opts.mesh:
                     from ..parallel.mesh import make_mesh
@@ -345,6 +364,11 @@ class JaxLLMBackend(Backend):
         scans finish on the old weights, the next dispatch uses the new."""
         if self.engine is None or self.spec is None:
             raise RuntimeError("model not loaded")
+        if getattr(self, "_quantized", False):
+            raise RuntimeError(
+                "LoRA hot-apply needs full-precision weights; load the "
+                "model without quantization (or restart with the adapter "
+                "in lora_adapters, which merges before quantizing)")
         params, n = merge_lora(self.spec, self.engine.params, adapter_dir,
                                scale=scale)
         self.engine.params = self._reshard(params)
@@ -354,6 +378,9 @@ class JaxLLMBackend(Backend):
         """Hot-unmerge a previously applied adapter (same scale)."""
         if self.engine is None or self.spec is None:
             raise RuntimeError("model not loaded")
+        if self._quantized:
+            raise RuntimeError(
+                "LoRA hot-unmerge needs full-precision weights")
         params, n = merge_lora(self.spec, self.engine.params, adapter_dir,
                                scale=scale, sign=-1.0)
         self.engine.params = self._reshard(params)

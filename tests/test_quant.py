@@ -1,0 +1,141 @@
+"""int8 weight-only quantization (ref: the reference's default serving
+mode is quantized — llama.cpp Q8/Q4 GGUFs, exllama2 EXL2; knob
+`quantization`). Per-output-channel symmetric int8 with inline upcast."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from localai_tfp_tpu.engine.engine import GenRequest, LLMEngine
+from localai_tfp_tpu.engine.tokenizer import ByteTokenizer
+from localai_tfp_tpu.models.llm_spec import tiny_spec
+from localai_tfp_tpu.models.quant import (
+    QTensor,
+    dequantize,
+    mm,
+    quantize_params,
+    quantize_tensor,
+)
+from localai_tfp_tpu.models.transformer import KVCache, forward, init_params
+
+
+def test_quantize_roundtrip_error_bounded():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(4, 64, 32)).astype(np.float32))
+    qt = quantize_tensor(w)
+    assert qt.q.dtype == jnp.int8 and qt.scale.shape == (4, 32)
+    back = dequantize(qt)
+    # symmetric int8: error bounded by half a quantization step per entry
+    step = np.asarray(qt.scale)[:, None, :]
+    assert np.all(np.abs(np.asarray(back) - np.asarray(w)) <= step * 0.51)
+
+
+def test_mm_matches_dequantized_matmul():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(3, 64)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32))
+    qt = quantize_tensor(w)
+    got = mm(x, qt)
+    want = x @ dequantize(qt)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quantized_forward_tracks_full_precision():
+    tk = ByteTokenizer()
+    spec = tiny_spec(vocab_size=tk.vocab_size, d_model=128, d_ff=256,
+                     n_heads=4, n_kv_heads=2, d_head=32)
+    params = init_params(jax.random.PRNGKey(0), spec, dtype=jnp.float32)
+    qparams = quantize_params(params)
+    assert isinstance(qparams["wq"], QTensor)
+    assert isinstance(qparams["embed"], jax.Array)  # embeddings untouched
+
+    ids = np.asarray([[2, 9, 17, 33, 5, 80]], np.int32)
+    full, _ = forward(spec, params, jnp.asarray(ids),
+                      jnp.zeros((1,), jnp.int32),
+                      KVCache.create(spec, 1, 32, jnp.float32),
+                      jnp.zeros((1,), jnp.int32))
+    quant, _ = forward(spec, qparams, jnp.asarray(ids),
+                       jnp.zeros((1,), jnp.int32),
+                       KVCache.create(spec, 1, 32, jnp.float32),
+                       jnp.zeros((1,), jnp.int32))
+    a = np.asarray(full).reshape(-1)
+    b = np.asarray(quant).reshape(-1)
+    cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+    assert cos > 0.99, cos
+
+
+def test_engine_serves_quantized_weights():
+    tk = ByteTokenizer()
+    spec = tiny_spec(vocab_size=tk.vocab_size, max_position=512)
+    params = quantize_params(
+        init_params(jax.random.PRNGKey(0), spec, dtype=jnp.float32))
+    eng = LLMEngine(spec, params, tk, n_slots=2, max_seq=128,
+                    prefill_buckets=(8, 32), cache_dtype=jnp.float32,
+                    autostart=False)
+    eng.start()
+    try:
+        ev = eng.generate(GenRequest(
+            prompt_ids=tk.encode("quantized hello", add_bos=True),
+            max_tokens=8, ignore_eos=True))
+        assert ev.finish_reason == "length", ev.error
+        assert len(ev.full_text) > 0
+    finally:
+        eng.close()
+
+
+def test_sharded_quantized_params():
+    from localai_tfp_tpu.parallel.mesh import make_mesh
+    from localai_tfp_tpu.parallel.sharding import shard_params
+
+    tk = ByteTokenizer()
+    spec = tiny_spec(vocab_size=tk.vocab_size)
+    params = quantize_params(
+        init_params(jax.random.PRNGKey(0), spec, dtype=jnp.float32))
+    mesh = make_mesh({"data": 2, "seq": 1, "model": 4},
+                     devices=jax.devices("cpu"))
+    sp = shard_params(params, mesh)
+    assert isinstance(sp["wq"], QTensor)
+    ids = np.asarray([[2, 9, 17, 33]], np.int32)
+    ref, _ = forward(spec, params, jnp.asarray(ids),
+                     jnp.zeros((1,), jnp.int32),
+                     KVCache.create(spec, 1, 32, jnp.float32),
+                     jnp.zeros((1,), jnp.int32))
+    with mesh:
+        got, _ = forward(spec, sp, jnp.asarray(ids),
+                         jnp.zeros((1,), jnp.int32),
+                         KVCache.create(spec, 1, 32, jnp.float32),
+                         jnp.zeros((1,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_worker_quantization_knob(tmp_path):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from localai_tfp_tpu.workers.base import ModelLoadOptions
+    from localai_tfp_tpu.workers.llm import JaxLLMBackend
+
+    torch.manual_seed(0)
+    d = tmp_path / "ckpt"
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256)).save_pretrained(
+            d, safe_serialization=True)
+    b = JaxLLMBackend()
+    res = b.load_model(ModelLoadOptions(
+        model=str(d), context_size=128, batch_slots=2, dtype="float32",
+        quantization="int8"))
+    assert res.success, res.message
+    assert isinstance(b.engine.params["wq"], QTensor)
+    with pytest.raises(RuntimeError):
+        b.apply_lora(str(d))
+    b2 = JaxLLMBackend()
+    res = b2.load_model(ModelLoadOptions(
+        model=str(d), context_size=128, batch_slots=2,
+        quantization="exl2"))
+    assert not res.success and "unsupported quantization" in res.message
