@@ -175,12 +175,13 @@ def load_params(
             p["bv"] = stack(lambda i: get(lp.format(i=i) + "self_attn.v_proj.bias"))
     p["wo"] = stack(lambda i: t(lp.format(i=i) + "self_attn.o_proj.weight"))
 
-    if spec.n_experts and mt == "qwen2_moe":
-        # qwen2_moe: mlp.gate [E,D] router + mlp.experts.{e}.gate/up/down
-        # + always-on mlp.shared_expert (scaled by mlp.shared_expert_gate
-        # [1,D]); mlp_only/off-step layers carry a plain dense MLP, which
-        # lands in the shared slots with zeroed expert/router weights (the
-        # _dense_only flag in transformer.py forces their gate to 1)
+    if spec.n_experts and mt in ("qwen2_moe", "qwen3_moe"):
+        # qwen-family MoE: mlp.gate [E,D] router + mlp.experts.{e}.gate/
+        # up/down. qwen2_moe adds an always-on mlp.shared_expert (scaled
+        # by mlp.shared_expert_gate [1,D]); its mlp_only/off-step layers
+        # carry a plain dense MLP, which lands in the shared slots with
+        # zeroed expert/router weights (the _dense_only flag in
+        # transformer.py forces their gate to 1). qwen3_moe has neither.
         E, D = spec.n_experts, spec.d_model
         Fm = spec.moe_d_ff or spec.d_ff
         Fs = spec.moe_shared_d_ff or spec.d_ff
@@ -212,12 +213,14 @@ def load_params(
         p["moe_gate"] = stack(lambda i: experts(i, "gate_proj"))
         p["moe_up"] = stack(lambda i: experts(i, "up_proj"))
         p["moe_down"] = stack(lambda i: experts(i, "down_proj"))
-        p["shared_gate"] = stack(lambda i: shared(i, "gate_proj"))
-        p["shared_up"] = stack(lambda i: shared(i, "up_proj"))
-        p["shared_down"] = stack(lambda i: shared(i, "down_proj"))
-        p["shared_router"] = stack(
-            lambda i: np.zeros((D,), np.float32) if i in dense_set
-            else get(lp.format(i=i) + "mlp.shared_expert_gate.weight")[0])
+        if spec.moe_shared_expert:
+            p["shared_gate"] = stack(lambda i: shared(i, "gate_proj"))
+            p["shared_up"] = stack(lambda i: shared(i, "up_proj"))
+            p["shared_down"] = stack(lambda i: shared(i, "down_proj"))
+            p["shared_router"] = stack(
+                lambda i: np.zeros((D,), np.float32) if i in dense_set
+                else get(lp.format(i=i)
+                         + "mlp.shared_expert_gate.weight")[0])
     elif spec.n_experts:
         # mixtral: block_sparse_moe.gate [E,D] router + per-expert
         # w1 (gate) / w3 (up) / w2 (down), stacked [L, E, in, out]

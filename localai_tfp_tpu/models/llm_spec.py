@@ -183,6 +183,31 @@ def spec_from_hf_config(cfg: dict[str, Any]) -> LLMSpec:
             moe_norm_topk=bool(cfg.get("norm_topk_prob", False)),
             moe_dense_layers=dense_layers,
         )
+    elif mt == "qwen3_moe":
+        # qwen3 MoE: per-head q/k RMSNorm (no qkv bias) + top-k sparse
+        # experts with renormalized weights and NO shared expert
+        step = int(cfg.get("decoder_sparse_step") or 1)
+        mlp_only = {int(x) for x in (cfg.get("mlp_only_layers") or [])}
+        dense_layers = tuple(sorted(
+            layer for layer in range(n_layers)
+            if layer in mlp_only or (step > 0 and (layer + 1) % step != 0)
+        ))
+        if dense_layers:
+            # without a shared expert there is no slot to park a dense
+            # MLP in the stacked scan; no released checkpoint uses this
+            raise NotImplementedError(
+                "qwen3_moe with dense (mlp_only/off-step) layers is not "
+                "supported yet")
+        kw.update(
+            qk_norm=True,
+            n_experts=int(cfg.get("num_experts") or 128),
+            experts_per_token=int(cfg.get("num_experts_per_tok") or 8),
+            moe_d_ff=int(cfg.get("moe_intermediate_size") or d_ff),
+            # released qwen3-MoE checkpoints set norm_topk_prob=true in
+            # config.json, but the HF CLASS default for an omitted key is
+            # False — mirror that so omitted-key configs stay bit-parity
+            moe_norm_topk=bool(cfg.get("norm_topk_prob", False)),
+        )
     elif mt == "phi":
         kw.update(
             norm_type="layernorm",

@@ -63,6 +63,13 @@ def _save_tiny(tmp_path, family: str) -> str:
 
         model = MixtralForCausalLM(MixtralConfig(
             **common, num_local_experts=4, num_experts_per_tok=2))
+    elif family == "qwen3_moe":
+        from transformers import Qwen3MoeConfig, Qwen3MoeForCausalLM
+
+        model = Qwen3MoeForCausalLM(Qwen3MoeConfig(
+            **common, head_dim=16, num_experts=4, num_experts_per_tok=2,
+            moe_intermediate_size=96, decoder_sparse_step=1,
+            mlp_only_layers=[]))
     elif family == "qwen2_moe":
         from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
 
@@ -97,7 +104,7 @@ def _hf_logits(model_dir: str, tokens: np.ndarray) -> np.ndarray:
 
 @pytest.mark.parametrize("family", ["llama", "qwen2", "qwen3", "gemma2",
                                     "gemma3", "mixtral", "qwen2_moe",
-                                    "phi"])
+                                    "qwen3_moe", "phi"])
 def test_logits_match_hf(tmp_path, family):
     from localai_tfp_tpu.models.hf_loader import load_params
     from localai_tfp_tpu.models.transformer import KVCache, forward
