@@ -198,6 +198,39 @@ def _sel_active(active, new, old):
     return jnp.where(a, new, old)
 
 
+def _window_cache(cache: KVCache, window: int):
+    """Slice the cache to its first ``window`` positions; returns the
+    windowed view and a restore fn writing it back into the full buffer.
+    Per-dispatch windowing keeps attention/write traffic proportional to
+    the live-context bucket, not max_seq (the XLA stand-in for ragged
+    paged attention)."""
+    L, S, SEQ, F = cache.k.shape
+    if window >= SEQ:
+        return cache, lambda c: c
+    win = KVCache(
+        k=lax.slice(cache.k, (0, 0, 0, 0), (L, S, window, F)),
+        v=lax.slice(cache.v, (0, 0, 0, 0), (L, S, window, F)),
+        k_scale=(lax.slice(cache.k_scale, (0, 0, 0), (L, S, window))
+                 if cache.quantized else None),
+        v_scale=(lax.slice(cache.v_scale, (0, 0, 0), (L, S, window))
+                 if cache.quantized else None),
+    )
+
+    def restore(c: KVCache) -> KVCache:
+        return KVCache(
+            k=lax.dynamic_update_slice(cache.k, c.k, (0, 0, 0, 0)),
+            v=lax.dynamic_update_slice(cache.v, c.v, (0, 0, 0, 0)),
+            k_scale=(lax.dynamic_update_slice(
+                cache.k_scale, c.k_scale, (0, 0, 0))
+                if cache.quantized else None),
+            v_scale=(lax.dynamic_update_slice(
+                cache.v_scale, c.v_scale, (0, 0, 0))
+                if cache.quantized else None),
+        )
+
+    return win, restore
+
+
 def _sample_masked(sampling, slot_ids, logits, active, masks):
     toks, new_sampling = sample(sampling, slot_ids, logits, mask=masks)
     merged = jax.tree_util.tree_map(
@@ -278,44 +311,6 @@ class LLMEngine:
         self.metrics = EngineMetrics()
         self._all_slot_ids = jnp.arange(n_slots, dtype=jnp.int32)
 
-        @partial(jax.jit, donate_argnums=(2,))
-        def _prefill(params, tokens, cache, pos0, slot_ids, soft=None):
-            if soft is not None:
-                soft = _soft_expand(tokens, *soft)
-            return forward(spec, params, tokens, pos0, cache, slot_ids,
-                           soft=soft)
-
-        @partial(jax.jit, donate_argnums=(2, 4))
-        def _prefill_final(params, tokens, cache, pos0, sampling, slot_ids,
-                           n_chunk, tails, tail_lens, masks, soft=None):
-            """Final prompt chunks for a BATCH of slots + penalty-window
-            seed + first-token sample in ONE dispatch — concurrent prompts
-            share the round trip instead of paying one each, and TTFT pays
-            one RTT, not three (SURVEY.md §7 hard part #2).
-
-            tokens [B, bucket]; slot_ids/pos0/n_chunk/tail_lens [B];
-            tails [B, W]."""
-            if soft is not None:
-                soft = _soft_expand(tokens, *soft)
-            logits, cache = forward(
-                spec, params, tokens, pos0, cache, slot_ids, soft=soft
-            )
-
-            def seed(st, i):
-                return observe_tokens(
-                    st, slot_ids, tails[:, i], i < tail_lens
-                ), None
-
-            sampling, _ = lax.scan(
-                seed, sampling,
-                jnp.arange(tails.shape[1], dtype=jnp.int32),
-            )
-            last = jax.vmap(
-                lambda lg, n: lax.dynamic_slice_in_dim(lg, n - 1, 1, 0)[0]
-            )(logits, n_chunk)  # [B, V] at each chunk's true last position
-            toks, sampling = sample(sampling, slot_ids, last, mask=masks)
-            return toks, cache, sampling
-
         @partial(jax.jit, donate_argnums=(2, 5))
         def _decode(params, tokens, cache, pos0, slot_ids, sampling,
                     active, masks):
@@ -337,12 +332,12 @@ class LLMEngine:
         def _hidden(params, tokens, cache, pos0, slot_ids):
             return forward_hidden(spec, params, tokens, pos0, cache, slot_ids)
 
-        self._prefill_fn = _prefill
-        self._prefill_final_fn = _prefill_final
         self._decode_fn = _decode
         self._sample_fn = _sample_only
         self._hidden_fn = _hidden
-        self._decode_k_fns: dict[tuple, Any] = {}  # ("decode", k, W) | ("spec", kd, rounds) | ("draft_prefill",)
+        self._decode_k_fns: dict[tuple, Any] = {}  # ("decode", k, W) |
+        # ("spec", kd, rounds) | ("draft_prefill",) | ("prefill", W) |
+        # ("prefill_final", W)
         # device-resident decode state (tokens/pos/active) reused across
         # dispatches while no slot changes; _epoch invalidates it
         self._epoch = 0
@@ -359,18 +354,27 @@ class LLMEngine:
 
         from ..ops.decode_attention import PAGE, _interpret
 
-        env = os.environ.get("LOCALAI_DECODE_KERNEL")
-        if env is None:
-            # default OFF: measured on v5e, the per-page pallas dispatch
-            # overhead currently loses to the windowed XLA path below;
-            # flip on once the kernels fuse the layer loop
+        env = os.environ.get("LOCALAI_DECODE_KERNEL", "auto")
+        if env in ("0", "false", "off"):
             return False
-        return env not in ("0", "false", "off") and (
-            not _interpret()
+        # default ON where mosaic compiles: the fused per-slot kernel
+        # (ragged page reads, full-cache addressing) beats the windowed
+        # XLA path at serving shapes on v5e. Forcing =1 also allows the
+        # (slow) interpret path so CPU tests exercise the kernel engine.
+        from ..models.transformer import _layer_windows
+
+        forced = env in ("1", "true", "on")
+        return (
+            (forced or not _interpret())
             and self.mesh is None  # kernels need shard_map under a mesh
             and self.max_seq % PAGE == 0
             and self.spec.kv_dim % 128 == 0
             and not self.spec.attn_logit_softcap
+            # conditions forward_hidden ALSO gates on — if they disagree
+            # the engine would skip window bucketing while forward falls
+            # back to the full-seq XLA path
+            and not self.cache.quantized
+            and _layer_windows(self.spec) is None
         )
 
     def _spec_decode_fn(self, kd: int, rounds: int):
@@ -542,6 +546,79 @@ class LLMEngine:
         self._decode_k_fns[key] = _spec_s
         return _spec_s
 
+    def _prefill_fn(self, window: int):
+        """Jitted prompt-chunk prefill over a ``window``-sliced cache
+        (attention + KV writes scale with the live-context bucket)."""
+        key = ("prefill", window)
+        fn = self._decode_k_fns.get(key)
+        if fn is not None:
+            return fn
+        spec = self.spec
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def _prefill(params, tokens, cache, pos0, slot_ids, soft=None):
+            if soft is not None:
+                soft = _soft_expand(tokens, *soft)
+            win, restore = _window_cache(cache, window)
+            logits, win = forward(spec, params, tokens, pos0, win,
+                                  slot_ids, soft=soft)
+            return logits, restore(win)
+
+        self._decode_k_fns[key] = _prefill
+        return _prefill
+
+    def _prefill_final_fn(self, window: int):
+        """Final prompt chunks for a BATCH of slots + penalty-window seed
+        + first-token sample in ONE dispatch — concurrent prompts share
+        the round trip instead of paying one each, and TTFT pays one RTT,
+        not three (SURVEY.md §7 hard part #2). The cache is windowed like
+        the decode path: full-seq prefill attention measured ~7s/wave at
+        1B/2048-seq shapes, windowed ~100ms.
+
+        tokens [B, bucket]; slot_ids/pos0/n_chunk/tail_lens [B];
+        tails [B, W]."""
+        key = ("prefill_final", window)
+        fn = self._decode_k_fns.get(key)
+        if fn is not None:
+            return fn
+        spec = self.spec
+
+        @partial(jax.jit, donate_argnums=(2, 4))
+        def _prefill_final(params, tokens, cache, pos0, sampling, slot_ids,
+                           n_chunk, tails, tail_lens, masks, soft=None):
+            if soft is not None:
+                soft = _soft_expand(tokens, *soft)
+            win, restore = _window_cache(cache, window)
+            logits, win = forward(
+                spec, params, tokens, pos0, win, slot_ids, soft=soft
+            )
+            cache = restore(win)
+
+            def seed(st, i):
+                return observe_tokens(
+                    st, slot_ids, tails[:, i], i < tail_lens
+                ), None
+
+            sampling, _ = lax.scan(
+                seed, sampling,
+                jnp.arange(tails.shape[1], dtype=jnp.int32),
+            )
+            last = jax.vmap(
+                lambda lg, n: lax.dynamic_slice_in_dim(lg, n - 1, 1, 0)[0]
+            )(logits, n_chunk)  # [B, V] at each chunk's true last position
+            toks, sampling = sample(sampling, slot_ids, last, mask=masks)
+            return toks, cache, sampling
+
+        self._decode_k_fns[key] = _prefill_final
+        return _prefill_final
+
+    def _window_bucket(self, need: int) -> int:
+        """Smallest power-of-two window >= need (floor 256, cap max_seq)."""
+        w = 256
+        while w < need:
+            w *= 2
+        return min(w, self.max_seq)
+
     def _draft_prefill_fn(self):
         """Draft-model prefill (the draft cache must mirror the main
         cache's token positions for speculative decoding)."""
@@ -661,19 +738,7 @@ class LLMEngine:
         @partial(jax.jit, donate_argnums=(2, 5))
         def _decode_k(params, tokens, cache, pos0, slot_ids, sampling,
                       active):
-            full = cache
-            if window < self.max_seq:
-                L, S, _, F = cache.k.shape
-                cache = KVCache(
-                    k=lax.slice(cache.k, (0, 0, 0, 0), (L, S, window, F)),
-                    v=lax.slice(cache.v, (0, 0, 0, 0), (L, S, window, F)),
-                    k_scale=(lax.slice(cache.k_scale, (0, 0, 0),
-                                       (L, S, window))
-                             if cache.quantized else None),
-                    v_scale=(lax.slice(cache.v_scale, (0, 0, 0),
-                                       (L, S, window))
-                             if cache.quantized else None),
-                )
+            cache, restore = _window_cache(cache, window)
 
             def step(carry, _):
                 tokens, pos, cache, sampling = carry
@@ -689,20 +754,10 @@ class LLMEngine:
             (tok_next, pos_next, cache, sampling), toks_seq = lax.scan(
                 step, (tokens, pos0, cache, sampling), None, length=k
             )
-            if window < self.max_seq:
-                cache = KVCache(
-                    k=lax.dynamic_update_slice(full.k, cache.k, (0, 0, 0, 0)),
-                    v=lax.dynamic_update_slice(full.v, cache.v, (0, 0, 0, 0)),
-                    k_scale=(lax.dynamic_update_slice(
-                        full.k_scale, cache.k_scale, (0, 0, 0))
-                        if cache.quantized else None),
-                    v_scale=(lax.dynamic_update_slice(
-                        full.v_scale, cache.v_scale, (0, 0, 0))
-                        if cache.quantized else None),
-                )
             # tok_next/pos_next are returned so the next dispatch can chain
             # on device state without a host round trip
-            return toks_seq.T, tok_next, pos_next, cache, sampling  # [S, k]
+            return (toks_seq.T, tok_next, pos_next, restore(cache),
+                    sampling)  # [S, k]
 
         self._decode_k_fns[("decode", k, window)] = _decode_k
         return _decode_k
@@ -734,8 +789,15 @@ class LLMEngine:
         """Device-only work for one dispatch record. MUST be fully
         determined by (kind, payload) + engine construction — no reads of
         leader-side scheduler state — so follower replay stays lockstep."""
-        if kind == "reset":
-            self.sampling = self.sampling.reset_slot(p["slot"], **p["params"])
+        if kind == "reset_batch":
+            from ..ops.sampling import reset_slots
+
+            self.sampling = reset_slots(
+                self.sampling, *(jnp.asarray(p[k]) for k in (
+                    "slot_ids", "temperature", "top_k", "top_p", "min_p",
+                    "repeat_penalty", "freq_penalty", "presence_penalty",
+                    "repeat_last_n", "seeds", "has_seed")),
+            )
             return None
         if kind == "prefill":
             toks = jnp.asarray(p["toks"])
@@ -743,6 +805,7 @@ class LLMEngine:
             sids = jnp.asarray(p["slot_ids"])
             soft = self._soft_dense(p.get("soft"), *p["toks"].shape)
             _, self.cache = self._prefill_fn(
+                p.get("window", self.max_seq))(
                 self.params, toks, self.cache, pos0, sids, soft
             )
             if self.draft is not None:
@@ -757,6 +820,7 @@ class LLMEngine:
             masks = _unpack_masks(p["masks"])
             soft = self._soft_dense(p.get("soft"), *p["toks"].shape)
             toks_out, self.cache, self.sampling = self._prefill_final_fn(
+                p.get("window", self.max_seq))(
                 self.params, toks, self.cache, pos0, self.sampling, sids,
                 jnp.asarray(p["n_chunk"]), jnp.asarray(p["tails"]),
                 jnp.asarray(p["tail_lens"]), masks, soft,
@@ -933,6 +997,7 @@ class LLMEngine:
     def _admit(self) -> None:
         with self._lock:
             pending, self._pending = self._pending, []
+        assigned: list[_Slot] = []
         for req, out in pending:
             slot = self._pick_slot(req)
             if slot is None:
@@ -940,6 +1005,61 @@ class LLMEngine:
                     self._pending.append((req, out))
                 continue
             self._assign(slot, req, out)
+            assigned.append(slot)
+        if assigned:
+            self._dispatch_resets(assigned)
+
+    def _dispatch_resets(self, slots: list[_Slot]) -> None:
+        """One batched sampler-reset dispatch for an admission wave
+        (per-slot resets cost ~25ms each through a tunneled chip). Rows
+        are padded to a power of two with row 0 repeated — identical
+        values keep the duplicate-index scatter deterministic."""
+        K = 1 << max(len(slots) - 1, 0).bit_length()
+        first = slots[0].request
+        assert first is not None
+
+        def row(i):
+            s = slots[i] if i < len(slots) else slots[0]
+            r = s.request
+            assert r is not None
+            return s.idx, r
+        cols: dict[str, list] = {k: [] for k in (
+            "slot_ids", "temperature", "top_k", "top_p", "min_p",
+            "repeat_penalty", "freq_penalty", "presence_penalty",
+            "repeat_last_n", "seeds", "has_seed")}
+        W = self.sampling.window
+        for i in range(K):
+            idx, r = row(i)
+            cols["slot_ids"].append(idx)
+            cols["temperature"].append(r.temperature)
+            cols["top_k"].append(r.top_k)
+            cols["top_p"].append(r.top_p)
+            cols["min_p"].append(r.min_p)
+            cols["repeat_penalty"].append(r.repeat_penalty)
+            cols["freq_penalty"].append(r.frequency_penalty)
+            cols["presence_penalty"].append(r.presence_penalty)
+            cols["repeat_last_n"].append(
+                min(r.repeat_last_n if r.repeat_last_n > 0 else 64, W))
+            # wrap to the int32 bit pattern: 64-bit seeds are legal in the
+            # API and np.asarray(np.int32) raises on >= 2**31
+            seed = (r.seed if r.seed is not None else 0) & 0xFFFFFFFF
+            cols["seeds"].append(seed - (1 << 32) if seed >= (1 << 31)
+                                 else seed)
+            cols["has_seed"].append(r.seed is not None)
+        self._run("reset_batch", {
+            "slot_ids": np.asarray(cols["slot_ids"], np.int32),
+            "temperature": np.asarray(cols["temperature"], np.float32),
+            "top_k": np.asarray(cols["top_k"], np.int32),
+            "top_p": np.asarray(cols["top_p"], np.float32),
+            "min_p": np.asarray(cols["min_p"], np.float32),
+            "repeat_penalty": np.asarray(cols["repeat_penalty"], np.float32),
+            "freq_penalty": np.asarray(cols["freq_penalty"], np.float32),
+            "presence_penalty": np.asarray(
+                cols["presence_penalty"], np.float32),
+            "repeat_last_n": np.asarray(cols["repeat_last_n"], np.int32),
+            "seeds": np.asarray(cols["seeds"], np.int32),
+            "has_seed": np.asarray(cols["has_seed"], bool),
+        })
 
     def _pick_slot(self, req: GenRequest) -> Optional[_Slot]:
         free = [s for s in self.slots if not s.active]
@@ -1079,18 +1199,7 @@ class LLMEngine:
         slot.constraint_state = (
             req.constraint.initial_state() if req.constraint else None
         )
-        self._epoch += 1
-        self._run("reset", {"slot": slot.idx, "params": dict(
-            temperature=req.temperature,
-            top_k=req.top_k,
-            top_p=req.top_p,
-            min_p=req.min_p,
-            repeat_penalty=req.repeat_penalty,
-            freq_penalty=req.frequency_penalty,
-            presence_penalty=req.presence_penalty,
-            repeat_last_n=req.repeat_last_n,
-            seed=req.seed,
-        )})
+        self._epoch += 1  # sampler reset is batched per wave (_admit)
 
     def _bucket(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -1118,6 +1227,7 @@ class LLMEngine:
             "pos0": np.asarray([slot.n_past], np.int32),
             "slot_ids": np.asarray([slot.idx], np.int32),
             "soft": self._soft_payload([slot], [slot.n_past], bucket),
+            "window": self._window_bucket(slot.n_past + bucket),
         })
         slot.n_past += len(chunk)
         slot.cache_tokens.extend(chunk)
@@ -1154,6 +1264,7 @@ class LLMEngine:
             "n_chunk": n_chunk, "tails": tails, "tail_lens": tail_lens,
             "masks": masks,
             "soft": self._soft_payload(group, pos0, bucket),
+            "window": self._window_bucket(int(pos0.max()) + bucket),
         })
         toks_host = np.asarray(toks_out)
         dt_ms = (time.perf_counter() - t0) * 1e3
@@ -1280,20 +1391,22 @@ class LLMEngine:
         S = self.n_slots
         k, room = self._multi_step_k(decoding)
         depth = 2 if k > 1 and room >= 2 * k else 1
-        # live-context window bucket for this dispatch (see _decode_k_fn)
-        need = max(s.n_past for s in decoding) + depth * k + 1
-        window = 256
-        while window < need:
-            window *= 2
-        window = min(window, self.max_seq)
-        # prefer an already-compiled window >= need over compiling a new
-        # exact bucket (a cold jit costs seconds; reading a slightly larger
-        # window costs microseconds)
-        compiled = [key[2] for key in self._decode_k_fns
-                    if key[0] == "decode" and key[1] == k
-                    and window <= key[2]]
-        if compiled:
-            window = min(compiled)
+        if self._use_kernel:
+            # the fused Pallas kernel is ragged (reads only valid pages),
+            # so no window slicing: one compiled variant for all contexts
+            window = self.max_seq
+        else:
+            # live-context window bucket for this dispatch (_decode_k_fn)
+            need = max(s.n_past for s in decoding) + depth * k + 1
+            window = self._window_bucket(need)
+            # prefer an already-compiled window >= need over compiling a
+            # new exact bucket (a cold jit costs seconds; reading a
+            # slightly larger window costs microseconds)
+            compiled = [key[2] for key in self._decode_k_fns
+                        if key[0] == "decode" and key[1] == k
+                        and window <= key[2]]
+            if compiled:
+                window = min(compiled)
 
         tokens = np.zeros((S, 1), np.int32)
         pos0 = np.zeros((S,), np.int32)

@@ -555,30 +555,54 @@ def forward_hidden(
     identity = slot_ids is None  # batch row b IS cache row b (decode path)
     quant = cache.quantized  # int8 rows + per-row scales
 
-    def body(x, scanned):
-        if quant:
-            lp, ck, cv, ks, vs = scanned
+    def body(carry, scanned):
+        # cache rides as the scan CARRY (not xs/ys): XLA aliases loop
+        # carries in place, so the per-layer update is a true in-place
+        # write of the touched rows. As xs/ys the whole cache would be
+        # copied through the ys stack every step (~GBs/step read+write at
+        # serving shapes — measured 3-4x the decode roofline on v5e).
+        x, ck_all, cv_all, ks_all, vs_all = carry
+        l, lp = scanned
+        use_kernel = (decode_kernel and identity and x.shape[1] == 1
+                      and not quant and win is None)  # uniform windows only
+        if use_kernel:
+            ck = cv = ks = vs = None  # kernel addresses the full cache
         else:
-            lp, ck, cv = scanned  # layer params; cache [n_slots, S, kv_dim]
-            ks = vs = None
+            ck = lax.dynamic_index_in_dim(ck_all, l, 0, keepdims=False)
+            cv = lax.dynamic_index_in_dim(cv_all, l, 0, keepdims=False)
+            if quant:
+                ks = lax.dynamic_index_in_dim(ks_all, l, 0, keepdims=False)
+                vs = lax.dynamic_index_in_dim(vs_all, l, 0, keepdims=False)
+            else:
+                ks = vs = None
 
         def kernel_attn(q, k, v):
-            # Pallas path: append one page per slot, attend over valid
-            # pages only (ragged reads — the decode bandwidth win)
-            from ..ops.decode_attention import decode_attention, paged_append
+            # Fused Pallas path: the current K/V rows are appended via an
+            # in-place scatter on the scan-CARRIED full cache (XLA keeps
+            # carry scatters in place; single bf16 rows cannot be DMA'd
+            # into the tiled HBM buffer from inside a kernel), then one
+            # read-only kernel attends over each slot's VALID pages only
+            # (ragged reads — the decode bandwidth win).
+            from ..ops.decode_attention import fused_decode_attention
 
-            ck2 = paged_append(ck, k.reshape(B, spec.kv_dim), pos0)
-            cv2 = paged_append(cv, v.reshape(B, spec.kv_dim), pos0)
+            kf = k.reshape(B, spec.kv_dim)
+            vf = v.reshape(B, spec.kv_dim)
+            rows = jnp.arange(B, dtype=jnp.int32)
+            ck_new = ck_all.at[l, rows, pos0, :].set(
+                kf.astype(ck_all.dtype), mode="promise_in_bounds")
+            cv_new = cv_all.at[l, rows, pos0, :].set(
+                vf.astype(cv_all.dtype), mode="promise_in_bounds")
             scale = (
                 1.0 / math.sqrt(spec.query_pre_attn_scalar)
                 if spec.query_pre_attn_scalar
                 else 1.0 / math.sqrt(spec.d_head)
             )
-            out = decode_attention(
-                q[:, 0], ck2, cv2, pos0 + 1, spec.n_kv_heads,
-                scale=scale, sliding_window=spec.sliding_window,
+            out = fused_decode_attention(
+                q[:, 0], kf, vf, ck_new, cv_new, l, pos0 + 1,
+                spec.n_kv_heads, scale=scale,
+                sliding_window=spec.sliding_window,
             )
-            return out[:, None, :].astype(x.dtype), (ck2, cv2)
+            return out[:, None, :].astype(x.dtype), (ck_new, cv_new)
 
         def kv_from_cache(k, v):
             # cache rows are head-FLAT [seq, kv_dim] (see KVCache); heads are
@@ -660,23 +684,37 @@ def forward_hidden(
             return _attend(spec, q, k_eff, v_eff, positions,
                            lp.get("_window")), carry
 
-        use_kernel = (decode_kernel and identity and x.shape[1] == 1
-                      and not quant and win is None)  # uniform windows only
         x, out = _layer_body(
             spec, x, lp, positions, inv_freq, rope_scale,
             kernel_attn if use_kernel else xla_attn,
         )
-        return x, out
+        if use_kernel:
+            # the fused kernel updated the FULL stacked cache in place
+            ck_all, cv_all = out
+        elif quant:
+            ck2, cv2, ks2, vs2 = out
+            ck_all = lax.dynamic_update_index_in_dim(ck_all, ck2, l, 0)
+            cv_all = lax.dynamic_update_index_in_dim(cv_all, cv2, l, 0)
+            ks_all = lax.dynamic_update_index_in_dim(ks_all, ks2, l, 0)
+            vs_all = lax.dynamic_update_index_in_dim(vs_all, vs2, l, 0)
+        else:
+            ck2, cv2 = out
+            ck_all = lax.dynamic_update_index_in_dim(ck_all, ck2, l, 0)
+            cv_all = lax.dynamic_update_index_in_dim(cv_all, cv2, l, 0)
+        return (x, ck_all, cv_all, ks_all, vs_all), None
 
+    layer_idx = jnp.arange(spec.n_layers, dtype=jnp.int32)
+    (x, new_k, new_v, new_ks, new_vs), _ = lax.scan(
+        body,
+        (x, cache.k, cache.v,
+         cache.k_scale if quant else jnp.zeros((), jnp.float32),
+         cache.v_scale if quant else jnp.zeros((), jnp.float32)),
+        (layer_idx, stacked),
+    )
     if quant:
-        x, (new_k, new_v, new_ks, new_vs) = lax.scan(
-            body, x,
-            (stacked, cache.k, cache.v, cache.k_scale, cache.v_scale),
-        )
         new_cache = KVCache(k=new_k, v=new_v, k_scale=new_ks,
                             v_scale=new_vs)
     else:
-        x, (new_k, new_v) = lax.scan(body, x, (stacked, cache.k, cache.v))
         new_cache = KVCache(k=new_k, v=new_v)
 
     if spec.final_norm:

@@ -1,25 +1,29 @@
-"""Pallas TPU kernels for the batched-decode attention hot path.
+"""Pallas TPU kernel for the batched-decode attention hot path.
 
 The XLA decode path reads every KV-cache position (max_seq) for every slot
 on every step — the measured throughput ceiling on v5e once dispatch RTT
-is amortized. These kernels make the cache access *ragged*: only the pages
+is amortized. This kernel makes the cache access *ragged*: only the pages
 covering each slot's valid prefix are DMA'd (TPU counterpart of the
 reference's per-slot `cache_tokens` raggedness, backend/cpp/llama/
 grpc-server.cpp:188-385 — and of its paged llama.cpp KV cache).
 
 Design notes (see /opt/skills/guides/pallas_guide.md):
-- cache layout stays head-FLAT [n_slots, max_seq, kv_dim]: full 128-lane
-  rows (kv_dim >= 512), no (H, 64) register padding, no relayouts.
+- cache layout stays head-FLAT [L, n_slots, max_seq, kv_dim]: full
+  128-lane rows (kv_dim >= 512), no (H, 64) register padding, no
+  relayouts. The kernel addresses the FULL stacked cache with a layer
+  scalar, so the caller's layer loop never slices or copies buffers.
+- ONE grid step per slot; an inner double-buffered manual-DMA loop walks
+  only that slot's valid pages (a grid=(S, n_pages) formulation pays
+  ~5us of fixed cost per page of max_seq, valid or not — measured
+  dominant on v5e). Flash-style (m, l, acc) accumulation across pages.
 - attention uses a block-diagonal q matrix ``wq [kv_dim, n_q_heads]``
   (column h carries q-head h's vector in the 64-lane band of its GQA kv
   head), so logits are ONE full-lane MXU matmul ``k_page @ wq`` — the 8x
   FLOP overhead is irrelevant at decode (bandwidth-bound).
-- pages beyond a slot's valid length are clamped in the index_map, so
-  Mosaic's block pipeline re-uses the resident block and skips the DMA;
-  compute is skipped with @pl.when. Flash-style (m, l, acc) accumulation
-  across pages; output emitted on each slot's last valid page.
-- the append kernel touches exactly ONE page per slot (input/output
-  aliased), replacing a full-cache dynamic_update_slice copy.
+- the kernel is READ-ONLY on the cache: the caller appends the current
+  K/V rows with an in-place scatter on the scan-carried cache (single
+  bf16 rows cannot be DMA'd into the (8,128)-tiled HBM buffer); their
+  attention contribution is seeded from VMEM and the HBM copy masked.
 """
 
 from __future__ import annotations
@@ -29,6 +33,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -45,157 +50,6 @@ def _interpret() -> bool:
     if dd is not None:
         return dd.platform != "tpu"
     return jax.default_backend() != "tpu"
-
-
-# ---------------------------------------------------------------------------
-# append: write this step's k/v row into the page containing `pos`
-# ---------------------------------------------------------------------------
-
-
-def _append_kernel(pos_ref, new_ref, page_in_ref, page_out_ref, *,
-                   max_pos: int):
-    b = pl.program_id(0)
-    off = jnp.minimum(pos_ref[b], max_pos) % PAGE
-    # masked whole-page write: mosaic cannot do dynamic sublane-unaligned
-    # stores (`ref[ds(off,1)] = ...` needs off % 8 == 0), a lane-wise select
-    # costs nothing extra (the page is already resident in VMEM)
-    row = jax.lax.broadcasted_iota(jnp.int32, (PAGE, 1), 0)
-    page_out_ref[0] = jnp.where(row == off, new_ref[0], page_in_ref[0])
-
-
-def paged_append(cache: jax.Array, new: jax.Array,
-                 pos: jax.Array) -> jax.Array:
-    """cache [S, SEQ, F] <- new [S, F] at per-slot positions pos [S].
-
-    Only the target page per slot is read+written (2*PAGE*F bytes/slot vs
-    the whole cache row for a fused XLA DUS inside a scan)."""
-    S, SEQ, F = cache.shape
-    # clamp like lax.dynamic_update_slice does: an out-of-range position
-    # (defensive — the engine guarantees pos < SEQ) writes at the last row
-    # instead of producing an out-of-range page index (undefined in mosaic)
-    page_map = (  # noqa: E731
-        lambda b, pos: (b, jnp.minimum(pos[b], SEQ - 1) // PAGE, 0)
-    )
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(S,),
-        in_specs=[
-            # [S, 1, F] with block (1, 1, F): trailing block dims equal the
-            # array dims, satisfying mosaic's (8, 128) block-divisibility
-            pl.BlockSpec((1, 1, F), lambda b, pos: (b, 0, 0)),  # new row
-            pl.BlockSpec((1, PAGE, F), page_map),  # aliased cache page
-        ],
-        out_specs=pl.BlockSpec((1, PAGE, F), page_map),
-    )
-    return pl.pallas_call(
-        functools.partial(_append_kernel, max_pos=SEQ - 1),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(cache.shape, cache.dtype),
-        input_output_aliases={2: 0},  # cache operand -> out (in-place page)
-        interpret=_interpret(),
-    )(pos, new[:, None, :], cache)
-
-
-# ---------------------------------------------------------------------------
-# attend: flash accumulation over valid pages only
-# ---------------------------------------------------------------------------
-
-
-def _attend_kernel(len_ref, wq_ref, k_ref, v_ref, out_ref,
-                   acc_ref, m_ref, l_ref, *, scale: float,
-                   sliding_window: Optional[int]):
-    b = pl.program_id(0)
-    p = pl.program_id(1)
-    n = len_ref[b]
-    n_pages = jax.lax.div(n + PAGE - 1, PAGE)
-
-    @pl.when(p == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
-
-    @pl.when(p < n_pages)
-    def _page():
-        k = k_ref[0]  # [PAGE, F]
-        wq = wq_ref[0]  # [F, H]
-        logits = jax.lax.dot_general(
-            k, wq, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [PAGE, H]
-        row = p * PAGE + jax.lax.broadcasted_iota(
-            jnp.int32, logits.shape, 0
-        )
-        valid = row < n
-        if sliding_window is not None:
-            valid &= row > (n - 1 - sliding_window)
-        logits = jnp.where(valid, logits, NEG_INF)
-
-        m_prev = m_ref[...]  # [1, H]
-        m_page = jnp.max(logits, axis=0, keepdims=True)  # [1, H]
-        m_new = jnp.maximum(m_prev, m_page)
-        alpha = jnp.exp(m_prev - m_new)  # [1, H]
-        pexp = jnp.exp(logits - m_new)  # [PAGE, H]
-        pexp = jnp.where(valid, pexp, 0.0)
-        l_ref[...] = l_ref[...] * alpha + jnp.sum(pexp, 0, keepdims=True)
-        v = v_ref[0]  # [PAGE, F]
-        pv = jax.lax.dot_general(
-            pexp, v, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [H, F]
-        acc_ref[...] = acc_ref[...] * alpha.T + pv
-        m_ref[...] = m_new
-
-    @pl.when(p == n_pages - 1)
-    def _emit():
-        out_ref[0] = (
-            acc_ref[...] / jnp.maximum(l_ref[...].T, 1e-30)
-        ).astype(out_ref.dtype)
-
-
-def paged_attend(
-    wq: jax.Array,  # [S, F, H] block-diagonal q matrices
-    cache_k: jax.Array,  # [S, SEQ, F]
-    cache_v: jax.Array,  # [S, SEQ, F]
-    lengths: jax.Array,  # [S] valid positions (incl. current token)
-    *,
-    scale: float,
-    sliding_window: Optional[int] = None,
-) -> jax.Array:
-    """Returns [S, H, F] f32: per q-head weighted V rows (still flat; the
-    caller extracts each head's 64-lane band)."""
-    S, SEQ, F = cache_k.shape
-    H = wq.shape[-1]
-    n_pages = SEQ // PAGE
-
-    def page_map(b, p, lens):
-        last = jax.lax.div(lens[b] + PAGE - 1, PAGE) - 1
-        return (b, jnp.minimum(p, last), 0)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(S, n_pages),
-        in_specs=[
-            pl.BlockSpec((1, F, H), lambda b, p, lens: (b, 0, 0)),
-            pl.BlockSpec((1, PAGE, F), page_map),
-            pl.BlockSpec((1, PAGE, F), page_map),
-        ],
-        out_specs=pl.BlockSpec((1, H, F), lambda b, p, lens: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((H, F), jnp.float32),
-            pltpu.VMEM((1, H), jnp.float32),
-            pltpu.VMEM((1, H), jnp.float32),
-        ],
-    )
-    kernel = functools.partial(
-        _attend_kernel, scale=scale, sliding_window=sliding_window
-    )
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S, H, F), jnp.float32),
-        interpret=_interpret(),
-    )(lengths, wq, cache_k, cache_v)
 
 
 # ---------------------------------------------------------------------------
@@ -227,21 +81,165 @@ def extract_head_bands(out: jax.Array, n_kv_heads: int,
     return outr[:, idx, :, idx, :].transpose(1, 0, 2, 3).reshape(S, H, d_head)
 
 
-def decode_attention(
-    q: jax.Array,  # [S, H, Dh] (post-rope)
-    cache_k: jax.Array,  # [S, SEQ, F]
+# ---------------------------------------------------------------------------
+# fused ragged attend: one grid step per slot, manual DMA over valid pages
+# ---------------------------------------------------------------------------
+#
+# The grid=(S, n_pages) kernel above pays a fixed per-grid-step cost for
+# every page of max_seq whether valid or not (~5us/step measured on v5e:
+# at 32 slots x 8 pages x 16 layers that alone is ~20ms per decode step).
+# This kernel runs ONE grid step per slot and walks only the slot's VALID
+# pages with double-buffered explicit DMA, so cost scales with the live
+# context, not max_seq. It addresses the FULL stacked [L, S, SEQ, F]
+# cache with a layer scalar, so the caller's layer loop never slices or
+# copies cache buffers. The kernel is READ-ONLY on the cache: the
+# current token's K/V row is appended by the caller (an in-place scatter
+# on the scan-carried cache — single bf16 rows cannot be DMA'd into the
+# (8,128)-tiled HBM buffer from inside the kernel); its attention
+# contribution is seeded from VMEM and its HBM copy masked out.
+
+
+def _fused_kernel(len_ref, layer_ref, wq_ref, newk_ref, newv_ref,
+                  ck_in, cv_in, out_ref,
+                  kbuf, vbuf, rsem, *,
+                  scale: float, sliding_window: Optional[int], page: int):
+    b = pl.program_id(0)
+    layer = layer_ref[0]
+    n = len_ref[b]  # valid length INCLUDING the current token
+    pos = jnp.maximum(n - 1, 0)  # current token's position
+
+    n_prev = pos  # tokens attended from HBM (current token rides in VMEM)
+    if sliding_window is not None:
+        lo = jnp.maximum(n - sliding_window, 0)  # first attended position
+        first_page = lax.div(lo, page)
+    else:
+        lo = 0
+        first_page = 0
+    n_pages = lax.div(n_prev + page - 1, page)
+
+    def get_dma(slot, p):
+        return (
+            pltpu.make_async_copy(
+                ck_in.at[layer, b, pl.ds(p * page, page), :],
+                kbuf.at[slot], rsem.at[slot, 0],
+            ),
+            pltpu.make_async_copy(
+                cv_in.at[layer, b, pl.ds(p * page, page), :],
+                vbuf.at[slot], rsem.at[slot, 1],
+            ),
+        )
+
+    @pl.when(first_page < n_pages)
+    def _():
+        k0, v0 = get_dma(0, first_page)
+        k0.start()
+        v0.start()
+
+    wq = wq_ref[0]  # [F, H]
+    # current token's contribution seeds the flash accumulator (it is
+    # always valid and needs no HBM read)
+    new_k_row = newk_ref[:].reshape(1, newk_ref.shape[-1])
+    new_v_row = newv_ref[:].reshape(1, newv_ref.shape[-1])
+    logit_c = jax.lax.dot_general(
+        new_k_row, wq, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [1, H]
+    m0 = logit_c  # [1, H]
+    l0 = jnp.ones_like(logit_c)
+    # seed accumulator: every head's row is exp(0)=1 times the current v
+    acc0 = jnp.tile(new_v_row.astype(jnp.float32), (wq.shape[1], 1))
+
+    def body(p, carry):
+        acc, m, l = carry
+        slot = lax.rem(p - first_page, 2)
+        nxt = lax.rem(p - first_page + 1, 2)
+
+        @pl.when(p + 1 < n_pages)
+        def _():
+            kn, vn = get_dma(nxt, p + 1)
+            kn.start()
+            vn.start()
+
+        kp, vp = get_dma(slot, p)
+        kp.wait()
+        vp.wait()
+        k = kbuf[slot]  # [page, F]
+        logits = jax.lax.dot_general(
+            k, wq, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [page, H]
+        row = p * page + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 0
+        )
+        valid = row < n_prev
+        if sliding_window is not None:
+            valid &= row >= lo
+        logits = jnp.where(valid, logits, NEG_INF)
+        m_page = jnp.max(logits, axis=0, keepdims=True)  # [1, H]
+        m_new = jnp.maximum(m, m_page)
+        alpha = jnp.exp(m - m_new)  # [1, H]
+        pexp = jnp.exp(logits - m_new)  # [page, H]
+        pexp = jnp.where(valid, pexp, 0.0)
+        l = l * alpha + jnp.sum(pexp, 0, keepdims=True)
+        pv = jax.lax.dot_general(
+            pexp, vbuf[slot], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [H, F]
+        acc = acc * alpha.T + pv
+        return acc, m_new, l
+
+    acc, m, l = lax.fori_loop(first_page, n_pages, body, (acc0, m0, l0))
+    out_ref[0] = (acc / jnp.maximum(l.T, 1e-30)).astype(out_ref.dtype)
+
+
+def fused_decode_attention(
+    q: jax.Array,  # [S, H, Dh] post-rope current-token queries
+    new_k: jax.Array,  # [S, F] post-rope current-token K rows
+    new_v: jax.Array,  # [S, F]
+    cache_k: jax.Array,  # [L, S, SEQ, F] FULL stacked cache, already
+    # containing the current rows at lengths-1 (caller scatter-appends)
     cache_v: jax.Array,
-    lengths: jax.Array,  # [S]
+    layer: jax.Array,  # [] i32 layer index
+    lengths: jax.Array,  # [S] valid positions INCLUDING current token
     n_kv_heads: int,
     *,
     scale: float,
     sliding_window: Optional[int] = None,
+    page: int = PAGE,
 ) -> jax.Array:
-    """Full ragged decode attention; returns [S, H * Dh]."""
-    S, H, Dh = q.shape
+    """Ragged decode attention over ``[0, lengths)`` of layer ``layer``;
+    the current token's K/V contribution is taken from ``new_k``/``new_v``
+    in VMEM (its HBM copy is masked out). Returns attn [S, H*Dh]."""
+    L, S, SEQ, F = cache_k.shape
+    H = q.shape[1]
     wq = build_block_diag_q(q, n_kv_heads)
-    out = paged_attend(
-        wq, cache_k, cache_v, lengths,
-        scale=scale, sliding_window=sliding_window,
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S,),
+        in_specs=[
+            pl.BlockSpec((1, F, H), lambda b, lens, lay: (b, 0, 0)),
+            pl.BlockSpec((1, 1, F), lambda b, lens, lay: (b, 0, 0)),
+            pl.BlockSpec((1, 1, F), lambda b, lens, lay: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # cache_k (HBM)
+            pl.BlockSpec(memory_space=pltpu.ANY),  # cache_v (HBM)
+        ],
+        out_specs=pl.BlockSpec((1, H, F), lambda b, lens, lay: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, page, F), cache_k.dtype),
+            pltpu.VMEM((2, page, F), cache_v.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
     )
-    return extract_head_bands(out, n_kv_heads, Dh).reshape(S, H * Dh)
+    kernel = functools.partial(
+        _fused_kernel, scale=scale, sliding_window=sliding_window, page=page
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, F), jnp.float32),
+        interpret=_interpret(),
+    )(lengths, layer[None], wq, new_k[:, None, :], new_v[:, None, :],
+      cache_k, cache_v)
+    return extract_head_bands(out, n_kv_heads, q.shape[2]).reshape(
+        S, H * q.shape[2]
+    )

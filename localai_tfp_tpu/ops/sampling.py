@@ -18,6 +18,7 @@ incrementally on-device: O(1) per step instead of re-scanning history.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import Optional
 
 import jax
@@ -110,6 +111,46 @@ jax.tree_util.register_pytree_node(
     ),
     lambda _, ch: SamplingState(*ch),
 )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def reset_slots(
+    state: SamplingState,
+    slot_ids: jax.Array,  # [K] i32; may repeat (padding rows repeat row 0)
+    temperature: jax.Array,  # [K] f32
+    top_k: jax.Array,  # [K] i32
+    top_p: jax.Array,  # [K] f32
+    min_p: jax.Array,  # [K] f32
+    repeat_penalty: jax.Array,  # [K] f32
+    freq_penalty: jax.Array,  # [K] f32
+    presence_penalty: jax.Array,  # [K] f32
+    repeat_last_n: jax.Array,  # [K] i32 (already clamped host-side)
+    seeds: jax.Array,  # [K] i32
+    has_seed: jax.Array,  # [K] bool
+) -> SamplingState:
+    """Configure a BATCH of slots in one donated dispatch.
+
+    ``reset_slot`` costs ~12 unbatched buffer copies per slot (including
+    the [S, V] count matrix) — ~25ms/slot through a tunneled chip, which
+    dominated admission waves. Duplicate padding rows must carry row 0's
+    values so the scatter stays deterministic."""
+    keys = jax.vmap(jax.random.PRNGKey)(seeds)  # [K, 2]
+    rng_rows = jnp.where(has_seed[:, None], keys, state.rng[slot_ids])
+    return SamplingState(
+        rng=state.rng.at[slot_ids].set(rng_rows),
+        temperature=state.temperature.at[slot_ids].set(temperature),
+        top_k=state.top_k.at[slot_ids].set(top_k),
+        top_p=state.top_p.at[slot_ids].set(top_p),
+        min_p=state.min_p.at[slot_ids].set(min_p),
+        repeat_penalty=state.repeat_penalty.at[slot_ids].set(repeat_penalty),
+        freq_penalty=state.freq_penalty.at[slot_ids].set(freq_penalty),
+        presence_penalty=state.presence_penalty.at[slot_ids].set(
+            presence_penalty),
+        token_counts=state.token_counts.at[slot_ids].set(0),
+        history=state.history.at[slot_ids].set(-1),
+        history_pos=state.history_pos.at[slot_ids].set(0),
+        repeat_last_n=state.repeat_last_n.at[slot_ids].set(repeat_last_n),
+    )
 
 
 def observe_tokens(state: SamplingState, slot_ids: jax.Array,

@@ -1,4 +1,4 @@
-"""Pallas paged decode-attention kernels vs dense reference (interpret
+"""Pallas ragged decode-attention kernel vs dense reference (interpret
 mode on CPU; the same code path compiles with Mosaic on TPU)."""
 
 import jax
@@ -7,8 +7,7 @@ import numpy as np
 import pytest
 
 from localai_tfp_tpu.ops.decode_attention import (
-    PAGE, build_block_diag_q, decode_attention, extract_head_bands,
-    paged_append,
+    build_block_diag_q, extract_head_bands, fused_decode_attention,
 )
 
 S, SEQ, HKV, DH, H = 4, 512, 2, 32, 8  # group = 4
@@ -60,29 +59,54 @@ def test_block_diag_roundtrip():
         assert np.all(other == 0)
 
 
-def test_paged_append_matches_dus():
-    cache = _rand(S, SEQ, F, seed=2)
-    new = _rand(S, F, seed=3)
-    pos = jnp.asarray([0, 5, PAGE - 1, PAGE + 7], jnp.int32)
-    out = paged_append(cache, new, pos)
-    ref = np.array(cache)
-    for b in range(S):
-        ref[b, int(pos[b])] = np.asarray(new)[b]
-    np.testing.assert_allclose(np.asarray(out), ref)
-
-
 @pytest.mark.parametrize("window", [None, 100])
-def test_decode_attention_matches_dense(window):
-    ck = _rand(S, SEQ, F, seed=4)
-    cv = _rand(S, SEQ, F, seed=5)
-    q = _rand(S, H, DH, seed=6) * 0.3
-    lengths = jnp.asarray([1, 37, 256, 300], jnp.int32)
+def test_fused_decode_attention_matches_dense(window):
+    """The per-slot manual-DMA kernel (read-only cache, VMEM-seeded
+    current token) against the dense reference."""
+    L = 3
+    ck = _rand(L, S, SEQ, F, seed=8)
+    cv = _rand(L, S, SEQ, F, seed=9)
+    q = _rand(S, H, DH, seed=10) * 0.3
+    new_k = _rand(S, F, seed=11)
+    new_v = _rand(S, F, seed=12)
+    lengths = jnp.asarray([1, 37, 256, 300], jnp.int32)  # incl current
     scale = 1.0 / np.sqrt(DH)
-    out = decode_attention(
-        q, ck, cv, lengths, HKV, scale=scale, sliding_window=window
+    rows = jnp.arange(S)
+    ck2 = ck.at[1, rows, lengths - 1, :].set(new_k)
+    cv2 = cv.at[1, rows, lengths - 1, :].set(new_v)
+    out = fused_decode_attention(
+        q, new_k, new_v, ck2, cv2, jnp.asarray(1, jnp.int32), lengths,
+        HKV, scale=scale, sliding_window=window,
     )
-    ref = _reference(q, ck, cv, lengths, scale, window)
+    ref = _reference(q, ck2[1], cv2[1], lengths, scale, window)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_fused_kernel_wrong_layer_untouched():
+    """The layer scalar must select the right [L] slab."""
+    L = 2
+    ck = _rand(L, S, SEQ, F, seed=13)
+    cv = _rand(L, S, SEQ, F, seed=14)
+    q = _rand(S, H, DH, seed=15) * 0.3
+    new_k = _rand(S, F, seed=16)
+    new_v = _rand(S, F, seed=17)
+    lengths = jnp.asarray([5, 9, 17, 33], jnp.int32)
+    rows = jnp.arange(S)
+    scale = 1.0 / np.sqrt(DH)
+    outs = []
+    for layer in range(L):
+        ckw = ck.at[layer, rows, lengths - 1, :].set(new_k)
+        cvw = cv.at[layer, rows, lengths - 1, :].set(new_v)
+        out = fused_decode_attention(
+            q, new_k, new_v, ckw, cvw, jnp.asarray(layer, jnp.int32),
+            lengths, HKV, scale=scale,
+        )
+        ref = _reference(q, ckw[layer], cvw[layer], lengths, scale)
+        np.testing.assert_allclose(np.asarray(out), ref,
+                                   rtol=2e-4, atol=2e-4)
+        outs.append(np.asarray(out))
+    # different layers hold different K/V, so outputs must differ
+    assert not np.allclose(outs[0], outs[1])
 
 
 def test_extract_head_bands_shape():
