@@ -138,6 +138,11 @@ def build_parser() -> argparse.ArgumentParser:
     fit.add_argument("--context-size", type=int, default=4096)
     fit.add_argument("--batch-slots", type=int, default=8)
     fit.add_argument("--dtype", default="bfloat16")
+    fit.add_argument("--kv-dtype", default="",
+                     help="KV cache dtype (defaults to --dtype; int8 KV "
+                          "serving halves the cache)")
+    fit.add_argument("--quantization", default="",
+                     help="weight-only quantization mode (e.g. int8)")
 
     return p
 
@@ -332,6 +337,15 @@ def main(argv: Optional[list[str]] = None) -> None:
                     print(f"skipping malformed asset entry: {a!r}")
                     continue
                 dst = os.path.join(args.dest_dir, name)
+                # a YAML-supplied "../../.bashrc" must not escape the
+                # destination (same traversal guard as OCI extraction)
+                root = os.path.realpath(args.dest_dir)
+                real = os.path.realpath(dst)
+                if os.path.isabs(name) or (
+                        real != root
+                        and not real.startswith(root + os.sep)):
+                    print(f"skipping unsafe asset filename: {name!r}")
+                    continue
                 URI(url).download(
                     dst, sha256=a.get("sha256") or a.get("sha") or "")
                 print(f"downloaded {name}")
@@ -343,7 +357,9 @@ def main(argv: Optional[list[str]] = None) -> None:
             est = estimate_model_bytes(
                 args.model_dir, dtype=args.dtype,
                 context_size=args.context_size,
-                batch_slots=args.batch_slots)
+                batch_slots=args.batch_slots,
+                kv_dtype=args.kv_dtype,
+                quantization=args.quantization)
             est["fits"] = fits_in_memory(args.model_dir, est=est)
             print(_json.dumps(est, indent=2))
         else:

@@ -94,7 +94,7 @@ class URI:
         if offset:
             req.add_header("Range", f"bytes={offset}-")
         mode = "ab" if offset else "wb"
-        with urllib.request.urlopen(req) as resp:
+        with _opener().open(req) as resp:
             if offset and resp.status != 206:
                 mode, offset = "wb", 0  # server ignored the range
             total = offset + int(resp.headers.get("Content-Length") or 0)
@@ -119,6 +119,35 @@ class URI:
         return dst
 
 
+class _AuthStripRedirect(urllib.request.HTTPRedirectHandler):
+    """Drop the Authorization header when a redirect crosses hosts.
+
+    Real registries (registry.ollama.ai, Docker Hub) 307-redirect blob
+    GETs to presigned CDN URLs (S3/R2), which reject requests carrying a
+    second auth mechanism — and forwarding the bearer token would leak it
+    to the CDN host. go-containerregistry/docker clients strip it the
+    same way.
+    """
+
+    def redirect_request(self, req, fp, code, msg, hdrs, newurl):
+        new = super().redirect_request(req, fp, code, msg, hdrs, newurl)
+        if new is not None:
+            import urllib.parse
+
+            old = urllib.parse.urlsplit(req.full_url)
+            cur = urllib.parse.urlsplit(new.full_url)
+            # host:port comparison, like go-containerregistry's
+            # "newURL.Host != originalURL.Host" check
+            if ((old.hostname, old.port) != (cur.hostname, cur.port)
+                    and new.has_header("Authorization")):
+                new.remove_header("Authorization")
+        return new
+
+
+def _opener() -> urllib.request.OpenerDirector:
+    return urllib.request.build_opener(_AuthStripRedirect())
+
+
 def _sha256(path: str) -> str:
     h = hashlib.sha256()
     with open(path, "rb") as f:
@@ -130,6 +159,34 @@ def _sha256(path: str) -> str:
 # ---------------------------------------------------------------------------
 # OCI / ollama registry pulls (ref: pkg/oci/image.go:153, ollama.go:88)
 # ---------------------------------------------------------------------------
+
+def _tar_member_safe(member, dst: str) -> bool:
+    """Manual stand-in for tarfile's 'data' extraction filter on Pythons
+    that predate it: reject device nodes, absolute/escaping paths, and
+    links whose target escapes the destination."""
+    import tarfile
+
+    if member.isdev():
+        return False
+    root = os.path.realpath(dst)
+    target = os.path.realpath(os.path.join(dst, member.name))
+    if target != root and not target.startswith(root + os.sep):
+        return False
+    if member.issym():
+        # symlink targets resolve relative to the member's directory
+        link = os.path.realpath(os.path.join(
+            os.path.dirname(os.path.join(dst, member.name)),
+            member.linkname))
+        if link != root and not link.startswith(root + os.sep):
+            return False
+    elif member.islnk():
+        # HARDLINK targets resolve relative to the extraction ROOT
+        # (tarfile: _link_target = os.path.join(path, linkname))
+        link = os.path.realpath(os.path.join(dst, member.linkname))
+        if link != root and not link.startswith(root + os.sep):
+            return False
+    return isinstance(member, tarfile.TarInfo)
+
 
 OLLAMA_REGISTRY = "https://registry.ollama.ai"
 
@@ -302,6 +359,11 @@ def pull_oci_model(raw: str, dst: str,
                         tf.extract(member, dst, filter="data")
                     except tarfile.FilterError:
                         continue  # skip unsafe members, keep the rest
+                    except TypeError:
+                        # pre-3.10.12/3.11.4: no extraction-filter
+                        # support — apply the equivalent guards manually
+                        if _tar_member_safe(member, dst):
+                            tf.extract(member, dst)
         finally:
             for leftover in (tmp_path, tmp_path + ".partial"):
                 if os.path.exists(leftover):

@@ -208,6 +208,9 @@ async def tts(request: web.Request) -> web.Response:
 async def tts_elevenlabs(request: web.Request) -> web.Response:
     """ref: elevenlabs/tts.go — voice id in path, model in body."""
     body = await _body(request)
+    # same typed-400 contract as /tts: a non-string "text" must be a
+    # schema error, not a 500 from deep inside the worker
+    schema.TTSRequest.validate(body)
     return await _tts_impl(
         request, body.get("text", ""), body.get("model_id"),
         request.match_info["voice_id"],

@@ -56,14 +56,27 @@ def _safetensors_param_count(path: str) -> int:
     return total
 
 
+def _dtype_bytes(name: str) -> int:
+    n = (name or "").lower()
+    if n in ("int8", "i8", "q8", "q8_0"):
+        return 1
+    if n in ("bfloat16", "bf16", "float16", "f16", "half"):
+        return 2
+    return 4
+
+
 def estimate_model_bytes(model_dir: str, dtype: str = "bfloat16",
                          context_size: int = 4096,
-                         batch_slots: int = 8) -> dict[str, int]:
+                         batch_slots: int = 8,
+                         kv_dtype: str = "",
+                         quantization: str = "") -> dict[str, int]:
     """HBM footprint estimate for an HF checkpoint dir: element counts
     from the safetensors headers times the SERVING dtype width (disk
     dtype is irrelevant once loaded), KV cache at the given shape, and a
     fudge for activations/compiler scratch (ref: xsysinfo gguf
-    VRAM-fit)."""
+    VRAM-fit). ``kv_dtype`` defaults to the serving dtype (int8 KV and
+    float32 serving are both supported); ``quantization`` (e.g. "int8")
+    accounts for weight-only quantized serving."""
     n_params = 0
     for f in os.listdir(model_dir):
         if f.endswith(".safetensors") and not f.startswith("."):
@@ -71,7 +84,8 @@ def estimate_model_bytes(model_dir: str, dtype: str = "bfloat16",
         elif f.endswith(".bin") and "training" not in f:
             # torch .bin shards are f32 by convention
             n_params += os.path.getsize(os.path.join(model_dir, f)) // 4
-    per = 2 if dtype.lower() in ("bfloat16", "bf16", "float16", "f16") else 4
+    per = (_dtype_bytes(quantization) if quantization
+           else _dtype_bytes(dtype))
     params = n_params * per
     kv = 0
     cfg_path = os.path.join(model_dir, "config.json")
@@ -86,7 +100,9 @@ def estimate_model_bytes(model_dir: str, dtype: str = "bfloat16",
         d_head = int(cfg.get("head_dim")
                      or (cfg.get("hidden_size") or 0)
                      // max(cfg.get("num_attention_heads") or 1, 1))
-        kv = 2 * layers * batch_slots * context_size * heads * d_head * 2
+        kv_per = _dtype_bytes(kv_dtype or dtype)
+        kv = (2 * layers * batch_slots * context_size * heads * d_head
+              * kv_per)
     total = params + kv
     return {
         "param_bytes": int(params),

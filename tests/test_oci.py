@@ -185,3 +185,81 @@ def test_oci_digest_pinned_reference(registry, tmp_path):
         dl.pull_oci_model(
             f"oci://{base}/acme/artifact@sha256:{'0' * 64}",
             str(tmp_path / "x.bin"))
+
+
+def test_blob_redirect_strips_auth_cross_host(tmp_path):
+    """Registries 307-redirect blob GETs to presigned CDN URLs; the
+    bearer token must NOT follow to the other host (presigned endpoints
+    reject a second auth mechanism, and forwarding leaks the token)."""
+    import localai_tfp_tpu.gallery.downloader as dl
+
+    data = b"blob-on-the-cdn"
+    digest = "sha256:" + hashlib.sha256(data).hexdigest()
+    seen = {}
+
+    class CDN(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            seen["auth"] = self.headers.get("Authorization")
+            if seen["auth"] is not None:
+                # S3/R2 presigned behavior: only one auth mechanism
+                self.send_response(400)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    cdn = HTTPServer(("127.0.0.1", 0), CDN)
+    cdn_port = cdn.server_port
+
+    manifest = {"schemaVersion": 2,
+                "layers": [{"digest": digest, "size": len(data)}]}
+
+    class Registry(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path.startswith("/token"):
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(json.dumps({"token": "sek"}).encode())
+                return
+            if self.headers.get("Authorization") != "Bearer sek":
+                self.send_response(401)
+                self.send_header(
+                    "Www-Authenticate",
+                    f'Bearer realm="http://127.0.0.1:{rport}/token",'
+                    f'service="reg",scope="repository:x:pull"')
+                self.end_headers()
+                return
+            if "/manifests/" in self.path:
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(json.dumps(manifest).encode())
+            elif "/blobs/" in self.path:
+                self.send_response(307)
+                self.send_header(
+                    "Location",
+                    f"http://127.0.0.1:{cdn_port}/presigned/{digest}")
+                self.end_headers()
+
+    reg = HTTPServer(("127.0.0.1", 0), Registry)
+    rport = reg.server_port
+    for srv in (cdn, reg):
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        dst = str(tmp_path / "blob.bin")
+        out = dl.pull_oci_model(
+            f"oci://http://127.0.0.1:{rport}/acme/thing:v1", dst)
+        assert out == dst
+        with open(dst, "rb") as f:
+            assert f.read() == data
+        assert seen["auth"] is None  # token stripped at the CDN hop
+    finally:
+        cdn.shutdown()
+        reg.shutdown()
