@@ -336,14 +336,13 @@ def main(argv: Optional[list[str]] = None) -> None:
                 if not name or not url:
                     print(f"skipping malformed asset entry: {a!r}")
                     continue
+                from .gallery.downloader import is_within
+
                 dst = os.path.join(args.dest_dir, name)
                 # a YAML-supplied "../../.bashrc" must not escape the
                 # destination (same traversal guard as OCI extraction)
-                root = os.path.realpath(args.dest_dir)
-                real = os.path.realpath(dst)
-                if os.path.isabs(name) or (
-                        real != root
-                        and not real.startswith(root + os.sep)):
+                if os.path.isabs(name) or not is_within(args.dest_dir,
+                                                        dst):
                     print(f"skipping unsafe asset filename: {name!r}")
                     continue
                 URI(url).download(

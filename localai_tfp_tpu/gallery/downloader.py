@@ -160,6 +160,15 @@ def _sha256(path: str) -> str:
 # OCI / ollama registry pulls (ref: pkg/oci/image.go:153, ollama.go:88)
 # ---------------------------------------------------------------------------
 
+def is_within(root: str, path: str) -> bool:
+    """True when ``path`` resolves inside ``root`` (realpath containment
+    — the one traversal guard shared by tar extraction, whiteouts and
+    asset downloads)."""
+    rr = os.path.realpath(root)
+    rp = os.path.realpath(path)
+    return rp == rr or rp.startswith(rr + os.sep)
+
+
 def _tar_member_safe(member, dst: str) -> bool:
     """Manual stand-in for tarfile's 'data' extraction filter on Pythons
     that predate it: reject device nodes, absolute/escaping paths, and
@@ -168,22 +177,18 @@ def _tar_member_safe(member, dst: str) -> bool:
 
     if member.isdev():
         return False
-    root = os.path.realpath(dst)
-    target = os.path.realpath(os.path.join(dst, member.name))
-    if target != root and not target.startswith(root + os.sep):
+    if not is_within(dst, os.path.join(dst, member.name)):
         return False
     if member.issym():
         # symlink targets resolve relative to the member's directory
-        link = os.path.realpath(os.path.join(
-            os.path.dirname(os.path.join(dst, member.name)),
-            member.linkname))
-        if link != root and not link.startswith(root + os.sep):
+        if not is_within(dst, os.path.join(
+                os.path.dirname(os.path.join(dst, member.name)),
+                member.linkname)):
             return False
     elif member.islnk():
         # HARDLINK targets resolve relative to the extraction ROOT
         # (tarfile: _link_target = os.path.join(path, linkname))
-        link = os.path.realpath(os.path.join(dst, member.linkname))
-        if link != root and not link.startswith(root + os.sep):
+        if not is_within(dst, os.path.join(dst, member.linkname)):
             return False
     return isinstance(member, tarfile.TarInfo)
 
@@ -224,7 +229,7 @@ def _registry_get(url: str, accept: str = "", registry: str = "",
         headers["Authorization"] = f"Bearer {token}"
     req = urllib.request.Request(url, headers=headers)
     try:
-        return urllib.request.urlopen(req)
+        return _opener().open(req)  # auth stripped on cross-host redirect
     except urllib.error.HTTPError as e:
         if e.code != 401 or retried:
             raise

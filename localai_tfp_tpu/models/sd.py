@@ -1,0 +1,709 @@
+"""Stable-Diffusion-class text-to-image pipeline in JAX.
+
+Serves REAL checkpoints in the diffusers directory layout (the format the
+reference's diffusers backend loads — backend/python/diffusers/backend.py
+:139-272 pipeline switch, :304-350 GenerateImage): CLIP text encoder +
+UNet2DConditionModel + AutoencoderKL decoder + DDIM scheduler, with
+classifier-free guidance. No diffusers dependency: weights are imported
+straight from the component safetensors by a mechanical key-tree mapping
+(same technique as models/hf_loader.py for LLMs).
+
+Coverage: SD 1.x / 2.x class single-text-encoder pipelines, conv or
+linear transformer projections, epsilon or v-prediction. SDXL's dual
+text towers and added-cond embeddings are a follow-up.
+
+TPU-first: NHWC layout end to end, the full denoise loop is ONE
+``lax.scan`` on device (same dispatch-amortization rationale as the LLM
+decode loop), f32 numerics.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# ---------------------------------------------------------------------------
+# generic checkpoint import: safetensors keys -> nested param tree
+# ---------------------------------------------------------------------------
+
+_EMBED_MARKERS = ("token_embedding", "position_embedding",
+                  "shared.weight", "embeddings.weight")
+
+
+def _is_embedding(key: str) -> bool:
+    return any(m in key for m in _EMBED_MARKERS)
+
+
+def load_component_tree(component_dir: str) -> tuple[dict, dict]:
+    """(param tree, config dict) for one diffusers component directory.
+
+    Mapping rules: conv kernels OIHW -> HWIO; linear weights [out, in] ->
+    [in, out] (right-matmul convention, like hf_loader); embeddings and
+    1-D norm params pass through. Tree structure mirrors the checkpoint
+    key paths, so the forward code reads like the architecture."""
+    cfg = {}
+    cfg_path = os.path.join(component_dir, "config.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+
+    tensors: dict[str, np.ndarray] = {}
+    for fname in sorted(os.listdir(component_dir)):
+        path = os.path.join(component_dir, fname)
+        if fname.endswith(".safetensors"):
+            from safetensors import safe_open
+
+            with safe_open(path, framework="np") as f:
+                for key in f.keys():
+                    tensors[key] = f.get_tensor(key)
+        elif fname.endswith(".bin") and "training" not in fname:
+            import torch
+
+            state = torch.load(path, map_location="cpu",
+                               weights_only=True)
+            for key, t in state.items():
+                tensors[key] = t.float().numpy()
+
+    tree: dict = {}
+    for key, arr in tensors.items():
+        if key.endswith("position_ids"):
+            continue  # CLIP buffer, not a weight
+        arr = np.asarray(arr)
+        if arr.dtype != np.float32:
+            arr = arr.astype(np.float32)
+        if key.endswith(".weight"):
+            if arr.ndim == 4:
+                arr = arr.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+            elif arr.ndim == 2 and not _is_embedding(key):
+                arr = arr.T  # [out, in] -> [in, out]
+        node = tree
+        parts = key.split(".")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = jnp.asarray(arr)
+    return tree, cfg
+
+
+def tree_keys(tree: dict, prefix: str = "") -> list[str]:
+    out = []
+    for k, v in tree.items():
+        p = f"{prefix}.{k}" if prefix else k
+        if isinstance(v, dict):
+            out.extend(tree_keys(v, p))
+        else:
+            out.append(p)
+    return out
+
+
+class _RecDict:
+    """Dict view that records every LEAF access into ``sink`` — used by
+    the key-consumption check so tests can assert the forward code
+    touched every imported tensor (a silently unused tensor is a wiring
+    bug)."""
+
+    def __init__(self, node: dict, path: str, sink: set) -> None:
+        self._node = node
+        self._path = path
+        self._sink = sink
+
+    def __getitem__(self, k: str) -> Any:
+        v = self._node[k]
+        p = f"{self._path}.{k}" if self._path else k
+        if isinstance(v, dict):
+            return _RecDict(v, p, self._sink)
+        self._sink.add(p)
+        return v
+
+    def __contains__(self, k: str) -> bool:
+        return k in self._node
+
+    def __len__(self) -> int:
+        return len(self._node)
+
+    def keys(self):
+        return self._node.keys()
+
+
+def _g(node: Any, path: str) -> Any:
+    """Fetch a subtree/leaf by dotted path."""
+    cur = node
+    for part in path.split("."):
+        cur = cur[part]
+    return cur
+
+
+def _has(node: Any, path: str) -> bool:
+    cur = node
+    for part in path.split("."):
+        if part not in cur:
+            return False
+        cur = cur[part]
+    return True
+
+
+# ---------------------------------------------------------------------------
+# primitives (NHWC)
+# ---------------------------------------------------------------------------
+
+
+def _conv(p: dict, x: jax.Array, stride: int = 1) -> jax.Array:
+    out = lax.conv_general_dilated(
+        x, p["weight"], (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    if "bias" in p:
+        out = out + p["bias"]
+    return out
+
+
+def _linear(p: dict, x: jax.Array) -> jax.Array:
+    out = x @ p["weight"]
+    if "bias" in p:
+        out = out + p["bias"]
+    return out
+
+
+def _group_norm(p: dict, x: jax.Array, groups: int = 32,
+                eps: float = 1e-5) -> jax.Array:
+    B = x.shape[0]
+    C = x.shape[-1]
+    g = min(groups, C)
+    spatial = x.shape[1:-1]
+    xr = x.reshape(B, -1, g, C // g)
+    mu = xr.mean(axis=(1, 3), keepdims=True)
+    var = xr.var(axis=(1, 3), keepdims=True)
+    xr = (xr - mu) * lax.rsqrt(var + eps)
+    out = xr.reshape(B, *spatial, C)
+    return out * p["weight"] + p["bias"]
+
+
+def _layer_norm(p: dict, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * p["weight"] + p["bias"]
+
+
+def _attention(p: dict, x: jax.Array, context: jax.Array,
+               heads: int, mask: Optional[jax.Array] = None) -> jax.Array:
+    """diffusers Attention: to_q/to_k/to_v (no bias in UNet), to_out.0."""
+    B, T, C = x.shape
+    q = _linear(p["to_q"], x)
+    k = _linear(p["to_k"], context)
+    v = _linear(p["to_v"], context)
+    dh = q.shape[-1] // heads
+    S = k.shape[1]
+    q = q.reshape(B, T, heads, dh).transpose(0, 2, 1, 3)
+    k = k.reshape(B, S, heads, dh).transpose(0, 2, 1, 3)
+    v = v.reshape(B, S, heads, dh).transpose(0, 2, 1, 3)
+    logits = jnp.einsum("bhtd,bhsd->bhts", q, k) / math.sqrt(dh)
+    if mask is not None:
+        logits = logits + mask
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhts,bhsd->bhtd", probs, v)
+    out = out.transpose(0, 2, 1, 3).reshape(B, T, heads * dh)
+    return _linear(p["to_out"]["0"], out)
+
+
+# ---------------------------------------------------------------------------
+# CLIP text encoder (transformers CLIPTextModel layout)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class CLIPTextSpec:
+    vocab_size: int = 49408
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_position: int = 77
+    hidden_act: str = "quick_gelu"
+    eps: float = 1e-5
+
+
+def clip_spec_from_config(cfg: dict) -> CLIPTextSpec:
+    return CLIPTextSpec(
+        vocab_size=int(cfg.get("vocab_size", 49408)),
+        d_model=int(cfg.get("hidden_size", 768)),
+        n_layers=int(cfg.get("num_hidden_layers", 12)),
+        n_heads=int(cfg.get("num_attention_heads", 12)),
+        d_ff=int(cfg.get("intermediate_size", 3072)),
+        max_position=int(cfg.get("max_position_embeddings", 77)),
+        hidden_act=str(cfg.get("hidden_act", "quick_gelu")),
+        eps=float(cfg.get("layer_norm_eps", 1e-5)),
+    )
+
+
+def _clip_act(spec: CLIPTextSpec, x: jax.Array) -> jax.Array:
+    if spec.hidden_act == "quick_gelu":
+        return x * jax.nn.sigmoid(1.702 * x)
+    return jax.nn.gelu(x, approximate=False)
+
+
+def clip_text_encode(spec: CLIPTextSpec, tree: dict,
+                     ids: jax.Array) -> jax.Array:
+    """ids [B, T] -> last hidden state [B, T, d] (post final_layer_norm),
+    matching transformers CLIPTextModel.last_hidden_state."""
+    tm = _g(tree, "text_model")
+    B, T = ids.shape
+    x = _g(tm, "embeddings.token_embedding.weight")[ids]
+    x = x + _g(tm, "embeddings.position_embedding.weight")[:T]
+    causal = jnp.where(
+        jnp.arange(T)[None, :] <= jnp.arange(T)[:, None], 0.0, -1e9
+    )[None, None]  # [1, 1, T, T]
+    for i in range(spec.n_layers):
+        lp = _g(tm, f"encoder.layers.{i}")
+        h = _layer_norm(lp["layer_norm1"], x, spec.eps)
+        q = _linear(lp["self_attn"]["q_proj"], h)
+        k = _linear(lp["self_attn"]["k_proj"], h)
+        v = _linear(lp["self_attn"]["v_proj"], h)
+        dh = spec.d_model // spec.n_heads
+        qh = q.reshape(B, T, spec.n_heads, dh).transpose(0, 2, 1, 3)
+        kh = k.reshape(B, T, spec.n_heads, dh).transpose(0, 2, 1, 3)
+        vh = v.reshape(B, T, spec.n_heads, dh).transpose(0, 2, 1, 3)
+        logits = jnp.einsum("bhtd,bhsd->bhts", qh, kh) / math.sqrt(dh)
+        probs = jax.nn.softmax(logits + causal, axis=-1)
+        attn = jnp.einsum("bhts,bhsd->bhtd", probs, vh)
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, T, spec.d_model)
+        x = x + _linear(lp["self_attn"]["out_proj"], attn)
+        h = _layer_norm(lp["layer_norm2"], x, spec.eps)
+        h = _linear(lp["mlp"]["fc1"], h)
+        h = _clip_act(spec, h)
+        x = x + _linear(lp["mlp"]["fc2"], h)
+    return _layer_norm(_g(tm, "final_layer_norm"), x, spec.eps)
+
+
+# ---------------------------------------------------------------------------
+# UNet2DConditionModel (diffusers layout)
+# ---------------------------------------------------------------------------
+
+
+def _timestep_embedding(t: jax.Array, dim: int) -> jax.Array:
+    """diffusers get_timestep_embedding with flip_sin_to_cos=True,
+    downscale_freq_shift=0: [cos | sin] ordering."""
+    half = dim // 2
+    freqs = jnp.exp(
+        -math.log(10000.0) * jnp.arange(half, dtype=jnp.float32) / half
+    )
+    args = t[:, None].astype(jnp.float32) * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def _resnet(p: dict, x: jax.Array, temb: Optional[jax.Array],
+            groups: int = 32, eps: float = 1e-5) -> jax.Array:
+    """diffusers ResnetBlock2D."""
+    h = _conv(p["conv1"], jax.nn.silu(_group_norm(p["norm1"], x,
+                                                  groups, eps)))
+    if temb is not None and "time_emb_proj" in p:
+        h = h + _linear(p["time_emb_proj"],
+                        jax.nn.silu(temb))[:, None, None, :]
+    h = _conv(p["conv2"], jax.nn.silu(_group_norm(p["norm2"], h,
+                                                  groups, eps)))
+    if "conv_shortcut" in p:
+        x = _conv(p["conv_shortcut"], x)
+    return x + h
+
+
+def _basic_transformer(p: dict, x: jax.Array, context: jax.Array,
+                       heads: int) -> jax.Array:
+    """diffusers BasicTransformerBlock: self-attn, cross-attn, GEGLU ff."""
+    h = _layer_norm(p["norm1"], x)
+    x = x + _attention(p["attn1"], h, h, heads)
+    h = _layer_norm(p["norm2"], x)
+    x = x + _attention(p["attn2"], h, context, heads)
+    h = _layer_norm(p["norm3"], x)
+    hidden = _linear(p["ff"]["net"]["0"]["proj"], h)
+    a, gate = jnp.split(hidden, 2, axis=-1)
+    x = x + _linear(p["ff"]["net"]["2"], a * jax.nn.gelu(gate,
+                                                         approximate=False))
+    return x
+
+
+def _spatial_transformer(p: dict, x: jax.Array, context: jax.Array,
+                         heads: int, groups: int = 32) -> jax.Array:
+    """diffusers Transformer2DModel (conv OR linear projections)."""
+    B, H, W, C = x.shape
+    residual = x
+    h = _group_norm(p["norm"], x, groups, eps=1e-6)
+    conv_proj = p["proj_in"]["weight"].ndim == 4
+    if conv_proj:
+        h = _conv(p["proj_in"], h)
+        h = h.reshape(B, H * W, -1)
+    else:
+        h = _linear(p["proj_in"], h.reshape(B, H * W, C))
+    n_blocks = len(p["transformer_blocks"])
+    for i in range(n_blocks):
+        h = _basic_transformer(p["transformer_blocks"][str(i)], h,
+                               context, heads)
+    if conv_proj:
+        h = _conv(p["proj_out"], h.reshape(B, H, W, -1))
+    else:
+        h = _linear(p["proj_out"], h).reshape(B, H, W, C)
+    return h + residual
+
+
+@dataclass(frozen=True)
+class UNetSpec:
+    block_out_channels: tuple[int, ...] = (320, 640, 1280, 1280)
+    down_block_types: tuple[str, ...] = (
+        "CrossAttnDownBlock2D", "CrossAttnDownBlock2D",
+        "CrossAttnDownBlock2D", "DownBlock2D")
+    up_block_types: tuple[str, ...] = (
+        "UpBlock2D", "CrossAttnUpBlock2D", "CrossAttnUpBlock2D",
+        "CrossAttnUpBlock2D")
+    layers_per_block: int = 2
+    attention_head_dim: Any = 8  # int or per-block tuple; SD convention:
+    # this is the HEAD COUNT for Transformer2D (diffusers quirk)
+    cross_attention_dim: int = 768
+    in_channels: int = 4
+    norm_num_groups: int = 32
+
+
+def unet_spec_from_config(cfg: dict) -> UNetSpec:
+    return UNetSpec(
+        block_out_channels=tuple(cfg.get("block_out_channels",
+                                         (320, 640, 1280, 1280))),
+        down_block_types=tuple(cfg.get("down_block_types", (
+            "CrossAttnDownBlock2D", "CrossAttnDownBlock2D",
+            "CrossAttnDownBlock2D", "DownBlock2D"))),
+        up_block_types=tuple(cfg.get("up_block_types", (
+            "UpBlock2D", "CrossAttnUpBlock2D", "CrossAttnUpBlock2D",
+            "CrossAttnUpBlock2D"))),
+        layers_per_block=int(cfg.get("layers_per_block", 2)),
+        attention_head_dim=cfg.get("attention_head_dim", 8),
+        cross_attention_dim=int(cfg.get("cross_attention_dim", 768)),
+        in_channels=int(cfg.get("in_channels", 4)),
+        norm_num_groups=int(cfg.get("norm_num_groups", 32)),
+    )
+
+
+def _heads_for(spec: UNetSpec, block_idx: int) -> int:
+    ahd = spec.attention_head_dim
+    if isinstance(ahd, (list, tuple)):
+        return int(ahd[block_idx])
+    return int(ahd)
+
+
+def unet_forward(spec: UNetSpec, tree: dict, x: jax.Array, t: jax.Array,
+                 context: jax.Array) -> jax.Array:
+    """x [B, h, w, in_channels] latents; t [B]; context [B, Tc, d_cond].
+    Returns the predicted noise/v [B, h, w, in_channels]."""
+    g = spec.norm_num_groups
+    temb = _timestep_embedding(t, spec.block_out_channels[0])
+    temb = _linear(_g(tree, "time_embedding.linear_1"), temb)
+    temb = _linear(_g(tree, "time_embedding.linear_2"), jax.nn.silu(temb))
+
+    h = _conv(_g(tree, "conv_in"), x)
+    skips = [h]
+    for bi, btype in enumerate(spec.down_block_types):
+        blk = _g(tree, f"down_blocks.{bi}")
+        heads = _heads_for(spec, bi)
+        for li in range(spec.layers_per_block):
+            h = _resnet(blk["resnets"][str(li)], h, temb, g)
+            if btype.startswith("CrossAttn"):
+                h = _spatial_transformer(blk["attentions"][str(li)], h,
+                                         context, heads, g)
+            skips.append(h)
+        if "downsamplers" in blk:
+            h = _conv(blk["downsamplers"]["0"]["conv"], h, stride=2)
+            skips.append(h)
+
+    mid = _g(tree, "mid_block")
+    h = _resnet(mid["resnets"]["0"], h, temb, g)
+    if "attentions" in mid:
+        h = _spatial_transformer(mid["attentions"]["0"], h, context,
+                                 _heads_for(spec,
+                                            len(spec.block_out_channels)
+                                            - 1), g)
+    h = _resnet(mid["resnets"]["1"], h, temb, g)
+
+    for bi, btype in enumerate(spec.up_block_types):
+        blk = _g(tree, f"up_blocks.{bi}")
+        heads = _heads_for(spec, len(spec.up_block_types) - 1 - bi)
+        for li in range(spec.layers_per_block + 1):
+            h = jnp.concatenate([h, skips.pop()], axis=-1)
+            h = _resnet(blk["resnets"][str(li)], h, temb, g)
+            if btype.startswith("CrossAttn"):
+                h = _spatial_transformer(blk["attentions"][str(li)], h,
+                                         context, heads, g)
+        if "upsamplers" in blk:
+            B, H, W, C = h.shape
+            h = jax.image.resize(h, (B, H * 2, W * 2, C), "nearest")
+            h = _conv(blk["upsamplers"]["0"]["conv"], h)
+
+    h = jax.nn.silu(_group_norm(_g(tree, "conv_norm_out"), h, g))
+    return _conv(_g(tree, "conv_out"), h)
+
+
+# ---------------------------------------------------------------------------
+# VAE decoder (diffusers AutoencoderKL layout)
+# ---------------------------------------------------------------------------
+
+
+def vae_decode(tree: dict, cfg: dict, z: jax.Array) -> jax.Array:
+    """latents [B, h, w, latent_channels] -> image [B, 8h, 8w, 3] in
+    [-1, 1]."""
+    g = int(cfg.get("norm_num_groups", 32))
+    scaling = float(cfg.get("scaling_factor", 0.18215))
+    z = z / scaling
+    if _has(tree, "post_quant_conv"):
+        z = _conv(_g(tree, "post_quant_conv"), z)
+    dec = _g(tree, "decoder")
+    h = _conv(dec["conv_in"], z)
+
+    mid = dec["mid_block"]
+    h = _resnet(mid["resnets"]["0"], h, None, g)
+    if "attentions" in mid:
+        ap = mid["attentions"]["0"]
+        B, H, W, C = h.shape
+        # modern key names (to_q/...) or legacy (query/.../proj_attn)
+        legacy = "query" in ap
+        norm_key = "group_norm" if "group_norm" in ap else "norm"
+        hn = _group_norm(ap[norm_key], h, g, eps=1e-6)
+        hn = hn.reshape(B, H * W, C)
+        q = _linear(ap["query" if legacy else "to_q"], hn)
+        k = _linear(ap["key" if legacy else "to_k"], hn)
+        v = _linear(ap["value" if legacy else "to_v"], hn)
+        probs = jax.nn.softmax(
+            jnp.einsum("btd,bsd->bts", q, k) / math.sqrt(C), axis=-1)
+        attn = jnp.einsum("bts,bsd->btd", probs, v)
+        attn = _linear(ap["proj_attn"] if legacy else ap["to_out"]["0"],
+                       attn)
+        h = h + attn.reshape(B, H, W, C)
+    h = _resnet(mid["resnets"]["1"], h, None, g)
+
+    n_up = len(dec["up_blocks"])
+    for bi in range(n_up):
+        blk = dec["up_blocks"][str(bi)]
+        n_res = len(blk["resnets"])
+        for li in range(n_res):
+            h = _resnet(blk["resnets"][str(li)], h, None, g)
+        if "upsamplers" in blk:
+            B, H, W, C = h.shape
+            h = jax.image.resize(h, (B, H * 2, W * 2, C), "nearest")
+            h = _conv(blk["upsamplers"]["0"]["conv"], h)
+
+    h = jax.nn.silu(_group_norm(dec["conv_norm_out"], h, g, eps=1e-6))
+    return jnp.clip(_conv(dec["conv_out"], h), -1.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# DDIM scheduler + pipeline
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SDPipeline:
+    """Loaded SD-class pipeline (diffusers directory layout).
+
+    model_index.json names the components; each subdirectory carries its
+    own config.json + safetensors. generate() runs prompt -> CLIP ->
+    guided DDIM over the UNet -> VAE decode -> uint8 RGB."""
+
+    model_dir: str
+    clip_spec: CLIPTextSpec = None  # type: ignore[assignment]
+    text_tree: dict = field(default_factory=dict)
+    unet_spec: UNetSpec = None  # type: ignore[assignment]
+    unet_tree: dict = field(default_factory=dict)
+    vae_tree: dict = field(default_factory=dict)
+    vae_cfg: dict = field(default_factory=dict)
+    sched_cfg: dict = field(default_factory=dict)
+    tokenizer: Any = None
+    vae_scale: int = 8
+
+    @classmethod
+    def load(cls, model_dir: str) -> "SDPipeline":
+        if not os.path.exists(os.path.join(model_dir, "model_index.json")):
+            raise ValueError(
+                f"{model_dir} is not a diffusers-format checkpoint "
+                "(no model_index.json)")
+        text_tree, text_cfg = load_component_tree(
+            os.path.join(model_dir, "text_encoder"))
+        unet_tree, unet_cfg = load_component_tree(
+            os.path.join(model_dir, "unet"))
+        vae_tree, vae_cfg = load_component_tree(
+            os.path.join(model_dir, "vae"))
+        sched_cfg = {}
+        sp = os.path.join(model_dir, "scheduler", "scheduler_config.json")
+        if os.path.exists(sp):
+            with open(sp) as f:
+                sched_cfg = json.load(f)
+        tok = _load_clip_tokenizer(os.path.join(model_dir, "tokenizer"))
+        ups = len(vae_cfg.get("block_out_channels", (1, 1, 1, 1)))
+        return cls(
+            model_dir=model_dir,
+            clip_spec=clip_spec_from_config(text_cfg),
+            text_tree=text_tree,
+            unet_spec=unet_spec_from_config(unet_cfg),
+            unet_tree=unet_tree,
+            vae_tree=vae_tree,
+            vae_cfg=vae_cfg,
+            sched_cfg=sched_cfg,
+            tokenizer=tok,
+            vae_scale=2 ** (ups - 1),
+        )
+
+    # ---------------------------------------------------------- components
+
+    def encode_prompt(self, prompt: str) -> jax.Array:
+        ids = self.tokenizer(
+            prompt, padding="max_length",
+            max_length=self.clip_spec.max_position, truncation=True,
+            return_tensors="np",
+        )["input_ids"].astype(np.int32)
+        return clip_text_encode(self.clip_spec, self.text_tree,
+                                jnp.asarray(ids))
+
+    def _alphas_cumprod(self) -> jnp.ndarray:
+        T = int(self.sched_cfg.get("num_train_timesteps", 1000))
+        b0 = float(self.sched_cfg.get("beta_start", 0.00085))
+        b1 = float(self.sched_cfg.get("beta_end", 0.012))
+        schedule = self.sched_cfg.get("beta_schedule", "scaled_linear")
+        if schedule == "scaled_linear":
+            betas = jnp.linspace(b0 ** 0.5, b1 ** 0.5, T) ** 2
+        else:  # "linear"
+            betas = jnp.linspace(b0, b1, T)
+        return jnp.cumprod(1.0 - betas)
+
+    # ---------------------------------------------------------- generation
+
+    def generate(self, prompt: str, negative_prompt: str = "",
+                 height: int = 512, width: int = 512, steps: int = 20,
+                 guidance: float = 7.5,
+                 seed: Optional[int] = None) -> np.ndarray:
+        """Returns a [height, width, 3] uint8 image."""
+        # the latent grid must survive the UNet's downsamples
+        snap = self.vae_scale * (2 ** (len(
+            self.unet_spec.block_out_channels) - 1))
+        height = max(snap, height // snap * snap)
+        width = max(snap, width // snap * snap)
+        cond = self.encode_prompt(prompt)
+        uncond = self.encode_prompt(negative_prompt or "")
+        ctx = jnp.concatenate([uncond, cond], axis=0)  # [2, Tc, d]
+
+        T = int(self.sched_cfg.get("num_train_timesteps", 1000))
+        offset = int(self.sched_cfg.get("steps_offset", 1))
+        stride = T // steps
+        ts = (jnp.arange(steps, dtype=jnp.int32) * stride + offset)[::-1]
+        alphas = self._alphas_cumprod()
+        if not self.sched_cfg.get("set_alpha_to_one", True):
+            final_alpha = alphas[0]  # SD1.x scheduler convention
+        else:
+            final_alpha = jnp.asarray(1.0)
+        v_pred = self.sched_cfg.get("prediction_type",
+                                    "epsilon") == "v_prediction"
+
+        rng = jax.random.PRNGKey(
+            seed if seed is not None else
+            int.from_bytes(os.urandom(4), "little"))
+        lat_shape = (1, height // self.vae_scale,
+                     width // self.vae_scale,
+                     int(self.unet_spec.in_channels))
+        x = jax.random.normal(rng, lat_shape, jnp.float32)
+        img = _sd_sample_jit(
+            self.unet_spec, self.unet_tree, self.vae_tree,
+            _freeze(self.vae_cfg), x, ctx, ts, alphas, final_alpha,
+            float(guidance), bool(v_pred),
+        )
+        arr = np.asarray(img[0])
+        return ((arr + 1.0) * 127.5).clip(0, 255).astype(np.uint8)
+
+
+def _freeze(cfg: dict) -> tuple:
+    return tuple(sorted(
+        (k, tuple(v) if isinstance(v, list) else v)
+        for k, v in cfg.items()
+        if isinstance(v, (int, float, str, bool, list))
+    ))
+
+
+@partial(jax.jit, static_argnums=(0, 3, 9, 10))
+def _sd_sample_jit(unet_spec: UNetSpec, unet_tree: dict, vae_tree: dict,
+                   vae_cfg_frozen: tuple, x: jax.Array, ctx: jax.Array,
+                   ts: jax.Array, alphas: jax.Array, final_alpha: jax.Array,
+                   guidance: float, v_pred: bool) -> jax.Array:
+    """Full guided DDIM loop + VAE decode in one compiled program."""
+    vae_cfg = {k: (list(v) if isinstance(v, tuple) else v)
+               for k, v in vae_cfg_frozen}
+    steps = ts.shape[0]
+
+    def step(x, i):
+        t = ts[i]
+        a_t = alphas[t]
+        t_prev = ts[jnp.minimum(i + 1, steps - 1)]
+        a_prev = jnp.where(i + 1 < steps, alphas[t_prev], final_alpha)
+        xx = jnp.concatenate([x, x], axis=0)  # [uncond | cond]
+        tb = jnp.full((2,), t, jnp.int32)
+        out = unet_forward(unet_spec, unet_tree, xx, tb, ctx)
+        out_u, out_c = out[:1], out[1:]
+        out = out_u + guidance * (out_c - out_u)
+        if v_pred:  # v = sqrt(a) eps - sqrt(1-a) x0
+            eps = (jnp.sqrt(a_t) * out
+                   + jnp.sqrt(1 - a_t) * x)
+            x0 = jnp.sqrt(a_t) * x - jnp.sqrt(1 - a_t) * out
+        else:
+            eps = out
+            x0 = (x - jnp.sqrt(1 - a_t) * eps) / jnp.sqrt(a_t)
+        x = jnp.sqrt(a_prev) * x0 + jnp.sqrt(1 - a_prev) * eps
+        return x, None
+
+    x, _ = lax.scan(step, x, jnp.arange(steps))
+    return vae_decode(vae_tree, vae_cfg, x)
+
+
+def _load_clip_tokenizer(tok_dir: str):
+    """CLIP tokenizer from local files only (no network)."""
+    tj = os.path.join(tok_dir, "tokenizer.json")
+    if os.path.exists(tj):
+        from transformers import CLIPTokenizerFast
+
+        return CLIPTokenizerFast(tokenizer_file=tj)
+    from transformers import CLIPTokenizer
+
+    return CLIPTokenizer(
+        vocab_file=os.path.join(tok_dir, "vocab.json"),
+        merges_file=os.path.join(tok_dir, "merges.txt"),
+    )
+
+
+def consumed_keys_check(pipe: SDPipeline, prompt: str = "x") -> dict:
+    """Run one tiny un-jitted forward of every component with
+    leaf-access recording; returns {component: [unconsumed keys]} —
+    tests assert these are empty (an imported tensor the forward never
+    reads is a wiring bug)."""
+    report = {}
+    snap = pipe.vae_scale * (2 ** (len(
+        pipe.unet_spec.block_out_channels) - 1))
+
+    seen: set = set()
+    ids = pipe.tokenizer(
+        prompt, padding="max_length",
+        max_length=pipe.clip_spec.max_position, truncation=True,
+        return_tensors="np")["input_ids"].astype(np.int32)
+    cond = clip_text_encode(pipe.clip_spec,
+                            _RecDict(pipe.text_tree, "", seen),
+                            jnp.asarray(ids))
+    report["text_encoder"] = [k for k in tree_keys(pipe.text_tree)
+                              if k not in seen]
+
+    seen = set()
+    lat = jnp.zeros((1, snap // pipe.vae_scale, snap // pipe.vae_scale,
+                     int(pipe.unet_spec.in_channels)), jnp.float32)
+    unet_forward(pipe.unet_spec, _RecDict(pipe.unet_tree, "", seen), lat,
+                 jnp.zeros((1,), jnp.int32), cond)
+    report["unet"] = [k for k in tree_keys(pipe.unet_tree)
+                      if k not in seen]
+
+    seen = set()
+    vae_decode(_RecDict(pipe.vae_tree, "", seen), pipe.vae_cfg, lat)
+    report["vae"] = [k for k in tree_keys(pipe.vae_tree) if k not in seen]
+    return report

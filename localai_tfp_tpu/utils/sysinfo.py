@@ -84,11 +84,11 @@ def estimate_model_bytes(model_dir: str, dtype: str = "bfloat16",
         elif f.endswith(".bin") and "training" not in f:
             # torch .bin shards are f32 by convention
             n_params += os.path.getsize(os.path.join(model_dir, f)) // 4
-    per = (_dtype_bytes(quantization) if quantization
-           else _dtype_bytes(dtype))
-    params = n_params * per
+    base = _dtype_bytes(dtype)
     kv = 0
+    n_highprec = 0  # params weight-only quant does NOT touch
     cfg_path = os.path.join(model_dir, "config.json")
+    cfg = {}
     if os.path.exists(cfg_path):
         with open(cfg_path) as f:
             cfg = json.load(f)
@@ -103,6 +103,18 @@ def estimate_model_bytes(model_dir: str, dtype: str = "bfloat16",
         kv_per = _dtype_bytes(kv_dtype or dtype)
         kv = (2 * layers * batch_slots * context_size * heads * d_head
               * kv_per)
+    if quantization:
+        # int8 weight-only quantizes the projection stacks ONLY; embed
+        # and lm_head (~vocab*d each, x1 if tied) plus norms stay at the
+        # serving dtype (models/quant.py QUANTIZABLE)
+        vocab = int(cfg.get("vocab_size") or 0)
+        d = int(cfg.get("hidden_size") or 0)
+        towers = 1 if cfg.get("tie_word_embeddings") else 2
+        n_highprec = min(vocab * d * towers, n_params)
+        params = (n_highprec * base
+                  + (n_params - n_highprec) * _dtype_bytes(quantization))
+    else:
+        params = n_params * base
     total = params + kv
     return {
         "param_bytes": int(params),

@@ -3,11 +3,16 @@
 also backend/go/image/stablediffusion-ggml). Serves
 /v1/images/generations and /video.
 
-Runs the JAX UNet+DDIM pipeline (models/diffusion.py). Text conditioning
-is a byte-embedding sequence (a learned table; CLIP-class text towers
-plug in behind the same cond interface). Video = frame-chained sampling
-with the previous frame mixed into the init noise (img2img-style
-temporal coherence).
+Two pipelines:
+- REAL checkpoints: a diffusers-format directory (model_index.json)
+  loads the SD-class pipeline (models/sd.py — CLIP + UNet + VAE, full
+  safetensors import, classifier-free-guided DDIM).
+- ``__random__`` (explicit test fixture only): the toy random-init
+  UNet+DDIM of models/diffusion.py with a byte-embedding conditioner —
+  exercises the serving plumbing without a checkpoint.
+
+Video = frame-chained sampling with the previous frame mixed into the
+init noise (img2img-style temporal coherence).
 """
 
 from __future__ import annotations
@@ -53,14 +58,17 @@ class JaxDiffusionBackend(Backend):
     def __init__(self) -> None:
         self.spec: Optional[DiffusionSpec] = None
         self.params = None
+        self._sd = None  # models/sd.py SDPipeline for real checkpoints
         self._state = "UNINITIALIZED"
         self._lock = threading.Lock()
         self._steps = 12
-        self._guidance = 3.0
+        self._guidance: Optional[float] = None  # None => per-pipeline
+        # default (7.5 for SD checkpoints, 3.0 for the toy fixture)
 
     def load_model(self, opts: ModelLoadOptions) -> Result:
         with self._lock:
             try:
+                self._sd = None  # a reload must not keep a stale pipeline
                 seed = 0
                 for kv in opts.options:
                     k, _, v = kv.partition("=")
@@ -70,6 +78,25 @@ class JaxDiffusionBackend(Backend):
                         self._guidance = float(v)
                     elif k == "seed":
                         seed = int(v)
+                model_dir = opts.model
+                if model_dir and model_dir != "__random__" \
+                        and not os.path.isabs(model_dir):
+                    model_dir = os.path.join(opts.model_path or "",
+                                             model_dir)
+                if model_dir and os.path.exists(
+                        os.path.join(model_dir, "model_index.json")):
+                    from ..models.sd import SDPipeline
+
+                    self._sd = SDPipeline.load(model_dir)
+                    self._state = "READY"
+                    return Result(True, "sd pipeline ready")
+                if opts.model and opts.model != "__random__":
+                    return Result(False, (
+                        f"{opts.model!r} is not a diffusers-format "
+                        "checkpoint directory (no model_index.json); "
+                        "the random-init pipeline is a test fixture — "
+                        "request it explicitly with model: __random__"))
+                # explicit test fixture: random-init toy pipeline
                 from ..ops.decode_attention import _interpret
 
                 tiny = bool(os.environ.get("LOCALAI_TINY_DIFFUSION")) or \
@@ -82,7 +109,8 @@ class JaxDiffusionBackend(Backend):
                     jax.random.fold_in(rng, 1), (258, self.spec.d_cond)
                 ) * 0.02
                 self._state = "READY"
-                return Result(True, "diffusion pipeline ready")
+                return Result(True, "diffusion pipeline ready (random "
+                                    "test fixture)")
             except Exception as e:
                 self._state = "ERROR"
                 return Result(False, f"load failed: {e}")
@@ -94,7 +122,7 @@ class JaxDiffusionBackend(Backend):
         return StatusResponse(state=self._state)
 
     def shutdown(self) -> None:
-        self.spec = self.params = None
+        self.spec = self.params = self._sd = None
         self._state = "UNINITIALIZED"
 
     # ------------------------------------------------------------ generation
@@ -111,6 +139,14 @@ class JaxDiffusionBackend(Backend):
 
     def _sample(self, prompt: str, negative: str, w: int, h: int,
                 steps: Optional[int], seed) -> np.ndarray:
+        if self._sd is not None:
+            return self._sd.generate(
+                prompt, negative_prompt=negative, height=h, width=w,
+                steps=steps or self._steps,
+                guidance=self._guidance if self._guidance is not None
+                else 7.5,
+                seed=seed,
+            )
         # UNet downsamples len(channels) times; snap to the multiple
         mult = 2 ** len(self.spec.channels)
         w = max(mult, (w // mult) * mult)
@@ -121,7 +157,8 @@ class JaxDiffusionBackend(Backend):
         )
         img = ddim_sample(
             self.spec, self.params, self._cond(prompt, negative), rng,
-            h, w, steps or self._steps, self._guidance,
+            h, w, steps or self._steps,
+            self._guidance if self._guidance is not None else 3.0,
         )
         arr = np.asarray(img[0])
         return ((arr + 1.0) * 127.5).clip(0, 255).astype(np.uint8)

@@ -1,0 +1,210 @@
+"""Builds a tiny synthetic diffusers-format SD checkpoint on disk: the
+same directory layout, key names and tensor shapes (in torch OIHW /
+[out, in] convention) that real SD 1.x checkpoints ship with, at toy
+sizes — so the importer and pipeline are exercised against the real
+schema without network access."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+RNG = np.random.default_rng(0)
+
+# tiny geometry
+C = (32, 64)  # unet block_out_channels
+D_COND = 32  # cross-attention dim == CLIP hidden size
+TEMB = C[0] * 4
+GROUPS = 8
+VAE_C = (32, 64)
+LAT = 4
+
+
+def _w(*shape, scale=0.05):
+    return (RNG.standard_normal(shape) * scale).astype(np.float32)
+
+
+def _conv(t, name, cout, cin, k=3):
+    t[f"{name}.weight"] = _w(cout, cin, k, k)
+    t[f"{name}.bias"] = np.zeros((cout,), np.float32)
+
+
+def _lin(t, name, cout, cin, bias=True):
+    t[f"{name}.weight"] = _w(cout, cin)
+    if bias:
+        t[f"{name}.bias"] = np.zeros((cout,), np.float32)
+
+
+def _norm(t, name, c):
+    t[f"{name}.weight"] = np.ones((c,), np.float32)
+    t[f"{name}.bias"] = np.zeros((c,), np.float32)
+
+
+def _resnet(t, name, cin, cout, temb=TEMB):
+    _norm(t, f"{name}.norm1", cin)
+    _conv(t, f"{name}.conv1", cout, cin)
+    if temb:
+        _lin(t, f"{name}.time_emb_proj", cout, temb)
+    _norm(t, f"{name}.norm2", cout)
+    _conv(t, f"{name}.conv2", cout, cout)
+    if cin != cout:
+        _conv(t, f"{name}.conv_shortcut", cout, cin, k=1)
+
+
+def _attn_block(t, name, c, d_cond):
+    """Transformer2DModel with one BasicTransformerBlock (conv proj)."""
+    _norm(t, f"{name}.norm", c)
+    _conv(t, f"{name}.proj_in", c, c, k=1)
+    b = f"{name}.transformer_blocks.0"
+    for n in ("norm1", "norm2", "norm3"):
+        _norm(t, f"{b}.{n}", c)
+    for attn, kv in (("attn1", c), ("attn2", d_cond)):
+        _lin(t, f"{b}.{attn}.to_q", c, c, bias=False)
+        _lin(t, f"{b}.{attn}.to_k", c, kv, bias=False)
+        _lin(t, f"{b}.{attn}.to_v", c, kv, bias=False)
+        _lin(t, f"{b}.{attn}.to_out.0", c, c)
+    inner = 4 * c
+    _lin(t, f"{b}.ff.net.0.proj", 2 * inner, c)  # GEGLU
+    _lin(t, f"{b}.ff.net.2", c, inner)
+    _conv(t, f"{name}.proj_out", c, c, k=1)
+
+
+def build_unet(dirpath: str) -> None:
+    os.makedirs(dirpath, exist_ok=True)
+    t: dict[str, np.ndarray] = {}
+    _conv(t, "conv_in", C[0], LAT)
+    _lin(t, "time_embedding.linear_1", TEMB, C[0])
+    _lin(t, "time_embedding.linear_2", TEMB, TEMB)
+    # down 0: CrossAttnDownBlock2D (C0) with downsampler
+    _resnet(t, "down_blocks.0.resnets.0", C[0], C[0])
+    _attn_block(t, "down_blocks.0.attentions.0", C[0], D_COND)
+    _conv(t, "down_blocks.0.downsamplers.0.conv", C[0], C[0])
+    # down 1: DownBlock2D (C1), last block: no downsampler
+    _resnet(t, "down_blocks.1.resnets.0", C[0], C[1])
+    # mid
+    _resnet(t, "mid_block.resnets.0", C[1], C[1])
+    _attn_block(t, "mid_block.attentions.0", C[1], D_COND)
+    _resnet(t, "mid_block.resnets.1", C[1], C[1])
+    # up 0: UpBlock2D (C1) with upsampler; skips: [d1.res0(C1), d0.down(C0)]
+    _resnet(t, "up_blocks.0.resnets.0", C[1] + C[1], C[1])
+    _resnet(t, "up_blocks.0.resnets.1", C[1] + C[0], C[1])
+    _conv(t, "up_blocks.0.upsamplers.0.conv", C[1], C[1])
+    # up 1: CrossAttnUpBlock2D (C0); skips: [d0.res0(C0), conv_in(C0)]
+    _resnet(t, "up_blocks.1.resnets.0", C[1] + C[0], C[0])
+    _attn_block(t, "up_blocks.1.attentions.0", C[0], D_COND)
+    _resnet(t, "up_blocks.1.resnets.1", C[0] + C[0], C[0])
+    _attn_block(t, "up_blocks.1.attentions.1", C[0], D_COND)
+    _norm(t, "conv_norm_out", C[0])
+    _conv(t, "conv_out", LAT, C[0])
+    from safetensors.numpy import save_file
+
+    save_file(t, os.path.join(dirpath, "diffusion_pytorch_model.safetensors"))
+    with open(os.path.join(dirpath, "config.json"), "w") as f:
+        json.dump({
+            "_class_name": "UNet2DConditionModel",
+            "block_out_channels": list(C),
+            "down_block_types": ["CrossAttnDownBlock2D", "DownBlock2D"],
+            "up_block_types": ["UpBlock2D", "CrossAttnUpBlock2D"],
+            "layers_per_block": 1,
+            "attention_head_dim": 2,
+            "cross_attention_dim": D_COND,
+            "in_channels": LAT,
+            "out_channels": LAT,
+            "norm_num_groups": GROUPS,
+        }, f)
+
+
+def build_vae(dirpath: str) -> None:
+    os.makedirs(dirpath, exist_ok=True)
+    t: dict[str, np.ndarray] = {}
+    _conv(t, "post_quant_conv", LAT, LAT, k=1)
+    top = VAE_C[-1]
+    _conv(t, "decoder.conv_in", top, LAT)
+    _resnet(t, "decoder.mid_block.resnets.0", top, top, temb=0)
+    _norm(t, "decoder.mid_block.attentions.0.group_norm", top)
+    _lin(t, "decoder.mid_block.attentions.0.to_q", top, top)
+    _lin(t, "decoder.mid_block.attentions.0.to_k", top, top)
+    _lin(t, "decoder.mid_block.attentions.0.to_v", top, top)
+    _lin(t, "decoder.mid_block.attentions.0.to_out.0", top, top)
+    _resnet(t, "decoder.mid_block.resnets.1", top, top, temb=0)
+    # up blocks walk reversed(block_out): [64, 32]
+    _resnet(t, "decoder.up_blocks.0.resnets.0", top, top, temb=0)
+    _resnet(t, "decoder.up_blocks.0.resnets.1", top, top, temb=0)
+    _conv(t, "decoder.up_blocks.0.upsamplers.0.conv", top, top)
+    _resnet(t, "decoder.up_blocks.1.resnets.0", top, VAE_C[0], temb=0)
+    _resnet(t, "decoder.up_blocks.1.resnets.1", VAE_C[0], VAE_C[0],
+            temb=0)
+    _norm(t, "decoder.conv_norm_out", VAE_C[0])
+    _conv(t, "decoder.conv_out", 3, VAE_C[0])
+    from safetensors.numpy import save_file
+
+    save_file(t, os.path.join(dirpath, "diffusion_pytorch_model.safetensors"))
+    with open(os.path.join(dirpath, "config.json"), "w") as f:
+        json.dump({
+            "_class_name": "AutoencoderKL",
+            "block_out_channels": list(VAE_C),
+            "latent_channels": LAT,
+            "norm_num_groups": GROUPS,
+            "scaling_factor": 0.18215,
+        }, f)
+
+
+def build_text_encoder(dirpath: str) -> None:
+    """A REAL (tiny, random-weight) transformers CLIPTextModel — the
+    golden-parity reference for clip_text_encode."""
+    import torch
+    from transformers import CLIPTextConfig, CLIPTextModel
+
+    torch.manual_seed(0)
+    cfg = CLIPTextConfig(
+        vocab_size=96, hidden_size=D_COND, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2,
+        max_position_embeddings=16, hidden_act="quick_gelu",
+    )
+    CLIPTextModel(cfg).save_pretrained(dirpath, safe_serialization=True)
+
+
+def build_tokenizer(dirpath: str) -> None:
+    """Minimal CLIP-style BPE vocab covering ascii letters (enough for
+    test prompts), in the slow-tokenizer vocab.json + merges.txt form."""
+    os.makedirs(dirpath, exist_ok=True)
+    vocab = {"<|startoftext|>": 0, "<|endoftext|>": 1}
+    for ch in "abcdefghijklmnopqrstuvwxyz0123456789":
+        vocab[ch] = len(vocab)
+        vocab[ch + "</w>"] = len(vocab)
+    with open(os.path.join(dirpath, "vocab.json"), "w") as f:
+        json.dump(vocab, f)
+    with open(os.path.join(dirpath, "merges.txt"), "w") as f:
+        f.write("#version: 0.2\n")
+
+
+def build_pipeline(root: str) -> str:
+    """Full tiny diffusers-format pipeline directory; returns root."""
+    os.makedirs(root, exist_ok=True)
+    build_unet(os.path.join(root, "unet"))
+    build_vae(os.path.join(root, "vae"))
+    build_text_encoder(os.path.join(root, "text_encoder"))
+    build_tokenizer(os.path.join(root, "tokenizer"))
+    os.makedirs(os.path.join(root, "scheduler"), exist_ok=True)
+    with open(os.path.join(root, "scheduler",
+                           "scheduler_config.json"), "w") as f:
+        json.dump({
+            "_class_name": "DDIMScheduler",
+            "num_train_timesteps": 1000,
+            "beta_start": 0.00085, "beta_end": 0.012,
+            "beta_schedule": "scaled_linear",
+            "steps_offset": 1, "set_alpha_to_one": False,
+            "prediction_type": "epsilon",
+        }, f)
+    with open(os.path.join(root, "model_index.json"), "w") as f:
+        json.dump({
+            "_class_name": "StableDiffusionPipeline",
+            "unet": ["diffusers", "UNet2DConditionModel"],
+            "vae": ["diffusers", "AutoencoderKL"],
+            "text_encoder": ["transformers", "CLIPTextModel"],
+            "tokenizer": ["transformers", "CLIPTokenizer"],
+            "scheduler": ["diffusers", "DDIMScheduler"],
+        }, f)
+    return root

@@ -131,3 +131,32 @@ def test_write_png_roundtrip(tmp_path):
         np.testing.assert_array_equal(back, img)
     except ImportError:
         assert open(p, "rb").read()[:4] == b"\x89PNG"
+
+
+def test_diffusion_serves_real_sd_checkpoint(tmp_path):
+    """A diffusers-format checkpoint dir (real schema, toy sizes) must
+    load the SD pipeline and produce a PNG (ref: diffusers backend
+    GenerateImage :304-350)."""
+    from . import sd_fixture
+
+    root = sd_fixture.build_pipeline(str(tmp_path / "sd"))
+    b = JaxDiffusionBackend()
+    res = b.load_model(ModelLoadOptions(model=root, options=["steps=2"]))
+    assert res.success and "sd pipeline" in res.message
+    dst = str(tmp_path / "sd.png")
+    out = b.generate_image(prompt="a cat", width=16, height=16, dst=dst,
+                           seed=3)
+    assert out.success
+    assert open(dst, "rb").read()[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+def test_diffusion_named_non_checkpoint_errors(tmp_path):
+    """A configured model name that is NOT a diffusers checkpoint must
+    fail loudly — the random-init pipeline is only an explicit fixture."""
+    b = JaxDiffusionBackend()
+    res = b.load_model(ModelLoadOptions(
+        model=str(tmp_path / "nope"), options=[]))
+    assert not res.success and "model_index.json" in res.message
+    # explicit fixture request still works
+    b2 = JaxDiffusionBackend()
+    assert b2.load_model(ModelLoadOptions(model="__random__")).success
