@@ -56,22 +56,31 @@ def load_component_tree(component_dir: str) -> tuple[dict, dict]:
         with open(cfg_path) as f:
             cfg = json.load(f)
 
+    names = sorted(os.listdir(component_dir))
+    st_names = [n for n in names if n.endswith(".safetensors")]
+    if any(".fp16." not in n for n in st_names):
+        # dual-precision snapshots ship model.safetensors AND
+        # model.fp16.safetensors: read one variant, not both
+        st_names = [n for n in st_names if ".fp16." not in n]
+    bin_names = ([] if st_names else
+                 [n for n in names
+                  if n.endswith(".bin") and "training" not in n])
+
     tensors: dict[str, np.ndarray] = {}
-    for fname in sorted(os.listdir(component_dir)):
-        path = os.path.join(component_dir, fname)
-        if fname.endswith(".safetensors"):
-            from safetensors import safe_open
+    for fname in st_names:
+        from safetensors import safe_open
 
-            with safe_open(path, framework="np") as f:
-                for key in f.keys():
-                    tensors[key] = f.get_tensor(key)
-        elif fname.endswith(".bin") and "training" not in fname:
-            import torch
+        with safe_open(os.path.join(component_dir, fname),
+                       framework="np") as f:
+            for key in f.keys():
+                tensors[key] = f.get_tensor(key)
+    for fname in bin_names:
+        import torch
 
-            state = torch.load(path, map_location="cpu",
-                               weights_only=True)
-            for key, t in state.items():
-                tensors[key] = t.float().numpy()
+        state = torch.load(os.path.join(component_dir, fname),
+                           map_location="cpu", weights_only=True)
+        for key, t in state.items():
+            tensors[key] = t.float().numpy()
 
     tree: dict = {}
     for key, arr in tensors.items():
@@ -379,7 +388,12 @@ def unet_spec_from_config(cfg: dict) -> UNetSpec:
             "UpBlock2D", "CrossAttnUpBlock2D", "CrossAttnUpBlock2D",
             "CrossAttnUpBlock2D"))),
         layers_per_block=int(cfg.get("layers_per_block", 2)),
-        attention_head_dim=cfg.get("attention_head_dim", 8),
+        # SD 2.x ships a per-block JSON list; UNetSpec is a jit static
+        # arg, so it must be hashable
+        attention_head_dim=(tuple(cfg["attention_head_dim"])
+                            if isinstance(cfg.get("attention_head_dim"),
+                                          list)
+                            else cfg.get("attention_head_dim", 8)),
         cross_attention_dim=int(cfg.get("cross_attention_dim", 768)),
         in_channels=int(cfg.get("in_channels", 4)),
         norm_num_groups=int(cfg.get("norm_num_groups", 32)),

@@ -1,0 +1,221 @@
+"""Learned voice-activity detection: silero-class network in JAX.
+
+The reference serves silero-vad's ONNX model through onnxruntime
+(backend/go/vad/silero/vad.go, POST /vad). This module implements the
+same network family natively: short-time-Fourier conv frontend (a fixed
+conv basis of sine/cosine filters), a small causal conv encoder with
+ReLU, an LSTM cell carrying streaming state across chunks, and a
+sigmoid head emitting one speech probability per chunk.
+
+Weights import from silero's distributed torchscript archive
+(``silero_vad.jit`` — ``torch.jit.load(...).state_dict()``) or any
+state dict using the same key schema:
+
+    _model.stft.forward_basis_buffer            [2*bins, 1, win]
+    _model.encoder.{i}.reparam_conv.weight/bias [C_out, C_in, 3]
+    _model.decoder.rnn.weight_ih/weight_hh      [4H, H]
+    _model.decoder.rnn.bias_ih/bias_hh          [4H]
+    _model.decoder.decoder.2.weight/bias        [1, H, 1]
+
+Every block is verified against the equivalent torch ops with shared
+weights in tests/test_vad_net.py (LSTM gate order i|f|g|o, reflect pad,
+stride-128 conv STFT), so a real silero state dict drops in without a
+numerics surprise. The DSP detector in workers/vad.py remains the
+no-checkpoint fallback.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+SAMPLE_RATE = 16000
+CHUNK = 512  # samples per probability (silero 16k convention)
+CONTEXT = 64  # carried samples prepended to each chunk
+
+
+@dataclass
+class VADParams:
+    stft_basis: jax.Array  # [2*bins, win]
+    enc_w: tuple  # per-layer [k, C_in, C_out] (HWIO-style for lax.conv)
+    enc_b: tuple
+    w_ih: jax.Array  # [H_in, 4H] (transposed for right-matmul)
+    w_hh: jax.Array  # [H, 4H]
+    b: jax.Array  # [4H] (bias_ih + bias_hh)
+    head_w: jax.Array  # [H, 1]
+    head_b: jax.Array  # [1]
+
+
+def load_state_dict(sd: dict) -> VADParams:
+    """Map a silero-schema state dict (torch tensors or numpy) to
+    VADParams."""
+
+    def np_(t):
+        if hasattr(t, "detach"):
+            t = t.detach().cpu().float().numpy()
+        return np.asarray(t, np.float32)
+
+    pfx = "_model." if any(k.startswith("_model.") for k in sd) else ""
+    basis = np_(sd[f"{pfx}stft.forward_basis_buffer"])  # [2B, 1, win]
+    enc_w, enc_b = [], []
+    i = 0
+    while f"{pfx}encoder.{i}.reparam_conv.weight" in sd:
+        w = np_(sd[f"{pfx}encoder.{i}.reparam_conv.weight"])  # [O, I, k]
+        enc_w.append(jnp.asarray(w.transpose(2, 1, 0)))  # [k, I, O]
+        enc_b.append(jnp.asarray(np_(
+            sd[f"{pfx}encoder.{i}.reparam_conv.bias"])))
+        i += 1
+    if not enc_w:
+        raise ValueError("no encoder conv layers found in state dict")
+    return VADParams(
+        stft_basis=jnp.asarray(basis[:, 0, :]),
+        enc_w=tuple(enc_w),
+        enc_b=tuple(enc_b),
+        w_ih=jnp.asarray(np_(sd[f"{pfx}decoder.rnn.weight_ih"]).T),
+        w_hh=jnp.asarray(np_(sd[f"{pfx}decoder.rnn.weight_hh"]).T),
+        b=jnp.asarray(np_(sd[f"{pfx}decoder.rnn.bias_ih"])
+                      + np_(sd[f"{pfx}decoder.rnn.bias_hh"])),
+        head_w=jnp.asarray(np_(sd[f"{pfx}decoder.decoder.2.weight"]
+                               )[0, :, 0][:, None]),
+        head_b=jnp.asarray(np_(sd[f"{pfx}decoder.decoder.2.bias"])),
+    )
+
+
+def load_torchscript(path: str) -> VADParams:
+    """Import from silero's distributed .jit archive (torch CPU)."""
+    import torch
+
+    mod = torch.jit.load(path, map_location="cpu")
+    return load_state_dict(dict(mod.state_dict()))
+
+
+jax.tree_util.register_pytree_node(
+    VADParams,
+    lambda p: ((p.stft_basis, p.enc_w, p.enc_b, p.w_ih, p.w_hh, p.b,
+                p.head_w, p.head_b), None),
+    lambda _, c: VADParams(*c),
+)
+
+
+def _stft_mag(basis: jax.Array, x: jax.Array) -> jax.Array:
+    """x [B, n] -> magnitude [B, bins, T]: reflect-pad then the conv
+    basis (sine/cosine filters) at stride win//2, as silero's STFT
+    module does."""
+    win = basis.shape[-1]
+    hop = win // 2
+    pad = win // 2
+    x = jnp.pad(x, ((0, 0), (pad, pad)), mode="reflect")
+    # conv1d: [B, 1, n] * [2bins, 1, win] -> treat as NWC x WIO
+    out = lax.conv_general_dilated(
+        x[:, :, None], basis.T[:, None, :], (hop,), "VALID",
+        dimension_numbers=("NWC", "WIO", "NWC"),
+    )  # [B, T, 2bins]
+    out = out.transpose(0, 2, 1)  # [B, 2bins, T]
+    bins = out.shape[1] // 2
+    return jnp.sqrt(out[:, :bins] ** 2 + out[:, bins:] ** 2 + 1e-12)
+
+
+def _encoder(params: VADParams, x: jax.Array) -> jax.Array:
+    """[B, C, T] -> [B, C', T]: stacked k=3 same-pad convs + ReLU."""
+    h = x.transpose(0, 2, 1)  # [B, T, C] (NWC)
+    for w, b in zip(params.enc_w, params.enc_b):
+        h = lax.conv_general_dilated(
+            h, w, (1,), "SAME", dimension_numbers=("NWC", "WIO", "NWC")
+        ) + b
+        h = jax.nn.relu(h)
+    return h.transpose(0, 2, 1)
+
+
+def _lstm_cell(params: VADParams, x: jax.Array, h: jax.Array,
+               c: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """torch LSTMCell semantics: gates ordered i | f | g | o."""
+    gates = x @ params.w_ih + h @ params.w_hh + params.b
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return h, c
+
+
+@partial(jax.jit, donate_argnums=())
+def vad_forward(params: VADParams, chunk: jax.Array, h: jax.Array,
+                c: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One streaming step. chunk [B, CONTEXT+CHUNK] f32 in [-1, 1];
+    h/c [B, H] LSTM state. Returns (prob [B], h, c)."""
+    mag = _stft_mag(params.stft_basis, chunk)  # [B, bins, T]
+    feat = _encoder(params, mag)  # [B, H, T]
+    feat = feat.mean(axis=-1)  # time-pool the chunk
+    h, c = _lstm_cell(params, feat, h, c)
+    logit = jax.nn.relu(h) @ params.head_w + params.head_b  # [B, 1]
+    return jax.nn.sigmoid(logit)[:, 0], h, c
+
+
+def init_state(batch: int, hidden: int) -> tuple[jax.Array, jax.Array]:
+    z = jnp.zeros((batch, hidden), jnp.float32)
+    return z, z
+
+
+def speech_probs(params: VADParams, audio: np.ndarray) -> np.ndarray:
+    """Full-utterance helper: audio [n] f32 -> per-chunk probabilities
+    [ceil(n/CHUNK)] with streaming LSTM state, one jitted scan."""
+    n = len(audio)
+    n_chunks = max((n + CHUNK - 1) // CHUNK, 1)
+    padded = np.zeros(n_chunks * CHUNK + CONTEXT, np.float32)
+    padded[CONTEXT:CONTEXT + n] = audio
+    idx = (np.arange(n_chunks)[:, None] * CHUNK
+           + np.arange(CHUNK + CONTEXT)[None, :])
+    chunks = jnp.asarray(padded[idx])  # [n_chunks, CONTEXT+CHUNK]
+    H = params.w_hh.shape[0]
+
+    def step(carry, chunk):
+        h, c = carry
+        p, h, c = vad_forward(params, chunk[None], h, c)
+        return (h, c), p[0]
+
+    (_, _), probs = lax.scan(step, init_state(1, H), chunks)
+    return np.asarray(probs)
+
+
+def probs_to_segments(
+    probs: np.ndarray,
+    *,
+    threshold: float = 0.5,
+    neg_threshold: Optional[float] = None,
+    min_speech_s: float = 0.25,
+    min_silence_s: float = 0.1,
+    pad_s: float = 0.03,
+    chunk_s: float = CHUNK / SAMPLE_RATE,
+) -> list[tuple[float, float]]:
+    """Hysteresis segmentation over per-chunk probabilities (the silero
+    utils_vad convention: enter at ``threshold``, leave only below
+    ``neg_threshold``, drop short speech, bridge short silence, pad)."""
+    neg = neg_threshold if neg_threshold is not None else threshold - 0.15
+    segs: list[list[float]] = []
+    active = False
+    start = 0.0
+    silence = 0.0
+    for i, p in enumerate(probs):
+        t = i * chunk_s
+        if not active and p >= threshold:
+            active, start = True, t
+            silence = 0.0
+        elif active:
+            if p < neg:
+                silence += chunk_s
+                if silence >= min_silence_s:
+                    segs.append([start, t - silence + chunk_s])
+                    active = False
+            else:
+                silence = 0.0
+    if active:
+        segs.append([start, len(probs) * chunk_s])
+    out = []
+    for s, e in segs:
+        if e - s >= min_speech_s:
+            out.append((max(0.0, s - pad_s), e + pad_s))
+    return out

@@ -44,11 +44,16 @@ def _frame_features(audio: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
 class JaxVADBackend(Backend):
     def __init__(self) -> None:
         self._state = "UNINITIALIZED"
-        self.threshold = 2.5  # over noise floor
+        self.threshold = 2.5  # over noise floor (DSP mode); learned mode
+        # reinterprets values <= 1 as the probability threshold
         self.min_speech_s = 0.25
         self.min_silence_s = 0.25
+        self._net = None  # learned silero-class model (models/vad_net)
 
     def load_model(self, opts: ModelLoadOptions) -> Result:
+        import os
+
+        self._net = None
         for kv in opts.options:
             k, _, v = kv.partition("=")
             if k == "threshold":
@@ -57,8 +62,45 @@ class JaxVADBackend(Backend):
                 self.min_speech_s = float(v)
             elif k == "min_silence_s":
                 self.min_silence_s = float(v)
+        model = opts.model
+        if model and not os.path.isabs(model):
+            cand = os.path.join(opts.model_path or "", model)
+            model = cand if os.path.exists(cand) else model
+        if model and not os.path.exists(model):
+            # a configured-but-missing model must fail loudly, not
+            # silently degrade to the DSP heuristic
+            self._state = "ERROR"
+            return Result(False, f"vad model not found: {opts.model!r}")
+        if model:
+            try:
+                from ..models import vad_net
+
+                if model.endswith((".jit", ".pt", ".pth", ".ts")):
+                    try:  # torchscript archive (the silero download)
+                        self._net = vad_net.load_torchscript(model)
+                    except Exception:
+                        import torch
+
+                        self._net = vad_net.load_state_dict(
+                            torch.load(model, map_location="cpu",
+                                       weights_only=True))
+                elif model.endswith(".safetensors"):
+                    from safetensors import safe_open
+
+                    with safe_open(model, framework="np") as f:
+                        sd = {k: f.get_tensor(k) for k in f.keys()}
+                    self._net = vad_net.load_state_dict(sd)
+                else:
+                    return Result(False, (
+                        f"unsupported VAD model format: {model!r} "
+                        "(.jit/.pt/.pth/.safetensors)"))
+            except Exception as e:
+                self._state = "ERROR"
+                return Result(False, f"vad model load failed: {e}")
         self._state = "READY"
-        return Result(True, "vad ready")
+        return Result(True, "vad ready (learned silero-class model)"
+                      if self._net is not None
+                      else "vad ready (DSP detector)")
 
     def health(self) -> bool:
         return self._state == "READY"
@@ -68,6 +110,20 @@ class JaxVADBackend(Backend):
 
     def vad(self, audio: list[float]) -> VADResponse:
         pcm = np.asarray(audio, np.float32)
+        if self._net is not None:
+            from ..models import vad_net
+
+            if pcm.shape[0] < vad_net.CHUNK:
+                return VADResponse()
+            probs = vad_net.speech_probs(self._net, pcm)
+            thr = self.threshold if self.threshold <= 1.0 else 0.5
+            segs = vad_net.probs_to_segments(
+                probs, threshold=thr, min_speech_s=self.min_speech_s,
+                min_silence_s=self.min_silence_s)
+            return VADResponse(segments=[
+                VADSegment(start=round(s, 3), end=round(e, 3))
+                for s, e in segs
+            ])
         if pcm.shape[0] < FRAME:
             return VADResponse()
         # pad to a power-of-two bucket so the jitted FFT program compiles

@@ -1,0 +1,153 @@
+"""Learned (silero-class) VAD: every block verified against the
+equivalent torch ops with SHARED weights, so a real silero state dict
+imports without numeric surprises (ref: backend/go/vad/silero/vad.go
+runs the ONNX build of the same network)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from localai_tfp_tpu.models.vad_net import (  # noqa: E402
+    CHUNK, CONTEXT, VADParams, init_state, load_state_dict,
+    probs_to_segments, speech_probs, vad_forward,
+)
+
+BINS, WIN, H = 33, 64, 16
+ENC = ((BINS, 24), (24, H))  # (C_in, C_out) per conv layer
+
+
+def _state_dict(seed=0):
+    """Random weights in silero's torchscript key schema."""
+    g = torch.Generator().manual_seed(seed)
+
+    def t(*shape, scale=0.3):
+        return torch.randn(*shape, generator=g) * scale
+
+    sd = {"_model.stft.forward_basis_buffer": t(2 * BINS, 1, WIN)}
+    for i, (cin, cout) in enumerate(ENC):
+        sd[f"_model.encoder.{i}.reparam_conv.weight"] = t(cout, cin, 3)
+        sd[f"_model.encoder.{i}.reparam_conv.bias"] = t(cout)
+    sd["_model.decoder.rnn.weight_ih"] = t(4 * H, H)
+    sd["_model.decoder.rnn.weight_hh"] = t(4 * H, H)
+    sd["_model.decoder.rnn.bias_ih"] = t(4 * H)
+    sd["_model.decoder.rnn.bias_hh"] = t(4 * H)
+    sd["_model.decoder.decoder.2.weight"] = t(1, H, 1)
+    sd["_model.decoder.decoder.2.bias"] = t(1)
+    return sd
+
+
+def _torch_forward(sd, chunk, h, c):
+    """The same network in torch primitives (the golden reference)."""
+    x = torch.tensor(chunk)
+    basis = sd["_model.stft.forward_basis_buffer"]
+    pad = WIN // 2
+    x = torch.nn.functional.pad(x[:, None, :], (pad, pad), mode="reflect")
+    spec = torch.nn.functional.conv1d(x, basis, stride=WIN // 2)
+    mag = torch.sqrt(spec[:, :BINS] ** 2 + spec[:, BINS:] ** 2 + 1e-12)
+    hfeat = mag
+    for i in range(len(ENC)):
+        hfeat = torch.nn.functional.conv1d(
+            hfeat, sd[f"_model.encoder.{i}.reparam_conv.weight"],
+            sd[f"_model.encoder.{i}.reparam_conv.bias"], padding=1)
+        hfeat = torch.relu(hfeat)
+    feat = hfeat.mean(dim=-1)
+    cell = torch.nn.LSTMCell(H, H)
+    cell.weight_ih.data = sd["_model.decoder.rnn.weight_ih"]
+    cell.weight_hh.data = sd["_model.decoder.rnn.weight_hh"]
+    cell.bias_ih.data = sd["_model.decoder.rnn.bias_ih"]
+    cell.bias_hh.data = sd["_model.decoder.rnn.bias_hh"]
+    with torch.no_grad():
+        h2, c2 = cell(feat, (torch.tensor(h), torch.tensor(c)))
+        logit = torch.nn.functional.conv1d(
+            torch.relu(h2)[:, :, None],
+            sd["_model.decoder.decoder.2.weight"],
+            sd["_model.decoder.decoder.2.bias"])
+        prob = torch.sigmoid(logit)[:, 0, 0]
+    return prob.numpy(), h2.numpy(), c2.numpy()
+
+
+def test_forward_matches_torch_exactly():
+    sd = _state_dict()
+    params = load_state_dict(sd)
+    rng = np.random.default_rng(1)
+    chunk = rng.standard_normal((2, CONTEXT + CHUNK)).astype(np.float32)
+    h0 = rng.standard_normal((2, H)).astype(np.float32) * 0.1
+    c0 = rng.standard_normal((2, H)).astype(np.float32) * 0.1
+    want_p, want_h, want_c = _torch_forward(sd, chunk, h0, c0)
+    got_p, got_h, got_c = vad_forward(
+        params, chunk, np.asarray(h0), np.asarray(c0))
+    np.testing.assert_allclose(np.asarray(got_p), want_p,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_h), want_h,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_c), want_c,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_streaming_state_carries():
+    """Same audio split into chunks must give different probs than a
+    zero-state restart (the LSTM actually carries memory)."""
+    params = load_state_dict(_state_dict())
+    rng = np.random.default_rng(2)
+    audio = rng.standard_normal(CHUNK * 4).astype(np.float32)
+    probs = speech_probs(params, audio)
+    assert probs.shape == (4,)
+    # restart state at chunk 2: second prob differs from streamed run
+    h, c = init_state(1, H)
+    chunk2 = np.zeros((1, CONTEXT + CHUNK), np.float32)
+    chunk2[0, CONTEXT:] = audio[CHUNK:2 * CHUNK]
+    chunk2[0, :CONTEXT] = audio[CHUNK - CONTEXT:CHUNK]
+    p_fresh, _, _ = vad_forward(params, chunk2, h, c)
+    assert abs(float(p_fresh[0]) - float(probs[1])) > 1e-6
+
+
+def test_probs_to_segments_hysteresis():
+    probs = np.array([0.1, 0.9, 0.8, 0.4, 0.4, 0.9, 0.1, 0.1, 0.1])
+    segs = probs_to_segments(probs, threshold=0.5, min_speech_s=0.05,
+                             min_silence_s=0.07)
+    assert len(segs) == 1  # the 0.4 dip is above neg_threshold: bridged
+    s, e = segs[0]
+    assert s <= 0.04 and e > 0.15
+
+
+def test_probs_to_segments_splits_on_silence():
+    probs = np.array([0.9, 0.9, 0.05, 0.05, 0.05, 0.9, 0.9, 0.05, 0.05,
+                      0.05])
+    segs = probs_to_segments(probs, threshold=0.5, min_speech_s=0.03,
+                             min_silence_s=0.06)
+    assert len(segs) == 2
+
+
+def test_worker_loads_learned_model(tmp_path):
+    """The VAD worker runs learned weights when configured (ref verdict:
+    /vad runs learned weights when configured; DSP stays the fallback)."""
+    from safetensors.numpy import save_file
+
+    from localai_tfp_tpu.workers.base import ModelLoadOptions
+    from localai_tfp_tpu.workers.vad import JaxVADBackend
+
+    sd = {k: v.numpy() for k, v in _state_dict().items()}
+    path = str(tmp_path / "vad.safetensors")
+    save_file(sd, path)
+    b = JaxVADBackend()
+    res = b.load_model(ModelLoadOptions(model=path,
+                                        options=["threshold=0.5"]))
+    assert res.success and "learned" in res.message
+    rng = np.random.default_rng(3)
+    out = b.vad(list(rng.standard_normal(CHUNK * 6).astype(np.float32)))
+    assert isinstance(out.segments, list)  # learned path executed
+
+    # no model => DSP fallback still works
+    b2 = JaxVADBackend()
+    res2 = b2.load_model(ModelLoadOptions())
+    assert res2.success and "DSP" in res2.message
+
+
+def test_worker_missing_model_fails_loudly():
+    from localai_tfp_tpu.workers.base import ModelLoadOptions
+    from localai_tfp_tpu.workers.vad import JaxVADBackend
+
+    b = JaxVADBackend()
+    res = b.load_model(ModelLoadOptions(model="/nope/silero.jit"))
+    assert not res.success and "not found" in res.message
