@@ -1,0 +1,479 @@
+"""GGUF checkpoint ingestion: dequantize-on-load into the JAX serving
+stack.
+
+GGUF is the reference's primary model format (loader
+pkg/model/initializers.go:498-559; introspection core/config/gguf.go
+:36-123; the LocalAI gallery is GGUF-heavy). This module reads GGUF
+v2/v3 files, dequantizes the common llama.cpp tensor types (F32, F16,
+BF16, Q4_0, Q8_0, Q4_K, Q5_K, Q6_K — the Q4_K_M / Q5_K_M / Q8_0
+publishing set) with vectorized numpy kernels, maps llama-family tensor
+names onto the transformer's parameter tree (including the inverse of
+convert_hf_to_gguf's Q/K head permutation — gguf stores rope-interleaved
+rows, the serving stack uses the HF rotate-half convention), and
+reconstructs the tokenizer from the embedded vocab (BPE for "gpt2",
+Unigram+byte-fallback for "llama"/sentencepiece).
+
+Serving dtype is the engine's (bf16 by default): dequantize-on-load
+trades the gguf file's compression for MXU-native weights; pair with
+``quantization: int8`` to re-quantize the projections for HBM.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, BinaryIO, Callable, Optional
+
+import numpy as np
+
+GGUF_MAGIC = 0x46554747  # "GGUF" little-endian
+
+# metadata value types
+_T_U8, _T_I8, _T_U16, _T_I16, _T_U32, _T_I32, _T_F32, _T_BOOL, \
+    _T_STR, _T_ARR, _T_U64, _T_I64, _T_F64 = range(13)
+
+_SCALARS: dict[int, tuple[str, int]] = {
+    _T_U8: ("<B", 1), _T_I8: ("<b", 1), _T_U16: ("<H", 2),
+    _T_I16: ("<h", 2), _T_U32: ("<I", 4), _T_I32: ("<i", 4),
+    _T_F32: ("<f", 4), _T_BOOL: ("<?", 1), _T_U64: ("<Q", 8),
+    _T_I64: ("<q", 8), _T_F64: ("<d", 8),
+}
+
+
+def _read_str(f: BinaryIO) -> str:
+    (n,) = struct.unpack("<Q", f.read(8))
+    return f.read(n).decode("utf-8", errors="replace")
+
+
+def _read_value(f: BinaryIO, vtype: int) -> Any:
+    if vtype in _SCALARS:
+        fmt, size = _SCALARS[vtype]
+        return struct.unpack(fmt, f.read(size))[0]
+    if vtype == _T_STR:
+        return _read_str(f)
+    if vtype == _T_ARR:
+        (etype,) = struct.unpack("<I", f.read(4))
+        (count,) = struct.unpack("<Q", f.read(8))
+        if etype in _SCALARS:
+            fmt, size = _SCALARS[etype]
+            raw = f.read(size * count)
+            return list(struct.unpack(f"<{count}{fmt[1]}", raw))
+        return [_read_value(f, etype) for _ in range(count)]
+    raise ValueError(f"unknown gguf metadata type {vtype}")
+
+
+class GGUFTensorInfo:
+    __slots__ = ("name", "shape", "ggml_type", "offset")
+
+    def __init__(self, name: str, shape: tuple[int, ...], ggml_type: int,
+                 offset: int) -> None:
+        self.name = name
+        self.shape = shape  # numpy order (outermost first)
+        self.ggml_type = ggml_type
+        self.offset = offset
+
+
+class GGUFFile:
+    """Parsed header + lazy per-tensor dequantization."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.metadata: dict[str, Any] = {}
+        self.tensors: dict[str, GGUFTensorInfo] = {}
+        with open(path, "rb") as f:
+            magic, version = struct.unpack("<II", f.read(8))
+            if magic != GGUF_MAGIC:
+                raise ValueError(f"{path}: not a GGUF file")
+            if version not in (2, 3):
+                raise ValueError(f"{path}: unsupported GGUF v{version}")
+            n_tensors, n_kv = struct.unpack("<QQ", f.read(16))
+            for _ in range(n_kv):
+                key = _read_str(f)
+                (vtype,) = struct.unpack("<I", f.read(4))
+                self.metadata[key] = _read_value(f, vtype)
+            infos = []
+            for _ in range(n_tensors):
+                name = _read_str(f)
+                (nd,) = struct.unpack("<I", f.read(4))
+                ne = struct.unpack(f"<{nd}Q", f.read(8 * nd))
+                ggml_type, = struct.unpack("<I", f.read(4))
+                offset, = struct.unpack("<Q", f.read(8))
+                # gguf ne is innermost-first; numpy shape reverses it
+                infos.append(GGUFTensorInfo(
+                    name, tuple(reversed(ne)), ggml_type, offset))
+            align = int(self.metadata.get("general.alignment", 32))
+            pos = f.tell()
+            self.data_start = (pos + align - 1) // align * align
+        for ti in infos:
+            self.tensors[ti.name] = ti
+
+    def tensor(self, name: str) -> np.ndarray:
+        """Dequantized f32 tensor in numpy (outermost-first) order."""
+        ti = self.tensors[name]
+        kind = _GGML_TYPES.get(ti.ggml_type)
+        if kind is None:
+            raise ValueError(
+                f"{name}: unsupported ggml tensor type {ti.ggml_type}")
+        dequant, block, block_bytes = kind
+        n = int(np.prod(ti.shape))
+        nbytes = n // block * block_bytes
+        with open(self.path, "rb") as f:
+            f.seek(self.data_start + ti.offset)
+            raw = f.read(nbytes)
+        return dequant(np.frombuffer(raw, np.uint8)).reshape(ti.shape)
+
+
+# ---------------------------------------------------------------------------
+# dequantization kernels (llama.cpp block layouts, vectorized)
+# ---------------------------------------------------------------------------
+
+
+def _dq_f32(b: np.ndarray) -> np.ndarray:
+    return b.view(np.float32)
+
+
+def _dq_f16(b: np.ndarray) -> np.ndarray:
+    return b.view(np.float16).astype(np.float32)
+
+
+def _dq_bf16(b: np.ndarray) -> np.ndarray:
+    u = b.view(np.uint16).astype(np.uint32) << 16
+    return u.view(np.float32)
+
+
+def _dq_q8_0(b: np.ndarray) -> np.ndarray:
+    """block: f16 d + 32 int8."""
+    blk = b.reshape(-1, 34)
+    d = blk[:, :2].copy().view(np.float16).astype(np.float32)  # [N,1]
+    q = blk[:, 2:].view(np.int8).astype(np.float32)  # [N,32]
+    return (d * q).ravel()
+
+
+def _dq_q4_0(b: np.ndarray) -> np.ndarray:
+    """block: f16 d + 16 bytes of nibbles; elems 0..15 = low nibbles,
+    16..31 = high."""
+    blk = b.reshape(-1, 18)
+    d = blk[:, :2].copy().view(np.float16).astype(np.float32)
+    qs = blk[:, 2:]
+    lo = (qs & 0xF).astype(np.float32) - 8.0
+    hi = (qs >> 4).astype(np.float32) - 8.0
+    return (d * np.concatenate([lo, hi], axis=1)).ravel()
+
+
+def _k_scale_min(scales: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Unpack the 12-byte 6-bit scale/min table of K-quants: returns
+    (sc [N, 8], m [N, 8])."""
+    s = scales.astype(np.uint16)
+    sc = np.empty(s.shape[:-1] + (8,), np.uint16)
+    m = np.empty_like(sc)
+    sc[..., :4] = s[..., 0:4] & 63
+    m[..., :4] = s[..., 4:8] & 63
+    sc[..., 4:] = (s[..., 8:12] & 0xF) | ((s[..., 0:4] >> 6) << 4)
+    m[..., 4:] = (s[..., 8:12] >> 4) | ((s[..., 4:8] >> 6) << 4)
+    return sc.astype(np.float32), m.astype(np.float32)
+
+
+def _dq_q4_k(b: np.ndarray) -> np.ndarray:
+    """super-block of 256: d f16, dmin f16, scales[12], qs[128].
+    Chunk c (64 vals) uses qs[32c:32c+32]: low nibbles -> scale 2c,
+    high nibbles -> scale 2c+1."""
+    blk = b.reshape(-1, 144)
+    N = blk.shape[0]
+    d = blk[:, :2].copy().view(np.float16).astype(np.float32)  # [N,1]
+    dmin = blk[:, 2:4].copy().view(np.float16).astype(np.float32)
+    sc, mn = _k_scale_min(blk[:, 4:16])  # [N, 8]
+    qs = blk[:, 16:144].reshape(N, 4, 32)  # per chunk
+    lo = (qs & 0xF).astype(np.float32)  # [N, 4, 32]
+    hi = (qs >> 4).astype(np.float32)
+    out = np.empty((N, 4, 2, 32), np.float32)
+    out[:, :, 0, :] = (d[:, None] * sc.reshape(N, 4, 2)[:, :, 0:1] * lo
+                       - dmin[:, None] * mn.reshape(N, 4, 2)[:, :, 0:1])
+    out[:, :, 1, :] = (d[:, None] * sc.reshape(N, 4, 2)[:, :, 1:2] * hi
+                       - dmin[:, None] * mn.reshape(N, 4, 2)[:, :, 1:2])
+    return out.ravel()
+
+
+def _dq_q5_k(b: np.ndarray) -> np.ndarray:
+    """super-block of 256: d, dmin, scales[12], qh[32], qs[128]."""
+    blk = b.reshape(-1, 176)
+    N = blk.shape[0]
+    d = blk[:, :2].copy().view(np.float16).astype(np.float32)
+    dmin = blk[:, 2:4].copy().view(np.float16).astype(np.float32)
+    sc, mn = _k_scale_min(blk[:, 4:16])
+    qh = blk[:, 16:48]  # [N, 32] high bits, bit 2c/2c+1 per chunk
+    qs = blk[:, 48:176].reshape(N, 4, 32)
+    out = np.empty((N, 4, 2, 32), np.float32)
+    for c in range(4):
+        lo = (qs[:, c] & 0xF).astype(np.float32) + \
+            (((qh >> (2 * c)) & 1) << 4).astype(np.float32)
+        hi = (qs[:, c] >> 4).astype(np.float32) + \
+            (((qh >> (2 * c + 1)) & 1) << 4).astype(np.float32)
+        out[:, c, 0] = d * sc[:, 2 * c:2 * c + 1] * lo \
+            - dmin * mn[:, 2 * c:2 * c + 1]
+        out[:, c, 1] = d * sc[:, 2 * c + 1:2 * c + 2] * hi \
+            - dmin * mn[:, 2 * c + 1:2 * c + 2]
+    return out.ravel()
+
+
+def _dq_q6_k(b: np.ndarray) -> np.ndarray:
+    """super-block of 256: ql[128], qh[64], scales[16] i8, d f16."""
+    blk = b.reshape(-1, 210)
+    N = blk.shape[0]
+    ql = blk[:, 0:128].reshape(N, 2, 64)
+    qh = blk[:, 128:192].reshape(N, 2, 32)
+    scales = blk[:, 192:208].view(np.int8).astype(np.float32)  # [N,16]
+    d = blk[:, 208:210].copy().view(np.float16).astype(np.float32)
+    out = np.empty((N, 2, 4, 32), np.float32)
+    l = np.arange(32)
+    for half in range(2):
+        qlh = ql[:, half]  # [N, 64]
+        qhh = qh[:, half]  # [N, 32]
+        q1 = ((qlh[:, :32] & 0xF) | (((qhh >> 0) & 3) << 4)).astype(
+            np.int32) - 32
+        q2 = ((qlh[:, 32:] & 0xF) | (((qhh >> 2) & 3) << 4)).astype(
+            np.int32) - 32
+        q3 = ((qlh[:, :32] >> 4) | (((qhh >> 4) & 3) << 4)).astype(
+            np.int32) - 32
+        q4 = ((qlh[:, 32:] >> 4) | (((qhh >> 6) & 3) << 4)).astype(
+            np.int32) - 32
+        base = 8 * half
+        sidx = base + l // 16  # [32] scale index for y[l]
+        out[:, half, 0] = d * scales[:, sidx] * q1
+        out[:, half, 1] = d * scales[:, sidx + 2] * q2
+        out[:, half, 2] = d * scales[:, sidx + 4] * q3
+        out[:, half, 3] = d * scales[:, sidx + 6] * q4
+    return out.ravel()
+
+
+# ggml_type -> (dequant, block size in elems, block bytes)
+_GGML_TYPES: dict[int, tuple[Callable, int, int]] = {
+    0: (_dq_f32, 1, 4),
+    1: (_dq_f16, 1, 2),
+    2: (_dq_q4_0, 32, 18),
+    8: (_dq_q8_0, 32, 34),
+    12: (_dq_q4_k, 256, 144),
+    13: (_dq_q5_k, 256, 176),
+    14: (_dq_q6_k, 256, 210),
+    30: (_dq_bf16, 1, 2),
+}
+
+GGML_TYPE_NAMES = {0: "F32", 1: "F16", 2: "Q4_0", 8: "Q8_0",
+                   12: "Q4_K", 13: "Q5_K", 14: "Q6_K", 30: "BF16"}
+
+
+# ---------------------------------------------------------------------------
+# spec + params mapping (llama-family)
+# ---------------------------------------------------------------------------
+
+
+def _unpermute_qk(w: np.ndarray, n_heads: int) -> np.ndarray:
+    """Invert convert_hf_to_gguf's permute(): gguf stores Q/K rows in
+    rope-interleaved order; the serving stack ropes in HF rotate-half
+    order. w is [out, in]."""
+    out, in_ = w.shape
+    hd = out // n_heads
+    return (w.reshape(n_heads, hd // 2, 2, in_)
+            .swapaxes(1, 2)
+            .reshape(out, in_))
+
+
+def spec_from_gguf(meta: dict):
+    from .llm_spec import LLMSpec
+
+    arch = meta.get("general.architecture", "llama")
+
+    def g(key, default=None):
+        return meta.get(f"{arch}.{key}", default)
+
+    n_heads = int(g("attention.head_count", 32))
+    d_model = int(g("embedding_length", 4096))
+    head_dim = int(g("attention.key_length", d_model // n_heads))
+    rope_scaling = None
+    if g("rope.scaling.type") == "linear":
+        rope_scaling = {"rope_type": "linear",
+                        "factor": float(g("rope.scaling.factor", 1.0))}
+    elif g("rope.scaling.type") == "yarn":
+        rope_scaling = {
+            "rope_type": "yarn",
+            "factor": float(g("rope.scaling.factor", 1.0)),
+            "original_max_position_embeddings": int(
+                g("rope.scaling.original_context_length", 4096)),
+        }
+    tokens = meta.get("tokenizer.ggml.tokens") or []
+    return LLMSpec(
+        vocab_size=int(g("vocab_size", len(tokens) or 32000)),
+        d_model=d_model,
+        n_layers=int(g("block_count", 32)),
+        n_heads=n_heads,
+        n_kv_heads=int(g("attention.head_count_kv", n_heads)),
+        d_head=head_dim,
+        d_ff=int(g("feed_forward_length", 4 * d_model)),
+        max_position=int(g("context_length", 4096)),
+        rope_theta=float(g("rope.freq_base", 10000.0)),
+        norm_eps=float(g("attention.layer_norm_rms_epsilon", 1e-5)),
+        rope_scaling=rope_scaling,
+    )
+
+
+def load_gguf_params(path: str, dtype: Any = None,
+                     gf: Optional[GGUFFile] = None):
+    """(spec, params) from a GGUF file; weights dequantized to ``dtype``
+    (bf16 default). Pass an already-parsed ``gf`` to skip re-reading the
+    (vocab-heavy) header."""
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.bfloat16
+    gf = gf or GGUFFile(path)
+    spec = spec_from_gguf(gf.metadata)
+    L = spec.n_layers
+    used: set[str] = set()
+
+    def get(name: str) -> np.ndarray:
+        used.add(name)
+        return gf.tensor(name)
+
+    def stack(fmt: str, fn=None) -> Any:
+        rows = []
+        for i in range(L):
+            a = get(fmt.format(i=i))
+            rows.append(fn(a) if fn is not None else a)
+        return jnp.asarray(np.stack(rows), dtype)
+
+    def t(a: np.ndarray) -> np.ndarray:  # [out, in] -> [in, out]
+        return a.T
+
+    p: dict[str, Any] = {
+        "embed": jnp.asarray(get("token_embd.weight"), dtype),
+        "ln1_w": stack("blk.{i}.attn_norm.weight"),
+        "ln2_w": stack("blk.{i}.ffn_norm.weight"),
+        "wq": stack("blk.{i}.attn_q.weight",
+                    lambda a: t(_unpermute_qk(a, spec.n_heads))),
+        "wk": stack("blk.{i}.attn_k.weight",
+                    lambda a: t(_unpermute_qk(a, spec.n_kv_heads))),
+        "wv": stack("blk.{i}.attn_v.weight", t),
+        "wo": stack("blk.{i}.attn_output.weight", t),
+        "w_gate": stack("blk.{i}.ffn_gate.weight", t),
+        "w_up": stack("blk.{i}.ffn_up.weight", t),
+        "w_down": stack("blk.{i}.ffn_down.weight", t),
+        "final_norm_w": jnp.asarray(get("output_norm.weight"), dtype),
+    }
+    if "output.weight" in gf.tensors:
+        p["lm_head"] = jnp.asarray(t(get("output.weight")), dtype)
+    else:
+        spec = __import__("dataclasses").replace(
+            spec, tie_word_embeddings=True)
+    if "blk.0.attn_q.bias" in gf.tensors:  # qwen-style qkv bias
+        p["bq"] = stack("blk.{i}.attn_q.bias",
+                        lambda a: _unpermute_qk(a[:, None],
+                                                spec.n_heads)[:, 0])
+        p["bk"] = stack("blk.{i}.attn_k.bias",
+                        lambda a: _unpermute_qk(a[:, None],
+                                                spec.n_kv_heads)[:, 0])
+        p["bv"] = stack("blk.{i}.attn_v.bias")
+        spec = __import__("dataclasses").replace(spec, qkv_bias=True)
+    if "blk.0.attn_q_norm.weight" in gf.tensors:  # qwen3 qk-norm
+        p["q_norm_w"] = stack("blk.{i}.attn_q_norm.weight")
+        p["k_norm_w"] = stack("blk.{i}.attn_k_norm.weight")
+        spec = __import__("dataclasses").replace(spec, qk_norm=True)
+    return spec, p
+
+
+# ---------------------------------------------------------------------------
+# tokenizer from embedded vocab
+# ---------------------------------------------------------------------------
+
+
+class GGUFTokenizer:
+    """Tokenizer protocol implementation built from gguf metadata
+    (tokenizer.ggml.*): BPE for "gpt2" vocabs, Unigram with byte
+    fallback for "llama"/sentencepiece vocabs."""
+
+    def __init__(self, meta: dict) -> None:
+        from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+
+        kind = meta.get("tokenizer.ggml.model", "llama")
+        tokens = list(meta.get("tokenizer.ggml.tokens") or [])
+        if not tokens:
+            raise ValueError("gguf carries no tokenizer.ggml.tokens")
+        self.bos_id = meta.get("tokenizer.ggml.bos_token_id")
+        eos = meta.get("tokenizer.ggml.eos_token_id")
+        self.eos_ids = {int(eos)} if eos is not None else set()
+        self.chat_template = meta.get("tokenizer.chat_template")
+        if kind == "gpt2":
+            merges = [tuple(m.split(" ", 1))
+                      for m in meta.get("tokenizer.ggml.merges") or []]
+            vocab = {tok: i for i, tok in enumerate(tokens)}
+            tk = Tokenizer(models.BPE(vocab=vocab, merges=merges))
+            tk.pre_tokenizer = pre_tokenizers.ByteLevel(
+                add_prefix_space=False)
+            tk.decoder = decoders.ByteLevel()
+        else:  # sentencepiece-style
+            scores = meta.get("tokenizer.ggml.scores") or [0.0] * len(
+                tokens)
+            unk = int(meta.get("tokenizer.ggml.unknown_token_id", 0))
+            tk = Tokenizer(models.Unigram(
+                list(zip(tokens, [float(s) for s in scores])),
+                unk_id=unk, byte_fallback=True))
+            tk.pre_tokenizer = pre_tokenizers.Metaspace()
+            tk.decoder = decoders.Sequence([
+                decoders.ByteFallback(), decoders.Metaspace()])
+        self._tk = tk
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tk.get_vocab_size()
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]:
+        ids = self._tk.encode(text, add_special_tokens=False).ids
+        if add_bos and self.bos_id is not None:
+            ids = [int(self.bos_id)] + ids
+        return ids
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tk.decode(ids, skip_special_tokens=False)
+
+    def encode_special(self, text: str) -> list[int]:
+        return self._tk.encode(text, add_special_tokens=True).ids
+
+    def apply_chat_template(self, messages, *, add_generation_prompt=True,
+                            tools=None) -> str:
+        if not self.chat_template:
+            raise ValueError("gguf has no tokenizer.chat_template")
+        import datetime
+
+        import jinja2
+
+        # mainstream templates (llama3, qwen) call raise_exception() /
+        # strftime_now() and use |tojson — the same environment
+        # transformers' templating provides
+        env = jinja2.Environment(extensions=["jinja2.ext.loopcontrols"])
+
+        def raise_exception(msg):
+            raise jinja2.exceptions.TemplateError(msg)
+
+        env.globals["raise_exception"] = raise_exception
+        env.globals["strftime_now"] = (
+            lambda fmt: datetime.datetime.now().strftime(fmt))
+        tpl = env.from_string(self.chat_template)
+        return tpl.render(
+            messages=messages,
+            add_generation_prompt=add_generation_prompt,
+            tools=tools,
+            bos_token=self._token_str(self.bos_id),
+            eos_token=self._token_str(next(iter(self.eos_ids), None)),
+        )
+
+    def _token_str(self, tid) -> str:
+        if tid is None:
+            return ""
+        try:
+            return self._tk.id_to_token(int(tid)) or ""
+        except Exception:
+            return ""
+
+
+def tokenizer_from_gguf(gf: "GGUFFile") -> GGUFTokenizer:
+    """Tokenizer from an already-parsed GGUF (the vocab metadata is
+    large — parse the file once). Raises on a vocab the tokenizer layer
+    cannot represent: serving raw-byte fallback for a 128k-vocab model
+    would emit gibberish with no diagnostic."""
+    return GGUFTokenizer(gf.metadata)

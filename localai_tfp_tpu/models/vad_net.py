@@ -194,7 +194,10 @@ def probs_to_segments(
     """Hysteresis segmentation over per-chunk probabilities (the silero
     utils_vad convention: enter at ``threshold``, leave only below
     ``neg_threshold``, drop short speech, bridge short silence, pad)."""
-    neg = neg_threshold if neg_threshold is not None else threshold - 0.15
+    # silero utils_vad convention: exit threshold floored so low entry
+    # thresholds still allow segments to close
+    neg = (neg_threshold if neg_threshold is not None
+           else max(threshold - 0.15, 0.01))
     segs: list[list[float]] = []
     active = False
     start = 0.0

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from ..engine.engine import GenRequest, LLMEngine, StreamEvent
-from ..engine.tokenizer import Tokenizer, load_tokenizer
+from ..engine.tokenizer import ByteTokenizer, Tokenizer, load_tokenizer
 from ..grammars.native import make_constraint
 from ..models.hf_loader import load_params
 from ..models.lora import merge_lora
@@ -88,13 +88,15 @@ class JaxLLMBackend(Backend):
             model_dir = opts.model
             if not os.path.isabs(model_dir):
                 model_dir = os.path.join(opts.model_path or "", model_dir)
-            if not os.path.isdir(model_dir):
+            is_gguf = model_dir.endswith(".gguf")
+            if (not os.path.isdir(model_dir) if not is_gguf
+                    else not os.path.isfile(model_dir)):
                 # validate BEFORE broadcasting: a typo'd model name must
                 # stay leader-local, not fan a doomed load out to the slice
                 self._state = "ERROR"
                 return Result(
                     False,
-                    f"load failed: model directory not found: {model_dir}",
+                    f"load failed: model not found: {model_dir}",
                 )
             if channel is not None and role == "leader":
                 # followers load the identical checkpoint from their own
@@ -107,11 +109,25 @@ class JaxLLMBackend(Backend):
                 self._state = "BUSY"
                 dtype = _DTYPES.get((opts.dtype or "bfloat16").lower(),
                                     jnp.bfloat16)
-                from ..models.hf_loader import load_hf_state
+                if is_gguf:
+                    # GGUF: dequantize-on-load (ref: the reference's
+                    # primary format — initializers.go:498-559); the
+                    # tokenizer rides inside the file. Header parsed
+                    # ONCE (the 100k+-token vocab dominates parse time).
+                    from ..models.gguf import (
+                        GGUFFile, load_gguf_params, tokenizer_from_gguf,
+                    )
 
-                hf_state = load_hf_state(model_dir)
-                self.spec, params = load_params(model_dir, dtype=dtype,
-                                                state=hf_state)
+                    hf_state = None
+                    gf = GGUFFile(model_dir)
+                    self.spec, params = load_gguf_params(
+                        model_dir, dtype=dtype, gf=gf)
+                else:
+                    from ..models.hf_loader import load_hf_state
+
+                    hf_state = load_hf_state(model_dir)
+                    self.spec, params = load_params(
+                        model_dir, dtype=dtype, state=hf_state)
                 # merge LoRA adapters at load (ref: llama.cpp LoRA apply
                 # via LoadModel — proto LoraAdapter/LoraScale)
                 for i, adir in enumerate(opts.lora_adapters):
@@ -125,12 +141,21 @@ class JaxLLMBackend(Backend):
                         continue
                     params, n = merge_lora(self.spec, params, adir,
                                            scale=scale)
-                self.tokenizer = load_tokenizer(model_dir)
+                if is_gguf:
+                    # no silent raw-byte fallback: a 128k-vocab model
+                    # with a broken embedded vocab must fail the load
+                    self.tokenizer = tokenizer_from_gguf(gf)
+                else:
+                    self.tokenizer = load_tokenizer(model_dir)
                 try:
+                    if is_gguf:
+                        raise LookupError("gguf: no mmproj tower")
                     from ..models.hf_loader import load_multimodal
 
                     self.vision = load_multimodal(model_dir, dtype=dtype,
                                                   state=hf_state)
+                except LookupError:
+                    self.vision = None
                 except Exception as ve:
                     # text-only serving still works, but a genuinely
                     # multimodal checkpoint losing its tower must be
@@ -163,8 +188,12 @@ class JaxLLMBackend(Backend):
                     ddir = opts.draft_model
                     if not os.path.isabs(ddir):
                         ddir = os.path.join(opts.model_path or "", ddir)
-                    dspec, dparams = load_params(ddir, dtype=dtype)
-                    draft = (dspec, dparams)
+                    if ddir.endswith(".gguf"):
+                        from ..models.gguf import load_gguf_params
+
+                        draft = load_gguf_params(ddir, dtype=dtype)
+                    else:
+                        draft = load_params(ddir, dtype=dtype)
                 self.engine = LLMEngine(
                     self.spec,
                     params,

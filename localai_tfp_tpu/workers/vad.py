@@ -91,6 +91,7 @@ class JaxVADBackend(Backend):
                         sd = {k: f.get_tensor(k) for k in f.keys()}
                     self._net = vad_net.load_state_dict(sd)
                 else:
+                    self._state = "ERROR"
                     return Result(False, (
                         f"unsupported VAD model format: {model!r} "
                         "(.jit/.pt/.pth/.safetensors)"))

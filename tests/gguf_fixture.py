@@ -1,0 +1,167 @@
+"""Minimal GGUF v3 writer + block-quant encoders for tests.
+
+Written independently from the reader (localai_tfp_tpu/models/gguf.py)
+against the llama.cpp format spec, so the reader's bit-layout handling
+is cross-checked, not self-checked. Quant encoders take explicit
+(d, q, ...) components and the tests compute the expected dequantized
+values from the same components."""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+_T = {"u8": 0, "i8": 1, "u16": 2, "i16": 3, "u32": 4, "i32": 5,
+      "f32": 6, "bool": 7, "str": 8, "arr": 9, "u64": 10, "i64": 11,
+      "f64": 12}
+_FMT = {0: "B", 1: "b", 2: "H", 3: "h", 4: "I", 5: "i", 6: "f", 7: "?",
+        10: "Q", 11: "q", 12: "d"}
+
+
+def _pack_str(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return struct.pack("<Q", len(b)) + b
+
+
+def _pack_value(vtype: int, v) -> bytes:
+    if vtype == _T["str"]:
+        return _pack_str(v)
+    return struct.pack("<" + _FMT[vtype], v)
+
+
+def write_gguf(path: str, metadata: list, tensors: list,
+               align: int = 32) -> None:
+    """metadata: [(key, type_name, value)] where type_name may be
+    "arr:<elem>"; tensors: [(name, ggml_type, ne_innermost_first, raw)].
+    """
+    out = bytearray()
+    out += struct.pack("<IIQQ", 0x46554747, 3, len(tensors),
+                       len(metadata))
+    for key, tname, value in metadata:
+        out += _pack_str(key)
+        if tname.startswith("arr:"):
+            et = _T[tname[4:]]
+            out += struct.pack("<I", _T["arr"])
+            out += struct.pack("<IQ", et, len(value))
+            for v in value:
+                out += _pack_value(et, v)
+        else:
+            out += struct.pack("<I", _T[tname])
+            out += _pack_value(_T[tname], value)
+    offsets = []
+    off = 0
+    for name, gt, ne, raw in tensors:
+        out += _pack_str(name)
+        out += struct.pack("<I", len(ne))
+        out += struct.pack(f"<{len(ne)}Q", *ne)
+        out += struct.pack("<I", gt)
+        out += struct.pack("<Q", off)
+        offsets.append(off)
+        off += (len(raw) + align - 1) // align * align
+    pad = (-len(out)) % align
+    out += b"\x00" * pad
+    for i, (name, gt, ne, raw) in enumerate(tensors):
+        out += raw
+        out += b"\x00" * ((-len(raw)) % align)
+    with open(path, "wb") as f:
+        f.write(bytes(out))
+
+
+# ------------------------------------------------------------------ encoders
+
+
+def enc_f32(w: np.ndarray) -> bytes:
+    return w.astype("<f4").tobytes()
+
+
+def enc_f16(w: np.ndarray) -> bytes:
+    return w.astype("<f2").tobytes()
+
+
+def enc_q8_0(d: np.ndarray, q: np.ndarray) -> bytes:
+    """d [N] f32, q [N, 32] int8 -> blocks; value = d*q."""
+    out = bytearray()
+    for i in range(len(d)):
+        out += np.float16(d[i]).tobytes()
+        out += q[i].astype(np.int8).tobytes()
+    return bytes(out)
+
+
+def enc_q4_0(d: np.ndarray, q: np.ndarray) -> bytes:
+    """q [N, 32] ints in [-8, 7]; value = d*q; elems 0..15 low nibbles."""
+    out = bytearray()
+    for i in range(len(d)):
+        out += np.float16(d[i]).tobytes()
+        u = (q[i] + 8).astype(np.uint8)
+        out += (u[:16] | (u[16:] << 4)).tobytes()
+    return bytes(out)
+
+
+def _pack_k_scales(sc: np.ndarray, m: np.ndarray) -> bytes:
+    """Inverse of the reader's 6-bit unpack: sc/m [8] ints in [0, 63]."""
+    s = np.zeros(12, np.uint8)
+    for j in range(4):
+        s[j] = (sc[j] & 63) | ((sc[j + 4] >> 4) << 6)
+        s[j + 4] = (m[j] & 63) | ((m[j + 4] >> 4) << 6)
+        s[j + 8] = (sc[j + 4] & 0xF) | ((m[j + 4] & 0xF) << 4)
+    return s.tobytes()
+
+
+def enc_q4_k(d, dmin, sc, m, q) -> bytes:
+    """One super-block: d/dmin scalars, sc/m [8] in [0,63], q [256] in
+    [0,15]. value[64c+j] = d*sc[2c]*qlow - dmin*m[2c] (j<32) etc."""
+    out = bytearray()
+    out += np.float16(d).tobytes() + np.float16(dmin).tobytes()
+    out += _pack_k_scales(np.asarray(sc), np.asarray(m))
+    qv = np.asarray(q, np.uint8).reshape(4, 2, 32)
+    for c in range(4):
+        out += (qv[c, 0] | (qv[c, 1] << 4)).tobytes()
+    return bytes(out)
+
+
+def enc_q5_k(d, dmin, sc, m, q) -> bytes:
+    """q [256] in [0, 31]."""
+    qv = np.asarray(q, np.uint32).reshape(4, 2, 32)
+    qh = np.zeros(32, np.uint8)
+    qs = bytearray()
+    for c in range(4):
+        lo = qv[c, 0]
+        hi = qv[c, 1]
+        qh |= ((lo >> 4) & 1).astype(np.uint8) << (2 * c)
+        qh |= ((hi >> 4) & 1).astype(np.uint8) << (2 * c + 1)
+        qs += ((lo & 0xF) | ((hi & 0xF) << 4)).astype(np.uint8).tobytes()
+    out = bytearray()
+    out += np.float16(d).tobytes() + np.float16(dmin).tobytes()
+    out += _pack_k_scales(np.asarray(sc), np.asarray(m))
+    out += qh.tobytes() + bytes(qs)
+    return bytes(out)
+
+
+def enc_q6_k(d, scales, q) -> bytes:
+    """scales [16] int8, q [256] ints in [-32, 31];
+    value[i] = d * scales[i // 16] * q[i]."""
+    qv = (np.asarray(q, np.int32) + 32).astype(np.uint32).reshape(2, 4,
+                                                                  32)
+    ql = np.zeros((2, 64), np.uint8)
+    qh = np.zeros((2, 32), np.uint8)
+    for half in range(2):
+        v1, v2, v3, v4 = qv[half]
+        ql[half, :32] = (v1 & 0xF) | ((v3 & 0xF) << 4)
+        ql[half, 32:] = (v2 & 0xF) | ((v4 & 0xF) << 4)
+        qh[half] = ((v1 >> 4) | ((v2 >> 4) << 2) | ((v3 >> 4) << 4)
+                    | ((v4 >> 4) << 6))
+    out = bytearray()
+    out += ql.tobytes() + qh.tobytes()
+    out += np.asarray(scales, np.int8).tobytes()
+    out += np.float16(d).tobytes()
+    return bytes(out)
+
+
+def hf_to_gguf_permute(w: np.ndarray, n_head: int) -> np.ndarray:
+    """convert_hf_to_gguf.py's Q/K permutation (HF rotate-half order ->
+    gguf interleaved order). w [out, in]."""
+    out, in_ = w.shape
+    return (w.reshape(n_head, 2, out // n_head // 2, in_)
+            .swapaxes(1, 2)
+            .reshape(out, in_))

@@ -1,0 +1,258 @@
+"""GGUF ingestion: reader vs an independent test-side writer (bit
+layouts cross-checked, not self-checked), dequant exactness per quant
+type, and END-TO-END logits parity: a tiny HF llama checkpoint converted
+to GGUF (with convert_hf_to_gguf's Q/K permutation) must produce
+IDENTICAL logits to the same checkpoint loaded through hf_loader.
+(ref: pkg/model/initializers.go:498-559 gguf loading,
+core/config/gguf.go:36-123 introspection)"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from localai_tfp_tpu.models.gguf import (
+    GGUFFile, GGUFTokenizer, load_gguf_params, spec_from_gguf,
+)
+
+from . import gguf_fixture as fx
+
+
+def test_header_metadata_and_f32_tensor(tmp_path):
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    path = str(tmp_path / "t.gguf")
+    fx.write_gguf(path, [
+        ("general.architecture", "str", "llama"),
+        ("llama.block_count", "u32", 2),
+        ("tokenizer.ggml.tokens", "arr:str", ["a", "b"]),
+        ("llama.rope.freq_base", "f32", 500000.0),
+    ], [("x.weight", 0, (4, 3), fx.enc_f32(w))])  # ne innermost-first
+    gf = GGUFFile(path)
+    assert gf.metadata["llama.block_count"] == 2
+    assert gf.metadata["tokenizer.ggml.tokens"] == ["a", "b"]
+    assert abs(gf.metadata["llama.rope.freq_base"] - 500000.0) < 1e-3
+    np.testing.assert_array_equal(gf.tensor("x.weight"), w)
+
+
+@pytest.mark.parametrize("case", ["f16", "q8_0", "q4_0", "q4_k", "q5_k",
+                                  "q6_k"])
+def test_dequant_exact(tmp_path, case):
+    rng = np.random.default_rng(hash(case) % 2**32)
+    if case == "f16":
+        w = rng.standard_normal(64).astype(np.float16)
+        raw, gt, want = fx.enc_f16(w), 1, w.astype(np.float32)
+    elif case == "q8_0":
+        d = np.float16(rng.uniform(0.01, 0.1, 4)).astype(np.float32)
+        q = rng.integers(-127, 128, (4, 32))
+        raw, gt = fx.enc_q8_0(d, q), 8
+        want = (d[:, None] * q).astype(np.float32).ravel()
+    elif case == "q4_0":
+        d = np.float16(rng.uniform(0.01, 0.1, 2)).astype(np.float32)
+        q = rng.integers(-8, 8, (2, 32))
+        raw, gt = fx.enc_q4_0(d, q), 2
+        want = (d[:, None] * q).astype(np.float32).ravel()
+    elif case == "q4_k":
+        d, dmin = np.float16(0.03), np.float16(0.007)
+        sc = rng.integers(0, 64, 8)
+        m = rng.integers(0, 64, 8)
+        q = rng.integers(0, 16, 256)
+        raw, gt = fx.enc_q4_k(d, dmin, sc, m, q), 12
+        want = np.empty(256, np.float32)
+        for i in range(256):
+            s = 2 * (i // 64) + (i % 64) // 32
+            want[i] = (np.float32(d) * sc[s] * q[i]
+                       - np.float32(dmin) * m[s])
+    elif case == "q5_k":
+        d, dmin = np.float16(0.02), np.float16(0.005)
+        sc = rng.integers(0, 64, 8)
+        m = rng.integers(0, 64, 8)
+        q = rng.integers(0, 32, 256)
+        raw, gt = fx.enc_q5_k(d, dmin, sc, m, q), 13
+        want = np.empty(256, np.float32)
+        for i in range(256):
+            s = 2 * (i // 64) + (i % 64) // 32
+            want[i] = (np.float32(d) * sc[s] * q[i]
+                       - np.float32(dmin) * m[s])
+    else:  # q6_k
+        d = np.float16(0.04)
+        scales = rng.integers(-30, 31, 16)
+        q = rng.integers(-32, 32, 256)
+        raw, gt = fx.enc_q6_k(d, scales, q), 14
+        want = (np.float32(d) * scales[np.arange(256) // 16]
+                * q).astype(np.float32)
+    n = len(want)
+    path = str(tmp_path / "q.gguf")
+    fx.write_gguf(path, [("general.architecture", "str", "llama")],
+                  [("w", gt, (n,), raw)])
+    got = GGUFFile(path).tensor("w")
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def _hf_llama_dir(tmp_path):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    import torch
+
+    torch.manual_seed(0)
+    cfg = LlamaConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=128,
+        rope_theta=10000.0, rms_norm_eps=1e-5, tie_word_embeddings=False,
+    )
+    model = LlamaForCausalLM(cfg)
+    d = str(tmp_path / "hf")
+    model.save_pretrained(d, safe_serialization=True)
+    return d, model
+
+
+def _convert_to_gguf(hf_dir, model, path):
+    """Test-side convert_hf_to_gguf: llama.cpp names + Q/K permute."""
+    sd = {k: v.detach().float().numpy() for k, v in
+          model.state_dict().items()}
+    cfg = model.config
+    heads, kv = cfg.num_attention_heads, cfg.num_key_value_heads
+    tensors = []
+
+    def add(gname, w):
+        tensors.append((gname, 0, tuple(reversed(w.shape)),
+                        fx.enc_f32(np.ascontiguousarray(w))))
+
+    add("token_embd.weight", sd["model.embed_tokens.weight"])
+    add("output_norm.weight", sd["model.norm.weight"])
+    add("output.weight", sd["lm_head.weight"])
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}."
+        b = f"blk.{i}."
+        add(b + "attn_norm.weight", sd[p + "input_layernorm.weight"])
+        add(b + "ffn_norm.weight",
+            sd[p + "post_attention_layernorm.weight"])
+        add(b + "attn_q.weight", fx.hf_to_gguf_permute(
+            sd[p + "self_attn.q_proj.weight"], heads))
+        add(b + "attn_k.weight", fx.hf_to_gguf_permute(
+            sd[p + "self_attn.k_proj.weight"], kv))
+        add(b + "attn_v.weight", sd[p + "self_attn.v_proj.weight"])
+        add(b + "attn_output.weight", sd[p + "self_attn.o_proj.weight"])
+        add(b + "ffn_gate.weight", sd[p + "mlp.gate_proj.weight"])
+        add(b + "ffn_up.weight", sd[p + "mlp.up_proj.weight"])
+        add(b + "ffn_down.weight", sd[p + "mlp.down_proj.weight"])
+    meta = [
+        ("general.architecture", "str", "llama"),
+        ("llama.vocab_size", "u32", cfg.vocab_size),
+        ("llama.embedding_length", "u32", cfg.hidden_size),
+        ("llama.block_count", "u32", cfg.num_hidden_layers),
+        ("llama.attention.head_count", "u32", heads),
+        ("llama.attention.head_count_kv", "u32", kv),
+        ("llama.feed_forward_length", "u32", cfg.intermediate_size),
+        ("llama.context_length", "u32", cfg.max_position_embeddings),
+        ("llama.rope.freq_base", "f32", cfg.rope_theta),
+        ("llama.attention.layer_norm_rms_epsilon", "f32",
+         cfg.rms_norm_eps),
+        ("tokenizer.ggml.model", "str", "llama"),
+        ("tokenizer.ggml.tokens", "arr:str",
+         [f"<t{i}>" for i in range(cfg.vocab_size)]),
+        ("tokenizer.ggml.scores", "arr:f32",
+         [0.0] * cfg.vocab_size),
+        ("tokenizer.ggml.bos_token_id", "u32", 1),
+        ("tokenizer.ggml.eos_token_id", "u32", 2),
+    ]
+    fx.write_gguf(path, meta, tensors)
+
+
+def test_gguf_logits_match_hf_loader_exactly(tmp_path):
+    from localai_tfp_tpu.models.hf_loader import load_params
+    from localai_tfp_tpu.models.transformer import KVCache, forward
+
+    hf_dir, model = _hf_llama_dir(tmp_path)
+    gpath = str(tmp_path / "m.gguf")
+    _convert_to_gguf(hf_dir, model, gpath)
+
+    spec_hf, p_hf = load_params(hf_dir, dtype=jnp.float32)
+    spec_gg, p_gg = load_gguf_params(gpath, dtype=jnp.float32)
+    assert spec_gg.n_layers == spec_hf.n_layers
+    assert spec_gg.n_kv_heads == spec_hf.n_kv_heads
+    assert spec_gg.vocab_size == spec_hf.vocab_size
+
+    ids = jnp.asarray([[1, 5, 9, 13, 2, 7]], jnp.int32)
+    zeros = jnp.zeros((1,), jnp.int32)
+
+    def logits(spec, p):
+        cache = KVCache.create(spec, 1, 32, jnp.float32)
+        lg, _ = forward(spec, p, ids, zeros, cache, zeros)
+        return np.asarray(lg)
+
+    got = logits(spec_gg, p_gg)
+    want = logits(spec_hf, p_hf)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_spec_from_gguf_rope_scaling():
+    spec = spec_from_gguf({
+        "general.architecture": "llama",
+        "llama.embedding_length": 64,
+        "llama.block_count": 2,
+        "llama.attention.head_count": 4,
+        "llama.rope.scaling.type": "yarn",
+        "llama.rope.scaling.factor": 4.0,
+        "llama.rope.scaling.original_context_length": 2048,
+        "tokenizer.ggml.tokens": ["a"] * 10,
+    })
+    assert spec.rope_scaling["rope_type"] == "yarn"
+    assert spec.rope_scaling["factor"] == 4.0
+    assert spec.vocab_size == 10
+
+
+def test_gguf_tokenizer_gpt2_roundtrip():
+    # byte-level BPE over a tiny vocab: single bytes + one merge
+    toks = ["h", "e", "l", "o", " ", "he", "<s>", "</s>"]
+    tk = GGUFTokenizer({
+        "tokenizer.ggml.model": "gpt2",
+        "tokenizer.ggml.tokens": toks,
+        "tokenizer.ggml.merges": ["h e"],
+        "tokenizer.ggml.bos_token_id": 6,
+        "tokenizer.ggml.eos_token_id": 7,
+    })
+    ids = tk.encode("hello")
+    assert ids[0] == toks.index("he")  # the merge fired
+    assert tk.decode(ids) == "hello"
+    assert tk.eos_ids == {7}
+
+
+def test_gguf_tokenizer_sentencepiece_bytes():
+    toks = ["<unk>", "<s>", "</s>", "▁hi", "▁the", "re"]
+    tk = GGUFTokenizer({
+        "tokenizer.ggml.model": "llama",
+        "tokenizer.ggml.tokens": toks,
+        "tokenizer.ggml.scores": [0.0, 0.0, 0.0, -1.0, -1.0, -2.0],
+        "tokenizer.ggml.unknown_token_id": 0,
+        "tokenizer.ggml.bos_token_id": 1,
+    })
+    ids = tk.encode("hi there", add_bos=True)
+    assert ids[0] == 1
+    assert toks.index("▁hi") in ids
+
+
+def test_llm_worker_serves_gguf(tmp_path):
+    """A .gguf model configured like a gallery entry must load and
+    generate through the real worker + engine (VERDICT #8 done-check)."""
+    from localai_tfp_tpu.workers.base import (
+        ModelLoadOptions, PredictOptions,
+    )
+    from localai_tfp_tpu.workers.llm import JaxLLMBackend
+
+    hf_dir, model = _hf_llama_dir(tmp_path)
+    gpath = str(tmp_path / "tiny.gguf")
+    _convert_to_gguf(hf_dir, model, gpath)
+
+    b = JaxLLMBackend()
+    res = b.load_model(ModelLoadOptions(
+        model="tiny.gguf", model_path=str(tmp_path), context_size=64,
+        batch_slots=1, dtype="float32"))
+    assert res.success, res.message
+    replies = list(b.predict_stream(PredictOptions(
+        prompt="<t5><t9>", tokens=6, temperature=0.0,
+        ignore_eos=True)))
+    assert not any(r.error for r in replies), replies
+    assert sum(1 for r in replies if r.token_id is not None) >= 6
+    b.shutdown()

@@ -132,3 +132,93 @@ def test_follower_replays_prefix_reuse_and_respects_channel_guards(model):
     import os
 
     assert not os.path.exists("/tmp/should-not-be-written.npz")
+
+
+def test_follower_load_does_not_stall_other_model(model):
+    """VERDICT r1 weak #3: loading model B on the follower must NOT
+    pause model A's in-flight replay — A keeps decoding during B's load
+    and ends bitwise-identical to the leader; B serves afterwards."""
+    import time
+
+    spec, params, tk = model
+    kw = dict(n_slots=2, max_seq=128, prefill_buckets=(8, 32),
+              cache_dtype=jnp.float32, decode_steps=2)
+    channel = multihost.LocalChannel()
+    end = channel.follower_end()
+    leader_a = LLMEngine(spec, params, tk, channel=channel, tag="A", **kw)
+    follower_a = LLMEngine(spec, params, tk, follower=True, **kw)
+
+    trace: list[tuple[str, float]] = []
+
+    class _StubBackend:
+        def __init__(self, engine=None):
+            self.engine = engine
+
+        def load_model(self, rec):
+            trace.append(("load_start", time.perf_counter()))
+            time.sleep(0.6)  # a slow checkpoint load
+            self.engine = LLMEngine(spec, params, tk, follower=True, **kw)
+            trace.append(("load_end", time.perf_counter()))
+            from localai_tfp_tpu.workers.base import Result
+
+            return Result(True, "ok")
+
+        def shutdown(self):
+            self.engine = None
+
+    router = multihost.FollowerRouter(make_backend=_StubBackend)
+    router.backends["A"] = _StubBackend(follower_a)
+
+    def loop():
+        while True:
+            kind, rec = end.recv(timeout=60)
+            if kind not in ("stop",) and isinstance(rec, dict) \
+                    and rec.get("model") == "A":
+                trace.append(("a_record", time.perf_counter()))
+            if not router.handle(kind, rec):
+                return
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+
+    # A decodes a long generation; mid-flight, the leader loads B
+    q = leader_a.submit(GenRequest(
+        prompt_ids=tk.encode("hello"), max_tokens=48, ignore_eos=True))
+
+    from localai_tfp_tpu.workers.base import ModelLoadOptions
+
+    time.sleep(0.05)
+    channel.publish("load", ModelLoadOptions(model="B"))
+    toks, final = _collect(q)
+    assert final.finish_reason == "length" and len(toks) == 48
+
+    # B's engine records replay after the async load completes
+    leader_b = LLMEngine(spec, params, tk, channel=channel, tag="B", **kw)
+    qb = leader_b.submit(GenRequest(prompt_ids=tk.encode("abc"),
+                                    max_tokens=4, ignore_eos=True))
+    toks_b, final_b = _collect(qb)
+    assert final_b.finish_reason == "length"
+
+    leader_a.close()
+    leader_b.close()
+    channel.publish("stop", None)
+    t.join(timeout=60)
+    assert not t.is_alive()
+
+    # B's follower engine loaded, replayed records, and matches bitwise
+    bk = router.backends.get("B")
+    assert bk is not None and bk.engine is not None
+    np.testing.assert_array_equal(
+        np.asarray(leader_b.cache.k), np.asarray(bk.engine.cache.k))
+    router.shutdown()
+
+    # bitwise equality on A (replay never diverged)
+    np.testing.assert_array_equal(
+        np.asarray(leader_a.cache.k), np.asarray(follower_a.cache.k))
+    np.testing.assert_array_equal(
+        np.asarray(leader_a.cache.v), np.asarray(follower_a.cache.v))
+    # the stall property: A records executed BETWEEN load_start/load_end
+    ls = next(ts for k, ts in trace if k == "load_start")
+    le = next(ts for k, ts in trace if k == "load_end")
+    during = [ts for k, ts in trace if k == "a_record" and ls < ts < le]
+    assert during, "no A records replayed while B was loading (stalled)"
