@@ -145,6 +145,13 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    # persistent compile cache: the 8B-class prefill graph takes ~25 min
+    # to compile through the remote AOT helper; cached it loads in
+    # seconds, so repeat bench runs measure serving, not the compiler
+    jax.config.update("jax_compilation_cache_dir",
+                      "/root/.cache/localai_xla")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
     from localai_tfp_tpu.engine.engine import LLMEngine
     from localai_tfp_tpu.engine.tokenizer import ByteTokenizer
     from localai_tfp_tpu.models.llm_spec import LLMSpec, tiny_spec
@@ -185,9 +192,12 @@ def main() -> None:
                 rope_theta=500000.0,
             )
             params8 = _fast_int8_params(spec8)
+            # decode_steps=8: the 8B scan's compile cost scales hard with
+            # length through the remote compile helper; 8 steps amortize
+            # the dispatch RTT acceptably at 8B step times
             eng8 = LLMEngine(
                 spec8, params8, tok, n_slots=16, max_seq=1024,
-                decode_steps=64, cache_dtype=jnp.bfloat16, autostart=False,
+                decode_steps=8, cache_dtype=jnp.bfloat16, autostart=False,
             )
             eng8.start()
             tok_s8, p50_8, p95_8 = _bench_config(eng8, tok, 16, 256,

@@ -1,0 +1,585 @@
+"""Bark-class text-to-audio in JAX (suno/bark architecture, HF layout).
+
+The reference serves bark through backend/python/bark/backend.py (and
+kokoro/coqui through sibling workers); round 1 aliased those gallery
+entries to the VITS worker. This module implements the bark family
+natively: three GPT stages — semantic (text tokens -> semantic tokens),
+coarse (semantic -> first two EnCodec codebooks, interleaved), fine
+(non-causal infilling of the remaining codebooks) — and an EnCodec
+SEANet decoder (weight-normalized causal convs, residual blocks, 2-layer
+LSTM, transposed-conv upsampling) turning codes into waveform.
+
+Weights import from an HF BarkModel checkpoint directory (state-dict
+prefixes ``semantic.``/``coarse_acoustics.``/``fine_acoustics.``/
+``codec_model.``); every forward is verified against the transformers
+modules with shared weights in tests/test_bark.py. Generation follows
+the bark convention (text-offset + pad + infer token for the semantic
+stage, codebook offsets and 2-codebook interleave for coarse, windowed
+infill for fine); voice-preset history prompts are accepted as optional
+arrays.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# bark generation constants (suno convention, also the HF generation
+# config defaults)
+TEXT_ENCODING_OFFSET = 10_048
+TEXT_PAD_TOKEN = 129_595
+SEMANTIC_PAD_TOKEN = 10_000
+SEMANTIC_INFER_TOKEN = 129_599
+SEMANTIC_VOCAB_SIZE = 10_000
+SEMANTIC_RATE_HZ = 49.9
+COARSE_RATE_HZ = 75.0
+CODEBOOK_SIZE = 1024
+N_COARSE_CODEBOOKS = 2
+COARSE_SEMANTIC_PAD_TOKEN = 12_048
+COARSE_INFER_TOKEN = 12_050
+
+
+# ---------------------------------------------------------------------------
+# GPT stages (BarkCausalModel / BarkFineModel layout)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class BarkGPTSpec:
+    hidden_size: int
+    n_layers: int
+    n_heads: int
+    block_size: int
+    bias: bool = False
+    n_codes_total: int = 0  # >0 => fine model (multi-embed, non-causal)
+    n_codes_given: int = 1  # fine: lm_heads[i] predicts codebook
+    # i + n_codes_given (HF tying: lm_heads[i] == input_embeds[i+1])
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, np.float32)
+
+
+def load_bark_gpt(sd: dict, prefix: str, spec: BarkGPTSpec,
+                  dtype: Any = jnp.float32) -> dict:
+    """Stacked param tree from an HF Bark state dict."""
+
+    def get(name):
+        return _np(sd[prefix + name])
+
+    def stack(fmt, transpose=False):
+        rows = [get(fmt.format(i=i)) for i in range(spec.n_layers)]
+        rows = [r.T if transpose else r for r in rows]
+        return jnp.asarray(np.stack(rows), dtype)
+
+    p: dict = {
+        "pos": jnp.asarray(get("position_embeds_layer.weight"), dtype),
+        "ln1_w": stack("layers.{i}.layernorm_1.weight"),
+        "ln2_w": stack("layers.{i}.layernorm_2.weight"),
+        "att_proj": stack("layers.{i}.attn.att_proj.weight", True),
+        "att_out": stack("layers.{i}.attn.out_proj.weight", True),
+        "mlp_in": stack("layers.{i}.mlp.in_proj.weight", True),
+        "mlp_out": stack("layers.{i}.mlp.out_proj.weight", True),
+        "lnf_w": jnp.asarray(get("layernorm_final.weight"), dtype),
+    }
+    if spec.bias:
+        for name, key in (("ln1_b", "layers.{i}.layernorm_1.bias"),
+                          ("ln2_b", "layers.{i}.layernorm_2.bias"),
+                          ("att_proj_b", "layers.{i}.attn.att_proj.bias"),
+                          ("att_out_b", "layers.{i}.attn.out_proj.bias"),
+                          ("mlp_in_b", "layers.{i}.mlp.in_proj.bias"),
+                          ("mlp_out_b", "layers.{i}.mlp.out_proj.bias")):
+            p[name] = stack(key)
+        p["lnf_b"] = jnp.asarray(get("layernorm_final.bias"), dtype)
+    if spec.n_codes_total:
+        p["embeds"] = jnp.asarray(np.stack([
+            get(f"input_embeds_layers.{i}.weight")
+            for i in range(spec.n_codes_total)]), dtype)
+        n_heads = spec.n_codes_total - spec.n_codes_given
+        if prefix + "lm_heads.0.weight" in sd:
+            heads = [get(f"lm_heads.{i}.weight").T
+                     for i in range(n_heads)]
+        else:
+            # checkpoints drop the tied heads: lm_heads[i].weight ==
+            # input_embeds_layers[i + n_codes_given].weight (HF
+            # BarkFineModel._tie_weights)
+            heads = [
+                get(f"input_embeds_layers.{i + spec.n_codes_given}"
+                    ".weight").T
+                for i in range(n_heads)]
+        p["heads"] = jnp.asarray(np.stack(heads), dtype)
+    else:
+        p["embed"] = jnp.asarray(get("input_embeds_layer.weight"), dtype)
+        p["head"] = jnp.asarray(get("lm_head.weight").T, dtype)
+    return p
+
+
+def _ln(x, w, b, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    out = (x - mu) * lax.rsqrt(var + eps) * w
+    return out + b if b is not None else out
+
+
+def bark_gpt_hidden(spec: BarkGPTSpec, p: dict,
+                    x: jax.Array) -> jax.Array:
+    """Embedded input [B, T, H] -> final hidden [B, T, H] (pre-head)."""
+    B, T, H = x.shape
+    nh = spec.n_heads
+    dh = H // nh
+    x = x + p["pos"][:T]
+    if spec.n_codes_total == 0:  # causal
+        mask = jnp.where(
+            jnp.arange(T)[None, :] <= jnp.arange(T)[:, None], 0.0, -1e9
+        )[None, None]
+    else:
+        mask = None
+    for i in range(spec.n_layers):
+        h = _ln(x, p["ln1_w"][i], p.get("ln1_b", [None] * spec.n_layers)[i]
+                if spec.bias else None)
+        qkv = h @ p["att_proj"][i]
+        if spec.bias:
+            qkv = qkv + p["att_proj_b"][i]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, nh, dh).transpose(0, 2, 1, 3)
+        k = k.reshape(B, T, nh, dh).transpose(0, 2, 1, 3)
+        v = v.reshape(B, T, nh, dh).transpose(0, 2, 1, 3)
+        logits = jnp.einsum("bhtd,bhsd->bhts", q, k) / math.sqrt(dh)
+        if mask is not None:
+            logits = logits + mask
+        attn = jnp.einsum("bhts,bhsd->bhtd",
+                          jax.nn.softmax(logits, -1), v)
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, T, H)
+        attn = attn @ p["att_out"][i]
+        if spec.bias:
+            attn = attn + p["att_out_b"][i]
+        x = x + attn
+        h = _ln(x, p["ln2_w"][i], p["ln2_b"][i] if spec.bias else None)
+        h = h @ p["mlp_in"][i]
+        if spec.bias:
+            h = h + p["mlp_in_b"][i]
+        h = jax.nn.gelu(h, approximate=False)
+        h = h @ p["mlp_out"][i]
+        if spec.bias:
+            h = h + p["mlp_out_b"][i]
+        x = x + h
+    return _ln(x, p["lnf_w"], p.get("lnf_b") if spec.bias else None)
+
+
+def bark_causal_logits(spec: BarkGPTSpec, p: dict,
+                       ids: jax.Array) -> jax.Array:
+    """ids [B, T] -> logits [B, T, out_vocab] (semantic/coarse stages)."""
+    x = p["embed"][ids]
+    return bark_gpt_hidden(spec, p, x) @ p["head"]
+
+
+def _bucketed_last_logits(spec: BarkGPTSpec, p: dict,
+                          window: list[int]) -> jax.Array:
+    """Last-position logits with the window RIGHT-padded to a power-of-
+    two bucket: the causal mask makes right padding invisible to earlier
+    positions, so the autoregressive host loop compiles once per bucket
+    instead of once per length."""
+    n = len(window)
+    bucket = min(max(1 << (n - 1).bit_length(), 64), spec.block_size)
+    padded = window + [0] * (bucket - n)
+    logits = bark_causal_logits(
+        spec, p, jnp.asarray([padded], jnp.int32))
+    return logits[0, n - 1]
+
+
+def bark_fine_logits(spec: BarkGPTSpec, p: dict, codes: jax.Array,
+                     pred_idx: int) -> jax.Array:
+    """codes [B, T, n_codes_total] -> logits [B, T, vocab] for codebook
+    ``pred_idx`` (HF convention: sum input embeds of codebooks
+    [0, pred_idx], non-causal attention)."""
+    B, T, _ = codes.shape
+    x = jnp.zeros((B, T, spec.hidden_size), p["embeds"].dtype)
+    for c in range(pred_idx + 1):
+        x = x + p["embeds"][c][codes[:, :, c]]
+    h = bark_gpt_hidden(spec, p, x)
+    # HF convention: lm_heads[codebook_idx - n_codes_given]
+    return h @ p["heads"][pred_idx - spec.n_codes_given]
+
+
+# ---------------------------------------------------------------------------
+# EnCodec decoder (SEANet, HF modeling_encodec layout)
+# ---------------------------------------------------------------------------
+
+
+def _wn_weight(g: np.ndarray, v: np.ndarray) -> np.ndarray:
+    """weight-norm reconstruction: w = g * v / ||v|| (norm over all dims
+    but the first — torch parametrizations.weight original0/original1)."""
+    norm = np.sqrt((v ** 2).sum(axis=(1, 2), keepdims=True))
+    return g * v / np.maximum(norm, 1e-12)
+
+
+def load_encodec_decoder(sd: dict, prefix: str = "codec_model.",
+                         dtype: Any = jnp.float32) -> dict:
+    """{quantizer codebooks, ordered decoder layer list} from an HF
+    EncodecModel state dict (weight-normalized convs reconstructed)."""
+    books = []
+    i = 0
+    while f"{prefix}quantizer.layers.{i}.codebook.embed" in sd:
+        books.append(_np(sd[f"{prefix}quantizer.layers.{i}.codebook.embed"]))
+        i += 1
+    layers: dict[int, dict] = {}
+    for key in sd:
+        if not key.startswith(f"{prefix}decoder.layers."):
+            continue
+        rest = key[len(f"{prefix}decoder.layers."):]
+        idx = int(rest.split(".")[0])
+        layers.setdefault(idx, {})[rest.split(".", 1)[1]] = _np(sd[key])
+
+    def conv_params(d: dict, sub: str = "conv") -> dict:
+        g = d[f"{sub}.parametrizations.weight.original0"]
+        v = d[f"{sub}.parametrizations.weight.original1"]
+        w = _wn_weight(g, v)
+        out = {"w": jnp.asarray(w, dtype)}
+        if f"{sub}.bias" in d:
+            out["b"] = jnp.asarray(d[f"{sub}.bias"], dtype)
+        return out
+
+    ordered = []
+    prev_idx = -1
+    for idx in sorted(layers):
+        d = layers[idx]
+        # gaps in the module list are nn.ELU() activations: record them
+        # as a pre-activation on the following layer (the final conv has
+        # one too — index 8 in the standard decoder)
+        pre_elu = idx - prev_idx > 1
+        prev_idx = idx
+        if any(k.startswith("lstm.") for k in d):
+            n_l = len([k for k in d if k.startswith("lstm.weight_ih_l")])
+            lstm = []
+            for li in range(n_l):
+                lstm.append({
+                    "w_ih": jnp.asarray(d[f"lstm.weight_ih_l{li}"].T, dtype),
+                    "w_hh": jnp.asarray(d[f"lstm.weight_hh_l{li}"].T, dtype),
+                    "b": jnp.asarray(d[f"lstm.bias_ih_l{li}"]
+                                     + d[f"lstm.bias_hh_l{li}"], dtype),
+                })
+            ordered.append(("lstm", lstm, pre_elu))
+        elif any(k.startswith("block.") for k in d):
+            blk = {k: v for k, v in d.items() if k.startswith("block.")}
+            subs = sorted({int(k.split(".")[1]) for k in blk})
+            convs = [conv_params(
+                {kk.split(".", 2)[2]: vv for kk, vv in blk.items()
+                 if int(kk.split(".")[1]) == s}, "conv") for s in subs]
+            short = (conv_params(
+                {kk.split(".", 1)[1]: vv for kk, vv in d.items()
+                 if kk.startswith("shortcut.")}, "conv")
+                if any(k.startswith("shortcut.") for k in d) else None)
+            ordered.append(("resnet", {"convs": convs, "short": short},
+                            pre_elu))
+        else:
+            kind = ("convtr" if idx in _convtr_indices(layers) else "conv")
+            ordered.append((kind, conv_params(d), pre_elu))
+    return {"codebooks": jnp.asarray(np.stack(books), dtype),
+            "layers": ordered}
+
+
+def _convtr_indices(layers: dict) -> set:
+    """Transposed convs are the in-between upsampling layers: everything
+    that is a bare conv except the first (stem) and last (head)."""
+    bare = [i for i, d in layers.items()
+            if not any(k.startswith(("lstm.", "block.")) for k in d)]
+    bare = sorted(bare)
+    return set(bare[1:-1])
+
+
+def _causal_conv1d(p: dict, x: jax.Array, stride: int = 1,
+                   dilation: int = 1) -> jax.Array:
+    """x [B, T, C]; torch conv weight [out, in, k]; causal left pad in
+    EnCodec's REFLECT mode (with HF's zero-extension quirk for inputs
+    shorter than the pad)."""
+    w = p["w"]
+    k = w.shape[-1]
+    pad = (k - 1) * dilation + 1 - stride
+    # extra right padding so every input frame is covered (HF
+    # _get_extra_padding_for_conv1d)
+    T = x.shape[1]
+    n_frames = (T - k * dilation + dilation - 1 + pad) / stride + 1
+    ideal = (math.ceil(n_frames) - 1) * stride + k * dilation - \
+        (dilation - 1) - pad
+    extra = max(int(ideal) - T, 0)
+    if pad or extra:
+        ext = 0
+        if T <= max(pad, extra):  # reflect needs length > pad
+            ext = max(pad, extra) - T + 1
+            x = jnp.pad(x, ((0, 0), (0, ext), (0, 0)))
+        x = jnp.pad(x, ((0, 0), (pad, extra), (0, 0)), mode="reflect")
+        if ext:
+            x = x[:, : x.shape[1] - ext]
+    out = lax.conv_general_dilated(
+        x, w.transpose(2, 1, 0), (stride,), "VALID",
+        rhs_dilation=(dilation,),
+        dimension_numbers=("NWC", "WIO", "NWC"),
+    )
+    if "b" in p:
+        out = out + p["b"]
+    return out
+
+
+def _causal_convtr1d(p: dict, x: jax.Array, stride: int) -> jax.Array:
+    """torch ConvTranspose1d weight [in, out, k]; causal: trim the whole
+    (k - stride) padding from the right (trim_right_ratio=1)."""
+    w = p["w"]
+    k = w.shape[-1]
+    # torch ConvTranspose1d is the conv GRADIENT (flipped kernel, in/out
+    # swapped): transpose_kernel=True with the forward-conv orientation
+    out = lax.conv_transpose(
+        x, w.transpose(2, 1, 0), (stride,), "VALID",
+        dimension_numbers=("NWC", "WIO", "NWC"), transpose_kernel=True,
+    )
+    if "b" in p:
+        out = out + p["b"]
+    trim = k - stride
+    return out[:, : out.shape[1] - trim] if trim else out
+
+
+def _lstm_stack(layers: list, x: jax.Array) -> jax.Array:
+    """torch 2-layer LSTM over time + residual (EncodecLSTM)."""
+    B, T, C = x.shape
+    h_in = x
+    for lp in layers:
+        def cell(carry, xt):
+            h, c = carry
+            gates = xt @ lp["w_ih"] + h @ lp["w_hh"] + lp["b"]
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (h, c), h
+
+        z = jnp.zeros((B, lp["w_hh"].shape[0]), x.dtype)
+        (_, _), hs = lax.scan(cell, (z, z), x.transpose(1, 0, 2))
+        x = hs.transpose(1, 0, 2)
+    return x + h_in
+
+
+def encodec_decode(dec: dict, codes: jax.Array,
+                   ratios: list[int]) -> jax.Array:
+    """codes [nq, T] int32 -> waveform [samples] f32 in [-1, 1]."""
+    books = dec["codebooks"]  # [nq, K, dim]
+    nq = codes.shape[0]
+    x = jnp.zeros((1, codes.shape[1], books.shape[-1]), books.dtype)
+    for q in range(nq):
+        x = x + books[q][codes[q]][None]
+    ri = iter(ratios)
+    for kind, lp, pre_elu in dec["layers"]:
+        if pre_elu:
+            x = jax.nn.elu(x)
+        if kind == "conv":
+            x = _causal_conv1d(lp, x)
+        elif kind == "convtr":
+            x = _causal_convtr1d(lp, x, next(ri))
+        elif kind == "resnet":
+            res = x
+            h = x
+            for cp in lp["convs"]:
+                h = _causal_conv1d(cp, jax.nn.elu(h))
+            x = h + (_causal_conv1d(lp["short"], res)
+                     if lp["short"] is not None else res)
+        else:  # lstm
+            x = _lstm_stack(lp, x)
+    return jnp.clip(x[0, :, 0], -1.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# full pipeline
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BarkTTS:
+    """Loaded bark pipeline. ``load`` expects an HF BarkModel checkpoint
+    directory (config.json + safetensors/bin; tokenizer files optional —
+    BertTokenizer(vocab.txt) when present)."""
+
+    semantic_spec: BarkGPTSpec
+    semantic: dict
+    coarse_spec: BarkGPTSpec
+    coarse: dict
+    fine_spec: BarkGPTSpec
+    fine: dict
+    codec: dict
+    ratios: list[int]
+    sample_rate: int
+    tokenizer: Any = None
+
+    @classmethod
+    def load(cls, model_dir: str, dtype: Any = jnp.float32) -> "BarkTTS":
+        with open(os.path.join(model_dir, "config.json")) as f:
+            cfg = json.load(f)
+        sd: dict = {}
+        for fname in sorted(os.listdir(model_dir)):
+            path = os.path.join(model_dir, fname)
+            if fname.endswith(".safetensors"):
+                from safetensors import safe_open
+
+                with safe_open(path, framework="np") as f:
+                    for key in f.keys():
+                        sd[key] = f.get_tensor(key)
+            elif fname.endswith(".bin") and "training" not in fname:
+                import torch
+
+                sd.update(torch.load(path, map_location="cpu",
+                                     weights_only=True))
+
+        def gpt_spec(sub: str, fine: bool = False) -> BarkGPTSpec:
+            c = cfg[sub]
+            return BarkGPTSpec(
+                hidden_size=int(c["hidden_size"]),
+                n_layers=int(c.get("num_layers", 2)),
+                n_heads=int(c.get("num_heads", 2)),
+                block_size=int(c.get("block_size", 1024)),
+                bias=bool(c.get("bias", False)),
+                n_codes_total=int(c.get("n_codes_total", 8)) if fine
+                else 0,
+                n_codes_given=int(c.get("n_codes_given", 1)),
+            )
+
+        sem_spec = gpt_spec("semantic_config")
+        coarse_spec = gpt_spec("coarse_acoustics_config")
+        fine_spec = gpt_spec("fine_acoustics_config", fine=True)
+        codec_cfg = cfg.get("codec_config", {})
+        tok = None
+        if os.path.exists(os.path.join(model_dir, "vocab.txt")):
+            from transformers import BertTokenizer
+
+            tok = BertTokenizer(os.path.join(model_dir, "vocab.txt"))
+        return cls(
+            semantic_spec=sem_spec,
+            semantic=load_bark_gpt(sd, "semantic.", sem_spec, dtype),
+            coarse_spec=coarse_spec,
+            coarse=load_bark_gpt(sd, "coarse_acoustics.", coarse_spec,
+                                 dtype),
+            fine_spec=fine_spec,
+            fine=load_bark_gpt(sd, "fine_acoustics.", fine_spec, dtype),
+            codec=load_encodec_decoder(sd, "codec_model.", dtype),
+            ratios=list(codec_cfg.get("upsampling_ratios",
+                                      [8, 5, 4, 2])),
+            sample_rate=int(codec_cfg.get("sampling_rate", 24_000)),
+        )
+
+    # ------------------------------------------------------------ stages
+
+    def _sample_loop(self, spec: BarkGPTSpec, p: dict, prompt: np.ndarray,
+                     *, max_new: int, temperature: float,
+                     stop_token: Optional[int], vocab_limit: int,
+                     offset_out: int, rng: jax.Array) -> list[int]:
+        """Greedy/temperature autoregressive loop over a causal stage
+        (host loop; these stages are short clips, not the LLM hot path)."""
+        ids = list(int(t) for t in prompt)
+        out: list[int] = []
+        for step in range(max_new):
+            window = ids[-spec.block_size:]
+            logits = _bucketed_last_logits(spec, p, window)
+            logits = logits[:vocab_limit]
+            if temperature <= 0:
+                tok = int(jnp.argmax(logits))
+            else:
+                rng, key = jax.random.split(rng)
+                tok = int(jax.random.categorical(key, logits / temperature))
+            if stop_token is not None and tok == stop_token:
+                break
+            out.append(tok + offset_out)
+            ids.append(tok + offset_out)
+        return out
+
+    def generate(self, text: str = "", input_ids: Optional[list] = None,
+                 *, temperature: float = 0.7, max_semantic: int = 256,
+                 seed: int = 0,
+                 history: Optional[dict] = None) -> np.ndarray:
+        """text -> waveform [n] f32. ``history`` optionally carries a
+        voice preset {semantic_prompt, coarse_prompt [2, T]}."""
+        if input_ids is None:
+            if self.tokenizer is not None:
+                input_ids = self.tokenizer.encode(
+                    text, add_special_tokens=False)
+            else:
+                input_ids = [b % 1000 for b in text.encode()]
+        rng = jax.random.PRNGKey(seed)
+
+        # --- semantic stage (suno prompt layout) ---
+        text_arr = np.asarray(
+            [t + TEXT_ENCODING_OFFSET for t in input_ids[:256]], np.int64)
+        text_arr = np.pad(text_arr, (0, 256 - len(text_arr)),
+                          constant_values=TEXT_PAD_TOKEN)
+        hist = (np.asarray(history["semantic_prompt"], np.int64)[-256:]
+                if history else np.array([], np.int64))
+        hist = np.pad(hist, (0, 256 - len(hist)),
+                      constant_values=SEMANTIC_PAD_TOKEN)
+        prompt = np.concatenate(
+            [text_arr, hist, [SEMANTIC_INFER_TOKEN]])
+        rng, k1 = jax.random.split(rng)
+        semantic = self._sample_loop(
+            self.semantic_spec, self.semantic, prompt,
+            max_new=max_semantic, temperature=temperature,
+            stop_token=None, vocab_limit=SEMANTIC_VOCAB_SIZE,
+            offset_out=0, rng=k1)
+
+        # --- coarse stage: 2 codebooks interleaved at 75/49.9 ratio ---
+        ratio = COARSE_RATE_HZ / SEMANTIC_RATE_HZ * N_COARSE_CODEBOOKS
+        n_coarse = int(round(len(semantic) * ratio / N_COARSE_CODEBOOKS)
+                       ) * N_COARSE_CODEBOOKS
+        prompt = np.concatenate([
+            np.asarray(semantic, np.int64),
+            [COARSE_SEMANTIC_PAD_TOKEN, COARSE_INFER_TOKEN]])
+        rng, k2 = jax.random.split(rng)
+        flat = self._coarse_loop(prompt, n_coarse, temperature, k2)
+        coarse = np.full((N_COARSE_CODEBOOKS,
+                          max(len(flat) // N_COARSE_CODEBOOKS, 1)), 0,
+                         np.int64)
+        for j, tok in enumerate(flat):
+            cb = j % N_COARSE_CODEBOOKS
+            coarse[cb, j // N_COARSE_CODEBOOKS] = \
+                tok - SEMANTIC_VOCAB_SIZE - cb * CODEBOOK_SIZE
+
+        # --- fine stage: infill remaining codebooks in one window ---
+        n_total = self.fine_spec.n_codes_total
+        T = coarse.shape[1]
+        codes = np.zeros((T, n_total), np.int64)
+        codes[:, :N_COARSE_CODEBOOKS] = coarse.T
+        cj = jnp.asarray(codes[None], jnp.int32)
+        for cb in range(N_COARSE_CODEBOOKS, n_total):
+            logits = bark_fine_logits(self.fine_spec, self.fine, cj, cb)
+            pred = jnp.argmax(logits[0, :, :CODEBOOK_SIZE], -1)
+            cj = cj.at[0, :, cb].set(pred.astype(jnp.int32))
+
+        # --- EnCodec decode ---
+        wave = encodec_decode(self.codec, jnp.asarray(cj[0].T), self.ratios)
+        return np.asarray(wave, np.float32)
+
+    def _coarse_loop(self, prompt: np.ndarray, n_tokens: int,
+                     temperature: float, rng: jax.Array) -> list[int]:
+        """Coarse sampling with per-position codebook masking: even
+        steps draw from codebook 0's band, odd from codebook 1's."""
+        spec, p = self.coarse_spec, self.coarse
+        ids = list(int(t) for t in prompt)
+        out: list[int] = []
+        for step in range(n_tokens):
+            cb = step % N_COARSE_CODEBOOKS
+            lo = SEMANTIC_VOCAB_SIZE + cb * CODEBOOK_SIZE
+            window = ids[-spec.block_size:]
+            logits = _bucketed_last_logits(spec, p, window)
+            band = logits[lo:lo + CODEBOOK_SIZE]
+            if temperature <= 0:
+                tok = int(jnp.argmax(band)) + lo
+            else:
+                rng, key = jax.random.split(rng)
+                tok = int(jax.random.categorical(
+                    key, band / temperature)) + lo
+            out.append(tok)
+            ids.append(tok)
+        return out

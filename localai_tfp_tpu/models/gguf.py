@@ -325,10 +325,8 @@ def load_gguf_params(path: str, dtype: Any = None,
     gf = gf or GGUFFile(path)
     spec = spec_from_gguf(gf.metadata)
     L = spec.n_layers
-    used: set[str] = set()
 
     def get(name: str) -> np.ndarray:
-        used.add(name)
         return gf.tensor(name)
 
     def stack(fmt: str, fn=None) -> Any:
@@ -416,6 +414,19 @@ class GGUFTokenizer:
             tk.pre_tokenizer = pre_tokenizers.Metaspace()
             tk.decoder = decoders.Sequence([
                 decoders.ByteFallback(), decoders.Metaspace()])
+        # control/user-defined tokens (token_type 3/4) must tokenize as
+        # single ids, or chat-template markers like <|im_start|> shred
+        # into byte pieces the model was never trained on
+        types = meta.get("tokenizer.ggml.token_type") or []
+        from tokenizers import AddedToken
+
+        specials = [
+            AddedToken(tok, special=(int(t) == 3))
+            for tok, t in zip(tokens, types) if int(t) in (3, 4)
+        ]
+        if specials:
+            tk.add_tokens([a for a in specials if not a.special])
+            tk.add_special_tokens([a for a in specials if a.special])
         self._tk = tk
 
     @property

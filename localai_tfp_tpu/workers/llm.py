@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from ..engine.engine import GenRequest, LLMEngine, StreamEvent
-from ..engine.tokenizer import ByteTokenizer, Tokenizer, load_tokenizer
+from ..engine.tokenizer import Tokenizer, load_tokenizer
 from ..grammars.native import make_constraint
 from ..models.hf_loader import load_params
 from ..models.lora import merge_lora
@@ -147,26 +147,25 @@ class JaxLLMBackend(Backend):
                     self.tokenizer = tokenizer_from_gguf(gf)
                 else:
                     self.tokenizer = load_tokenizer(model_dir)
-                try:
-                    if is_gguf:
-                        raise LookupError("gguf: no mmproj tower")
-                    from ..models.hf_loader import load_multimodal
+                if is_gguf:
+                    self.vision = None  # gguf carries no mmproj tower
+                else:
+                    try:
+                        from ..models.hf_loader import load_multimodal
 
-                    self.vision = load_multimodal(model_dir, dtype=dtype,
-                                                  state=hf_state)
-                except LookupError:
-                    self.vision = None
-                except Exception as ve:
-                    # text-only serving still works, but a genuinely
-                    # multimodal checkpoint losing its tower must be
-                    # operator-visible, not silent
-                    import logging
+                        self.vision = load_multimodal(
+                            model_dir, dtype=dtype, state=hf_state)
+                    except Exception as ve:
+                        # text-only serving still works, but a genuinely
+                        # multimodal checkpoint losing its tower must be
+                        # operator-visible, not silent
+                        import logging
 
-                    logging.getLogger(__name__).warning(
-                        "vision tower load failed for %s: %r — serving "
-                        "text-only, image parts will be ignored",
-                        model_dir, ve)
-                    self.vision = None
+                        logging.getLogger(__name__).warning(
+                            "vision tower load failed for %s: %r — "
+                            "serving text-only, image parts will be "
+                            "ignored", model_dir, ve)
+                        self.vision = None
                 kv_dtype = _KV_DTYPES.get(
                     (opts.kv_cache_dtype or opts.dtype or "bfloat16").lower(),
                     dtype,

@@ -145,8 +145,13 @@ class JaxTTSBackend(Backend):
         self._state = "UNINITIALIZED"
         self._vits = None  # (spec, params, tokenizer-or-None)
         self._musicgen = None  # (bundle, tokenizer-or-None)
+        self._bark = None  # models/bark.py BarkTTS
 
     def load_model(self, opts: ModelLoadOptions) -> Result:
+        # a reload must not leave a previous family reachable (tts()
+        # dispatches on whichever slot is non-None)
+        self._vits = self._musicgen = self._bark = None
+        self._bark_opts = {}
         model_dir = opts.model
         if model_dir and not os.path.isabs(model_dir):
             model_dir = os.path.join(opts.model_path or "", model_dir)
@@ -170,6 +175,19 @@ class JaxTTSBackend(Backend):
 
                     self._musicgen = (load_musicgen(model_dir),
                                       _try_tokenizer(model_dir))
+                elif mtype == "bark":
+                    # ref: backend/python/bark/backend.py — the bark
+                    # semantic/coarse/fine + EnCodec family
+                    from ..models.bark import BarkTTS
+
+                    self._bark = BarkTTS.load(model_dir)
+                    self._bark_opts = {}
+                    for kv in opts.options:
+                        k, _, v = kv.partition("=")
+                        if k == "max_semantic":
+                            self._bark_opts["max_semantic"] = int(v)
+                        elif k == "temperature":
+                            self._bark_opts["temperature"] = float(v)
             except Exception as e:
                 self._state = "ERROR"
                 return Result(False, f"{mtype or 'tts'} load failed: {e}")
@@ -193,6 +211,11 @@ class JaxTTSBackend(Backend):
 
     def tts(self, text: str, voice: str = "", dst: str = "",
             language: str = "") -> Result:
+        if self._bark is not None:
+            audio = self._bark.generate(
+                text, **getattr(self, "_bark_opts", {}))
+            write_wav(dst, audio, sr=self._bark.sample_rate)
+            return Result(True, dst)
         if self._vits is not None:
             from ..models.vits import synthesize
 

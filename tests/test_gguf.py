@@ -256,3 +256,18 @@ def test_llm_worker_serves_gguf(tmp_path):
     assert not any(r.error for r in replies), replies
     assert sum(1 for r in replies if r.token_id is not None) >= 6
     b.shutdown()
+
+
+def test_gguf_tokenizer_control_tokens_single_ids():
+    """Chat-template markers (token_type 3 = CONTROL) must encode as
+    single ids, not shredded byte pieces."""
+    toks = ["h", "i", "<|im_start|>", "<|im_end|>"]
+    tk = GGUFTokenizer({
+        "tokenizer.ggml.model": "gpt2",
+        "tokenizer.ggml.tokens": toks,
+        "tokenizer.ggml.merges": [],
+        "tokenizer.ggml.token_type": [1, 1, 3, 3],
+    })
+    ids = tk.encode_special("<|im_start|>hi<|im_end|>")
+    assert ids[0] == 2 and ids[-1] == 3
+    assert ids[1:-1] == [0, 1]
