@@ -51,6 +51,7 @@ def _run_wave(eng, tok, n_req, n_tok, prompt_text):
     t0 = time.perf_counter()
     ttft = [None] * n_req
     total = 0
+    errors: list[str] = []
     # drain all queues round-robin so TTFT is measured per request
     pending = list(enumerate(qs))
     while pending:
@@ -66,6 +67,8 @@ def _run_wave(eng, tok, n_req, n_tok, prompt_text):
                     ttft[i] = (time.perf_counter() - t0) * 1e3
                 if ev.done:
                     total += ev.completion_tokens
+                    if ev.error:
+                        errors.append(ev.error)
                     finished = True
                     break
             if not finished:
@@ -74,21 +77,28 @@ def _run_wave(eng, tok, n_req, n_tok, prompt_text):
         if pending:
             time.sleep(0.001)
     wall = time.perf_counter() - t0
-    return total, wall, sorted(t for t in ttft if t is not None)
+    return total, wall, sorted(t for t in ttft if t is not None), errors
 
 
 def _bench_config(eng, tok, n_req, n_tok, runs=3):
-    """Best-of-N decode throughput + p50/p95 TTFT for one engine."""
+    """Best-of-N decode throughput + p50/p95 TTFT for one engine.
+    Raises if the wave errored (a zeroed number must not pass silently).
+    """
     prompt_text = "benchmark " * 12
     # two warmup waves: the first compiles the cold-prompt prefill path,
     # the second compiles the prefix-reuse path (rem=1 bucket) that every
     # measured wave actually takes — so measured TTFT has no compiles
-    _run_wave(eng, tok, n_req, n_tok, prompt_text)
-    _run_wave(eng, tok, n_req, n_tok, prompt_text)
+    for _ in range(2):
+        _, _, _, errs = _run_wave(eng, tok, n_req, n_tok, prompt_text)
+        if errs:
+            raise RuntimeError(f"warmup wave errored: {errs[0][:200]}")
     best = 0.0
     ttfts = []
     for _ in range(runs):
-        total, wall, tt = _run_wave(eng, tok, n_req, n_tok, prompt_text)
+        total, wall, tt, errs = _run_wave(eng, tok, n_req, n_tok,
+                                          prompt_text)
+        if errs:
+            raise RuntimeError(f"measured wave errored: {errs[0][:200]}")
         best = max(best, total / wall)
         ttfts.extend(tt)
     ttfts.sort()
@@ -182,6 +192,12 @@ def main() -> None:
         del params, eng
         extra["ttft_p50_ms_1b"] = p50
         extra["ttft_p95_ms_1b"] = p95
+        # release the 1B leg's HBM (params + KV cache + jit executables
+        # holding donated buffers) before the 8B weights arrive
+        import gc
+
+        gc.collect()
+        jax.clear_caches()
 
         # --- 8B-class config (Llama-3.1-8B geometry, int8 weight-only:
         # bf16 8B does not fit one v5e chip) ---

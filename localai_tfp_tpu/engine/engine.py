@@ -305,6 +305,7 @@ class LLMEngine:
         self.slots = [_Slot(i) for i in range(n_slots)]
         self._use_kernel = self._kernel_eligible()
         self._pending: list[tuple[GenRequest, queue.SimpleQueue]] = []
+        self._cancelled: dict[str, float] = {}  # id -> cancel time
         self._lock = threading.Condition()
         self._stop = False
         self._thread: Optional[threading.Thread] = None
@@ -947,6 +948,45 @@ class LLMEngine:
             if ev.done:
                 return ev
 
+    def cancel(self, request_id: str) -> None:
+        """Release a queued or in-flight request (ref: llama.cpp task
+        cancel on client disconnect — the slot frees at the next
+        scheduler iteration; its stream gets a final "cancelled"
+        event). A cancel that RACES AHEAD of submit is retained (with an
+        expiry) so the late-arriving request is still dropped."""
+        with self._lock:
+            self._cancelled[request_id] = time.perf_counter()
+            self._lock.notify_all()
+
+    _CANCEL_TTL_S = 300.0  # unmatched cancel ids expire (leak bound)
+
+    def _apply_cancellations(self) -> None:
+        with self._lock:
+            if not self._cancelled:
+                return
+            now = time.perf_counter()
+            for rid in [r for r, t in self._cancelled.items()
+                        if now - t > self._CANCEL_TTL_S]:
+                del self._cancelled[rid]
+            cancelled = self._cancelled
+            # queued requests: drop before admission
+            still = []
+            for req, out in self._pending:
+                if req.id in cancelled:
+                    del cancelled[req.id]
+                    out.put(StreamEvent(done=True,
+                                        finish_reason="cancelled"))
+                else:
+                    still.append((req, out))
+            self._pending = still
+        hit = [s for s in self.slots
+               if s.active and s.request is not None
+               and s.request.id in cancelled]
+        for s in hit:
+            with self._lock:
+                cancelled.pop(s.request.id, None)
+            self._finish(s, "cancelled")
+
     # ------------------------------------------------------------- scheduler
 
     def _loop(self) -> None:
@@ -973,6 +1013,7 @@ class LLMEngine:
 
     def step(self) -> None:
         """One scheduler iteration (ref: update_slots, grpc-server.cpp:1639)."""
+        self._apply_cancellations()
         self._admit()
         prefilling = [s for s in self.slots if s.state is SlotState.PREFILL]
         if prefilling:
@@ -999,6 +1040,12 @@ class LLMEngine:
             pending, self._pending = self._pending, []
         assigned: list[_Slot] = []
         for req, out in pending:
+            with self._lock:
+                if req.id in self._cancelled:  # cancel raced ahead
+                    del self._cancelled[req.id]
+                    out.put(StreamEvent(done=True,
+                                        finish_reason="cancelled"))
+                    continue
             slot = self._pick_slot(req)
             if slot is None:
                 with self._lock:  # no free slot; requeue preserving order

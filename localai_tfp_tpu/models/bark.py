@@ -454,10 +454,22 @@ class BarkTTS:
         fine_spec = gpt_spec("fine_acoustics_config", fine=True)
         codec_cfg = cfg.get("codec_config", {})
         tok = None
-        if os.path.exists(os.path.join(model_dir, "vocab.txt")):
+        if os.path.exists(os.path.join(model_dir, "tokenizer.json")):
+            from transformers import PreTrainedTokenizerFast
+
+            tok = PreTrainedTokenizerFast(
+                tokenizer_file=os.path.join(model_dir, "tokenizer.json"))
+        elif os.path.exists(os.path.join(model_dir, "vocab.txt")):
             from transformers import BertTokenizer
 
             tok = BertTokenizer(os.path.join(model_dir, "vocab.txt"))
+        else:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "bark checkpoint %s has no tokenizer files; text will "
+                "be byte-mapped — synthesis quality will be poor until "
+                "a tokenizer.json/vocab.txt is provided", model_dir)
         return cls(
             semantic_spec=sem_spec,
             semantic=load_bark_gpt(sd, "semantic.", sem_spec, dtype),
@@ -484,14 +496,19 @@ class BarkTTS:
         out: list[int] = []
         for step in range(max_new):
             window = ids[-spec.block_size:]
-            logits = _bucketed_last_logits(spec, p, window)
-            logits = logits[:vocab_limit]
+            full = _bucketed_last_logits(spec, p, window)
+            logits = full[:vocab_limit]
+            if stop_token is not None:
+                # suno early-stop: the stop token's logit competes as an
+                # extra candidate beyond the value band
+                logits = jnp.concatenate(
+                    [logits, full[stop_token][None]])
             if temperature <= 0:
                 tok = int(jnp.argmax(logits))
             else:
                 rng, key = jax.random.split(rng)
                 tok = int(jax.random.categorical(key, logits / temperature))
-            if stop_token is not None and tok == stop_token:
+            if stop_token is not None and tok == vocab_limit:
                 break
             out.append(tok + offset_out)
             ids.append(tok + offset_out)
@@ -526,8 +543,11 @@ class BarkTTS:
         semantic = self._sample_loop(
             self.semantic_spec, self.semantic, prompt,
             max_new=max_semantic, temperature=temperature,
-            stop_token=None, vocab_limit=SEMANTIC_VOCAB_SIZE,
+            stop_token=SEMANTIC_PAD_TOKEN,  # suno's early-stop candidate
+            vocab_limit=SEMANTIC_VOCAB_SIZE,
             offset_out=0, rng=k1)
+        if not semantic:  # degenerate immediate stop: emit one frame
+            semantic = [0]
 
         # --- coarse stage: 2 codebooks interleaved at 75/49.9 ratio ---
         ratio = COARSE_RATE_HZ / SEMANTIC_RATE_HZ * N_COARSE_CODEBOOKS

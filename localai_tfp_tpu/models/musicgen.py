@@ -515,20 +515,27 @@ def mg_generate(bundle, text_ids: np.ndarray, max_new_tokens: int = 128,
     pattern_mask = np.where(valid, -1, pad)
 
     codes = np.full((nb, T_total), meta["decoder_start"], np.int32)
-    step_fn = _mg_step_cached(dec)
+    n_layers = len(dp["layers"])
+    # cache sized to a power-of-two bucket: one compiled step serves all
+    # requested lengths up to the bucket (log2 cache entries, not one
+    # per max_new_tokens value); the step's position mask hides slack
+    t_bucket = 1 << max(T_total - 1, 1).bit_length()
+    step_fn = _mg_step_kv_cached(dec, n_layers, t_bucket)
 
     B = 2 if guidance_scale != 1.0 else 1
+    # KV cache (PARITY gap #4 closed): each step feeds ONE frame and
+    # attends over cached K/V instead of re-running the padded prefix —
+    # O(T^2) total instead of O(T^3)
+    D = dec.d_model
+    cache_k = jnp.zeros((n_layers, B, t_bucket, D), jnp.float32)
+    cache_v = jnp.zeros((n_layers, B, t_bucket, D), jnp.float32)
+    cross_k, cross_v = _mg_cross_kv(dec)(dp, enc_states)
     for step in range(1, T_total):
-        cur = np.where(pattern_mask[:, :step] == -1, codes[:, :step],
-                       pattern_mask[:, :step])
-        # pad the prefix to a power-of-two bucket: the causal mask keeps
-        # positions < step independent of the padding, so the jit cache
-        # holds log2(T) entries instead of one per length
-        Tp = 1 << max(step - 1, 0).bit_length()
-        buf = np.full((nb, Tp), pad, np.int32)
-        buf[:, :step] = cur
-        inp = jnp.asarray(np.repeat(buf[None], B, 0))  # [B, nb, Tp]
-        logits = step_fn(dp, inp, enc_states, step - 1)  # [B, nb, V]
+        cur = np.where(pattern_mask[:, step - 1] == -1,
+                       codes[:, step - 1], pattern_mask[:, step - 1])
+        frame = jnp.asarray(np.repeat(cur[None], B, 0))  # [B, nb]
+        logits, cache_k, cache_v = step_fn(
+            dp, frame, cross_k, cross_v, cache_k, cache_v, step - 1)
         lg = np.asarray(logits, np.float32)
         if guidance_scale != 1.0:
             lg = lg[1] + guidance_scale * (lg[0] - lg[1])
@@ -561,21 +568,91 @@ _STEP_FNS: dict[tuple, Any] = {}  # spec fields -> jitted step, so the
 # (field-tuple keying survives model reloads; id() could be recycled)
 
 
-def _mg_step_cached(dec: MgDecSpec):
+def _mg_cross_kv(dec: MgDecSpec):
+    """Jitted once-per-request cross-attention K/V precompute:
+    [L, B, S, D] each (the encoder states never change mid-decode)."""
     import dataclasses
 
-    key = dataclasses.astuple(dec)
+    key = ("cross",) + dataclasses.astuple(dec)
     fn = _STEP_FNS.get(key)
     if fn is not None:
         return fn
 
     @jax.jit
-    def step(dp, codes, enc_states, last):
-        x = mg_hidden(dec, dp, codes, enc_states)
-        xt = lax.dynamic_index_in_dim(x, last, 1, keepdims=False)  # [B, D]
-        # heads run on ONE position, not the whole padded prefix
-        return jnp.stack(
+    def cross(dp, enc_states):
+        ks = jnp.stack([enc_states @ lp["cross_wk"]
+                        for lp in dp["layers"]])
+        vs = jnp.stack([enc_states @ lp["cross_wv"]
+                        for lp in dp["layers"]])
+        return ks, vs
+
+    _STEP_FNS[key] = cross
+    return cross
+
+
+def _mg_step_kv_cached(dec: MgDecSpec, n_layers: int, t_max: int):
+    """Jitted KV-cached single-frame decoder step (PARITY gap #4: the
+    engine's cache discipline applied to MusicGen)."""
+    import dataclasses
+
+    key = dataclasses.astuple(dec) + (n_layers, t_max)
+    fn = _STEP_FNS.get(key)
+    if fn is not None:
+        return fn
+    H, Dh = dec.n_heads, dec.d_head
+
+    def attend(q, ks, vs, mask):
+        # q [B, 1, D]; ks/vs [B, S, D]
+        B, S = ks.shape[:2]
+        qh = q.reshape(B, 1, H, Dh)
+        kh = ks.reshape(B, S, H, Dh)
+        vh = vs.reshape(B, S, H, Dh)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
+                            precision=lax.Precision.HIGHEST)
+        if mask is not None:
+            logits = logits + mask
+        probs = jax.nn.softmax(logits, -1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, vh,
+                         precision=lax.Precision.HIGHEST)
+        return out.reshape(B, 1, H * Dh)
+
+    @partial(jax.jit, donate_argnums=(4, 5))
+    def step(dp, frame, cross_k, cross_v, cache_k, cache_v, pos):
+        B = frame.shape[0]
+        x = jnp.zeros((B, 1, dec.d_model), cache_k.dtype)
+        for cb in range(dec.n_codebooks):
+            x = x + dp["embed"][cb][frame[:, cb]][:, None]
+        if dec.scale_embedding:
+            x = x * math.sqrt(dec.d_model)
+        x = x + _sin_pos(pos[None], dec.d_model)[None]
+        # positions beyond pos are zeros in the cache; mask them out
+        mask = jnp.where(jnp.arange(t_max) <= pos, 0.0, -1e9)[
+            None, None, None, :]
+        for li, lp in enumerate(dp["layers"]):
+            h = _ln(x, lp["ln1_w"], lp["ln1_b"])
+            q = (h @ lp["self_wq"]) * (Dh ** -0.5)
+            k_new = h @ lp["self_wk"]
+            v_new = h @ lp["self_wv"]
+            cache_k = lax.dynamic_update_slice(
+                cache_k, k_new[None].astype(cache_k.dtype),
+                (li, 0, pos, 0))
+            cache_v = lax.dynamic_update_slice(
+                cache_v, v_new[None].astype(cache_v.dtype),
+                (li, 0, pos, 0))
+            attn = attend(q, cache_k[li], cache_v[li], mask)
+            x = x + attn @ lp["self_wo"]
+            h = _ln(x, lp["ln2_w"], lp["ln2_b"])
+            q = (h @ lp["cross_wq"]) * (Dh ** -0.5)
+            attn = attend(q, cross_k[li], cross_v[li], None)
+            x = x + attn @ lp["cross_wo"]
+            h = _ln(x, lp["ln3_w"], lp["ln3_b"])
+            x = x + jax.nn.gelu(h @ lp["fc1_w"] + lp["fc1_b"],
+                                approximate=False) @ lp["fc2_w"] \
+                + lp["fc2_b"]
+        xt = _ln(x, dp["final_ln_w"], dp["final_ln_b"])[:, 0]  # [B, D]
+        logits = jnp.stack(
             [xt @ dp["heads"][cb] for cb in range(dec.n_codebooks)], 1)
+        return logits, cache_k, cache_v
 
     _STEP_FNS[key] = step
     return step

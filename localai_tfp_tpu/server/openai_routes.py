@@ -431,6 +431,7 @@ async def _stream_chat(
 
     loop = asyncio.get_running_loop()
     q: asyncio.Queue = asyncio.Queue()
+    opts.request_id = opts.request_id or uuid.uuid4().hex
 
     def producer() -> None:
         try:
@@ -446,17 +447,23 @@ async def _stream_chat(
 
     buffered = ""
     final: Optional[Reply] = None
-    while True:
-        r = await q.get()
-        if r is None:
-            break
-        if r.finish_reason or r.error:
-            final = r
-            continue
-        if tools_requested:
-            buffered += r.message
-        elif r.message:
-            await resp.write(chunk({"content": r.message}))
+    try:
+        while True:
+            r = await q.get()
+            if r is None:
+                break
+            if r.finish_reason or r.error:
+                final = r
+                continue
+            if tools_requested:
+                buffered += r.message
+            elif r.message:
+                await resp.write(chunk({"content": r.message}))
+    except (ConnectionResetError, asyncio.CancelledError):
+        # client went away: free the slot instead of decoding to
+        # max_tokens (ref: llama.cpp task cancel on disconnect)
+        backend.cancel(opts.request_id)
+        raise
 
     finish = (final.finish_reason if final else "stop") or "stop"
     if tools_requested and final is not None:
@@ -563,6 +570,7 @@ async def _stream_completion(request, backend, opts, cfg, cid, created,
     await resp.prepare(request)
     loop = asyncio.get_running_loop()
     q: asyncio.Queue = asyncio.Queue()
+    opts.request_id = opts.request_id or uuid.uuid4().hex
 
     def producer() -> None:
         try:
@@ -576,21 +584,26 @@ async def _stream_completion(request, backend, opts, cfg, cid, created,
 
     loop.run_in_executor(None, producer)
     final = None
-    while True:
-        r = await q.get()
-        if r is None:
-            break
-        if r.finish_reason or r.error:
-            final = r
-            continue
-        if r.message:
-            payload = {
-                "id": cid, "object": "text_completion", "created": created,
-                "model": cfg.name,
-                "choices": [{"index": 0, "text": r.message,
-                             "finish_reason": None}],
-            }
-            await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
+    try:
+        while True:
+            r = await q.get()
+            if r is None:
+                break
+            if r.finish_reason or r.error:
+                final = r
+                continue
+            if r.message:
+                payload = {
+                    "id": cid, "object": "text_completion",
+                    "created": created, "model": cfg.name,
+                    "choices": [{"index": 0, "text": r.message,
+                                 "finish_reason": None}],
+                }
+                await resp.write(
+                    f"data: {json.dumps(payload)}\n\n".encode())
+    except (ConnectionResetError, asyncio.CancelledError):
+        backend.cancel(opts.request_id)
+        raise
     payload = {
         "id": cid, "object": "text_completion", "created": created,
         "model": cfg.name,

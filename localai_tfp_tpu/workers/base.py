@@ -57,6 +57,8 @@ class PredictOptions:
     prompt_cache_all: bool = False
     prompt_cache_ro: bool = False
     correlation_id: str = ""
+    request_id: str = ""  # caller-chosen id enabling cancel() on
+    # client disconnect (ref: llama.cpp task cancel)
     use_tokenizer_template: bool = False
 
 
@@ -176,6 +178,11 @@ class Backend(abc.ABC):
 
     def health(self) -> bool:
         return True
+
+    def cancel(self, request_id: str) -> None:
+        """Best-effort release of an in-flight request (client
+        disconnect). Default: no-op for workers without long-running
+        per-request state."""
 
     def load_model(self, opts: ModelLoadOptions) -> Result:
         raise NotImplementedError

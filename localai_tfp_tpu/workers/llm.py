@@ -352,7 +352,12 @@ class JaxLLMBackend(Backend):
             correlation_id=opts.correlation_id,
             soft_embeds=soft_embeds,
             soft_positions=soft_positions,
+            **({"id": opts.request_id} if opts.request_id else {}),
         )
+
+    def cancel(self, request_id: str) -> None:
+        if self.engine is not None:
+            self.engine.cancel(request_id)
 
     def predict(self, opts: PredictOptions) -> Reply:
         if self.engine is None:
