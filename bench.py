@@ -208,12 +208,12 @@ def main() -> None:
                 rope_theta=500000.0,
             )
             params8 = _fast_int8_params(spec8)
-            # decode_steps=8: the 8B scan's compile cost scales hard with
-            # length through the remote compile helper; 8 steps amortize
-            # the dispatch RTT acceptably at 8B step times
+            # decode_steps=16: amortizes the dispatch RTT over more steps
+            # while keeping the 8B scan's (remote) compile cost bounded
             eng8 = LLMEngine(
                 spec8, params8, tok, n_slots=16, max_seq=1024,
-                decode_steps=8, cache_dtype=jnp.bfloat16, autostart=False,
+                decode_steps=16, cache_dtype=jnp.bfloat16,
+                autostart=False,
             )
             eng8.start()
             tok_s8, p50_8, p95_8 = _bench_config(eng8, tok, 16, 256,

@@ -296,8 +296,12 @@ class LLMEngine:
             n_slots, spec.vocab_size, window=penalty_window
         )
         if mesh is not None:
+            from ..models import quant
             from ..parallel.sharding import shard_engine_state, shard_params
 
+            # GSPMD cannot partition the fused int8 pallas call; meshed
+            # serving takes the XLA dequant path (models/quant.py)
+            quant.set_meshed_serving(True)
             self.params = shard_params(self.params, mesh)
             self.cache, self.sampling = shard_engine_state(
                 self.cache, self.sampling, mesh

@@ -52,10 +52,44 @@ def quantize_params(params: dict[str, Any]) -> dict[str, Any]:
     return out
 
 
+_MESHED_SERVING = False  # set by the engine when params are GSPMD-
+# sharded: the pallas custom call is not partitionable by GSPMD (it
+# would need a shard_map wrapper), so meshed serving stays on the XLA
+# path. Process-global is safe under the single-TPU-owner convention
+# (engine/loader.py enforces one active backend).
+
+
+def set_meshed_serving(flag: bool) -> None:
+    global _MESHED_SERVING
+    _MESHED_SERVING = flag
+
+
+def _kernel_enabled() -> bool:
+    import os
+
+    if _MESHED_SERVING:
+        return False
+    return os.environ.get("LOCALAI_INT8_KERNEL", "1") not in (
+        "0", "false", "off")
+
+
 def mm(x: jax.Array, w: Any):
-    """x @ w for plain arrays OR QTensor (int8 upcast inline + one
-    per-channel multiply on the output)."""
+    """x @ w for plain arrays OR QTensor.
+
+    QTensor path: the fused Pallas dequant-matmul when shapes qualify
+    (weight traffic stays 1 byte/elem — XLA's inline upcast measured 5x
+    off the weight-read roofline at 8B scale); XLA upcast otherwise."""
     if isinstance(w, QTensor):
+        from ..ops.int8_matmul import eligible, int8_matmul
+
+        lead = x.shape[:-1]
+        m = 1
+        for d in lead:
+            m *= d
+        if _kernel_enabled() and eligible(m, w.q.shape):
+            y = int8_matmul(x.reshape(m, x.shape[-1]), w.q, w.scale,
+                            out_dtype=x.dtype)
+            return y.reshape(*lead, w.q.shape[-1])
         y = x @ w.q.astype(x.dtype)
         return y * w.scale.astype(x.dtype)
     return x @ w

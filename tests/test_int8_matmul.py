@@ -1,0 +1,70 @@
+"""Fused int8 dequant-matmul kernel vs the reference dequantized matmul
+(interpret mode on CPU; Mosaic on TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from localai_tfp_tpu.models.quant import QTensor, mm, quantize_tensor
+from localai_tfp_tpu.ops.int8_matmul import BK, BN, int8_matmul
+
+
+@pytest.mark.parametrize("m", [8, 16, 128])
+def test_kernel_matches_dequant_reference(m):
+    K, N = 2 * BK, BN
+    rng = jax.random.PRNGKey(0)
+    w = jax.random.normal(rng, (K, N), jnp.float32) * 0.05
+    qt = quantize_tensor(w)
+    x = jax.random.normal(jax.random.fold_in(rng, 1), (m, K),
+                          jnp.float32)
+    want = (x @ qt.q.astype(jnp.float32)) * qt.scale
+    got = int8_matmul(x, qt.q, qt.scale, out_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_mm_dispatches_and_matches(monkeypatch):
+    K, N = BK, BN
+    w = jax.random.normal(jax.random.PRNGKey(2), (K, N),
+                          jnp.float32) * 0.05
+    qt = quantize_tensor(w)
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 4, K), jnp.float32)
+    got = mm(x, qt)
+    monkeypatch.setenv("LOCALAI_INT8_KERNEL", "0")
+    want = mm(x, qt)
+    assert got.shape == (2, 4, N)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_mm_falls_back_on_odd_shapes():
+    # K not a BK multiple: must silently use the XLA path
+    K, N = 96, 64
+    qt = quantize_tensor(
+        jax.random.normal(jax.random.PRNGKey(4), (K, N), jnp.float32))
+    x = jax.random.normal(jax.random.PRNGKey(5), (3, K), jnp.float32)
+    out = mm(x, qt)
+    assert out.shape == (3, N)
+
+
+def test_mm_meshed_serving_uses_xla_path(monkeypatch):
+    """Under GSPMD-sharded serving the pallas call must not be emitted
+    (GSPMD cannot partition it); the engine sets the meshed flag."""
+    from localai_tfp_tpu.models import quant
+    from localai_tfp_tpu.ops import int8_matmul as kmod
+
+    def boom(*a, **k):
+        raise AssertionError("pallas kernel dispatched under mesh")
+
+    monkeypatch.setattr(kmod, "int8_matmul", boom)
+    K, N = BK, BN
+    qt = quantize_tensor(
+        jax.random.normal(jax.random.PRNGKey(6), (K, N), jnp.float32))
+    x = jax.random.normal(jax.random.PRNGKey(7), (4, K), jnp.float32)
+    quant.set_meshed_serving(True)
+    try:
+        out = mm(x, qt)  # must take the XLA path, not boom
+        assert out.shape == (4, N)
+    finally:
+        quant.set_meshed_serving(False)
