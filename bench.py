@@ -188,10 +188,24 @@ def main() -> None:
         )
         eng.start()
         tok_s, p50, p95 = _bench_config(eng, tok, n_slots, gen_tokens)
+        extra["ttft_p50_ms_1b"] = p50  # under a 64-deep burst
+        extra["ttft_p95_ms_1b"] = p95
+        # interactive TTFT: one request against the warm engine (the
+        # BASELINE <200 ms target's classic reading)
+        singles = []
+        for _ in range(5):
+            _, _, tt, errs = _run_wave(eng, tok, 1, 8, "benchmark " * 12)
+            if errs:
+                raise RuntimeError(
+                    f"single-request wave errored: {errs[0][:200]}")
+            if tt:
+                singles.append(tt[0])
+        if not singles:
+            raise RuntimeError("single-request TTFT produced no samples")
+        singles.sort()
+        extra["ttft_ms_1b_single"] = round(singles[len(singles) // 2], 1)
         eng.close()
         del params, eng
-        extra["ttft_p50_ms_1b"] = p50
-        extra["ttft_p95_ms_1b"] = p95
         # release the 1B leg's HBM (params + KV cache + jit executables
         # holding donated buffers) before the 8B weights arrive
         import gc
@@ -208,11 +222,12 @@ def main() -> None:
                 rope_theta=500000.0,
             )
             params8 = _fast_int8_params(spec8)
-            # decode_steps=16: amortizes the dispatch RTT over more steps
-            # while keeping the 8B scan's (remote) compile cost bounded
+            # decode_steps=8 measured best for the 8B leg (16 regressed:
+            # dispatch RTT is already amortized at 8 while the longer
+            # scan costs compile time and won nothing back)
             eng8 = LLMEngine(
                 spec8, params8, tok, n_slots=16, max_seq=1024,
-                decode_steps=16, cache_dtype=jnp.bfloat16,
+                decode_steps=8, cache_dtype=jnp.bfloat16,
                 autostart=False,
             )
             eng8.start()

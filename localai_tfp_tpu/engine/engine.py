@@ -43,7 +43,9 @@ from jax import lax
 
 from ..models.llm_spec import LLMSpec
 from ..models.transformer import KVCache, Params, forward, forward_hidden
-from ..ops.sampling import SamplingState, observe_tokens, sample
+from ..ops.sampling import (
+    SamplingState, observe_tokens, sample, seed_windows,
+)
 from .tokenizer import StreamDecoder, Tokenizer
 
 DEFAULT_PREFILL_BUCKETS = (32, 128, 512, 2048)
@@ -602,16 +604,9 @@ class LLMEngine:
                 spec, params, tokens, pos0, win, slot_ids, soft=soft
             )
             cache = restore(win)
-
-            def seed(st, i):
-                return observe_tokens(
-                    st, slot_ids, tails[:, i], i < tail_lens
-                ), None
-
-            sampling, _ = lax.scan(
-                seed, sampling,
-                jnp.arange(tails.shape[1], dtype=jnp.int32),
-            )
+            # closed-form penalty-window seed (scan-equivalent; the W
+            # sequential scatter steps dominated this dispatch's time)
+            sampling = seed_windows(sampling, slot_ids, tails, tail_lens)
             last = jax.vmap(
                 lambda lg, n: lax.dynamic_slice_in_dim(lg, n - 1, 1, 0)[0]
             )(logits, n_chunk)  # [B, V] at each chunk's true last position
@@ -687,8 +682,12 @@ class LLMEngine:
         # beyond their valid prefix)
         room = min(self.max_seq - 1 - s.n_past
                    for s in self.slots if s.state is SlotState.DECODE)
+        need = max((s.request.max_tokens - len(s.generated)
+                    for s in decoding if s.request is not None),
+                   default=1)
         rounds = max(1, min(self.decode_steps // kd,
-                            max(room // kd, 1)))
+                            max(room // kd, 1),
+                            -(-need // kd)))  # no overshoot rounds
         span = rounds * kd
         elig = {s.idx for s in decoding}
         tokens = np.zeros((S, 1), np.int32)
@@ -1447,12 +1446,21 @@ class LLMEngine:
         room = min(self.max_seq - 1 - s.n_past for s in decoding)
         if self.decode_steps <= 1:
             return 1, room
+        need = 1
         for s in decoding:
             req = s.request
             if req is not None and (req.constraint or req.logit_bias):
                 return 1, room
-        k = min(self.decode_steps, max(room, 1))
-        while k & (k - 1):  # round down to a power of two (tiny jit cache)
+            if req is not None:
+                need = max(need, req.max_tokens - len(s.generated))
+        # cap by the largest remaining budget: a short request must not
+        # pay (or make the NEXT request wait behind) a full-length scan
+        # of discarded overshoot tokens
+        k = min(self.decode_steps, max(room, 1), max(need, 1))
+        if k & (k - 1):  # round UP to a power of two (tiny jit cache)
+            k = 1 << k.bit_length()
+        k = min(k, self.decode_steps, max(room, 1))
+        while k & (k - 1):  # room may not be a power of two: round down
             k &= k - 1
         return max(k, 1), room
 
@@ -1480,8 +1488,15 @@ class LLMEngine:
                 return
         t0 = time.perf_counter()
         S = self.n_slots
+        # after the spec filter: budgets of the slots THIS dispatch
+        # actually advances
+        need_tokens = max(
+            (s.request.max_tokens - len(s.generated)
+             for s in decoding if s.request is not None), default=1)
         k, room = self._multi_step_k(decoding)
-        depth = 2 if k > 1 and room >= 2 * k else 1
+        # no second chained scan when one already covers every slot's
+        # remaining budget (pure overshoot otherwise)
+        depth = 2 if k > 1 and room >= 2 * k and need_tokens > k else 1
         if self._use_kernel:
             # the fused Pallas kernel is ragged (reads only valid pages),
             # so no window slicing: one compiled variant for all contexts

@@ -219,6 +219,48 @@ def observe_sequence(state: SamplingState, slot_id: jax.Array,
     return state
 
 
+def seed_windows(state: SamplingState, slot_ids: jax.Array,
+                 tails: jax.Array, tail_lens: jax.Array) -> SamplingState:
+    """Seed freshly-reset slots' penalty windows from their prompt tails
+    in CLOSED FORM — equivalent to scanning ``observe_tokens`` over the
+    tail, but O(1) depth instead of W sequential steps (the scan
+    dominated the fused prefill dispatch: W=256 sequential scatter
+    steps). slot_ids [B]; tails [B, W] (prompt[-W:], left-aligned);
+    tail_lens [B]. Requires the target slots to be in the reset state
+    (counts 0, history -1, pos 0) — exactly how the engine calls it."""
+    W = state.window
+    V = state.token_counts.shape[-1]
+    T = tail_lens[:, None]  # [B, 1]
+    n = jnp.minimum(state.repeat_last_n[slot_ids][:, None], T)  # [B, 1]
+    j = jnp.arange(tails.shape[1], dtype=jnp.int32)[None, :]  # [1, W]
+    in_window = (j >= T - n) & (j < T)  # counted positions
+    safe = jnp.where((j < T) & (tails >= 0), tails, V)  # V = drop row
+
+    def count_row(tokens_row, mask_row):
+        return jnp.zeros(V + 1, jnp.int32).at[tokens_row].add(
+            mask_row.astype(jnp.int32))[:V]
+
+    counts_rows = jax.vmap(count_row)(safe, in_window)  # [B, V]
+    hist_rows = jnp.where(j < T, tails, -1)  # [B, W] ring images
+    if tails.shape[1] < W:
+        hist_rows = jnp.pad(hist_rows, ((0, 0), (0, W - tails.shape[1])),
+                            constant_values=-1)
+    return SamplingState(
+        rng=state.rng,
+        temperature=state.temperature,
+        top_k=state.top_k,
+        top_p=state.top_p,
+        min_p=state.min_p,
+        repeat_penalty=state.repeat_penalty,
+        freq_penalty=state.freq_penalty,
+        presence_penalty=state.presence_penalty,
+        token_counts=state.token_counts.at[slot_ids].set(counts_rows),
+        history=state.history.at[slot_ids].set(hist_rows),
+        history_pos=state.history_pos.at[slot_ids].set(tail_lens),
+        repeat_last_n=state.repeat_last_n,
+    )
+
+
 def _apply_penalties(logits: jax.Array, counts: jax.Array,
                      repeat_penalty: jax.Array, freq_penalty: jax.Array,
                      presence_penalty: jax.Array) -> jax.Array:

@@ -30,6 +30,7 @@ def test_mm_dispatches_and_matches(monkeypatch):
                           jnp.float32) * 0.05
     qt = quantize_tensor(w)
     x = jax.random.normal(jax.random.PRNGKey(3), (2, 4, K), jnp.float32)
+    monkeypatch.setenv("LOCALAI_INT8_KERNEL", "1")
     got = mm(x, qt)
     monkeypatch.setenv("LOCALAI_INT8_KERNEL", "0")
     want = mm(x, qt)
@@ -58,6 +59,7 @@ def test_mm_meshed_serving_uses_xla_path(monkeypatch):
         raise AssertionError("pallas kernel dispatched under mesh")
 
     monkeypatch.setattr(kmod, "int8_matmul", boom)
+    monkeypatch.setenv("LOCALAI_INT8_KERNEL", "1")
     K, N = BK, BN
     qt = quantize_tensor(
         jax.random.normal(jax.random.PRNGKey(6), (K, N), jnp.float32))

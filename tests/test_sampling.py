@@ -156,3 +156,45 @@ def test_sample_is_jittable():
     row[0, 11] = 1.0
     tok, st2 = step(st, jnp.array([0]), jnp.asarray(row))
     assert int(tok[0]) == 11
+
+
+def test_seed_windows_equals_observe_scan():
+    """The closed-form prompt-tail seeding must reproduce the sequential
+    observe_tokens scan bit for bit (the engine's fused prefill relies
+    on the equivalence)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from localai_tfp_tpu.ops.sampling import (
+        SamplingState, observe_tokens, seed_windows,
+    )
+
+    V, W, S = 64, 32, 4
+    st = SamplingState.create(S, V, window=W)
+    # varied per-slot repeat windows, incl. eviction (tail longer than n)
+    for s, n in enumerate((8, 32, 5, 16)):
+        st = st.reset_slot(s, repeat_last_n=n)
+    rng = np.random.default_rng(0)
+    tails = rng.integers(0, V, (3, W)).astype(np.int32)
+    tails = np.concatenate([tails, np.zeros((1, W), np.int32)])
+    tail_lens = np.asarray([W, 11, 1, 0], np.int32)
+    slot_ids = jnp.asarray([0, 1, 2, 3], jnp.int32)
+
+    def scan_seed(state):
+        def seed(s_, i):
+            return observe_tokens(
+                s_, slot_ids, jnp.asarray(tails)[:, i],
+                i < jnp.asarray(tail_lens)), None
+        out, _ = lax.scan(seed, state,
+                          jnp.arange(W, dtype=jnp.int32))
+        return out
+
+    want = scan_seed(st)
+    got = seed_windows(st, slot_ids, jnp.asarray(tails),
+                       jnp.asarray(tail_lens))
+    for name in ("token_counts", "history", "history_pos"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, name)),
+            np.asarray(getattr(want, name)), err_msg=name)
