@@ -1438,21 +1438,24 @@ class LLMEngine:
             return None
         return np.stack(rows)
 
-    def _multi_step_k(self, decoding: list[_Slot]) -> tuple[int, int]:
-        """(k, room): largest safe on-device step count — no grammar/
-        logit-bias slot (those need a host-side mask per token), and no
-        slot may cross the end of its context row mid-scan. ``room`` is the
-        shared context headroom that also gates pipeline depth."""
+    def _multi_step_k(
+        self, decoding: list[_Slot]
+    ) -> tuple[int, int, int]:
+        """(k, room, need): on-device step count — no grammar/logit-bias
+        slot (those need a host-side mask per token), no slot may cross
+        the end of its context row mid-scan, and k is capped by ``need``
+        (the largest remaining token budget). ``room`` is the shared
+        context headroom that also gates pipeline depth."""
         room = min(self.max_seq - 1 - s.n_past for s in decoding)
-        if self.decode_steps <= 1:
-            return 1, room
         need = 1
         for s in decoding:
             req = s.request
             if req is not None and (req.constraint or req.logit_bias):
-                return 1, room
+                return 1, room, need
             if req is not None:
                 need = max(need, req.max_tokens - len(s.generated))
+        if self.decode_steps <= 1:
+            return 1, room, need
         # cap by the largest remaining budget: a short request must not
         # pay (or make the NEXT request wait behind) a full-length scan
         # of discarded overshoot tokens
@@ -1462,7 +1465,17 @@ class LLMEngine:
         k = min(k, self.decode_steps, max(room, 1))
         while k & (k - 1):  # room may not be a power of two: round down
             k &= k - 1
-        return max(k, 1), room
+        k = max(k, 1)
+        # prefer an already-compiled k in [k, room] over cold-compiling
+        # the exact smaller variant (same trick as the window buckets:
+        # overshoot is discarded host-side anyway)
+        compiled = [key[1] for key in self._decode_k_fns
+                    if key[0] == "decode" and k < key[1] <= room
+                    and key[1] <= self.decode_steps]
+        if compiled and ("decode", k) not in {
+                (key[0], key[1]) for key in self._decode_k_fns}:
+            k = min(compiled)
+        return k, room, need
 
     def _decode_step(self, decoding: list[_Slot]) -> None:
         """One batched decode dispatch over every running slot
@@ -1488,12 +1501,7 @@ class LLMEngine:
                 return
         t0 = time.perf_counter()
         S = self.n_slots
-        # after the spec filter: budgets of the slots THIS dispatch
-        # actually advances
-        need_tokens = max(
-            (s.request.max_tokens - len(s.generated)
-             for s in decoding if s.request is not None), default=1)
-        k, room = self._multi_step_k(decoding)
+        k, room, need_tokens = self._multi_step_k(decoding)
         # no second chained scan when one already covers every slot's
         # remaining budget (pure overshoot otherwise)
         depth = 2 if k > 1 and room >= 2 * k and need_tokens > k else 1

@@ -311,6 +311,8 @@ def spec_from_gguf(meta: dict):
         rope_theta=float(g("rope.freq_base", 10000.0)),
         norm_eps=float(g("attention.layer_norm_rms_epsilon", 1e-5)),
         rope_scaling=rope_scaling,
+        n_experts=int(g("expert_count", 0)),
+        experts_per_token=int(g("expert_used_count", 2)),
     )
 
 
@@ -349,11 +351,23 @@ def load_gguf_params(path: str, dtype: Any = None,
                     lambda a: t(_unpermute_qk(a, spec.n_kv_heads))),
         "wv": stack("blk.{i}.attn_v.weight", t),
         "wo": stack("blk.{i}.attn_output.weight", t),
-        "w_gate": stack("blk.{i}.ffn_gate.weight", t),
-        "w_up": stack("blk.{i}.ffn_up.weight", t),
-        "w_down": stack("blk.{i}.ffn_down.weight", t),
         "final_norm_w": jnp.asarray(get("output_norm.weight"), dtype),
     }
+    if spec.n_experts:
+        # mixtral-family MoE gguf: ffn_gate_inp [E, D] router +
+        # fused expert stacks ffn_{gate,up,down}_exps [E, out, in]
+        # (numpy order after ne reversal) -> ours [L, E, in, out]
+        p["router"] = stack("blk.{i}.ffn_gate_inp.weight", t)
+        for ours, theirs in (("moe_gate", "ffn_gate_exps"),
+                             ("moe_up", "ffn_up_exps"),
+                             ("moe_down", "ffn_down_exps")):
+            p[ours] = stack(
+                "blk.{i}." + theirs + ".weight",
+                lambda a: np.ascontiguousarray(a.transpose(0, 2, 1)))
+    else:
+        p["w_gate"] = stack("blk.{i}.ffn_gate.weight", t)
+        p["w_up"] = stack("blk.{i}.ffn_up.weight", t)
+        p["w_down"] = stack("blk.{i}.ffn_down.weight", t)
     if "output.weight" in gf.tensors:
         p["lm_head"] = jnp.asarray(t(get("output.weight")), dtype)
     else:

@@ -271,3 +271,98 @@ def test_gguf_tokenizer_control_tokens_single_ids():
     ids = tk.encode_special("<|im_start|>hi<|im_end|>")
     assert ids[0] == 2 and ids[-1] == 3
     assert ids[1:-1] == [0, 1]
+
+
+def test_gguf_moe_logits_match_hf_loader(tmp_path):
+    """Mixtral-family MoE gguf mapping (fused ffn_*_exps stacks +
+    ffn_gate_inp router) must reproduce the HF-loaded logits exactly."""
+    import torch
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    from localai_tfp_tpu.models.hf_loader import load_params
+    from localai_tfp_tpu.models.transformer import KVCache, forward
+
+    torch.manual_seed(0)
+    cfg = MixtralConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, num_local_experts=4,
+        num_experts_per_tok=2, max_position_embeddings=128,
+        rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    model = MixtralForCausalLM(cfg)
+    hf_dir = str(tmp_path / "hf")
+    model.save_pretrained(hf_dir, safe_serialization=True)
+
+    sd = {k: v.detach().float().numpy() for k, v in
+          model.state_dict().items()}
+    heads, kv = cfg.num_attention_heads, cfg.num_key_value_heads
+    E = cfg.num_local_experts
+    tensors = []
+
+    def add(gname, w):
+        tensors.append((gname, 0, tuple(reversed(w.shape)),
+                        fx.enc_f32(np.ascontiguousarray(w))))
+
+    add("token_embd.weight", sd["model.embed_tokens.weight"])
+    add("output_norm.weight", sd["model.norm.weight"])
+    add("output.weight", sd["lm_head.weight"])
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}."
+        b = f"blk.{i}."
+        add(b + "attn_norm.weight", sd[p + "input_layernorm.weight"])
+        add(b + "ffn_norm.weight",
+            sd[p + "post_attention_layernorm.weight"])
+        add(b + "attn_q.weight", fx.hf_to_gguf_permute(
+            sd[p + "self_attn.q_proj.weight"], heads))
+        add(b + "attn_k.weight", fx.hf_to_gguf_permute(
+            sd[p + "self_attn.k_proj.weight"], kv))
+        add(b + "attn_v.weight", sd[p + "self_attn.v_proj.weight"])
+        add(b + "attn_output.weight", sd[p + "self_attn.o_proj.weight"])
+        add(b + "ffn_gate_inp.weight",
+            sd[p + "block_sparse_moe.gate.weight"])
+        for gg, hh in (("ffn_gate_exps", "w1"), ("ffn_up_exps", "w3"),
+                       ("ffn_down_exps", "w2")):
+            add(b + gg + ".weight", np.stack([
+                sd[p + f"block_sparse_moe.experts.{e}.{hh}.weight"]
+                for e in range(E)]))
+    meta = [
+        ("general.architecture", "str", "llama"),
+        ("llama.vocab_size", "u32", cfg.vocab_size),
+        ("llama.embedding_length", "u32", cfg.hidden_size),
+        ("llama.block_count", "u32", cfg.num_hidden_layers),
+        ("llama.attention.head_count", "u32", heads),
+        ("llama.attention.head_count_kv", "u32", kv),
+        ("llama.feed_forward_length", "u32", cfg.intermediate_size),
+        ("llama.context_length", "u32",
+         cfg.max_position_embeddings),
+        ("llama.rope.freq_base", "f32", cfg.rope_theta),
+        ("llama.attention.layer_norm_rms_epsilon", "f32",
+         cfg.rms_norm_eps),
+        ("llama.expert_count", "u32", E),
+        ("llama.expert_used_count", "u32", cfg.num_experts_per_tok),
+        ("tokenizer.ggml.model", "str", "llama"),
+        ("tokenizer.ggml.tokens", "arr:str",
+         [f"<t{i}>" for i in range(cfg.vocab_size)]),
+        ("tokenizer.ggml.scores", "arr:f32", [0.0] * cfg.vocab_size),
+    ]
+    gpath = str(tmp_path / "moe.gguf")
+    fx.write_gguf(gpath, meta, tensors)
+
+    spec_hf, p_hf = load_params(hf_dir, dtype=jnp.float32)
+    spec_gg, p_gg = load_gguf_params(gpath, dtype=jnp.float32)
+    assert spec_gg.n_experts == E
+    assert spec_gg.experts_per_token == cfg.num_experts_per_tok
+
+    ids = jnp.asarray([[1, 5, 9, 13, 2, 7]], jnp.int32)
+    zeros = jnp.zeros((1,), jnp.int32)
+
+    def logits(spec, p):
+        cache = KVCache.create(spec, 1, 32, jnp.float32)
+        lg, _ = forward(spec, p, ids, zeros, cache, zeros)
+        return np.asarray(lg)
+
+    np.testing.assert_allclose(logits(spec_gg, p_gg),
+                               logits(spec_hf, p_hf),
+                               rtol=2e-5, atol=2e-5)
