@@ -383,8 +383,8 @@ class LLMEngine:
             and not self.spec.attn_logit_softcap
             # conditions forward_hidden ALSO gates on — if they disagree
             # the engine would skip window bucketing while forward falls
-            # back to the full-seq XLA path
-            and not self.cache.quantized
+            # back to the full-seq XLA path (int8 caches qualify: the
+            # kernel reads int8 pages + per-row scales directly)
             and _layer_windows(self.spec) is None
         )
 

@@ -219,3 +219,42 @@ def ddim_sample(spec: DiffusionSpec, params: dict, cond: jax.Array,
 
     x, _ = lax.scan(step, x, jnp.arange(steps))
     return jnp.clip(x, -1, 1)
+
+
+@partial(jax.jit, static_argnums=(0, 5, 6, 7))
+def ddim_img2img(spec: DiffusionSpec, params: dict, cond: jax.Array,
+                 rng: jax.Array, init: jax.Array, steps: int = 20,
+                 guidance: float = 3.0,
+                 strength: float = 0.5) -> jax.Array:
+    """img2img for the toy pixel-space pipeline: renoise ``init``
+    ([B, H, W, C] in [-1, 1]) to ``strength`` of the schedule and
+    denoise from there — the frame-chaining primitive the video worker
+    uses (real checkpoints chain through the VAE in models/sd.py)."""
+    B = cond.shape[0]
+    betas = jnp.linspace(1e-4, 0.02, spec.steps_train)
+    alphas = jnp.cumprod(1.0 - betas)
+    full = jnp.linspace(spec.steps_train - 1, 0, steps).astype(jnp.int32)
+    i0 = min(int(round(steps * (1.0 - strength))), steps - 1)
+    ts = full[i0:]
+    n = ts.shape[0]
+    a0 = alphas[ts[0]]
+    noise = jax.random.normal(rng, init.shape)
+    x = jnp.sqrt(a0) * init + jnp.sqrt(1.0 - a0) * noise
+    uncond = jnp.zeros_like(cond)
+
+    def step(x, i):
+        t = ts[i]
+        t_prev = jnp.where(i + 1 < n, ts[jnp.minimum(i + 1, n - 1)], 0)
+        a_t = alphas[t]
+        a_prev = jnp.where(i + 1 < n, alphas[t_prev], 1.0)
+        tb = jnp.full((B,), t)
+        eps_c = unet(spec, params, x, tb, cond)
+        eps_u = unet(spec, params, x, tb, uncond)
+        eps = eps_u + guidance * (eps_c - eps_u)
+        x0 = (x - jnp.sqrt(1 - a_t) * eps) / jnp.sqrt(a_t)
+        x0 = jnp.clip(x0, -1.5, 1.5)
+        x = jnp.sqrt(a_prev) * x0 + jnp.sqrt(1 - a_prev) * eps
+        return x, None
+
+    x, _ = lax.scan(step, x, jnp.arange(n))
+    return jnp.clip(x, -1, 1)

@@ -159,6 +159,137 @@ def _dq_q4_0(b: np.ndarray) -> np.ndarray:
     return (d * np.concatenate([lo, hi], axis=1)).ravel()
 
 
+def _dq_q4_1(b: np.ndarray) -> np.ndarray:
+    """block: f16 d + f16 m + 16 nibble bytes; val = d*q + m."""
+    blk = b.reshape(-1, 20)
+    d = blk[:, :2].copy().view(np.float16).astype(np.float32)
+    m = blk[:, 2:4].copy().view(np.float16).astype(np.float32)
+    qs = blk[:, 4:]
+    lo = (qs & 0xF).astype(np.float32)
+    hi = (qs >> 4).astype(np.float32)
+    return (d * np.concatenate([lo, hi], axis=1) + m).ravel()
+
+
+def _q5_bits(blk: np.ndarray, off: int) -> np.ndarray:
+    """qh u32 + 16 nibble bytes at ``off`` -> [N, 32] 5-bit values
+    (elems 0..15 = low nibbles w/ qh bits 0..15, 16..31 = high w/ bits
+    16..31)."""
+    qh = blk[:, off:off + 4].copy().view(np.uint32)  # [N, 1]
+    qs = blk[:, off + 4:off + 20]
+    j = np.arange(16, dtype=np.uint32)
+    lo = (qs & 0xF) | (((qh >> j) & 1) << 4).astype(np.uint8)
+    hi = (qs >> 4) | (((qh >> (j + 16)) & 1) << 4).astype(np.uint8)
+    return np.concatenate([lo, hi], axis=1).astype(np.float32)
+
+
+def _dq_q5_0(b: np.ndarray) -> np.ndarray:
+    """block: f16 d + u32 qh + 16 nibble bytes; val = d*(q5 - 16)."""
+    blk = b.reshape(-1, 22)
+    d = blk[:, :2].copy().view(np.float16).astype(np.float32)
+    return (d * (_q5_bits(blk, 2) - 16.0)).ravel()
+
+
+def _dq_q5_1(b: np.ndarray) -> np.ndarray:
+    """block: f16 d + f16 m + u32 qh + 16 nibble bytes; val = d*q5 + m."""
+    blk = b.reshape(-1, 24)
+    d = blk[:, :2].copy().view(np.float16).astype(np.float32)
+    m = blk[:, 2:4].copy().view(np.float16).astype(np.float32)
+    return (d * _q5_bits(blk, 4) + m).ravel()
+
+
+def _dq_q2_k(b: np.ndarray) -> np.ndarray:
+    """super-block of 256: scales[16] (lo nibble=scale, hi=min), qs[64]
+    2-bit, d f16, dmin f16. Element (h, j, sub, l): h=128-half,
+    j=shift/2, sub=byte group, l=0..15 — q = qs[32h+16sub+l]>>(2j) & 3,
+    scale index 8h+2j+sub."""
+    blk = b.reshape(-1, 84)
+    N = blk.shape[0]
+    scales = blk[:, :16]
+    qs = blk[:, 16:80].reshape(N, 2, 2, 16)  # [N, half, sub, l]
+    d = blk[:, 80:82].copy().view(np.float16).astype(np.float32)
+    dmin = blk[:, 82:84].copy().view(np.float16).astype(np.float32)
+    shifts = np.arange(4, dtype=np.uint8) * 2  # j
+    # q [N, half, j, sub, l]
+    q = ((qs[:, :, None, :, :] >> shifts[None, None, :, None, None]) & 3
+         ).astype(np.float32)
+    sc = (scales & 0xF).astype(np.float32).reshape(N, 2, 4, 2)
+    mn = (scales >> 4).astype(np.float32).reshape(N, 2, 4, 2)
+    out = (d[:, :, None, None, None] * sc[..., None] * q
+           - dmin[:, :, None, None, None] * mn[..., None])
+    return out.ravel()
+
+
+def _dq_q3_k(b: np.ndarray) -> np.ndarray:
+    """super-block of 256: hmask[32], qs[64] 2-bit, scales[12] packed
+    6-bit signed (-32 offset), d f16. q = (qs>>(2j) & 3) - (hmask bit ?
+    0 : 4); hmask bit for (h, j, sub, l) = hm[16sub+l] & (1 << (4h+j))."""
+    blk = b.reshape(-1, 110)
+    N = blk.shape[0]
+    hm = blk[:, :32].reshape(N, 2, 16)  # [N, sub, l]
+    qs = blk[:, 32:96].reshape(N, 2, 2, 16)  # [N, half, sub, l]
+    raw = blk[:, 96:108]
+    d = blk[:, 108:110].copy().view(np.float16).astype(np.float32)
+    # unpack the 12-byte scale table into 16 6-bit signed values, in
+    # llama.cpp's aux-word order: scales[k] for k<8 = lo 4 bits of
+    # raw[k] region; k>=8 = hi 4 bits; raw[8:12] carries bits 4..5
+    lo = np.concatenate([raw[:, 0:4] & 0xF, raw[:, 4:8] & 0xF,
+                         raw[:, 0:4] >> 4, raw[:, 4:8] >> 4], axis=1)
+    hi_src = raw[:, 8:12]
+    hi = np.concatenate([
+        (hi_src >> 0) & 3, (hi_src >> 2) & 3,
+        (hi_src >> 4) & 3, (hi_src >> 6) & 3], axis=1)
+    scales = (lo | (hi << 4)).astype(np.int8).astype(np.float32) - 32.0
+    shifts = np.arange(4, dtype=np.uint8) * 2
+    q = ((qs[:, :, None, :, :] >> shifts[None, None, :, None, None]) & 3
+         ).astype(np.float32)
+    hbit = np.arange(4, dtype=np.uint8)  # j
+    mask = (np.uint8(1) << (hbit[None, None, :, None, None]
+                            + 4 * np.arange(2,
+                                            dtype=np.uint8)[None, :, None,
+                                                            None, None]))
+    have_h = (hm[:, None, None, :, :] & mask) != 0  # [N, half, j, sub, l]
+    q = q - np.where(have_h, 0.0, 4.0)
+    sc = scales.reshape(N, 2, 4, 2)  # [N, half, j, sub]
+    out = d[:, :, None, None, None] * sc[..., None] * q
+    return out.ravel()
+
+
+# non-linear 4-bit codebook shared by IQ4_NL / IQ4_XS (ggml kvalues)
+_IQ4_KVALUES = np.array(
+    [-127, -104, -83, -65, -49, -35, -22, -10, 1, 13, 25, 38, 53, 69,
+     89, 113], np.float32)
+
+
+def _dq_iq4_nl(b: np.ndarray) -> np.ndarray:
+    """block: f16 d + 16 nibble bytes indexing the nonlinear kvalues."""
+    blk = b.reshape(-1, 18)
+    d = blk[:, :2].copy().view(np.float16).astype(np.float32)
+    qs = blk[:, 2:]
+    lo = _IQ4_KVALUES[qs & 0xF]
+    hi = _IQ4_KVALUES[qs >> 4]
+    return (d * np.concatenate([lo, hi], axis=1)).ravel()
+
+
+def _dq_iq4_xs(b: np.ndarray) -> np.ndarray:
+    """super-block of 256: f16 d + u16 scales_h + scales_l[4] + qs[128].
+    Per 32-block k: scale = ((scales_l nibble) | (scales_h 2 bits << 4))
+    - 32; values = d * scale * kvalues[nibble] (lo 0..15, hi 16..31)."""
+    blk = b.reshape(-1, 136)
+    N = blk.shape[0]
+    d = blk[:, :2].copy().view(np.float16).astype(np.float32)  # [N,1]
+    sh = blk[:, 2:4].copy().view(np.uint16).astype(np.uint32)  # [N,1]
+    sl = blk[:, 4:8]  # [N, 4]
+    qs = blk[:, 8:136].reshape(N, 8, 16)
+    k = np.arange(8)
+    ls_l = (sl[:, k // 2] >> (4 * (k % 2))) & 0xF  # [N, 8]
+    ls_h = (sh >> (2 * k)) & 3  # [N, 8]
+    scale = (ls_l | (ls_h << 4)).astype(np.float32) - 32.0  # [N, 8]
+    lo = _IQ4_KVALUES[qs & 0xF]  # [N, 8, 16]
+    hi = _IQ4_KVALUES[qs >> 4]
+    vals = np.concatenate([lo, hi], axis=2)  # [N, 8, 32]
+    return (d[..., None] * scale[..., None] * vals).ravel()
+
+
 def _k_scale_min(scales: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """Unpack the 12-byte 6-bit scale/min table of K-quants: returns
     (sc [N, 8], m [N, 8])."""
@@ -249,15 +380,24 @@ _GGML_TYPES: dict[int, tuple[Callable, int, int]] = {
     0: (_dq_f32, 1, 4),
     1: (_dq_f16, 1, 2),
     2: (_dq_q4_0, 32, 18),
+    3: (_dq_q4_1, 32, 20),
+    6: (_dq_q5_0, 32, 22),
+    7: (_dq_q5_1, 32, 24),
     8: (_dq_q8_0, 32, 34),
+    10: (_dq_q2_k, 256, 84),
+    11: (_dq_q3_k, 256, 110),
     12: (_dq_q4_k, 256, 144),
     13: (_dq_q5_k, 256, 176),
     14: (_dq_q6_k, 256, 210),
+    20: (_dq_iq4_nl, 32, 18),
+    23: (_dq_iq4_xs, 256, 136),
     30: (_dq_bf16, 1, 2),
 }
 
-GGML_TYPE_NAMES = {0: "F32", 1: "F16", 2: "Q4_0", 8: "Q8_0",
-                   12: "Q4_K", 13: "Q5_K", 14: "Q6_K", 30: "BF16"}
+GGML_TYPE_NAMES = {0: "F32", 1: "F16", 2: "Q4_0", 3: "Q4_1", 6: "Q5_0",
+                   7: "Q5_1", 8: "Q8_0", 10: "Q2_K", 11: "Q3_K",
+                   12: "Q4_K", 13: "Q5_K", 14: "Q6_K", 20: "IQ4_NL",
+                   23: "IQ4_XS", 30: "BF16"}
 
 
 # ---------------------------------------------------------------------------

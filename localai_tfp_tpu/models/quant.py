@@ -42,13 +42,33 @@ def quantize_tensor(w: jax.Array) -> QTensor:
     return QTensor(q=q.astype(jnp.int8), scale=scale)
 
 
-def quantize_params(params: dict[str, Any]) -> dict[str, Any]:
+def quantize_embed(w: jax.Array) -> QTensor:
+    """Embedding-table int8: PER-ROW (per-token) scales [V] — embedding
+    rows vary widely in magnitude, so per-column scales would let rare
+    high-norm rows crush the rest. The gather dequantizes the touched
+    rows only; used tied as the LM head, the scale applies per OUTPUT
+    logit (one multiply on the matmul result)."""
+    wf = w.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(wf), axis=-1) / 127.0 + 1e-12  # [V]
+    q = jnp.clip(jnp.round(wf / scale[:, None]), -127, 127)
+    return QTensor(q=q.astype(jnp.int8), scale=scale)
+
+
+def quantize_params(params: dict[str, Any],
+                    embeddings: bool = False) -> dict[str, Any]:
     """Quantize the eligible projection stacks in place of their bf16
-    leaves. Everything else passes through untouched."""
+    leaves. ``embeddings=True`` also quantizes embed/lm_head (~2 GB on
+    an 8B: the difference between batch 16 and batch 64 serving on one
+    16 GB chip). Everything else passes through untouched."""
     out = dict(params)
     for name in QUANTIZABLE:
         if name in out and not isinstance(out[name], QTensor):
             out[name] = quantize_tensor(out[name])
+    if embeddings:
+        if not isinstance(out.get("embed"), QTensor):
+            out["embed"] = quantize_embed(out["embed"])
+        if "lm_head" in out and not isinstance(out["lm_head"], QTensor):
+            out["lm_head"] = quantize_tensor(out["lm_head"])
     return out
 
 

@@ -8,9 +8,13 @@ classifier-free guidance. No diffusers dependency: weights are imported
 straight from the component safetensors by a mechanical key-tree mapping
 (same technique as models/hf_loader.py for LLMs).
 
-Coverage: SD 1.x / 2.x class single-text-encoder pipelines, conv or
-linear transformer projections, epsilon or v-prediction. SDXL's dual
-text towers and added-cond embeddings are a follow-up.
+Coverage: SD 1.x / 2.x single-text-encoder pipelines AND SDXL-class
+dual-tower pipelines (CLIP-L + CLIP-G penultimate-layer concat, pooled
+text embedding + time-ids through the UNet's add_embedding path — ref:
+the reference's StableDiffusionXLPipeline branch, diffusers/backend.py
+:139-272), conv or linear transformer projections, epsilon or
+v-prediction, txt2img and img2img (VAE encoder + renoise, the base of
+frame-chained video).
 
 TPU-first: NHWC layout end to end, the full denoise loop is ONE
 ``lax.scan`` on device (same dispatch-amortization rationale as the LLM
@@ -237,6 +241,8 @@ class CLIPTextSpec:
     max_position: int = 77
     hidden_act: str = "quick_gelu"
     eps: float = 1e-5
+    projection_dim: int = 0  # CLIPTextModelWithProjection (SDXL CLIP-G)
+    eos_token_id: int = 49407  # pooled-embedding position marker
 
 
 def clip_spec_from_config(cfg: dict) -> CLIPTextSpec:
@@ -249,6 +255,8 @@ def clip_spec_from_config(cfg: dict) -> CLIPTextSpec:
         max_position=int(cfg.get("max_position_embeddings", 77)),
         hidden_act=str(cfg.get("hidden_act", "quick_gelu")),
         eps=float(cfg.get("layer_norm_eps", 1e-5)),
+        projection_dim=int(cfg.get("projection_dim", 0)),
+        eos_token_id=int(cfg.get("eos_token_id", 49407)),
     )
 
 
@@ -258,10 +266,16 @@ def _clip_act(spec: CLIPTextSpec, x: jax.Array) -> jax.Array:
     return jax.nn.gelu(x, approximate=False)
 
 
-def clip_text_encode(spec: CLIPTextSpec, tree: dict,
-                     ids: jax.Array) -> jax.Array:
-    """ids [B, T] -> last hidden state [B, T, d] (post final_layer_norm),
-    matching transformers CLIPTextModel.last_hidden_state."""
+def clip_text_states(spec: CLIPTextSpec, tree: dict,
+                     ids: jax.Array) -> tuple[jax.Array, jax.Array,
+                                              jax.Array]:
+    """ids [B, T] -> (penultimate hidden [B, T, d], final-normed last
+    hidden [B, T, d], pooled [B, d_or_proj]).
+
+    penultimate = the output of layer n_layers-1 WITHOUT final norm
+    (transformers hidden_states[-2] — what SDXL conditions on); pooled =
+    the EOS position of the final-normed states, through text_projection
+    when the checkpoint carries one (CLIPTextModelWithProjection)."""
     tm = _g(tree, "text_model")
     B, T = ids.shape
     x = _g(tm, "embeddings.token_embedding.weight")[ids]
@@ -269,7 +283,9 @@ def clip_text_encode(spec: CLIPTextSpec, tree: dict,
     causal = jnp.where(
         jnp.arange(T)[None, :] <= jnp.arange(T)[:, None], 0.0, -1e9
     )[None, None]  # [1, 1, T, T]
+    penult = x
     for i in range(spec.n_layers):
+        penult = x  # entering the last layer, x IS hidden_states[-2]
         lp = _g(tm, f"encoder.layers.{i}")
         h = _layer_norm(lp["layer_norm1"], x, spec.eps)
         q = _linear(lp["self_attn"]["q_proj"], h)
@@ -288,7 +304,27 @@ def clip_text_encode(spec: CLIPTextSpec, tree: dict,
         h = _linear(lp["mlp"]["fc1"], h)
         h = _clip_act(spec, h)
         x = x + _linear(lp["mlp"]["fc2"], h)
-    return _layer_norm(_g(tm, "final_layer_norm"), x, spec.eps)
+    final = _layer_norm(_g(tm, "final_layer_norm"), x, spec.eps)
+    # EOS pooling, mirroring transformers CLIPTextModel exactly: legacy
+    # configs (eos_token_id==2 — including SDXL-base's text_encoder_2,
+    # whose REAL eos is 49407) pool at argmax(ids); non-legacy configs
+    # pool at the FIRST eos_token_id occurrence (0 when absent)
+    if spec.eos_token_id == 2:
+        eos = jnp.argmax(ids, axis=-1)  # [B]
+    else:
+        eos = jnp.argmax((ids == spec.eos_token_id).astype(jnp.int32),
+                         axis=-1)  # [B]
+    pooled = jnp.take_along_axis(final, eos[:, None, None], axis=1)[:, 0]
+    if _has(tree, "text_projection"):
+        pooled = pooled @ _g(tree, "text_projection.weight")
+    return penult, final, pooled
+
+
+def clip_text_encode(spec: CLIPTextSpec, tree: dict,
+                     ids: jax.Array) -> jax.Array:
+    """ids [B, T] -> last hidden state [B, T, d] (post final_layer_norm),
+    matching transformers CLIPTextModel.last_hidden_state."""
+    return clip_text_states(spec, tree, ids)[1]
 
 
 # ---------------------------------------------------------------------------
@@ -375,6 +411,12 @@ class UNetSpec:
     cross_attention_dim: int = 768
     in_channels: int = 4
     norm_num_groups: int = 32
+    # SDXL "text_time" added conditioning: pooled text embeds + 6
+    # micro-conditioning time ids, each sinusoidally embedded at
+    # addition_time_embed_dim and run through add_embedding (ref:
+    # diffusers UNet2DConditionModel.get_aug_embed)
+    addition_embed_type: str = ""
+    addition_time_embed_dim: int = 256
 
 
 def unet_spec_from_config(cfg: dict) -> UNetSpec:
@@ -397,7 +439,22 @@ def unet_spec_from_config(cfg: dict) -> UNetSpec:
         cross_attention_dim=int(cfg.get("cross_attention_dim", 768)),
         in_channels=int(cfg.get("in_channels", 4)),
         norm_num_groups=int(cfg.get("norm_num_groups", 32)),
+        addition_embed_type=_check_addition_type(
+            str(cfg.get("addition_embed_type") or "")),
+        addition_time_embed_dim=int(
+            cfg.get("addition_time_embed_dim") or 256),
     )
+
+
+def _check_addition_type(t: str) -> str:
+    # "text"/"text_image"/"image"/"image_hint" checkpoints carry an
+    # add_embedding module with DIFFERENT submodule structure — reject
+    # cleanly at load instead of mis-applying text_time semantics
+    if t and t != "text_time":
+        raise ValueError(
+            f"unsupported UNet addition_embed_type {t!r} "
+            "(supported: text_time — the SDXL class)")
+    return t
 
 
 def _heads_for(spec: UNetSpec, block_idx: int) -> int:
@@ -408,13 +465,26 @@ def _heads_for(spec: UNetSpec, block_idx: int) -> int:
 
 
 def unet_forward(spec: UNetSpec, tree: dict, x: jax.Array, t: jax.Array,
-                 context: jax.Array) -> jax.Array:
-    """x [B, h, w, in_channels] latents; t [B]; context [B, Tc, d_cond].
-    Returns the predicted noise/v [B, h, w, in_channels]."""
+                 context: jax.Array,
+                 added: Optional[tuple] = None) -> jax.Array:
+    """x [B, h, w, in_channels] latents; t [B]; context [B, Tc, d_cond];
+    ``added`` = (pooled text_embeds [B, P], time_ids [B, 6]) for SDXL's
+    "text_time" added conditioning. Returns the predicted noise/v
+    [B, h, w, in_channels]."""
     g = spec.norm_num_groups
     temb = _timestep_embedding(t, spec.block_out_channels[0])
     temb = _linear(_g(tree, "time_embedding.linear_1"), temb)
     temb = _linear(_g(tree, "time_embedding.linear_2"), jax.nn.silu(temb))
+    if added is not None and spec.addition_embed_type == "text_time":
+        text_embeds, time_ids = added
+        B = text_embeds.shape[0]
+        tids = _timestep_embedding(
+            time_ids.reshape(-1), spec.addition_time_embed_dim
+        ).reshape(B, -1)  # [B, 6 * add_dim]
+        aug = jnp.concatenate([text_embeds, tids], axis=-1)
+        aug = _linear(_g(tree, "add_embedding.linear_1"), aug)
+        aug = _linear(_g(tree, "add_embedding.linear_2"), jax.nn.silu(aug))
+        temb = temb + aug
 
     h = _conv(_g(tree, "conv_in"), x)
     skips = [h]
@@ -510,6 +580,56 @@ def vae_decode(tree: dict, cfg: dict, z: jax.Array) -> jax.Array:
     return jnp.clip(_conv(dec["conv_out"], h), -1.0, 1.0)
 
 
+def vae_encode(tree: dict, cfg: dict, img: jax.Array) -> jax.Array:
+    """image [B, H, W, 3] in [-1, 1] -> latent MEAN [B, H/8, W/8, C],
+    already multiplied by scaling_factor (the deterministic img2img
+    init; diffusers samples the posterior — the mean is its mode and
+    keeps frame chaining reproducible)."""
+    g = int(cfg.get("norm_num_groups", 32))
+    scaling = float(cfg.get("scaling_factor", 0.18215))
+    enc = _g(tree, "encoder")
+    h = _conv(enc["conv_in"], img)
+    n_down = len(enc["down_blocks"])
+    for bi in range(n_down):
+        blk = enc["down_blocks"][str(bi)]
+        for li in range(len(blk["resnets"])):
+            h = _resnet(blk["resnets"][str(li)], h, None, g)
+        if "downsamplers" in blk:
+            # diffusers Downsample2D pads (0,1,0,1) then VALID-convs
+            h = jnp.pad(h, ((0, 0), (0, 1), (0, 1), (0, 0)))
+            h = lax.conv_general_dilated(
+                h, blk["downsamplers"]["0"]["conv"]["weight"], (2, 2),
+                "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            ) + blk["downsamplers"]["0"]["conv"]["bias"]
+
+    mid = enc["mid_block"]
+    h = _resnet(mid["resnets"]["0"], h, None, g)
+    if "attentions" in mid:
+        ap = mid["attentions"]["0"]
+        B, H, W, C = h.shape
+        legacy = "query" in ap
+        norm_key = "group_norm" if "group_norm" in ap else "norm"
+        hn = _group_norm(ap[norm_key], h, g, eps=1e-6)
+        hn = hn.reshape(B, H * W, C)
+        q = _linear(ap["query" if legacy else "to_q"], hn)
+        k = _linear(ap["key" if legacy else "to_k"], hn)
+        v = _linear(ap["value" if legacy else "to_v"], hn)
+        probs = jax.nn.softmax(
+            jnp.einsum("btd,bsd->bts", q, k) / math.sqrt(C), axis=-1)
+        attn = jnp.einsum("bts,bsd->btd", probs, v)
+        attn = _linear(ap["proj_attn"] if legacy else ap["to_out"]["0"],
+                       attn)
+        h = h + attn.reshape(B, H, W, C)
+    h = _resnet(mid["resnets"]["1"], h, None, g)
+
+    h = jax.nn.silu(_group_norm(enc["conv_norm_out"], h, g, eps=1e-6))
+    moments = _conv(enc["conv_out"], h)  # [B, h, w, 2C] mean|logvar
+    if _has(tree, "quant_conv"):
+        moments = _conv(_g(tree, "quant_conv"), moments)
+    mean, _ = jnp.split(moments, 2, axis=-1)
+    return mean * scaling
+
+
 # ---------------------------------------------------------------------------
 # DDIM scheduler + pipeline
 # ---------------------------------------------------------------------------
@@ -533,13 +653,26 @@ class SDPipeline:
     sched_cfg: dict = field(default_factory=dict)
     tokenizer: Any = None
     vae_scale: int = 8
+    # SDXL dual-tower extras (None/empty on SD 1.x/2.x)
+    clip2_spec: Optional[CLIPTextSpec] = None
+    text2_tree: dict = field(default_factory=dict)
+    tokenizer_2: Any = None
+    force_zeros_for_empty_prompt: bool = True  # SDXL model_index flag:
+    # empty negative prompt -> ZERO uncond embeddings, not CLIP("")
+
+    @property
+    def is_xl(self) -> bool:
+        return self.clip2_spec is not None
 
     @classmethod
     def load(cls, model_dir: str) -> "SDPipeline":
-        if not os.path.exists(os.path.join(model_dir, "model_index.json")):
+        mi_path = os.path.join(model_dir, "model_index.json")
+        if not os.path.exists(mi_path):
             raise ValueError(
                 f"{model_dir} is not a diffusers-format checkpoint "
                 "(no model_index.json)")
+        with open(mi_path) as f:
+            model_index = json.load(f)
         text_tree, text_cfg = load_component_tree(
             os.path.join(model_dir, "text_encoder"))
         unet_tree, unet_cfg = load_component_tree(
@@ -552,6 +685,13 @@ class SDPipeline:
             with open(sp) as f:
                 sched_cfg = json.load(f)
         tok = _load_clip_tokenizer(os.path.join(model_dir, "tokenizer"))
+        clip2_spec, text2_tree, tok2 = None, {}, None
+        te2 = os.path.join(model_dir, "text_encoder_2")
+        if os.path.isdir(te2):  # SDXL-class dual towers
+            text2_tree, text2_cfg = load_component_tree(te2)
+            clip2_spec = clip_spec_from_config(text2_cfg)
+            tok2 = _load_clip_tokenizer(
+                os.path.join(model_dir, "tokenizer_2"))
         ups = len(vae_cfg.get("block_out_channels", (1, 1, 1, 1)))
         return cls(
             model_dir=model_dir,
@@ -564,18 +704,39 @@ class SDPipeline:
             sched_cfg=sched_cfg,
             tokenizer=tok,
             vae_scale=2 ** (ups - 1),
+            clip2_spec=clip2_spec,
+            text2_tree=text2_tree,
+            tokenizer_2=tok2,
+            force_zeros_for_empty_prompt=bool(
+                model_index.get("force_zeros_for_empty_prompt", True)),
         )
 
     # ---------------------------------------------------------- components
 
+    def _ids(self, tok, prompt: str, max_len: int) -> jax.Array:
+        return jnp.asarray(tok(
+            prompt, padding="max_length", max_length=max_len,
+            truncation=True, return_tensors="np",
+        )["input_ids"].astype(np.int32))
+
     def encode_prompt(self, prompt: str) -> jax.Array:
-        ids = self.tokenizer(
-            prompt, padding="max_length",
-            max_length=self.clip_spec.max_position, truncation=True,
-            return_tensors="np",
-        )["input_ids"].astype(np.int32)
-        return clip_text_encode(self.clip_spec, self.text_tree,
-                                jnp.asarray(ids))
+        return clip_text_encode(
+            self.clip_spec, self.text_tree,
+            self._ids(self.tokenizer, prompt, self.clip_spec.max_position))
+
+    def encode_prompt_xl(self, prompt: str) -> tuple[jax.Array, jax.Array]:
+        """SDXL conditioning: (context [B, 77, d1+d2], pooled [B, d2]) —
+        both towers' PENULTIMATE hidden states concatenated on features,
+        pooled text embedding from CLIP-G's projection (ref: diffusers
+        StableDiffusionXLPipeline.encode_prompt)."""
+        h1, _, _ = clip_text_states(
+            self.clip_spec, self.text_tree,
+            self._ids(self.tokenizer, prompt, self.clip_spec.max_position))
+        h2, _, pooled = clip_text_states(
+            self.clip2_spec, self.text2_tree,
+            self._ids(self.tokenizer_2, prompt,
+                      self.clip2_spec.max_position))
+        return jnp.concatenate([h1, h2], axis=-1), pooled
 
     def _alphas_cumprod(self) -> jnp.ndarray:
         T = int(self.sched_cfg.get("num_train_timesteps", 1000))
@@ -593,16 +754,40 @@ class SDPipeline:
     def generate(self, prompt: str, negative_prompt: str = "",
                  height: int = 512, width: int = 512, steps: int = 20,
                  guidance: float = 7.5,
-                 seed: Optional[int] = None) -> np.ndarray:
-        """Returns a [height, width, 3] uint8 image."""
+                 seed: Optional[int] = None,
+                 init_image: Optional[np.ndarray] = None,
+                 strength: float = 0.5) -> np.ndarray:
+        """Returns a [height, width, 3] uint8 image. ``init_image``
+        ([H, W, 3] uint8) switches to img2img: the image is VAE-encoded,
+        renoised to ``strength`` (0..1, fraction of the schedule re-run)
+        and denoised — the frame-chaining primitive behind /video (ref:
+        diffusers img2img pipelines; backend.py GenerateVideo)."""
         # the latent grid must survive the UNet's downsamples
         snap = self.vae_scale * (2 ** (len(
             self.unet_spec.block_out_channels) - 1))
         height = max(snap, height // snap * snap)
         width = max(snap, width // snap * snap)
-        cond = self.encode_prompt(prompt)
-        uncond = self.encode_prompt(negative_prompt or "")
-        ctx = jnp.concatenate([uncond, cond], axis=0)  # [2, Tc, d]
+        if self.is_xl:
+            cond, pooled_c = self.encode_prompt_xl(prompt)
+            if not negative_prompt and self.force_zeros_for_empty_prompt:
+                # SDXL model_index flag: empty negative -> zero
+                # embeddings, matching StableDiffusionXLPipeline
+                uncond = jnp.zeros_like(cond)
+                pooled_u = jnp.zeros_like(pooled_c)
+            else:
+                uncond, pooled_u = self.encode_prompt_xl(
+                    negative_prompt or "")
+            ctx = jnp.concatenate([uncond, cond], axis=0)
+            # micro-conditioning: original/crop/target all = output size
+            tid = jnp.asarray(
+                [[height, width, 0, 0, height, width]], jnp.float32)
+            added = (jnp.concatenate([pooled_u, pooled_c], axis=0),
+                     jnp.concatenate([tid, tid], axis=0))
+        else:
+            cond = self.encode_prompt(prompt)
+            uncond = self.encode_prompt(negative_prompt or "")
+            ctx = jnp.concatenate([uncond, cond], axis=0)  # [2, Tc, d]
+            added = None
 
         T = int(self.sched_cfg.get("num_train_timesteps", 1000))
         offset = int(self.sched_cfg.get("steps_offset", 1))
@@ -622,10 +807,28 @@ class SDPipeline:
         lat_shape = (1, height // self.vae_scale,
                      width // self.vae_scale,
                      int(self.unet_spec.in_channels))
-        x = jax.random.normal(rng, lat_shape, jnp.float32)
+        if init_image is not None:
+            # img2img: encode, then jump into the schedule at step i0
+            img = jnp.asarray(init_image, jnp.float32) / 127.5 - 1.0
+            if img.ndim == 3:
+                img = img[None]
+            if img.shape[1:3] != (height, width):
+                # honor the height/width contract (and keep the UNet's
+                # stride-2 skip concats shape-safe for any init size)
+                img = jax.image.resize(
+                    img, (img.shape[0], height, width, img.shape[3]),
+                    "bilinear")
+            z0 = vae_encode(self.vae_tree, self.vae_cfg, img)
+            i0 = min(int(round(steps * (1.0 - strength))), steps - 1)
+            ts = ts[i0:]
+            a0 = alphas[ts[0]]
+            noise = jax.random.normal(rng, z0.shape, jnp.float32)
+            x = jnp.sqrt(a0) * z0 + jnp.sqrt(1.0 - a0) * noise
+        else:
+            x = jax.random.normal(rng, lat_shape, jnp.float32)
         img = _sd_sample_jit(
             self.unet_spec, self.unet_tree, self.vae_tree,
-            _freeze(self.vae_cfg), x, ctx, ts, alphas, final_alpha,
+            _freeze(self.vae_cfg), x, ctx, added, ts, alphas, final_alpha,
             float(guidance), bool(v_pred),
         )
         arr = np.asarray(img[0])
@@ -640,9 +843,10 @@ def _freeze(cfg: dict) -> tuple:
     ))
 
 
-@partial(jax.jit, static_argnums=(0, 3, 9, 10))
+@partial(jax.jit, static_argnums=(0, 3, 10, 11))
 def _sd_sample_jit(unet_spec: UNetSpec, unet_tree: dict, vae_tree: dict,
                    vae_cfg_frozen: tuple, x: jax.Array, ctx: jax.Array,
+                   added: Optional[tuple],
                    ts: jax.Array, alphas: jax.Array, final_alpha: jax.Array,
                    guidance: float, v_pred: bool) -> jax.Array:
     """Full guided DDIM loop + VAE decode in one compiled program."""
@@ -657,7 +861,7 @@ def _sd_sample_jit(unet_spec: UNetSpec, unet_tree: dict, vae_tree: dict,
         a_prev = jnp.where(i + 1 < steps, alphas[t_prev], final_alpha)
         xx = jnp.concatenate([x, x], axis=0)  # [uncond | cond]
         tb = jnp.full((2,), t, jnp.int32)
-        out = unet_forward(unet_spec, unet_tree, xx, tb, ctx)
+        out = unet_forward(unet_spec, unet_tree, xx, tb, ctx, added)
         out_u, out_c = out[:1], out[1:]
         out = out_u + guidance * (out_c - out_u)
         if v_pred:  # v = sqrt(a) eps - sqrt(1-a) x0
@@ -709,15 +913,37 @@ def consumed_keys_check(pipe: SDPipeline, prompt: str = "x") -> dict:
     report["text_encoder"] = [k for k in tree_keys(pipe.text_tree)
                               if k not in seen]
 
+    added = None
+    if pipe.is_xl:
+        seen = set()
+        ids2 = pipe.tokenizer_2(
+            prompt, padding="max_length",
+            max_length=pipe.clip2_spec.max_position, truncation=True,
+            return_tensors="np")["input_ids"].astype(np.int32)
+        h1, _, _ = clip_text_states(pipe.clip_spec, pipe.text_tree,
+                                    jnp.asarray(ids))
+        h2, _, pooled = clip_text_states(
+            pipe.clip2_spec, _RecDict(pipe.text2_tree, "", seen),
+            jnp.asarray(ids2))
+        report["text_encoder_2"] = [k for k in tree_keys(pipe.text2_tree)
+                                    if k not in seen]
+        cond = jnp.concatenate([h1, h2], axis=-1)
+        added = (pooled,
+                 jnp.asarray([[snap, snap, 0, 0, snap, snap]],
+                             jnp.float32))
+
     seen = set()
     lat = jnp.zeros((1, snap // pipe.vae_scale, snap // pipe.vae_scale,
                      int(pipe.unet_spec.in_channels)), jnp.float32)
     unet_forward(pipe.unet_spec, _RecDict(pipe.unet_tree, "", seen), lat,
-                 jnp.zeros((1,), jnp.int32), cond)
+                 jnp.zeros((1,), jnp.int32), cond, added)
     report["unet"] = [k for k in tree_keys(pipe.unet_tree)
                       if k not in seen]
 
     seen = set()
     vae_decode(_RecDict(pipe.vae_tree, "", seen), pipe.vae_cfg, lat)
+    if "encoder" in pipe.vae_tree:  # img2img/video reads the encoder too
+        vae_encode(_RecDict(pipe.vae_tree, "", seen), pipe.vae_cfg,
+                   jnp.zeros((1, snap, snap, 3), jnp.float32))
     report["vae"] = [k for k in tree_keys(pipe.vae_tree) if k not in seen]
     return report

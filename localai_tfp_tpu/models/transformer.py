@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .llm_spec import LLMSpec
+from .quant import QTensor as _QTensor
 from .quant import mm as _mm  # plain or int8-QTensor matmul
 
 Params = dict[str, jax.Array]
@@ -489,20 +490,40 @@ def _layer_inv_freqs(spec):
 
 
 def _embed_in(spec, params, tokens):
-    x = params["embed"][tokens]
+    emb = params["embed"]
+    if isinstance(emb, _QTensor):  # int8 table, per-row scales (quant.py)
+        dt = params["ln1_w"].dtype  # model compute dtype
+        x = (emb.q[tokens].astype(dt)
+             * emb.scale[tokens][..., None].astype(dt))
+    else:
+        x = emb[tokens]
     if spec.embedding_multiplier != 1.0:
         x = (x.astype(jnp.float32) * spec.embedding_multiplier).astype(x.dtype)
     return x
 
 
 def _lm_head(spec, params, x):
-    head = params["embed"].T if spec.tie_word_embeddings else params["lm_head"]
     prec = (
         lax.Precision.HIGHEST if x.dtype == jnp.float32
         else lax.Precision.DEFAULT
     )
-    logits = jnp.einsum("btd,dv->btv", x, head,
-                        preferred_element_type=jnp.float32, precision=prec)
+    head = params["embed"] if spec.tie_word_embeddings else params["lm_head"]
+    if isinstance(head, _QTensor):
+        # int8 head: both layouts carry a per-OUTPUT-logit scale [V]
+        # (tied = quantize_embed's per-row [V, D]; untied = standard
+        # per-out-channel [D, V]), so dequantization is one multiply on
+        # the f32 logits — the MXU reads 1 byte/elem.
+        eq = "btd,vd->btv" if spec.tie_word_embeddings else "btd,dv->btv"
+        logits = jnp.einsum(
+            eq, x, head.q.astype(x.dtype),
+            preferred_element_type=jnp.float32, precision=prec,
+        ) * head.scale.astype(jnp.float32)
+    else:
+        if spec.tie_word_embeddings:
+            head = head.T
+        logits = jnp.einsum("btd,dv->btv", x, head,
+                            preferred_element_type=jnp.float32,
+                            precision=prec)
     if "lm_head_b" in params:
         logits = logits + params["lm_head_b"].astype(jnp.float32)
     if spec.logit_softcap:
@@ -564,7 +585,7 @@ def forward_hidden(
         x, ck_all, cv_all, ks_all, vs_all = carry
         l, lp = scanned
         use_kernel = (decode_kernel and identity and x.shape[1] == 1
-                      and not quant and win is None)  # uniform windows only
+                      and win is None)  # uniform windows only
         if use_kernel:
             ck = cv = ks = vs = None  # kernel addresses the full cache
         else:
@@ -582,16 +603,30 @@ def forward_hidden(
             # carry scatters in place; single bf16 rows cannot be DMA'd
             # into the tiled HBM buffer from inside a kernel), then one
             # read-only kernel attends over each slot's VALID pages only
-            # (ragged reads — the decode bandwidth win).
+            # (ragged reads — the decode bandwidth win). int8 caches
+            # scatter quantized rows + per-row scales; the kernel
+            # dequantizes per page in VMEM (the bytes stay halved).
             from ..ops.decode_attention import fused_decode_attention
 
             kf = k.reshape(B, spec.kv_dim)
             vf = v.reshape(B, spec.kv_dim)
             rows = jnp.arange(B, dtype=jnp.int32)
+            if quant:
+                kq_row, ks_row = _quantize_rows(kf)  # int8 [B,F], f32 [B]
+                vq_row, vs_row = _quantize_rows(vf)
+            else:
+                kq_row, vq_row, ks_row, vs_row = kf, vf, None, None
             ck_new = ck_all.at[l, rows, pos0, :].set(
-                kf.astype(ck_all.dtype), mode="promise_in_bounds")
+                kq_row.astype(ck_all.dtype), mode="promise_in_bounds")
             cv_new = cv_all.at[l, rows, pos0, :].set(
-                vf.astype(cv_all.dtype), mode="promise_in_bounds")
+                vq_row.astype(cv_all.dtype), mode="promise_in_bounds")
+            if quant:
+                ks_new = ks_all.at[l, rows, pos0].set(
+                    ks_row, mode="promise_in_bounds")
+                vs_new = vs_all.at[l, rows, pos0].set(
+                    vs_row, mode="promise_in_bounds")
+            else:
+                ks_new = vs_new = None
             scale = (
                 1.0 / math.sqrt(spec.query_pre_attn_scalar)
                 if spec.query_pre_attn_scalar
@@ -601,7 +636,11 @@ def forward_hidden(
                 q[:, 0], kf, vf, ck_new, cv_new, l, pos0 + 1,
                 spec.n_kv_heads, scale=scale,
                 sliding_window=spec.sliding_window,
+                cache_k_scale=ks_new, cache_v_scale=vs_new,
             )
+            if quant:
+                return (out[:, None, :].astype(x.dtype),
+                        (ck_new, cv_new, ks_new, vs_new))
             return out[:, None, :].astype(x.dtype), (ck_new, cv_new)
 
         def kv_from_cache(k, v):
@@ -690,7 +729,10 @@ def forward_hidden(
         )
         if use_kernel:
             # the fused kernel updated the FULL stacked cache in place
-            ck_all, cv_all = out
+            if quant:
+                ck_all, cv_all, ks_all, vs_all = out
+            else:
+                ck_all, cv_all = out
         elif quant:
             ck2, cv2, ks2, vs2 = out
             ck_all = lax.dynamic_update_index_in_dim(ck_all, ck2, l, 0)

@@ -100,9 +100,14 @@ def extract_head_bands(out: jax.Array, n_kv_heads: int,
 
 
 def _fused_kernel(len_ref, layer_ref, wq_ref, newk_ref, newv_ref,
-                  ck_in, cv_in, out_ref,
-                  kbuf, vbuf, rsem, *,
-                  scale: float, sliding_window: Optional[int], page: int):
+                  ck_in, cv_in, *rest,
+                  scale: float, sliding_window: Optional[int], page: int,
+                  quantized: bool = False):
+    if quantized:
+        (ks_ref, vs_ref, out_ref, kbuf, vbuf, rsem) = rest
+    else:
+        out_ref, kbuf, vbuf, rsem = rest
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     layer = layer_ref[0]
     n = len_ref[b]  # valid length INCLUDING the current token
@@ -128,6 +133,21 @@ def _fused_kernel(len_ref, layer_ref, wq_ref, newk_ref, newv_ref,
                 vbuf.at[slot], rsem.at[slot, 1],
             ),
         )
+
+    def scale_col(sref, p):
+        """Page p's per-row scales as a (page, 1) column. The slot's
+        scale rows ride in VMEM as an auto-pipelined (n_pages, page)
+        block (DMA-slicing a single [L, S, SEQ] row trips second-minor
+        tiling alignment); the MXU contraction against a one-hot both
+        selects the page and transposes lanes -> sublanes, so no vector
+        relayout is ever emitted."""
+        mat = sref[0]  # [n_pages_total, page] f32
+        onehot = (jax.lax.broadcasted_iota(
+            jnp.int32, (mat.shape[0], 1), 0) == p).astype(jnp.float32)
+        return jax.lax.dot_general(
+            mat, onehot, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [page, 1]
 
     @pl.when(first_page < n_pages)
     def _():
@@ -163,11 +183,21 @@ def _fused_kernel(len_ref, layer_ref, wq_ref, newk_ref, newv_ref,
         kp, vp = get_dma(slot, p)
         kp.wait()
         vp.wait()
-        k = kbuf[slot]  # [page, F]
+        if quantized:
+            # int8 rows dequantize by a PER-ROW scale, which commutes
+            # through the row-wise contractions: the k scale multiplies
+            # logits on the row axis, and the v scale folds into pexp
+            # before the pv matmul — the MXU never reads a dequantized
+            # page from HBM.
+            k = kbuf[slot].astype(wq.dtype)  # [page, F]
+        else:
+            k = kbuf[slot]  # [page, F]
         logits = jax.lax.dot_general(
             k, wq, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [page, H]
+        if quantized:
+            logits = logits * scale_col(ks_ref, p)
         row = p * page + jax.lax.broadcasted_iota(
             jnp.int32, logits.shape, 0
         )
@@ -181,8 +211,13 @@ def _fused_kernel(len_ref, layer_ref, wq_ref, newk_ref, newv_ref,
         pexp = jnp.exp(logits - m_new)  # [page, H]
         pexp = jnp.where(valid, pexp, 0.0)
         l = l * alpha + jnp.sum(pexp, 0, keepdims=True)
+        if quantized:
+            pexp_v = pexp * scale_col(vs_ref, p)
+            vpage = vbuf[slot].astype(jnp.float32)
+        else:
+            pexp_v, vpage = pexp, vbuf[slot]
         pv = jax.lax.dot_general(
-            pexp, vbuf[slot], (((0,), (0,)), ((), ())),
+            pexp_v, vpage, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [H, F]
         acc = acc * alpha.T + pv
@@ -206,23 +241,45 @@ def fused_decode_attention(
     scale: float,
     sliding_window: Optional[int] = None,
     page: int = PAGE,
+    cache_k_scale: Optional[jax.Array] = None,  # [L, S, SEQ] f32 when the
+    # cache is int8 (per-row symmetric scales — models/transformer.py
+    # _quantize_rows; ref: llama.cpp cache_type_k/v q8_0)
+    cache_v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Ragged decode attention over ``[0, lengths)`` of layer ``layer``;
     the current token's K/V contribution is taken from ``new_k``/``new_v``
     in VMEM (its HBM copy is masked out). Returns attn [S, H*Dh]."""
     L, S, SEQ, F = cache_k.shape
     H = q.shape[1]
+    quantized = cache_k_scale is not None
     wq = build_block_diag_q(q, n_kv_heads)
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    in_specs = [
+        pl.BlockSpec((1, F, H), lambda b, lens, lay: (b, 0, 0)),
+        pl.BlockSpec((1, 1, F), lambda b, lens, lay: (b, 0, 0)),
+        pl.BlockSpec((1, 1, F), lambda b, lens, lay: (b, 0, 0)),
+        any_spec,  # cache_k (HBM)
+        any_spec,  # cache_v (HBM)
+    ]
+    operands = [lengths, layer[None], wq, new_k[:, None, :],
+                new_v[:, None, :], cache_k, cache_v]
+    if quantized:
+        # current layer's scale rows, paged [S, n_pages, page]: Pallas
+        # auto-pipelines each slot's block into VMEM (SEQ*4 bytes/slot)
+        npg = SEQ // page
+        ks_l = lax.dynamic_index_in_dim(
+            cache_k_scale, layer, 0, keepdims=False).reshape(S, npg, page)
+        vs_l = lax.dynamic_index_in_dim(
+            cache_v_scale, layer, 0, keepdims=False).reshape(S, npg, page)
+        in_specs += [
+            pl.BlockSpec((1, npg, page), lambda b, lens, lay: (b, 0, 0)),
+            pl.BlockSpec((1, npg, page), lambda b, lens, lay: (b, 0, 0)),
+        ]
+        operands += [ks_l, vs_l]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S,),
-        in_specs=[
-            pl.BlockSpec((1, F, H), lambda b, lens, lay: (b, 0, 0)),
-            pl.BlockSpec((1, 1, F), lambda b, lens, lay: (b, 0, 0)),
-            pl.BlockSpec((1, 1, F), lambda b, lens, lay: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),  # cache_k (HBM)
-            pl.BlockSpec(memory_space=pltpu.ANY),  # cache_v (HBM)
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, H, F), lambda b, lens, lay: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((2, page, F), cache_k.dtype),
@@ -231,15 +288,15 @@ def fused_decode_attention(
         ],
     )
     kernel = functools.partial(
-        _fused_kernel, scale=scale, sliding_window=sliding_window, page=page
+        _fused_kernel, scale=scale, sliding_window=sliding_window,
+        page=page, quantized=quantized,
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, H, F), jnp.float32),
         interpret=_interpret(),
-    )(lengths, layer[None], wq, new_k[:, None, :], new_v[:, None, :],
-      cache_k, cache_v)
+    )(*operands)
     return extract_head_bands(out, n_kv_heads, q.shape[2]).reshape(
         S, H * q.shape[2]
     )

@@ -25,13 +25,11 @@ Transports:
   * ``LocalChannel`` — in-process queue fan-out used by the test suite to
     prove leader/follower replay equivalence without a second process.
 
-Known limitation: lifecycle and engine records share ONE lockstep stream,
-and publish is a blocking collective. While a follower is inside a slow
-``load`` (minutes for a big checkpoint), the leader's next publish for an
-ALREADY-SERVING model waits until the follower returns to recv() — i.e.
-loading a second model pauses in-flight generation on the slice for the
-load duration. Per-model record streams (one broadcast channel per tag)
-are the planned fix if mixed-model multi-host serving becomes hot.
+Lifecycle and engine records share ONE lockstep stream, but a slow
+``load`` does NOT pause in-flight generation for other models:
+``FollowerRouter`` executes load records asynchronously (a load issues
+no cross-host collectives — see the invariant note on FollowerRouter)
+and rejoins the lockstep stream at the new model's first engine record.
 """
 
 from __future__ import annotations
@@ -123,12 +121,22 @@ class JaxBroadcastChannel:
         self.order_lock = threading.RLock()
 
     def publish(self, kind: str, payload: Any) -> None:
+        if in_follower_load():  # not assert: must survive python -O
+            raise RuntimeError(
+                "collective publish from inside an async follower load — "
+                "loads must stay collective-free (FollowerRouter "
+                "invariant)")
         hdr, buf = encode_record(kind, payload)
         with self.order_lock:
             self._mh.broadcast_one_to_all(hdr)
             self._mh.broadcast_one_to_all(buf)
 
     def recv(self) -> Record:
+        if in_follower_load():
+            raise RuntimeError(
+                "collective recv from inside an async follower load — "
+                "loads must stay collective-free (FollowerRouter "
+                "invariant)")
         # no timeout parameter by design: a collective cannot time out
         # partially — callers must not assume a bounded wait on this
         # transport (LocalFollowerEnd.recv does honor one, tests only)
@@ -142,6 +150,25 @@ class JaxBroadcastChannel:
 
 _CHANNEL: Optional[Any] = None
 _ROLE = "solo"  # solo | leader | follower
+
+# FollowerRouter's async-load safety rests on "a load issues no
+# cross-host collectives" — this thread-local marks follower-load
+# threads so the collective entry points can ASSERT the invariant
+# instead of trusting it (parallel/sharding.py checks it before any
+# multi-process resharding; the broadcast channel checks it on use).
+_load_tls = threading.local()
+
+
+def in_follower_load() -> bool:
+    return bool(getattr(_load_tls, "loading", False))
+
+
+class _follower_load_scope:
+    def __enter__(self):
+        _load_tls.loading = True
+
+    def __exit__(self, *exc):
+        _load_tls.loading = False
 
 
 def enable(channel: Any, role: str) -> None:
@@ -252,7 +279,8 @@ class FollowerRouter:
 
         def run() -> None:
             backend = self._make_backend()
-            res = backend.load_model(rec)
+            with _follower_load_scope():  # pins "no collectives in load"
+                res = backend.load_model(rec)
             if res.success:
                 self.failed.discard(tag)
                 self.backends[tag] = backend

@@ -72,10 +72,32 @@ TOKENS_SPEC = P("data", "seq")
 BATCH_SPEC = P("data")
 
 
+def _mesh_is_multiprocess(mesh: Mesh) -> bool:
+    pi = jax.process_index()
+    return any(d.process_index != pi for d in mesh.devices.flat)
+
+
+def _assert_load_collective_free(mesh: Mesh) -> None:
+    """Pin FollowerRouter's safety argument: an async follower load must
+    not issue cross-host collectives, and device_put onto a MULTI-PROCESS
+    mesh is exactly that (a compiled cross-host resharding). A future
+    loader change that reshards across hosts fails loudly here instead of
+    silently deadlocking the lockstep stream (parallel/multihost.py)."""
+    from . import multihost
+
+    if multihost.in_follower_load() and _mesh_is_multiprocess(mesh):
+        raise RuntimeError(
+            "cross-host resharding inside an async follower load would "
+            "violate the no-collectives-in-load invariant "
+            "(multihost.FollowerRouter)")
+
+
 def shard_engine_state(cache, sampling, mesh: Mesh):
     """Place the serving engine's device state on the mesh: KV cache rows
     over "data"/"model", per-slot sampler state over "data" (scalars and
     vocab-width rows follow their leading slot dim)."""
+    _assert_load_collective_free(mesh)
+
     def put(arr, spec):
         fixed = _divisible_spec(arr.shape, spec, mesh)
         return jax.device_put(arr, NamedSharding(mesh, fixed))
@@ -117,6 +139,7 @@ def shard_params(params: dict, mesh: Mesh) -> dict:
     per-output-channel scale on the matching output dim."""
     from ..models.quant import QTensor
 
+    _assert_load_collective_free(mesh)
     specs = param_specs(params)
     out = {}
     for name, arr in params.items():

@@ -138,14 +138,20 @@ class JaxDiffusionBackend(Backend):
         return cond[None]
 
     def _sample(self, prompt: str, negative: str, w: int, h: int,
-                steps: Optional[int], seed) -> np.ndarray:
+                steps: Optional[int], seed,
+                init: Optional[np.ndarray] = None,
+                strength: float = 0.5) -> np.ndarray:
+        """txt2img, or img2img when ``init`` ([H, W, 3] uint8) is given:
+        the init frame is encoded (VAE for real checkpoints, pixel space
+        for the toy fixture), renoised to ``strength`` and denoised —
+        the chaining primitive generate_video builds on."""
         if self._sd is not None:
             return self._sd.generate(
                 prompt, negative_prompt=negative, height=h, width=w,
                 steps=steps or self._steps,
                 guidance=self._guidance if self._guidance is not None
                 else 7.5,
-                seed=seed,
+                seed=seed, init_image=init, strength=strength,
             )
         # UNet downsamples len(channels) times; snap to the multiple
         mult = 2 ** len(self.spec.channels)
@@ -155,11 +161,20 @@ class JaxDiffusionBackend(Backend):
             seed if seed is not None else
             int.from_bytes(os.urandom(4), "little")
         )
-        img = ddim_sample(
-            self.spec, self.params, self._cond(prompt, negative), rng,
-            h, w, steps or self._steps,
-            self._guidance if self._guidance is not None else 3.0,
-        )
+        guidance = self._guidance if self._guidance is not None else 3.0
+        if init is not None:
+            from ..models.diffusion import ddim_img2img
+
+            init_arr = jnp.asarray(init, jnp.float32)[None] / 127.5 - 1.0
+            img = ddim_img2img(
+                self.spec, self.params, self._cond(prompt, negative), rng,
+                init_arr, steps or self._steps, guidance, strength,
+            )
+        else:
+            img = ddim_sample(
+                self.spec, self.params, self._cond(prompt, negative), rng,
+                h, w, steps or self._steps, guidance,
+            )
         arr = np.asarray(img[0])
         return ((arr + 1.0) * 127.5).clip(0, 255).astype(np.uint8)
 
@@ -175,9 +190,12 @@ class JaxDiffusionBackend(Backend):
 
     def generate_video(self, prompt: str = "", dst: str = "",
                        num_frames: Optional[int] = None, **kw) -> Result:
-        """Frame sequence with img2img chaining; emitted as animated-PNG-
-        style frame dump next to a JSON manifest (mp4 muxing via ffmpeg
-        when available — ref utils/ffmpeg.go)."""
+        """Temporally-coherent frame sequence: frame 0 is a txt2img
+        sample, every later frame is img2img-chained from its
+        predecessor (encode previous frame, renoise to ~0.45 strength,
+        denoise) — so consecutive frames evolve instead of re-rolling
+        (ref: diffusers GenerateVideo; core/backend/video.go). Muxed to
+        mp4 via ffmpeg when available (ref utils/ffmpeg.go)."""
         if self._state != "READY":
             return Result(False, "model not loaded")
         import subprocess
@@ -186,8 +204,11 @@ class JaxDiffusionBackend(Backend):
         frames_dir = dst + ".frames"
         os.makedirs(frames_dir, exist_ok=True)
         paths = []
+        prev: Optional[np.ndarray] = None
         for i in range(n):
-            img = self._sample(prompt, "", 128, 128, None, seed=i)
+            img = self._sample(prompt, "", 128, 128, None, seed=i,
+                               init=prev, strength=0.45)
+            prev = img
             p = os.path.join(frames_dir, f"f{i:04d}.png")
             write_png(p, img)
             paths.append(p)

@@ -78,13 +78,14 @@ class JaxLLMBackend(Backend):
             # milliseconds, before checkpoint IO and before the multihost
             # load broadcast fans the doomed load out to followers
             quant = (opts.quantization or "").lower()
-            if quant and quant not in ("int8", "q8", "q8_0", "w8", "none",
-                                       "f16", "fp16", "bf16", "bfloat16"):
+            if quant and quant not in ("int8", "q8", "q8_0", "w8",
+                                       "int8_full", "none", "f16", "fp16",
+                                       "bf16", "bfloat16"):
                 self._state = "ERROR"
                 return Result(
                     False,
                     f"load failed: unsupported quantization "
-                    f"'{opts.quantization}' (supported: int8)")
+                    f"'{opts.quantization}' (supported: int8, int8_full)")
             model_dir = opts.model
             if not os.path.isabs(model_dir):
                 model_dir = os.path.join(opts.model_path or "", model_dir)
@@ -170,13 +171,17 @@ class JaxLLMBackend(Backend):
                     (opts.kv_cache_dtype or opts.dtype or "bfloat16").lower(),
                     dtype,
                 )
-                self._quantized = quant in ("int8", "q8", "q8_0", "w8")
+                self._quantized = quant in ("int8", "q8", "q8_0", "w8",
+                                            "int8_full")
                 if self._quantized:
                     # AFTER LoRA merge: adapters fold into full-precision
-                    # weights first, then the projections quantize
+                    # weights first, then the projections quantize.
+                    # int8_full also quantizes embed/lm_head (~2 GB on an
+                    # 8B — the batch-64-on-one-chip mode)
                     from ..models.quant import quantize_params
 
-                    params = quantize_params(params)
+                    params = quantize_params(
+                        params, embeddings=quant == "int8_full")
                 mesh = None
                 if opts.mesh:
                     from ..parallel.mesh import make_mesh
@@ -277,7 +282,10 @@ class JaxLLMBackend(Backend):
         pix = np.stack([
             preprocess_image(b, mm["image_size"]) for b in images
         ])
-        dtype = self.engine.params["embed"].dtype
+        emb = self.engine.params["embed"]
+        dtype = emb.q.dtype if hasattr(emb, "q") else emb.dtype
+        if dtype == jnp.int8:  # quantized table: compute stays bf16
+            dtype = jnp.bfloat16
         soft_all = np.asarray(
             encode_images_jit(vspec, vparams,
                               jnp.asarray(pix).astype(dtype))

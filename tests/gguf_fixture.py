@@ -158,6 +158,122 @@ def enc_q6_k(d, scales, q) -> bytes:
     return bytes(out)
 
 
+def enc_q4_1(d, m, q) -> bytes:
+    """d/m [N] f32, q [N, 32] in [0, 15]; value = d*q + m."""
+    out = bytearray()
+    for i in range(len(d)):
+        out += np.float16(d[i]).tobytes() + np.float16(m[i]).tobytes()
+        u = np.asarray(q[i], np.uint8)
+        out += (u[:16] | (u[16:] << 4)).tobytes()
+    return bytes(out)
+
+
+def _pack_q5(q: np.ndarray) -> bytes:
+    """q [32] in [0, 31] -> qh u32 + 16 nibble bytes."""
+    u = np.asarray(q, np.uint32)
+    qh = np.uint32(0)
+    for j in range(16):
+        qh |= np.uint32((u[j] >> 4) & 1) << j
+        qh |= np.uint32((u[j + 16] >> 4) & 1) << (j + 16)
+    lo = (u[:16] & 0xF).astype(np.uint8)
+    hi = (u[16:] & 0xF).astype(np.uint8)
+    return qh.tobytes() + (lo | (hi << 4)).tobytes()
+
+
+def enc_q5_0(d, q) -> bytes:
+    """d [N] f32, q [N, 32] in [-16, 15]; value = d*q."""
+    out = bytearray()
+    for i in range(len(d)):
+        out += np.float16(d[i]).tobytes()
+        out += _pack_q5(np.asarray(q[i]) + 16)
+    return bytes(out)
+
+
+def enc_q5_1(d, m, q) -> bytes:
+    """q [N, 32] in [0, 31]; value = d*q + m."""
+    out = bytearray()
+    for i in range(len(d)):
+        out += np.float16(d[i]).tobytes() + np.float16(m[i]).tobytes()
+        out += _pack_q5(q[i])
+    return bytes(out)
+
+
+def _pack_2bit_qs(q: np.ndarray) -> bytes:
+    """q [256] values 0..3 in llama.cpp element order (half, shift, sub,
+    l) -> qs[64]."""
+    qe = np.asarray(q, np.uint8).reshape(2, 4, 2, 16)
+    qs = np.zeros((2, 32), np.uint8)
+    for h in range(2):
+        for j in range(4):
+            for sub in range(2):
+                qs[h, 16 * sub:16 * sub + 16] |= qe[h, j, sub] << (2 * j)
+    return qs.tobytes()
+
+
+def enc_q2_k(d, dmin, sc, mn, q) -> bytes:
+    """sc/mn [16] in [0,15] (scale idx = 8h+2j+sub), q [256] in [0,3];
+    value = d*sc*q - dmin*mn."""
+    scales = (np.asarray(sc, np.uint8) & 0xF) | \
+        (np.asarray(mn, np.uint8) << 4)
+    out = bytearray()
+    out += scales.tobytes()
+    out += _pack_2bit_qs(q)
+    out += np.float16(d).tobytes() + np.float16(dmin).tobytes()
+    return bytes(out)
+
+
+def enc_q3_k(d, scales, q) -> bytes:
+    """scales [16] in [-32, 31], q [256] in [-4, 3];
+    value = d * scales[8h+2j+sub] * q."""
+    qv = np.asarray(q, np.int32).reshape(2, 4, 2, 16)
+    hbit = (qv >= 0).astype(np.uint8)
+    base = np.where(qv >= 0, qv, qv + 4).astype(np.uint8)
+    hm = np.zeros((2, 16), np.uint8)  # [sub, l]
+    for h in range(2):
+        for j in range(4):
+            for sub in range(2):
+                hm[sub] |= hbit[h, j, sub] << (4 * h + j)
+    s = (np.asarray(scales, np.int32) + 32).astype(np.uint8)  # 6-bit
+    raw = np.zeros(12, np.uint8)
+    for k in range(4):
+        raw[k] = (s[k] & 0xF) | ((s[8 + k] & 0xF) << 4)
+        raw[4 + k] = (s[4 + k] & 0xF) | ((s[12 + k] & 0xF) << 4)
+        raw[8 + k] = ((s[k] >> 4) | ((s[4 + k] >> 4) << 2)
+                      | ((s[8 + k] >> 4) << 4) | ((s[12 + k] >> 4) << 6))
+    out = bytearray()
+    out += hm.tobytes()
+    out += _pack_2bit_qs(base.ravel())
+    out += raw.tobytes()
+    out += np.float16(d).tobytes()
+    return bytes(out)
+
+
+def enc_iq4_nl(d, idx) -> bytes:
+    """d [N] f32, idx [N, 32] kvalues indices 0..15."""
+    out = bytearray()
+    for i in range(len(d)):
+        out += np.float16(d[i]).tobytes()
+        u = np.asarray(idx[i], np.uint8)
+        out += (u[:16] | (u[16:] << 4)).tobytes()
+    return bytes(out)
+
+
+def enc_iq4_xs(d, scales, idx) -> bytes:
+    """scales [8] in [-32, 31] (one per 32-block), idx [256] in 0..15."""
+    s = (np.asarray(scales, np.int32) + 32).astype(np.uint32)
+    sh = np.uint16(0)
+    sl = np.zeros(4, np.uint8)
+    for k in range(8):
+        sh |= np.uint16(((s[k] >> 4) & 3) << (2 * k))
+        sl[k // 2] |= (s[k] & 0xF) << (4 * (k % 2))
+    u = np.asarray(idx, np.uint8).reshape(8, 32)
+    out = bytearray()
+    out += np.float16(d).tobytes() + sh.tobytes() + sl.tobytes()
+    for k in range(8):
+        out += (u[k, :16] | (u[k, 16:] << 4)).tobytes()
+    return bytes(out)
+
+
 def hf_to_gguf_permute(w: np.ndarray, n_head: int) -> np.ndarray:
     """convert_hf_to_gguf.py's Q/K permutation (HF rotate-half order ->
     gguf interleaved order). w [out, in]."""

@@ -116,10 +116,29 @@ def build_unet(dirpath: str) -> None:
         }, f)
 
 
-def build_vae(dirpath: str) -> None:
+def build_vae(dirpath: str, with_encoder: bool = False) -> None:
     os.makedirs(dirpath, exist_ok=True)
     t: dict[str, np.ndarray] = {}
     _conv(t, "post_quant_conv", LAT, LAT, k=1)
+    if with_encoder:  # img2img / video chaining reads the encoder
+        _conv(t, "quant_conv", 2 * LAT, 2 * LAT, k=1)
+        _conv(t, "encoder.conv_in", VAE_C[0], 3)
+        _resnet(t, "encoder.down_blocks.0.resnets.0", VAE_C[0], VAE_C[0],
+                temb=0)
+        _conv(t, "encoder.down_blocks.0.downsamplers.0.conv", VAE_C[0],
+              VAE_C[0])
+        _resnet(t, "encoder.down_blocks.1.resnets.0", VAE_C[0], VAE_C[1],
+                temb=0)
+        top = VAE_C[-1]
+        _resnet(t, "encoder.mid_block.resnets.0", top, top, temb=0)
+        _norm(t, "encoder.mid_block.attentions.0.group_norm", top)
+        _lin(t, "encoder.mid_block.attentions.0.to_q", top, top)
+        _lin(t, "encoder.mid_block.attentions.0.to_k", top, top)
+        _lin(t, "encoder.mid_block.attentions.0.to_v", top, top)
+        _lin(t, "encoder.mid_block.attentions.0.to_out.0", top, top)
+        _resnet(t, "encoder.mid_block.resnets.1", top, top, temb=0)
+        _norm(t, "encoder.conv_norm_out", top)
+        _conv(t, "encoder.conv_out", 2 * LAT, top)
     top = VAE_C[-1]
     _conv(t, "decoder.conv_in", top, LAT)
     _resnet(t, "decoder.mid_block.resnets.0", top, top, temb=0)
@@ -166,6 +185,78 @@ def build_text_encoder(dirpath: str) -> None:
     CLIPTextModel(cfg).save_pretrained(dirpath, safe_serialization=True)
 
 
+# SDXL tiny geometry: CLIP-G-class tower + added-cond UNet
+D2 = 48  # text_encoder_2 hidden size == its projection_dim
+ADD_T = 8  # addition_time_embed_dim
+
+
+def build_text_encoder_2(dirpath: str) -> None:
+    """A REAL tiny transformers CLIPTextModelWithProjection — SDXL's
+    CLIP-G-class second tower (gelu act, pooled text_projection)."""
+    import torch
+    from transformers import CLIPTextConfig, CLIPTextModelWithProjection
+
+    torch.manual_seed(1)
+    cfg = CLIPTextConfig(
+        vocab_size=96, hidden_size=D2, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=2,
+        max_position_embeddings=16, hidden_act="gelu",
+        projection_dim=D2, bos_token_id=0, eos_token_id=1,
+    )
+    CLIPTextModelWithProjection(cfg).save_pretrained(
+        dirpath, safe_serialization=True)
+
+
+def build_unet_xl(dirpath: str) -> None:
+    """SDXL-schema UNet at toy sizes: cross_attention_dim = D_COND + D2
+    (dual-tower concat), add_embedding over pooled (D2) + 6 sinusoidal
+    time ids (ADD_T each)."""
+    os.makedirs(dirpath, exist_ok=True)
+    d_cond = D_COND + D2
+    t: dict[str, np.ndarray] = {}
+    _conv(t, "conv_in", C[0], LAT)
+    _lin(t, "time_embedding.linear_1", TEMB, C[0])
+    _lin(t, "time_embedding.linear_2", TEMB, TEMB)
+    _lin(t, "add_embedding.linear_1", TEMB, D2 + 6 * ADD_T)
+    _lin(t, "add_embedding.linear_2", TEMB, TEMB)
+    _resnet(t, "down_blocks.0.resnets.0", C[0], C[0])
+    _attn_block(t, "down_blocks.0.attentions.0", C[0], d_cond)
+    _conv(t, "down_blocks.0.downsamplers.0.conv", C[0], C[0])
+    _resnet(t, "down_blocks.1.resnets.0", C[0], C[1])
+    _resnet(t, "mid_block.resnets.0", C[1], C[1])
+    _attn_block(t, "mid_block.attentions.0", C[1], d_cond)
+    _resnet(t, "mid_block.resnets.1", C[1], C[1])
+    _resnet(t, "up_blocks.0.resnets.0", C[1] + C[1], C[1])
+    _resnet(t, "up_blocks.0.resnets.1", C[1] + C[0], C[1])
+    _conv(t, "up_blocks.0.upsamplers.0.conv", C[1], C[1])
+    _resnet(t, "up_blocks.1.resnets.0", C[1] + C[0], C[0])
+    _attn_block(t, "up_blocks.1.attentions.0", C[0], d_cond)
+    _resnet(t, "up_blocks.1.resnets.1", C[0] + C[0], C[0])
+    _attn_block(t, "up_blocks.1.attentions.1", C[0], d_cond)
+    _norm(t, "conv_norm_out", C[0])
+    _conv(t, "conv_out", LAT, C[0])
+    from safetensors.numpy import save_file
+
+    save_file(t, os.path.join(dirpath,
+                              "diffusion_pytorch_model.safetensors"))
+    with open(os.path.join(dirpath, "config.json"), "w") as f:
+        json.dump({
+            "_class_name": "UNet2DConditionModel",
+            "block_out_channels": list(C),
+            "down_block_types": ["CrossAttnDownBlock2D", "DownBlock2D"],
+            "up_block_types": ["UpBlock2D", "CrossAttnUpBlock2D"],
+            "layers_per_block": 1,
+            "attention_head_dim": 2,
+            "cross_attention_dim": d_cond,
+            "in_channels": LAT,
+            "out_channels": LAT,
+            "norm_num_groups": GROUPS,
+            "addition_embed_type": "text_time",
+            "addition_time_embed_dim": ADD_T,
+            "projection_class_embeddings_input_dim": D2 + 6 * ADD_T,
+        }, f)
+
+
 def build_tokenizer(dirpath: str) -> None:
     """Minimal CLIP-style BPE vocab covering ascii letters (enough for
     test prompts), in the slow-tokenizer vocab.json + merges.txt form."""
@@ -180,13 +271,7 @@ def build_tokenizer(dirpath: str) -> None:
         f.write("#version: 0.2\n")
 
 
-def build_pipeline(root: str) -> str:
-    """Full tiny diffusers-format pipeline directory; returns root."""
-    os.makedirs(root, exist_ok=True)
-    build_unet(os.path.join(root, "unet"))
-    build_vae(os.path.join(root, "vae"))
-    build_text_encoder(os.path.join(root, "text_encoder"))
-    build_tokenizer(os.path.join(root, "tokenizer"))
+def _write_scheduler(root: str) -> None:
     os.makedirs(os.path.join(root, "scheduler"), exist_ok=True)
     with open(os.path.join(root, "scheduler",
                            "scheduler_config.json"), "w") as f:
@@ -198,6 +283,16 @@ def build_pipeline(root: str) -> str:
             "steps_offset": 1, "set_alpha_to_one": False,
             "prediction_type": "epsilon",
         }, f)
+
+
+def build_pipeline(root: str, with_vae_encoder: bool = False) -> str:
+    """Full tiny diffusers-format pipeline directory; returns root."""
+    os.makedirs(root, exist_ok=True)
+    build_unet(os.path.join(root, "unet"))
+    build_vae(os.path.join(root, "vae"), with_encoder=with_vae_encoder)
+    build_text_encoder(os.path.join(root, "text_encoder"))
+    build_tokenizer(os.path.join(root, "tokenizer"))
+    _write_scheduler(root)
     with open(os.path.join(root, "model_index.json"), "w") as f:
         json.dump({
             "_class_name": "StableDiffusionPipeline",
@@ -205,6 +300,32 @@ def build_pipeline(root: str) -> str:
             "vae": ["diffusers", "AutoencoderKL"],
             "text_encoder": ["transformers", "CLIPTextModel"],
             "tokenizer": ["transformers", "CLIPTokenizer"],
+            "scheduler": ["diffusers", "DDIMScheduler"],
+        }, f)
+    return root
+
+
+def build_pipeline_xl(root: str) -> str:
+    """Tiny SDXL-schema pipeline: dual towers, added-cond UNet, VAE with
+    encoder (img2img); returns root."""
+    os.makedirs(root, exist_ok=True)
+    build_unet_xl(os.path.join(root, "unet"))
+    build_vae(os.path.join(root, "vae"), with_encoder=True)
+    build_text_encoder(os.path.join(root, "text_encoder"))
+    build_text_encoder_2(os.path.join(root, "text_encoder_2"))
+    build_tokenizer(os.path.join(root, "tokenizer"))
+    build_tokenizer(os.path.join(root, "tokenizer_2"))
+    _write_scheduler(root)
+    with open(os.path.join(root, "model_index.json"), "w") as f:
+        json.dump({
+            "_class_name": "StableDiffusionXLPipeline",
+            "unet": ["diffusers", "UNet2DConditionModel"],
+            "vae": ["diffusers", "AutoencoderKL"],
+            "text_encoder": ["transformers", "CLIPTextModel"],
+            "text_encoder_2": ["transformers",
+                               "CLIPTextModelWithProjection"],
+            "tokenizer": ["transformers", "CLIPTokenizer"],
+            "tokenizer_2": ["transformers", "CLIPTokenizer"],
             "scheduler": ["diffusers", "DDIMScheduler"],
         }, f)
     return root

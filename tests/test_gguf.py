@@ -35,8 +35,9 @@ def test_header_metadata_and_f32_tensor(tmp_path):
     np.testing.assert_array_equal(gf.tensor("x.weight"), w)
 
 
-@pytest.mark.parametrize("case", ["f16", "q8_0", "q4_0", "q4_k", "q5_k",
-                                  "q6_k"])
+@pytest.mark.parametrize("case", ["f16", "q8_0", "q4_0", "q4_1", "q5_0",
+                                  "q5_1", "q2_k", "q3_k", "q4_k", "q5_k",
+                                  "q6_k", "iq4_nl", "iq4_xs"])
 def test_dequant_exact(tmp_path, case):
     rng = np.random.default_rng(hash(case) % 2**32)
     if case == "f16":
@@ -74,6 +75,59 @@ def test_dequant_exact(tmp_path, case):
             s = 2 * (i // 64) + (i % 64) // 32
             want[i] = (np.float32(d) * sc[s] * q[i]
                        - np.float32(dmin) * m[s])
+    elif case == "q4_1":
+        d = np.float16(rng.uniform(0.01, 0.1, 3)).astype(np.float32)
+        m = np.float16(rng.uniform(-0.5, 0.5, 3)).astype(np.float32)
+        q = rng.integers(0, 16, (3, 32))
+        raw, gt = fx.enc_q4_1(d, m, q), 3
+        want = (d[:, None] * q + m[:, None]).astype(np.float32).ravel()
+    elif case == "q5_0":
+        d = np.float16(rng.uniform(0.01, 0.1, 3)).astype(np.float32)
+        q = rng.integers(-16, 16, (3, 32))
+        raw, gt = fx.enc_q5_0(d, q), 6
+        want = (d[:, None] * q).astype(np.float32).ravel()
+    elif case == "q5_1":
+        d = np.float16(rng.uniform(0.01, 0.1, 3)).astype(np.float32)
+        m = np.float16(rng.uniform(-0.5, 0.5, 3)).astype(np.float32)
+        q = rng.integers(0, 32, (3, 32))
+        raw, gt = fx.enc_q5_1(d, m, q), 7
+        want = (d[:, None] * q + m[:, None]).astype(np.float32).ravel()
+    elif case == "q2_k":
+        d, dmin = np.float16(0.05), np.float16(0.01)
+        sc = rng.integers(0, 16, 16)
+        mn = rng.integers(0, 16, 16)
+        q = rng.integers(0, 4, 256)
+        raw, gt = fx.enc_q2_k(d, dmin, sc, mn, q), 10
+        want = np.empty(256, np.float32)
+        for i in range(256):
+            s = 8 * (i // 128) + 2 * ((i % 128) // 32) + (i % 32) // 16
+            want[i] = (np.float32(d) * sc[s] * q[i]
+                       - np.float32(dmin) * mn[s])
+    elif case == "q3_k":
+        d = np.float16(0.03)
+        scales = rng.integers(-32, 32, 16)
+        q = rng.integers(-4, 4, 256)
+        raw, gt = fx.enc_q3_k(d, scales, q), 11
+        want = np.empty(256, np.float32)
+        for i in range(256):
+            s = 8 * (i // 128) + 2 * ((i % 128) // 32) + (i % 32) // 16
+            want[i] = np.float32(d) * scales[s] * q[i]
+    elif case == "iq4_nl":
+        from localai_tfp_tpu.models.gguf import _IQ4_KVALUES
+
+        d = np.float16(rng.uniform(0.01, 0.1, 3)).astype(np.float32)
+        idx = rng.integers(0, 16, (3, 32))
+        raw, gt = fx.enc_iq4_nl(d, idx), 20
+        want = (d[:, None] * _IQ4_KVALUES[idx]).astype(np.float32).ravel()
+    elif case == "iq4_xs":
+        from localai_tfp_tpu.models.gguf import _IQ4_KVALUES
+
+        d = np.float16(0.02)
+        scales = rng.integers(-32, 32, 8)
+        idx = rng.integers(0, 16, 256)
+        raw, gt = fx.enc_iq4_xs(d, scales, idx), 23
+        want = (np.float32(d) * scales[np.arange(256) // 32]
+                * _IQ4_KVALUES[idx]).astype(np.float32)
     else:  # q6_k
         d = np.float16(0.04)
         scales = rng.integers(-30, 31, 16)

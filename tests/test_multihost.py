@@ -222,3 +222,60 @@ def test_follower_load_does_not_stall_other_model(model):
     le = next(ts for k, ts in trace if k == "load_end")
     during = [ts for k, ts in trace if k == "a_record" and ls < ts < le]
     assert during, "no A records replayed while B was loading (stalled)"
+
+
+def test_follower_load_collective_free_invariant(model):
+    """FollowerRouter's safety argument ("a load issues no cross-host
+    collectives") is ASSERTED, not assumed: the load thread is marked,
+    and (a) the broadcast channel refuses use from it, (b) shard_params /
+    shard_engine_state refuse a multi-process resharding from it."""
+    import time
+
+    from localai_tfp_tpu.models.transformer import KVCache, init_params
+    from localai_tfp_tpu.ops.sampling import SamplingState
+    from localai_tfp_tpu.parallel import sharding
+    from localai_tfp_tpu.parallel.mesh import make_mesh
+    from localai_tfp_tpu.workers.base import ModelLoadOptions, Result
+
+    spec, params, tk = model
+    seen: dict[str, bool] = {}
+    errors: list[Exception] = []
+    mesh = make_mesh({"data": 2, "seq": 1, "model": 4},
+                     devices=jax.devices("cpu"))
+
+    class _StubBackend:
+        def load_model(self, rec):
+            seen["flagged"] = multihost.in_follower_load()
+            # single-process mesh: allowed (no cross-host transfer)
+            sharding.shard_params(params, mesh)
+            # multi-process mesh: must refuse inside a follower load
+            orig = sharding._mesh_is_multiprocess
+            sharding._mesh_is_multiprocess = lambda m: True
+            try:
+                for fn in (
+                    lambda: sharding.shard_params(params, mesh),
+                    lambda: sharding.shard_engine_state(
+                        KVCache.create(spec, 2, 32, jnp.float32),
+                        SamplingState.create(2, spec.vocab_size), mesh),
+                ):
+                    try:
+                        fn()
+                        errors.append(AssertionError("no raise"))
+                    except RuntimeError:
+                        pass
+            finally:
+                sharding._mesh_is_multiprocess = orig
+            return Result(True, "ok")
+
+        def shutdown(self):
+            pass
+
+    router = multihost.FollowerRouter(make_backend=lambda: _StubBackend())
+    router.handle("load", ModelLoadOptions(model="X"))
+    deadline = time.time() + 30
+    while "flagged" not in seen and time.time() < deadline:
+        time.sleep(0.01)
+    router.shutdown()
+    assert seen.get("flagged") is True
+    assert not errors, errors
+    assert not multihost.in_follower_load()  # scope exited

@@ -67,6 +67,57 @@ def test_quantized_forward_tracks_full_precision():
     assert cos > 0.99, cos
 
 
+def test_quantized_embeddings_track_full_precision():
+    """embeddings=True also int8-quantizes embed (per-row scales) and
+    lm_head — the ~2 GB that moves an 8B from batch-16 to batch-64
+    serving on one chip."""
+    from localai_tfp_tpu.models.quant import quantize_embed
+
+    tk = ByteTokenizer()
+    spec = tiny_spec(vocab_size=tk.vocab_size, d_model=128, d_ff=256,
+                     n_heads=4, n_kv_heads=2, d_head=32,
+                     tie_word_embeddings=False)
+    params = init_params(jax.random.PRNGKey(0), spec, dtype=jnp.float32)
+    qparams = quantize_params(params, embeddings=True)
+    assert isinstance(qparams["embed"], QTensor)
+    assert qparams["embed"].scale.shape == (tk.vocab_size,)
+    assert isinstance(qparams["lm_head"], QTensor)
+
+    ids = np.asarray([[2, 9, 17, 33, 5, 80]], np.int32)
+    full, _ = forward(spec, params, jnp.asarray(ids),
+                      jnp.zeros((1,), jnp.int32),
+                      KVCache.create(spec, 1, 32, jnp.float32),
+                      jnp.zeros((1,), jnp.int32))
+    quant, _ = forward(spec, qparams, jnp.asarray(ids),
+                       jnp.zeros((1,), jnp.int32),
+                       KVCache.create(spec, 1, 32, jnp.float32),
+                       jnp.zeros((1,), jnp.int32))
+    a = np.asarray(full).reshape(-1)
+    b = np.asarray(quant).reshape(-1)
+    cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+    assert cos > 0.99, cos
+
+    # tied-embedding variant: the per-row scale applies per output logit
+    spec_t = tiny_spec(vocab_size=tk.vocab_size, d_model=128, d_ff=256,
+                       n_heads=4, n_kv_heads=2, d_head=32,
+                       tie_word_embeddings=True)
+    params_t = init_params(jax.random.PRNGKey(1), spec_t,
+                           dtype=jnp.float32)
+    q_t = dict(params_t, embed=quantize_embed(params_t["embed"]))
+    full_t, _ = forward(spec_t, params_t, jnp.asarray(ids),
+                        jnp.zeros((1,), jnp.int32),
+                        KVCache.create(spec_t, 1, 32, jnp.float32),
+                        jnp.zeros((1,), jnp.int32))
+    quant_t, _ = forward(spec_t, q_t, jnp.asarray(ids),
+                         jnp.zeros((1,), jnp.int32),
+                         KVCache.create(spec_t, 1, 32, jnp.float32),
+                         jnp.zeros((1,), jnp.int32))
+    a = np.asarray(full_t).reshape(-1)
+    b = np.asarray(quant_t).reshape(-1)
+    cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+    assert cos > 0.99, cos
+
+
 def test_engine_serves_quantized_weights():
     tk = ByteTokenizer()
     spec = tiny_spec(vocab_size=tk.vocab_size, max_position=512)
@@ -132,8 +183,21 @@ def test_worker_quantization_knob(tmp_path):
         quantization="int8"))
     assert res.success, res.message
     assert isinstance(b.engine.params["wq"], QTensor)
+    assert not isinstance(b.engine.params["embed"], QTensor)
     with pytest.raises(RuntimeError):
         b.apply_lora(str(d))
+    # int8_full also quantizes embed/lm_head and still generates
+    bf = JaxLLMBackend()
+    res = bf.load_model(ModelLoadOptions(
+        model=str(d), context_size=128, batch_slots=2, dtype="float32",
+        quantization="int8_full"))
+    assert res.success, res.message
+    assert isinstance(bf.engine.params["embed"], QTensor)
+    from localai_tfp_tpu.workers.base import PredictOptions
+
+    out = bf.predict(PredictOptions(prompt="ab", tokens=4,
+                                    ignore_eos=True))
+    assert out.message is not None and out.tokens == 4
     b2 = JaxLLMBackend()
     res = b2.load_model(ModelLoadOptions(
         model=str(d), context_size=128, batch_slots=2,
