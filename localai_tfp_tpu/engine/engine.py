@@ -87,6 +87,21 @@ class GenRequest:
     id: str = field(default_factory=lambda: uuid.uuid4().hex)
 
 
+class _PadReq:
+    """Neutral sampler params for prefill-group pad rows (their state
+    writes target the out-of-bounds sentinel slot and are dropped)."""
+
+    temperature = 0.0
+    top_k = 0
+    top_p = 1.0
+    min_p = 0.0
+    repeat_penalty = 0.0
+    frequency_penalty = 0.0
+    presence_penalty = 0.0
+    repeat_last_n = 0
+    seed = None
+
+
 @dataclass
 class StreamEvent:
     """Streamed to the caller per emitted text span; final carries stats."""
@@ -324,7 +339,8 @@ class LLMEngine:
             # slot_ids=None: decode batches every cache row in order, so the
             # KV write is a per-row DUS, not a cache-sized scatter
             logits, cache = forward(
-                spec, params, tokens, pos0, cache, None, self._use_kernel
+                spec, params, tokens, pos0, cache, None, self._use_kernel,
+                mesh=self.mesh,
             )
             last = logits[:, -1, :]
             toks, sampling = _sample_masked(sampling, slot_ids, last,
@@ -375,9 +391,19 @@ class LLMEngine:
         from ..models.transformer import _layer_windows
 
         forced = env in ("1", "true", "on")
+        if self.mesh is not None:
+            # meshed serving runs the kernel per-shard under shard_map
+            # (ops.decode_attention.sharded_append_attend); shapes must
+            # split evenly over the mesh axes
+            from ..ops.decode_attention import mesh_kernel_eligible
+
+            if not mesh_kernel_eligible(
+                self.mesh, self.spec.n_kv_heads, self.spec.n_heads,
+                self.spec.kv_dim, self.n_slots,
+            ):
+                return False
         return (
             (forced or not _interpret())
-            and self.mesh is None  # kernels need shard_map under a mesh
             and self.max_seq % PAGE == 0
             and self.spec.kv_dim % 128 == 0
             and not self.spec.attn_logit_softcap
@@ -596,7 +622,8 @@ class LLMEngine:
 
         @partial(jax.jit, donate_argnums=(2, 4))
         def _prefill_final(params, tokens, cache, pos0, sampling, slot_ids,
-                           n_chunk, tails, tail_lens, masks, soft=None):
+                           n_chunk, tails, tail_lens, masks, reset,
+                           soft=None):
             if soft is not None:
                 soft = _soft_expand(tokens, *soft)
             win, restore = _window_cache(cache, window)
@@ -604,6 +631,12 @@ class LLMEngine:
                 spec, params, tokens, pos0, win, slot_ids, soft=soft
             )
             cache = restore(win)
+            # sampler reset rides THIS dispatch (admission used to pay a
+            # separate reset_batch round trip before the prefill — one
+            # full tunnel RTT off TTFT for singles and waves alike)
+            from ..ops.sampling import reset_slots
+
+            sampling = reset_slots(sampling, slot_ids, *reset)
             # closed-form penalty-window seed (scan-equivalent; the W
             # sequential scatter steps dominated this dispatch's time)
             sampling = seed_windows(sampling, slot_ids, tails, tail_lens)
@@ -774,7 +807,8 @@ class LLMEngine:
             def step(carry, _):
                 tokens, pos, cache, sampling = carry
                 logits, cache = forward(
-                    spec, params, tokens, pos, cache, None, self._use_kernel
+                    spec, params, tokens, pos, cache, None, self._use_kernel,
+                    mesh=self.mesh,
                 )
                 toks, sampling = _sample_masked(
                     sampling, slot_ids, logits[:, -1, :], active, None
@@ -820,16 +854,6 @@ class LLMEngine:
         """Device-only work for one dispatch record. MUST be fully
         determined by (kind, payload) + engine construction — no reads of
         leader-side scheduler state — so follower replay stays lockstep."""
-        if kind == "reset_batch":
-            from ..ops.sampling import reset_slots
-
-            self.sampling = reset_slots(
-                self.sampling, *(jnp.asarray(p[k]) for k in (
-                    "slot_ids", "temperature", "top_k", "top_p", "min_p",
-                    "repeat_penalty", "freq_penalty", "presence_penalty",
-                    "repeat_last_n", "seeds", "has_seed")),
-            )
-            return None
         if kind == "prefill":
             toks = jnp.asarray(p["toks"])
             pos0 = jnp.asarray(p["pos0"])
@@ -850,11 +874,15 @@ class LLMEngine:
             sids = jnp.asarray(p["slot_ids"])
             masks = _unpack_masks(p["masks"])
             soft = self._soft_dense(p.get("soft"), *p["toks"].shape)
+            reset = tuple(jnp.asarray(p["reset"][k]) for k in (
+                "temperature", "top_k", "top_p", "min_p",
+                "repeat_penalty", "freq_penalty", "presence_penalty",
+                "repeat_last_n", "seeds", "has_seed"))
             toks_out, self.cache, self.sampling = self._prefill_final_fn(
                 p.get("window", self.max_seq))(
                 self.params, toks, self.cache, pos0, self.sampling, sids,
                 jnp.asarray(p["n_chunk"]), jnp.asarray(p["tails"]),
-                jnp.asarray(p["tail_lens"]), masks, soft,
+                jnp.asarray(p["tail_lens"]), masks, reset, soft,
             )
             if self.draft is not None:
                 self.draft_cache = self._draft_prefill_fn()(
@@ -1089,31 +1117,23 @@ class LLMEngine:
                 continue
             self._assign(slot, req, out)
             assigned.append(slot)
-        if assigned:
-            self._dispatch_resets(assigned)
 
-    def _dispatch_resets(self, slots: list[_Slot]) -> None:
-        """One batched sampler-reset dispatch for an admission wave
-        (per-slot resets cost ~25ms each through a tunneled chip). Rows
-        are padded to a power of two with row 0 repeated — identical
-        values keep the duplicate-index scatter deterministic."""
-        K = 1 << max(len(slots) - 1, 0).bit_length()
-        first = slots[0].request
-        assert first is not None
-
-        def row(i):
-            s = slots[i] if i < len(slots) else slots[0]
-            r = s.request
-            assert r is not None
-            return s.idx, r
+    def _reset_columns(self, group: list[_Slot], pad_to: int) -> dict:
+        """Per-slot sampler-reset columns for a prefill_final group. The
+        reset rides the prefill dispatch (a separate reset_batch dispatch
+        cost one extra tunnel RTT per admission wave — measured directly
+        on burst TTFT). Rows beyond ``len(group)`` pad with zeros; their
+        scatter targets the out-of-bounds sentinel slot, so the writes
+        are dropped."""
+        W = self.sampling.window
         cols: dict[str, list] = {k: [] for k in (
-            "slot_ids", "temperature", "top_k", "top_p", "min_p",
+            "temperature", "top_k", "top_p", "min_p",
             "repeat_penalty", "freq_penalty", "presence_penalty",
             "repeat_last_n", "seeds", "has_seed")}
-        W = self.sampling.window
-        for i in range(K):
-            idx, r = row(i)
-            cols["slot_ids"].append(idx)
+        pad = _PadReq()
+        for s in list(group) + [None] * (pad_to - len(group)):
+            r = s.request if s is not None else pad
+            assert r is not None
             cols["temperature"].append(r.temperature)
             cols["top_k"].append(r.top_k)
             cols["top_p"].append(r.top_p)
@@ -1129,8 +1149,7 @@ class LLMEngine:
             cols["seeds"].append(seed - (1 << 32) if seed >= (1 << 31)
                                  else seed)
             cols["has_seed"].append(r.seed is not None)
-        self._run("reset_batch", {
-            "slot_ids": np.asarray(cols["slot_ids"], np.int32),
+        return {
             "temperature": np.asarray(cols["temperature"], np.float32),
             "top_k": np.asarray(cols["top_k"], np.int32),
             "top_p": np.asarray(cols["top_p"], np.float32),
@@ -1142,7 +1161,7 @@ class LLMEngine:
             "repeat_last_n": np.asarray(cols["repeat_last_n"], np.int32),
             "seeds": np.asarray(cols["seeds"], np.int32),
             "has_seed": np.asarray(cols["has_seed"], bool),
-        })
+        }
 
     def _pick_slot(self, req: GenRequest) -> Optional[_Slot]:
         free = [s for s in self.slots if not s.active]
@@ -1319,16 +1338,21 @@ class LLMEngine:
     def _prefill_final_step(self, group: list[_Slot], bucket: int) -> None:
         """Finish a batch of same-bucket prompts: one fused dispatch runs
         the final chunks, seeds the penalty windows, and samples each
-        slot's first token (group size rounded down to a power of two to
-        bound the jit-shape cache; the remainder goes next iteration)."""
-        B = 1 << (len(group).bit_length() - 1)
-        group = group[:B]
+        slot's first token. The group is padded UP to a power of two with
+        sentinel rows pointing at the out-of-bounds slot id ``n_slots``:
+        JAX drops out-of-bounds scatter updates and clamps out-of-bounds
+        gathers, so a pad row is pure discarded compute that never
+        touches engine state. (Rounding DOWN and deferring the remainder
+        — the previous scheme — turned one ragged 63-request wave into
+        SIX dispatches of six distinct jit shapes; under HTTP arrival
+        raggedness that compile churn collapsed endpoint throughput.)"""
+        B = 1 << max(len(group) - 1, 0).bit_length()
         t0 = time.perf_counter()
         W = self.sampling.window
         toks = np.zeros((B, bucket), np.int32)
         pos0 = np.zeros((B,), np.int32)
-        slot_ids = np.zeros((B,), np.int32)
-        n_chunk = np.zeros((B,), np.int32)
+        slot_ids = np.full((B,), self.n_slots, np.int32)  # OOB sentinel
+        n_chunk = np.ones((B,), np.int32)
         tails = np.zeros((B, W), np.int32)
         tail_lens = np.zeros((B,), np.int32)
         for r, s in enumerate(group):
@@ -1342,10 +1366,14 @@ class LLMEngine:
             tails[r, : len(tail)] = tail
             tail_lens[r] = len(tail)
         masks = self._constraint_mask_rows(group)
+        if masks is not None and B > len(group):
+            masks = np.vstack(
+                [masks, np.ones((B - len(group), masks.shape[1]), bool)])
         toks_out = self._run("prefill_final", {
             "toks": toks, "pos0": pos0, "slot_ids": slot_ids,
             "n_chunk": n_chunk, "tails": tails, "tail_lens": tail_lens,
             "masks": masks,
+            "reset": self._reset_columns(group, B),
             "soft": self._soft_payload(group, pos0, bucket),
             "window": self._window_bucket(int(pos0.max()) + bucket),
         })
@@ -1560,12 +1588,29 @@ class LLMEngine:
             # discarded like any mid-scan finish.
             epoch0 = self._epoch
             akey = active.tobytes()
-            batches = self._run("decodek", {
-                "k": k, "window": window, "depth": depth,
-                "carry": (self._dev_epoch == self._epoch
-                          and self._dev_akey == akey),
-                "tokens": tokens, "pos0": pos0, "active": active,
-            })
+            batches = []
+            free_slots = any(not s.active for s in self.slots)
+            for d in range(depth):
+                if d and free_slots:
+                    # an arriving request COULD be admitted (free slot):
+                    # wait for the in-flight scan to actually finish —
+                    # JAX dispatch is async, so checking _pending right
+                    # after enqueueing would race ahead of the scan —
+                    # and skip the chained scan if one arrived, so its
+                    # prefill isn't stuck behind k more steps (burst
+                    # TTFT). With every slot busy (the saturated case)
+                    # the chained scan is enqueued immediately and the
+                    # dispatch pipeline stays full.
+                    while not (batches[-1].is_ready() or self._pending):
+                        time.sleep(2e-4)
+                    if self._pending:
+                        break
+                batches += self._run("decodek", {
+                    "k": k, "window": window, "depth": 1,
+                    "carry": d > 0 or (self._dev_epoch == self._epoch
+                                       and self._dev_akey == akey),
+                    "tokens": tokens, "pos0": pos0, "active": active,
+                })
             emitted = 0
             prev_last = {s.idx: int(tokens[s.idx, 0]) for s in decoding}
             t_prev = t0

@@ -191,55 +191,22 @@ def unet(spec: DiffusionSpec, params: dict, x: jax.Array, t: jax.Array,
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnums=(0, 4, 5, 6))
-def ddim_sample(spec: DiffusionSpec, params: dict, cond: jax.Array,
-                rng: jax.Array, height: int, width: int,
-                steps: int = 20, guidance: float = 3.0) -> jax.Array:
-    """Classifier-free-guided DDIM; the whole sampler is one lax.scan."""
-    B = cond.shape[0]
+def _ddim_schedule(spec: DiffusionSpec, steps: int):
+    """(alphas over the training schedule, descending sample timesteps)."""
     betas = jnp.linspace(1e-4, 0.02, spec.steps_train)
     alphas = jnp.cumprod(1.0 - betas)
     ts = jnp.linspace(spec.steps_train - 1, 0, steps).astype(jnp.int32)
-    x = jax.random.normal(rng, (B, height, width, spec.img_channels))
-    uncond = jnp.zeros_like(cond)
-
-    def step(x, i):
-        t = ts[i]
-        t_prev = jnp.where(i + 1 < steps, ts[jnp.minimum(i + 1, steps - 1)], 0)
-        a_t = alphas[t]
-        a_prev = jnp.where(i + 1 < steps, alphas[t_prev], 1.0)
-        tb = jnp.full((B,), t)
-        eps_c = unet(spec, params, x, tb, cond)
-        eps_u = unet(spec, params, x, tb, uncond)
-        eps = eps_u + guidance * (eps_c - eps_u)
-        x0 = (x - jnp.sqrt(1 - a_t) * eps) / jnp.sqrt(a_t)
-        x0 = jnp.clip(x0, -1.5, 1.5)
-        x = jnp.sqrt(a_prev) * x0 + jnp.sqrt(1 - a_prev) * eps
-        return x, None
-
-    x, _ = lax.scan(step, x, jnp.arange(steps))
-    return jnp.clip(x, -1, 1)
+    return alphas, ts
 
 
-@partial(jax.jit, static_argnums=(0, 5, 6, 7))
-def ddim_img2img(spec: DiffusionSpec, params: dict, cond: jax.Array,
-                 rng: jax.Array, init: jax.Array, steps: int = 20,
-                 guidance: float = 3.0,
-                 strength: float = 0.5) -> jax.Array:
-    """img2img for the toy pixel-space pipeline: renoise ``init``
-    ([B, H, W, C] in [-1, 1]) to ``strength`` of the schedule and
-    denoise from there — the frame-chaining primitive the video worker
-    uses (real checkpoints chain through the VAE in models/sd.py)."""
+def _ddim_denoise(spec: DiffusionSpec, params: dict, cond: jax.Array,
+                  x: jax.Array, ts: jax.Array, alphas: jax.Array,
+                  guidance: float) -> jax.Array:
+    """Classifier-free-guided DDIM denoise over timesteps ``ts`` — the
+    shared core of txt2img (full schedule) and img2img (tail of the
+    schedule); the whole loop is one lax.scan."""
     B = cond.shape[0]
-    betas = jnp.linspace(1e-4, 0.02, spec.steps_train)
-    alphas = jnp.cumprod(1.0 - betas)
-    full = jnp.linspace(spec.steps_train - 1, 0, steps).astype(jnp.int32)
-    i0 = min(int(round(steps * (1.0 - strength))), steps - 1)
-    ts = full[i0:]
     n = ts.shape[0]
-    a0 = alphas[ts[0]]
-    noise = jax.random.normal(rng, init.shape)
-    x = jnp.sqrt(a0) * init + jnp.sqrt(1.0 - a0) * noise
     uncond = jnp.zeros_like(cond)
 
     def step(x, i):
@@ -258,3 +225,33 @@ def ddim_img2img(spec: DiffusionSpec, params: dict, cond: jax.Array,
 
     x, _ = lax.scan(step, x, jnp.arange(n))
     return jnp.clip(x, -1, 1)
+
+
+@partial(jax.jit, static_argnums=(0, 4, 5, 6))
+def ddim_sample(spec: DiffusionSpec, params: dict, cond: jax.Array,
+                rng: jax.Array, height: int, width: int,
+                steps: int = 20, guidance: float = 3.0) -> jax.Array:
+    """txt2img: denoise pure noise over the full schedule."""
+    B = cond.shape[0]
+    alphas, ts = _ddim_schedule(spec, steps)
+    x = jax.random.normal(rng, (B, height, width, spec.img_channels))
+    return _ddim_denoise(spec, params, cond, x, ts, alphas, guidance)
+
+
+@partial(jax.jit, static_argnums=(0, 5, 6, 7))
+def ddim_img2img(spec: DiffusionSpec, params: dict, cond: jax.Array,
+                 rng: jax.Array, init: jax.Array, steps: int = 20,
+                 guidance: float = 3.0,
+                 strength: float = 0.5) -> jax.Array:
+    """img2img for the toy pixel-space pipeline: renoise ``init``
+    ([B, H, W, C] in [-1, 1]) to ``strength`` of the schedule and
+    denoise over the tail — the frame-chaining primitive the video
+    worker uses (real checkpoints chain through the VAE in models/sd.py).
+    txt2img is exactly the strength=1.0 limit of this path."""
+    alphas, full = _ddim_schedule(spec, steps)
+    i0 = min(int(round(steps * (1.0 - strength))), steps - 1)
+    ts = full[i0:]
+    a0 = alphas[ts[0]]
+    noise = jax.random.normal(rng, init.shape)
+    x = jnp.sqrt(a0) * init + jnp.sqrt(1.0 - a0) * noise
+    return _ddim_denoise(spec, params, cond, x, ts, alphas, guidance)

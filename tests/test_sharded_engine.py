@@ -76,6 +76,37 @@ def test_sharded_engine_concurrent_slots():
         eng.close()
 
 
+def test_sharded_engine_kernel_path_matches(monkeypatch):
+    """The Pallas decode kernel keeps the fast path under a mesh: the
+    per-shard shard_map kernel (ops.decode_attention.sharded_append_attend)
+    must reproduce the unmeshed kernel engine's greedy tokens exactly —
+    attention is GQA-head-local, so sharding heads over "model" changes
+    nothing about any head's arithmetic. Covers bf16 and int8 caches
+    (int8 also exercises the replicated-scale-buffer invariant)."""
+    spec = tiny_spec(n_heads=4, n_kv_heads=2, d_head=128)
+    params = init_params(jax.random.PRNGKey(2), spec, dtype=jnp.float32)
+    tok = ByteTokenizer()
+    mesh = make_mesh({"data": 2, "seq": 1, "model": 2},
+                     devices=jax.devices("cpu")[:4])
+    monkeypatch.setenv("LOCALAI_DECODE_KERNEL", "1")
+    for cache_dtype in (jnp.float32, "int8"):
+        plain = LLMEngine(spec, params, tok, n_slots=2, max_seq=256,
+                          cache_dtype=cache_dtype, autostart=False)
+        sharded = LLMEngine(spec, params, tok, n_slots=2, max_seq=256,
+                            cache_dtype=cache_dtype, mesh=mesh,
+                            autostart=False)
+        assert plain._use_kernel and sharded._use_kernel
+        plain.start()
+        sharded.start()
+        try:
+            a = _run(plain)
+            b = _run(sharded)
+            assert a == b and len(a) > 0
+        finally:
+            plain.close()
+            sharded.close()
+
+
 def test_moe_expert_parallel_forward():
     """Mixtral-class MoE with experts sharded over the model axis (EP):
     sharded forward must equal the single-device forward."""
