@@ -1,0 +1,704 @@
+"""Kokoro (StyleTTS2-derived) TTS in JAX.
+
+The reference ships a dedicated kokoro worker that is a thin wrapper over
+the `kokoro` library: load the StyleTTS2-class model + a voicepack tensor
+(with "voice1+voice2" mean blending), synthesize 22-24 kHz audio
+(/root/reference/backend/python/kokoro/backend.py:46-100). This module is
+the from-scratch JAX implementation of that model family's inference
+graph (Kokoro v0.19 architecture):
+
+    tokens -> PLBERT (ALBERT encoder) -> bert_encoder linear
+           -> DurationEncoder (+ style)   -> per-token durations
+           -> alignment expansion         -> prosody F0/N curves
+    tokens -> TextEncoder (convs + biLSTM) -> aligned ASR features
+    (asr, F0, N, style) -> Decoder (AdaIN residual stacks)
+                        -> iSTFTNet Generator (harmonic source + snake
+                           resblocks + inverse STFT head)
+
+Parameters are kept under their torch state-dict names (weight-norm
+tensors folded at import), so the importer is a direct tensor convert of
+the official checkpoint layout `{"net": {bert, bert_encoder, predictor,
+text_encoder, decoder}}` with optional DataParallel "module." prefixes.
+Voicepacks are `[N, 1, 2*style_dim]` tensors indexed by token count;
+the first half styles the decoder, the second half the predictor.
+
+All forwards are B=1 float32 (TTS is latency-, not throughput-bound; a
+whole utterance is one jit). Torch parity is pinned module-by-module in
+tests/test_kokoro.py against reference torch modules.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+LRELU_GEN = 0.1  # generator leaky-relu slope (hifigan convention)
+LRELU = 0.2  # everywhere else in StyleTTS2
+
+
+@dataclass(frozen=True)
+class KokoroSpec:
+    n_token: int = 178
+    hidden_dim: int = 512
+    style_dim: int = 128
+    max_dur: int = 50
+    n_layer: int = 3  # text-encoder conv depth AND duration-encoder depth
+    text_encoder_kernel_size: int = 5
+    # plbert (ALBERT) dims
+    plbert_vocab: int = 178
+    plbert_hidden: int = 768
+    plbert_embedding: int = 128
+    plbert_heads: int = 12
+    plbert_layers: int = 12
+    plbert_intermediate: int = 2048
+    plbert_max_position: int = 512
+    # istftnet generator
+    upsample_rates: tuple = (10, 6)
+    upsample_kernel_sizes: tuple = (20, 12)
+    upsample_initial_channel: int = 512
+    resblock_kernel_sizes: tuple = (3, 7, 11)
+    resblock_dilation_sizes: tuple = ((1, 3, 5), (1, 3, 5), (1, 3, 5))
+    gen_istft_n_fft: int = 20
+    gen_istft_hop_size: int = 5
+    decoder_hidden: int = 1024  # AdainResBlk width inside the decoder
+    asr_res_dim: int = 64
+    sampling_rate: int = 24000
+    harmonic_num: int = 8
+    sine_amp: float = 0.1
+    noise_std: float = 0.003
+    voiced_threshold: float = 10.0
+
+    @property
+    def total_upsample(self) -> int:
+        r = self.gen_istft_hop_size
+        for u in self.upsample_rates:
+            r *= u
+        return r
+
+
+def spec_from_config(cfg: dict) -> KokoroSpec:
+    """Map a Kokoro-82M-style config.json onto KokoroSpec."""
+    ist = cfg.get("istftnet") or cfg.get("decoder") or {}
+    pl = cfg.get("plbert") or {}
+    kw = dict(
+        n_token=cfg.get("n_token", 178),
+        hidden_dim=cfg.get("hidden_dim", 512),
+        style_dim=cfg.get("style_dim", 128),
+        max_dur=cfg.get("max_dur", 50),
+        n_layer=cfg.get("n_layer", 3),
+        text_encoder_kernel_size=cfg.get("text_encoder_kernel_size", 5),
+        plbert_vocab=pl.get("vocab_size", cfg.get("n_token", 178)),
+        plbert_hidden=pl.get("hidden_size", 768),
+        plbert_embedding=pl.get("embedding_size", 128),
+        plbert_heads=pl.get("num_attention_heads", 12),
+        plbert_layers=pl.get("num_hidden_layers", 12),
+        plbert_intermediate=pl.get("intermediate_size", 2048),
+        plbert_max_position=pl.get("max_position_embeddings", 512),
+    )
+    for k_json, k_spec in (
+        ("upsample_rates", "upsample_rates"),
+        ("upsample_kernel_sizes", "upsample_kernel_sizes"),
+        ("upsample_initial_channel", "upsample_initial_channel"),
+        ("resblock_kernel_sizes", "resblock_kernel_sizes"),
+        ("gen_istft_n_fft", "gen_istft_n_fft"),
+        ("gen_istft_hop_size", "gen_istft_hop_size"),
+    ):
+        if k_json in ist:
+            v = ist[k_json]
+            kw[k_spec] = tuple(v) if isinstance(v, list) else v
+    if "resblock_dilation_sizes" in ist:
+        kw["resblock_dilation_sizes"] = tuple(
+            tuple(d) for d in ist["resblock_dilation_sizes"])
+    if "sampling_rate" in cfg:
+        kw["sampling_rate"] = cfg["sampling_rate"]
+    if "decoder_hidden" in cfg:
+        kw["decoder_hidden"] = cfg["decoder_hidden"]
+    if "asr_res_dim" in cfg:
+        kw["asr_res_dim"] = cfg["asr_res_dim"]
+    return KokoroSpec(**kw)
+
+
+# ---------------------------------------------------------------------------
+# torch-parity primitives (B=1, float32)
+# ---------------------------------------------------------------------------
+
+
+def _lin(p, prefix, x):
+    """nn.Linear: weight [out, in]."""
+    y = x @ p[f"{prefix}.weight"].T
+    b = p.get(f"{prefix}.bias")
+    return y if b is None else y + b
+
+
+def _layer_norm(x, w, b, eps=1e-5):
+    m = jnp.mean(x, -1, keepdims=True)
+    v = jnp.var(x, -1, keepdims=True)
+    out = (x - m) / jnp.sqrt(v + eps)
+    return out * w + b
+
+
+def _conv1d(p, prefix, x, *, stride=1, padding=0, dilation=1, groups=1):
+    """nn.Conv1d on [B, C, T]; weight [out, in/groups, k]."""
+    w = p[f"{prefix}.weight"]
+    out = lax.conv_general_dilated(
+        x, w, (stride,), [(padding, padding)],
+        rhs_dilation=(dilation,),
+        dimension_numbers=("NCH", "OIH", "NCH"),
+        feature_group_count=groups,
+    )
+    b = p.get(f"{prefix}.bias")
+    return out if b is None else out + b[None, :, None]
+
+
+def _conv_transpose1d(p, prefix, x, *, stride, padding=0, output_padding=0,
+                      groups=1):
+    """nn.ConvTranspose1d on [B, C, T]; weight [in, out/groups, k].
+    Implemented as the zero-insertion (lhs-dilated) convolution with the
+    flipped kernel — the exact transpose of the forward conv."""
+    w = p[f"{prefix}.weight"]  # [in, out/g, k]
+    cin, og, k = w.shape
+    # flip taps, regroup to [out, in/g, k]
+    wf = jnp.flip(w, -1).reshape(groups, cin // groups, og, k)
+    wf = jnp.swapaxes(wf, 1, 2).reshape(groups * og, cin // groups, k)
+    out = lax.conv_general_dilated(
+        x, wf, (1,),
+        [(k - 1 - padding, k - 1 - padding + output_padding)],
+        lhs_dilation=(stride,),
+        dimension_numbers=("NCH", "OIH", "NCH"),
+        feature_group_count=groups,
+    )
+    b = p.get(f"{prefix}.bias")
+    return out if b is None else out + b[None, :, None]
+
+
+def _lstm_dir(x, w_ih, w_hh, b, reverse=False):
+    """One LSTM direction over [T, in] -> [T, H]; torch gate order
+    i, f, g, o; b = b_ih + b_hh pre-summed."""
+    H = w_hh.shape[1]
+    xs = x[::-1] if reverse else x
+    pre = xs @ w_ih.T + b  # [T, 4H]
+
+    def step(carry, p_t):
+        h, c = carry
+        z = p_t + h @ w_hh.T
+        i = jax.nn.sigmoid(z[:H])
+        f = jax.nn.sigmoid(z[H:2 * H])
+        g = jnp.tanh(z[2 * H:3 * H])
+        o = jax.nn.sigmoid(z[3 * H:])
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    (_, _), hs = lax.scan(step, (jnp.zeros(H), jnp.zeros(H)), pre)
+    return hs[::-1] if reverse else hs
+
+
+def _bilstm(p, prefix, x):
+    """Bidirectional single-layer LSTM, batch_first, x [B=1, T, in]."""
+    xt = x[0]
+    fwd = _lstm_dir(
+        xt, p[f"{prefix}.weight_ih_l0"], p[f"{prefix}.weight_hh_l0"],
+        p[f"{prefix}.bias_ih_l0"] + p[f"{prefix}.bias_hh_l0"])
+    bwd = _lstm_dir(
+        xt, p[f"{prefix}.weight_ih_l0_reverse"],
+        p[f"{prefix}.weight_hh_l0_reverse"],
+        p[f"{prefix}.bias_ih_l0_reverse"] + p[f"{prefix}.bias_hh_l0_reverse"],
+        reverse=True)
+    return jnp.concatenate([fwd, bwd], -1)[None]
+
+
+def _instance_norm(x, eps=1e-5):
+    """nn.InstanceNorm1d(affine=False) over T per (B, C)."""
+    m = jnp.mean(x, -1, keepdims=True)
+    v = jnp.var(x, -1, keepdims=True)
+    return (x - m) / jnp.sqrt(v + eps)
+
+
+def _adain(p, prefix, x, s):
+    """AdaIN1d: instance-norm modulated by style: fc -> (gamma, beta)."""
+    h = _lin(p, f"{prefix}.fc", s)  # [B, 2C]
+    gamma, beta = jnp.split(h[:, :, None], 2, axis=1)
+    return (1 + gamma) * _instance_norm(x) + beta
+
+
+def _ada_layer_norm(p, prefix, x, s):
+    """AdaLayerNorm on [B, T, C]."""
+    h = _lin(p, f"{prefix}.fc", s)  # [B, 2C]
+    gamma, beta = jnp.split(h[:, None, :], 2, axis=-1)
+    m = jnp.mean(x, -1, keepdims=True)
+    v = jnp.var(x, -1, keepdims=True)
+    out = (x - m) / jnp.sqrt(v + 1e-5)
+    return (1 + gamma) * out + beta
+
+
+def _interp_linear(x, out_len):
+    """F.interpolate(mode='linear', align_corners=False) on [B, C, T]."""
+    t_in = x.shape[-1]
+    pos = (jnp.arange(out_len) + 0.5) * (t_in / out_len) - 0.5
+    pos = jnp.clip(pos, 0.0, t_in - 1.0)
+    lo = jnp.floor(pos).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, t_in - 1)
+    frac = pos - lo
+    return x[..., lo] * (1 - frac) + x[..., hi] * frac
+
+
+# ---------------------------------------------------------------------------
+# PLBERT (ALBERT encoder, transformers layout)
+# ---------------------------------------------------------------------------
+
+
+def _albert(spec: KokoroSpec, p, tokens):
+    """AlbertModel.last_hidden_state for tokens [1, T] (full attention)."""
+    T = tokens.shape[1]
+    pre = "bert.embeddings"
+    x = (p[f"{pre}.word_embeddings.weight"][tokens[0]]
+         + p[f"{pre}.position_embeddings.weight"][:T]
+         + p[f"{pre}.token_type_embeddings.weight"][0])
+    x = _layer_norm(x, p[f"{pre}.LayerNorm.weight"],
+                    p[f"{pre}.LayerNorm.bias"], eps=1e-12)[None]
+    x = _lin(p, "bert.encoder.embedding_hidden_mapping_in", x)
+    lp = "bert.encoder.albert_layer_groups.0.albert_layers.0"
+    H, D = spec.plbert_heads, spec.plbert_hidden
+    dh = D // H
+    for _ in range(spec.plbert_layers):  # ALBERT shares one layer's params
+        q = _lin(p, f"{lp}.attention.query", x).reshape(1, T, H, dh)
+        k = _lin(p, f"{lp}.attention.key", x).reshape(1, T, H, dh)
+        v = _lin(p, f"{lp}.attention.value", x).reshape(1, T, H, dh)
+        a = jnp.einsum("bthd,bshd->bhts", q, k) / math.sqrt(dh)
+        a = jax.nn.softmax(a, -1)
+        ctx = jnp.einsum("bhts,bshd->bthd", a, v).reshape(1, T, D)
+        attn = _lin(p, f"{lp}.attention.dense", ctx)
+        x = _layer_norm(x + attn, p[f"{lp}.attention.LayerNorm.weight"],
+                        p[f"{lp}.attention.LayerNorm.bias"], eps=1e-12)
+        h = jax.nn.gelu(_lin(p, f"{lp}.ffn", x), approximate=True)
+        h = _lin(p, f"{lp}.ffn_output", h)
+        x = _layer_norm(x + h, p[f"{lp}.full_layer_layer_norm.weight"],
+                        p[f"{lp}.full_layer_layer_norm.bias"], eps=1e-12)
+    return x  # [1, T, hidden]
+
+
+# ---------------------------------------------------------------------------
+# TextEncoder / DurationEncoder / ProsodyPredictor
+# ---------------------------------------------------------------------------
+
+
+def _text_encoder(spec: KokoroSpec, p, tokens):
+    """tokens [1, T] -> [1, hidden_dim, T]."""
+    x = p["text_encoder.embedding.weight"][tokens[0]][None]  # [1, T, C]
+    x = jnp.swapaxes(x, 1, 2)  # [1, C, T]
+    ks = spec.text_encoder_kernel_size
+    for i in range(spec.n_layer):
+        x = _conv1d(p, f"text_encoder.cnn.{i}.0", x, padding=ks // 2)
+        xt = jnp.swapaxes(x, 1, 2)
+        xt = _layer_norm(xt, p[f"text_encoder.cnn.{i}.1.gamma"],
+                         p[f"text_encoder.cnn.{i}.1.beta"])
+        x = jnp.swapaxes(xt, 1, 2)
+        x = jnp.where(x >= 0, x, LRELU * x)
+    x = _bilstm(p, "text_encoder.lstm", jnp.swapaxes(x, 1, 2))
+    return jnp.swapaxes(x, 1, 2)  # [1, C, T]
+
+
+def _duration_encoder(spec: KokoroSpec, p, d_en, s):
+    """d_en [1, D, T], style s [1, sty] -> [1, T, D+sty]
+    (lstms = [LSTM, AdaLayerNorm] * n_layer; style re-concatenated after
+    every AdaLayerNorm — the StyleTTS2 DurationEncoder)."""
+    T = d_en.shape[-1]
+    sty = jnp.broadcast_to(s[:, :, None], (1, s.shape[1], T))
+    x = jnp.concatenate([d_en, sty], 1)  # [1, D+sty, T]
+    for i in range(spec.n_layer):
+        x = _bilstm(p, f"predictor.text_encoder.lstms.{2 * i}",
+                    jnp.swapaxes(x, 1, 2))  # [1, T, D]
+        x = _ada_layer_norm(p, f"predictor.text_encoder.lstms.{2 * i + 1}",
+                            x, s)
+        x = jnp.concatenate([jnp.swapaxes(x, 1, 2), sty], 1)
+    return jnp.swapaxes(x, 1, 2)  # [1, T, D+sty]
+
+
+def _upsample_nearest2(x):
+    return jnp.repeat(x, 2, axis=-1)
+
+
+def _adain_resblk1d(p, prefix, x, s, *, upsample=False, learned_sc=False):
+    """StyleTTS2 AdainResBlk1d: two AdaIN+lrelu+conv stages with a
+    (possibly upsampled / 1x1-projected) shortcut, / sqrt(2)."""
+    sc = x
+    if upsample:
+        sc = _upsample_nearest2(sc)
+    if learned_sc:
+        sc = _conv1d(p, f"{prefix}.conv1x1", sc)
+    h = _adain(p, f"{prefix}.norm1", x, s)
+    h = jnp.where(h >= 0, h, LRELU * h)
+    if upsample:  # grouped stride-2 transposed conv "pool"
+        c = h.shape[1]
+        h = _conv_transpose1d(p, f"{prefix}.pool", h, stride=2, padding=1,
+                              output_padding=1, groups=c)
+    h = _conv1d(p, f"{prefix}.conv1", h, padding=1)
+    h = _adain(p, f"{prefix}.norm2", h, s)
+    h = jnp.where(h >= 0, h, LRELU * h)
+    h = _conv1d(p, f"{prefix}.conv2", h, padding=1)
+    return (h + sc) / math.sqrt(2)
+
+
+def _prosody_f0n(spec: KokoroSpec, p, en, s):
+    """en [1, D+sty, frames] -> (F0 [1, 2*frames], N [1, 2*frames])."""
+    x = _bilstm(p, "predictor.shared", jnp.swapaxes(en, 1, 2))
+    x = jnp.swapaxes(x, 1, 2)  # [1, D, frames]
+
+    def branch(name):
+        h = _adain_resblk1d(p, f"predictor.{name}.0", x, s)
+        h = _adain_resblk1d(p, f"predictor.{name}.1", h, s, upsample=True,
+                            learned_sc=True)
+        h = _adain_resblk1d(p, f"predictor.{name}.2", h, s)
+        return _conv1d(p, f"predictor.{name}_proj", h)[:, 0]  # [1, 2f]
+
+    return branch("F0"), branch("N")
+
+
+# ---------------------------------------------------------------------------
+# iSTFTNet decoder
+# ---------------------------------------------------------------------------
+
+
+def _hann(n):
+    return 0.5 - 0.5 * jnp.cos(2 * jnp.pi * jnp.arange(n) / n)
+
+
+def _stft_mag_phase(spec: KokoroSpec, x):
+    """torch.stft(center=True, hann) magnitude+phase of x [1, t]."""
+    n_fft, hop = spec.gen_istft_n_fft, spec.gen_istft_hop_size
+    pad = n_fft // 2
+    xp = jnp.pad(x, ((0, 0), (pad, pad)), mode="reflect")
+    n_frames = (xp.shape[1] - n_fft) // hop + 1
+    idx = jnp.arange(n_frames)[:, None] * hop + jnp.arange(n_fft)[None]
+    frames = xp[0][idx] * _hann(n_fft)[None]  # [F, n_fft]
+    sp = jnp.fft.rfft(frames, axis=-1)  # [F, n_fft/2+1]
+    return (jnp.abs(sp).T[None], jnp.angle(sp).T[None])  # [1, bins, F]
+
+
+def _istft(spec: KokoroSpec, mag, phase):
+    """torch.istft(mag * exp(i*phase), center=True, hann) -> [1, t]."""
+    n_fft, hop = spec.gen_istft_n_fft, spec.gen_istft_hop_size
+    sp = mag * jnp.exp(1j * phase)  # [1, bins, F]
+    frames = jnp.fft.irfft(sp[0].T, n=n_fft, axis=-1)  # [F, n_fft]
+    win = _hann(n_fft)
+    frames = frames * win[None]
+    F = frames.shape[0]
+    t_len = n_fft + hop * (F - 1)
+    idx = jnp.arange(F)[:, None] * hop + jnp.arange(n_fft)[None]
+    sig = jnp.zeros(t_len).at[idx.reshape(-1)].add(frames.reshape(-1))
+    norm = jnp.zeros(t_len).at[idx.reshape(-1)].add(
+        jnp.tile(win * win, (F,)))
+    sig = sig / jnp.maximum(norm, 1e-11)
+    pad = n_fft // 2
+    return sig[None, pad:t_len - pad]
+
+
+def _sine_source(spec: KokoroSpec, f0_up, rng, noise=None):
+    """SineGen + SourceModuleHnNSF harmonic source. f0_up [1, t, 1]
+    (already upsampled); returns (sine_waves [1, t, h], uv). ``noise``
+    overrides the dithering noise (parity tests inject a shared
+    sample)."""
+    h = spec.harmonic_num + 1
+    scale = spec.total_upsample
+    f0h = f0_up * (jnp.arange(1, h + 1, dtype=jnp.float32))[None, None, :]
+    rad = (f0h / spec.sampling_rate) % 1.0  # [1, t, h]
+    # the SineGen upsample trick: integrate at frame rate, then linearly
+    # re-upsample the phase (keeps harmonics coherent across frames)
+    t_up = rad.shape[1]
+    rad_f = _interp_linear(jnp.swapaxes(rad, 1, 2), t_up // scale)
+    phase = jnp.cumsum(rad_f, -1) * 2 * jnp.pi
+    phase = _interp_linear(phase * scale, t_up)
+    sines = jnp.sin(jnp.swapaxes(phase, 1, 2))  # [1, t, h]
+    uv = (f0_up > spec.voiced_threshold).astype(jnp.float32)  # [1, t, 1]
+    amp = spec.sine_amp
+    # SineGen noise: voiced rows dither at noise_std, unvoiced rows
+    # carry amp/3 noise instead of the sine
+    if noise is None:
+        noise = jax.random.normal(rng, sines.shape)
+    noise = (uv * spec.noise_std + (1 - uv) * (amp / 3.0)) * noise
+    sine_waves = amp * sines * uv + noise
+    return sine_waves, uv
+
+
+def _generator(spec: KokoroSpec, p, x, s, f0, rng, noise=None):
+    """istftnet Generator: x [1, C0, frames], f0 [1, frames] -> [1, t]."""
+    g = "decoder.generator"
+    # harmonic source; f0_upsamp is nn.Upsample(scale) = nearest = repeat
+    f0_up = jnp.swapaxes(
+        jnp.repeat(f0[:, None, :], spec.total_upsample, axis=-1), 1, 2
+    )  # [1, t, 1]
+    sine_waves, _uv = _sine_source(spec, f0_up, rng, noise)
+    har = jnp.tanh(_lin(p, f"{g}.m_source.l_linear", sine_waves))  # [1,t,1]
+    har_spec, har_phase = _stft_mag_phase(spec, har[:, :, 0])
+    har_cat = jnp.concatenate([har_spec, har_phase], 1)  # [1, n_fft+2, F]
+
+    n_k = len(spec.resblock_kernel_sizes)
+    for i, (u, k) in enumerate(zip(spec.upsample_rates,
+                                   spec.upsample_kernel_sizes)):
+        x = jnp.where(x >= 0, x, LRELU_GEN * x)
+        if i + 1 < len(spec.upsample_rates):
+            stride_f0 = 1
+            for r in spec.upsample_rates[i + 1:]:
+                stride_f0 *= r
+            xs_src = _conv1d(p, f"{g}.noise_convs.{i}", har_cat,
+                             stride=stride_f0,
+                             padding=(stride_f0 + 1) // 2)
+        else:
+            xs_src = _conv1d(p, f"{g}.noise_convs.{i}", har_cat)
+        xs_src = _adain_resblock1(spec, p, f"{g}.noise_res.{i}", xs_src, s,
+                                  kernel=7 if i + 1 < len(
+                                      spec.upsample_rates) else 11,
+                                  dilations=(1, 3, 5))
+        x = _conv_transpose1d(p, f"{g}.ups.{i}", x, stride=u,
+                              padding=(k - u) // 2)
+        if i == len(spec.upsample_rates) - 1:
+            x = jnp.pad(x, ((0, 0), (0, 0), (1, 0)), mode="reflect")
+        x = x + xs_src
+        acc = None
+        for j, (rk, rd) in enumerate(zip(spec.resblock_kernel_sizes,
+                                         spec.resblock_dilation_sizes)):
+            h = _adain_resblock1(spec, p, f"{g}.resblocks.{i * n_k + j}",
+                                 x, s, kernel=rk, dilations=rd)
+            acc = h if acc is None else acc + h
+        x = acc / n_k
+    x = jnp.where(x >= 0, x, 0.01 * x)  # F.leaky_relu default slope
+    x = _conv1d(p, f"{g}.conv_post", x, padding=3)
+    bins = spec.gen_istft_n_fft // 2 + 1
+    mag = jnp.exp(x[:, :bins])
+    phase = jnp.sin(x[:, bins:])
+    return _istft(spec, mag, phase)
+
+
+def _adain_resblock1(spec: KokoroSpec, p, prefix, x, s, *, kernel,
+                     dilations):
+    """AdaINResBlock1 (hifigan resblock1 + AdaIN + snake activation)."""
+    for j, d in enumerate(dilations):
+        a1 = p[f"{prefix}.alpha1.{j}"]
+        a2 = p[f"{prefix}.alpha2.{j}"]
+        h = _adain(p, f"{prefix}.adain1.{j}", x, s)
+        h = h + (1.0 / a1) * jnp.sin(a1 * h) ** 2  # snake
+        h = _conv1d(p, f"{prefix}.convs1.{j}", h, dilation=d,
+                    padding=(kernel * d - d) // 2)
+        h = _adain(p, f"{prefix}.adain2.{j}", h, s)
+        h = h + (1.0 / a2) * jnp.sin(a2 * h) ** 2
+        h = _conv1d(p, f"{prefix}.convs2.{j}", h, padding=kernel // 2)
+        x = x + h
+    return x
+
+
+def _decoder(spec: KokoroSpec, p, asr, f0_curve, n_curve, s, rng,
+             noise=None):
+    """Decoder: asr [1, D, frames], F0/N [1, 2*frames], style ref
+    [1, sty] -> audio [1, t]."""
+    f0 = _conv1d(p, "decoder.F0_conv", f0_curve[:, None], stride=2,
+                 padding=1)
+    n = _conv1d(p, "decoder.N_conv", n_curve[:, None], stride=2, padding=1)
+    x = jnp.concatenate([asr, f0, n], 1)
+    x = _adain_resblk1d(p, "decoder.encode", x, s, learned_sc=True)
+    asr_res = _conv1d(p, "decoder.asr_res.0", asr)
+    res = True
+    for i in range(4):
+        if res:
+            x = jnp.concatenate([x, asr_res, f0, n], 1)
+        up = i == 3
+        x = _adain_resblk1d(
+            p, f"decoder.decode.{i}", x, s, upsample=up,
+            learned_sc=True,  # every decode block concatenates extra
+            # channels in front, so dim_in != dim_out always holds
+        )
+        if up:
+            res = False
+    return _generator(spec, p, x, s, f0_curve, rng, noise)
+
+
+# ---------------------------------------------------------------------------
+# full pipeline
+# ---------------------------------------------------------------------------
+
+
+def durations(spec: KokoroSpec, p, tokens, s, speed=1.0):
+    """Per-token frame counts [T] (int) plus the duration-encoder output
+    d [1, T, D+sty] the alignment expands."""
+    bert = _albert(spec, p, tokens)
+    d_en = jnp.swapaxes(_lin(p, "bert_encoder", bert), 1, 2)
+    d = _duration_encoder(spec, p, d_en, s)
+    x = _bilstm(p, "predictor.lstm", d)
+    dur = _lin(p, "predictor.duration_proj.linear_layer", x)  # [1,T,max]
+    dur = jnp.sum(jax.nn.sigmoid(dur), -1) / speed  # [1, T]
+    pred = jnp.clip(jnp.round(dur), 1, None).astype(jnp.int32)[0]
+    return pred, d
+
+
+def synthesize_kokoro(spec: KokoroSpec, p, token_ids, ref_s,
+                      speed: float = 1.0, seed: int = 0,
+                      source_noise=None) -> np.ndarray:
+    """token_ids: 1-D int array (the worker wraps with 0 pads); ref_s
+    [1, 2*style_dim] voicepack row. Returns float32 audio.
+
+    Runs on host CPU: TTS is an ~82M-param latency-bound model (the
+    reference's kokoro worker is CPU-first too), the iSTFT head needs
+    complex FFT support the experimental TPU plugin lacks, and pinning
+    it host-side keeps the chip owned by the LLM engine
+    (single-TPU-owner rule, engine/loader.py)."""
+    with jax.default_device(jax.devices("cpu")[0]):
+        return _synthesize_cpu(spec, p, token_ids, ref_s, speed, seed,
+                               source_noise)
+
+
+def _synthesize_cpu(spec, p, token_ids, ref_s, speed, seed,
+                    source_noise) -> np.ndarray:
+    tokens = jnp.asarray(np.asarray(token_ids, np.int32))[None]
+    ref_s = jnp.asarray(np.asarray(ref_s, np.float32)).reshape(1, -1)
+    s_pros = ref_s[:, spec.style_dim:]
+    s_ref = ref_s[:, :spec.style_dim]
+    pred_dur, d = durations(spec, p, tokens, s_pros, speed)
+    # alignment expansion (pred_aln_trg matmul == repeat_interleave)
+    reps = np.asarray(pred_dur)
+    en = jnp.swapaxes(d, 1, 2)  # [1, D+sty, T]
+    en = jnp.repeat(en, reps, axis=-1, total_repeat_length=int(reps.sum()))
+    f0, n = _prosody_f0n(spec, p, en, s_pros)
+    t_en = _text_encoder(spec, p, tokens)
+    asr = jnp.repeat(t_en, reps, axis=-1,
+                     total_repeat_length=int(reps.sum()))
+    rng = jax.random.PRNGKey(seed)
+    audio = _decoder(spec, p, asr, f0, n, s_ref, rng,
+                 source_noise)
+    return np.asarray(audio[0], np.float32)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint import
+# ---------------------------------------------------------------------------
+
+
+def _fold_weight_norm(flat: dict) -> dict:
+    """Fold weight_norm (weight_g, weight_v) pairs into plain .weight:
+    W = g * v / ||v|| with the norm over all-but-dim-0."""
+    out = {}
+    for k, v in flat.items():
+        if k.endswith(".weight_g"):
+            continue
+        if k.endswith(".weight_v"):
+            base = k[: -len(".weight_v")]
+            g = flat[base + ".weight_g"]
+            axes = tuple(range(1, v.ndim))
+            norm = np.sqrt((v.astype(np.float64) ** 2).sum(
+                axis=axes, keepdims=True))
+            out[base + ".weight"] = (g * (v / np.maximum(norm, 1e-12))
+                                     ).astype(np.float32)
+        else:
+            out[k] = v
+    return out
+
+
+def load_kokoro(model_dir: str):
+    """Load a kokoro-layout checkpoint directory:
+    - config.json with the model hyperparams (style_dim/hidden_dim/
+      plbert/istftnet blocks — the Kokoro-82M layout),
+    - a *.pth torch checkpoint `{"net": {module: state_dict}}` (optional
+      "net" wrapper, optional DataParallel "module." prefixes),
+    - voices/*.pt voicepack tensors [N, 1, 2*style_dim].
+    Returns (spec, params, voices: name -> np.ndarray)."""
+    import torch
+
+    with open(os.path.join(model_dir, "config.json")) as f:
+        spec = spec_from_config(json.load(f))
+    ckpts = sorted(
+        fn for fn in os.listdir(model_dir)
+        if fn.endswith((".pth", ".pt")) and not fn.startswith("voice"))
+    if not ckpts:
+        raise FileNotFoundError(f"no .pth checkpoint in {model_dir}")
+    raw = torch.load(os.path.join(model_dir, ckpts[0]),
+                     map_location="cpu", weights_only=True)
+    if "net" in raw:
+        raw = raw["net"]
+    flat: dict[str, np.ndarray] = {}
+    for mod, sd in raw.items():
+        for k, v in sd.items():
+            if k.startswith("module."):
+                k = k[len("module."):]
+            flat[f"{mod}.{k}"] = v.float().numpy()
+    flat = _fold_weight_norm(flat)
+    cpu = jax.devices("cpu")[0]  # synthesis is host-pinned (see
+    # synthesize_kokoro) — params must live there too
+    params = {k: jax.device_put(jnp.asarray(v), cpu)
+              for k, v in flat.items()}
+    voices = {}
+    vdir = os.path.join(model_dir, "voices")
+    if os.path.isdir(vdir):
+        for fn in sorted(os.listdir(vdir)):
+            if fn.endswith(".pt"):
+                voices[fn[:-3]] = torch.load(
+                    os.path.join(vdir, fn), map_location="cpu",
+                    weights_only=True).float().numpy()
+    return spec, params, voices
+
+
+def pick_voice(voices: dict, name: str, n_tokens: int,
+               style_dim: int) -> np.ndarray:
+    """Reference voicepack semantics (kokoro backend.py:72-79): blend
+    "a+b" as the mean of the packs; index the pack by token count."""
+    if not voices:
+        raise ValueError("kokoro model has no voicepacks")
+    if name and "+" in name:
+        parts = [v.strip() for v in name.split("+")]
+        packs = [voices[v] for v in parts if v in voices]
+        if not packs:
+            packs = [next(iter(voices.values()))]
+        pack = np.mean(np.stack(packs), axis=0)
+    else:
+        pack = voices.get(name) if name else None
+        if pack is None:
+            pack = next(iter(voices.values()))
+    idx = min(n_tokens, pack.shape[0] - 1)
+    return pack[idx].reshape(1, -1)
+
+
+_PUNCT = ';:,.!?¡¿—…"«»“” '
+_LETTERS = "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz"
+_IPA = ("ɑɐɒæɓʙβɔɕçɗɖðʤəɘɚɛɜɝɞɟʄɡɠɢʛɦɧħɥʜɨɪʝɭɬɫɮʟɱɯɰŋɳɲɴøɵɸθœɶʘɹɺɾɻʀʁɽ"
+        "ʂʃʈʧʉʊʋⱱʌɣɤʍχʎʏʑʐʒʔʡʕʢǀǁǂǃˈˌːˑʼʴʰʱʲʷˠˤ˞↓↑→↗↘'̩ᵻ")
+
+
+def symbol_table() -> dict:
+    """Kokoro symbol inventory: pad + punctuation + ASCII letters + IPA
+    (the tokenizer the official pipeline feeds phonemized text into)."""
+    symbols = ["$"] + list(_PUNCT) + list(_LETTERS) + list(_IPA)
+    return {s: i for i, s in enumerate(symbols)}
+
+
+def text_to_tokens(text: str, n_token: int) -> list:
+    """Grapheme fallback tokenization: ASCII letters and punctuation are
+    first-class symbols in the kokoro inventory, so raw text maps to
+    valid token ids directly. (The official pipeline phonemizes with
+    espeak first — unavailable offline; phonemization improves prosody,
+    not validity.) Ids are folded into the model's vocab so undersized
+    test vocabs stay in range."""
+    table = symbol_table()
+    ids = [table[c] for c in text if c in table]
+    return [i % max(n_token, 1) for i in ids] or [0]
+
+
+def is_kokoro_dir(model_dir: str) -> bool:
+    """Kokoro checkpoints carry no transformers model_type; detect by
+    the config's own fields."""
+    cfg_path = os.path.join(model_dir, "config.json")
+    if not os.path.exists(cfg_path):
+        return False
+    try:
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+    except Exception:
+        return False
+    if (cfg.get("model_type") or "").lower() in ("kokoro", "styletts2"):
+        return True
+    return ("istftnet" in cfg or "plbert" in cfg) and "style_dim" in cfg

@@ -146,11 +146,12 @@ class JaxTTSBackend(Backend):
         self._vits = None  # (spec, params, tokenizer-or-None)
         self._musicgen = None  # (bundle, tokenizer-or-None)
         self._bark = None  # models/bark.py BarkTTS
+        self._kokoro = None  # (spec, params, voices)
 
     def load_model(self, opts: ModelLoadOptions) -> Result:
         # a reload must not leave a previous family reachable (tts()
         # dispatches on whichever slot is non-None)
-        self._vits = self._musicgen = self._bark = None
+        self._vits = self._musicgen = self._bark = self._kokoro = None
         self._bark_opts = {}
         model_dir = opts.model
         if model_dir and not os.path.isabs(model_dir):
@@ -161,6 +162,18 @@ class JaxTTSBackend(Backend):
 
             mtype = ""
             try:
+                from ..models.kokoro import is_kokoro_dir
+
+                if is_kokoro_dir(model_dir):
+                    # StyleTTS2-derived family; its config.json carries
+                    # no transformers model_type (ref: backend/python/
+                    # kokoro/backend.py)
+                    from ..models.kokoro import load_kokoro
+
+                    mtype = "kokoro"
+                    self._kokoro = load_kokoro(model_dir)
+                    self._state = "READY"
+                    return Result(True, "kokoro ready")
                 with open(cfg_path) as f:
                     mtype = (json.load(f).get("model_type") or "").lower()
                 if mtype == "vits":
@@ -211,6 +224,18 @@ class JaxTTSBackend(Backend):
 
     def tts(self, text: str, voice: str = "", dst: str = "",
             language: str = "") -> Result:
+        if self._kokoro is not None:
+            from ..models.kokoro import (pick_voice, synthesize_kokoro,
+                                         text_to_tokens)
+
+            kspec, kparams, voices = self._kokoro
+            ids = text_to_tokens(text, kspec.n_token)
+            ref = pick_voice(voices, voice, len(ids), kspec.style_dim)
+            # official generate() pads the token stream with 0 on both
+            # ends before the forward
+            audio = synthesize_kokoro(kspec, kparams, [0, *ids, 0], ref)
+            write_wav(dst, audio, sr=kspec.sampling_rate)
+            return Result(True, dst)
         if self._bark is not None:
             audio = self._bark.generate(
                 text, **getattr(self, "_bark_opts", {}))

@@ -1,0 +1,611 @@
+"""Kokoro (StyleTTS2-class) TTS: module-level torch parity + checkpoint
+import + worker integration.
+
+The torch reference below mirrors the official Kokoro v0.19 module
+structure (AdaIN residual blocks, DurationEncoder, iSTFTNet generator
+with harmonic source) using real torch ops (nn.LSTM, torch.stft/istft,
+F.interpolate, weight_norm, InstanceNorm1d) as ground truth; the PLBERT
+encoder parity is pinned against transformers.AlbertModel directly. The
+checkpoint is saved in the official layout ({"net": {module:
+state_dict}} with DataParallel "module." prefixes and weight_norm
+weight_g/weight_v tensors) so the importer path is what a real
+kokoro-v0_19.pth would exercise. Deterministic deviations from upstream
+(documented in models/kokoro.py): no random initial harmonic phase, and
+injectable source noise (shared here for exact comparison).
+
+Ref: /root/reference/backend/python/kokoro/backend.py (voicepack
+selection incl. "+" blending, voice indexing by token count).
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+import torch.nn.functional as F  # noqa: E402
+from torch.nn.utils import weight_norm  # noqa: E402
+
+from localai_tfp_tpu.models.kokoro import (  # noqa: E402
+    KokoroSpec,
+    is_kokoro_dir,
+    load_kokoro,
+    pick_voice,
+    spec_from_config,
+    synthesize_kokoro,
+)
+
+# tiny geometry (keeps CPU runtime in seconds)
+CFG = {
+    "n_token": 20,
+    "hidden_dim": 16,
+    "style_dim": 8,
+    "max_dur": 6,
+    "n_layer": 2,
+    "text_encoder_kernel_size": 5,
+    "decoder_hidden": 24,
+    "asr_res_dim": 4,
+    "sampling_rate": 24000,
+    "plbert": {
+        "vocab_size": 20, "hidden_size": 16, "embedding_size": 8,
+        "num_attention_heads": 2, "num_hidden_layers": 2,
+        "intermediate_size": 24, "max_position_embeddings": 64,
+    },
+    "istftnet": {
+        "upsample_rates": [4, 3],
+        # k - u must stay even (= 2*padding) like the official (20,10)/
+        # (12,6) pairs, or ConvTranspose1d emits one extra sample
+        "upsample_kernel_sizes": [8, 9],
+        "upsample_initial_channel": 16,
+        "resblock_kernel_sizes": [3, 5],
+        "resblock_dilation_sizes": [[1, 3], [1, 3]],
+        "gen_istft_n_fft": 8,
+        "gen_istft_hop_size": 2,
+    },
+}
+
+
+# ---------------------------------------------------------------------------
+# torch reference modules (official Kokoro v0.19 structure)
+# ---------------------------------------------------------------------------
+
+
+class AdaIN1d(nn.Module):
+    def __init__(self, style_dim, num_features):
+        super().__init__()
+        self.norm = nn.InstanceNorm1d(num_features, affine=False)
+        self.fc = nn.Linear(style_dim, num_features * 2)
+
+    def forward(self, x, s):
+        h = self.fc(s)
+        h = h.view(h.size(0), h.size(1), 1)
+        gamma, beta = torch.chunk(h, chunks=2, dim=1)
+        return (1 + gamma) * self.norm(x) + beta
+
+
+class AdaLayerNorm(nn.Module):
+    def __init__(self, style_dim, channels, eps=1e-5):
+        super().__init__()
+        self.channels, self.eps = channels, eps
+        self.fc = nn.Linear(style_dim, channels * 2)
+
+    def forward(self, x, s):  # x [B, T, C]
+        h = self.fc(s).view(s.size(0), self.channels * 2, 1)
+        gamma, beta = torch.chunk(h, chunks=2, dim=1)
+        gamma, beta = gamma.transpose(1, 2), beta.transpose(1, 2)
+        x = F.layer_norm(x, (self.channels,), eps=self.eps)
+        return (1 + gamma) * x + beta
+
+
+class ChannelLayerNorm(nn.Module):  # StyleTTS2 "LayerNorm"
+    def __init__(self, channels, eps=1e-5):
+        super().__init__()
+        self.channels, self.eps = channels, eps
+        self.gamma = nn.Parameter(torch.ones(channels))
+        self.beta = nn.Parameter(torch.zeros(channels))
+
+    def forward(self, x):  # [B, C, T]
+        x = x.transpose(1, -1)
+        x = F.layer_norm(x, (self.channels,), self.gamma, self.beta,
+                         self.eps)
+        return x.transpose(1, -1)
+
+
+class UpSample1d(nn.Module):
+    def __init__(self, upsample):
+        super().__init__()
+        self.upsample = upsample
+
+    def forward(self, x):
+        if not self.upsample:
+            return x
+        return F.interpolate(x, scale_factor=2, mode="nearest")
+
+
+class AdainResBlk1d(nn.Module):
+    def __init__(self, dim_in, dim_out, style_dim, upsample=False):
+        super().__init__()
+        self.upsample_type = upsample
+        self.upsample = UpSample1d(upsample)
+        self.learned_sc = dim_in != dim_out
+        self.conv1 = weight_norm(nn.Conv1d(dim_in, dim_out, 3, 1, 1))
+        self.conv2 = weight_norm(nn.Conv1d(dim_out, dim_out, 3, 1, 1))
+        self.norm1 = AdaIN1d(style_dim, dim_in)
+        self.norm2 = AdaIN1d(style_dim, dim_out)
+        if self.learned_sc:
+            self.conv1x1 = weight_norm(
+                nn.Conv1d(dim_in, dim_out, 1, 1, 0, bias=False))
+        if upsample:
+            self.pool = weight_norm(nn.ConvTranspose1d(
+                dim_in, dim_in, kernel_size=3, stride=2, groups=dim_in,
+                padding=1, output_padding=1))
+        else:
+            self.pool = nn.Identity()
+
+    def forward(self, x, s):
+        sc = self.upsample(x)
+        if self.learned_sc:
+            sc = self.conv1x1(sc)
+        h = self.norm1(x, s)
+        h = F.leaky_relu(h, 0.2)
+        h = self.pool(h)
+        h = self.conv1(h)
+        h = self.norm2(h, s)
+        h = F.leaky_relu(h, 0.2)
+        h = self.conv2(h)
+        return (h + sc) / math.sqrt(2)
+
+
+class TextEncoder(nn.Module):
+    def __init__(self, channels, kernel_size, depth, n_symbols):
+        super().__init__()
+        self.embedding = nn.Embedding(n_symbols, channels)
+        self.cnn = nn.ModuleList()
+        for _ in range(depth):
+            self.cnn.append(nn.Sequential(
+                weight_norm(nn.Conv1d(channels, channels, kernel_size,
+                                      padding=kernel_size // 2)),
+                ChannelLayerNorm(channels),
+                nn.LeakyReLU(0.2),
+                nn.Dropout(0.2),
+            ))
+        self.lstm = nn.LSTM(channels, channels // 2, 1,
+                            batch_first=True, bidirectional=True)
+
+    def forward(self, x):
+        x = self.embedding(x).transpose(1, 2)
+        for c in self.cnn:
+            x = c(x)
+        x, _ = self.lstm(x.transpose(1, 2))
+        return x.transpose(1, 2)
+
+
+class DurationEncoder(nn.Module):
+    def __init__(self, sty_dim, d_model, nlayers):
+        super().__init__()
+        self.lstms = nn.ModuleList()
+        for _ in range(nlayers):
+            self.lstms.append(nn.LSTM(d_model + sty_dim, d_model // 2, 1,
+                                      batch_first=True,
+                                      bidirectional=True))
+            self.lstms.append(AdaLayerNorm(sty_dim, d_model))
+
+    def forward(self, x, style):  # x [B, D, T]
+        T = x.shape[-1]
+        s = style[:, :, None].expand(-1, -1, T)  # [B, sty, T]
+        x = torch.cat([x, s], dim=1)
+        for block in self.lstms:
+            if isinstance(block, AdaLayerNorm):
+                xt = block(x.transpose(-1, -2), style).transpose(-1, -2)
+                x = torch.cat([xt, s], dim=1)
+            else:
+                xt, _ = block(x.transpose(-1, -2))
+                x = xt.transpose(-1, -2)
+        return x.transpose(-1, -2)  # [B, T, D+sty]
+
+
+class ProsodyPredictor(nn.Module):
+    def __init__(self, style_dim, d_hid, nlayers, max_dur):
+        super().__init__()
+        self.text_encoder = DurationEncoder(style_dim, d_hid, nlayers)
+        self.lstm = nn.LSTM(d_hid + style_dim, d_hid // 2, 1,
+                            batch_first=True, bidirectional=True)
+        self.duration_proj = nn.Module()
+        self.duration_proj.linear_layer = nn.Linear(d_hid, max_dur)
+        self.shared = nn.LSTM(d_hid + style_dim, d_hid // 2, 1,
+                              batch_first=True, bidirectional=True)
+        self.F0 = nn.ModuleList([
+            AdainResBlk1d(d_hid, d_hid, style_dim),
+            AdainResBlk1d(d_hid, d_hid // 2, style_dim, upsample=True),
+            AdainResBlk1d(d_hid // 2, d_hid // 2, style_dim),
+        ])
+        self.N = nn.ModuleList([
+            AdainResBlk1d(d_hid, d_hid, style_dim),
+            AdainResBlk1d(d_hid, d_hid // 2, style_dim, upsample=True),
+            AdainResBlk1d(d_hid // 2, d_hid // 2, style_dim),
+        ])
+        self.F0_proj = nn.Conv1d(d_hid // 2, 1, 1)
+        self.N_proj = nn.Conv1d(d_hid // 2, 1, 1)
+
+    def F0Ntrain(self, x, s):
+        x, _ = self.shared(x.transpose(-1, -2))
+        f0 = x.transpose(-1, -2)
+        for block in self.F0:
+            f0 = block(f0, s)
+        f0 = self.F0_proj(f0)
+        n = x.transpose(-1, -2)
+        for block in self.N:
+            n = block(n, s)
+        n = self.N_proj(n)
+        return f0.squeeze(1), n.squeeze(1)
+
+
+class AdaINResBlock1(nn.Module):
+    def __init__(self, channels, kernel_size, dilation, style_dim):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.dilation = dilation
+        self.convs1 = nn.ModuleList([
+            weight_norm(nn.Conv1d(
+                channels, channels, kernel_size, dilation=d,
+                padding=(kernel_size * d - d) // 2)) for d in dilation])
+        self.convs2 = nn.ModuleList([
+            weight_norm(nn.Conv1d(
+                channels, channels, kernel_size,
+                padding=kernel_size // 2)) for _ in dilation])
+        self.adain1 = nn.ModuleList(
+            [AdaIN1d(style_dim, channels) for _ in dilation])
+        self.adain2 = nn.ModuleList(
+            [AdaIN1d(style_dim, channels) for _ in dilation])
+        self.alpha1 = nn.ParameterList(
+            [nn.Parameter(torch.ones(1, channels, 1)) for _ in dilation])
+        self.alpha2 = nn.ParameterList(
+            [nn.Parameter(torch.ones(1, channels, 1)) for _ in dilation])
+
+    def forward(self, x, s):
+        for c1, c2, n1, n2, a1, a2 in zip(
+                self.convs1, self.convs2, self.adain1, self.adain2,
+                self.alpha1, self.alpha2):
+            xt = n1(x, s)
+            xt = xt + (1 / a1) * torch.sin(a1 * xt) ** 2
+            xt = c1(xt)
+            xt = n2(xt, s)
+            xt = xt + (1 / a2) * torch.sin(a2 * xt) ** 2
+            xt = c2(xt)
+            x = xt + x
+        return x
+
+
+class TorchSTFT(nn.Module):
+    def __init__(self, n_fft, hop):
+        super().__init__()
+        self.n_fft, self.hop = n_fft, hop
+        self.window = torch.hann_window(n_fft)
+
+    def transform(self, x):
+        sp = torch.stft(x, self.n_fft, self.hop, self.n_fft,
+                        window=self.window, return_complex=True)
+        return torch.abs(sp), torch.angle(sp)
+
+    def inverse(self, mag, phase):
+        return torch.istft(mag * torch.exp(phase * 1j), self.n_fft,
+                           self.hop, self.n_fft, window=self.window)
+
+
+class SourceModuleHnNSF(nn.Module):
+    def __init__(self, spec):
+        super().__init__()
+        self.spec = spec
+        self.l_linear = nn.Linear(spec.harmonic_num + 1, 1)
+
+    def forward(self, f0_up, noise):  # f0_up [B, t, 1]
+        s = self.spec
+        h = s.harmonic_num + 1
+        scale = s.total_upsample
+        f0h = f0_up * torch.arange(1, h + 1, dtype=torch.float32)
+        rad = (f0h / s.sampling_rate) % 1.0
+        rad_f = F.interpolate(rad.transpose(1, 2),
+                              scale_factor=1.0 / scale, mode="linear")
+        phase = torch.cumsum(rad_f, dim=-1) * 2 * math.pi
+        phase = F.interpolate(phase * scale, scale_factor=scale,
+                              mode="linear")
+        sines = torch.sin(phase.transpose(1, 2))
+        uv = (f0_up > s.voiced_threshold).float()
+        noise = (uv * s.noise_std + (1 - uv) * (s.sine_amp / 3.0)) * noise
+        sine_waves = s.sine_amp * sines * uv + noise
+        return torch.tanh(self.l_linear(sine_waves))
+
+
+class Generator(nn.Module):
+    def __init__(self, spec):
+        super().__init__()
+        self.spec = spec
+        style_dim = spec.style_dim
+        self.m_source = SourceModuleHnNSF(spec)
+        self.ups = nn.ModuleList()
+        self.noise_convs = nn.ModuleList()
+        self.noise_res = nn.ModuleList()
+        c0 = spec.upsample_initial_channel
+        for i, (u, k) in enumerate(zip(spec.upsample_rates,
+                                       spec.upsample_kernel_sizes)):
+            self.ups.append(weight_norm(nn.ConvTranspose1d(
+                c0 // (2 ** i), c0 // (2 ** (i + 1)), k, u,
+                padding=(k - u) // 2)))
+            ch = c0 // (2 ** (i + 1))
+            if i + 1 < len(spec.upsample_rates):
+                stride_f0 = int(np.prod(spec.upsample_rates[i + 1:]))
+                self.noise_convs.append(nn.Conv1d(
+                    spec.gen_istft_n_fft + 2, ch,
+                    kernel_size=stride_f0 * 2, stride=stride_f0,
+                    padding=(stride_f0 + 1) // 2))
+                self.noise_res.append(
+                    AdaINResBlock1(ch, 7, (1, 3, 5), style_dim))
+            else:
+                self.noise_convs.append(nn.Conv1d(
+                    spec.gen_istft_n_fft + 2, ch, kernel_size=1))
+                self.noise_res.append(
+                    AdaINResBlock1(ch, 11, (1, 3, 5), style_dim))
+        self.resblocks = nn.ModuleList()
+        for i in range(len(self.ups)):
+            ch = c0 // (2 ** (i + 1))
+            for k, d in zip(spec.resblock_kernel_sizes,
+                            spec.resblock_dilation_sizes):
+                self.resblocks.append(
+                    AdaINResBlock1(ch, k, d, style_dim))
+        self.conv_post = weight_norm(nn.Conv1d(
+            ch, spec.gen_istft_n_fft + 2, 7, 1, padding=3))
+        self.reflection_pad = nn.ReflectionPad1d((1, 0))
+        self.stft = TorchSTFT(spec.gen_istft_n_fft,
+                              spec.gen_istft_hop_size)
+
+    def forward(self, x, s, f0, noise):
+        spec = self.spec
+        f0_up = F.interpolate(f0[:, None], scale_factor=spec.total_upsample,
+                              mode="nearest").transpose(1, 2)
+        har = self.m_source(f0_up, noise)[:, :, 0]
+        har_spec, har_phase = self.stft.transform(har)
+        har = torch.cat([har_spec, har_phase], dim=1)
+        n_k = len(spec.resblock_kernel_sizes)
+        for i in range(len(self.ups)):
+            x = F.leaky_relu(x, 0.1)
+            x_source = self.noise_convs[i](har)
+            x_source = self.noise_res[i](x_source, s)
+            x = self.ups[i](x)
+            if i == len(self.ups) - 1:
+                x = self.reflection_pad(x)
+            x = x + x_source
+            xs = None
+            for j in range(n_k):
+                h = self.resblocks[i * n_k + j](x, s)
+                xs = h if xs is None else xs + h
+            x = xs / n_k
+        x = F.leaky_relu(x)
+        x = self.conv_post(x)
+        bins = spec.gen_istft_n_fft // 2 + 1
+        mag = torch.exp(x[:, :bins])
+        phase = torch.sin(x[:, bins:])
+        return self.stft.inverse(mag, phase)
+
+
+class Decoder(nn.Module):
+    def __init__(self, spec):
+        super().__init__()
+        dh, sty = spec.decoder_hidden, spec.style_dim
+        din, ar = spec.hidden_dim, spec.asr_res_dim
+        self.encode = AdainResBlk1d(din + 2, dh, sty)
+        self.decode = nn.ModuleList([
+            AdainResBlk1d(dh + 2 + ar, dh, sty),
+            AdainResBlk1d(dh + 2 + ar, dh, sty),
+            AdainResBlk1d(dh + 2 + ar, dh, sty),
+            AdainResBlk1d(dh + 2 + ar, spec.upsample_initial_channel,
+                          sty, upsample=True),
+        ])
+        self.F0_conv = weight_norm(
+            nn.Conv1d(1, 1, kernel_size=3, stride=2, padding=1))
+        self.N_conv = weight_norm(
+            nn.Conv1d(1, 1, kernel_size=3, stride=2, padding=1))
+        self.asr_res = nn.Sequential(
+            weight_norm(nn.Conv1d(din, ar, kernel_size=1)))
+        self.generator = Generator(spec)
+
+    def forward(self, asr, f0_curve, n_curve, s, noise):
+        f0 = self.F0_conv(f0_curve.unsqueeze(1))
+        n = self.N_conv(n_curve.unsqueeze(1))
+        x = torch.cat([asr, f0, n], dim=1)
+        x = self.encode(x, s)
+        asr_res = self.asr_res(asr)
+        res = True
+        for block in self.decode:
+            if res:
+                x = torch.cat([x, asr_res, f0, n], dim=1)
+            x = block(x, s)
+            if block.upsample_type:
+                res = False
+        return self.generator(x, s, f0_curve, noise)
+
+
+def build_torch_model(spec: KokoroSpec, seed=0):
+    from transformers import AlbertConfig, AlbertModel
+
+    torch.manual_seed(seed)
+    bert = AlbertModel(AlbertConfig(
+        vocab_size=spec.plbert_vocab, hidden_size=spec.plbert_hidden,
+        embedding_size=spec.plbert_embedding,
+        num_attention_heads=spec.plbert_heads,
+        num_hidden_layers=spec.plbert_layers,
+        intermediate_size=spec.plbert_intermediate,
+        max_position_embeddings=spec.plbert_max_position,
+        num_hidden_groups=1,
+    ))
+    model = {
+        "bert": bert,
+        "bert_encoder": nn.Linear(spec.plbert_hidden, spec.hidden_dim),
+        "text_encoder": TextEncoder(
+            spec.hidden_dim, spec.text_encoder_kernel_size, spec.n_layer,
+            spec.n_token),
+        "predictor": ProsodyPredictor(
+            spec.style_dim, spec.hidden_dim, spec.n_layer, spec.max_dur),
+        "decoder": Decoder(spec),
+    }
+    for m in model.values():
+        m.eval()
+        # non-degenerate random weights (default init leaves some zeros)
+        with torch.no_grad():
+            for prm in m.parameters():
+                if prm.ndim > 0 and float(prm.abs().sum()) == 0.0:
+                    prm.add_(torch.randn_like(prm) * 0.05)
+    return model
+
+
+def torch_generate(model, spec, tokens, ref_s, speed, noise):
+    """Mirror of kokoro.py generate() (inference graph)."""
+    with torch.no_grad():
+        t = torch.tensor(tokens, dtype=torch.long)[None]
+        mask = torch.ones_like(t)
+        bert_dur = model["bert"](t, attention_mask=mask).last_hidden_state
+        d_en = model["bert_encoder"](bert_dur).transpose(-1, -2)
+        s = ref_s[:, spec.style_dim:]
+        ref = ref_s[:, :spec.style_dim]
+        pred = model["predictor"]
+        d = pred.text_encoder(d_en, s)
+        x, _ = pred.lstm(d)
+        duration = torch.sigmoid(
+            pred.duration_proj.linear_layer(x)).sum(-1) / speed
+        pred_dur = torch.round(duration).clamp(min=1).long()[0]
+        aln = torch.zeros(t.shape[1], int(pred_dur.sum()))
+        c = 0
+        for i, n in enumerate(pred_dur):
+            aln[i, c:c + int(n)] = 1
+            c += int(n)
+        en = d.transpose(-1, -2) @ aln
+        f0, n_c = pred.F0Ntrain(en, s)
+        t_en = model["text_encoder"](t)
+        asr = t_en @ aln
+        audio = model["decoder"](asr, f0, n_c, ref, noise)
+    return (bert_dur, d, pred_dur, f0, n_c, asr, audio)
+
+
+@pytest.fixture(scope="module")
+def kokoro_dir(tmp_path_factory):
+    """Official-layout checkpoint dir: config.json + .pth ({"net": ...},
+    one module with DataParallel prefixes) + voices/*.pt."""
+    root = tmp_path_factory.mktemp("kokoro")
+    spec = spec_from_config(CFG)
+    model = build_torch_model(spec)
+    net = {}
+    for name, m in model.items():
+        sd = m.state_dict()
+        if name == "decoder":  # exercise the "module." strip path
+            sd = {f"module.{k}": v for k, v in sd.items()}
+        net[name] = sd
+    torch.save({"net": net}, root / "kokoro-tiny.pth")
+    (root / "config.json").write_text(json.dumps(CFG))
+    vdir = root / "voices"
+    vdir.mkdir()
+    torch.manual_seed(7)
+    torch.save(torch.randn(32, 1, 2 * spec.style_dim) * 0.1,
+               vdir / "af.pt")
+    torch.save(torch.randn(32, 1, 2 * spec.style_dim) * 0.1,
+               vdir / "bf.pt")
+    return str(root), model, spec
+
+
+def test_detect_and_load(kokoro_dir):
+    root, _, spec = kokoro_dir
+    assert is_kokoro_dir(root)
+    jspec, params, voices = load_kokoro(root)
+    assert jspec == spec
+    assert set(voices) == {"af", "bf"}
+    assert voices["af"].shape == (32, 1, 2 * spec.style_dim)
+    # weight norm folded: no weight_g/_v survive, folded .weight exists
+    assert not any(k.endswith(("weight_g", "weight_v")) for k in params)
+    assert "decoder.generator.conv_post.weight" in params
+    # DataParallel prefix stripped
+    assert "decoder.encode.conv1.weight" in params
+
+
+def test_full_pipeline_torch_parity(kokoro_dir):
+    root, model, spec = kokoro_dir
+    _, params, voices = load_kokoro(root)
+    tokens = [0, 5, 9, 3, 14, 7, 2, 11, 0]
+    ref_np = pick_voice(voices, "af", len(tokens), spec.style_dim)
+    ref_t = torch.tensor(ref_np)
+
+    # exact parity needs a shared harmonic-source noise sample: compute
+    # the upsampled length from the torch duration prediction first
+    bert_ref, d_ref, dur_ref, f0_ref, n_ref, asr_ref, audio_ref = \
+        torch_generate(model, spec, tokens, ref_t, 1.0,
+                       torch.zeros(1, 1, 1))
+    t_up = 2 * int(dur_ref.sum()) * spec.total_upsample
+    torch.manual_seed(3)
+    noise = torch.randn(1, t_up, spec.harmonic_num + 1)
+    *_, audio_ref = torch_generate(model, spec, tokens, ref_t, 1.0, noise)
+
+    from localai_tfp_tpu.models import kokoro as K
+    import jax.numpy as jnp
+
+    jspec, p, _ = load_kokoro(root)
+    tok = jnp.asarray(np.asarray(tokens, np.int32))[None]
+    s_pros = jnp.asarray(ref_np[:, spec.style_dim:])
+
+    # module parity: PLBERT vs transformers.AlbertModel
+    bert_jax = K._albert(jspec, p, tok)
+    np.testing.assert_allclose(np.asarray(bert_jax),
+                               bert_ref.numpy(), rtol=2e-4, atol=2e-4)
+    # module parity: duration encoder stack + predicted durations
+    dur_jax, d_jax = K.durations(jspec, p, tok, s_pros)
+    np.testing.assert_allclose(np.asarray(d_jax), d_ref.numpy(),
+                               rtol=2e-4, atol=2e-4)
+    assert np.array_equal(np.asarray(dur_jax), dur_ref.numpy())
+    # module parity: text encoder (via the aligned asr features)
+    t_en = K._text_encoder(jspec, p, tok)
+    asr_jax = np.repeat(np.asarray(t_en), np.asarray(dur_jax), axis=-1)
+    np.testing.assert_allclose(asr_jax, asr_ref.numpy(),
+                               rtol=2e-4, atol=2e-4)
+    # module parity: prosody F0/N heads
+    en = jnp.repeat(jnp.swapaxes(d_jax, 1, 2), np.asarray(dur_jax),
+                    axis=-1)
+    f0_jax, n_jax = K._prosody_f0n(jspec, p, en, s_pros)
+    np.testing.assert_allclose(np.asarray(f0_jax), f0_ref.numpy(),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(n_jax), n_ref.numpy(),
+                               rtol=2e-3, atol=2e-3)
+    # end-to-end audio with the shared source noise
+    audio = synthesize_kokoro(jspec, p, tokens, ref_np,
+                              source_noise=noise.numpy())
+    ref = audio_ref[0].numpy()
+    assert audio.shape == ref.shape
+    np.testing.assert_allclose(audio, ref, rtol=5e-3, atol=5e-3)
+
+
+def test_voice_blending(kokoro_dir):
+    root, _, spec = kokoro_dir
+    _, _, voices = load_kokoro(root)
+    a = pick_voice(voices, "af", 5, spec.style_dim)
+    b = pick_voice(voices, "bf", 5, spec.style_dim)
+    ab = pick_voice(voices, "af+bf", 5, spec.style_dim)
+    np.testing.assert_allclose(ab, (a + b) / 2, rtol=1e-6)
+    # token-count indexing clamps to the pack
+    long = pick_voice(voices, "af", 999, spec.style_dim)
+    assert long.shape == (1, 2 * spec.style_dim)
+
+
+def test_tts_worker_serves_kokoro(kokoro_dir, tmp_path):
+    from localai_tfp_tpu.workers.base import ModelLoadOptions
+    from localai_tfp_tpu.workers.tts import JaxTTSBackend
+
+    root, _, _ = kokoro_dir
+    be = JaxTTSBackend()
+    res = be.load_model(ModelLoadOptions(model=root))
+    assert res.success, res.message
+    dst = str(tmp_path / "out.wav")
+    r = be.tts("hello world", voice="af", dst=dst)
+    assert r.success and os.path.exists(dst)
+    import wave
+
+    with wave.open(dst) as w:
+        assert w.getframerate() == 24000
+        assert w.getnframes() > 0
