@@ -162,14 +162,21 @@ def _bench_http(eng, tok, n_req, n_tok, runs=2):
         url = f"http://127.0.0.1:{port}/v1/chat/completions"
         async with ClientSession(
             connector=TCPConnector(limit=0),
-            timeout=ClientTimeout(total=600),
+            # generous: a warmup wave may sit behind a cold jit of a
+            # prefill variant (minutes at 8B through the AOT path); the
+            # persistent compile cache makes later runs immune
+            timeout=ClientTimeout(total=3600),
         ) as sess:
 
             async def one(i, t0, ttfts):
                 body = {
                     "model": "bench",
+                    # the chat template adds ~17 tokens ("user: ",
+                    # "\nassistant:", BOS); 10 reps keeps the templated
+                    # prompt inside the SAME 128-token prefill bucket as
+                    # the engine leg, so the legs share compiled variants
                     "messages": [{"role": "user",
-                                  "content": "benchmark " * 12 + str(i)}],
+                                  "content": "benchmark " * 10 + str(i)}],
                     "max_tokens": n_tok, "stream": True,
                     "temperature": 0.8, "top_k": 40, "top_p": 0.95,
                     "ignore_eos": True,

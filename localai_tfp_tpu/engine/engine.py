@@ -1102,7 +1102,6 @@ class LLMEngine:
     def _admit(self) -> None:
         with self._lock:
             pending, self._pending = self._pending, []
-        assigned: list[_Slot] = []
         for req, out in pending:
             with self._lock:
                 if req.id in self._cancelled:  # cancel raced ahead
@@ -1116,7 +1115,6 @@ class LLMEngine:
                     self._pending.append((req, out))
                 continue
             self._assign(slot, req, out)
-            assigned.append(slot)
 
     def _reset_columns(self, group: list[_Slot], pad_to: int) -> dict:
         """Per-slot sampler-reset columns for a prefill_final group. The
@@ -1301,7 +1299,8 @@ class LLMEngine:
         slot.constraint_state = (
             req.constraint.initial_state() if req.constraint else None
         )
-        self._epoch += 1  # sampler reset is batched per wave (_admit)
+        self._epoch += 1  # sampler reset rides the slot's prefill_final
+        # dispatch (_reset_columns), before its first sample
 
     def _bucket(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -1338,15 +1337,29 @@ class LLMEngine:
     def _prefill_final_step(self, group: list[_Slot], bucket: int) -> None:
         """Finish a batch of same-bucket prompts: one fused dispatch runs
         the final chunks, seeds the penalty windows, and samples each
-        slot's first token. The group is padded UP to a power of two with
-        sentinel rows pointing at the out-of-bounds slot id ``n_slots``:
-        JAX drops out-of-bounds scatter updates and clamps out-of-bounds
-        gathers, so a pad row is pure discarded compute that never
-        touches engine state. (Rounding DOWN and deferring the remainder
+        slot's first token. The group is padded UP with sentinel rows
+        pointing at the out-of-bounds slot id ``n_slots``: JAX drops
+        out-of-bounds scatter updates and clamps out-of-bounds gathers,
+        so a pad row is pure discarded compute that never touches
+        engine state. (Rounding DOWN and deferring the remainder
         — the previous scheme — turned one ragged 63-request wave into
         SIX dispatches of six distinct jit shapes; under HTTP arrival
-        raggedness that compile churn collapsed endpoint throughput.)"""
-        B = 1 << max(len(group) - 1, 0).bit_length()
+        raggedness that compile churn collapsed endpoint throughput.)
+        Group sizes come from {1, 8, 32} (capped at n_slots): at
+        8B-class sizes one compile costs minutes through the AOT path,
+        so the variant set must stay tiny — three sizes cover any
+        admission pattern at <=8x padded compute, and padded rows are
+        bandwidth-free (no new weights are read). The cap at 32 also
+        STAGGERS a deep burst: a 64-wave prefills as two dispatches, so
+        the first half's TTFT is one half-wave, not the whole wave —
+        p50 math: with per-dispatch overhead o and per-request compute
+        c, p50 over an n-wave in groups of g is ~(n/2)c + (n/2)(o/g),
+        minimized by the largest g that still splits the wave."""
+        group = group[: min(32, max(self.n_slots, 1))]
+        B = 1
+        while B < len(group):
+            B *= 8
+        B = min(B, 32, max(self.n_slots, 1))
         t0 = time.perf_counter()
         W = self.sampling.window
         toks = np.zeros((B, bucket), np.int32)

@@ -128,12 +128,15 @@ def reset_slots(
     seeds: jax.Array,  # [K] i32
     has_seed: jax.Array,  # [K] bool
 ) -> SamplingState:
-    """Configure a BATCH of slots in one donated dispatch.
+    """Configure a BATCH of slots in one dispatch (it rides the
+    prefill_final dispatch — engine._reset_columns).
 
     ``reset_slot`` costs ~12 unbatched buffer copies per slot (including
     the [S, V] count matrix) — ~25ms/slot through a tunneled chip, which
-    dominated admission waves. Duplicate padding rows must carry row 0's
-    values so the scatter stays deterministic."""
+    dominated admission waves. Padding rows point at the OUT-OF-BOUNDS
+    slot id n_slots: JAX drops their scatter updates (and clamps their
+    gathers), so they never touch live sampler state. Do NOT pad with a
+    live slot id — a duplicate index would clobber that slot."""
     keys = jax.vmap(jax.random.PRNGKey)(seeds)  # [K, 2]
     rng_rows = jnp.where(has_seed[:, None], keys, state.rng[slot_ids])
     return SamplingState(

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 from aiohttp import web
@@ -17,6 +18,17 @@ from aiohttp import web
 from ..config.model_config import ModelConfig, Usecase
 from ..workers.base import Backend
 from .state import Application
+
+# Every streaming response parks a blocking producer thread for its WHOLE
+# stream duration, and every non-stream inference parks one for the call.
+# asyncio's default executor caps at cpu_count+4 threads — FIVE on a
+# 1-vCPU host — so under a 64-deep SSE burst only 5 requests ever reached
+# the engine at once: the serving batch collapsed and the rest queued for
+# minutes (measured: 0.07x engine throughput through the endpoint).
+# Blocked threads are cheap (they sleep in queue.get); size for peak
+# concurrent streams, not cores.
+WORKER_POOL = ThreadPoolExecutor(max_workers=256,
+                                 thread_name_prefix="srv-blocking")
 
 
 def state_of(request: web.Request) -> Application:
@@ -37,7 +49,7 @@ def resolve_config(request: web.Request, name: Optional[str],
 async def load_backend(request: web.Request, cfg: ModelConfig) -> Backend:
     st = state_of(request)
     return await asyncio.get_running_loop().run_in_executor(
-        None, st.model_loader.load, cfg)
+        WORKER_POOL, st.model_loader.load, cfg)
 
 
 async def acquire(request: web.Request, name: Optional[str],
@@ -58,4 +70,5 @@ def busy(st: Application, model_name: str):
 
 
 async def run_blocking(fn, *args):
-    return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
+    return await asyncio.get_running_loop().run_in_executor(
+        WORKER_POOL, fn, *args)

@@ -18,6 +18,7 @@ from ..config.model_config import Usecase
 from ..version import __version__
 from ..workers.base import PredictOptions
 from . import schema
+from .common import WORKER_POOL, run_blocking
 from .state import Application
 
 
@@ -180,16 +181,15 @@ async def _tts_impl(request: web.Request, text: str, model_name,
     cfg = st.config_loader.resolve(model_name, Usecase.TTS)
     if cfg is None:
         raise web.HTTPNotFound(reason="no TTS model available")
-    backend = await asyncio.get_running_loop().run_in_executor(
-        None, st.model_loader.load, cfg
-    )
+    backend = await run_blocking(st.model_loader.load, cfg)
     import os
     import uuid as _uuid
 
     dst = os.path.join(st.config.generated_content_dir,
                        f"tts-{_uuid.uuid4().hex}.wav")
-    res = backend.tts(text=text, voice=voice or cfg.tts.voice, dst=dst,
-                      language=language)
+    res = await run_blocking(
+        lambda: backend.tts(text=text, voice=voice or cfg.tts.voice,
+                            dst=dst, language=language))
     if not res.success:
         raise web.HTTPInternalServerError(reason=res.message)
     return web.FileResponse(dst)
@@ -225,16 +225,13 @@ async def sound_generation(request: web.Request) -> web.Response:
                                    Usecase.SOUND_GENERATION)
     if cfg is None:
         raise web.HTTPNotFound(reason="no sound-generation model available")
-    backend = await asyncio.get_running_loop().run_in_executor(
-        None, st.model_loader.load, cfg
-    )
+    backend = await run_blocking(st.model_loader.load, cfg)
     import os
     import uuid as _uuid
 
     dst = os.path.join(st.config.generated_content_dir,
                        f"sound-{_uuid.uuid4().hex}.wav")
-    res = await asyncio.get_running_loop().run_in_executor(
-        None, lambda: backend.sound_generation(
+    res = await run_blocking(lambda: backend.sound_generation(
             text=req.text, dst=dst,
             duration=req.duration,
             temperature=1.0 if req.temperature is None
@@ -256,10 +253,8 @@ async def vad(request: web.Request) -> web.Response:
     cfg = st.config_loader.resolve(body.get("model"), Usecase.VAD)
     if cfg is None:
         raise web.HTTPNotFound(reason="no VAD model available")
-    backend = await asyncio.get_running_loop().run_in_executor(
-        None, st.model_loader.load, cfg
-    )
-    res = backend.vad(body.get("audio") or [])
+    backend = await run_blocking(st.model_loader.load, cfg)
+    res = await run_blocking(backend.vad, body.get("audio") or [])
     return web.json_response({
         "segments": [{"start": s.start, "end": s.end} for s in res.segments]
     })
@@ -273,14 +268,10 @@ async def rerank(request: web.Request) -> web.Response:
     cfg = st.config_loader.resolve(body.get("model"), Usecase.RERANK)
     if cfg is None:
         raise web.HTTPNotFound(reason="no rerank model available")
-    backend = await asyncio.get_running_loop().run_in_executor(
-        None, st.model_loader.load, cfg
-    )
+    backend = await run_blocking(st.model_loader.load, cfg)
     docs = body.get("documents") or []
-    res = await asyncio.get_running_loop().run_in_executor(
-        None, backend.rerank, body.get("query", ""), docs,
-        int(body.get("top_n") or len(docs)),
-    )
+    res = await run_blocking(backend.rerank, body.get("query", ""),
+                             docs, int(body.get("top_n") or len(docs)))
     return web.json_response({
         "model": cfg.name,
         "usage": res.usage,
@@ -372,8 +363,7 @@ async def models_delete(request: web.Request) -> web.Response:
 
 async def models_available(request: web.Request) -> web.Response:
     st = _state(request)
-    models = await asyncio.get_running_loop().run_in_executor(
-        None, st.gallery.available_models)
+    models = await run_blocking(st.gallery.available_models)
     return web.json_response([
         {
             "name": m.name, "description": m.description,
@@ -479,9 +469,7 @@ async def stores_dispatch(request: web.Request) -> web.Response:
              "backend": "local-store"}
         )
         st.config_loader.register(cfg)
-    backend = await asyncio.get_running_loop().run_in_executor(
-        None, st.model_loader.load, cfg
-    )
+    backend = await run_blocking(st.model_loader.load, cfg)
     op = request.path.rsplit("/", 1)[-1]
     if op == "set":
         backend.stores_set(body.get("keys") or [], body.get("values") or [])

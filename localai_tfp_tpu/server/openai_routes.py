@@ -26,6 +26,7 @@ from ..grammars.json_schema import functions_grammar, schema_to_gbnf
 from ..grammars.parse import parse_function_call, parse_text_content
 from ..workers.base import Backend, PredictOptions, Reply
 from . import schema
+from .common import WORKER_POOL, run_blocking
 from .state import Application
 
 
@@ -80,8 +81,7 @@ def _resolve_config(request: web.Request, body: dict,
 
 async def _load_backend(request: web.Request, cfg: ModelConfig) -> Backend:
     st = _state(request)
-    loop = asyncio.get_running_loop()
-    return await loop.run_in_executor(None, st.model_loader.load, cfg)
+    return await run_blocking(st.model_loader.load, cfg)
 
 
 _MEDIA_MAX_BYTES = 32 << 20  # cap per fetched image
@@ -290,7 +290,7 @@ def _completion_id(prefix: str = "chatcmpl") -> str:
 
 async def _run_predict(backend: Backend, opts: PredictOptions) -> Reply:
     loop = asyncio.get_running_loop()
-    return await loop.run_in_executor(None, backend.predict, opts)
+    return await loop.run_in_executor(WORKER_POOL, backend.predict, opts)
 
 
 # ------------------------------------------------------------------- chat
@@ -443,7 +443,7 @@ async def _stream_chat(
             )
         loop.call_soon_threadsafe(q.put_nowait, None)
 
-    loop.run_in_executor(None, producer)
+    loop.run_in_executor(WORKER_POOL, producer)
 
     buffered = ""
     final: Optional[Reply] = None
@@ -582,7 +582,7 @@ async def _stream_completion(request, backend, opts, cfg, cid, created,
             )
         loop.call_soon_threadsafe(q.put_nowait, None)
 
-    loop.run_in_executor(None, producer)
+    loop.run_in_executor(WORKER_POOL, producer)
     final = None
     try:
         while True:
@@ -671,9 +671,8 @@ async def embeddings(request: web.Request) -> web.Response:
     loop = asyncio.get_running_loop()
     data = []
     for i, text in enumerate(inputs):
-        res = await loop.run_in_executor(
-            None, backend.embedding, PredictOptions(embeddings=str(text))
-        )
+        res = await run_blocking(backend.embedding,
+                                 PredictOptions(embeddings=str(text)))
         data.append({
             "object": "embedding",
             "index": i,
