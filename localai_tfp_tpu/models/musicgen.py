@@ -94,7 +94,13 @@ def t5_encode(spec: T5Spec, p: Params, ids: jax.Array) -> jax.Array:
                           precision=lax.Precision.HIGHEST)
         x = x + attn.reshape(B, S, H * Dk) @ lp["wo"]
         h = _t5_ln(x, lp["ln2"], spec.eps)
-        x = x + jax.nn.relu(h @ lp["wi"]) @ lp["wo_ff"]
+        if "wi_0" in lp:
+            # v1.1 gated-gelu variant (SD3/Flux T5-XXL class encoders —
+            # models/mmdit.py loads them onto this same layout)
+            x = x + (jax.nn.gelu(h @ lp["wi_0"], approximate=True)
+                     * (h @ lp["wi_1"])) @ lp["wo_ff"]
+        else:
+            x = x + jax.nn.relu(h @ lp["wi"]) @ lp["wo_ff"]
     return _t5_ln(x, p["final_ln"], spec.eps)
 
 

@@ -40,7 +40,8 @@ from jax import lax
 # ---------------------------------------------------------------------------
 
 _EMBED_MARKERS = ("token_embedding", "position_embedding",
-                  "shared.weight", "embeddings.weight")
+                  "shared.weight", "embeddings.weight",
+                  "relative_attention_bias")  # T5 bias table (Embedding)
 
 
 def _is_embedding(key: str) -> bool:

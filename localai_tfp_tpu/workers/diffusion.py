@@ -85,6 +85,23 @@ class JaxDiffusionBackend(Backend):
                                              model_dir)
                 if model_dir and os.path.exists(
                         os.path.join(model_dir, "model_index.json")):
+                    # pipeline-class switch (ref: diffusers backend.py
+                    # :139-272 pipeline type dispatch)
+                    from ..models.mmdit import pipeline_class_name
+
+                    cls_name = pipeline_class_name(model_dir)
+                    if cls_name.startswith("StableDiffusion3"):
+                        from ..models.mmdit import SD3Pipeline
+
+                        self._sd = SD3Pipeline.load(model_dir)
+                        self._state = "READY"
+                        return Result(True, "sd3 pipeline ready")
+                    if cls_name.startswith("Flux"):
+                        from ..models.mmdit import FluxPipeline
+
+                        self._sd = FluxPipeline.load(model_dir)
+                        self._state = "READY"
+                        return Result(True, "flux pipeline ready")
                     from ..models.sd import SDPipeline
 
                     self._sd = SDPipeline.load(model_dir)
