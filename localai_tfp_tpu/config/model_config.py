@@ -42,6 +42,11 @@ class SamplingParams:
     repeat_last_n: int = 64
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
+    # mirostat adaptive sampling (ref: backend_config.go:116-118,
+    # SetDefaults :300-302: mirostat=0, tau=5.0, eta=0.1)
+    mirostat: Optional[int] = None
+    mirostat_tau: Optional[float] = None
+    mirostat_eta: Optional[float] = None
     seed: Optional[int] = None
     negative_prompt: str = ""
     rope_freq_base: float = 0.0

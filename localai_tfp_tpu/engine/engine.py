@@ -65,6 +65,10 @@ class GenRequest:
     repeat_last_n: int = 64
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
+    typical_p: float = 1.0  # locally typical sampling (>=1 disabled)
+    mirostat: int = 0  # 0 off | 1 v1 | 2 v2 (ref: grpc-server.cpp:708)
+    mirostat_tau: float = 5.0
+    mirostat_eta: float = 0.1
     seed: Optional[int] = None
     stop: list[str] = field(default_factory=list)
     ignore_eos: bool = False
@@ -99,6 +103,10 @@ class _PadReq:
     frequency_penalty = 0.0
     presence_penalty = 0.0
     repeat_last_n = 0
+    typical_p = 1.0
+    mirostat = 0
+    mirostat_tau = 5.0
+    mirostat_eta = 0.1
     seed = None
 
 
@@ -675,15 +683,17 @@ class LLMEngine:
 
     @staticmethod
     def _spec_eligible(s: _Slot) -> bool:
-        """Penalty/grammar/bias/multimodal slots need per-token sampler
-        state the speculative path does not thread (mm: the draft cache
-        never saw the image soft tokens)."""
+        """Penalty/grammar/bias/multimodal/mirostat slots need per-token
+        sampler state the speculative path does not thread (mm: the draft
+        cache never saw the image soft tokens; mirostat: mu adapts per
+        emitted token)."""
         r = s.request
         return not (
             r is None or r.constraint or r.logit_bias
             or r.repeat_penalty not in (0.0, 1.0)
             or r.frequency_penalty or r.presence_penalty
             or r.soft_embeds is not None
+            or r.mirostat
         )
 
     def _spec_mode(
@@ -877,7 +887,8 @@ class LLMEngine:
             reset = tuple(jnp.asarray(p["reset"][k]) for k in (
                 "temperature", "top_k", "top_p", "min_p",
                 "repeat_penalty", "freq_penalty", "presence_penalty",
-                "repeat_last_n", "seeds", "has_seed"))
+                "repeat_last_n", "seeds", "has_seed",
+                "typical_p", "mirostat", "mirostat_tau", "mirostat_eta"))
             toks_out, self.cache, self.sampling = self._prefill_final_fn(
                 p.get("window", self.max_seq))(
                 self.params, toks, self.cache, pos0, self.sampling, sids,
@@ -1127,7 +1138,8 @@ class LLMEngine:
         cols: dict[str, list] = {k: [] for k in (
             "temperature", "top_k", "top_p", "min_p",
             "repeat_penalty", "freq_penalty", "presence_penalty",
-            "repeat_last_n", "seeds", "has_seed")}
+            "repeat_last_n", "seeds", "has_seed",
+            "typical_p", "mirostat", "mirostat_tau", "mirostat_eta")}
         pad = _PadReq()
         for s in list(group) + [None] * (pad_to - len(group)):
             r = s.request if s is not None else pad
@@ -1147,6 +1159,10 @@ class LLMEngine:
             cols["seeds"].append(seed - (1 << 32) if seed >= (1 << 31)
                                  else seed)
             cols["has_seed"].append(r.seed is not None)
+            cols["typical_p"].append(r.typical_p)
+            cols["mirostat"].append(r.mirostat)
+            cols["mirostat_tau"].append(r.mirostat_tau)
+            cols["mirostat_eta"].append(r.mirostat_eta)
         return {
             "temperature": np.asarray(cols["temperature"], np.float32),
             "top_k": np.asarray(cols["top_k"], np.int32),
@@ -1159,6 +1175,10 @@ class LLMEngine:
             "repeat_last_n": np.asarray(cols["repeat_last_n"], np.int32),
             "seeds": np.asarray(cols["seeds"], np.int32),
             "has_seed": np.asarray(cols["has_seed"], bool),
+            "typical_p": np.asarray(cols["typical_p"], np.float32),
+            "mirostat": np.asarray(cols["mirostat"], np.int32),
+            "mirostat_tau": np.asarray(cols["mirostat_tau"], np.float32),
+            "mirostat_eta": np.asarray(cols["mirostat_eta"], np.float32),
         }
 
     def _pick_slot(self, req: GenRequest) -> Optional[_Slot]:
@@ -1345,11 +1365,14 @@ class LLMEngine:
         — the previous scheme — turned one ragged 63-request wave into
         SIX dispatches of six distinct jit shapes; under HTTP arrival
         raggedness that compile churn collapsed endpoint throughput.)
-        Group sizes come from {1, 8, 32} (capped at n_slots): at
-        8B-class sizes one compile costs minutes through the AOT path,
-        so the variant set must stay tiny — three sizes cover any
-        admission pattern at <=8x padded compute, and padded rows are
-        bandwidth-free (no new weights are read). The cap at 32 also
+        Group sizes come from {1, 8, 32} capped at min(32, n_slots) —
+        when n_slots is not itself in {1, 8, 32} the cap introduces ONE
+        extra variant (e.g. n_slots=6 gives B=6), so the compile surface
+        is at most four sizes (ADVICE r3 #3). At 8B-class sizes one
+        compile costs minutes through the AOT path, so the variant set
+        must stay tiny — these sizes cover any admission pattern at
+        <=8x padded compute, and padded rows are bandwidth-free (no new
+        weights are read). The cap at 32 also
         STAGGERS a deep burst: a 64-wave prefills as two dispatches, so
         the first half's TTFT is one half-wave, not the whole wave —
         p50 math: with per-dispatch overhead o and per-request compute
@@ -1470,6 +1493,11 @@ class LLMEngine:
             if req is not None and req.logit_bias:
                 if mask is None:
                     mask = np.ones(V, bool)
+                else:
+                    # next_mask returns cached/shared arrays — mutating
+                    # in place would ban these tokens for every later
+                    # request sharing the constraint
+                    mask = mask.copy()
                 for tid, bias in req.logit_bias.items():
                     if 0 <= int(tid) < V and bias <= -100:
                         mask[int(tid)] = False

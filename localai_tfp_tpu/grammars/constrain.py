@@ -87,6 +87,10 @@ class GrammarConstraint:
             return state  # eos / special token: state unchanged (terminal)
         return self.matcher.accept_string(state, s)
 
+    def accept_text(self, state: MatchState, text: str) -> MatchState:
+        """Feed raw text (symmetric with NativeGrammarConstraint)."""
+        return self.matcher.accept_string(state, text)
+
     def next_mask(self, state: MatchState) -> np.ndarray:
         cached = self._mask_cache.get(state)
         if cached is not None:
@@ -108,6 +112,80 @@ class GrammarConstraint:
         if len(self._mask_cache) < 4096:
             self._mask_cache[state] = mask
         return mask
+
+
+class LazyGrammarConstraint:
+    """Trigger-gated grammar (ref: grpc-server.cpp:2441-2454 grammar_lazy
+    + grammar_triggers; pkg/functions/parse.go:51 `triggers:` yaml).
+
+    The grammar stays DORMANT — generation unconstrained — until one of
+    the trigger words appears in the generated text; from the trigger
+    boundary on, the inner grammar constrains decoding, and the text
+    from the trigger onward (trigger word included, llama.cpp
+    semantics) is fed to it. This is how text-then-tool-call models
+    work: prose preamble free-form, `<function=...` onward constrained.
+
+    Wraps any constraint implementing the engine contract
+    (initial_state/next_mask/advance) plus a ``tokenizer`` attribute.
+    State: ("d", tail) while dormant, ("a", inner_state) once active.
+    """
+
+    def __init__(self, inner, triggers: list[str], tokenizer) -> None:
+        self.triggers = [t for t in triggers if t]
+        assert self.triggers, (
+            "use the inner constraint when there are no triggers")
+        self.inner = inner
+        self.tokenizer = tokenizer
+        self.vocab_size = inner.vocab_size
+        self._max_trig = max(len(t) for t in self.triggers)
+        self._free = np.ones(self.vocab_size, dtype=bool)
+        # callers (engine logit_bias path) must not corrupt the shared
+        # dormant mask in place — they copy before mutating, and this
+        # flag turns any violation into a loud error
+        self._free.setflags(write=False)
+        strs = getattr(inner, "_token_strs", None)
+        if strs is not None:  # reuse the inner table: a 128k-vocab
+            # decode loop is seconds of first-request latency
+            self._token_strs = strs
+        else:
+            self._token_strs = [None] * self.vocab_size
+            for tid in range(self.vocab_size):
+                try:
+                    s = tokenizer.decode([tid])
+                except Exception:
+                    continue
+                if s and "�" not in s:
+                    self._token_strs[tid] = s
+
+    def initial_state(self):
+        return ("d", "")
+
+    def next_mask(self, state) -> np.ndarray:
+        kind, st = state
+        if kind == "d":
+            return self._free
+        return self.inner.next_mask(st)
+
+    def advance(self, state, token_id: int):
+        kind, st = state
+        if kind == "a":
+            return ("a", self.inner.advance(st, token_id))
+        s = self._token_strs[token_id] if token_id < self.vocab_size else None
+        if not s:
+            return state
+        tail = st + s
+        # a trigger fully inside the OLD tail would have fired then, so
+        # scanning the whole (bounded) tail is idempotent-safe
+        hit = min((p for p in (tail.find(t) for t in self.triggers)
+                   if p >= 0), default=-1)
+        if hit >= 0:
+            # grammar receives the trigger word and everything after it
+            return ("a", self.inner.accept_text(
+                self.inner.initial_state(), tail[hit:]))
+        # bound the rolling tail: a trigger can straddle token
+        # boundaries, so keep max_trigger-1 chars of lookbehind
+        return ("d", tail[-(self._max_trig - 1):] if self._max_trig > 1
+                else "")
 
 
 class JSONConstraint(GrammarConstraint):

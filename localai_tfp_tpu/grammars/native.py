@@ -115,13 +115,26 @@ class NativeGrammarConstraint:
         return self.can_end(st)
 
 
-def make_constraint(gbnf_text: str, tokenizer):
-    """Factory: native engine when built, Python fallback otherwise."""
+def make_constraint(gbnf_text: str, tokenizer,
+                    triggers: Optional[list[str]] = None):
+    """Factory: native engine when built, Python fallback otherwise.
+    ``triggers`` (ref: grpc-server.cpp:2441-2454 grammar_lazy) gates the
+    grammar behind the first occurrence of a trigger word in the
+    generated text."""
     if available():
         try:
-            return NativeGrammarConstraint(gbnf_text, tokenizer)
+            inner = NativeGrammarConstraint(gbnf_text, tokenizer)
         except (RuntimeError, ValueError):
-            pass
-    from .constrain import GrammarConstraint
+            inner = None
+    else:
+        inner = None
+    if inner is None:
+        from .constrain import GrammarConstraint
 
-    return GrammarConstraint.from_gbnf(gbnf_text, tokenizer)
+        inner = GrammarConstraint.from_gbnf(gbnf_text, tokenizer)
+    live = [t for t in (triggers or []) if t]
+    if live:
+        from .constrain import LazyGrammarConstraint
+
+        return LazyGrammarConstraint(inner, live, tokenizer)
+    return inner

@@ -651,14 +651,21 @@ def pick_voice(voices: dict, name: str, n_tokens: int,
         raise ValueError("kokoro model has no voicepacks")
     if name and "+" in name:
         parts = [v.strip() for v in name.split("+")]
-        packs = [voices[v] for v in parts if v in voices]
-        if not packs:
-            packs = [next(iter(voices.values()))]
-        pack = np.mean(np.stack(packs), axis=0)
+        missing = [v for v in parts if v not in voices]
+        if missing:
+            # the reference backend fails the load on an unknown voice —
+            # a typo must not silently produce a different voice
+            raise ValueError(
+                f"unknown voice(s) {missing}; available: "
+                f"{sorted(voices)}")
+        pack = np.mean(np.stack([voices[v] for v in parts]), axis=0)
+    elif name:
+        if name not in voices:
+            raise ValueError(
+                f"unknown voice {name!r}; available: {sorted(voices)}")
+        pack = voices[name]
     else:
-        pack = voices.get(name) if name else None
-        if pack is None:
-            pack = next(iter(voices.values()))
+        pack = next(iter(voices.values()))
     idx = min(n_tokens, pack.shape[0] - 1)
     return pack[idx].reshape(1, -1)
 

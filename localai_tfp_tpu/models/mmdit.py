@@ -546,11 +546,12 @@ class SD3Pipeline:
         )
 
     def encode_prompt(self, prompt: str,
-                      t5_len: int = 77) -> tuple[jax.Array, jax.Array]:
+                      t5_len: int = 256) -> tuple[jax.Array, jax.Array]:
         """(ctx [1, 77+t5_len, 4096], pooled [1, 2048]): both CLIP
         penultimate states feature-concatenated and zero-padded to the
         T5 width, then sequence-concatenated with the T5 states (ref:
-        StableDiffusion3Pipeline.encode_prompt)."""
+        StableDiffusion3Pipeline.encode_prompt, whose
+        max_sequence_length default is 256 — ADVICE r3 #1)."""
         from .musicgen import t5_encode
 
         def ids(tok, max_len):

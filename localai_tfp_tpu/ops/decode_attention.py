@@ -307,7 +307,14 @@ def mesh_kernel_eligible(mesh, n_kv_heads: int, n_heads: int,
     """Whether the fused kernel can run under ``shard_map`` on this
     serving mesh: kv heads split evenly over "model" (attention is
     GQA-head-local, so each shard's kernel call needs a whole kv-head
-    band with full 128-lane rows) and slots split evenly over "data"."""
+    band with full 128-lane rows) and slots split evenly over "data".
+
+    A nontrivial "seq" axis is tolerated but NOT partitioned over: the
+    KV cache is never seq-sharded at decode time, so
+    ``sharded_append_attend``'s specs replicate the kernel body across
+    seq shards — redundant compute per decode step, never incorrect
+    (ADVICE r3 #4). Serving meshes that want decode efficiency should
+    keep seq=1 and spend those chips on "data"/"model"."""
     tp = mesh.shape.get("model", 1)
     dp = mesh.shape.get("data", 1)
     return (

@@ -17,6 +17,7 @@ incrementally on-device: O(1) per step instead of re-scanning history.
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 from functools import partial
 from typing import Optional
@@ -48,6 +49,11 @@ class SamplingState:
     history: jax.Array  # [S, W] i32 ring buffer of recent tokens (-1 empty)
     history_pos: jax.Array  # [S] i32 ring write cursor
     repeat_last_n: jax.Array  # [S] i32 effective window size (<= W)
+    typical_p: jax.Array  # [S] f32; >=1 => disabled (locally typical)
+    mirostat: jax.Array  # [S] i32; 0 off, 1 v1, 2 v2
+    mirostat_tau: jax.Array  # [S] f32 target surprise (bits)
+    mirostat_eta: jax.Array  # [S] f32 learning rate
+    mirostat_mu: jax.Array  # [S] f32 adaptive cutoff (2*tau at reset)
 
     @classmethod
     def create(cls, n_slots: int, vocab_size: int, window: int = 256,
@@ -66,6 +72,11 @@ class SamplingState:
             history=jnp.full((n_slots, window), -1, jnp.int32),
             history_pos=jnp.zeros((n_slots,), jnp.int32),
             repeat_last_n=jnp.full((n_slots,), min(64, window), jnp.int32),
+            typical_p=jnp.ones((n_slots,), jnp.float32),
+            mirostat=jnp.zeros((n_slots,), jnp.int32),
+            mirostat_tau=jnp.full((n_slots,), 5.0, jnp.float32),
+            mirostat_eta=jnp.full((n_slots,), 0.1, jnp.float32),
+            mirostat_mu=jnp.full((n_slots,), 10.0, jnp.float32),
         )
 
     @property
@@ -76,7 +87,9 @@ class SamplingState:
                    top_k: int = 0, top_p: float = 1.0, min_p: float = 0.0,
                    repeat_penalty: float = 0.0, freq_penalty: float = 0.0,
                    presence_penalty: float = 0.0, repeat_last_n: int = 64,
-                   seed: Optional[int] = None) -> "SamplingState":
+                   seed: Optional[int] = None, typical_p: float = 1.0,
+                   mirostat: int = 0, mirostat_tau: float = 5.0,
+                   mirostat_eta: float = 0.1) -> "SamplingState":
         """Host-side: configure one slot for a new request."""
         s = slot
         st = self
@@ -98,15 +111,20 @@ class SamplingState:
             repeat_last_n=st.repeat_last_n.at[s].set(
                 min(repeat_last_n if repeat_last_n > 0 else 64, st.window)
             ),
+            typical_p=st.typical_p.at[s].set(typical_p),
+            mirostat=st.mirostat.at[s].set(mirostat),
+            mirostat_tau=st.mirostat_tau.at[s].set(mirostat_tau),
+            mirostat_eta=st.mirostat_eta.at[s].set(mirostat_eta),
+            # mirostat's adaptive cutoff starts at 2*tau (the paper's and
+            # llama.cpp's initialisation)
+            mirostat_mu=st.mirostat_mu.at[s].set(2.0 * mirostat_tau),
         )
 
 
 jax.tree_util.register_pytree_node(
     SamplingState,
     lambda s: (
-        (s.rng, s.temperature, s.top_k, s.top_p, s.min_p, s.repeat_penalty,
-         s.freq_penalty, s.presence_penalty, s.token_counts, s.history,
-         s.history_pos, s.repeat_last_n),
+        tuple(getattr(s, f.name) for f in dataclasses.fields(s)),
         None,
     ),
     lambda _, ch: SamplingState(*ch),
@@ -127,6 +145,10 @@ def reset_slots(
     repeat_last_n: jax.Array,  # [K] i32 (already clamped host-side)
     seeds: jax.Array,  # [K] i32
     has_seed: jax.Array,  # [K] bool
+    typical_p: jax.Array,  # [K] f32
+    mirostat: jax.Array,  # [K] i32
+    mirostat_tau: jax.Array,  # [K] f32
+    mirostat_eta: jax.Array,  # [K] f32
 ) -> SamplingState:
     """Configure a BATCH of slots in one dispatch (it rides the
     prefill_final dispatch — engine._reset_columns).
@@ -153,6 +175,11 @@ def reset_slots(
         history=state.history.at[slot_ids].set(-1),
         history_pos=state.history_pos.at[slot_ids].set(0),
         repeat_last_n=state.repeat_last_n.at[slot_ids].set(repeat_last_n),
+        typical_p=state.typical_p.at[slot_ids].set(typical_p),
+        mirostat=state.mirostat.at[slot_ids].set(mirostat),
+        mirostat_tau=state.mirostat_tau.at[slot_ids].set(mirostat_tau),
+        mirostat_eta=state.mirostat_eta.at[slot_ids].set(mirostat_eta),
+        mirostat_mu=state.mirostat_mu.at[slot_ids].set(2.0 * mirostat_tau),
     )
 
 
@@ -186,19 +213,9 @@ def observe_tokens(state: SamplingState, slot_ids: jax.Array,
         jnp.where(valid, tokens, state.history[slot_ids, pos % W])
     )
     newpos = jnp.where(valid, pos + 1, pos)
-    return SamplingState(
-        rng=state.rng,
-        temperature=state.temperature,
-        top_k=state.top_k,
-        top_p=state.top_p,
-        min_p=state.min_p,
-        repeat_penalty=state.repeat_penalty,
-        freq_penalty=state.freq_penalty,
-        presence_penalty=state.presence_penalty,
-        token_counts=counts,
-        history=hist,
+    return dataclasses.replace(
+        state, token_counts=counts, history=hist,
         history_pos=state.history_pos.at[slot_ids].set(newpos),
-        repeat_last_n=state.repeat_last_n,
     )
 
 
@@ -248,19 +265,11 @@ def seed_windows(state: SamplingState, slot_ids: jax.Array,
     if tails.shape[1] < W:
         hist_rows = jnp.pad(hist_rows, ((0, 0), (0, W - tails.shape[1])),
                             constant_values=-1)
-    return SamplingState(
-        rng=state.rng,
-        temperature=state.temperature,
-        top_k=state.top_k,
-        top_p=state.top_p,
-        min_p=state.min_p,
-        repeat_penalty=state.repeat_penalty,
-        freq_penalty=state.freq_penalty,
-        presence_penalty=state.presence_penalty,
+    return dataclasses.replace(
+        state,
         token_counts=state.token_counts.at[slot_ids].set(counts_rows),
         history=state.history.at[slot_ids].set(hist_rows),
         history_pos=state.history_pos.at[slot_ids].set(tail_lens),
-        repeat_last_n=state.repeat_last_n,
     )
 
 
@@ -289,26 +298,46 @@ def _apply_penalties(logits: jax.Array, counts: jax.Array,
 CAND = 128
 
 
-def filtered_candidates(
-    state: SamplingState,
-    slot_ids: jax.Array,  # [B] i32
-    logits: jax.Array,  # [B, V] f32
-) -> tuple[jax.Array, jax.Array]:
-    """Per-row candidate DISTRIBUTION after the temperature/top-k/top-p/
-    min-p chain — the same llama.cpp sampler pipeline as ``sample`` minus
-    penalties (callers enforce penalty-free eligibility). Returns
-    (probs [B, CAND], vocab idx [B, CAND]); temp<=0 rows are an exact
-    one-hot on the argmax. Used by speculative REJECTION sampling, which
-    needs both models' filtered distributions, not just a draw."""
+def _topk_scaled(state: SamplingState, slot_ids: jax.Array,
+                 logits: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Shared candidate prologue for ``sample`` and
+    ``filtered_candidates``: top-CAND truncation + temperature scaling.
+    ONE implementation so the decode sampler and the speculative
+    rejection distribution cannot drift apart."""
     logits = logits.astype(jnp.float32)
     K = min(CAND, logits.shape[-1])
     vals, idx = lax.top_k(logits, K)  # [B, K] desc
     temp = state.temperature[slot_ids]
     scaled = vals / jnp.maximum(temp, 1e-6)[:, None]
+    return scaled, idx
+
+
+def _chain_probs(state: SamplingState, slot_ids: jax.Array,
+                 scaled: jax.Array) -> jax.Array:
+    """top_k -> typical_p -> top_p -> min_p over temp-scaled candidate
+    logits ``scaled`` [B, K] (desc order). Returns probs [B, K]."""
+    K = scaled.shape[-1]
     rank = jnp.arange(K, dtype=jnp.int32)[None, :]
     k_eff = jnp.where(state.top_k[slot_ids] <= 0, K,
                       state.top_k[slot_ids])[:, None]
     scaled = jnp.where(rank < k_eff, scaled, NEG_INF)
+    # locally typical filter, between top_k and top_p (llama.cpp chain
+    # order top_k -> typ_p -> top_p -> min_p; llama_sampler_typical):
+    # keep the smallest candidate set, ordered by |surprise - entropy|,
+    # whose cumulative probability reaches typical_p
+    typ = state.typical_p[slot_ids][:, None]  # [B, 1]
+    probs = jax.nn.softmax(scaled, axis=-1)
+    logp = jnp.where(probs > 0, jnp.log(jnp.maximum(probs, 1e-30)), NEG_INF)
+    entropy = -jnp.sum(jnp.where(probs > 0, probs * logp, 0.0), axis=-1,
+                       keepdims=True)  # [B, 1]
+    dev = jnp.where(probs > 0, jnp.abs(-logp - entropy), jnp.inf)
+    order = jnp.argsort(dev, axis=-1)  # ascending deviation
+    p_sorted = jnp.take_along_axis(probs, order, axis=-1)
+    cum = jnp.cumsum(p_sorted, axis=-1)
+    keep_sorted = (cum - p_sorted) < typ  # first crossing kept
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(order.shape[0])[:, None], order].set(keep_sorted)
+    scaled = jnp.where(keep | (typ >= 1.0), scaled, NEG_INF)
     probs = jax.nn.softmax(scaled, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     keep = (cum - probs) < state.top_p[slot_ids][:, None]
@@ -316,7 +345,68 @@ def filtered_candidates(
     probs = jax.nn.softmax(scaled, axis=-1)
     keep = probs >= probs[:, :1] * state.min_p[slot_ids][:, None]
     scaled = jnp.where(keep, scaled, NEG_INF)
-    probs = jax.nn.softmax(scaled, axis=-1)
+    return jax.nn.softmax(scaled, axis=-1)
+
+
+_LOG2E = 1.4426950408889634  # 1/ln(2): nats -> bits
+
+
+def _mirostat_probs(state: SamplingState, slot_ids: jax.Array,
+                    scaled: jax.Array, vocab: int) -> jax.Array:
+    """Mirostat v1/v2 candidate distribution (ref: llama.cpp
+    llama_sampler_mirostat{,_v2}, the reference's default sampler mode —
+    grpc-server.cpp:708-710, docs/content/docs/faq.md:19-21). Truncation
+    only — the adaptive mu update happens in ``sample`` after the draw.
+
+    v2: drop candidates whose surprise (-log2 p) exceeds mu.
+    v1: estimate the Zipf exponent s_hat from the top candidates, derive
+        k from (s_hat, mu, vocab), truncate to top-k."""
+    probs = jax.nn.softmax(scaled, axis=-1)  # temp-applied, full cand set
+    K = scaled.shape[-1]
+    rank = jnp.arange(K, dtype=jnp.int32)[None, :]
+    mu = state.mirostat_mu[slot_ids][:, None]  # [B, 1]
+    surprise = -jnp.log2(jnp.maximum(probs, 1e-30))
+    keep_v2 = surprise <= mu
+    # v1: linear-regression estimate of the Zipf exponent over the top m
+    # candidates: s_hat = sum(t_i * b_i) / sum(t_i^2), with
+    # t_i = log((i+2)/(i+1)), b_i = log(p_i / p_{i+1})
+    m = min(100, K)
+    i = jnp.arange(m - 1, dtype=jnp.float32)
+    t = jnp.log((i + 2.0) / (i + 1.0))[None, :]  # [1, m-1]
+    p_top = jnp.maximum(probs[:, :m], 1e-30)
+    b = jnp.log(p_top[:, :-1] / p_top[:, 1:])  # [B, m-1]
+    s_hat = jnp.sum(t * b, axis=-1, keepdims=True) / jnp.sum(t * t)
+    eps = s_hat - 1.0
+    # k = ((eps * 2^mu) / (1 - N^(-eps)))^(1/s_hat)  (mirostat paper eq. 6)
+    n_f = jnp.float32(vocab)
+    k1 = jnp.power(
+        (eps * jnp.power(2.0, mu))
+        / jnp.maximum(1.0 - jnp.power(n_f, -eps), 1e-6),
+        1.0 / jnp.maximum(s_hat, 1e-6),
+    )
+    keep_v1 = rank < jnp.maximum(jnp.round(k1), 1.0).astype(jnp.int32)
+    is_v1 = (state.mirostat[slot_ids] == 1)[:, None]
+    keep = jnp.where(is_v1, keep_v1, keep_v2)
+    keep = keep | (rank == 0)  # always at least the argmax
+    return jax.nn.softmax(jnp.where(keep, scaled, NEG_INF), axis=-1)
+
+
+def filtered_candidates(
+    state: SamplingState,
+    slot_ids: jax.Array,  # [B] i32
+    logits: jax.Array,  # [B, V] f32
+) -> tuple[jax.Array, jax.Array]:
+    """Per-row candidate DISTRIBUTION after the temperature/top-k/
+    typical-p/top-p/min-p chain — the same llama.cpp sampler pipeline as
+    ``sample`` minus penalties and mirostat (callers enforce
+    penalty-free, mirostat-free eligibility). Returns (probs [B, CAND],
+    vocab idx [B, CAND]); temp<=0 rows are an exact one-hot on the
+    argmax. Used by speculative REJECTION sampling, which needs both
+    models' filtered distributions, not just a draw."""
+    scaled, idx = _topk_scaled(state, slot_ids, logits)
+    temp = state.temperature[slot_ids]
+    probs = _chain_probs(state, slot_ids, scaled)
+    rank = jnp.arange(scaled.shape[-1], dtype=jnp.int32)[None, :]
     greedy = (rank == 0).astype(jnp.float32)  # candidates sorted desc
     return jnp.where((temp <= 0.0)[:, None], greedy, probs), idx
 
@@ -346,10 +436,19 @@ def sample(
 
     # the shared filter chain: ONE implementation feeds both this sampler
     # and speculative rejection sampling, so their distributions can never
-    # drift apart
-    probs, idx = filtered_candidates(state, slot_ids, logits)
-    greedy_tok = idx[:, 0].astype(jnp.int32)  # candidates sorted desc
+    # drift apart. Mirostat rows (llama.cpp semantics) bypass the chain:
+    # temperature + adaptive-surprise truncation only.
+    V = logits.shape[-1]
+    scaled, idx = _topk_scaled(state, slot_ids, logits)
     temp = state.temperature[slot_ids]
+    rank = jnp.arange(scaled.shape[-1], dtype=jnp.int32)[None, :]
+    greedy_row = (rank == 0).astype(jnp.float32)
+    chain = _chain_probs(state, slot_ids, scaled)
+    miro = state.mirostat[slot_ids]
+    miro_probs = _mirostat_probs(state, slot_ids, scaled, V)
+    probs = jnp.where((miro > 0)[:, None], miro_probs, chain)
+    probs = jnp.where((temp <= 0.0)[:, None], greedy_row, probs)
+    greedy_tok = idx[:, 0].astype(jnp.int32)  # candidates sorted desc
 
     keys = state.rng[slot_ids]
     split = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
@@ -367,14 +466,20 @@ def sample(
 
     tok = jnp.where(temp <= 0.0, greedy_tok, sampled_tok)
 
-    rng = state.rng.at[slot_ids].set(new_keys)
-    state = SamplingState(
-        rng=rng, temperature=state.temperature, top_k=state.top_k,
-        top_p=state.top_p, min_p=state.min_p,
-        repeat_penalty=state.repeat_penalty, freq_penalty=state.freq_penalty,
-        presence_penalty=state.presence_penalty,
-        token_counts=state.token_counts, history=state.history,
-        history_pos=state.history_pos, repeat_last_n=state.repeat_last_n,
+    # mirostat mu update: observed surprise of the drawn token (bits,
+    # from the truncated+renormalized distribution, as llama.cpp computes
+    # it post-softmax), mu -= eta * (observed - tau)
+    p_drawn = jnp.take_along_axis(probs, j[:, None], axis=-1)[:, 0]
+    observed = -jnp.log2(jnp.maximum(p_drawn, 1e-30))
+    mu = state.mirostat_mu[slot_ids]
+    mu_new = mu - state.mirostat_eta[slot_ids] * (
+        observed - state.mirostat_tau[slot_ids])
+    mu_rows = jnp.where((miro > 0) & (temp > 0.0), mu_new, mu)
+
+    state = dataclasses.replace(
+        state,
+        rng=state.rng.at[slot_ids].set(new_keys),
+        mirostat_mu=state.mirostat_mu.at[slot_ids].set(mu_rows),
     )
     valid = jnp.ones(tok.shape, bool)
     state = observe_tokens(state, slot_ids, tok, valid)

@@ -186,6 +186,15 @@ def _predict_options(cfg: ModelConfig, body: dict, prompt: str,
         frequency_penalty=float(pick("frequency_penalty",
                                      p.frequency_penalty)),
         presence_penalty=float(pick("presence_penalty", p.presence_penalty)),
+        typical_p=float(pick("typical_p", p.typical_p
+                             if p.typical_p is not None else 1.0)),
+        # mirostat config defaults mirror backend_config.go SetDefaults
+        # :300-302 (0 / 5.0 / 0.1)
+        mirostat=int(pick("mirostat", p.mirostat or 0)),
+        mirostat_tau=float(pick("mirostat_tau", p.mirostat_tau
+                                if p.mirostat_tau is not None else 5.0)),
+        mirostat_eta=float(pick("mirostat_eta", p.mirostat_eta
+                                if p.mirostat_eta is not None else 0.1)),
         stop_prompts=stop,
         ignore_eos=bool(pick("ignore_eos", p.ignore_eos)),
         grammar=body.get("grammar", "") or cfg.grammar or "",
@@ -330,6 +339,12 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         opts.images = await _fetch_media_all(media)
     if grammar:
         opts.grammar = grammar
+        # lazy-grammar triggers from the model yaml (function.grammar.
+        # triggers: [{word: ...}] — ref: parse.go:51, options.go:118)
+        opts.grammar_triggers = [w for w in (
+            t.get("word", "") if isinstance(t, dict) else str(t)
+            for t in (cfg.function.grammar_options().get("triggers") or [])
+        ) if w]  # entries without a word (e.g. token-id style) drop out
     extra_usage = ("Extra-Usage" in request.headers
                    or bool((body.get("stream_options") or {})
                            .get("include_usage")))

@@ -58,10 +58,11 @@ def _bool(body: dict, name: str) -> Optional[bool]:
 
 # sampling surface shared by chat/completion/edit (ref: schema/
 # prediction.go PredictionOptions)
-_SAMPLING_NUM = ("temperature", "top_p", "min_p", "repeat_penalty",
-                 "frequency_penalty", "presence_penalty")
+_SAMPLING_NUM = ("temperature", "top_p", "min_p", "typical_p",
+                 "repeat_penalty", "frequency_penalty", "presence_penalty",
+                 "mirostat_tau", "mirostat_eta")
 _SAMPLING_INT = ("top_k", "max_tokens", "max_completion_tokens", "seed",
-                 "repeat_last_n", "n")
+                 "repeat_last_n", "n", "mirostat")
 
 
 def _check_sampling(body: dict) -> None:

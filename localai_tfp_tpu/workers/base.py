@@ -44,6 +44,9 @@ class PredictOptions:
     stop_prompts: list[str] = field(default_factory=list)
     ignore_eos: bool = False
     grammar: str = ""
+    # lazy-grammar trigger words (ref: pb.GrammarTrigger, options.go:118;
+    # grammar constrains only from the first trigger occurrence on)
+    grammar_triggers: list[str] = field(default_factory=list)
     logit_bias: dict[int, float] = field(default_factory=dict)
     images: list[bytes] = field(default_factory=list)
     audios: list[bytes] = field(default_factory=list)

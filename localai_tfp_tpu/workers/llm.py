@@ -332,12 +332,14 @@ class JaxLLMBackend(Backend):
             prompt_ids = self.tokenizer.encode(opts.prompt, add_bos=True)
         constraint = None
         if opts.grammar:
-            constraint = self._grammar_cache.get(opts.grammar)
+            key = (opts.grammar, tuple(opts.grammar_triggers or ()))
+            constraint = self._grammar_cache.get(key)
             if constraint is None:
                 # native C++ engine when built; Python fallback otherwise
-                constraint = make_constraint(opts.grammar, self.tokenizer)
+                constraint = make_constraint(opts.grammar, self.tokenizer,
+                                             triggers=opts.grammar_triggers)
                 if len(self._grammar_cache) < 32:
-                    self._grammar_cache[opts.grammar] = constraint
+                    self._grammar_cache[key] = constraint
         return GenRequest(
             prompt_ids=prompt_ids,
             max_tokens=opts.tokens or 2048,
@@ -349,6 +351,10 @@ class JaxLLMBackend(Backend):
             repeat_last_n=opts.repeat_last_n,
             frequency_penalty=opts.frequency_penalty,
             presence_penalty=opts.presence_penalty,
+            typical_p=opts.typical_p if opts.typical_p > 0 else 1.0,
+            mirostat=opts.mirostat,
+            mirostat_tau=opts.mirostat_tau if opts.mirostat_tau > 0 else 5.0,
+            mirostat_eta=opts.mirostat_eta if opts.mirostat_eta > 0 else 0.1,
             seed=opts.seed,
             stop=list(opts.stop_prompts),
             ignore_eos=opts.ignore_eos,

@@ -321,3 +321,72 @@ def test_finetune_pipeline():
     assert apply_finetune("x<r>42</r>y",
                           extract_regex=[r"<r>\d+</r>"]) == "<r>42</r>"
     assert apply_finetune("out", echo_prompt="in:") == "in:out"
+
+
+def test_lazy_grammar_dormant_until_trigger():
+    """Lazy triggers (ref: grpc-server.cpp:2441-2454 grammar_lazy): no
+    constraint before the trigger word; grammar active — fed the trigger
+    itself — from the boundary on."""
+    from localai_tfp_tpu.grammars.constrain import LazyGrammarConstraint
+
+    tk = ByteTokenizer()
+    inner = GrammarConstraint.from_gbnf('root ::= "<f>" [a-z]+ "</f>"', tk)
+    c = LazyGrammarConstraint(inner, ["<f>"], tk)
+    st = c.initial_state()
+    # dormant: everything admissible (prose preamble)
+    mask = c.next_mask(st)
+    assert mask.all()
+    for ch in "some prose ":
+        st = c.advance(st, ord(ch))
+        assert c.next_mask(st).all()
+    # trigger straddles token boundaries: feed "<", "f", ">"
+    for ch in "<f":
+        st = c.advance(st, ord(ch))
+        assert c.next_mask(st).all()  # not yet complete
+    st = c.advance(st, ord(">"))
+    mask = c.next_mask(st)  # active: grammar consumed "<f>", wants [a-z]
+    assert mask[ord("x")] and not mask[ord("<")] and not mask[ord("1")]
+    for ch in "ok":
+        st = c.advance(st, ord(ch))
+    st = c.advance(st, ord("<"))
+    mask = c.next_mask(st)
+    assert mask[ord("/")] and not mask[ord("1")]
+    for ch in "/f":
+        st = c.advance(st, ord(ch))
+    st = c.advance(st, ord(">"))
+    assert c.next_mask(st)[257]  # eos admissible at grammar end
+
+
+def test_lazy_grammar_tool_call_after_prose_in_engine():
+    """E2E (VERDICT r3 next #4): the model emits unconstrained prose, the
+    trigger appears, and everything after it must conform to the grammar
+    — through the real engine decode path."""
+    import jax
+    import jax.numpy as jnp
+
+    from localai_tfp_tpu.engine.engine import GenRequest, LLMEngine
+    from localai_tfp_tpu.grammars.native import make_constraint
+    from localai_tfp_tpu.models.llm_spec import tiny_spec
+    from localai_tfp_tpu.models.transformer import init_params
+
+    tk = ByteTokenizer()
+    spec = tiny_spec(vocab_size=tk.vocab_size, max_position=256)
+    params = init_params(jax.random.PRNGKey(3), spec, dtype=jnp.float32)
+    eng = LLMEngine(spec, params, tk, n_slots=2, max_seq=128,
+                    prefill_buckets=(8, 32), cache_dtype=jnp.float32)
+    prompt = tk.encode("call a tool")
+    free = eng.generate(GenRequest(prompt_ids=prompt, max_tokens=10,
+                                   ignore_eos=True))
+    assert len(free.full_text) >= 3
+    trig = free.full_text[2]  # a char the model emits unconstrained
+    grammar = f'root ::= "{trig}" "abc"'
+    constraint = make_constraint(grammar, tk, triggers=[trig])
+    ev = eng.generate(GenRequest(prompt_ids=prompt, max_tokens=24,
+                                 constraint=constraint))
+    eng.close()
+    # the grammar engages at the FIRST trigger occurrence (which may be
+    # earlier than the char we sampled it from)
+    pre, _, post = ev.full_text.partition(trig)
+    assert free.full_text.startswith(pre + trig)  # preamble = greedy
+    assert post == "abc"  # constrained continuation, then clean EOS stop
+    assert ev.finish_reason == "stop"

@@ -198,3 +198,92 @@ def test_seed_windows_equals_observe_scan():
         np.testing.assert_array_equal(
             np.asarray(getattr(got, name)),
             np.asarray(getattr(want, name)), err_msg=name)
+
+
+def test_typical_p_prefers_typical_tokens():
+    """Locally typical sampling (llama.cpp llama_sampler_typical): with a
+    distribution of one dominant mode + a flat tail, small typical_p
+    keeps the tokens whose surprise is CLOSEST to the entropy — which for
+    a near-flat remainder is the tail, not necessarily the argmax. Use a
+    two-level distribution where the typical set is well defined."""
+    st = _state().reset_slot(0, temperature=1.0, typical_p=0.2, seed=3)
+    # 8 equally-likely tokens (0..7), rest impossible: entropy = log 8,
+    # every live token's surprise == entropy -> all 8 equally typical;
+    # typical_p=0.2 keeps ceil(0.2*8)=2 of them
+    row = np.full(V, -50.0, np.float32)
+    row[:8] = 1.0
+    seen = set()
+    for _ in range(40):
+        tok, st = sample(st, jnp.array([0]), _logits([row]))
+        seen.add(int(tok[0]))
+    assert seen <= set(range(8))
+    assert len(seen) <= 2  # truncated to the 0.2 mass
+
+
+def test_typical_p_disabled_at_one():
+    st = _state().reset_slot(0, temperature=1.0, typical_p=1.0, seed=5)
+    row = np.full(V, 0.0, np.float32)
+    seen = set()
+    for _ in range(60):
+        tok, st = sample(st, jnp.array([0]), _logits([row]))
+        seen.add(int(tok[0]))
+    assert len(seen) > 8  # no truncation beyond CAND
+
+
+def test_mirostat_v2_changes_output_and_adapts_mu():
+    """Mirostat v2 (grpc-server.cpp:708-710; llama.cpp
+    llama_sampler_mirostat_v2): low tau must restrict sampling to
+    high-probability tokens, and mu must move toward tau."""
+    st = _state().reset_slot(0, temperature=1.0, mirostat=2,
+                             mirostat_tau=1.0, mirostat_eta=0.2, seed=1)
+    assert float(st.mirostat_mu[0]) == 2.0  # 2*tau init
+    row = np.zeros(V, np.float32)
+    row[4] = 6.0  # dominant mode; tail improbable
+    mus = []
+    for _ in range(30):
+        tok, st = sample(st, jnp.array([0]), _logits([row]))
+        # tau=1.0 bits: only tokens with surprise <= mu survive; with a
+        # crushing mode that is essentially always token 4
+        assert int(tok[0]) == 4
+        mus.append(float(st.mirostat_mu[0]))
+    # mu adapts: observed surprise ~0 < tau -> mu rises by eta*tau each
+    # step (bounded drift upward)
+    assert mus[-1] > 2.0
+
+
+def test_mirostat_v2_high_tau_keeps_diversity():
+    st = _state().reset_slot(0, temperature=1.0, mirostat=2,
+                             mirostat_tau=8.0, mirostat_eta=0.1, seed=2)
+    row = np.zeros(V, np.float32)  # uniform: surprise = log2(V) = 5 bits
+    seen = set()
+    for _ in range(40):
+        tok, st = sample(st, jnp.array([0]), _logits([row]))
+        seen.add(int(tok[0]))
+    assert len(seen) > 5  # mu=16 keeps the whole uniform support
+
+
+def test_mirostat_v1_truncates_via_zipf_k():
+    """Mirostat v1 derives k from the Zipf exponent estimate; with low
+    tau on a peaked Zipf-like distribution the sampled set must collapse
+    to the head."""
+    st = _state().reset_slot(0, temperature=1.0, mirostat=1,
+                             mirostat_tau=0.5, mirostat_eta=0.1, seed=4)
+    # Zipf-ish: logit ~ -2*log(rank)
+    row = np.asarray([-2.0 * np.log(i + 1.0) for i in range(V)],
+                     np.float32)
+    for _ in range(25):
+        tok, st = sample(st, jnp.array([0]), _logits([row]))
+        assert int(tok[0]) < 4  # head of the distribution only
+
+
+def test_mirostat_state_is_per_slot():
+    st = _state()
+    st = st.reset_slot(0, temperature=1.0, mirostat=2, mirostat_tau=2.0,
+                       mirostat_eta=0.5, seed=9)
+    st = st.reset_slot(1, temperature=1.0, seed=10)
+    row = np.zeros((2, V), np.float32)
+    row[:, 3] = 8.0
+    mu1_before = float(st.mirostat_mu[1])
+    tok, st = sample(st, jnp.array([0, 1]), _logits(row))
+    assert float(st.mirostat_mu[1]) == mu1_before  # non-miro slot frozen
+    assert float(st.mirostat_mu[0]) != 4.0  # miro slot adapted

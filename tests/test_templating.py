@@ -156,3 +156,138 @@ def test_part_list_content_flattens_without_media():
     ])
     assert "hello" in out
     assert "[img-" not in out and "image_url" not in out
+
+
+# ---- Go text/template interpreter goldens (ported from the reference's
+# pkg/templates/evaluator_test.go chatML/llama3 tables) ----
+
+CHATML_GO = """<|im_start|>{{if eq .RoleName "assistant"}}assistant\
+{{else if eq .RoleName "system"}}system{{else if eq .RoleName "tool"}}tool\
+{{else if eq .RoleName "user"}}user{{end}}
+{{- if .FunctionCall }}
+<tool_call>
+{{- else if eq .RoleName "tool" }}
+<tool_response>
+{{- end }}
+{{- if .Content}}
+{{.Content }}
+{{- end }}
+{{- if .FunctionCall}}
+{{toJson .FunctionCall}}
+{{- end }}
+{{- if .FunctionCall }}
+</tool_call>
+{{- else if eq .RoleName "tool" }}
+</tool_response>
+{{- end }}<|im_end|>"""
+
+LLAMA3_GO = """<|start_header_id|>{{if eq .RoleName "assistant"}}assistant\
+{{else if eq .RoleName "system"}}system{{else if eq .RoleName "tool"}}tool\
+{{else if eq .RoleName "user"}}user{{end}}<|end_header_id|>
+
+{{ if .FunctionCall -}}
+Function call:
+{{ else if eq .RoleName "tool" -}}
+Function response:
+{{ end -}}
+{{ if .Content -}}
+{{.Content -}}
+{{ else if .FunctionCall -}}
+{{ toJson .FunctionCall -}}
+{{ end -}}
+<|eot_id|>"""
+
+STORY = "A long time ago in a galaxy far, far away..."
+
+
+def _render_msg(tpl, **kw):
+    from localai_tfp_tpu.engine.templating import ChatMessageData, Evaluator
+
+    return Evaluator()._render(tpl, ChatMessageData(**kw))
+
+
+def test_gotmpl_llama3_goldens():
+    assert _render_msg(LLAMA3_GO, RoleName="user", Content=STORY) == (
+        "<|start_header_id|>user<|end_header_id|>\n\n" + STORY
+        + "<|eot_id|>")
+    assert _render_msg(LLAMA3_GO, RoleName="assistant", Content=STORY) == (
+        "<|start_header_id|>assistant<|end_header_id|>\n\n" + STORY
+        + "<|eot_id|>")
+    assert _render_msg(
+        LLAMA3_GO, RoleName="assistant",
+        FunctionCall={"function": "test"}) == (
+        "<|start_header_id|>assistant<|end_header_id|>\n\n"
+        'Function call:\n{"function":"test"}<|eot_id|>')
+    assert _render_msg(LLAMA3_GO, RoleName="tool",
+                       Content="Response from tool") == (
+        "<|start_header_id|>tool<|end_header_id|>\n\n"
+        "Function response:\nResponse from tool<|eot_id|>")
+
+
+def test_gotmpl_chatml_goldens():
+    assert _render_msg(CHATML_GO, RoleName="user", Content=STORY) == (
+        "<|im_start|>user\n" + STORY + "<|im_end|>")
+    assert _render_msg(
+        CHATML_GO, RoleName="assistant",
+        FunctionCall={"function": "test"}) == (
+        '<|im_start|>assistant\n<tool_call>\n{"function":"test"}\n'
+        "</tool_call><|im_end|>")
+    assert _render_msg(CHATML_GO, RoleName="tool",
+                       Content="Response from tool") == (
+        "<|im_start|>tool\n<tool_response>\nResponse from tool\n"
+        "</tool_response><|im_end|>")
+
+
+def test_gotmpl_range_index_and_vars():
+    """Constructs from real gallery templates: range over tool defs with
+    $key,$val over (index . "..."), variable accumulation via print."""
+    from localai_tfp_tpu.engine.gotmpl import GoTemplate
+
+    tpl = GoTemplate(
+        '{{$tools:=""}}{{range .Functions}}'
+        "{{$tools = print $tools .name \" \"}}{{end}}tools: {{$tools}}")
+    out = tpl.render({"Functions": [{"name": "a"}, {"name": "b"}]})
+    assert out == "tools: a b "
+
+    tpl = GoTemplate(
+        '{{range $key,$val := (index .Parameters "properties") -}}'
+        "{{$key}}={{index $val \"type\"}};{{end}}")
+    out = tpl.render({"Parameters": {
+        "properties": {"b": {"type": "int"}, "a": {"type": "str"}}}})
+    # text/template iterates map keys sorted
+    assert out == "a=str;b=int;"
+
+
+def test_gotmpl_sprig_subset():
+    from localai_tfp_tpu.engine.gotmpl import GoTemplate
+
+    assert GoTemplate('{{ trim "  x  " }}').render({}) == "x"
+    assert GoTemplate('{{ if contains "b" .S }}yes{{end}}').render(
+        {"S": "abc"}) == "yes"
+    assert GoTemplate('{{ default "d" .Missing }}').render({}) == "d"
+    assert GoTemplate('{{ default "d" .S }}').render({"S": "v"}) == "v"
+    assert GoTemplate('{{ join ", " .L }}').render(
+        {"L": ["x", "y"]}) == "x, y"
+    assert GoTemplate("{{ add1 .N }}").render({"N": 2}) == "3"
+    assert GoTemplate('{{ printf "%s=%d" .K .N }}').render(
+        {"K": "n", "N": 5}) == "n=5"
+    assert GoTemplate('{{ upper ( trim "  hi " ) }}').render({}) == "HI"
+    assert GoTemplate('{{ "  pad  " | trim | upper }}').render({}) == "PAD"
+
+
+def test_gotmpl_if_else_and_nested():
+    from localai_tfp_tpu.engine.gotmpl import GoTemplate
+
+    tpl = GoTemplate(
+        "{{if .A}}{{if .B}}AB{{else}}A{{end}}{{else}}none{{end}}")
+    assert tpl.render({"A": 1, "B": 1}) == "AB"
+    assert tpl.render({"A": 1}) == "A"
+    assert tpl.render({}) == "none"
+
+
+def test_gotmpl_range_else_and_empty():
+    from localai_tfp_tpu.engine.gotmpl import GoTemplate
+
+    tpl = GoTemplate("{{range .L}}[{{.}}]{{else}}empty{{end}}")
+    assert tpl.render({"L": [1, 2]}) == "[1][2]"
+    assert tpl.render({"L": []}) == "empty"
