@@ -252,9 +252,17 @@ class ModelConfig:
     decode_steps_per_dispatch: int = 1
     activation_dtype: str = "bfloat16"
     kv_cache_dtype: str = ""  # "" = same as activations; "int8" enables quantized KV
+    # "subprocess": run this model's backend in a child server process so
+    # a wedged load/compile or a crashed native backend can be reclaimed
+    # by killing the OS process (the reference's process-per-backend
+    # model, pkg/model/process.go:21-61). Default: in-process.
+    isolation: str = ""
 
     # Unrecognized / compat-only YAML keys land here untouched.
     extra: dict[str, Any] = field(default_factory=dict)
+    # The original parsed YAML document (for writing a child config in
+    # subprocess isolation); not part of the config surface.
+    raw: dict[str, Any] = field(default_factory=dict, repr=False)
 
     @classmethod
     def from_dict(cls, data: dict) -> "ModelConfig":
@@ -291,6 +299,7 @@ class ModelConfig:
         cfg.tts = TTSConfig.from_dict(kwargs.get("tts", {}))
         cfg.model = cfg.model or model_file
         cfg.extra = extra
+        cfg.raw = {**data, "parameters": {**params, "model": cfg.model}}
         cfg.set_defaults()
         return cfg
 

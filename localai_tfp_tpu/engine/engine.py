@@ -31,6 +31,7 @@ import queue
 import threading
 import time
 import uuid
+from collections import deque
 from dataclasses import dataclass, field
 from enum import Enum
 from functools import partial
@@ -48,7 +49,13 @@ from ..ops.sampling import (
 )
 from .tokenizer import StreamDecoder, Tokenizer
 
-DEFAULT_PREFILL_BUCKETS = (32, 128, 512, 2048)
+# Padded-prefill size ladder. The 4-bucket exists for the prefix-reuse
+# fast path: a warm request re-processes only its last token(s), and at
+# a 64-deep admission wave the difference between padding those rows to
+# 32 columns vs 4 is ~2048 vs ~256 dead token-positions of 8B forward —
+# measured ~400 ms vs ~30 ms on v5e, the difference between missing and
+# making a <200 ms TTFT.
+DEFAULT_PREFILL_BUCKETS = (4, 16, 128, 512, 2048)
 
 
 @dataclass
@@ -130,6 +137,27 @@ class SlotState(Enum):
     FREE = 0
     PREFILL = 1
     DECODE = 2
+    # final prompt chunk dispatched; first sampled token still on device.
+    # The slot joins decode scans once its prefill flight harvests.
+    PENDING_FIRST = 3
+
+
+@dataclass
+class _Flight:
+    """An in-flight device dispatch whose host-visible results are still
+    on the wire. The scheduler enqueues dispatches without blocking
+    (device work and the ~100ms tunnel round trip pipeline behind one
+    another) and harvests results in FIFO order — device execution is
+    serialized by the donated cache/sampling buffers, so flight N's
+    arrays are always ready no later than flight N+1's."""
+
+    kind: str  # "prefill_final" | "decodek"
+    arrays: list  # device arrays to harvest (copy_to_host_async started)
+    meta: dict
+    t_enqueue: float
+
+    def ready(self) -> bool:
+        return all(a.is_ready() for a in self.arrays)
 
 
 @dataclass
@@ -380,6 +408,13 @@ class LLMEngine:
         self._dev_tokens: Any = None
         self._dev_pos: Any = None
         self._dev_active: Any = None
+        # async dispatch pipeline (see step()): FIFO of in-flight device
+        # dispatches awaiting host-side harvest
+        self._flights: deque[_Flight] = deque()
+        self._pipeline_depth = 2  # decode scans kept in flight
+        self._harvest_last: dict[int, int] = {}  # last token per slot of
+        # the most recently harvested scan (chained flights' prev_last)
+        self._last_harvest_t = 0.0
 
     def _kernel_eligible(self) -> bool:
         """Use the Pallas ragged decode kernels when the mosaic path is
@@ -602,12 +637,14 @@ class LLMEngine:
 
         @partial(jax.jit, donate_argnums=(2,))
         def _prefill(params, tokens, cache, pos0, slot_ids, soft=None):
+            # non-final chunk: only the K/V writes matter — materializing
+            # [B, T, V] logits would waste bucket*V f32 of HBM per row
             if soft is not None:
                 soft = _soft_expand(tokens, *soft)
             win, restore = _window_cache(cache, window)
-            logits, win = forward(spec, params, tokens, pos0, win,
-                                  slot_ids, soft=soft)
-            return logits, restore(win)
+            _, win = forward_hidden(spec, params, tokens, pos0, win,
+                                    slot_ids, soft=soft)
+            return restore(win)
 
         self._decode_k_fns[key] = _prefill
         return _prefill
@@ -635,23 +672,29 @@ class LLMEngine:
             if soft is not None:
                 soft = _soft_expand(tokens, *soft)
             win, restore = _window_cache(cache, window)
-            logits, win = forward(
+            hidden, win = forward_hidden(
                 spec, params, tokens, pos0, win, slot_ids, soft=soft
             )
             cache = restore(win)
             # sampler reset rides THIS dispatch (admission used to pay a
             # separate reset_batch round trip before the prefill — one
             # full tunnel RTT off TTFT for singles and waves alike)
+            from ..models.transformer import _lm_head
             from ..ops.sampling import reset_slots
 
             sampling = reset_slots(sampling, slot_ids, *reset)
             # closed-form penalty-window seed (scan-equivalent; the W
             # sequential scatter steps dominated this dispatch's time)
             sampling = seed_windows(sampling, slot_ids, tails, tail_lens)
-            last = jax.vmap(
-                lambda lg, n: lax.dynamic_slice_in_dim(lg, n - 1, 1, 0)[0]
-            )(logits, n_chunk)  # [B, V] at each chunk's true last position
-            toks, sampling = sample(sampling, slot_ids, last, mask=masks)
+            # LM head on each row's LAST position only: full [B, T, V]
+            # logits would cost bucket*V f32 per row (a 64x2048 group at
+            # 32k vocab is 16 GB — instant OOM) for values the sampler
+            # never reads
+            last_h = jax.vmap(
+                lambda h, n: lax.dynamic_slice_in_dim(h, n - 1, 1, 0)[0]
+            )(hidden, n_chunk)  # [B, D] at each chunk's true last position
+            logits = _lm_head(spec, params, last_h[:, None, :])[:, 0]
+            toks, sampling = sample(sampling, slot_ids, logits, mask=masks)
             return toks, cache, sampling
 
         self._decode_k_fns[key] = _prefill_final
@@ -869,7 +912,7 @@ class LLMEngine:
             pos0 = jnp.asarray(p["pos0"])
             sids = jnp.asarray(p["slot_ids"])
             soft = self._soft_dense(p.get("soft"), *p["toks"].shape)
-            _, self.cache = self._prefill_fn(
+            self.cache = self._prefill_fn(
                 p.get("window", self.max_seq))(
                 self.params, toks, self.cache, pos0, sids, soft
             )
@@ -983,6 +1026,61 @@ class LLMEngine:
 
             quant.set_meshed_serving(False)
 
+    def warmup(self) -> None:
+        """Compile the serving dispatch-variant set up front.
+
+        At 8B scale one jit variant costs ~13s to compile; a cold
+        variant landing mid-request is a 13-second TTFT outlier
+        (measured through the HTTP bench: ragged arrivals hit group
+        sizes the first admission wave never used). All-pad dispatches
+        — every row pointing at the out-of-bounds sentinel slot id, or
+        an all-inactive scan — exercise the identical jit shapes
+        without touching engine state, so this is safe before serving.
+        With the persistent compilation cache the cost after a code
+        change is one cold pass; afterwards seconds."""
+        W = self.sampling.window
+        pad_reset = self._reset_columns([], 1)
+        for bucket in self.prefill_buckets:
+            cap = self._prefill_group_cap(bucket)
+            sizes = {cap}
+            b = 1
+            while b < cap:
+                sizes.add(b)
+                b *= 8
+            for B in sorted(sizes):
+                reset = {k: np.repeat(v, B, axis=0)
+                         for k, v in pad_reset.items()}
+                self._run("prefill_final", {
+                    "toks": np.zeros((B, bucket), np.int32),
+                    "pos0": np.zeros((B,), np.int32),
+                    "slot_ids": np.full((B,), self.n_slots, np.int32),
+                    "n_chunk": np.ones((B,), np.int32),
+                    "tails": np.zeros((B, W), np.int32),
+                    "tail_lens": np.zeros((B,), np.int32),
+                    "masks": None, "reset": reset, "soft": None,
+                    "window": self.max_seq,
+                })
+        S = self.n_slots
+        inactive = {
+            "tokens": np.zeros((S, 1), np.int32),
+            "pos0": np.zeros((S,), np.int32),
+            "active": np.zeros((S,), bool),
+        }
+        ks = {1, min(4, self.decode_steps), self.decode_steps}
+        window = (self.max_seq if self._use_kernel
+                  else self._window_bucket(256))
+        for k in sorted(ks):
+            if k > 1:
+                self._run("decodek", {
+                    "k": k, "window": window, "depth": 1, "carry": False,
+                    **inactive,
+                })
+        self._run("decode1", {**inactive, "masks": None})
+        self._dev_epoch = -1  # warmup carries are not serving state
+        # block until every warmup compile retires so the first real
+        # request measures serving, not the compiler
+        jax.block_until_ready(self.cache.k)
+
     def submit(self, req: GenRequest) -> queue.SimpleQueue:
         """Queue a request; returns the event stream queue."""
         return self.submit_many([req])[0]
@@ -1074,10 +1172,12 @@ class LLMEngine:
             try:
                 self.step()
             except Exception as e:  # engine must survive; fail active slots
+                self._flights.clear()
                 self._fail_all(f"engine step error: {e!r}")
 
     def _has_work(self) -> bool:
-        return bool(self._pending) or any(s.active for s in self.slots)
+        return (bool(self._pending) or bool(self._flights)
+                or any(s.active for s in self.slots))
 
     def _fail_all(self, msg: str) -> None:
         for s in self.slots:
@@ -1087,27 +1187,84 @@ class LLMEngine:
                 self._release(s)
 
     def step(self) -> None:
-        """One scheduler iteration (ref: update_slots, grpc-server.cpp:1639)."""
+        """One scheduler iteration (ref: update_slots, grpc-server.cpp:1639).
+
+        Async pipeline shape (the tunnel RTT redesign): every device
+        dispatch is ENQUEUED without waiting for its results — JAX
+        dispatch, the device work, and the ~100ms host<->device round
+        trip all pipeline — and results are harvested when their
+        device arrays turn ready. Admission therefore never waits
+        behind an in-flight prefill's download, and a deep burst's
+        prefill groups overlap on the wire: TTFT for group N is one
+        round trip plus the device compute of groups 1..N, not N
+        serialized (compute + RTT) blocks."""
         self._apply_cancellations()
         self._admit()
+        harvested = self._harvest()
+        dispatched = self._dispatch()
+        if not (harvested or dispatched):
+            self._wait_for_event()
+
+    def _dispatch(self) -> bool:
+        """Enqueue device work for the current slot states. Returns
+        whether anything was enqueued."""
+        did = False
         prefilling = [s for s in self.slots if s.state is SlotState.PREFILL]
         if prefilling:
-            # batch final chunks of the same bucket together (one dispatch
-            # for the whole admission wave); long prompts chunk one by one
+            # batch final chunks of the same bucket together (one
+            # dispatch per admission wave); long prompts chunk ahead
             finals: dict[int, list[_Slot]] = {}
             for s in prefilling:
                 rem = s.n_prompt - s.n_past
                 if rem <= self.prefill_buckets[-1]:
                     finals.setdefault(self._bucket(rem), []).append(s)
-            if finals:
-                bucket, group = max(finals.items(), key=lambda kv: len(kv[1]))
-                self._prefill_final_step(group, bucket)
-            else:
-                self._prefill_step(prefilling[0])
-            return
+                else:
+                    self._prefill_step(s)  # enqueue-only, no result
+                    did = True
+            for bucket in sorted(finals, key=lambda b: -len(finals[b])):
+                group = finals[bucket]
+                cap = self._prefill_group_cap(bucket)
+                while group:
+                    self._enqueue_prefill_final(group[:cap], bucket)
+                    group = group[cap:]
+                    did = True
         decoding = [s for s in self.slots if s.state is SlotState.DECODE]
         if decoding:
-            self._decode_step(decoding)
+            did = self._dispatch_decode(decoding) or did
+        return did
+
+    def _wait_for_event(self) -> None:
+        """Nothing to enqueue and nothing ready: block until the oldest
+        flight's arrays land, an ADMITTABLE request arrives (pending
+        alone is not an event — with every slot busy a queued request
+        can't be dispatched, and returning on it would hot-spin the
+        scheduler for the length of every in-flight scan), or a cancel
+        fires."""
+        while True:
+            with self._lock:
+                if self._stop or self._cancelled:
+                    return
+                if self._pending and any(not s.active for s in self.slots):
+                    return
+            if not self._flights:
+                return
+            if self._flights[0].ready():
+                return
+            time.sleep(5e-4)
+
+    def _harvest(self) -> bool:
+        """Complete ready flights in FIFO order (device execution is
+        serialized by the donated state buffers, so readiness is
+        monotone along the queue)."""
+        did = False
+        while self._flights and self._flights[0].ready():
+            fl = self._flights.popleft()
+            if fl.kind == "prefill_final":
+                self._complete_prefill_final(fl)
+            else:
+                self._complete_decodek(fl)
+            did = True
+        return did
 
     # admission + prefix reuse (ref: grpc-server.cpp:1749-1900)
     def _admit(self) -> None:
@@ -1354,35 +1511,55 @@ class LLMEngine:
         slot.cache_tokens.extend(chunk)
         slot.t_prefill_ms += (time.perf_counter() - t0) * 1e3
 
-    def _prefill_final_step(self, group: list[_Slot], bucket: int) -> None:
-        """Finish a batch of same-bucket prompts: one fused dispatch runs
-        the final chunks, seeds the penalty windows, and samples each
-        slot's first token. The group is padded UP with sentinel rows
-        pointing at the out-of-bounds slot id ``n_slots``: JAX drops
-        out-of-bounds scatter updates and clamps out-of-bounds gathers,
-        so a pad row is pure discarded compute that never touches
-        engine state. (Rounding DOWN and deferring the remainder
-        — the previous scheme — turned one ragged 63-request wave into
-        SIX dispatches of six distinct jit shapes; under HTTP arrival
+    @property
+    def _group_cap(self) -> int:
+        return min(64, max(self.n_slots, 1))
+
+    # token budget per fused prefill dispatch: the XLA prefill attention
+    # materializes [B, H, T, window] f32 scores, so B*bucket must stay
+    # bounded or big-bucket groups OOM at compile (measured: a 64x2048
+    # group at 1B/2048-ctx needs 34 GB of scores on a 16 GB chip)
+    _PREFILL_GROUP_TOKENS = 8192
+
+    def _prefill_group_cap(self, bucket: int) -> int:
+        return max(1, min(self._group_cap,
+                          self._PREFILL_GROUP_TOKENS // max(bucket, 1)))
+
+    def _enqueue_prefill_final(self, group: list[_Slot],
+                               bucket: int) -> None:
+        """Enqueue a batch of same-bucket final prompt chunks: one fused
+        dispatch runs the chunks, seeds the penalty windows, and samples
+        each slot's first token — harvested later as a _Flight (the
+        scheduler never blocks on the result). The group is padded UP
+        with sentinel rows pointing at the out-of-bounds slot id
+        ``n_slots``: JAX drops out-of-bounds scatter updates and clamps
+        out-of-bounds gathers, so a pad row is pure discarded compute
+        that never touches engine state. (Rounding DOWN and deferring
+        the remainder turned one ragged 63-request wave into SIX
+        dispatches of six distinct jit shapes; under HTTP arrival
         raggedness that compile churn collapsed endpoint throughput.)
-        Group sizes come from {1, 8, 32} capped at min(32, n_slots) —
-        when n_slots is not itself in {1, 8, 32} the cap introduces ONE
-        extra variant (e.g. n_slots=6 gives B=6), so the compile surface
-        is at most four sizes (ADVICE r3 #3). At 8B-class sizes one
-        compile costs minutes through the AOT path, so the variant set
-        must stay tiny — these sizes cover any admission pattern at
+        Group sizes come from powers of 8 {1, 8, 64} capped at
+        min(64, n_slots) — a non-member n_slots cap introduces ONE
+        extra variant (ADVICE r3 #3). At 8B-class sizes one compile
+        costs ~13s, so the variant set must stay tiny (Engine.warmup
+        precompiles it) — these sizes cover any admission pattern at
         <=8x padded compute, and padded rows are bandwidth-free (no new
-        weights are read). The cap at 32 also
-        STAGGERS a deep burst: a 64-wave prefills as two dispatches, so
-        the first half's TTFT is one half-wave, not the whole wave —
-        p50 math: with per-dispatch overhead o and per-request compute
-        c, p50 over an n-wave in groups of g is ~(n/2)c + (n/2)(o/g),
-        minimized by the largest g that still splits the wave."""
-        group = group[: min(32, max(self.n_slots, 1))]
+        weights are read). The cache window is pinned to max_seq (not
+        live-context bucketed): the attention saving was ~1ms at
+        serving shapes while every extra window bucket was another
+        13s compile that could land mid-request.
+
+        Slot bookkeeping that later dispatches read (n_past,
+        cache_tokens) advances HERE — device execution order equals
+        enqueue order, so the chunk is on device before anything
+        enqueued after it. The first-token emission happens at
+        harvest."""
+        cap = self._prefill_group_cap(bucket)
+        group = group[:cap]
         B = 1
         while B < len(group):
             B *= 8
-        B = min(B, 32, max(self.n_slots, 1))
+        B = min(B, cap)
         t0 = time.perf_counter()
         W = self.sampling.window
         toks = np.zeros((B, bucket), np.int32)
@@ -1411,15 +1588,32 @@ class LLMEngine:
             "masks": masks,
             "reset": self._reset_columns(group, B),
             "soft": self._soft_payload(group, pos0, bucket),
-            "window": self._window_bucket(int(pos0.max()) + bucket),
+            "window": self.max_seq,
         })
-        toks_host = np.asarray(toks_out)
-        dt_ms = (time.perf_counter() - t0) * 1e3
-        now = time.perf_counter()
+        try:
+            toks_out.copy_to_host_async()
+        except Exception:
+            pass  # not all backends expose it; harvest still works
         for r, s in enumerate(group):
             ln = int(n_chunk[r])
             s.cache_tokens.extend(s.request.prompt_ids[s.n_past:s.n_past + ln])
             s.n_past += ln
+            s.state = SlotState.PENDING_FIRST
+        self._flights.append(_Flight(
+            kind="prefill_final", arrays=[toks_out],
+            meta={"pairs": [(s, s.request) for s in group]},
+            t_enqueue=t0,
+        ))
+
+    def _complete_prefill_final(self, fl: _Flight) -> None:
+        """Harvest a prefill flight: emit each slot's first token and
+        move it into the decode set."""
+        toks_host = np.asarray(fl.arrays[0])
+        dt_ms = (time.perf_counter() - fl.t_enqueue) * 1e3
+        now = time.perf_counter()
+        for r, (s, req) in enumerate(fl.meta["pairs"]):
+            if s.request is not req:  # cancelled mid-flight
+                continue
             s.t_prefill_ms += dt_ms
             self.metrics.prompt_tokens_processed += s.n_prompt
             s.state = SlotState.DECODE
@@ -1546,44 +1740,70 @@ class LLMEngine:
             k = min(compiled)
         return k, room, need
 
-    def _decode_step(self, decoding: list[_Slot]) -> None:
-        """One batched decode dispatch over every running slot
-        (ref: grpc-server.cpp:1688-1726 batching ongoing tokens). Runs k
-        model steps on-device per dispatch when no slot needs per-token
-        host work; tokens generated past a slot's EOS/stop are discarded
-        host-side and its n_past rolled back (the over-written tail K/V sits
-        beyond the valid prefix, so it is never attended to)."""
+    def _dispatch_decode(self, decoding: list[_Slot]) -> bool:
+        """Enqueue (or, for the host-interactive paths, run) decode work
+        (ref: grpc-server.cpp:1688-1726 batching ongoing tokens). The
+        normal path enqueues one k-step scan as a _Flight and keeps up
+        to ``_pipeline_depth`` scans in flight, chained on the
+        device-resident carry — the device never idles waiting for a
+        download, and downloads never serialize behind each other.
+        Tokens generated past a slot's EOS/stop are discarded host-side
+        at harvest (the over-written tail K/V sits beyond the valid
+        prefix, so it is never attended to)."""
         spec_mode, spec_slots = self._spec_mode(decoding)
-        if spec_mode and min(
+        if spec_mode and not self._flights and min(
                 self.max_seq - 1 - s.n_past for s in decoding
         ) >= self.n_draft:
             # near the context wall the kd-token verify forward would
             # clamp its KV writes onto valid rows; normal path instead.
             # Eligible slots advance speculatively; the rest (penalties/
             # grammar/bias/mm) fall through to the normal dispatch below
-            # — PER-SLOT eligibility, not whole-batch.
+            # — PER-SLOT eligibility, not whole-batch. Spec decoding is
+            # a host-interactive (blocking) path, so it runs only with
+            # an empty pipeline.
             self._spec_decode_step(spec_slots, spec_mode)
             decoding = [s for s in decoding
                         if s.state is SlotState.DECODE
                         and s not in spec_slots]
             if not decoding:
-                return
-        t0 = time.perf_counter()
-        S = self.n_slots
+                return True
+        dflights = [f for f in self._flights if f.kind == "decodek"]
+        in_flight = sum(f.meta["k"] for f in dflights)
         k, room, need_tokens = self._multi_step_k(decoding)
-        # no second chained scan when one already covers every slot's
-        # remaining budget (pure overshoot otherwise)
-        depth = 2 if k > 1 and room >= 2 * k and need_tokens > k else 1
+        room -= in_flight
+        if k <= 1:
+            # grammar/logit-bias slots need a host mask per token: the
+            # blocking single-step path, and it needs the true current
+            # tokens — drain the pipeline first
+            if self._flights:
+                return False
+            self._decode1_step(decoding)
+            return True
+        if len(dflights) >= self._pipeline_depth or room < k:
+            return False
+        if need_tokens <= in_flight:
+            return False  # everything already covered by in-flight scans
+        if self._pending and any(not s.active for s in self.slots):
+            # admissible arrivals waiting: their prefill dispatch queues
+            # on the device BEHIND this scan — keep it short so burst
+            # TTFT is not hostage to a long scan. (Free slots alone must
+            # NOT shrink k: that throttled the whole drain phase of a
+            # wave to 1/4 throughput, measured on the 1B config.)
+            k = min(k, 4)
+
+        S = self.n_slots
         if self._use_kernel:
             # the fused Pallas kernel is ragged (reads only valid pages),
             # so no window slicing: one compiled variant for all contexts
             window = self.max_seq
         else:
             # live-context window bucket for this dispatch (_decode_k_fn)
-            # window must cover EVERY decode slot (a spec slot riding
-            # inactive after its own dispatch must not be clamp-trimmed)
+            # window must cover EVERY non-free slot position plus the
+            # tokens already in flight
             need = max(s.n_past for s in self.slots
-                       if s.state is SlotState.DECODE) + depth * k + 1
+                       if s.state in (SlotState.DECODE,
+                                      SlotState.PENDING_FIRST)) \
+                + in_flight + k + 1
             window = self._window_bucket(need)
             # prefer an already-compiled window >= need over compiling a
             # new exact bucket (a cold jit costs seconds; reading a
@@ -1605,99 +1825,122 @@ class LLMEngine:
                 tokens[s.idx, 0] = last_tok
                 pos0[s.idx] = s.n_past
                 active[s.idx] = True
-            elif s.state is SlotState.DECODE:
-                # a spec-eligible slot that already advanced this
-                # iteration: rides inactive; window covers its position
-                # (see `need`), so no trimming
+            elif s.state in (SlotState.DECODE, SlotState.PENDING_FIRST):
+                # spec-advanced or first-token-pending slots ride
+                # inactive; window covers their positions (see `need`),
+                # so no trimming
                 pos0[s.idx] = s.n_past
             else:
                 # park inactive rows at their own tail: K/V write lands past
                 # the valid prefix, preserving it for prefix reuse. In the
-                # windowed (k>1) path, a row whose prefix out-sizes the
-                # window gets clamped: its reusable prefix is truncated to
-                # what the window keeps. The k==1 path uses the full cache.
-                if k > 1 and s.n_past >= window:
+                # windowed path, a row whose prefix out-sizes the window
+                # gets clamped: its reusable prefix is truncated to what
+                # the window keeps.
+                if s.n_past >= window:
                     s.n_past = window - 1
                     s.cache_tokens = s.cache_tokens[: window - 1]
                 pos0[s.idx] = min(s.n_past, self.max_seq - 1)
 
-        if k > 1:
-            # Double-buffered k-step dispatches: the second scan chains on
-            # the first's device-resident carry, so its compute overlaps the
-            # first result's download (the tunnel/dispatch RTT — dominant
-            # cost; see SKILL.md gotcha). Tokens generated past a stop are
-            # discarded like any mid-scan finish.
-            epoch0 = self._epoch
-            akey = active.tobytes()
-            batches = []
-            free_slots = any(not s.active for s in self.slots)
-            for d in range(depth):
-                if d and free_slots:
-                    # an arriving request COULD be admitted (free slot):
-                    # wait for the in-flight scan to actually finish —
-                    # JAX dispatch is async, so checking _pending right
-                    # after enqueueing would race ahead of the scan —
-                    # and skip the chained scan if one arrived, so its
-                    # prefill isn't stuck behind k more steps (burst
-                    # TTFT). With every slot busy (the saturated case)
-                    # the chained scan is enqueued immediately and the
-                    # dispatch pipeline stays full.
-                    while not (batches[-1].is_ready() or self._pending):
-                        time.sleep(2e-4)
-                    if self._pending:
-                        break
-                batches += self._run("decodek", {
-                    "k": k, "window": window, "depth": 1,
-                    "carry": d > 0 or (self._dev_epoch == self._epoch
-                                       and self._dev_akey == akey),
-                    "tokens": tokens, "pos0": pos0, "active": active,
-                })
-            emitted = 0
-            prev_last = {s.idx: int(tokens[s.idx, 0]) for s in decoding}
-            t_prev = t0
-            for toks in batches:
-                toks_host = np.asarray(toks)  # [S, k]
-                now = time.perf_counter()
-                dt_ms = (now - t_prev) * 1e3
-                t_prev = now
-                for s in decoding:
-                    if s.state is not SlotState.DECODE:
-                        continue  # finished in an earlier batch
-                    consumed = [prev_last[s.idx]] + [
-                        int(t) for t in toks_host[s.idx, : k - 1]
-                    ]
-                    prev_last[s.idx] = int(toks_host[s.idx, k - 1])
-                    s.t_decode_ms += dt_ms
-                    for j in range(k):
-                        if s.state is not SlotState.DECODE:
-                            break  # finished: discard overshoot tokens
-                        s.cache_tokens.append(consumed[j])
-                        s.n_past += 1
-                        emitted += 1
-                        self._emit_token(s, int(toks_host[s.idx, j]))
-            # device carry stays valid only if nothing changed while emitting
-            self._dev_epoch = (
-                self._epoch if self._epoch == epoch0 else -1
-            )
-            self._dev_akey = akey
-        else:
-            masks = self._constraint_mask_rows(self.slots)
-            toks = self._run("decode1", {
-                "tokens": tokens, "pos0": pos0, "active": active,
-                "masks": masks,
-            })
-            toks_host = np.asarray(toks)
-            dt_ms = (time.perf_counter() - t0) * 1e3
-            emitted = 0
-            for s in decoding:
-                s.cache_tokens.append(int(tokens[s.idx, 0]))
+        akey = active.tobytes()
+        carry_ok = (self._dev_epoch == self._epoch
+                    and self._dev_akey == akey)
+        if dflights and not carry_ok:
+            # scans in flight but the active set changed (a slot
+            # finished/joined at harvest): fresh host tokens would be
+            # stale until those scans land — wait for them
+            return False
+        batches = self._run("decodek", {
+            "k": k, "window": window, "depth": 1, "carry": carry_ok,
+            "tokens": tokens, "pos0": pos0, "active": active,
+        })
+        toks = batches[0]
+        try:
+            toks.copy_to_host_async()
+        except Exception:
+            pass
+        self._dev_epoch = self._epoch
+        self._dev_akey = akey
+        self._flights.append(_Flight(
+            kind="decodek", arrays=[toks],
+            meta={
+                "k": k,
+                "pairs": [(s, s.request) for s in decoding],
+                # None for a chained scan: its predecessor's last tokens
+                # are unknown until that flight harvests (_harvest_last)
+                "prev_last": (None if dflights else
+                              {s.idx: int(tokens[s.idx, 0])
+                               for s in decoding}),
+            },
+            t_enqueue=time.perf_counter(),
+        ))
+        return True
+
+    def _complete_decodek(self, fl: _Flight) -> None:
+        """Harvest one k-step scan: emit tokens per slot, discarding
+        overshoot past a finish (EOS/stop/limit)."""
+        k = fl.meta["k"]
+        toks_host = np.asarray(fl.arrays[0])  # [S, k]
+        now = time.perf_counter()
+        dt_ms = (now - max(fl.t_enqueue, self._last_harvest_t)) * 1e3
+        self._last_harvest_t = now
+        prev_last = fl.meta["prev_last"]
+        if prev_last is None:
+            prev_last = self._harvest_last
+        emitted = 0
+        next_last: dict[int, int] = {}
+        for s, req in fl.meta["pairs"]:
+            next_last[s.idx] = int(toks_host[s.idx, k - 1])
+            if s.request is not req or s.state is not SlotState.DECODE:
+                continue  # finished/cancelled in an earlier flight
+            consumed = [prev_last[s.idx]] + [
+                int(t) for t in toks_host[s.idx, : k - 1]
+            ]
+            s.t_decode_ms += dt_ms
+            for j in range(k):
+                if s.state is not SlotState.DECODE:
+                    break  # finished: discard overshoot tokens
+                s.cache_tokens.append(consumed[j])
                 s.n_past += 1
-                s.t_decode_ms += dt_ms
                 emitted += 1
-                self._emit_token(s, int(toks_host[s.idx]))
-        dt = time.perf_counter() - t0
-        if dt > 0 and emitted:
-            self.metrics.tokens_per_second = emitted / dt
+                self._emit_token(s, int(toks_host[s.idx, j]))
+        self._harvest_last = next_last
+        if dt_ms > 0 and emitted:
+            self.metrics.tokens_per_second = emitted / (dt_ms / 1e3)
+        self.metrics.slots_busy = sum(1 for s in self.slots if s.active)
+
+    def _decode1_step(self, decoding: list[_Slot]) -> None:
+        """Blocking single-step decode for host-interactive slots
+        (grammar masks / logit_bias need fresh host work every token)."""
+        t0 = time.perf_counter()
+        S = self.n_slots
+        tokens = np.zeros((S, 1), np.int32)
+        pos0 = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
+        for s in self.slots:
+            if s.state is SlotState.DECODE:
+                tokens[s.idx, 0] = (s.generated[-1] if s.generated
+                                    else s.request.prompt_ids[-1])
+                pos0[s.idx] = s.n_past
+                active[s.idx] = True
+            else:
+                pos0[s.idx] = min(s.n_past, self.max_seq - 1)
+        masks = self._constraint_mask_rows(self.slots)
+        toks = self._run("decode1", {
+            "tokens": tokens, "pos0": pos0, "active": active,
+            "masks": masks,
+        })
+        toks_host = np.asarray(toks)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        emitted = 0
+        for s in decoding:
+            s.cache_tokens.append(int(tokens[s.idx, 0]))
+            s.n_past += 1
+            s.t_decode_ms += dt_ms
+            emitted += 1
+            self._emit_token(s, int(toks_host[s.idx]))
+        self._epoch += 1  # device carry (if any) is now stale
+        if dt_ms > 0 and emitted:
+            self.metrics.tokens_per_second = emitted / (dt_ms / 1e3)
         self.metrics.slots_busy = sum(1 for s in self.slots if s.active)
 
     # ---------------------------------------------------- token → stream

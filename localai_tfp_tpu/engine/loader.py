@@ -120,6 +120,9 @@ def register_default_backends() -> None:
     registry.register("jax-rerank", JaxRerankBackend)
     registry.register("jax-tts", JaxTTSBackend)
     registry.register("jax-vad", JaxVADBackend)
+    from ..workers.subprocess_worker import SubprocessBackend
+
+    registry.register("subprocess", SubprocessBackend)
     # jax-whisper / jax-diffusion register as they land
     try:
         from ..workers.whisper import JaxWhisperBackend
@@ -169,6 +172,26 @@ class ModelLoader:
 
     # ------------------------------------------------------------- loading
 
+    def get_loaded(self, name: str) -> Optional[Backend]:
+        """Non-blocking fast path: the already-loaded healthy backend, or
+        None. Routes call this on the EVENT LOOP to skip the thread-pool
+        hop for the common already-loaded case, so it must never wait:
+        ``load()`` holds the loader lock for a whole model load
+        (checkpoint IO + compiles + warmup — minutes at 8B scale), and a
+        blocking acquire here would freeze every request on the server
+        for that long. If the lock is contended, fall back to the
+        executor path (returns None)."""
+        if not self._lock.acquire(blocking=False):
+            return None
+        try:
+            lm = self._models.get(name)
+            if lm is not None and lm.backend.health():
+                lm.last_used = time.monotonic()
+                return lm.backend
+        finally:
+            self._lock.release()
+        return None
+
     def load(self, cfg: ModelConfig) -> Backend:
         """Load-or-reuse (ref: loader.go:119-188 CheckIsLoaded: health-check
         a cached backend and rebuild it if dead)."""
@@ -186,7 +209,12 @@ class ModelLoader:
                     if other != cfg.name:
                         self._shutdown_locked(other)
 
-            btype = resolve_backend(cfg.backend)
+            if cfg.isolation == "subprocess":
+                # child-process containment (workers/subprocess_worker):
+                # the child gets the same yaml minus `isolation`
+                btype = "subprocess"
+            else:
+                btype = resolve_backend(cfg.backend)
             backend = registry.create(btype)
             res = backend.load_model(self._load_options(cfg))
             if not res.success:
@@ -219,7 +247,9 @@ class ModelLoader:
                 [cfg.lora_scale] if cfg.lora_scale else []
             ),
             options=cfg.options,
-            extra=cfg.extra,
+            extra=({**cfg.extra, "_cfg_raw": cfg.raw,
+                    "_models_path": self.models_path}
+                   if cfg.isolation == "subprocess" else cfg.extra),
         )
 
     # ------------------------------------------------------------ lifecycle

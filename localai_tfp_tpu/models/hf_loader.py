@@ -76,18 +76,44 @@ def load_multimodal(model_dir: str, dtype: Any = jnp.bfloat16,
     outer HF config: boi/eoi/image token indices and tokens-per-image
     (ref: the reference's mmproj path — grpc-server.cpp :1476-1502 llava
     embedding; config `mmproj` backend_config.go)."""
-    from .vision import load_vision_params, vision_spec_from_hf
+    import dataclasses
+
+    from .vision import (
+        load_clip_vision_params,
+        load_vision_params,
+        vision_spec_from_hf,
+    )
 
     config, get, names = state or load_hf_state(model_dir)
     vcfg = config.get("vision_config")
     if not isinstance(vcfg, dict):
         return None
     tcfg = config.get("text_config") or {}
+    text_d = int(tcfg.get("hidden_size") or config.get("hidden_size") or 0)
+    clip = any(n.endswith("embeddings.class_embedding") for n in names)
+    if clip:
+        # CLIP/LLaVA family: one soft token per patch, no pooling, no
+        # boi/eoi protocol tokens — the <image> placeholder alone is
+        # replaced (HF LlavaForConditionalGeneration semantics)
+        vspec = vision_spec_from_hf(vcfg, 0, text_d)
+        vspec = dataclasses.replace(
+            vspec, family="clip", mm_tokens=vspec.n_patches,
+            eps=float(vcfg.get("layer_norm_eps") or 1e-5),
+        )
+        vparams = load_clip_vision_params(get, names, dtype, vspec)
+        if vparams is None:
+            return None
+        mm_info = {
+            "boi_token": None,
+            "eoi_token": None,
+            "image_token": int(config.get("image_token_index") or 32000),
+            "mm_tokens": vspec.mm_tokens,
+            "image_size": vspec.image_size,
+            "family": "clip",
+        }
+        return vspec, vparams, mm_info
     mm_tokens = int(config.get("mm_tokens_per_image") or 256)
-    vspec = vision_spec_from_hf(
-        vcfg, mm_tokens,
-        int(tcfg.get("hidden_size") or config.get("hidden_size") or 0),
-    )
+    vspec = vision_spec_from_hf(vcfg, mm_tokens, text_d)
     vparams = load_vision_params(get, names, dtype, vspec)
     if vparams is None:
         return None
@@ -97,6 +123,7 @@ def load_multimodal(model_dir: str, dtype: Any = jnp.bfloat16,
         "image_token": int(config.get("image_token_index") or 262144),
         "mm_tokens": mm_tokens,
         "image_size": vspec.image_size,
+        "family": "siglip",
     }
     return vspec, vparams, mm_info
 
@@ -278,8 +305,11 @@ def load_params(
         )
     p["final_norm_w"] = _cast(get(f"{prefix}norm.weight"), dtype)
     if not spec.tie_word_embeddings:
-        if "lm_head.weight" in names:
-            p["lm_head"] = _cast(t("lm_head.weight"), dtype)
+        # multimodal wrappers nest the head (llava: language_model.lm_head)
+        for head in ("lm_head.weight", "language_model.lm_head.weight"):
+            if head in names:
+                p["lm_head"] = _cast(t(head), dtype)
+                break
         else:  # checkpoint ties despite config
             object.__setattr__(spec, "tie_word_embeddings", True)
 

@@ -118,6 +118,14 @@ def spec_from_hf_config(cfg: dict[str, Any]) -> LLMSpec:
         # tower is not served here, only the language model
         cfg = {**cfg["text_config"], "model_type": "gemma3_text"}
         mt = "gemma3_text"
+    elif mt == "llava" and isinstance(cfg.get("text_config"), dict):
+        # plain-llava wrappers nest a standard text config (usually
+        # llama/mistral); the CLIP tower loads via load_multimodal.
+        # llava_next (anyres grids) / vipllava (multi-layer features)
+        # need different vision semantics — refuse rather than serve
+        # silently-wrong image embeddings.
+        cfg = dict(cfg["text_config"])
+        mt = (cfg.get("model_type") or "llama").lower()
     d_model = cfg.get("hidden_size") or cfg.get("n_embd") or 2048
     n_heads = cfg.get("num_attention_heads") or cfg.get("n_head") or 16
     n_kv = cfg.get("num_key_value_heads") or n_heads

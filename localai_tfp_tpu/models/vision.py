@@ -1,11 +1,22 @@
-"""SigLIP vision tower + Gemma3 multimodal projector (pure JAX).
+"""Vision towers + multimodal projectors (pure JAX): SigLIP/Gemma3 and
+CLIP/LLaVA families.
 
 Capability counterpart of the reference's multimodal path — llama.cpp's
 LLaVA/mmproj image embedding in the C++ engine (ref: grpc-server.cpp
 :1476-1502 llava image embedding, `llava_embd_batch` :420) and the vLLM
 backend's image inputs (ref: backend/python/vllm/backend.py multimodal
-b64 → PIL). Here the vision encoder is the gemma3 family's SigLIP tower;
-its pooled+projected soft tokens are spliced into the language model's
+b64 → PIL). Two encoder families cover the open-weights multimodal
+checkpoints the reference serves:
+
+- **siglip/gemma3**: SigLIP tower (post-LN features) + the Gemma3
+  pool-and-project projector.
+- **clip/llava**: CLIP ViT tower (CLS token, pre-LN, quick-gelu,
+  penultimate-layer features with CLS dropped — HF
+  ``vision_feature_layer=-2``, ``vision_feature_select_strategy=
+  "default"``) + LLaVA's 2-layer MLP projector; one soft token per
+  patch, spliced over the ``<image>`` placeholder.
+
+The projected soft tokens are spliced into the language model's
 embedding sequence (models/transformer.py ``soft`` override).
 
 TPU-first notes: the patch conv is expressed as a patchify+matmul (one
@@ -41,6 +52,8 @@ class VisionSpec:
     # gemma3 projector: pooled tokens per image and the text-model width
     mm_tokens: int = 256
     text_d_model: int = 0
+    # encoder family: "siglip" (gemma3) | "clip" (llava)
+    family: str = "siglip"
 
     @property
     def d_head(self) -> int:
@@ -153,9 +166,74 @@ def gemma3_project(spec: VisionSpec, vp: VisionParams,
     return out
 
 
+def clip_vision_encode(spec: VisionSpec, vp: VisionParams,
+                       pixels: jax.Array) -> jax.Array:
+    """CLIP vision transformer (HF CLIPVisionTransformer): pixels
+    [B, C, H, W] f32 (CLIP-normalized) -> penultimate-layer patch
+    features [B, n_patches, hidden] with the CLS row dropped — exactly
+    LLaVA's ``vision_feature_layer=-2`` + "default" select. Layers use
+    quick_gelu; embeddings carry a learned CLS token and a
+    pre-layernorm; the final encoder layer and post-LN are NOT run
+    (their outputs feed nothing in the -2 path)."""
+    B = pixels.shape[0]
+    P, C = spec.patch_size, spec.channels
+    G = spec.patches_per_side
+    x = pixels.reshape(B, C, G, P, G, P).transpose(0, 2, 4, 1, 3, 5)
+    x = x.reshape(B, G * G, C * P * P)
+    x = x @ vp["patch_w"]  # CLIP patch conv has no bias
+    cls = jnp.broadcast_to(vp["cls_embed"][None, None, :],
+                           (B, 1, spec.hidden)).astype(x.dtype)
+    x = jnp.concatenate([cls, x], axis=1)  # [B, 1+N, D]
+    x = x + vp["pos_embed"][None]
+    x = _ln(x, vp["pre_ln_w"], vp["pre_ln_b"], spec.eps)
+    prec = (lax.Precision.HIGHEST if x.dtype == jnp.float32
+            else lax.Precision.DEFAULT)
+    scale = 1.0 / math.sqrt(spec.d_head)
+    H, Dh = spec.n_heads, spec.d_head
+    N = x.shape[1]
+
+    def quick_gelu(v):
+        return v * jax.nn.sigmoid(1.702 * v)
+
+    def layer(x, lp):
+        h = _ln(x, lp["ln1_w"], lp["ln1_b"], spec.eps)
+        q = (h @ lp["wq"] + lp["bq"]).reshape(B, N, H, Dh)
+        k = (h @ lp["wk"] + lp["bk"]).reshape(B, N, H, Dh)
+        v = (h @ lp["wv"] + lp["bv"]).reshape(B, N, H, Dh)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32,
+                            precision=prec) * scale
+        probs = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32,
+                          precision=prec)
+        attn = attn.reshape(B, N, H * Dh).astype(x.dtype)
+        x = x + (attn @ lp["wo"] + lp["bo"])
+        h = _ln(x, lp["ln2_w"], lp["ln2_b"], spec.eps)
+        h = quick_gelu(h @ lp["fc1_w"] + lp["fc1_b"])
+        x = x + (h @ lp["fc2_w"] + lp["fc2_b"])
+        return x, None
+
+    # layers are stacked [L, ...]; run only the first L-1 (feature -2)
+    trimmed = jax.tree_util.tree_map(lambda a: a[:-1], vp["layers"])
+    x, _ = lax.scan(layer, x, trimmed)
+    return x[:, 1:, :]  # drop CLS
+
+
+def llava_project(spec: VisionSpec, vp: VisionParams,
+                  feats: jax.Array) -> jax.Array:
+    """LlavaMultiModalProjector: linear -> gelu -> linear, one soft
+    token per patch."""
+    h = feats @ vp["mm_l1_w"] + vp["mm_l1_b"]
+    h = jax.nn.gelu(h, approximate=False)
+    return h @ vp["mm_l2_w"] + vp["mm_l2_b"]
+
+
 def encode_images(spec: VisionSpec, vp: VisionParams,
                   pixels: jax.Array) -> jax.Array:
     """pixels [B, C, H, W] -> soft tokens [B, mm_tokens, text_d_model]."""
+    if spec.family == "clip":
+        return llava_project(spec, vp, clip_vision_encode(spec, vp, pixels))
     return gemma3_project(spec, vp, vision_encode(spec, vp, pixels))
 
 
@@ -165,22 +243,115 @@ encode_images_jit = jax.jit(encode_images, static_argnums=(0,))
 # --------------------------------------------------------------- preprocess
 
 
-def preprocess_image(data: bytes, image_size: int) -> np.ndarray:
-    """Decode + resize + normalize one image to [C, H, W] f32, matching
-    Gemma3ImageProcessor: bilinear resize to the square image_size,
-    rescale 1/255, normalize mean=0.5 std=0.5 per channel."""
+_CLIP_MEAN = np.asarray([0.48145466, 0.4578275, 0.40821073], np.float32)
+_CLIP_STD = np.asarray([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+def preprocess_image(data: bytes, image_size: int,
+                     family: str = "siglip") -> np.ndarray:
+    """Decode + resize + normalize one image to [C, H, W] f32.
+
+    siglip: Gemma3ImageProcessor — bilinear resize to the square
+    image_size, rescale 1/255, normalize mean=0.5 std=0.5.
+    clip: CLIPImageProcessor — bicubic resize of the SHORT side to
+    image_size, center crop, rescale, CLIP mean/std."""
     import io
 
     from PIL import Image
 
     img = Image.open(io.BytesIO(data)).convert("RGB")
-    img = img.resize((image_size, image_size), Image.BILINEAR)
-    arr = np.asarray(img, dtype=np.float32) / 255.0  # [H, W, C]
-    arr = (arr - 0.5) / 0.5
+    if family == "clip":
+        w, h = img.size
+        short = min(w, h)
+        nw, nh = (round(w * image_size / short),
+                  round(h * image_size / short))
+        img = img.resize((nw, nh), Image.BICUBIC)
+        left = (nw - image_size) // 2
+        top = (nh - image_size) // 2
+        img = img.crop((left, top, left + image_size, top + image_size))
+        arr = np.asarray(img, dtype=np.float32) / 255.0
+        arr = (arr - _CLIP_MEAN) / _CLIP_STD
+    else:
+        img = img.resize((image_size, image_size), Image.BILINEAR)
+        arr = np.asarray(img, dtype=np.float32) / 255.0  # [H, W, C]
+        arr = (arr - 0.5) / 0.5
     return np.ascontiguousarray(arr.transpose(2, 0, 1))
 
 
 # ------------------------------------------------------------------- loader
+
+
+def load_clip_vision_params(
+    get, names: list[str], dtype: Any, spec: VisionSpec,
+) -> Optional[VisionParams]:
+    """Load a CLIP tower + LLaVA MLP projector (tensors under
+    [model.]vision_tower.vision_model.* and
+    [model.]multi_modal_projector.linear_{1,2}.*)."""
+    for pref in ("model.vision_tower.vision_model.",
+                 "vision_tower.vision_model."):
+        if f"{pref}embeddings.class_embedding" in names:
+            break
+    else:
+        return None
+    proj = ("model.multi_modal_projector."
+            if "model.multi_modal_projector.linear_1.weight" in names
+            else "multi_modal_projector.")
+
+    def cast(a):
+        return jnp.asarray(np.ascontiguousarray(a)).astype(dtype)
+
+    D = spec.hidden
+    conv = get(pref + "embeddings.patch_embedding.weight")  # [D, C, P, P]
+    # HF spells it "pre_layrnorm" (sic)
+    pre = ("pre_layrnorm"
+           if pref + "pre_layrnorm.weight" in names else "pre_layernorm")
+    p: VisionParams = {
+        "patch_w": cast(conv.reshape(D, -1).T),  # [C*P*P, D]
+        "cls_embed": cast(get(pref + "embeddings.class_embedding")
+                          .reshape(-1)),
+        "pos_embed": cast(get(pref + "embeddings.position_embedding.weight")),
+        "pre_ln_w": cast(get(pref + f"{pre}.weight")),
+        "pre_ln_b": cast(get(pref + f"{pre}.bias")),
+        "mm_l1_w": cast(np.ascontiguousarray(
+            get(proj + "linear_1.weight").T)),
+        "mm_l1_b": cast(get(proj + "linear_1.bias")),
+        "mm_l2_w": cast(np.ascontiguousarray(
+            get(proj + "linear_2.weight").T)),
+        "mm_l2_b": cast(get(proj + "linear_2.bias")),
+    }
+    lp = pref + "encoder.layers.{i}."
+
+    def stack(name, transpose):
+        rows = []
+        for i in range(spec.n_layers):
+            w = get(lp.format(i=i) + name)
+            rows.append(np.ascontiguousarray(w.T) if transpose else w)
+        return cast(np.stack(rows))
+
+    p["layers"] = _encoder_layer_stack(stack)
+    return p
+
+
+def _encoder_layer_stack(stack) -> dict:
+    """The SigLIP and CLIP encoder layers share HF tensor names."""
+    return {
+        "ln1_w": stack("layer_norm1.weight", False),
+        "ln1_b": stack("layer_norm1.bias", False),
+        "wq": stack("self_attn.q_proj.weight", True),
+        "bq": stack("self_attn.q_proj.bias", False),
+        "wk": stack("self_attn.k_proj.weight", True),
+        "bk": stack("self_attn.k_proj.bias", False),
+        "wv": stack("self_attn.v_proj.weight", True),
+        "bv": stack("self_attn.v_proj.bias", False),
+        "wo": stack("self_attn.out_proj.weight", True),
+        "bo": stack("self_attn.out_proj.bias", False),
+        "ln2_w": stack("layer_norm2.weight", False),
+        "ln2_b": stack("layer_norm2.bias", False),
+        "fc1_w": stack("mlp.fc1.weight", True),
+        "fc1_b": stack("mlp.fc1.bias", False),
+        "fc2_w": stack("mlp.fc2.weight", True),
+        "fc2_b": stack("mlp.fc2.bias", False),
+    }
 
 
 def load_vision_params(
@@ -223,22 +394,5 @@ def load_vision_params(
             rows.append(np.ascontiguousarray(w.T) if transpose else w)
         return cast(np.stack(rows))
 
-    p["layers"] = {
-        "ln1_w": stack("layer_norm1.weight", False),
-        "ln1_b": stack("layer_norm1.bias", False),
-        "wq": stack("self_attn.q_proj.weight", True),
-        "bq": stack("self_attn.q_proj.bias", False),
-        "wk": stack("self_attn.k_proj.weight", True),
-        "bk": stack("self_attn.k_proj.bias", False),
-        "wv": stack("self_attn.v_proj.weight", True),
-        "bv": stack("self_attn.v_proj.bias", False),
-        "wo": stack("self_attn.out_proj.weight", True),
-        "bo": stack("self_attn.out_proj.bias", False),
-        "ln2_w": stack("layer_norm2.weight", False),
-        "ln2_b": stack("layer_norm2.bias", False),
-        "fc1_w": stack("mlp.fc1.weight", True),
-        "fc1_b": stack("mlp.fc1.bias", False),
-        "fc2_w": stack("mlp.fc2.weight", True),
-        "fc2_b": stack("mlp.fc2.bias", False),
-    }
+    p["layers"] = _encoder_layer_stack(stack)
     return p

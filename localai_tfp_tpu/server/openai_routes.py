@@ -81,6 +81,9 @@ def _resolve_config(request: web.Request, body: dict,
 
 async def _load_backend(request: web.Request, cfg: ModelConfig) -> Backend:
     st = _state(request)
+    backend = st.model_loader.get_loaded(cfg.name)  # no executor hop
+    if backend is not None:
+        return backend
     return await run_blocking(st.model_loader.load, cfg)
 
 
@@ -320,31 +323,40 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
     grammar = _grammar_for_request(cfg, body, tools)
 
     tokenizer = getattr(backend, "tokenizer", None)
-    # collect image parts only for backends with a vision tower; for
-    # text-only models image parts are dropped from the flattened text
-    # (no [img-N] markers, no downloads) as before
-    media: Optional[list] = (
-        [] if getattr(backend, "vision", None) is not None else None)
-    prompt = st.evaluator.template_messages(
-        cfg, messages, tokenizer=tokenizer,
-        functions=tools or None, use_function_template=tools_requested,
-        media=media,
-    )
+    corr = request.get("correlation_id", "")
+    has_vision = getattr(backend, "vision", None) is not None
 
-    opts = _predict_options(cfg, body, prompt,
-                            request.get("correlation_id", ""))
-    if media:
-        # image parts -> raw bytes (data: URLs decoded inline, http(s)
-        # downloaded — ref: middleware/request.go:302-329 base64-ification)
-        opts.images = await _fetch_media_all(media)
-    if grammar:
-        opts.grammar = grammar
-        # lazy-grammar triggers from the model yaml (function.grammar.
-        # triggers: [{word: ...}] — ref: parse.go:51, options.go:118)
-        opts.grammar_triggers = [w for w in (
-            t.get("word", "") if isinstance(t, dict) else str(t)
-            for t in (cfg.function.grammar_options().get("triggers") or [])
-        ) if w]  # entries without a word (e.g. token-id style) drop out
+    def build_opts(media: Optional[list]) -> PredictOptions:
+        """Template + sampling merge. For text-only STREAMING requests
+        this runs on the producer THREAD, not the event loop: at 64
+        concurrent arrivals the loop serialized ~3ms of per-request
+        template/merge work into a >200ms first-byte queue."""
+        prompt = st.evaluator.template_messages(
+            cfg, messages, tokenizer=tokenizer,
+            functions=tools or None, use_function_template=tools_requested,
+            media=media,
+        )
+        opts = _predict_options(cfg, body, prompt, corr)
+        if grammar:
+            opts.grammar = grammar
+            # lazy-grammar triggers from the model yaml (function.grammar
+            # .triggers: [{word:...}] — ref: parse.go:51, options.go:118)
+            opts.grammar_triggers = [w for w in (
+                t.get("word", "") if isinstance(t, dict) else str(t)
+                for t in (cfg.function.grammar_options().get("triggers")
+                          or [])
+            ) if w]  # entries without a word drop out
+        return opts
+
+    async def build_opts_with_media() -> PredictOptions:
+        media: list = []
+        opts = build_opts(media)
+        if media:
+            # image parts -> raw bytes (data: URLs decoded inline, http(s)
+            # downloaded — ref: middleware/request.go:302-329)
+            opts.images = await _fetch_media_all(media)
+        return opts
+
     extra_usage = ("Extra-Usage" in request.headers
                    or bool((body.get("stream_options") or {})
                            .get("include_usage")))
@@ -355,14 +367,29 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
     st.model_loader.mark_busy(cfg.name)
     try:
         if body.get("stream"):
+            if has_vision:
+                opts_src: Any = await build_opts_with_media()
+            else:
+                # cheap EAGER validation of the sampling merge (no
+                # template): a bad parameter must be a pre-stream 400,
+                # not an SSE error event after a 200 (the deferred
+                # factory covers only template/tokenize work)
+                try:
+                    _predict_options(cfg, body, "", corr)
+                except (TypeError, ValueError) as e:
+                    raise web.HTTPBadRequest(
+                        reason=f"invalid sampling parameter: {e}")
+                opts_src = lambda: build_opts(None)  # noqa: E731
             return await _stream_chat(
-                request, backend, opts, cfg, cid, created,
+                request, backend, opts_src, cfg, cid, created,
                 tools_requested, extra_usage,
             )
 
         # n>1: the choices run CONCURRENTLY — the continuous-batching
         # engine serves them from parallel slots (ref: ComputeChoices,
         # endpoints/openai/inference.go:11-60 loops n)
+        opts = (await build_opts_with_media() if has_vision
+                else build_opts(None))
         replies = await asyncio.gather(*[
             _run_predict(backend, opts) for _ in range(n)
         ])
@@ -410,7 +437,7 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
 async def _stream_chat(
     request: web.Request,
     backend: Backend,
-    opts: PredictOptions,
+    opts_src: Any,  # PredictOptions, or a () -> PredictOptions factory
     cfg: ModelConfig,
     cid: str,
     created: int,
@@ -419,7 +446,10 @@ async def _stream_chat(
 ) -> web.StreamResponse:
     """SSE streaming (ref: chat.go:331-381 token chunks; tool-call streaming
     chat.go:69-172: when tools are active the output is buffered, parsed,
-    and emitted as tool_call deltas)."""
+    and emitted as tool_call deltas). ``opts_src`` may be a factory: the
+    producer thread then does the template/merge work off the event
+    loop (a template failure surfaces as a stream error event — headers
+    are already sent by then)."""
     resp = web.StreamResponse(headers={
         "Content-Type": "text/event-stream",
         "Cache-Control": "no-cache",
@@ -446,10 +476,12 @@ async def _stream_chat(
 
     loop = asyncio.get_running_loop()
     q: asyncio.Queue = asyncio.Queue()
-    opts.request_id = opts.request_id or uuid.uuid4().hex
+    rid = uuid.uuid4().hex
 
     def producer() -> None:
         try:
+            opts = opts_src() if callable(opts_src) else opts_src
+            opts.request_id = opts.request_id or rid
             for r in backend.predict_stream(opts):
                 loop.call_soon_threadsafe(q.put_nowait, r)
         except Exception as e:  # surface engine errors as a final reply
@@ -491,7 +523,7 @@ async def _stream_chat(
     except (ConnectionResetError, asyncio.CancelledError):
         # client went away: free the slot instead of decoding to
         # max_tokens (ref: llama.cpp task cancel on disconnect)
-        backend.cancel(opts.request_id)
+        backend.cancel(getattr(opts_src, "request_id", "") or rid)
         raise
 
     finish = (final.finish_reason if final else "stop") or "stop"

@@ -214,6 +214,13 @@ class JaxLLMBackend(Backend):
                     tag=opts.model,
                 )
                 self.engine.start()
+                if (role != "follower"
+                        and os.environ.get("LOCALAI_WARMUP", "1")
+                        not in ("0", "false", "off")):
+                    # precompile the dispatch-variant set: a cold jit
+                    # landing mid-request is a ~13s TTFT outlier at 8B
+                    # scale (engine.warmup docstring)
+                    self.engine.warmup()
                 self._state = "READY"
                 return Result(True, "model loaded")
             except Exception as e:
@@ -280,7 +287,8 @@ class JaxLLMBackend(Backend):
 
         vspec, vparams, mm = self.vision
         pix = np.stack([
-            preprocess_image(b, mm["image_size"]) for b in images
+            preprocess_image(b, mm["image_size"],
+                             mm.get("family", "siglip")) for b in images
         ])
         emb = self.engine.params["embed"]
         dtype = emb.q.dtype if hasattr(emb, "q") else emb.dtype
@@ -309,12 +317,14 @@ class JaxLLMBackend(Backend):
                 ids.extend(self.tokenizer.encode(
                     f"[img-{parts[j]}]" + text, add_bos=False))
                 continue
-            ids.append(mm["boi_token"])
+            if mm.get("boi_token") is not None:
+                ids.append(mm["boi_token"])
             start = len(ids)
             ids.extend([mm["image_token"]] * mm["mm_tokens"])
             positions.extend(range(start, start + mm["mm_tokens"]))
             rows.append(soft_all[img_i])
-            ids.append(mm["eoi_token"])
+            if mm.get("eoi_token") is not None:
+                ids.append(mm["eoi_token"])
             if text:
                 ids.extend(self.tokenizer.encode(text, add_bos=False))
         if not rows:  # only bogus markers: plain text request

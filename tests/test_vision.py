@@ -190,3 +190,143 @@ def test_templating_collects_media_markers():
     ], media=media)
     assert "[img-0]" in out and "look at " in out
     assert len(media) == 1
+
+
+# ------------------------- CLIP / LLaVA family -------------------------
+
+
+@pytest.fixture(scope="module")
+def llava_ckpt(tmp_path_factory):
+    import torch
+    from transformers import LlavaConfig, LlavaForConditionalGeneration
+
+    torch.manual_seed(0)
+    cfg = LlavaConfig(
+        text_config=dict(
+            model_type="llama",
+            vocab_size=128,
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            max_position_embeddings=256,
+        ),
+        vision_config=dict(
+            model_type="clip_vision_model",
+            hidden_size=32,
+            num_hidden_layers=3,
+            num_attention_heads=2,
+            intermediate_size=64,
+            image_size=28,
+            patch_size=14,
+            num_channels=3,
+        ),
+        image_token_index=90,
+        vision_feature_layer=-2,
+        vision_feature_select_strategy="default",
+    )
+    model = LlavaForConditionalGeneration(cfg)
+    d = tmp_path_factory.mktemp("mm") / "llava-mm"
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+def test_llava_tower_and_projector_match_hf(llava_ckpt):
+    """CLIP tower (penultimate layer, CLS dropped) + MLP projector vs HF
+    LlavaForConditionalGeneration.get_image_features (VERDICT r3 next
+    #6: llava-class mmproj vision)."""
+    import torch
+    from transformers import LlavaForConditionalGeneration
+
+    from localai_tfp_tpu.models.hf_loader import load_multimodal
+    from localai_tfp_tpu.models.vision import encode_images
+
+    vspec, vparams, mm = load_multimodal(llava_ckpt, dtype=jnp.float32)
+    assert vspec.family == "clip"
+    assert mm["image_token"] == 90 and mm["boi_token"] is None
+    assert mm["mm_tokens"] == 4  # (28/14)^2 patches
+
+    rng = np.random.default_rng(0)
+    pixels = rng.normal(size=(2, 3, 28, 28)).astype(np.float32)
+
+    hf = LlavaForConditionalGeneration.from_pretrained(
+        llava_ckpt, torch_dtype=torch.float32)
+    hf.eval()
+    with torch.no_grad():
+        ref = hf.get_image_features(torch.tensor(pixels))
+        if isinstance(ref, (list, tuple)):  # per-image list in newer HF
+            ref = torch.stack(list(ref))
+        ref = ref.numpy()
+
+    got = np.asarray(encode_images(vspec, vparams, jnp.asarray(pixels)))
+    np.testing.assert_allclose(got, ref.reshape(got.shape),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_llava_logits_match_hf(llava_ckpt):
+    """Soft-token splice over the <image> placeholder reproduces HF
+    multimodal logits."""
+    import torch
+    from transformers import LlavaForConditionalGeneration
+
+    from localai_tfp_tpu.models.hf_loader import load_multimodal, load_params
+    from localai_tfp_tpu.models.transformer import KVCache, forward
+    from localai_tfp_tpu.models.vision import encode_images
+
+    spec, params = load_params(llava_ckpt, dtype=jnp.float32)
+    vspec, vparams, mm = load_multimodal(llava_ckpt, dtype=jnp.float32)
+
+    rng = np.random.default_rng(1)
+    pixels = rng.normal(size=(1, 3, 28, 28)).astype(np.float32)
+    ids = [5, 17] + [mm["image_token"]] * mm["mm_tokens"] + [23, 42]
+    tokens = np.asarray([ids], np.int32)
+
+    hf = LlavaForConditionalGeneration.from_pretrained(
+        llava_ckpt, torch_dtype=torch.float32)
+    hf.eval()
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(tokens, dtype=torch.long),
+                 pixel_values=torch.tensor(pixels)).logits.numpy()
+
+    soft_tokens = np.asarray(
+        encode_images(vspec, vparams, jnp.asarray(pixels)))[0]
+    T = tokens.shape[1]
+    emb = np.zeros((1, T, spec.d_model), np.float32)
+    mask = tokens == mm["image_token"]
+    emb[0, mask[0]] = soft_tokens
+    cache = KVCache.create(spec, 1, 32, jnp.float32)
+    logits, _ = forward(
+        spec, params, jnp.asarray(tokens), jnp.zeros((1,), jnp.int32),
+        cache, jnp.zeros((1,), jnp.int32),
+        soft=(jnp.asarray(emb), jnp.asarray(mask)),
+    )
+    np.testing.assert_allclose(np.asarray(logits), ref, rtol=3e-4,
+                               atol=3e-4)
+
+
+def test_llava_worker_splices_images_without_boi(llava_ckpt):
+    """The LLM worker's [img-N] splice must handle the boi/eoi-less
+    llava protocol end to end (image chat through the backend)."""
+    from localai_tfp_tpu.workers.base import ModelLoadOptions, PredictOptions
+    from localai_tfp_tpu.workers.llm import JaxLLMBackend
+
+    b = JaxLLMBackend()
+    res = b.load_model(ModelLoadOptions(
+        model=llava_ckpt, context_size=64, batch_slots=2,
+        dtype="float32"))
+    assert res.success, res.message
+    assert b.vision is not None
+    import io
+
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", (32, 32), (200, 30, 30)).save(buf, format="PNG")
+    png = buf.getvalue()
+    reply = b.predict(PredictOptions(
+        prompt="look: [img-0] describe", tokens=4, ignore_eos=True,
+        images=[png]))
+    assert not reply.error
+    assert reply.tokens == 4
+    b.shutdown()

@@ -75,6 +75,7 @@ def wave(eng, tok, n_req, n_tok):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--small", action="store_true")
+    ap.add_argument("--no-http", action="store_true")
     args = ap.parse_args()
 
     jax.config.update("jax_compilation_cache_dir", "/root/.cache/localai_xla")
@@ -99,17 +100,28 @@ def main():
     def traced_run(kind, payload):
         t0 = time.perf_counter()
         out = orig_run(kind, payload)
-        # block so the wall time is the dispatch's real device time when
-        # the result is consumed synchronously (prefill_final / decode1);
-        # decodek returns futures — time those separately below
+        shape = (list(payload["toks"].shape)
+                 if kind.startswith("prefill") else payload.get("k"))
         log.append((kind, round((time.perf_counter() - t0) * 1e3, 2),
-                    round(t0, 4)))
+                    round(t0, 4), shape))
         return out
 
+    rems = []
+    orig_assign = eng._assign
+
+    def traced_assign(slot, req, out):
+        pre = len(slot.cache_tokens)
+        orig_assign(slot, req, out)
+        rems.append((slot.idx, pre, slot.n_past,
+                     slot.n_prompt - slot.n_past))
+
+    eng._assign = traced_assign
     eng._run = traced_run
     t_wave = time.perf_counter()
     total, wall, ttfts, errs = wave(eng, tok, n_req, n_tok)
     eng._run = orig_run
+    eng._assign = orig_assign
+    print("ASSIGN (slot, cache_len, n_past, rem):", rems[:10], flush=True)
     if errs:
         print("ENGINE WAVE ERRORS:", errs[:2], flush=True)
     report = {
@@ -119,14 +131,18 @@ def main():
             "ttft_min_ms": round(ttfts[0], 1),
             "ttft_max_ms": round(ttfts[-1], 1),
             "dispatches": [
-                {"kind": k, "ms": ms, "at_ms": round((at - t_wave) * 1e3, 1)}
-                for k, ms, at in log[:40]
+                {"kind": k, "ms": ms, "at_ms": round((at - t_wave) * 1e3, 1),
+                 "shape": sh}
+                for k, ms, at, sh in log[:40]
             ],
             "n_dispatches": len(log),
         },
     }
     print(json.dumps(report, indent=1), flush=True)  # engine leg first —
     # the HTTP leg must not be able to lose it
+    if args.no_http:
+        eng.close()
+        return
 
     # -------- HTTP leg with phase timestamps --------
     import asyncio
