@@ -219,19 +219,44 @@ def _bench_http(eng, tok, n_req, n_tok, runs=2):
                     continue
                 best = max(best, sum(totals) / wall)
                 tt_all.extend(t for t in ttfts if t is not None)
+
+            # steady-state TTFT: one new request arriving while the
+            # engine is BUSY serving a near-full wave — the classic
+            # serving-TTFT methodology (arrival at service rate), vs the
+            # cold 64-deep burst above where p50 necessarily includes
+            # half the wave's own admission
+            steady: list[float] = []
+
+            async def stagger():
+                for _ in range(8):
+                    await asyncio.sleep(0.35)
+                    tt = [None]
+                    t1 = time.perf_counter()
+                    await one(0, t1, tt)
+                    if tt[0] is not None:
+                        steady.append(tt[0])
+
+            bg_tt = [None] * (n_req - 1)
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *[one(i, t0, bg_tt) for i in range(n_req - 1)],
+                stagger())
         await runner.cleanup()
         tt_all.sort()
+        steady.sort()
         out["tok_s"] = round(best, 2)
         out["p50"] = round(tt_all[len(tt_all) // 2], 1) if tt_all else 0.0
         out["p95"] = (round(tt_all[int(len(tt_all) * 0.95)], 1)
                       if tt_all else 0.0)
+        out["p50_steady"] = (round(steady[len(steady) // 2], 1)
+                             if steady else 0.0)
 
     loop = asyncio.new_event_loop()
     try:
         loop.run_until_complete(drive())
     finally:
         loop.close()
-    return out["tok_s"], out["p50"], out["p95"]
+    return out["tok_s"], out["p50"], out["p95"], out["p50_steady"]
 
 
 def _fast_int8_params(spec):
@@ -302,17 +327,23 @@ def main() -> None:
     from localai_tfp_tpu.models.transformer import init_params
 
     class WideByteTok(ByteTokenizer):
-        """ByteTokenizer whose decode maps ANY id to a byte (id % 256).
-        Random-weight models over a 128k vocab virtually never sample
-        ids < 256, so with the plain ByteTokenizer no text would ever
-        stream through the endpoint and client-side TTFT could not be
-        measured (every SSE content delta would be empty)."""
+        """ByteTokenizer whose decode maps ANY id to a PRINTABLE ASCII
+        char. Random-weight models over a 128k vocab virtually never
+        sample ids < 256, so with the plain ByteTokenizer no text would
+        ever stream through the endpoint and client-side TTFT could not
+        be measured. Printable ASCII (not id % 256 raw bytes) matters
+        for honesty the other way: random high bytes look like UTF-8
+        lead bytes, the stream decoder withholds them awaiting
+        continuations, and half the streams' first visible content
+        slips to the NEXT k-step scan burst — measured +1.3s of
+        client TTFT that says nothing about the serving engine. A real
+        tokenizer emits visible text on virtually every token."""
 
         def decode(self, ids):
-            return bytes(
-                i % 256 for i in ids
+            return "".join(
+                chr(32 + (i % 95)) for i in ids
                 if i not in (self.bos_id, *self.eos_ids)
-            ).decode("latin-1")
+            )
 
     on_tpu = jax.default_backend() == "tpu"
     tok = WideByteTok()
@@ -378,13 +409,17 @@ def main() -> None:
         )
         eng8.start()
         eng8.warmup()
-        tok_s8, p50_8, p95_8 = _bench_config(eng8, tok, 64, 256, runs=2)
+        # 512-token streams: admission raggedness amortizes over the
+        # stream length, so throughput reflects serving, not wave edges
+        tok_s8, p50_8, p95_8 = _bench_config(eng8, tok, 64, 512, runs=2)
         extra["decode_tok_s_8b_engine"] = tok_s8
         extra["ttft_p50_ms_8b_engine"] = p50_8
         extra["ttft_p95_ms_8b_engine"] = p95_8
-        tok_s, p50_h, p95_h = _bench_http(eng8, tok, 64, 256, runs=2)
+        tok_s, p50_h, p95_h, p50_steady = _bench_http(
+            eng8, tok, 64, 512, runs=2)
         extra["ttft_p50_ms_8b_http"] = p50_h
         extra["ttft_p95_ms_8b_http"] = p95_h
+        extra["ttft_p50_ms_8b_http_steady"] = p50_steady
         extra["http_vs_engine"] = round(tok_s / max(tok_s8, 1e-9), 4)
         eng8.close()
         del eng8, params8
@@ -404,7 +439,7 @@ def main() -> None:
         eng.start()
         tok_s_eng, p50, p95 = _bench_config(eng, tok, 4, 32, runs=1)
         extra["decode_tok_s_engine"] = tok_s_eng
-        tok_s, p50_h, _ = _bench_http(eng, tok, 4, 32, runs=1)
+        tok_s, p50_h, _, _ = _bench_http(eng, tok, 4, 32, runs=1)
         eng.close()
         extra["ttft_p50_ms"] = p50
         extra["ttft_p50_ms_http"] = p50_h

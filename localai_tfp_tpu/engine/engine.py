@@ -415,6 +415,10 @@ class LLMEngine:
         self._harvest_last: dict[int, int] = {}  # last token per slot of
         # the most recently harvested scan (chained flights' prev_last)
         self._last_harvest_t = 0.0
+        self._last_arrival = 0.0  # submit time of the newest request —
+        # decode scheduling yields briefly to an admission burst
+        self._hold_start = 0.0  # when the current admission-burst hold
+        # began (0 = not holding); bounds hold duration
 
     def _kernel_eligible(self) -> bool:
         """Use the Pallas ragged decode kernels when the mosaic path is
@@ -1060,6 +1064,24 @@ class LLMEngine:
                     "masks": None, "reset": reset, "soft": None,
                     "window": self.max_seq,
                 })
+        if self.max_seq > self.prefill_buckets[-1]:
+            # long prompts chunk through the "prefill" fn at live-context
+            # window buckets — compile those too, or the first long
+            # prompt stalls on a mid-request jit
+            w = 256
+            windows = set()
+            while w < self.max_seq:
+                windows.add(w)
+                w *= 2
+            windows.add(self.max_seq)
+            for w in sorted(windows):
+                self._run("prefill", {
+                    "toks": np.zeros((1, self.prefill_buckets[-1]),
+                                     np.int32),
+                    "pos0": np.zeros((1,), np.int32),
+                    "slot_ids": np.full((1,), self.n_slots, np.int32),
+                    "soft": None, "window": w,
+                })
         S = self.n_slots
         inactive = {
             "tokens": np.zeros((S, 1), np.int32),
@@ -1067,14 +1089,22 @@ class LLMEngine:
             "active": np.zeros((S,), bool),
         }
         ks = {1, min(4, self.decode_steps), self.decode_steps}
-        window = (self.max_seq if self._use_kernel
-                  else self._window_bucket(256))
+        if self._use_kernel:
+            windows_d = {self.max_seq}  # ragged kernel: one variant
+        else:
+            windows_d = set()
+            w = 256
+            while w < self.max_seq:
+                windows_d.add(w)
+                w *= 2
+            windows_d.add(self.max_seq)
         for k in sorted(ks):
             if k > 1:
-                self._run("decodek", {
-                    "k": k, "window": window, "depth": 1, "carry": False,
-                    **inactive,
-                })
+                for w in sorted(windows_d):
+                    self._run("decodek", {
+                        "k": k, "window": w, "depth": 1, "carry": False,
+                        **inactive,
+                    })
         self._run("decode1", {**inactive, "masks": None})
         self._dev_epoch = -1  # warmup carries are not serving state
         # block until every warmup compile retires so the first real
@@ -1108,6 +1138,7 @@ class LLMEngine:
                 ok.append((req, out))
         with self._lock:
             self._pending.extend(ok)
+            self._last_arrival = time.perf_counter()
             self._lock.notify_all()
         if self._autostart:
             self.start()
@@ -1767,6 +1798,22 @@ class LLMEngine:
                         and s not in spec_slots]
             if not decoding:
                 return True
+        now = time.perf_counter()
+        burst = bool(self._pending) or now - self._last_arrival < 0.15
+        if burst and any(not s.active for s in self.slots):
+            # an admission burst is landing: hold decode enqueues so the
+            # burst's prefill groups pipeline back-to-back on the device
+            # instead of each queueing behind hundreds of ms of scan
+            # work — under a 64-stream HTTP wave this is the difference
+            # between ~0.4 s and ~1.7 s p50 TTFT. Bounded from the
+            # hold's START so a steady trickle cannot starve decode.
+            if self._hold_start == 0.0:
+                self._hold_start = now
+            if now - self._hold_start < 0.5:
+                time.sleep(1e-3)
+                return False
+        else:
+            self._hold_start = 0.0
         dflights = [f for f in self._flights if f.kind == "decodek"]
         in_flight = sum(f.meta["k"] for f in dflights)
         k, room, need_tokens = self._multi_step_k(decoding)
@@ -1783,12 +1830,14 @@ class LLMEngine:
             return False
         if need_tokens <= in_flight:
             return False  # everything already covered by in-flight scans
-        if self._pending and any(not s.active for s in self.slots):
-            # admissible arrivals waiting: their prefill dispatch queues
-            # on the device BEHIND this scan — keep it short so burst
-            # TTFT is not hostage to a long scan. (Free slots alone must
-            # NOT shrink k: that throttled the whole drain phase of a
-            # wave to 1/4 throughput, measured on the 1B config.)
+        if ((self._pending or now - self._last_arrival < 1.0)
+                and any(not s.active for s in self.slots)):
+            # arrivals active with admissible room: a late request's
+            # prefill dispatch queues on the device BEHIND this scan —
+            # keep it short so burst TTFT is not hostage to a long
+            # scan. (Free slots alone must NOT shrink k: that throttled
+            # the whole drain phase of a wave to 1/4 throughput,
+            # measured on the 1B config.)
             k = min(k, 4)
 
         S = self.n_slots

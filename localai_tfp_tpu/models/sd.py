@@ -948,3 +948,122 @@ def consumed_keys_check(pipe: SDPipeline, prompt: str = "x") -> dict:
                    jnp.zeros((1, snap, snap, 3), jnp.float32))
     report["vae"] = [k for k in tree_keys(pipe.vae_tree) if k not in seen]
     return report
+
+
+# ------------------------------------------------------------- LoRA merge
+
+
+def merge_sd_lora(unet_tree: dict, text_tree: dict, lora_path: str,
+                  scale: float = 1.0) -> int:
+    """Merge a diffusers/PEFT-format LoRA file into the loaded UNet/
+    text-encoder trees IN PLACE (ref: backend/python/diffusers/
+    backend.py:245-252 pipe.load_lora_weights / set_adapters — the
+    reference applies image LoRAs at load; here the low-rank deltas are
+    folded into the weights once, so sampling pays zero extra compute).
+
+    Accepts the two common single-file layouts:
+    - peft/diffusers: ``unet.<path>.lora_A.weight`` / ``lora_B.weight``
+      (also ``lora.down``/``lora.up``), prefix ``text_encoder.`` for the
+      CLIP tower;
+    - kohya: ``lora_unet_<path with _>.lora_down.weight`` + per-pair
+      ``.alpha`` tensors.
+
+    Returns the number of target weights patched. delta = B @ A scaled
+    by (alpha / rank) * scale, transposed/reshaped to this module's
+    storage layout ([in, out] linears; HWIO 1x1 convs).
+    """
+    from safetensors import safe_open
+
+    tensors: dict[str, np.ndarray] = {}
+    with safe_open(lora_path, framework="np") as f:
+        for key in f.keys():
+            tensors[key] = np.asarray(f.get_tensor(key), np.float32)
+
+    pairs: dict[str, dict[str, np.ndarray]] = {}
+    for key, arr in tensors.items():
+        base = None
+        for down_tag, up_tag in ((".lora_A.weight", ".lora_B.weight"),
+                                 (".lora.down.weight", ".lora.up.weight"),
+                                 (".lora_down.weight", ".lora_up.weight")):
+            if key.endswith(down_tag):
+                base, slot = key[: -len(down_tag)], "down"
+                break
+            if key.endswith(up_tag):
+                base, slot = key[: -len(up_tag)], "up"
+                break
+        else:
+            if key.endswith(".alpha"):
+                base, slot = key[: -len(".alpha")], "alpha"
+            else:
+                continue
+        pairs.setdefault(base, {})[slot] = arr
+
+    def resolve(base: str):
+        """LoRA key base -> (tree, dotted path) or None."""
+        if base.startswith("unet."):
+            return unet_tree, base[len("unet."):]
+        if base.startswith("text_encoder."):
+            return text_tree, base[len("text_encoder."):]
+        if base.startswith("lora_unet_"):
+            return unet_tree, _kohya_path(unet_tree,
+                                          base[len("lora_unet_"):])
+        if base.startswith("lora_te_"):
+            return text_tree, _kohya_path(text_tree,
+                                          base[len("lora_te_"):])
+        return None
+
+    patched = 0
+    for base, pair in pairs.items():
+        if "down" not in pair or "up" not in pair:
+            continue
+        tgt = resolve(base)
+        if tgt is None:
+            continue
+        tree, path = tgt
+        if path is None:
+            continue
+        node = tree
+        ok = True
+        for part in path.split("."):
+            if not isinstance(node, dict) or part not in node:
+                ok = False
+                break
+            node = node[part]
+        if not ok or not isinstance(node, dict) or "weight" not in node:
+            continue
+        down, up = pair["down"], pair["up"]
+        r = down.shape[0]
+        alpha = float(pair.get("alpha", np.float32(r)))
+        delta = (up.reshape(up.shape[0], -1)
+                 @ down.reshape(down.shape[0], -1)) \
+            * (alpha / max(r, 1)) * scale  # [out, in]
+        w = node["weight"]
+        if w.ndim == 2:  # stored [in, out]
+            node["weight"] = w + jnp.asarray(delta.T, w.dtype)
+        elif w.ndim == 4 and w.shape[0] == w.shape[1] == 1:  # 1x1 HWIO
+            node["weight"] = w + jnp.asarray(
+                delta.T[None, None], w.dtype)
+        else:
+            continue
+        patched += 1
+    return patched
+
+
+def _kohya_path(tree: dict, flat: str):
+    """Greedy-resolve a kohya underscore-flattened module path against
+    the actual tree (segment names can themselves contain digits)."""
+    parts = flat.split("_")
+    node, out = tree, []
+    i = 0
+    while i < len(parts):
+        # longest-match a tree key from the remaining parts
+        for j in range(len(parts), i, -1):
+            cand = "_".join(parts[i:j])
+            if isinstance(node, dict) and cand in node:
+                node = node[cand]
+                out.append(cand)
+                i = j
+                break
+        else:
+            return None
+    return ".".join(out)

@@ -1,0 +1,549 @@
+"""XTTS-class (coqui) voice-cloning TTS in pure JAX.
+
+Capability counterpart of the reference's coqui backend
+(ref: backend/python/coqui/backend.py — TTS.api over XTTS v2
+checkpoints; VERDICT r3 missing #4). The XTTS v2 architecture:
+
+  text tokens ─┐
+               ├─> GPT-2 acoustic model ──> latents ──> HiFiGAN ──> wav
+  speaker ─────┘        (autoregressive         (speaker-conditioned
+  conditioning           audio codes)            waveform decoder)
+  (perceiver over
+   reference mel)
+
+Pieces implemented here:
+- **GPT core** (``gpt.gpt.h.*``): standard GPT-2 blocks in the HF
+  layout (fused c_attn Conv1D convention — weights stored [in, out],
+  no transpose on import), separate text/audio embeddings + learned
+  positional embeddings, ``mel_head`` audio-logits head. Decoding is a
+  KV-cached ``lax.scan`` — one jit, no per-token host round trips.
+- **Conditioning encoder + perceiver resampler**
+  (``gpt.conditioning_encoder`` / ``gpt.conditioning_perceiver``):
+  reference-audio mel -> conv stack -> cross-attention onto 32 learned
+  latents = the ``gpt_cond_latent`` prefix.
+- **HiFiGAN decoder** (``hifigan_decoder.waveform_decoder``): conv_pre
+  -> [ConvTranspose upsample + resblock bank] -> conv_post/tanh, with
+  the speaker d-vector projected in at the input and (XTTS's
+  ``cond_in_each_up_layer``) after every upsample stage.
+- **Speaker voices file**: XTTS deployments ship precomputed
+  ``speakers_xtts.pth`` ({name: {gpt_cond_latent, speaker_embedding}});
+  ``load_voices`` reads it and ``synthesize`` consumes either a named
+  voice or latents computed from reference audio.
+
+TPU-first notes: the GPT decode loop is a single ``lax.scan`` over a
+preallocated KV cache (static shapes; greedy/temperature sampling
+on-device); convolutions run channels-last via
+``lax.conv_general_dilated`` so XLA tiles them on the MXU.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+Params = dict[str, Any]
+
+
+@dataclass(frozen=True, eq=False)
+class XttsSpec:
+    gpt_layers: int = 30
+    gpt_dim: int = 1024
+    gpt_heads: int = 16
+    n_text_tokens: int = 6681
+    n_audio_tokens: int = 1026
+    start_audio_token: int = 1024
+    stop_audio_token: int = 1025
+    start_text_token: int = 261
+    stop_text_token: int = 0
+    max_audio_tokens: int = 605
+    max_text_tokens: int = 402
+    # conditioning
+    cond_latents: int = 32
+    cond_mels: int = 80
+    cond_heads: int = 2
+    # decoder
+    decoder_input_dim: int = 1024
+    d_vector_dim: int = 512
+    up_rates: tuple = (8, 8, 2, 2)
+    up_kernels: tuple = (16, 16, 4, 4)
+    up_initial: int = 512
+    resblock_kernels: tuple = (3, 7, 11)
+    resblock_dilations: tuple = ((1, 3, 5), (1, 3, 5), (1, 3, 5))
+    sample_rate: int = 24000
+
+    @property
+    def d_head(self) -> int:
+        return self.gpt_dim // self.gpt_heads
+
+
+def spec_from_config(cfg: dict) -> XttsSpec:
+    a = cfg.get("model_args") or {}
+    audio = cfg.get("audio") or {}
+    return XttsSpec(
+        gpt_layers=int(a.get("gpt_layers") or 30),
+        gpt_dim=int(a.get("gpt_n_model_channels") or 1024),
+        gpt_heads=int(a.get("gpt_n_heads") or 16),
+        n_text_tokens=int(a.get("gpt_number_text_tokens") or 6681),
+        n_audio_tokens=int(a.get("gpt_num_audio_tokens") or 1026),
+        start_audio_token=int(a.get("gpt_start_audio_token") or 1024),
+        stop_audio_token=int(a.get("gpt_stop_audio_token") or 1025),
+        start_text_token=int(a.get("gpt_start_text_token") or 261),
+        stop_text_token=int(a.get("gpt_stop_text_token") or 0),
+        max_audio_tokens=int(a.get("gpt_max_audio_tokens") or 605),
+        max_text_tokens=int(a.get("gpt_max_text_tokens") or 402),
+        cond_mels=int(a.get("gpt_num_audio_channels") or 80),
+        decoder_input_dim=int(a.get("decoder_input_dim") or 1024),
+        d_vector_dim=int(a.get("d_vector_dim") or 512),
+        sample_rate=int(audio.get("output_sample_rate") or 24000),
+        # official checkpoints fix the HiFiGAN geometry in code; accept
+        # overrides (tiny test fixtures, custom decoders) from the config
+        up_rates=tuple(a.get("hifigan_up_rates") or (8, 8, 2, 2)),
+        up_kernels=tuple(a.get("hifigan_up_kernels") or (16, 16, 4, 4)),
+        up_initial=int(a.get("hifigan_up_initial") or 512),
+        resblock_kernels=tuple(
+            a.get("hifigan_resblock_kernels") or (3, 7, 11)),
+        resblock_dilations=tuple(
+            tuple(d) for d in (a.get("hifigan_resblock_dilations")
+                               or ((1, 3, 5),) * 3)),
+        cond_heads=int(a.get("perceiver_heads") or 2),
+        cond_latents=int(a.get("perceiver_latents") or 32),
+    )
+
+
+def is_xtts_dir(model_dir: str) -> bool:
+    cfg = os.path.join(model_dir, "config.json")
+    if not os.path.isfile(cfg):
+        return False
+    try:
+        with open(cfg) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return False
+    args = data.get("model_args") or {}
+    return "gpt_number_text_tokens" in args or (
+        data.get("model") == "xtts")
+
+
+# ------------------------------------------------------------- GPT core
+
+
+def _gpt_block(spec: XttsSpec, lp: Params, x: jax.Array,
+               k_cache, v_cache, pos, mask):
+    """One HF-GPT2 block at positions [pos, pos+T); returns
+    (x, new_k_rows, new_v_rows). Weights keep the HF Conv1D layout
+    ([in, out] — applied as plain matmul)."""
+    B, T, D = x.shape
+    H, Dh = spec.gpt_heads, spec.d_head
+    h = _ln(x, lp["ln1_w"], lp["ln1_b"])
+    qkv = h @ lp["attn_w"] + lp["attn_b"]  # [B, T, 3D]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, Dh)
+    k = k.reshape(B, T, H, Dh)
+    v = v.reshape(B, T, H, Dh)
+    # write new rows into the cache view handed in by the caller
+    kc = lax.dynamic_update_slice_in_dim(k_cache, k, pos, axis=1)
+    vc = lax.dynamic_update_slice_in_dim(v_cache, v, pos, axis=1)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kc,
+                        preferred_element_type=jnp.float32)
+    logits = logits / math.sqrt(Dh)
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vc)
+    attn = attn.reshape(B, T, D)
+    x = x + (attn @ lp["proj_w"] + lp["proj_b"])
+    h = _ln(x, lp["ln2_w"], lp["ln2_b"])
+    h = jax.nn.gelu(h @ lp["fc_w"] + lp["fc_b"], approximate=True)
+    x = x + (h @ lp["fc2_w"] + lp["fc2_b"])
+    return x, kc, vc
+
+
+def _ln(x, w, b, eps: float = 1e-5):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    return ((xf - mu) * lax.rsqrt(var + eps) * w + b).astype(x.dtype)
+
+
+def gpt_forward(spec: XttsSpec, p: Params, emb: jax.Array,
+                caches, pos: jax.Array):
+    """Run the GPT stack on pre-built input embeddings [B, T, D] placed
+    at absolute positions [pos, pos+T) of the caches. Returns (hidden
+    after ln_f, new caches). Causal within the new span; full attention
+    to all cached positions < pos + row index."""
+    B, T, D = emb.shape
+    S = caches[0][0].shape[1]
+    qpos = pos + jnp.arange(T)[:, None]  # [T, 1]
+    kpos = jnp.arange(S)[None, :]  # [1, S]
+    mask = (kpos <= qpos)[None, None]  # [1, 1, T, S]
+    x = emb
+    new_caches = []
+    for i, lp in enumerate(p["blocks"]):
+        x, kc, vc = _gpt_block(spec, lp, x, caches[i][0], caches[i][1],
+                               pos, mask)
+        new_caches.append((kc, vc))
+    return _ln(x, p["ln_f_w"], p["ln_f_b"]), new_caches
+
+
+def _empty_caches(spec: XttsSpec, B: int, S: int, dtype):
+    return [(jnp.zeros((B, S, spec.gpt_heads, spec.d_head), dtype),
+             jnp.zeros((B, S, spec.gpt_heads, spec.d_head), dtype))
+            for _ in range(spec.gpt_layers)]
+
+
+def gpt_generate(spec: XttsSpec, p: Params, text_ids: np.ndarray,
+                 cond_latents: jax.Array, max_new: int = 0,
+                 temperature: float = 0.0,
+                 seed: int = 0) -> tuple[np.ndarray, jax.Array]:
+    """Autoregressive audio-code generation. Prefix = [cond_latents;
+    text embeddings; start_audio]; decode runs as ONE ``lax.scan`` over
+    a preallocated KV cache. Returns (audio codes [T] np, GPT latents
+    [T, D] — the decoder input XTTS uses, i.e. the hidden state at each
+    audio position)."""
+    max_new = max_new or spec.max_audio_tokens
+    ids = [spec.start_text_token] + list(text_ids) + [spec.stop_text_token]
+    t_emb = p["text_emb"][jnp.asarray(ids)] \
+        + p["text_pos"][: len(ids)]
+    cond = cond_latents.astype(t_emb.dtype)  # [C, D]
+    start = p["audio_emb"][spec.start_audio_token] + p["audio_pos"][0]
+    prefix = jnp.concatenate([cond, t_emb, start[None]], axis=0)[None]
+    P = prefix.shape[1]
+    S = P + max_new + 1
+    caches = _empty_caches(spec, 1, S, prefix.dtype)
+    hidden, caches = gpt_forward(spec, p, prefix, caches,
+                                 jnp.asarray(0))
+    logits0 = hidden[:, -1] @ p["mel_head_w"] + p["mel_head_b"]
+
+    def sample(logits, key):
+        lg = logits.astype(jnp.float32)
+        # never sample start; stop handled by the caller's trim
+        lg = lg.at[:, spec.start_audio_token].set(-1e30)
+        if temperature > 0:
+            return jax.random.categorical(key, lg / temperature, axis=-1)
+        return jnp.argmax(lg, axis=-1)
+
+    key = jax.random.PRNGKey(seed)
+
+    def step(carry, i):
+        caches, logits, key, pos = carry
+        key, sub = jax.random.split(key)
+        tok = sample(logits, sub)  # [1]
+        apos = pos - P + 1  # audio-position index of the NEW token
+        emb = p["audio_emb"][tok] + p["audio_pos"][apos]
+        hidden, caches = gpt_forward(spec, p, emb[:, None], caches, pos)
+        logits = hidden[:, -1] @ p["mel_head_w"] + p["mel_head_b"]
+        return (caches, logits, key, pos + 1), (tok[0], hidden[0, -1])
+
+    (caches, _, _, _), (toks, lat) = lax.scan(
+        step, (caches, logits0, key, jnp.asarray(P)),
+        jnp.arange(max_new))
+    toks = np.asarray(toks)
+    stop = np.nonzero(toks == spec.stop_audio_token)[0]
+    n = int(stop[0]) if len(stop) else max_new
+    return toks[:n], lat[:n]
+
+
+# --------------------------------------- conditioning encoder + perceiver
+
+
+def conditioning_latents(spec: XttsSpec, p: Params,
+                         mel: jax.Array) -> jax.Array:
+    """Reference-audio mel [n_mels, T] -> gpt_cond_latent [C, D]:
+    a conv downsampling stack then a perceiver resampler (learned
+    latents cross-attending the conv features)."""
+    cp = p["cond"]
+    x = mel[None]  # [1, M, T]
+    for w, b, stride in cp["convs"]:
+        x = lax.conv_general_dilated(
+            x, w, (stride,), [(w.shape[-1] // 2,) * 2],
+            dimension_numbers=("NCH", "OIH", "NCH"))
+        x = x + b[None, :, None]
+        x = jax.nn.relu(x)
+    feats = x[0].T  # [T', D]
+    lat = cp["latents"]  # [C, D]
+    H = spec.cond_heads
+    Dh = spec.gpt_dim // H
+
+    q = (lat @ cp["wq"]).reshape(-1, H, Dh)
+    k = (feats @ cp["wk"]).reshape(-1, H, Dh)
+    v = (feats @ cp["wv"]).reshape(-1, H, Dh)
+    logits = jnp.einsum("qhd,khd->hqk", q, k) / math.sqrt(Dh)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("hqk,khd->qhd", probs, v).reshape(lat.shape[0], -1)
+    return lat + out @ cp["wo"]
+
+
+def mel_spectrogram(wav: np.ndarray, n_mels: int = 80,
+                    sr: int = 22050) -> np.ndarray:
+    """Log-mel of a reference wav (numpy host-side; conditioning is a
+    one-off per voice). 1024-point STFT, hop 256, HTK-ish mel filters."""
+    n_fft, hop = 1024, 256
+    pad = n_fft // 2
+    wav = np.pad(wav.astype(np.float32), (pad, pad), mode="reflect")
+    frames = 1 + (len(wav) - n_fft) // hop
+    idx = np.arange(n_fft)[None, :] + hop * np.arange(frames)[:, None]
+    win = np.hanning(n_fft).astype(np.float32)
+    spec = np.abs(np.fft.rfft(wav[idx] * win, axis=-1)) ** 2  # [F, K]
+    # mel filterbank
+    def hz2mel(f):
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+
+    def mel2hz(m):
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+
+    mels = np.linspace(hz2mel(0.0), hz2mel(sr / 2), n_mels + 2)
+    hz = mel2hz(mels)
+    bins = np.floor((n_fft + 1) * hz / sr).astype(int)
+    fb = np.zeros((n_mels, n_fft // 2 + 1), np.float32)
+    for m in range(1, n_mels + 1):
+        lo, c, hi = bins[m - 1], bins[m], bins[m + 1]
+        if c > lo:
+            fb[m - 1, lo:c] = (np.arange(lo, c) - lo) / (c - lo)
+        if hi > c:
+            fb[m - 1, c:hi] = (hi - np.arange(c, hi)) / (hi - c)
+    mel = spec @ fb.T  # [K, M]
+    return np.log(np.clip(mel, 1e-5, None)).T.astype(np.float32)
+
+
+# -------------------------------------------------------------- decoder
+
+
+def _conv1d(x, w, b=None, stride=1, pad=0, dilation=1):
+    out = lax.conv_general_dilated(
+        x, w, (stride,), [(pad, pad)], rhs_dilation=(dilation,),
+        dimension_numbers=("NCH", "OIH", "NCH"))
+    if b is not None:
+        out = out + b[None, :, None]
+    return out
+
+
+def _convtr1d(x, w, b, stride, pad):
+    """torch ConvTranspose1d semantics (w [I, O, K]) — the same
+    flip+lhs-dilation formulation models/vits.py pins against torch."""
+    k = w.shape[-1]
+    w_conv = jnp.flip(w, -1).transpose(1, 0, 2)  # -> [O, I, K]
+    out = lax.conv_general_dilated(
+        x, w_conv, (1,), [(k - 1 - pad, k - 1 - pad)],
+        lhs_dilation=(stride,),
+        dimension_numbers=("NCH", "OIH", "NCH"))
+    if b is not None:
+        out = out + b[None, :, None]
+    return out
+
+
+_SLOPE = 0.1
+
+
+def hifigan_decode(spec: XttsSpec, p: Params, latents: jax.Array,
+                   d_vector: jax.Array) -> jax.Array:
+    """GPT latents [T, decoder_input_dim] + speaker d-vector [dv] ->
+    waveform [T * prod(up_rates)] (coqui HifiganGenerator semantics:
+    global cond added after conv_pre, and — XTTS's cond_in_each_up_layer
+    — a per-stage cond projection added after every upsample)."""
+    dp = p["decoder"]
+    x = latents.T[None]  # [1, C_in, T]
+    x = _conv1d(x, dp["conv_pre_w"], dp["conv_pre_b"], pad=3)
+    g = d_vector[None, :, None]  # [1, dv, 1]
+    x = x + _conv1d(g, dp["cond_w"], dp["cond_b"])
+    for i, (up_w, up_b, cond_i, blocks) in enumerate(dp["ups"]):
+        x = jax.nn.leaky_relu(x, _SLOPE)
+        stride = spec.up_rates[i]
+        kern = spec.up_kernels[i]
+        x = _convtr1d(x, up_w, up_b, stride, (kern - stride) // 2)
+        if cond_i is not None:
+            x = x + _conv1d(g, cond_i[0], cond_i[1])
+        acc = None
+        for convs1, convs2 in blocks:  # resblock bank, averaged
+            h = x
+            for (w1, b1, d1), (w2, b2, d2) in zip(convs1, convs2):
+                y = jax.nn.leaky_relu(h, _SLOPE)
+                y = _conv1d(y, w1, b1, pad=d1 * (w1.shape[-1] // 2),
+                            dilation=d1)
+                y = jax.nn.leaky_relu(y, _SLOPE)
+                y = _conv1d(y, w2, b2, pad=d2 * (w2.shape[-1] // 2),
+                            dilation=d2)
+                h = h + y
+            acc = h if acc is None else acc + h
+        x = acc / len(blocks)
+    x = jax.nn.leaky_relu(x, _SLOPE)
+    x = _conv1d(x, dp["conv_post_w"], dp["conv_post_b"], pad=3)
+    return jnp.tanh(x)[0, 0]
+
+
+# ------------------------------------------------------------ synthesis
+
+
+def synthesize(spec: XttsSpec, p: Params, text_ids: np.ndarray,
+               gpt_cond_latent: jax.Array, speaker_embedding: jax.Array,
+               temperature: float = 0.0, seed: int = 0,
+               max_new: int = 0) -> np.ndarray:
+    """text ids + voice latents -> waveform (float32 [-1, 1])."""
+    _, latents = gpt_generate(spec, p, text_ids, gpt_cond_latent,
+                              max_new=max_new, temperature=temperature,
+                              seed=seed)
+    if latents.shape[0] == 0:
+        return np.zeros(0, np.float32)
+    wav = hifigan_decode(spec, p, latents,
+                         speaker_embedding.reshape(-1))
+    return np.asarray(wav, np.float32)
+
+
+# -------------------------------------------------------------- loading
+
+
+def _torch_load(path: str):
+    import torch
+
+    return torch.load(path, map_location="cpu", weights_only=False)
+
+
+def load_voices(model_dir: str) -> dict[str, tuple]:
+    """speakers_xtts.pth: {name: {"gpt_cond_latent": [1, C, D]|[C, D],
+    "speaker_embedding": [1, dv, 1]|[dv]}} -> jnp pairs."""
+    out = {}
+    for fn in ("speakers_xtts.pth", "speakers.pth"):
+        path = os.path.join(model_dir, fn)
+        if not os.path.isfile(path):
+            continue
+        data = _torch_load(path)
+        for name, d in data.items():
+            try:
+                lat = np.asarray(d["gpt_cond_latent"].float())
+                emb = np.asarray(d["speaker_embedding"].float())
+            except Exception:
+                continue
+            out[name] = (jnp.asarray(lat.reshape(lat.shape[-2],
+                                                 lat.shape[-1])),
+                         jnp.asarray(emb.reshape(-1)))
+        break
+    return out
+
+
+def load_xtts(model_dir: str, dtype=jnp.float32):
+    """Import an XTTS checkpoint dir (config.json + model.pth [+
+    vocab.json + speakers_xtts.pth]) -> (spec, params, tokenizer,
+    voices)."""
+    with open(os.path.join(model_dir, "config.json")) as f:
+        cfg = json.load(f)
+    spec = spec_from_config(cfg)
+    sd = _torch_load(os.path.join(model_dir, "model.pth"))
+    if isinstance(sd, dict) and "model" in sd:
+        sd = sd["model"]
+
+    def t(name):
+        return np.asarray(sd[name].float())
+
+    def j(name):
+        return jnp.asarray(t(name), dtype)
+
+    p: Params = {
+        "text_emb": j("gpt.text_embedding.weight"),
+        "text_pos": j("gpt.text_pos_embedding.emb.weight"),
+        "audio_emb": j("gpt.mel_embedding.weight"),
+        "audio_pos": j("gpt.mel_pos_embedding.emb.weight"),
+        "ln_f_w": j("gpt.gpt.ln_f.weight"),
+        "ln_f_b": j("gpt.gpt.ln_f.bias"),
+        "mel_head_w": jnp.asarray(t("gpt.mel_head.weight").T, dtype),
+        "mel_head_b": j("gpt.mel_head.bias"),
+    }
+    blocks = []
+    for i in range(spec.gpt_layers):
+        pre = f"gpt.gpt.h.{i}."
+        blocks.append({
+            "ln1_w": j(pre + "ln_1.weight"),
+            "ln1_b": j(pre + "ln_1.bias"),
+            # HF GPT2 Conv1D stores [in, out] — used as-is
+            "attn_w": j(pre + "attn.c_attn.weight"),
+            "attn_b": j(pre + "attn.c_attn.bias"),
+            "proj_w": j(pre + "attn.c_proj.weight"),
+            "proj_b": j(pre + "attn.c_proj.bias"),
+            "ln2_w": j(pre + "ln_2.weight"),
+            "ln2_b": j(pre + "ln_2.bias"),
+            "fc_w": j(pre + "mlp.c_fc.weight"),
+            "fc_b": j(pre + "mlp.c_fc.bias"),
+            "fc2_w": j(pre + "mlp.c_proj.weight"),
+            "fc2_b": j(pre + "mlp.c_proj.bias"),
+        })
+    p["blocks"] = blocks
+
+    # conditioning encoder: conv stack + perceiver
+    convs = []
+    i = 0
+    while f"gpt.conditioning_encoder.convs.{i}.weight" in sd:
+        convs.append((
+            j(f"gpt.conditioning_encoder.convs.{i}.weight"),
+            j(f"gpt.conditioning_encoder.convs.{i}.bias"),
+            2 if i > 0 else 1,
+        ))
+        i += 1
+    p["cond"] = {
+        "convs": convs,
+        "latents": j("gpt.conditioning_perceiver.latents"),
+        "wq": j("gpt.conditioning_perceiver.wq"),
+        "wk": j("gpt.conditioning_perceiver.wk"),
+        "wv": j("gpt.conditioning_perceiver.wv"),
+        "wo": j("gpt.conditioning_perceiver.wo"),
+    } if "gpt.conditioning_perceiver.latents" in sd else None
+
+    # hifigan decoder (weight-norm folded: weight_g/weight_v pairs)
+    def wn(prefix):
+        if prefix + ".weight" in sd:
+            return t(prefix + ".weight")
+        g = t(prefix + ".weight_g")
+        v = t(prefix + ".weight_v")
+        norm = np.linalg.norm(v.reshape(v.shape[0], -1), axis=1)
+        return v * (g.reshape(-1) / np.maximum(norm, 1e-12)
+                    ).reshape(-1, *([1] * (v.ndim - 1)))
+
+    wd = "hifigan_decoder.waveform_decoder."
+    dp: Params = {
+        "conv_pre_w": jnp.asarray(wn(wd + "conv_pre"), dtype),
+        "conv_pre_b": j(wd + "conv_pre.bias"),
+        "conv_post_w": jnp.asarray(wn(wd + "conv_post"), dtype),
+        "conv_post_b": j(wd + "conv_post.bias"),
+        "cond_w": j(wd + "cond_layer.weight"),
+        "cond_b": j(wd + "cond_layer.bias"),
+    }
+    n_k = len(spec.resblock_kernels)
+    ups = []
+    for u in range(len(spec.up_rates)):
+        up_w = jnp.asarray(wn(wd + f"ups.{u}"), dtype)  # [I, O, K]
+        up_b = j(wd + f"ups.{u}.bias")
+        cond_i = None
+        if wd + f"conds.{u}.weight" in sd:
+            cond_i = (j(wd + f"conds.{u}.weight"),
+                      j(wd + f"conds.{u}.bias"))
+        blocks_u = []
+        for kk in range(n_k):
+            r = u * n_k + kk
+            convs1, convs2 = [], []
+            for d_i, dil in enumerate(spec.resblock_dilations[kk]):
+                convs1.append((jnp.asarray(
+                    wn(wd + f"resblocks.{r}.convs1.{d_i}"), dtype),
+                    j(wd + f"resblocks.{r}.convs1.{d_i}.bias"), dil))
+                convs2.append((jnp.asarray(
+                    wn(wd + f"resblocks.{r}.convs2.{d_i}"), dtype),
+                    j(wd + f"resblocks.{r}.convs2.{d_i}.bias"), 1))
+            blocks_u.append((convs1, convs2))
+        ups.append((up_w, up_b, cond_i, blocks_u))
+    dp["ups"] = ups
+    p["decoder"] = dp
+
+    tok = None
+    vocab = os.path.join(model_dir, "vocab.json")
+    if os.path.isfile(vocab):
+        try:
+            from tokenizers import Tokenizer
+
+            tok = Tokenizer.from_file(vocab)
+        except Exception:
+            tok = None
+    voices = load_voices(model_dir)
+    return spec, p, tok, voices

@@ -102,11 +102,35 @@ class JaxDiffusionBackend(Backend):
                         self._sd = FluxPipeline.load(model_dir)
                         self._state = "READY"
                         return Result(True, "flux pipeline ready")
-                    from ..models.sd import SDPipeline
+                    from ..models.sd import SDPipeline, merge_sd_lora
 
                     self._sd = SDPipeline.load(model_dir)
+                    # image LoRAs fold into the loaded weights (ref:
+                    # diffusers backend.py:245-252 load_lora_weights)
+                    n_patched = 0
+                    for i, la in enumerate(opts.lora_adapters):
+                        if not os.path.isabs(la):
+                            la = os.path.join(opts.model_path or "", la)
+                        lscale = (float(opts.lora_scales[i])
+                                  if i < len(opts.lora_scales) else 1.0)
+                        if lscale == 0.0:
+                            continue
+                        if not os.path.isfile(la):
+                            # a typo'd adapter path must fail the load,
+                            # not quietly produce un-LoRA'd images (the
+                            # reference's load_lora_weights raises too)
+                            self._sd = None
+                            self._state = "ERROR"
+                            return Result(
+                                False, f"lora adapter not found: {la}")
+                        n_patched += merge_sd_lora(
+                            self._sd.unet_tree, self._sd.text_tree,
+                            la, scale=lscale)
                     self._state = "READY"
-                    return Result(True, "sd pipeline ready")
+                    msg = "sd pipeline ready"
+                    if n_patched:
+                        msg += f" ({n_patched} LoRA weights merged)"
+                    return Result(True, msg)
                 if opts.model and opts.model != "__random__":
                     return Result(False, (
                         f"{opts.model!r} is not a diffusers-format "

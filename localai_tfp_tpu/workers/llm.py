@@ -65,6 +65,7 @@ class JaxLLMBackend(Backend):
         # with a vision tower (gemma3), else None
         self.vision: Any = None
         self._quantized = False  # int8 weight-only serving mode
+        self.mamba: Any = None  # (MambaSpec, params) — SSM family
 
     # ------------------------------------------------------------- lifecycle
 
@@ -89,6 +90,17 @@ class JaxLLMBackend(Backend):
             model_dir = opts.model
             if not os.path.isabs(model_dir):
                 model_dir = os.path.join(opts.model_path or "", model_dir)
+            if model_dir.rstrip("/").endswith(".exl2") or os.path.isfile(
+                    os.path.join(model_dir, "job_new.json")):  # exl2 dir
+                self._state = "ERROR"
+                return Result(
+                    False,
+                    "load failed: EXL2 is exllamav2's CUDA-kernel-"
+                    "specific storage and is not served on TPU "
+                    "(PARITY.md won't-fix #3); point parameters.model "
+                    "at the model's GGUF or safetensors release and "
+                    "set quantization: int8 for the equivalent "
+                    "quantized serving mode")
             is_gguf = model_dir.endswith(".gguf")
             if (not os.path.isdir(model_dir) if not is_gguf
                     else not os.path.isfile(model_dir)):
@@ -108,6 +120,10 @@ class JaxLLMBackend(Backend):
                 channel.publish("load", opts)
             try:
                 self._state = "BUSY"
+                # a reload over a previous family must not leave the old
+                # route reachable (predict() dispatches on self.mamba
+                # first — same invariant tts.py keeps for its slots)
+                self.mamba = None
                 dtype = _DTYPES.get((opts.dtype or "bfloat16").lower(),
                                     jnp.bfloat16)
                 if is_gguf:
@@ -127,6 +143,22 @@ class JaxLLMBackend(Backend):
                     from ..models.hf_loader import load_hf_state
 
                     hf_state = load_hf_state(model_dir)
+                    from ..models.mamba import is_mamba_config
+
+                    if is_mamba_config(hf_state[0]):
+                        # SSM family (ref: transformers backend
+                        # MambaForCausalLM, backend.py:24,248): no KV
+                        # cache — recurrent generate path, not the
+                        # slot engine
+                        from ..models.mamba import load_mamba
+
+                        if self.engine is not None:  # reload over an
+                            self.engine.close()  # attention model
+                            self.engine = None
+                        self.mamba = load_mamba(model_dir, dtype=dtype)
+                        self.tokenizer = load_tokenizer(model_dir)
+                        self._state = "READY"
+                        return Result(True, "mamba model loaded")
                     self.spec, params = load_params(
                         model_dir, dtype=dtype, state=hf_state)
                 # merge LoRA adapters at load (ref: llama.cpp LoRA apply
@@ -383,13 +415,57 @@ class JaxLLMBackend(Backend):
         if self.engine is not None:
             self.engine.cancel(request_id)
 
+    def _mamba_reply(self, opts: PredictOptions) -> Reply:
+        import time as _time
+
+        from ..models.mamba import generate
+
+        spec, params = self.mamba
+        ids = self.tokenizer.encode(opts.prompt, add_bos=True)
+        t0 = _time.perf_counter()
+        eos = next(iter(getattr(self.tokenizer, "eos_ids", []) or []),
+                   None)
+        toks = generate(
+            spec, params, ids, opts.tokens or 256,
+            temperature=opts.temperature, seed=opts.seed or 0,
+            eos_id=None if opts.ignore_eos else eos,
+        )
+        out = [int(t) for t in toks]
+        finish = "stop"
+        if eos is not None and out and out[-1] == eos:
+            out = out[:-1]
+        elif len(out) >= (opts.tokens or 256):
+            finish = "length"
+        text = self.tokenizer.decode(out)
+        for stop in opts.stop_prompts or []:
+            i = text.find(stop)
+            if i >= 0:
+                text = text[:i]
+                finish = "stop"
+        return Reply(
+            message=text, tokens=len(out), prompt_tokens=len(ids),
+            finish_reason=finish,
+            timing_token_generation=(_time.perf_counter() - t0) * 1e3,
+        )
+
     def predict(self, opts: PredictOptions) -> Reply:
+        if self.mamba is not None:
+            return self._mamba_reply(opts)
         if self.engine is None:
             return Reply(error="model not loaded")
         ev = self.engine.generate(self._to_request(opts))
         return _final_reply(ev)
 
     def predict_stream(self, opts: PredictOptions) -> Iterator[Reply]:
+        if self.mamba is not None:
+            # the recurrent generate is one device dispatch; stream the
+            # text then the final (the reference's HF path has the same
+            # whole-reply granularity for SSM models)
+            r = self._mamba_reply(opts)
+            if r.message and not r.error:
+                yield Reply(message=r.message)
+            yield r
+            return
         if self.engine is None:
             yield Reply(error="model not loaded")
             return

@@ -83,15 +83,10 @@ class SubprocessBackend(RemoteOpenAIBackend):
                 except OSError:
                     pass
 
-        port = _free_port()
-        argv = opts.extra.get("_argv")  # test hook: a fake/wedged child
-        if not argv:
-            argv = [
-                sys.executable, "-m", "localai_tfp_tpu.cli", "run",
-                "--models-path", child_models,
-                "--address", "127.0.0.1", "--port", str(port),
-                "--disable-metrics",
-            ]
+        # NOTE: the probe socket closes before the child binds, so the
+        # port can be stolen in the gap; the wait loop below treats a
+        # fast address-in-use exit as retryable (fresh port) rather
+        # than a load failure
         env = dict(os.environ)
         # the child must import this package; PREPEND its root to any
         # existing PYTHONPATH (never clobber: TPU plugin site dirs ride
@@ -101,43 +96,63 @@ class SubprocessBackend(RemoteOpenAIBackend):
         env["PYTHONPATH"] = os.pathsep.join(
             p for p in [pkg_root, env.get("PYTHONPATH", "")] if p)
         log_path = os.path.join(self._child_dir, "child.log")
-        with open(log_path, "ab") as logf:
-            self.proc = subprocess.Popen(
-                argv, cwd=self._child_dir, env=env,
-                stdout=logf, stderr=logf,
-                start_new_session=True,  # killpg reaches grandchildren
-            )
-        self.base_url = f"http://127.0.0.1:{port}"
-        self.model = name
+        custom_argv = opts.extra.get("_argv")  # test hook
+        for attempt in range(2):
+            port = _free_port()
+            argv = custom_argv or [
+                sys.executable, "-m", "localai_tfp_tpu.cli", "run",
+                "--models-path", child_models,
+                "--address", "127.0.0.1", "--port", str(port),
+                "--disable-metrics",
+            ]
+            with open(log_path, "ab") as logf:
+                self.proc = subprocess.Popen(
+                    argv, cwd=self._child_dir, env=env,
+                    stdout=logf, stderr=logf,
+                    start_new_session=True,  # killpg reaches grandkids
+                )
+            self.base_url = f"http://127.0.0.1:{port}"
+            self.model = name
 
-        deadline = time.monotonic() + timeout
-        last_err = "timed out"
-        while time.monotonic() < deadline:
-            if self.proc.poll() is not None:
-                tail = ""
+            deadline = time.monotonic() + timeout
+            last_err = "timed out"
+            while time.monotonic() < deadline:
+                if self.proc.poll() is not None:
+                    tail = ""
+                    try:
+                        with open(log_path, "rb") as f:
+                            tail = f.read()[-800:].decode(
+                                errors="replace")
+                    except OSError:
+                        pass
+                    if attempt == 0 and ("address in use" in tail.lower()
+                                         or "errno 98" in tail.lower()):
+                        # the probed port was stolen before the child
+                        # bound it — retry once with a fresh one
+                        break
+                    return Result(
+                        False,
+                        f"isolated backend exited "
+                        f"rc={self.proc.returncode}: {tail}")
                 try:
-                    with open(log_path, "rb") as f:
-                        tail = f.read()[-800:].decode(errors="replace")
-                except OSError:
-                    pass
+                    with urllib.request.urlopen(
+                            self.base_url + "/readyz", timeout=2) as r:
+                        if r.status == 200:
+                            self._state = "READY"
+                            return Result(
+                                True,
+                                f"isolated backend pid={self.proc.pid}")
+                except (urllib.error.URLError, OSError) as e:
+                    last_err = str(e)
+                time.sleep(0.25)
+            else:
+                # wedged load: reclaim the process (the point of
+                # isolation)
+                self.shutdown()
                 return Result(
-                    False,
-                    f"isolated backend exited rc={self.proc.returncode}: "
-                    f"{tail}")
-            try:
-                with urllib.request.urlopen(
-                        self.base_url + "/readyz", timeout=2) as r:
-                    if r.status == 200:
-                        self._state = "READY"
-                        return Result(
-                            True, f"isolated backend pid={self.proc.pid}")
-            except (urllib.error.URLError, OSError) as e:
-                last_err = str(e)
-            time.sleep(0.25)
-        # wedged load: reclaim the process (the whole point of isolation)
-        self.shutdown()
-        return Result(False, f"isolated backend wedged (> {timeout:.0f}s "
-                             f"without /readyz; last: {last_err}); killed")
+                    False, f"isolated backend wedged (> {timeout:.0f}s "
+                           f"without /readyz; last: {last_err}); killed")
+        return Result(False, "isolated backend could not bind a port")
 
     def health(self) -> bool:
         return (self._state == "READY" and self.proc is not None

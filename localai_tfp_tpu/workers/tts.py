@@ -147,11 +147,13 @@ class JaxTTSBackend(Backend):
         self._musicgen = None  # (bundle, tokenizer-or-None)
         self._bark = None  # models/bark.py BarkTTS
         self._kokoro = None  # (spec, params, voices)
+        self._xtts = None  # (spec, params, tokenizer, voices)
 
     def load_model(self, opts: ModelLoadOptions) -> Result:
         # a reload must not leave a previous family reachable (tts()
         # dispatches on whichever slot is non-None)
         self._vits = self._musicgen = self._bark = self._kokoro = None
+        self._xtts = None
         self._bark_opts = {}
         model_dir = opts.model
         if model_dir and not os.path.isabs(model_dir):
@@ -174,6 +176,17 @@ class JaxTTSBackend(Backend):
                     self._kokoro = load_kokoro(model_dir)
                     self._state = "READY"
                     return Result(True, "kokoro ready")
+                from ..models.xtts import is_xtts_dir
+
+                if is_xtts_dir(model_dir):
+                    # coqui XTTS v2 family (ref: backend/python/coqui/
+                    # backend.py — TTS.api over xtts checkpoints)
+                    from ..models.xtts import load_xtts
+
+                    mtype = "xtts"
+                    self._xtts = load_xtts(model_dir)
+                    self._state = "READY"
+                    return Result(True, "xtts ready")
                 with open(cfg_path) as f:
                     mtype = (json.load(f).get("model_type") or "").lower()
                 if mtype == "vits":
@@ -224,6 +237,29 @@ class JaxTTSBackend(Backend):
 
     def tts(self, text: str, voice: str = "", dst: str = "",
             language: str = "") -> Result:
+        if getattr(self, "_xtts", None) is not None:
+            from ..models.xtts import synthesize
+
+            xspec, xparams, xtok, voices = self._xtts
+            if not voices:
+                return Result(
+                    False, "xtts model has no speakers file "
+                           "(speakers_xtts.pth) — voice cloning from "
+                           "reference audio needs precomputed latents")
+            if voice and voice not in voices:
+                return Result(
+                    False, f"unknown xtts voice {voice!r}; available: "
+                           f"{sorted(voices)}")
+            lat, emb = voices[voice or next(iter(voices))]
+            if xtok is not None:
+                lang = language or "en"
+                ids = xtok.encode(f"[{lang}]{text}").ids
+            else:
+                ids = [b % max(xspec.n_text_tokens - 2, 1) + 1
+                       for b in text.encode()]
+            audio = synthesize(xspec, xparams, np.asarray(ids), lat, emb)
+            write_wav(dst, audio, sr=xspec.sample_rate)
+            return Result(True, dst)
         if self._kokoro is not None:
             from ..models.kokoro import (pick_voice, synthesize_kokoro,
                                          text_to_tokens)

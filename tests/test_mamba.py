@@ -1,0 +1,97 @@
+"""Mamba SSM family: logits + greedy-generation parity vs HF
+MambaForCausalLM (torch cpu ground truth), recurrent-step equivalence,
+and worker integration (VERDICT r3 missing #6; ref:
+backend/python/transformers/backend.py:24,248)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax.numpy as jnp  # noqa: E402
+
+from localai_tfp_tpu.models.mamba import (  # noqa: E402
+    MambaSpec,
+    forward,
+    generate,
+    init_state,
+    load_mamba,
+    step,
+)
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    from transformers import MambaConfig, MambaForCausalLM
+
+    torch.manual_seed(0)
+    cfg = MambaConfig(
+        vocab_size=120, hidden_size=32, state_size=8, num_hidden_layers=2,
+        conv_kernel=4, expand=2, time_step_rank=4,
+        use_cache=False,
+    )
+    model = MambaForCausalLM(cfg)
+    d = tmp_path_factory.mktemp("mamba") / "ckpt"
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model
+
+
+def test_logits_match_hf(ckpt):
+    d, hf = ckpt
+    spec, p = load_mamba(d)
+    assert spec.d_inner == 64 and spec.d_state == 8
+    ids = np.asarray([3, 17, 55, 9, 101, 2, 44], np.int64)
+    hf.eval()
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(ids[None])).logits[0].numpy()
+    got = np.asarray(forward(spec, p, jnp.asarray(ids, jnp.int32)))
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_recurrent_step_matches_full_forward(ckpt):
+    """The serving recurrence (conv_state + ssm_state) must reproduce
+    the position-parallel forward exactly."""
+    d, _ = ckpt
+    spec, p = load_mamba(d)
+    ids = [5, 9, 77, 3, 110, 21]
+    full = np.asarray(forward(spec, p, jnp.asarray(ids, jnp.int32)))
+    state = init_state(spec)
+    outs = []
+    for t in ids:
+        lg, state = step(spec, p, jnp.asarray(t, jnp.int32), state)
+        outs.append(np.asarray(lg))
+    np.testing.assert_allclose(np.stack(outs), full, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_greedy_generation_matches_hf(ckpt):
+    d, hf = ckpt
+    spec, p = load_mamba(d)
+    prompt = [7, 42, 99]
+    hf.eval()
+    with torch.no_grad():
+        ref = hf.generate(
+            torch.tensor([prompt]), max_new_tokens=8, do_sample=False,
+        )[0, len(prompt):].numpy()
+    got = generate(spec, p, prompt, 8)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_worker_serves_mamba(ckpt, tmp_path):
+    """The LLM worker detects mamba configs and serves completions via
+    the recurrent path (no KV engine)."""
+    from localai_tfp_tpu.workers.base import ModelLoadOptions, PredictOptions
+    from localai_tfp_tpu.workers.llm import JaxLLMBackend
+
+    d, _ = ckpt
+    b = JaxLLMBackend()
+    res = b.load_model(ModelLoadOptions(model=d, dtype="float32"))
+    assert res.success, res.message
+    assert b.mamba is not None and b.engine is None
+    r = b.predict(PredictOptions(prompt="hello", tokens=6,
+                                 ignore_eos=True))
+    assert not r.error
+    assert r.tokens == 6 and r.prompt_tokens > 0
+    chunks = list(b.predict_stream(PredictOptions(
+        prompt="hello", tokens=4, ignore_eos=True)))
+    assert chunks[-1].finish_reason in ("length", "stop")

@@ -209,3 +209,70 @@ def test_img2img_strength(xl_pipe):
                          init_image=other, strength=0.15)
     assert float(np.mean((a.astype(np.float32)
                           - low.astype(np.float32)) ** 2)) > 1.0
+
+
+def test_lora_merge_patches_weights_and_pipeline_runs(pipe_dir, tmp_path):
+    """Diffusion LoRA (VERDICT r3 missing #7; ref: diffusers
+    backend.py:245-252): a peft-format lora file folds B@A*(alpha/r)*scale
+    into the targeted UNet/text-encoder weights, and sampling still
+    works on the patched pipeline."""
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    from localai_tfp_tpu.models.sd import SDPipeline, merge_sd_lora
+
+    pipe = SDPipeline.load(pipe_dir)
+    tgt = pipe.unet_tree["down_blocks"]["0"]["attentions"]["0"][
+        "transformer_blocks"]["0"]["attn1"]["to_q"]["weight"]
+    c = tgt.shape[0]
+    rng = np.random.default_rng(0)
+    r = 2
+    down = rng.normal(size=(r, c)).astype(np.float32) * 0.1
+    up = rng.normal(size=(c, r)).astype(np.float32) * 0.1
+    base = ("unet.down_blocks.0.attentions.0.transformer_blocks.0"
+            ".attn1.to_q")
+    lora_path = str(tmp_path / "lora.safetensors")
+    save_file({f"{base}.lora_A.weight": down,
+               f"{base}.lora_B.weight": up}, lora_path)
+
+    before = np.asarray(tgt)
+    n = merge_sd_lora(pipe.unet_tree, pipe.text_tree, lora_path,
+                      scale=0.5)
+    assert n == 1
+    after = np.asarray(
+        pipe.unet_tree["down_blocks"]["0"]["attentions"]["0"][
+            "transformer_blocks"]["0"]["attn1"]["to_q"]["weight"])
+    want = before + ((up @ down) * (r / r) * 0.5).T
+    np.testing.assert_allclose(after, want, rtol=1e-5, atol=1e-6)
+
+    img = pipe.generate("a cat", height=16, width=16, steps=1, seed=1)
+    assert img.shape[2] == 3
+
+
+def test_lora_merge_kohya_naming(pipe_dir, tmp_path):
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    from localai_tfp_tpu.models.sd import SDPipeline, merge_sd_lora
+
+    pipe = SDPipeline.load(pipe_dir)
+    tgt = pipe.unet_tree["down_blocks"]["0"]["attentions"]["0"][
+        "transformer_blocks"]["0"]["attn1"]["to_k"]["weight"]
+    c = tgt.shape[0]
+    rng = np.random.default_rng(1)
+    down = rng.normal(size=(2, c)).astype(np.float32) * 0.1
+    up = rng.normal(size=(c, 2)).astype(np.float32) * 0.1
+    base = ("lora_unet_down_blocks_0_attentions_0_transformer_blocks_0"
+            "_attn1_to_k")
+    lora_path = str(tmp_path / "lora_kohya.safetensors")
+    save_file({f"{base}.lora_down.weight": down,
+               f"{base}.lora_up.weight": up,
+               f"{base}.alpha": np.asarray(4.0, np.float32)}, lora_path)
+    before = np.asarray(tgt)
+    n = merge_sd_lora(pipe.unet_tree, pipe.text_tree, lora_path)
+    assert n == 1
+    after = np.asarray(
+        pipe.unet_tree["down_blocks"]["0"]["attentions"]["0"][
+            "transformer_blocks"]["0"]["attn1"]["to_k"]["weight"])
+    want = before + ((up @ down) * (4.0 / 2)).T
+    np.testing.assert_allclose(after, want, rtol=1e-5, atol=1e-6)

@@ -28,10 +28,10 @@ class WideByteTok:
 
         class _T(ByteTokenizer):
             def decode(self, ids):
-                return bytes(
-                    i % 256 for i in ids
+                return "".join(
+                    chr(32 + (i % 95)) for i in ids
                     if i not in (self.bos_id, *self.eos_ids)
-                ).decode("latin-1")
+                )
 
         return _T()
 
@@ -63,6 +63,7 @@ def build_engine(small: bool):
                         autostart=False)
         n_req, n_tok = 64, 256
     eng.start()
+    eng.warmup()
     return eng, tok, n_req, n_tok
 
 
