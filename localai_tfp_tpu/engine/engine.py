@@ -1067,8 +1067,10 @@ class LLMEngine:
         if self.max_seq > self.prefill_buckets[-1]:
             # long prompts chunk through the "prefill" fn at live-context
             # window buckets — compile those too, or the first long
-            # prompt stalls on a mid-request jit
-            w = 256
+            # prompt stalls on a mid-request jit. Chunk dispatches are
+            # always full-bucket wide, so their windows start at the
+            # bucket's own window bucket (window >= n_past + bucket).
+            w = self._window_bucket(self.prefill_buckets[-1])
             windows = set()
             while w < self.max_seq:
                 windows.add(w)
