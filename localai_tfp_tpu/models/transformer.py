@@ -546,6 +546,13 @@ def forward_hidden(
     # (post-multiplier, matching HF's masked_scatter of image features)
     mesh: Any = None,  # serving mesh: the decode kernel runs per-shard
     # under shard_map (attention is GQA-head-local over the "model" axis)
+    ring_prefill: bool = False,  # long-prompt FIRST-chunk prefill on a
+    # seq-sharded mesh: attention runs as ring attention over the "seq"
+    # axis (parallel/ring_attention.py) — O(T/n) attention memory and
+    # ICI-overlapped KV rotation instead of a [B, H, T, T] score tensor.
+    # Caller contract: mesh has a nontrivial "seq" axis, every row's
+    # pos0 is 0 (the chunk attends only to itself), no sliding window,
+    # and T divides the seq axis.
 ) -> tuple[jax.Array, KVCache]:
     """Run the stack up to (and including) the final norm; returns
     (hidden [B, T, D], updated cache). The LM head lives in ``forward``;
@@ -739,6 +746,23 @@ def forward_hidden(
 
         def xla_attn(q, k, v):
             k_eff, v_eff, carry = kv_from_cache(k, v)
+            if ring_prefill:
+                # seq-parallel exact attention over the chunk itself
+                # (caller guarantees pos0 == 0, so the cache holds no
+                # earlier positions to attend). K/V still went through
+                # kv_from_cache above for the cache WRITE; attention
+                # reads the pre-quantization chunk rows.
+                from ..parallel.ring_attention import ring_attention
+
+                scale = (1.0 / math.sqrt(spec.query_pre_attn_scalar)
+                         if spec.query_pre_attn_scalar
+                         else 1.0 / math.sqrt(spec.d_head))
+                # GQA K/V go in at their native head count; the ring
+                # repeats them locally after each ICI receive
+                out = ring_attention(q, k, v, mesh, causal=True,
+                                     scale=scale)
+                B_, T_ = q.shape[0], q.shape[1]
+                return (out.reshape(B_, T_, -1).astype(x.dtype), carry)
             return _attend(spec, q, k_eff, v_eff, positions,
                            lp.get("_window")), carry
 
@@ -793,11 +817,12 @@ def forward(
     decode_kernel: bool = False,
     soft: Optional[tuple] = None,
     mesh: Any = None,
+    ring_prefill: bool = False,
 ) -> tuple[jax.Array, KVCache]:
     """forward_hidden + LM head; returns (logits [B, T, V] f32, cache)."""
     x, cache = forward_hidden(
         spec, params, tokens, pos0, cache, slot_ids, decode_kernel, soft,
-        mesh,
+        mesh, ring_prefill,
     )
     return _lm_head(spec, params, x), cache
 

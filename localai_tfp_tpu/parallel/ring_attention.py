@@ -27,14 +27,20 @@ NEG_INF = -1e30
 
 
 def _ring_body(q, k, v, *, axis_name: str, causal: bool, scale: float):
-    """Per-device program. q/k/v: [B, Tl, H, D] local chunks."""
+    """Per-device program. q: [B, Tl, H, D]; k/v: [B, Tl, Hkv, D] —
+    GQA K/V rotate around the ring at their NATIVE head count (the ICI
+    bytes per rotation stay Hkv-sized) and are repeated to the query
+    head count locally, after each receive."""
     B, Tl, H, D = q.shape
+    grp = H // k.shape[2]
     n = jax.lax.psum(1, axis_name)  # ring size (static under shard_map)
     my = lax.axis_index(axis_name)
     q_pos = my * Tl + jnp.arange(Tl)  # global positions of local queries
 
     def step(i, carry):
-        k_blk, v_blk, m, l, acc = carry
+        k_raw, v_raw, m, l, acc = carry
+        k_blk = jnp.repeat(k_raw, grp, axis=2) if grp > 1 else k_raw
+        v_blk = jnp.repeat(v_raw, grp, axis=2) if grp > 1 else v_raw
         # the block visiting us at step i started at device (my - i) mod n
         src = (my - i) % n
         kv_pos = src * Tl + jnp.arange(Tl)
@@ -59,11 +65,11 @@ def _ring_body(q, k, v, *, axis_name: str, causal: bool, scale: float):
             preferred_element_type=jnp.float32,
         )
         m = new_m
-        # rotate the K/V block to the next device over ICI
+        # rotate the (Hkv-sized) K/V block to the next device over ICI
         perm = [(j, (j + 1) % n) for j in range(n)]
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return k_blk, v_blk, m, l, acc
+        k_raw = lax.ppermute(k_raw, axis_name, perm)
+        v_raw = lax.ppermute(v_raw, axis_name, perm)
+        return k_raw, v_raw, m, l, acc
 
     m0 = jnp.full((B, H, Tl), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, Tl), jnp.float32)
@@ -75,8 +81,8 @@ def _ring_body(q, k, v, *, axis_name: str, causal: bool, scale: float):
 
 def ring_attention(
     q: jax.Array,  # [B, T, H, D] sequence-sharded on `axis_name`
-    k: jax.Array,
-    v: jax.Array,
+    k: jax.Array,  # [B, T, Hkv, D] — Hkv may be < H (GQA); blocks
+    v: jax.Array,  # rotate at Hkv size, repeated to H locally
     mesh: Mesh,
     *,
     axis_name: str = "seq",
