@@ -69,13 +69,41 @@ async def home(request: web.Request) -> web.Response:
         if cfg.has_usecase(Usecase.TTS):
             links.append(f'<a href="/tts/{cfg.name}">tts</a>')
         loaded = st.model_loader.get(cfg.name) is not None
+        # single-quoted attribute with the name as an escaped JS string:
+        # json.dumps inside a double-quoted onclick truncates the
+        # attribute at the first inner double quote
+        esc = json.dumps(cfg.name).replace("'", "\\'").replace('"', "&quot;")
+        links.append(
+            f"<button class=\"muted\" onclick='del({esc},this)'>"
+            "delete</button>")
         rows.append(
             f'<div class="card"><b>{cfg.name}</b> '
             f'<span class="muted">backend={cfg.backend or "auto"}'
             f'{" · loaded" if loaded else ""}</span><br>'
             + " ".join(links) + "</div>"
         )
-    body = "".join(rows) or "<p>No models installed — try the gallery.</p>"
+    body = ("".join(rows)
+            or "<p>No models installed — try the gallery.</p>") + """
+<script>
+async function del(name,btn){
+ if(!confirm('Delete model '+name+' (config + files)?'))return;
+ btn.disabled=true;btn.textContent='deleting…';
+ try{
+  const r=await (await fetch('/models/delete/'+encodeURIComponent(name),
+    {method:'POST'})).json();
+  const id=r.uuid;
+  const poll=async()=>{
+   try{
+    const s=await (await fetch('/models/jobs/'+id)).json();
+    if(s.processed){
+     if(s.error){btn.textContent='error: '+s.error;}
+     else location.reload();
+    }else setTimeout(poll,700);
+   }catch(e){btn.textContent='error: '+e;}};
+  poll();
+ }catch(e){btn.textContent='error: '+e;}
+}
+</script>"""
     return _page("Models", body)
 
 

@@ -52,6 +52,11 @@ def test_ui_pages_render(client, path):
 def test_home_lists_models(client):
     _, body = client("/")
     assert b"voice" in body
+    # per-model delete button wired to the gallery delete job API;
+    # the onclick must be single-quoted (a double-quoted attribute
+    # truncates at the JS string's own quotes — rendered-HTML bug class)
+    assert b"/models/delete/" in body
+    assert b"onclick='del(" in body
 
 
 def test_swagger_doc_covers_api(client):
