@@ -28,6 +28,7 @@ from ..workers.base import Backend, PredictOptions, Reply
 from . import schema
 from .common import WORKER_POOL, run_blocking
 from .state import Application
+from .stream_bridge import BRIDGE
 
 
 def register(app: web.Application) -> None:
@@ -482,6 +483,13 @@ async def _stream_chat(
         try:
             opts = opts_src() if callable(opts_src) else opts_src
             opts.request_id = opts.request_id or rid
+            # engine-backed streaming hands off to the single-pump
+            # bridge (this thread returns immediately); other backends
+            # keep the thread-per-stream generator
+            sq = backend.stream_queue(opts)
+            if sq is not None:
+                BRIDGE.register(sq, loop, q)
+                return
             for r in backend.predict_stream(opts):
                 loop.call_soon_threadsafe(q.put_nowait, r)
         except Exception as e:  # surface engine errors as a final reply
@@ -635,6 +643,10 @@ async def _stream_completion(request, backend, opts, cfg, cid, created,
 
     def producer() -> None:
         try:
+            sq = backend.stream_queue(opts)
+            if sq is not None:
+                BRIDGE.register(sq, loop, q)
+                return
             for r in backend.predict_stream(opts):
                 loop.call_soon_threadsafe(q.put_nowait, r)
         except Exception as e:

@@ -1,0 +1,132 @@
+"""Single-pump streaming bridge: engine queues -> asyncio queues.
+
+The original streaming path parked ONE blocking producer thread per SSE
+stream (64 concurrent streams = 64 threads each waking per event). At
+burst time the wakeup storm measurably stalled both the scheduler
+thread and the event loop on small hosts (GIL churn) — the dominant
+residual in cold-burst TTFT after the engine-side fixes. This bridge
+replaces all of them with ONE pump thread per process that round-robin
+drains every registered engine queue (``queue.SimpleQueue`` has no
+select; a 1 ms poll across N queues is microseconds of work) and wakes
+each event loop AT MOST once per sweep with the whole batch.
+
+Scope: engine-backed LLM streaming (the high-concurrency path). Other
+backends (remote proxies, recurrent models) keep the plain
+one-thread-per-stream producer — they are single-digit concurrency.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Optional
+
+from ..workers.base import Reply
+
+
+class _Stream:
+    __slots__ = ("sq", "aq", "loop", "done")
+
+    def __init__(self, sq, aq, loop):
+        self.sq = sq  # engine queue.SimpleQueue of StreamEvent
+        self.aq = aq  # asyncio.Queue of Optional[Reply]
+        self.loop = loop
+        self.done = False
+
+
+def _to_replies(ev) -> tuple[Optional[Reply], bool]:
+    """StreamEvent -> (Reply or None, is_final). Mirrors
+    JaxLLMBackend.predict_stream's mapping."""
+    if ev.done:
+        return Reply(
+            message=ev.full_text,
+            tokens=ev.completion_tokens,
+            prompt_tokens=ev.prompt_tokens,
+            timing_prompt_processing=ev.timing_prompt_processing_ms,
+            timing_token_generation=ev.timing_token_generation_ms,
+            finish_reason=ev.finish_reason,
+            error=ev.error,
+        ), True
+    if ev.text:
+        return Reply(message=ev.text, token_id=ev.token_id), False
+    return None, False
+
+
+class StreamBridge:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._streams: list[_Stream] = []
+        self._thread: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+
+    def register(self, sq, loop, aq: asyncio.Queue) -> asyncio.Queue:
+        """Attach an engine event queue feeding the handler's asyncio
+        queue (None terminates the stream)."""
+        st = _Stream(sq, aq, loop)
+        with self._lock:
+            self._streams.append(st)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._pump, name="stream-bridge", daemon=True)
+                self._thread.start()
+        self._wake.set()
+        return aq
+
+    def _pump(self) -> None:
+        import time
+
+        while True:
+            # clear BEFORE snapshotting: a register() between an empty
+            # snapshot and a later clear() would have its wakeup erased
+            # and the new stream would stall until the wait timeout
+            self._wake.clear()
+            with self._lock:
+                streams = list(self._streams)
+            if not streams:
+                # idle: sleep until the next register
+                self._wake.wait(timeout=5.0)
+                continue
+            sweeps: dict[Any, list[tuple[_Stream, list]]] = {}
+            finished = []
+            for st in streams:
+                items: list = []
+                while True:
+                    try:
+                        ev = st.sq.get_nowait()
+                    except Exception:
+                        break
+                    rep, final = _to_replies(ev)
+                    if rep is not None:
+                        items.append(rep)
+                    if final:
+                        items.append(None)  # stream terminator
+                        st.done = True
+                        break
+                if items:
+                    sweeps.setdefault(st.loop, []).append((st, items))
+                if st.done:
+                    finished.append(st)
+            if finished:
+                with self._lock:
+                    for st in finished:
+                        try:
+                            self._streams.remove(st)
+                        except ValueError:
+                            pass
+            for loop, batch in sweeps.items():
+                # ONE loop callback per sweep delivers every stream's
+                # batch (vs one call_soon_threadsafe per token)
+                def deliver(batch=batch):
+                    for st, items in batch:
+                        for it in items:
+                            st.aq.put_nowait(it)
+
+                try:
+                    loop.call_soon_threadsafe(deliver)
+                except RuntimeError:
+                    pass  # loop closed: client gone; engine cancel
+                    # happens via the handler's disconnect path
+            time.sleep(1e-3)
+
+
+BRIDGE = StreamBridge()

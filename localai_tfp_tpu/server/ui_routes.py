@@ -69,10 +69,13 @@ async def home(request: web.Request) -> web.Response:
         if cfg.has_usecase(Usecase.TTS):
             links.append(f'<a href="/tts/{cfg.name}">tts</a>')
         loaded = st.model_loader.get(cfg.name) is not None
-        # single-quoted attribute with the name as an escaped JS string:
-        # json.dumps inside a double-quoted onclick truncates the
-        # attribute at the first inner double quote
-        esc = json.dumps(cfg.name).replace("'", "\\'").replace('"', "&quot;")
+        # single-quoted attribute with the name as an escaped JS string;
+        # quotes become HTML ENTITIES (backslash means nothing to the
+        # HTML parser, so \\' would still terminate the attribute — a
+        # quote-bearing name could inject markup into the admin UI)
+        esc = (json.dumps(cfg.name)
+               .replace("&", "&amp;").replace("'", "&#39;")
+               .replace('"', "&quot;").replace("<", "&lt;"))
         links.append(
             f"<button class=\"muted\" onclick='del({esc},this)'>"
             "delete</button>")

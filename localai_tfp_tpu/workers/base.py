@@ -196,6 +196,13 @@ class Backend(abc.ABC):
     def predict_stream(self, opts: PredictOptions) -> Iterator[Reply]:
         raise NotImplementedError
 
+    def stream_queue(self, opts: PredictOptions):
+        """Optional capability: submit and return a raw engine event
+        queue for single-pump streaming (server/stream_bridge.py).
+        None (the default) means this backend streams via the
+        ``predict_stream`` generator on a per-stream thread."""
+        return None
+
     def embedding(self, opts: PredictOptions) -> EmbeddingResult:
         raise NotImplementedError
 

@@ -456,6 +456,16 @@ class JaxLLMBackend(Backend):
         ev = self.engine.generate(self._to_request(opts))
         return _final_reply(ev)
 
+    def stream_queue(self, opts: PredictOptions):
+        """Submit and return the raw engine event queue for bridge-pumped
+        streaming (server/stream_bridge.py) — one pump thread serves
+        every stream instead of a parked thread per stream. None for
+        the non-engine paths (mamba / unloaded), which stream via the
+        plain generator."""
+        if self.engine is None or self.mamba is not None:
+            return None
+        return self.engine.submit(self._to_request(opts))
+
     def predict_stream(self, opts: PredictOptions) -> Iterator[Reply]:
         if self.mamba is not None:
             # the recurrent generate is one device dispatch; stream the
