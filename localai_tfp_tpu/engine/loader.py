@@ -247,10 +247,19 @@ class ModelLoader:
                 [cfg.lora_scale] if cfg.lora_scale else []
             ),
             options=cfg.options,
-            extra=({**cfg.extra, "_cfg_raw": cfg.raw,
-                    "_models_path": self.models_path}
-                   if cfg.isolation == "subprocess" else cfg.extra),
+            extra=self._extra_for(cfg),
         )
+
+    def _extra_for(self, cfg: ModelConfig) -> dict:
+        extra = cfg.extra
+        if cfg.diffusers.control_net:
+            # forward the canonical diffusers.control_net key so the
+            # worker can fail loudly (it is not silently ignorable)
+            extra = {**extra, "control_net": cfg.diffusers.control_net}
+        if cfg.isolation == "subprocess":
+            extra = {**extra, "_cfg_raw": cfg.raw,
+                     "_models_path": self.models_path}
+        return extra
 
     # ------------------------------------------------------------ lifecycle
 

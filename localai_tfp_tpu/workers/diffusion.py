@@ -83,6 +83,20 @@ class JaxDiffusionBackend(Backend):
                         and not os.path.isabs(model_dir):
                     model_dir = os.path.join(opts.model_path or "",
                                              model_dir)
+                if (opts.extra.get("control_net")
+                        or opts.extra.get("controlnet")):
+                    # conditioning side-network not implemented yet
+                    # (PARITY.md ControlNet gap entry) — fail loudly,
+                    # never silently ignore the requested conditioning.
+                    # Covers the canonical diffusers.control_net key
+                    # (forwarded by the loader) and top-level spellings.
+                    self._state = "ERROR"
+                    return Result(
+                        False,
+                        "controlnet conditioning is not supported yet "
+                        "(see the ControlNet entry in PARITY.md's known "
+                        "gaps); remove `control_net` from the model "
+                        "yaml")
                 if model_dir and os.path.exists(
                         os.path.join(model_dir, "model_index.json")):
                     # pipeline-class switch (ref: diffusers backend.py

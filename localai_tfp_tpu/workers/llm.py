@@ -96,11 +96,11 @@ class JaxLLMBackend(Backend):
                 return Result(
                     False,
                     "load failed: EXL2 is exllamav2's CUDA-kernel-"
-                    "specific storage and is not served on TPU "
-                    "(PARITY.md won't-fix #3); point parameters.model "
-                    "at the model's GGUF or safetensors release and "
-                    "set quantization: int8 for the equivalent "
-                    "quantized serving mode")
+                    "specific storage and is not served on TPU (see "
+                    "the EXL2 won't-fix entry in PARITY.md); point "
+                    "parameters.model at the model's GGUF or "
+                    "safetensors release and set quantization: int8 "
+                    "for the equivalent quantized serving mode")
             is_gguf = model_dir.endswith(".gguf")
             if (not os.path.isdir(model_dir) if not is_gguf
                     else not os.path.isfile(model_dir)):
