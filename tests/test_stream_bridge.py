@@ -1,0 +1,115 @@
+"""StreamBridge unit behavior: delivery, termination, prompt wakeup
+(the lost-wakeup regression), and multi-stream batching."""
+
+import asyncio
+import queue
+import time
+
+from localai_tfp_tpu.engine.engine import StreamEvent
+from localai_tfp_tpu.server.stream_bridge import StreamBridge
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_delivers_events_and_terminates():
+    bridge = StreamBridge()
+
+    async def go():
+        loop = asyncio.get_running_loop()
+        sq: queue.SimpleQueue = queue.SimpleQueue()
+        aq: asyncio.Queue = asyncio.Queue()
+        bridge.register(sq, loop, aq)
+        sq.put(StreamEvent(text="hel", token_id=1))
+        sq.put(StreamEvent(text="lo", token_id=2))
+        sq.put(StreamEvent(done=True, finish_reason="stop",
+                           full_text="hello", completion_tokens=2,
+                           prompt_tokens=3))
+        out = []
+        while True:
+            item = await asyncio.wait_for(aq.get(), timeout=5)
+            if item is None:
+                break
+            out.append(item)
+        assert "".join(r.message for r in out[:-1]) == "hello"
+        final = out[-1]
+        assert final.finish_reason == "stop"
+        assert final.tokens == 2 and final.prompt_tokens == 3
+        # the stream self-removed after the final event
+        for _ in range(100):
+            with bridge._lock:
+                if not bridge._streams:
+                    return
+            time.sleep(0.01)
+        raise AssertionError("finished stream not unregistered")
+
+    _run(go())
+
+
+def test_register_after_idle_wakes_promptly():
+    """Lost-wakeup regression: a stream registered while the pump is in
+    its idle wait must be served in milliseconds, not at the idle-wait
+    timeout."""
+    bridge = StreamBridge()
+
+    async def go():
+        loop = asyncio.get_running_loop()
+        # prime the pump thread, let it go idle
+        sq0: queue.SimpleQueue = queue.SimpleQueue()
+        aq0: asyncio.Queue = asyncio.Queue()
+        bridge.register(sq0, loop, aq0)
+        sq0.put(StreamEvent(done=True, finish_reason="stop"))
+        assert (await asyncio.wait_for(aq0.get(), 5)).finish_reason == "stop"
+        assert await asyncio.wait_for(aq0.get(), 5) is None
+        await asyncio.sleep(0.1)  # pump is now idle-waiting
+
+        sq: queue.SimpleQueue = queue.SimpleQueue()
+        aq: asyncio.Queue = asyncio.Queue()
+        sq.put(StreamEvent(text="x", token_id=7))
+        t0 = time.perf_counter()
+        bridge.register(sq, loop, aq)
+        first = await asyncio.wait_for(aq.get(), timeout=5)
+        dt = time.perf_counter() - t0
+        assert first.message == "x"
+        assert dt < 1.0, f"wakeup took {dt:.2f}s (idle-wait leak)"
+        sq.put(StreamEvent(done=True, finish_reason="stop"))
+        while await asyncio.wait_for(aq.get(), 5) is not None:
+            pass
+
+    _run(go())
+
+
+def test_many_streams_batched_delivery():
+    bridge = StreamBridge()
+    n = 16
+
+    async def go():
+        loop = asyncio.get_running_loop()
+        pairs = []
+        for i in range(n):
+            sq: queue.SimpleQueue = queue.SimpleQueue()
+            aq: asyncio.Queue = asyncio.Queue()
+            bridge.register(sq, loop, aq)
+            pairs.append((sq, aq))
+        for i, (sq, _) in enumerate(pairs):
+            for j in range(4):
+                sq.put(StreamEvent(text=f"{i}:{j};", token_id=j))
+            sq.put(StreamEvent(done=True, finish_reason="length",
+                               full_text="", completion_tokens=4))
+        for i, (_, aq) in enumerate(pairs):
+            got = []
+            while True:
+                item = await asyncio.wait_for(aq.get(), timeout=5)
+                if item is None:
+                    break
+                got.append(item)
+            assert got[-1].finish_reason == "length"
+            text = "".join(r.message for r in got[:-1])
+            assert text == "".join(f"{i}:{j};" for j in range(4))
+
+    _run(go())
