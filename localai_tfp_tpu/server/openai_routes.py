@@ -23,7 +23,8 @@ from aiohttp import web
 
 from ..config.model_config import ModelConfig, Usecase
 from ..grammars.json_schema import functions_grammar, schema_to_gbnf
-from ..grammars.parse import parse_function_call, parse_text_content
+from ..grammars.parse import (FinetuneStream, apply_finetune,
+                              parse_function_call, parse_text_content)
 from ..workers.base import Backend, PredictOptions, Reply
 from . import schema
 from .common import WORKER_POOL, run_blocking
@@ -301,6 +302,21 @@ def _completion_id(prefix: str = "chatcmpl") -> str:
     return f"{prefix}-{uuid.uuid4().hex[:28]}"
 
 
+def _finetune_kw(cfg: ModelConfig, prompt: str) -> Optional[dict]:
+    """apply_finetune kwargs for this config, or None when no
+    post-processing is configured (the overwhelmingly common case pays
+    one boolean check). ref: core/backend/llm.go:192-240 Finetune,
+    called per choice from ComputeChoices (inference.go:58)."""
+    if not (cfg.parameters.echo or cfg.cutstrings or cfg.extract_regex
+            or cfg.trimspace or cfg.trimsuffix):
+        return None
+    return dict(
+        echo_prompt=prompt if cfg.parameters.echo else "",
+        cutstrings=cfg.cutstrings, extract_regex=cfg.extract_regex,
+        trimspace=cfg.trimspace, trimsuffix=cfg.trimsuffix,
+    )
+
+
 async def _run_predict(backend: Backend, opts: PredictOptions) -> Reply:
     loop = asyncio.get_running_loop()
     return await loop.run_in_executor(WORKER_POOL, backend.predict, opts)
@@ -396,9 +412,14 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         ])
         choices = []
         total = Reply()
+        ft_kw = _finetune_kw(cfg, opts.prompt)
         for i, reply in enumerate(replies):
             if reply.error:
                 raise web.HTTPInternalServerError(reason=reply.error)
+            if ft_kw is not None:  # before function parsing, like
+                # ComputeChoices (inference.go:58) hands the finetuned
+                # text to the chat callback
+                reply.message = apply_finetune(reply.message, **ft_kw)
             message: dict[str, Any] = {"role": "assistant"}
             finish = reply.finish_reason or "stop"
             if tools_requested:
@@ -478,11 +499,15 @@ async def _stream_chat(
     loop = asyncio.get_running_loop()
     q: asyncio.Queue = asyncio.Queue()
     rid = uuid.uuid4().hex
+    prompt_box: dict[str, str] = {}  # templated prompt, set by the
+    # producer BEFORE submit — stream events (and thus any finetune echo
+    # use of it) can only arrive after
 
     def producer() -> None:
         try:
             opts = opts_src() if callable(opts_src) else opts_src
             opts.request_id = opts.request_id or rid
+            prompt_box["prompt"] = opts.prompt
             # engine-backed streaming hands off to the single-pump
             # bridge (this thread returns immediately); other backends
             # keep the thread-per-stream generator
@@ -503,6 +528,19 @@ async def _stream_chat(
     buffered = ""
     final: Optional[Reply] = None
     done = False
+    ft: Optional[FinetuneStream] = None
+    ft_ready = False
+
+    def ensure_ft() -> Optional[FinetuneStream]:
+        # lazy: prompt_box is only guaranteed set once the producer ran
+        # (always before the first event, and before the done marker)
+        nonlocal ft, ft_ready
+        if not ft_ready:
+            kw = _finetune_kw(cfg, prompt_box.get("prompt", ""))
+            ft = FinetuneStream(**kw) if kw else None
+            ft_ready = True
+        return ft
+
     try:
         while not done:
             batch = [await q.get()]
@@ -525,7 +563,19 @@ async def _stream_chat(
                 elif tools_requested:
                     buffered += r.message
                 elif r.message:
-                    out += chunk({"content": r.message})
+                    f = ensure_ft()
+                    txt = f.feed(r.message) if f else r.message
+                    if txt:
+                        out += chunk({"content": txt})
+            if done and not tools_requested:
+                # zero content events: echo alone can still produce
+                # canonical output, so ensure the stream exists
+                f = ensure_ft()
+                if f is not None:
+                    tail = f.finish()
+                    ft = None
+                    if tail:
+                        out += chunk({"content": tail})
             if out:
                 await resp.write(bytes(out))
     except (ConnectionResetError, asyncio.CancelledError):
@@ -536,6 +586,10 @@ async def _stream_chat(
 
     finish = (final.finish_reason if final else "stop") or "stop"
     if tools_requested and final is not None:
+        kw = _finetune_kw(cfg, prompt_box.get("prompt", ""))
+        if kw is not None:
+            final.message = apply_finetune(final.message, **kw)
+            buffered = apply_finetune(buffered, **kw)
         calls = parse_function_call(final.message, cfg.function)
         if calls:
             finish = "tool_calls"
@@ -602,10 +656,13 @@ async def completions(request: web.Request) -> web.StreamResponse:
         ])
         choices = []
         total = Reply()
-        for i, ((prompt, _), reply) in enumerate(zip(jobs, replies)):
+        for i, ((prompt, o), reply) in enumerate(zip(jobs, replies)):
             if reply.error:
                 raise web.HTTPInternalServerError(reason=reply.error)
             text = reply.message
+            ft_kw = _finetune_kw(cfg, o.prompt)
+            if ft_kw is not None:  # ref: completion.go:170 ComputeChoices
+                text = apply_finetune(text, **ft_kw)
             if body.get("echo"):
                 text = prompt + text
             choices.append({
@@ -658,6 +715,18 @@ async def _stream_completion(request, backend, opts, cfg, cid, created,
     loop.run_in_executor(WORKER_POOL, producer)
     final = None
     done = False
+    ft_kw = _finetune_kw(cfg, opts.prompt)
+    ft = FinetuneStream(**ft_kw) if ft_kw else None
+
+    def text_chunk(text: str) -> bytes:
+        payload = {
+            "id": cid, "object": "text_completion",
+            "created": created, "model": cfg.name,
+            "choices": [{"index": 0, "text": text,
+                         "finish_reason": None}],
+        }
+        return f"data: {json.dumps(payload)}\n\n".encode()
+
     try:
         while not done:
             batch = [await q.get()]
@@ -674,13 +743,14 @@ async def _stream_completion(request, backend, opts, cfg, cid, created,
                 if r.finish_reason or r.error:
                     final = r
                 elif r.message:
-                    payload = {
-                        "id": cid, "object": "text_completion",
-                        "created": created, "model": cfg.name,
-                        "choices": [{"index": 0, "text": r.message,
-                                     "finish_reason": None}],
-                    }
-                    out += f"data: {json.dumps(payload)}\n\n".encode()
+                    txt = ft.feed(r.message) if ft else r.message
+                    if txt:
+                        out += text_chunk(txt)
+            if done and ft is not None:
+                tail = ft.finish()
+                ft = None
+                if tail:
+                    out += text_chunk(tail)
             if out:
                 await resp.write(bytes(out))
     except (ConnectionResetError, asyncio.CancelledError):
@@ -725,7 +795,11 @@ async def edits(request: web.Request) -> web.Response:
         reply = await _run_predict(backend, opts)
         if reply.error:
             raise web.HTTPInternalServerError(reason=reply.error)
-        choices.append({"index": i, "text": reply.message})
+        text = reply.message
+        ft_kw = _finetune_kw(cfg, opts.prompt)
+        if ft_kw is not None:  # ref: edit.go:59 ComputeChoices
+            text = apply_finetune(text, **ft_kw)
+        choices.append({"index": i, "text": text})
         total.prompt_tokens += reply.prompt_tokens
         total.tokens += reply.tokens
     return web.json_response({

@@ -390,3 +390,56 @@ def test_lazy_grammar_tool_call_after_prose_in_engine():
     assert free.full_text.startswith(pre + trig)  # preamble = greedy
     assert post == "abc"  # constrained continuation, then clean EOS stop
     assert ev.finish_reason == "stop"
+
+
+def test_finetune_stream_matches_batch():
+    """FinetuneStream invariant: concatenated feed() output + finish()
+    is bit-identical to apply_finetune on the full text, for EVERY
+    chunking of the input (the streaming path must not depend on where
+    the engine happens to split its k-step bursts)."""
+    from localai_tfp_tpu.grammars.parse import FinetuneStream
+
+    cases = [
+        (" PREFIX  hello world  END ", dict(trimspace=["PREFIX"],
+                                            trimsuffix=["END"])),
+        ("hello", dict(echo_prompt="in: ")),
+        ("a b a b c", dict(cutstrings=["a"])),  # buffered mode
+        ("x <r>42</r> y", dict(extract_regex=[r"<r>\d+</r>"])),
+        ("  just text, no config hits  ", dict(trimspace=["zz"],
+                                               trimsuffix=["yy"])),
+        ("suf suf suf", dict(trimsuffix=["suf"])),
+        ("ENDEND mid END  ", dict(trimsuffix=["END"])),
+        ("ppq payload", dict(trimspace=["pp", "q"])),
+        ("", dict(echo_prompt="only-echo")),
+        ("     ", dict(trimspace=[""])),
+        # trimsuffix's per-entry strip() ALSO trims leading whitespace —
+        # a tokenizer's leading space must not desync the stream
+        (" Hi there</s>", dict(trimsuffix=["</s>"])),
+        (" Hi there", dict(trimsuffix=["</s>"])),
+        # a trimspace entry that matches the ECHOED prompt: echo flows
+        # through the trim pipeline, as apply_finetune prepends-then-trims
+        ("Hello world", dict(echo_prompt="P: ", trimspace=["P:"])),
+        ("out!", dict(echo_prompt="  in  ", trimsuffix=["!"])),
+    ]
+    for text, kw in cases:
+        want = apply_finetune(text, **kw)
+        for step in (1, 2, 3, 5, len(text) or 1):
+            ft = FinetuneStream(**kw)
+            got = ""
+            for i in range(0, len(text), step):
+                got += ft.feed(text[i:i + step])
+            got += ft.finish()
+            assert got == want, (text, kw, step, got, want)
+
+
+def test_finetune_stream_incremental_not_buffered():
+    """With only trim/echo config the stream must flow incrementally —
+    content far from the tail is emitted before finish()."""
+    from localai_tfp_tpu.grammars.parse import FinetuneStream
+
+    ft = FinetuneStream(trimsuffix=["END"])
+    early = ft.feed("a long stretch of content " * 4)
+    assert len(early) > 50  # most of it emitted immediately
+    early += ft.feed(" END")
+    assert "END" not in early  # candidate suffix held back
+    assert early + ft.finish() == ("a long stretch of content " * 4).strip()
