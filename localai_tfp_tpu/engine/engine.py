@@ -663,7 +663,7 @@ class LLMEngine:
         self._decode_k_fns[key] = _prefill
         return _prefill
 
-    def _prefill_final_fn(self, window: int):
+    def _prefill_final_fn(self, window: int, identity: bool = False):
         """Final prompt chunks for a BATCH of slots + penalty-window seed
         + first-token sample in ONE dispatch — concurrent prompts share
         the round trip instead of paying one each, and TTFT pays one RTT,
@@ -671,13 +671,23 @@ class LLMEngine:
         the decode path: full-seq prefill attention measured ~7s/wave at
         1B/2048-seq shapes, windowed ~100ms.
 
+        ``identity``: the batch spans EVERY slot in cache-row order
+        (row b == slot b), so the K/V write takes forward_hidden's
+        per-row DUS hot path instead of the whole-layer gather/scatter a
+        cross-slot mapping forces — measured 234 -> 153 ms on the
+        [64, 4] 8B int8 dispatch (tools/microbench_step.py r5).
+        ``slot_ids`` still arrives for the SAMPLER scatters: non-member
+        rows carry the out-of-bounds sentinel so their reset/seed/sample
+        writes drop.
+
         tokens [B, bucket]; slot_ids/pos0/n_chunk/tail_lens [B];
         tails [B, W]."""
-        key = ("prefill_final", window)
+        key = ("prefill_final", window, identity)
         fn = self._decode_k_fns.get(key)
         if fn is not None:
             return fn
         spec = self.spec
+        n_slots = self.n_slots
 
         @partial(jax.jit, donate_argnums=(2, 4))
         def _prefill_final(params, tokens, cache, pos0, sampling, slot_ids,
@@ -687,7 +697,12 @@ class LLMEngine:
                 soft = _soft_expand(tokens, *soft)
             win, restore = _window_cache(cache, window)
             hidden, win = forward_hidden(
-                spec, params, tokens, pos0, win, slot_ids, soft=soft
+                spec, params, tokens, pos0, win,
+                None if identity else slot_ids, soft=soft,
+                # identity parks non-members at pos 0 with a no-op
+                # write, so the window can track the MEMBERS' live
+                # context instead of max_seq
+                write_mask=(slot_ids < n_slots) if identity else None,
             )
             cache = restore(win)
             # sampler reset rides THIS dispatch (admission used to pay a
@@ -949,7 +964,7 @@ class LLMEngine:
                 "repeat_last_n", "seeds", "has_seed",
                 "typical_p", "mirostat", "mirostat_tau", "mirostat_eta"))
             toks_out, self.cache, self.sampling = self._prefill_final_fn(
-                p.get("window", self.max_seq))(
+                p.get("window", self.max_seq), p.get("identity", False))(
                 self.params, toks, self.cache, pos0, self.sampling, sids,
                 jnp.asarray(p["n_chunk"]), jnp.asarray(p["tails"]),
                 jnp.asarray(p["tail_lens"]), masks, reset, soft,
@@ -1056,26 +1071,43 @@ class LLMEngine:
         change is one cold pass; afterwards seconds."""
         W = self.sampling.window
         pad_reset = self._reset_columns([], 1)
+        win_ladder = []
+        w = self._window_bucket(1)
+        while w < self.max_seq:
+            win_ladder.append(w)
+            w *= 2
+        win_ladder.append(self.max_seq)
         for bucket in self.prefill_buckets:
-            cap = self._prefill_group_cap(bucket)
-            sizes = {cap}
-            b = 1
-            while b < cap:
-                sizes.add(b)
-                b *= 8
+            identity = bucket * self.n_slots <= self._PREFILL_GROUP_TOKENS
+            if identity:
+                sizes = {self.n_slots}  # ONE identity shape per bucket
+                # every live-context window variant, so no (window,
+                # bucket) shape can cold-compile mid-request
+                windows = win_ladder
+            else:
+                cap = self._prefill_group_cap(bucket)
+                sizes = {cap}
+                b = 1
+                while b < cap:
+                    sizes.add(b)
+                    b *= 8
+                windows = [self.max_seq]
             for B in sorted(sizes):
                 reset = {k: np.repeat(v, B, axis=0)
                          for k, v in pad_reset.items()}
-                self._run("prefill_final", {
-                    "toks": np.zeros((B, bucket), np.int32),
-                    "pos0": np.zeros((B,), np.int32),
-                    "slot_ids": np.full((B,), self.n_slots, np.int32),
-                    "n_chunk": np.ones((B,), np.int32),
-                    "tails": np.zeros((B, W), np.int32),
-                    "tail_lens": np.zeros((B,), np.int32),
-                    "masks": None, "reset": reset, "soft": None,
-                    "window": self.max_seq,
-                })
+                for win in windows:
+                    self._run("prefill_final", {
+                        "toks": np.zeros((B, bucket), np.int32),
+                        "pos0": np.zeros((B,), np.int32),
+                        "slot_ids": np.full((B,), self.n_slots,
+                                            np.int32),
+                        "n_chunk": np.ones((B,), np.int32),
+                        "tails": np.zeros((B, W), np.int32),
+                        "tail_lens": np.zeros((B,), np.int32),
+                        "masks": None, "reset": reset, "soft": None,
+                        "window": win,
+                        "identity": identity,
+                    })
         if self.max_seq > self.prefill_buckets[-1]:
             # long prompts chunk through the "prefill" fn at live-context
             # window buckets — compile those too, or the first long
@@ -1274,6 +1306,8 @@ class LLMEngine:
                 else:
                     self._prefill_step(s)  # enqueue-only, no result
                     did = True
+            if finals and self._gather_prefill():
+                finals = {}
             for bucket in sorted(finals, key=lambda b: -len(finals[b])):
                 group = finals[bucket]
                 cap = self._prefill_group_cap(bucket)
@@ -1285,6 +1319,25 @@ class LLMEngine:
         if decoding:
             did = self._dispatch_decode(decoding) or did
         return did
+
+    def _gather_prefill(self) -> bool:
+        """While an admission burst is still landing, keep at most ONE
+        prefill_final flight in the air: the in-flight group's ~300 ms
+        tunnel round trip is the gather window that coalesces trickling
+        HTTP arrivals into one big batched prefill. Without this, a
+        64-deep HTTP wave fragments into ~10 ragged groups whose
+        serialized flights push p50 first-token PAST TWO SECONDS (engine
+        submit_many wave: 303 ms — measured r5, tools/profile_r5.py),
+        and the decode phase runs under-width until the last straggler
+        group lands. A lone request (no prefill in flight) dispatches
+        immediately; an all-at-once submit_many wave admits in one step
+        and is never split by this gate."""
+        if not any(f.kind == "prefill_final" for f in self._flights):
+            return False
+        with self._lock:
+            pending = bool(self._pending)
+        return (pending
+                or time.perf_counter() - self._last_arrival < 0.25)
 
     def _wait_for_event(self) -> None:
         """Nothing to enqueue and nothing ready: block until the oldest
@@ -1337,13 +1390,16 @@ class LLMEngine:
                 continue
             self._assign(slot, req, out)
 
-    def _reset_columns(self, group: list[_Slot], pad_to: int) -> dict:
+    def _reset_columns(self, group: list[_Slot], pad_to: int,
+                       rows: Optional[list[int]] = None) -> dict:
         """Per-slot sampler-reset columns for a prefill_final group. The
         reset rides the prefill dispatch (a separate reset_batch dispatch
         cost one extra tunnel RTT per admission wave — measured directly
-        on burst TTFT). Rows beyond ``len(group)`` pad with zeros; their
-        scatter targets the out-of-bounds sentinel slot, so the writes
-        are dropped."""
+        on burst TTFT). ``rows`` places each group member at an explicit
+        batch row (the identity dispatch, where row == slot idx); without
+        it members occupy the leading rows. Unoccupied rows pad with
+        zeros; their scatter targets the out-of-bounds sentinel slot, so
+        the writes are dropped."""
         W = self.sampling.window
         cols: dict[str, list] = {k: [] for k in (
             "temperature", "top_k", "top_p", "min_p",
@@ -1351,7 +1407,10 @@ class LLMEngine:
             "repeat_last_n", "seeds", "has_seed",
             "typical_p", "mirostat", "mirostat_tau", "mirostat_eta")}
         pad = _PadReq()
-        for s in list(group) + [None] * (pad_to - len(group)):
+        layout: list[Optional[_Slot]] = [None] * pad_to
+        for i, s in enumerate(group):
+            layout[rows[i] if rows is not None else i] = s
+        for s in layout:
             r = s.request if s is not None else pad
             assert r is not None
             cols["temperature"].append(r.temperature)
@@ -1608,10 +1667,15 @@ class LLMEngine:
         costs ~13s, so the variant set must stay tiny (Engine.warmup
         precompiles it) — these sizes cover any admission pattern at
         <=8x padded compute, and padded rows are bandwidth-free (no new
-        weights are read). The cache window is pinned to max_seq (not
-        live-context bucketed): the attention saving was ~1ms at
-        serving shapes while every extra window bucket was another
-        13s compile that could land mid-request.
+        weights are read).
+
+        Small buckets instead dispatch IDENTITY full-batch (row b ==
+        slot b, every slot a row): the cross-slot K/V scatter was ~35%
+        of the whole [64, 4] 8B dispatch (microbench r5: 234 -> 153 ms
+        with the per-row-DUS identity path), and one [n_slots, bucket]
+        shape replaces the {1, 8, 64}-row variant zoo. Non-member rows
+        park their K/V write beyond the valid prefix, exactly like
+        decode's inactive rows.
 
         Slot bookkeeping that later dispatches read (n_past,
         cache_tokens) advances HERE — device execution order equals
@@ -1620,10 +1684,16 @@ class LLMEngine:
         harvest."""
         cap = self._prefill_group_cap(bucket)
         group = group[:cap]
-        B = 1
-        while B < len(group):
-            B *= 8
-        B = min(B, cap)
+        identity = bucket * self.n_slots <= self._PREFILL_GROUP_TOKENS
+        if identity:
+            B = self.n_slots
+            rows = [s.idx for s in group]
+        else:
+            B = 1
+            while B < len(group):
+                B *= 8
+            B = min(B, cap)
+            rows = list(range(len(group)))
         t0 = time.perf_counter()
         W = self.sampling.window
         toks = np.zeros((B, bucket), np.int32)
@@ -1632,7 +1702,11 @@ class LLMEngine:
         n_chunk = np.ones((B,), np.int32)
         tails = np.zeros((B, W), np.int32)
         tail_lens = np.zeros((B,), np.int32)
-        for r, s in enumerate(group):
+        # identity non-member rows stay at pos0 == 0 with a no-op write
+        # (write_mask False re-writes what is already there), so their
+        # prefixes survive untouched and the window below is free to
+        # follow the members' live context
+        for r, s in zip(rows, group):
             req = s.request
             chunk = req.prompt_ids[s.n_past:]
             toks[r, : len(chunk)] = chunk
@@ -1643,29 +1717,50 @@ class LLMEngine:
             tails[r, : len(tail)] = tail
             tail_lens[r] = len(tail)
         masks = self._constraint_mask_rows(group)
-        if masks is not None and B > len(group):
-            masks = np.vstack(
-                [masks, np.ones((B - len(group), masks.shape[1]), bool)])
+        if masks is not None:
+            full = np.ones((B, masks.shape[1]), bool)
+            for r, m in zip(rows, masks):
+                full[r] = m
+            masks = full
+        if identity:
+            # window follows the MEMBERS' live context (parked rows are
+            # no-op writes at pos 0, so they place no demand on it):
+            # 1024 -> 256 on a fresh wave cuts the dispatch's attention
+            # traffic 4x. Prefer an already-compiled window >= need —
+            # max_seq is always warmed, so nothing compiles mid-request.
+            need = max(int(pos0[r]) for r in rows) + bucket + 1
+            window = self._window_bucket(need)
+            compiled = [k[1] for k in self._decode_k_fns
+                        if k[0] == "prefill_final" and len(k) > 2
+                        and k[2] and window <= k[1]]
+            if compiled:
+                window = min(compiled)
+            else:
+                window = self.max_seq
+        else:
+            window = self.max_seq
         toks_out = self._run("prefill_final", {
             "toks": toks, "pos0": pos0, "slot_ids": slot_ids,
             "n_chunk": n_chunk, "tails": tails, "tail_lens": tail_lens,
             "masks": masks,
-            "reset": self._reset_columns(group, B),
-            "soft": self._soft_payload(group, pos0, bucket),
-            "window": self.max_seq,
+            "reset": self._reset_columns(group, B, rows),
+            "soft": self._soft_payload(group, pos0, bucket, rows),
+            "window": window,
+            "identity": identity,
         })
         try:
             toks_out.copy_to_host_async()
         except Exception:
             pass  # not all backends expose it; harvest still works
-        for r, s in enumerate(group):
-            ln = int(n_chunk[r])
-            s.cache_tokens.extend(s.request.prompt_ids[s.n_past:s.n_past + ln])
-            s.n_past += ln
+        for s in group:
+            req = s.request
+            chunk_len = len(req.prompt_ids) - s.n_past
+            s.cache_tokens.extend(req.prompt_ids[s.n_past:])
+            s.n_past += chunk_len
             s.state = SlotState.PENDING_FIRST
         self._flights.append(_Flight(
             kind="prefill_final", arrays=[toks_out],
-            meta={"pairs": [(s, s.request) for s in group]},
+            meta={"pairs": [(s, s.request) for s in group], "rows": rows},
             t_enqueue=t0,
         ))
 
@@ -1675,7 +1770,8 @@ class LLMEngine:
         toks_host = np.asarray(fl.arrays[0])
         dt_ms = (time.perf_counter() - fl.t_enqueue) * 1e3
         now = time.perf_counter()
-        for r, (s, req) in enumerate(fl.meta["pairs"]):
+        rows = fl.meta.get("rows") or range(len(fl.meta["pairs"]))
+        for r, (s, req) in zip(rows, fl.meta["pairs"]):
             if s.request is not req:  # cancelled mid-flight
                 continue
             s.t_prefill_ms += dt_ms
@@ -1686,13 +1782,16 @@ class LLMEngine:
             self._emit_token(s, int(toks_host[r]))
 
     def _soft_payload(self, group: list[_Slot], pos0: Any,
-                      bucket: int) -> Optional[list]:
+                      bucket: int,
+                      rows: Optional[list[int]] = None) -> Optional[list]:
         """Compact multimodal rows for a prefill dispatch: [(batch row,
         chunk-relative positions, embeds [k, D])] for every slot whose
         soft tokens fall inside this chunk; None when text-only (the
-        common case pays nothing)."""
-        rows = []
-        for r, s in enumerate(group):
+        common case pays nothing). ``rows`` maps group member i to its
+        batch row (identity dispatches); default: leading rows."""
+        out = []
+        for i, s in enumerate(group):
+            r = rows[i] if rows is not None else i
             req = s.request
             if req is None or req.soft_embeds is None:
                 continue
@@ -1700,10 +1799,10 @@ class LLMEngine:
             sel = (sp >= int(pos0[r])) & (sp < int(pos0[r]) + bucket)
             if not sel.any():
                 continue
-            rows.append((r, (sp[sel] - int(pos0[r])).astype(np.int32),
-                         np.asarray(req.soft_embeds)[sel]
-                         .astype(np.float32)))
-        return rows or None
+            out.append((r, (sp[sel] - int(pos0[r])).astype(np.int32),
+                        np.asarray(req.soft_embeds)[sel]
+                        .astype(np.float32)))
+        return out or None
 
     def _soft_dense(self, rows: Optional[list], B: int,
                     T: int) -> Optional[tuple]:
@@ -1833,14 +1932,37 @@ class LLMEngine:
             if not decoding:
                 return True
         now = time.perf_counter()
+        # a prefill flight serving MORE waiters than there are decoders
+        # counts as a burst even after the arrival window lapses: the
+        # flight's ~200ms round trip outlives the 0.15s freshness test,
+        # and a decode scan slipping into that gap queues ~450ms of
+        # device work between the flight and its harvest detection —
+        # measured r5: the 63-slot gathered group's observed latency
+        # went 497ms with scans trailing it vs 174ms clean. In steady
+        # state (decoders >> waiters) decode proceeds: holding every
+        # scan behind each lone arrival's prefill would halve
+        # throughput under continuous load.
+        waiting = sum(1 for s in self.slots
+                      if s.state in (SlotState.PREFILL,
+                                     SlotState.PENDING_FIRST))
+        gathering = (
+            waiting > len(decoding)
+            and any(f.kind == "prefill_final" for f in self._flights))
         burst = bool(self._pending) or now - self._last_arrival < 0.15
-        if burst and any(not s.active for s in self.slots):
-            # an admission burst is landing: hold decode enqueues so the
-            # burst's prefill groups pipeline back-to-back on the device
-            # instead of each queueing behind hundreds of ms of scan
-            # work — under a 64-stream HTTP wave this is the difference
-            # between ~0.4 s and ~1.7 s p50 TTFT. Bounded from the
-            # hold's START so a steady trickle cannot starve decode.
+        if gathering or (burst and any(not s.active
+                                       or s.state is SlotState.PREFILL
+                                       for s in self.slots)):
+            # an admission burst is landing (free slots await requests,
+            # or assigned slots await their prefill — a gathered group
+            # held behind an in-flight prefill counts: r5 flight traces
+            # showed a 23-slot group queueing behind 900 ms of decode
+            # scans that slipped in the moment every slot was assigned):
+            # hold decode enqueues so the burst's prefill groups
+            # pipeline back-to-back on the device instead of each
+            # queueing behind hundreds of ms of scan work — under a
+            # 64-stream HTTP wave this is the difference between ~0.4 s
+            # and ~1.7 s p50 TTFT. Bounded from the hold's START so a
+            # steady trickle cannot starve decode.
             if self._hold_start == 0.0:
                 self._hold_start = now
             if now - self._hold_start < 0.5:

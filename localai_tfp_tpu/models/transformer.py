@@ -553,6 +553,12 @@ def forward_hidden(
     # Caller contract: mesh has a nontrivial "seq" axis, every row's
     # pos0 is 0 (the chunk attends only to itself), no sliding window,
     # and T divides the seq axis.
+    write_mask: Optional[jax.Array] = None,  # [B] bool (identity path
+    # only): rows where False RE-WRITE the cache content already at
+    # their write positions — a no-op write. Lets a full-slot-batch
+    # identity prefill park non-member rows at pos 0 without corrupting
+    # their live prefixes, which in turn lets the dispatch window follow
+    # the MEMBER rows' live context instead of max_seq.
 ) -> tuple[jax.Array, KVCache]:
     """Run the stack up to (and including) the final norm; returns
     (hidden [B, T, D], updated cache). The LM head lives in ``forward``;
@@ -703,6 +709,30 @@ def forward_hidden(
                 # hot path: per-row dynamic_update_slice, no gather/scatter
                 # (a cross-slot scatter would copy the whole cache layer
                 # every decode step — ~GBs/step at serving shapes)
+                if write_mask is not None:
+                    # masked rows write back what is already there: the
+                    # [B, T, F] read is tiny next to the layer traffic
+                    def cur_row(buf_row, off):
+                        return lax.dynamic_slice(
+                            buf_row, (off, 0), (kq.shape[1], kq.shape[2]))
+
+                    def cur_scale(srow, off):
+                        return lax.dynamic_slice(srow, (off,),
+                                                 (kq.shape[1],))
+
+                    m3 = write_mask[:, None, None]
+                    kq = jnp.where(
+                        m3, kq.astype(ck.dtype),
+                        jax.vmap(cur_row)(ck, pos0))
+                    vq = jnp.where(
+                        m3, vq.astype(cv.dtype),
+                        jax.vmap(cur_row)(cv, pos0))
+                    if quant:
+                        m2 = write_mask[:, None]
+                        ksc = jnp.where(m2, ksc,
+                                        jax.vmap(cur_scale)(ks, pos0))
+                        vsc = jnp.where(m2, vsc,
+                                        jax.vmap(cur_scale)(vs, pos0))
                 ck2 = jax.vmap(one_row)(ck, kq, pos0)
                 cv2 = jax.vmap(one_row)(cv, vq, pos0)
                 if quant:
