@@ -465,14 +465,12 @@ def _heads_for(spec: UNetSpec, block_idx: int) -> int:
     return int(ahd)
 
 
-def unet_forward(spec: UNetSpec, tree: dict, x: jax.Array, t: jax.Array,
-                 context: jax.Array,
-                 added: Optional[tuple] = None) -> jax.Array:
-    """x [B, h, w, in_channels] latents; t [B]; context [B, Tc, d_cond];
-    ``added`` = (pooled text_embeds [B, P], time_ids [B, 6]) for SDXL's
-    "text_time" added conditioning. Returns the predicted noise/v
-    [B, h, w, in_channels]."""
-    g = spec.norm_num_groups
+def _unet_temb(spec: UNetSpec, tree: dict, t: jax.Array,
+               added: Optional[tuple]) -> jax.Array:
+    """Shared time conditioning: sinusoidal timestep MLP plus SDXL
+    "text_time" added conditioning. One implementation for the UNet and
+    the ControlNet tower (the side network re-runs the identical
+    embedding on its own weights)."""
     temb = _timestep_embedding(t, spec.block_out_channels[0])
     temb = _linear(_g(tree, "time_embedding.linear_1"), temb)
     temb = _linear(_g(tree, "time_embedding.linear_2"), jax.nn.silu(temb))
@@ -486,8 +484,15 @@ def unet_forward(spec: UNetSpec, tree: dict, x: jax.Array, t: jax.Array,
         aug = _linear(_g(tree, "add_embedding.linear_1"), aug)
         aug = _linear(_g(tree, "add_embedding.linear_2"), jax.nn.silu(aug))
         temb = temb + aug
+    return temb
 
-    h = _conv(_g(tree, "conv_in"), x)
+
+def _down_tower(spec: UNetSpec, tree: dict, h: jax.Array, temb: jax.Array,
+                context: jax.Array) -> tuple[jax.Array, list]:
+    """Shared down-blocks walk from the post-conv_in hidden ``h``:
+    returns (bottom hidden, skips — conv_in output first, then every
+    layer/downsampler output, the order diffusers' residual lists use)."""
+    g = spec.norm_num_groups
     skips = [h]
     for bi, btype in enumerate(spec.down_block_types):
         blk = _g(tree, f"down_blocks.{bi}")
@@ -501,7 +506,12 @@ def unet_forward(spec: UNetSpec, tree: dict, x: jax.Array, t: jax.Array,
         if "downsamplers" in blk:
             h = _conv(blk["downsamplers"]["0"]["conv"], h, stride=2)
             skips.append(h)
+    return h, skips
 
+
+def _mid_block(spec: UNetSpec, tree: dict, h: jax.Array, temb: jax.Array,
+               context: jax.Array) -> jax.Array:
+    g = spec.norm_num_groups
     mid = _g(tree, "mid_block")
     h = _resnet(mid["resnets"]["0"], h, temb, g)
     if "attentions" in mid:
@@ -509,7 +519,35 @@ def unet_forward(spec: UNetSpec, tree: dict, x: jax.Array, t: jax.Array,
                                  _heads_for(spec,
                                             len(spec.block_out_channels)
                                             - 1), g)
-    h = _resnet(mid["resnets"]["1"], h, temb, g)
+    return _resnet(mid["resnets"]["1"], h, temb, g)
+
+
+def unet_forward(spec: UNetSpec, tree: dict, x: jax.Array, t: jax.Array,
+                 context: jax.Array,
+                 added: Optional[tuple] = None,
+                 ctrl: Optional[tuple] = None) -> jax.Array:
+    """x [B, h, w, in_channels] latents; t [B]; context [B, Tc, d_cond];
+    ``added`` = (pooled text_embeds [B, P], time_ids [B, 6]) for SDXL's
+    "text_time" added conditioning; ``ctrl`` = (down residuals — one per
+    skip, in skip order — and the mid residual) from controlnet_forward.
+    Returns the predicted noise/v [B, h, w, in_channels]."""
+    g = spec.norm_num_groups
+    temb = _unet_temb(spec, tree, t, added)
+    h = _conv(_g(tree, "conv_in"), x)
+    h, skips = _down_tower(spec, tree, h, temb, context)
+
+    if ctrl is not None:
+        # ControlNet conditioning: per-skip residuals summed into the
+        # down path, mid residual after the mid block (ref: diffusers
+        # UNet2DConditionModel.forward down/mid_block_additional_
+        # residuals; reference attaches the net at
+        # backend/python/diffusers/backend.py:239-241)
+        down_res, mid_res = ctrl
+        skips = [s + r for s, r in zip(skips, down_res)]
+
+    h = _mid_block(spec, tree, h, temb, context)
+    if ctrl is not None:
+        h = h + mid_res
 
     for bi, btype in enumerate(spec.up_block_types):
         blk = _g(tree, f"up_blocks.{bi}")
@@ -527,6 +565,43 @@ def unet_forward(spec: UNetSpec, tree: dict, x: jax.Array, t: jax.Array,
 
     h = jax.nn.silu(_group_norm(_g(tree, "conv_norm_out"), h, g))
     return _conv(_g(tree, "conv_out"), h)
+
+
+def controlnet_forward(spec: UNetSpec, tree: dict, x: jax.Array,
+                       t: jax.Array, context: jax.Array, cond: jax.Array,
+                       scale: jax.Array,
+                       added: Optional[tuple] = None) -> tuple:
+    """ControlNet side network (diffusers ControlNetModel layout): the
+    UNet's down+mid path re-run with the conditioning image folded in
+    after conv_in, each skip tapped through a zero-initialised 1x1
+    "controlnet" conv. Returns (down residuals tuple, mid residual), all
+    scaled by ``scale`` — consumed by unet_forward(ctrl=...). ``cond``
+    is the FULL-RESOLUTION conditioning image [B, H, W, 3] in [0, 1]
+    (diffusers prepare_image convention: no [-1,1] normalisation).
+    ref: backend/python/diffusers/backend.py:239-241 attaches the model;
+    the block math mirrors diffusers ControlNetModel.forward."""
+    temb = _unet_temb(spec, tree, t, added)
+
+    # conditioning embedding: conv_in -> silu -> (block, silu)* ->
+    # zero-init conv_out, downsampling the image to latent resolution
+    ce = _g(tree, "controlnet_cond_embedding")
+    e = jax.nn.silu(_conv(ce["conv_in"], cond))
+    blocks = ce["blocks"]
+    for i in range(len(blocks)):
+        # odd blocks stride-2 (channel_in->channel_out pairs)
+        e = jax.nn.silu(_conv(blocks[str(i)], e, stride=2 if i % 2 else 1))
+    e = _conv(ce["conv_out"], e)
+
+    h = _conv(_g(tree, "conv_in"), x) + e
+    h, skips = _down_tower(spec, tree, h, temb, context)
+    h = _mid_block(spec, tree, h, temb, context)
+
+    taps = _g(tree, "controlnet_down_blocks")
+    down_res = tuple(
+        _conv(taps[str(i)], s) * scale for i, s in enumerate(skips)
+    )
+    mid_res = _conv(_g(tree, "controlnet_mid_block"), h) * scale
+    return down_res, mid_res
 
 
 # ---------------------------------------------------------------------------
@@ -660,6 +735,33 @@ class SDPipeline:
     tokenizer_2: Any = None
     force_zeros_for_empty_prompt: bool = True  # SDXL model_index flag:
     # empty negative prompt -> ZERO uncond embeddings, not CLIP("")
+    # ControlNet side network (attach_controlnet; ref: diffusers
+    # backend.py:239-242 `pipe.controlnet = ControlNetModel...`)
+    control_spec: Optional[UNetSpec] = None
+    control_tree: dict = field(default_factory=dict)
+
+    def attach_controlnet(self, path: str) -> None:
+        """Load a diffusers-layout ControlNetModel directory (config.json
+        + safetensors) as this pipeline's conditioning side network."""
+        tree, cfg = load_component_tree(path)
+        if "controlnet_cond_embedding" not in tree:
+            raise ValueError(
+                f"{path} is not a ControlNetModel checkpoint "
+                "(no controlnet_cond_embedding keys)")
+        spec = unet_spec_from_config(cfg)
+        # the residuals are summed skip-for-skip into the UNet's down
+        # path — a net built for a different architecture would zip-
+        # truncate into corrupt conditioning; fail fast instead
+        for f in ("block_out_channels", "down_block_types",
+                  "layers_per_block", "cross_attention_dim",
+                  "in_channels"):
+            if getattr(spec, f) != getattr(self.unet_spec, f):
+                raise ValueError(
+                    f"ControlNet at {path} does not match this UNet: "
+                    f"{f}={getattr(spec, f)!r} vs "
+                    f"{getattr(self.unet_spec, f)!r}")
+        self.control_tree = tree
+        self.control_spec = spec
 
     @property
     def is_xl(self) -> bool:
@@ -757,12 +859,16 @@ class SDPipeline:
                  guidance: float = 7.5,
                  seed: Optional[int] = None,
                  init_image: Optional[np.ndarray] = None,
-                 strength: float = 0.5) -> np.ndarray:
+                 strength: float = 0.5,
+                 control_image: Optional[np.ndarray] = None,
+                 control_scale: float = 1.0) -> np.ndarray:
         """Returns a [height, width, 3] uint8 image. ``init_image``
         ([H, W, 3] uint8) switches to img2img: the image is VAE-encoded,
         renoised to ``strength`` (0..1, fraction of the schedule re-run)
         and denoised — the frame-chaining primitive behind /video (ref:
-        diffusers img2img pipelines; backend.py GenerateVideo)."""
+        diffusers img2img pipelines; backend.py GenerateVideo).
+        ``control_image`` ([H, W, 3] uint8) conditions every UNet step
+        through the attached ControlNet (requires attach_controlnet)."""
         # the latent grid must survive the UNet's downsamples
         snap = self.vae_scale * (2 ** (len(
             self.unet_spec.block_out_channels) - 1))
@@ -826,11 +932,29 @@ class SDPipeline:
             noise = jax.random.normal(rng, z0.shape, jnp.float32)
             x = jnp.sqrt(a0) * z0 + jnp.sqrt(1.0 - a0) * noise
         else:
-            x = jax.random.normal(rng, lat_shape, jnp.float32)
+            x = jnp.asarray(jax.random.normal(rng, lat_shape, jnp.float32))
+        control = None
+        if control_image is not None:
+            if self.control_spec is None:
+                raise ValueError(
+                    "control image given but no ControlNet is attached "
+                    "(set diffusers.control_net in the model yaml)")
+            ci = jnp.asarray(control_image, jnp.float32) / 255.0  # [0, 1]
+            if ci.ndim == 3:
+                ci = ci[None]
+            if ci.shape[1:3] != (height, width):
+                ci = jax.image.resize(
+                    ci, (ci.shape[0], height, width, ci.shape[3]),
+                    "bilinear")
+            # same image for both guidance halves [uncond | cond]
+            control = (self.control_tree,
+                       jnp.concatenate([ci, ci], axis=0),
+                       jnp.float32(control_scale))
         img = _sd_sample_jit(
             self.unet_spec, self.unet_tree, self.vae_tree,
             _freeze(self.vae_cfg), x, ctx, added, ts, alphas, final_alpha,
             float(guidance), bool(v_pred),
+            self.control_spec if control is not None else None, control,
         )
         arr = np.asarray(img[0])
         return ((arr + 1.0) * 127.5).clip(0, 255).astype(np.uint8)
@@ -844,13 +968,17 @@ def _freeze(cfg: dict) -> tuple:
     ))
 
 
-@partial(jax.jit, static_argnums=(0, 3, 10, 11))
+@partial(jax.jit, static_argnums=(0, 3, 10, 11, 12))
 def _sd_sample_jit(unet_spec: UNetSpec, unet_tree: dict, vae_tree: dict,
                    vae_cfg_frozen: tuple, x: jax.Array, ctx: jax.Array,
                    added: Optional[tuple],
                    ts: jax.Array, alphas: jax.Array, final_alpha: jax.Array,
-                   guidance: float, v_pred: bool) -> jax.Array:
-    """Full guided DDIM loop + VAE decode in one compiled program."""
+                   guidance: float, v_pred: bool,
+                   control_spec: Optional[UNetSpec] = None,
+                   control: Optional[tuple] = None) -> jax.Array:
+    """Full guided DDIM loop + VAE decode in one compiled program.
+    ``control`` = (control_tree, cond image [2, H, W, 3], scale) runs the
+    ControlNet side network inside every denoise step."""
     vae_cfg = {k: (list(v) if isinstance(v, tuple) else v)
                for k, v in vae_cfg_frozen}
     steps = ts.shape[0]
@@ -862,7 +990,12 @@ def _sd_sample_jit(unet_spec: UNetSpec, unet_tree: dict, vae_tree: dict,
         a_prev = jnp.where(i + 1 < steps, alphas[t_prev], final_alpha)
         xx = jnp.concatenate([x, x], axis=0)  # [uncond | cond]
         tb = jnp.full((2,), t, jnp.int32)
-        out = unet_forward(unet_spec, unet_tree, xx, tb, ctx, added)
+        ctrl = None
+        if control_spec is not None:
+            ctree, ccond, cscale = control
+            ctrl = controlnet_forward(control_spec, ctree, xx, tb, ctx,
+                                      ccond, cscale, added)
+        out = unet_forward(unet_spec, unet_tree, xx, tb, ctx, added, ctrl)
         out_u, out_c = out[:1], out[1:]
         out = out_u + guidance * (out_c - out_u)
         if v_pred:  # v = sqrt(a) eps - sqrt(1-a) x0
@@ -940,6 +1073,16 @@ def consumed_keys_check(pipe: SDPipeline, prompt: str = "x") -> dict:
                  jnp.zeros((1,), jnp.int32), cond, added)
     report["unet"] = [k for k in tree_keys(pipe.unet_tree)
                       if k not in seen]
+
+    if pipe.control_spec is not None:
+        seen = set()
+        controlnet_forward(
+            pipe.control_spec, _RecDict(pipe.control_tree, "", seen),
+            lat, jnp.zeros((1,), jnp.int32), cond,
+            jnp.zeros((1, snap, snap, 3), jnp.float32),
+            jnp.float32(1.0), added)
+        report["controlnet"] = [k for k in tree_keys(pipe.control_tree)
+                                if k not in seen]
 
     seen = set()
     vae_decode(_RecDict(pipe.vae_tree, "", seen), pipe.vae_cfg, lat)

@@ -112,6 +112,30 @@ async def speech(request: web.Request) -> web.Response:
     return web.FileResponse(dst)
 
 
+def _payload_to_tempfile(payload, field: str, prefix: str) -> str:
+    """Request-embedded image (base64 or a data: URL) -> a PRIVATE temp
+    path for the backend src contract (ref: endpoints/openai/image.go
+    :82-124, localai/video.go:82-124 write the decoded bytes to a temp
+    file). Private matters: generated_content_dir is served publicly at
+    /generated-images, and client uploads must never be. Returns "" when
+    the field is absent; callers unlink the path when done."""
+    if not payload:
+        return ""
+    text = str(payload)
+    if text.startswith("data:"):
+        text = text.partition(",")[2]
+    try:
+        raw = base64.b64decode(text)
+    except Exception:
+        raise web.HTTPBadRequest(reason=f"'{field}' is not valid base64")
+    import tempfile
+
+    fd, path = tempfile.mkstemp(prefix=prefix)
+    with os.fdopen(fd, "wb") as f:
+        f.write(raw)
+    return path
+
+
 async def images(request: web.Request) -> web.Response:
     """OpenAI /v1/images/generations; b64_json or url response formats
     (ref: endpoints/openai/image.go — url serves from generated dir)."""
@@ -124,50 +148,79 @@ async def images(request: web.Request) -> web.Response:
     except ValueError:
         raise web.HTTPBadRequest(reason=f"invalid size '{size}'")
     n = int(body.get("n") or 1)
+    # img2img init / ControlNet conditioning image (ref:
+    # endpoints/openai/image.go:82-124)
+    src = _payload_to_tempfile(body.get("file"), "file", "img-src-")
     data = []
-    for _ in range(n):
-        fname = f"img-{uuid.uuid4().hex}.png"
-        dst = os.path.join(st.config.generated_content_dir, fname)
+    try:
+        for _ in range(n):
+            fname = f"img-{uuid.uuid4().hex}.png"
+            dst = os.path.join(st.config.generated_content_dir, fname)
 
-        def call(dst=dst):
-            with busy(st, cfg.name):
-                return backend.generate_image(
-                    prompt=body.get("prompt", ""),
-                    negative_prompt=body.get("negative_prompt", ""),
-                    width=w, height=h, dst=dst,
-                    step=int(body.get("step") or 0) or None,
-                    seed=body.get("seed"),
-                )
+            def call(dst=dst):
+                with busy(st, cfg.name):
+                    return backend.generate_image(
+                        prompt=body.get("prompt", ""),
+                        negative_prompt=body.get("negative_prompt", ""),
+                        width=w, height=h, dst=dst,
+                        step=int(body.get("step") or 0) or None,
+                        seed=body.get("seed"), src=src,
+                    )
 
-        res = await _run(call)
-        if not res.success:
-            raise web.HTTPInternalServerError(reason=res.message)
-        if (body.get("response_format") or "url") == "b64_json":
-            with open(dst, "rb") as f:
-                data.append({"b64_json": base64.b64encode(f.read()).decode()})
-        else:
-            data.append({"url": f"/generated-images/{fname}"})
+            res = await _run(call)
+            if not res.success:
+                raise web.HTTPInternalServerError(reason=res.message)
+            if (body.get("response_format") or "url") == "b64_json":
+                with open(dst, "rb") as f:
+                    data.append(
+                        {"b64_json": base64.b64encode(f.read()).decode()})
+            else:
+                data.append({"url": f"/generated-images/{fname}"})
+    finally:
+        if src:
+            try:
+                os.unlink(src)
+            except OSError:
+                pass
     import time as _time
 
     return web.json_response({"created": int(_time.time()), "data": data})
 
 
 async def video(request: web.Request) -> web.Response:
-    """ref: routes/localai.go:64 POST /video; endpoints/localai/video.go."""
+    """ref: routes/localai.go:64 POST /video; endpoints/localai/video.go
+    — VideoRequest carries prompt/start_image/width/height/num_frames/
+    fps/seed; start_image (base64 or data: URL) is written to a private
+    temp path and handed to the backend as src (the reference's
+    StartImage temp-file contract, video.go:82-124)."""
     body = await request.json()
     st = _state(request)
     cfg, backend = await _load(request, body.get("model"), Usecase.VIDEO)
     fname = f"video-{uuid.uuid4().hex}.mp4"
     dst = os.path.join(st.config.generated_content_dir, fname)
+    src = _payload_to_tempfile(body.get("start_image"), "start_image",
+                               "video-src-")
 
     def call():
         with busy(st, cfg.name):
             return backend.generate_video(
                 prompt=body.get("prompt", ""), dst=dst,
                 num_frames=int(body.get("num_frames") or 0) or None,
+                src=src,
+                width=int(body.get("width") or 0),
+                height=int(body.get("height") or 0),
+                fps=int(body.get("fps") or 0) or 8,
+                seed=body.get("seed"),
             )
 
-    res = await _run(call)
+    try:
+        res = await _run(call)
+    finally:
+        if src:
+            try:
+                os.unlink(src)
+            except OSError:
+                pass
     if not res.success:
         raise web.HTTPInternalServerError(reason=res.message)
     return web.json_response({"url": f"/generated-videos/{fname}"})

@@ -35,6 +35,16 @@ from .base import Backend, ModelLoadOptions, Result, StatusResponse
 COND_LEN = 64
 
 
+def _read_image(path: str) -> np.ndarray:
+    """Decode an on-disk image to [H, W, 3] uint8 (the src contract of
+    GenerateImage — ref: endpoints/openai/image.go writes the request's
+    base64 `file` to a temp path and hands backends the path)."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        return np.asarray(im.convert("RGB"), np.uint8)
+
+
 def write_png(path: str, img: np.ndarray) -> None:
     """Minimal dependency-free PNG writer. img: [H, W, 3] uint8."""
     h, w, _ = img.shape
@@ -83,20 +93,11 @@ class JaxDiffusionBackend(Backend):
                         and not os.path.isabs(model_dir):
                     model_dir = os.path.join(opts.model_path or "",
                                              model_dir)
-                if (opts.extra.get("control_net")
-                        or opts.extra.get("controlnet")):
-                    # conditioning side-network not implemented yet
-                    # (PARITY.md ControlNet gap entry) — fail loudly,
-                    # never silently ignore the requested conditioning.
-                    # Covers the canonical diffusers.control_net key
-                    # (forwarded by the loader) and top-level spellings.
-                    self._state = "ERROR"
-                    return Result(
-                        False,
-                        "controlnet conditioning is not supported yet "
-                        "(see the ControlNet entry in PARITY.md's known "
-                        "gaps); remove `control_net` from the model "
-                        "yaml")
+                control_net = (opts.extra.get("control_net")
+                               or opts.extra.get("controlnet") or "")
+                if control_net and not os.path.isabs(control_net):
+                    control_net = os.path.join(opts.model_path or "",
+                                               control_net)
                 if model_dir and os.path.exists(
                         os.path.join(model_dir, "model_index.json")):
                     # pipeline-class switch (ref: diffusers backend.py
@@ -104,6 +105,25 @@ class JaxDiffusionBackend(Backend):
                     from ..models.mmdit import pipeline_class_name
 
                     cls_name = pipeline_class_name(model_dir)
+                    if control_net and cls_name.startswith(
+                            ("StableDiffusion3", "Flux",
+                             "StableVideoDiffusion")):
+                        # the side network targets the 2D UNet skip
+                        # topology (MMDiT and the SVD spatio-temporal
+                        # UNet have none) — reject rather than silently
+                        # ignore the requested conditioning
+                        self._state = "ERROR"
+                        return Result(
+                            False, "control_net is only supported for "
+                            "UNet pipelines (SD 1.x/2.x/SDXL), not "
+                            f"{cls_name}")
+                    if cls_name.startswith("StableVideoDiffusion"):
+                        # real image-to-video (ref: backend.py:175-177)
+                        from ..models.svd import SVDPipeline
+
+                        self._sd = SVDPipeline.load(model_dir)
+                        self._state = "READY"
+                        return Result(True, "svd pipeline ready")
                     if cls_name.startswith("StableDiffusion3"):
                         from ..models.mmdit import SD3Pipeline
 
@@ -119,6 +139,10 @@ class JaxDiffusionBackend(Backend):
                     from ..models.sd import SDPipeline, merge_sd_lora
 
                     self._sd = SDPipeline.load(model_dir)
+                    if control_net:
+                        # ref: backend/python/diffusers/backend.py
+                        # :239-242 pipe.controlnet = ControlNetModel...
+                        self._sd.attach_controlnet(control_net)
                     # image LoRAs fold into the loaded weights (ref:
                     # diffusers backend.py:245-252 load_lora_weights)
                     n_patched = 0
@@ -151,6 +175,14 @@ class JaxDiffusionBackend(Backend):
                         "checkpoint directory (no model_index.json); "
                         "the random-init pipeline is a test fixture — "
                         "request it explicitly with model: __random__"))
+                if control_net:
+                    # never silently drop requested conditioning (the
+                    # toy fixture has no UNet skips to condition)
+                    self._state = "ERROR"
+                    return Result(False, (
+                        "control_net requires a diffusers-format UNet "
+                        "checkpoint; the random test fixture cannot "
+                        "honor it"))
                 # explicit test fixture: random-init toy pipeline
                 from ..ops.decode_attention import _interpret
 
@@ -236,47 +268,119 @@ class JaxDiffusionBackend(Backend):
     def generate_image(self, prompt: str = "", negative_prompt: str = "",
                        width: int = 256, height: int = 256, dst: str = "",
                        step: Optional[int] = None, seed=None,
-                       **kw) -> Result:
+                       src: str = "", **kw) -> Result:
         if self._state != "READY":
             return Result(False, "model not loaded")
-        img = self._sample(prompt, negative_prompt, width, height, step, seed)
+        from ..models.svd import SVDPipeline
+
+        if isinstance(self._sd, SVDPipeline):
+            return Result(
+                False, "this model is an image-to-video pipeline "
+                "(StableVideoDiffusion); use /video with start_image")
+        if src and self._sd is not None \
+                and getattr(self._sd, "control_spec", None) is not None:
+            # a source image on a ControlNet pipeline is the
+            # conditioning image, not an img2img init (ref: diffusers
+            # backend.py:309-312 controlnet + request.src)
+            img = self._sd.generate(
+                prompt, negative_prompt=negative_prompt,
+                height=height, width=width, steps=step or self._steps,
+                guidance=self._guidance if self._guidance is not None
+                else 7.5,
+                seed=seed, control_image=_read_image(src),
+            )
+        elif src:
+            img = self._sample(prompt, negative_prompt, width, height,
+                               step, seed, init=_read_image(src))
+        else:
+            img = self._sample(prompt, negative_prompt, width, height,
+                               step, seed)
         write_png(dst, img)
         return Result(True, dst)
 
     def generate_video(self, prompt: str = "", dst: str = "",
-                       num_frames: Optional[int] = None, **kw) -> Result:
-        """Temporally-coherent frame sequence: frame 0 is a txt2img
-        sample, every later frame is img2img-chained from its
-        predecessor (encode previous frame, renoise to ~0.45 strength,
-        denoise) — so consecutive frames evolve instead of re-rolling
+                       num_frames: Optional[int] = None, src: str = "",
+                       width: int = 0, height: int = 0,
+                       fps: int = 8, seed=None, step: Optional[int] = None,
+                       **kw) -> Result:
+        """Video generation. With a StableVideoDiffusionPipeline loaded
+        (diffusers model_index class — ref: backend.py:175-177), ``src``
+        (the request's start_image) drives the REAL image-to-video
+        model: one temporally-attending UNet pass over all frames.
+        Otherwise the frame-chaining fallback: frame 0 is a txt2img
+        sample, every later frame img2img-chained from its predecessor
         (ref: diffusers GenerateVideo; core/backend/video.go). Muxed to
-        mp4 via ffmpeg when available (ref utils/ffmpeg.go)."""
+        mp4 via ffmpeg; frames are staged in a scratch dir removed on
+        success (kept only on the no-ffmpeg poster fallback, plus under
+        LOCALAI_KEEP_FRAMES=1 for tests)."""
         if self._state != "READY":
             return Result(False, "model not loaded")
+        import shutil
         import subprocess
+
+        from ..models.svd import SVDPipeline
 
         n = num_frames or 8
         frames_dir = dst + ".frames"
         os.makedirs(frames_dir, exist_ok=True)
         paths = []
-        prev: Optional[np.ndarray] = None
-        for i in range(n):
-            img = self._sample(prompt, "", 128, 128, None, seed=i,
-                               init=prev, strength=0.45)
-            prev = img
-            p = os.path.join(frames_dir, f"f{i:04d}.png")
-            write_png(p, img)
-            paths.append(p)
+        if isinstance(self._sd, SVDPipeline):
+            if not src:
+                return Result(
+                    False, "StableVideoDiffusion is image-to-video: "
+                    "the request needs a start_image")
+            frames = self._sd.generate(
+                _read_image(src), num_frames=n, height=height,
+                width=width, steps=step or self._steps, fps=fps,
+                seed=seed,
+            )
+            for i in range(frames.shape[0]):
+                p = os.path.join(frames_dir, f"f{i:04d}.png")
+                write_png(p, frames[i])
+                paths.append(p)
+        else:
+            if src and self._sd is None:
+                return Result(
+                    False, "start_image video needs a diffusers "
+                    "checkpoint (SVD for true img2vid, or an SD "
+                    "pipeline for frame chaining)")
+            prev: Optional[np.ndarray] = (
+                _read_image(src) if src else None)
+            base_seed = seed if seed is not None else 0
+            for i in range(n):
+                img = self._sample(prompt, "", width or 128,
+                                   height or 128, step,
+                                   seed=base_seed + i,
+                                   init=prev, strength=0.45)
+                prev = img
+                p = os.path.join(frames_dir, f"f{i:04d}.png")
+                write_png(p, img)
+                paths.append(p)
+        keep = os.environ.get("LOCALAI_KEEP_FRAMES", "") not in ("", "0")
         try:
             subprocess.run(
-                ["ffmpeg", "-y", "-framerate", "8", "-i",
+                ["ffmpeg", "-y", "-framerate", str(fps or 8), "-i",
                  os.path.join(frames_dir, "f%04d.png"), "-pix_fmt",
                  "yuv420p", dst],
                 capture_output=True, check=True,
             )
-        except (OSError, subprocess.CalledProcessError):
-            # no ffmpeg: ship the first frame as a poster + keep frames dir
-            import shutil
-
+            if not keep:  # scratch frames removed on success (ref:
+                # pkg/utils/ffmpeg.go cleans its temp inputs)
+                shutil.rmtree(frames_dir, ignore_errors=True)
+        except OSError as e:
+            # typed, operator-visible condition: ffmpeg missing (or not
+            # executable) — ship the first frame as a poster and KEEP
+            # the frames
             shutil.copy(paths[0], dst)
+            why = ("not installed" if isinstance(e, FileNotFoundError)
+                   else f"unavailable: {e}")
+            return Result(
+                True, f"{dst} (ffmpeg {why}: wrote the first frame as "
+                f"a poster; raw frames kept in {frames_dir})")
+        except subprocess.CalledProcessError as e:
+            shutil.copy(paths[0], dst)
+            return Result(
+                True, f"{dst} (ffmpeg failed: "
+                f"{e.stderr.decode(errors='replace')[-200:]}; wrote "
+                f"poster; raw frames kept in {frames_dir})")
         return Result(True, dst)

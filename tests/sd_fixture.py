@@ -329,3 +329,266 @@ def build_pipeline_xl(root: str) -> str:
             "scheduler": ["diffusers", "DDIMScheduler"],
         }, f)
     return root
+
+
+def build_controlnet(dirpath: str, zero_taps: bool = True) -> None:
+    """Tiny diffusers-schema ControlNetModel matching build_unet's
+    geometry: the UNet down+mid tower, a conditioning embedding that
+    downsamples the image by vae_scale (x2 here), and one 1x1 tap conv
+    per skip + mid. ``zero_taps`` mirrors real checkpoints' zero-init
+    (a freshly-initialised ControlNet is an exact no-op)."""
+    os.makedirs(dirpath, exist_ok=True)
+    t: dict[str, np.ndarray] = {}
+    _conv(t, "conv_in", C[0], LAT)
+    _lin(t, "time_embedding.linear_1", TEMB, C[0])
+    _lin(t, "time_embedding.linear_2", TEMB, TEMB)
+    # conditioning embedding: 3 -> 16 -> (16->16 s1, 16->32 s2) -> C0
+    CE = (16, 32)
+    _conv(t, "controlnet_cond_embedding.conv_in", CE[0], 3)
+    _conv(t, "controlnet_cond_embedding.blocks.0", CE[0], CE[0])
+    _conv(t, "controlnet_cond_embedding.blocks.1", CE[1], CE[0])
+    _conv(t, "controlnet_cond_embedding.conv_out", C[0], CE[1])
+    # down+mid tower (same schema as build_unet's down path)
+    _resnet(t, "down_blocks.0.resnets.0", C[0], C[0])
+    _attn_block(t, "down_blocks.0.attentions.0", C[0], D_COND)
+    _conv(t, "down_blocks.0.downsamplers.0.conv", C[0], C[0])
+    _resnet(t, "down_blocks.1.resnets.0", C[0], C[1])
+    _resnet(t, "mid_block.resnets.0", C[1], C[1])
+    _attn_block(t, "mid_block.attentions.0", C[1], D_COND)
+    _resnet(t, "mid_block.resnets.1", C[1], C[1])
+    # taps: one 1x1 conv per skip [conv_in, d0.res0, d0.down, d1.res0]
+    for i, c in enumerate((C[0], C[0], C[0], C[1])):
+        _conv(t, f"controlnet_down_blocks.{i}", c, c, k=1)
+    _conv(t, "controlnet_mid_block", C[1], C[1], k=1)
+    if zero_taps:
+        for k in list(t):
+            if (k.startswith("controlnet_down_blocks")
+                    or k.startswith("controlnet_mid_block")
+                    or k.startswith(
+                        "controlnet_cond_embedding.conv_out")):
+                t[k] = np.zeros_like(t[k])
+    from safetensors.numpy import save_file
+
+    save_file(t, os.path.join(dirpath, "diffusion_pytorch_model.safetensors"))
+    with open(os.path.join(dirpath, "config.json"), "w") as f:
+        json.dump({
+            "_class_name": "ControlNetModel",
+            "block_out_channels": list(C),
+            "down_block_types": ["CrossAttnDownBlock2D", "DownBlock2D"],
+            "layers_per_block": 1,
+            "attention_head_dim": 2,
+            "cross_attention_dim": D_COND,
+            "in_channels": LAT,
+            "norm_num_groups": GROUPS,
+            "conditioning_embedding_out_channels": [16, 32],
+        }, f)
+
+
+# ------------------------------------------------------------------- SVD
+
+SVD_C = (16, 32)  # UNet block channels
+SVD_TEMB = 64
+SVD_CROSS = 16  # CLIP projection dim == cross-attention dim
+SVD_ADD = 4  # addition_time_embed_dim (3 ids -> 12 input)
+
+
+def _conv3d_frames(t, name, cout, cin):
+    t[f"{name}.weight"] = _w(cout, cin, 3, 1, 1)
+    t[f"{name}.bias"] = np.zeros((cout,), np.float32)
+
+
+def _temporal_resnet_keys(t, name, cin, cout, temb=SVD_TEMB):
+    _norm(t, f"{name}.norm1", cin)
+    _conv3d_frames(t, f"{name}.conv1", cout, cin)
+    if temb:
+        _lin(t, f"{name}.time_emb_proj", cout, temb)
+    _norm(t, f"{name}.norm2", cout)
+    _conv3d_frames(t, f"{name}.conv2", cout, cout)
+
+
+def _st_resnet_keys(t, name, cin, cout, temb=SVD_TEMB):
+    _resnet(t, f"{name}.spatial_res_block", cin, cout, temb=temb)
+    _temporal_resnet_keys(t, f"{name}.temporal_res_block", cout, cout,
+                          temb=temb)
+    t[f"{name}.time_mixer.mix_factor"] = np.asarray(0.5, np.float32)
+
+
+def _tblock_keys(t, b, c, d_cond):
+    for n in ("norm1", "norm2", "norm3"):
+        _norm(t, f"{b}.{n}", c)
+    for attn, kv in (("attn1", c), ("attn2", d_cond)):
+        _lin(t, f"{b}.{attn}.to_q", c, c, bias=False)
+        _lin(t, f"{b}.{attn}.to_k", c, kv, bias=False)
+        _lin(t, f"{b}.{attn}.to_v", c, kv, bias=False)
+        _lin(t, f"{b}.{attn}.to_out.0", c, c)
+    inner = 4 * c
+    _lin(t, f"{b}.ff.net.0.proj", 2 * inner, c)  # GEGLU
+    _lin(t, f"{b}.ff.net.2", c, inner)
+
+
+def _st_transformer_keys(t, name, c, d_cond):
+    _norm(t, f"{name}.norm", c)
+    _lin(t, f"{name}.proj_in", c, c)
+    _tblock_keys(t, f"{name}.transformer_blocks.0", c, d_cond)
+    b = f"{name}.temporal_transformer_blocks.0"
+    _norm(t, f"{b}.norm_in", c)
+    inner = 4 * c
+    _lin(t, f"{b}.ff_in.net.0.proj", 2 * inner, c)
+    _lin(t, f"{b}.ff_in.net.2", c, inner)
+    _tblock_keys(t, b, c, d_cond)
+    _lin(t, f"{name}.time_pos_embed.linear_1", 4 * c, c)
+    _lin(t, f"{name}.time_pos_embed.linear_2", c, 4 * c)
+    t[f"{name}.time_mixer.mix_factor"] = np.asarray(0.5, np.float32)
+    _lin(t, f"{name}.proj_out", c, c)
+
+
+def build_svd_unet(dirpath: str) -> None:
+    """Tiny UNetSpatioTemporalConditionModel in the diffusers schema."""
+    os.makedirs(dirpath, exist_ok=True)
+    C0, C1 = SVD_C
+    t: dict[str, np.ndarray] = {}
+    _conv(t, "conv_in", C0, 8)
+    _lin(t, "time_embedding.linear_1", SVD_TEMB, C0)
+    _lin(t, "time_embedding.linear_2", SVD_TEMB, SVD_TEMB)
+    _lin(t, "add_embedding.linear_1", SVD_TEMB, 3 * SVD_ADD)
+    _lin(t, "add_embedding.linear_2", SVD_TEMB, SVD_TEMB)
+    # down 0: CrossAttn (C0) + downsampler
+    _st_resnet_keys(t, "down_blocks.0.resnets.0", C0, C0)
+    _st_transformer_keys(t, "down_blocks.0.attentions.0", C0, SVD_CROSS)
+    _conv(t, "down_blocks.0.downsamplers.0.conv", C0, C0)
+    # down 1: plain (C1), no downsampler
+    _st_resnet_keys(t, "down_blocks.1.resnets.0", C0, C1)
+    # mid
+    _st_resnet_keys(t, "mid_block.resnets.0", C1, C1)
+    _st_transformer_keys(t, "mid_block.attentions.0", C1, SVD_CROSS)
+    _st_resnet_keys(t, "mid_block.resnets.1", C1, C1)
+    # up 0: plain; skips [d1.res0(C1), d0.down(C0)]
+    _st_resnet_keys(t, "up_blocks.0.resnets.0", C1 + C1, C1)
+    _st_resnet_keys(t, "up_blocks.0.resnets.1", C1 + C0, C1)
+    _conv(t, "up_blocks.0.upsamplers.0.conv", C1, C1)
+    # up 1: CrossAttn; skips [d0.res0(C0), conv_in(C0)]
+    _st_resnet_keys(t, "up_blocks.1.resnets.0", C1 + C0, C0)
+    _st_transformer_keys(t, "up_blocks.1.attentions.0", C0, SVD_CROSS)
+    _st_resnet_keys(t, "up_blocks.1.resnets.1", C0 + C0, C0)
+    _st_transformer_keys(t, "up_blocks.1.attentions.1", C0, SVD_CROSS)
+    _norm(t, "conv_norm_out", C0)
+    _conv(t, "conv_out", 4, C0)
+    from safetensors.numpy import save_file
+
+    save_file(t, os.path.join(dirpath, "diffusion_pytorch_model.safetensors"))
+    with open(os.path.join(dirpath, "config.json"), "w") as f:
+        json.dump({
+            "_class_name": "UNetSpatioTemporalConditionModel",
+            "block_out_channels": list(SVD_C),
+            "down_block_types": ["CrossAttnDownBlockSpatioTemporal",
+                                 "DownBlockSpatioTemporal"],
+            "up_block_types": ["UpBlockSpatioTemporal",
+                               "CrossAttnUpBlockSpatioTemporal"],
+            "layers_per_block": 1,
+            "num_attention_heads": 2,
+            "cross_attention_dim": SVD_CROSS,
+            "in_channels": 8, "out_channels": 4,
+            "addition_time_embed_dim": SVD_ADD,
+            "projection_class_embeddings_input_dim": 3 * SVD_ADD,
+            "norm_num_groups": GROUPS,
+        }, f)
+
+
+def build_svd_vae(dirpath: str) -> None:
+    """Tiny AutoencoderKLTemporalDecoder: standard KL encoder +
+    spatio-temporal decoder with a final frame-axis conv."""
+    os.makedirs(dirpath, exist_ok=True)
+    t: dict[str, np.ndarray] = {}
+    # encoder: same schema build_vae uses
+    _conv(t, "quant_conv", 2 * LAT, 2 * LAT, k=1)
+    _conv(t, "encoder.conv_in", VAE_C[0], 3)
+    _resnet(t, "encoder.down_blocks.0.resnets.0", VAE_C[0], VAE_C[0],
+            temb=0)
+    _conv(t, "encoder.down_blocks.0.downsamplers.0.conv", VAE_C[0],
+          VAE_C[0])
+    _resnet(t, "encoder.down_blocks.1.resnets.0", VAE_C[0], VAE_C[1],
+            temb=0)
+    top = VAE_C[-1]
+    _resnet(t, "encoder.mid_block.resnets.0", top, top, temb=0)
+    _norm(t, "encoder.mid_block.attentions.0.group_norm", top)
+    _lin(t, "encoder.mid_block.attentions.0.to_q", top, top)
+    _lin(t, "encoder.mid_block.attentions.0.to_k", top, top)
+    _lin(t, "encoder.mid_block.attentions.0.to_v", top, top)
+    _lin(t, "encoder.mid_block.attentions.0.to_out.0", top, top)
+    _resnet(t, "encoder.mid_block.resnets.1", top, top, temb=0)
+    _norm(t, "encoder.conv_norm_out", top)
+    _conv(t, "encoder.conv_out", 2 * LAT, top)
+    # temporal decoder
+    _conv(t, "decoder.conv_in", top, LAT)
+    _st_resnet_keys(t, "decoder.mid_block.resnets.0", top, top, temb=0)
+    _norm(t, "decoder.mid_block.attentions.0.group_norm", top)
+    _lin(t, "decoder.mid_block.attentions.0.to_q", top, top)
+    _lin(t, "decoder.mid_block.attentions.0.to_k", top, top)
+    _lin(t, "decoder.mid_block.attentions.0.to_v", top, top)
+    _lin(t, "decoder.mid_block.attentions.0.to_out.0", top, top)
+    _st_resnet_keys(t, "decoder.mid_block.resnets.1", top, top, temb=0)
+    _st_resnet_keys(t, "decoder.up_blocks.0.resnets.0", top, top, temb=0)
+    _st_resnet_keys(t, "decoder.up_blocks.0.resnets.1", top, top, temb=0)
+    _conv(t, "decoder.up_blocks.0.upsamplers.0.conv", top, top)
+    _st_resnet_keys(t, "decoder.up_blocks.1.resnets.0", top, VAE_C[0],
+                    temb=0)
+    _st_resnet_keys(t, "decoder.up_blocks.1.resnets.1", VAE_C[0],
+                    VAE_C[0], temb=0)
+    _norm(t, "decoder.conv_norm_out", VAE_C[0])
+    _conv(t, "decoder.conv_out", 3, VAE_C[0])
+    _conv3d_frames(t, "time_conv_out", 3, 3)
+    from safetensors.numpy import save_file
+
+    save_file(t, os.path.join(dirpath, "diffusion_pytorch_model.safetensors"))
+    with open(os.path.join(dirpath, "config.json"), "w") as f:
+        json.dump({
+            "_class_name": "AutoencoderKLTemporalDecoder",
+            "block_out_channels": list(VAE_C),
+            "latent_channels": LAT,
+            "norm_num_groups": GROUPS,
+            "scaling_factor": 0.18215,
+        }, f)
+
+
+def build_svd_image_encoder(dirpath: str) -> None:
+    """REAL tiny transformers CLIPVisionModelWithProjection — the
+    torch-parity reference for SVDPipeline._encode_image_clip."""
+    import torch
+    from transformers import CLIPVisionConfig, CLIPVisionModelWithProjection
+
+    torch.manual_seed(2)
+    cfg = CLIPVisionConfig(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=2, image_size=32, patch_size=8,
+        projection_dim=SVD_CROSS, hidden_act="quick_gelu",
+    )
+    CLIPVisionModelWithProjection(cfg).save_pretrained(
+        dirpath, safe_serialization=True)
+
+
+def build_svd_pipeline(root: str) -> str:
+    """Tiny StableVideoDiffusionPipeline directory; returns root."""
+    os.makedirs(root, exist_ok=True)
+    build_svd_unet(os.path.join(root, "unet"))
+    build_svd_vae(os.path.join(root, "vae"))
+    build_svd_image_encoder(os.path.join(root, "image_encoder"))
+    os.makedirs(os.path.join(root, "scheduler"), exist_ok=True)
+    with open(os.path.join(root, "scheduler",
+                           "scheduler_config.json"), "w") as f:
+        json.dump({
+            "_class_name": "EulerDiscreteScheduler",
+            "prediction_type": "v_prediction",
+            "sigma_min": 0.002, "sigma_max": 700.0,
+            "use_karras_sigmas": True,
+            "timestep_type": "continuous",
+        }, f)
+    with open(os.path.join(root, "model_index.json"), "w") as f:
+        json.dump({
+            "_class_name": "StableVideoDiffusionPipeline",
+            "unet": ["diffusers", "UNetSpatioTemporalConditionModel"],
+            "vae": ["diffusers", "AutoencoderKLTemporalDecoder"],
+            "image_encoder": ["transformers",
+                              "CLIPVisionModelWithProjection"],
+            "scheduler": ["diffusers", "EulerDiscreteScheduler"],
+        }, f)
+    return root

@@ -3,6 +3,8 @@ directory schema at toy sizes), CLIP golden parity vs transformers, and
 end-to-end generation (ref: backend/python/diffusers/backend.py
 :139-272 LoadModel, :304-350 GenerateImage)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -276,3 +278,76 @@ def test_lora_merge_kohya_naming(pipe_dir, tmp_path):
             "transformer_blocks"]["0"]["attn1"]["to_k"]["weight"])
     want = before + ((up @ down) * (4.0 / 2)).T
     np.testing.assert_allclose(after, want, rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------------- ControlNet
+
+
+@pytest.fixture(scope="module")
+def cn_zero_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("cn") / "controlnet")
+    sd_fixture.build_controlnet(d, zero_taps=True)
+    return d
+
+
+@pytest.fixture(scope="module")
+def cn_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("cn2") / "controlnet")
+    sd_fixture.build_controlnet(d, zero_taps=False)
+    return d
+
+
+def test_controlnet_zero_init_is_noop(pipe_dir, cn_zero_dir):
+    """A freshly-initialised ControlNet (zero tap convs — diffusers
+    zero_module init) must leave generation EXACTLY unchanged: the
+    residual path is additive (ref: diffusers ControlNetModel init;
+    backend.py:239-241)."""
+    base = SDPipeline.load(pipe_dir)
+    want = base.generate("x", height=16, width=16, steps=2, seed=5)
+    base.attach_controlnet(cn_zero_dir)
+    cond = np.full((16, 16, 3), 128, np.uint8)
+    got = base.generate("x", height=16, width=16, steps=2, seed=5,
+                        control_image=cond)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_controlnet_conditions_output(pipe_dir, cn_dir):
+    """Non-zero taps: the conditioning image steers the output, and
+    different cond images give different images (the residuals carry
+    image information, not just bias)."""
+    p = SDPipeline.load(pipe_dir)
+    plain = p.generate("x", height=16, width=16, steps=2, seed=5)
+    p.attach_controlnet(cn_dir)
+    a = p.generate("x", height=16, width=16, steps=2, seed=5,
+                   control_image=np.zeros((16, 16, 3), np.uint8))
+    b = p.generate("x", height=16, width=16, steps=2, seed=5,
+                   control_image=np.full((16, 16, 3), 255, np.uint8))
+    assert not np.array_equal(a, plain)
+    assert not np.array_equal(a, b)
+    # scale=0 disables conditioning entirely
+    off = p.generate("x", height=16, width=16, steps=2, seed=5,
+                     control_image=np.zeros((16, 16, 3), np.uint8),
+                     control_scale=0.0)
+    np.testing.assert_array_equal(off, plain)
+
+
+def test_controlnet_all_keys_consumed(pipe_dir, cn_dir):
+    """Every tensor in the ControlNet checkpoint must be read by
+    controlnet_forward — the same schema-wiring completeness check the
+    other components get."""
+    p = SDPipeline.load(pipe_dir)
+    p.attach_controlnet(cn_dir)
+    report = consumed_keys_check(p)
+    assert report["controlnet"] == [], report["controlnet"]
+
+
+def test_controlnet_rejects_non_controlnet_dir(pipe_dir):
+    p = SDPipeline.load(pipe_dir)
+    with pytest.raises(ValueError, match="ControlNet"):
+        p.attach_controlnet(os.path.join(pipe_dir, "unet"))
+
+
+def test_control_image_without_attachment_raises(pipe):
+    with pytest.raises(ValueError, match="no ControlNet"):
+        pipe.generate("x", height=16, width=16, steps=1,
+                      control_image=np.zeros((16, 16, 3), np.uint8))
