@@ -1,0 +1,169 @@
+"""OuteTTS-class LLM-driven text-to-speech.
+
+Capability counterpart of the reference's ``type: OuteTTS`` TTS path
+(ref: backend/python/transformers/backend.py:205-233 builds an
+``outetts.InterfaceHF`` over an HF causal LM; :509-527 generates speech
+from it). The OuteTTS recipe: a llama-family LLM whose vocabulary
+includes per-frame AUDIO CODE tokens; text goes in as a prompt, the LM
+autoregressively emits code tokens, and a neural codec decodes them to
+a waveform. Speaker identity is a transcript + its code sequence
+prepended to the prompt (voice cloning by in-context example).
+
+This implementation runs the audio LM through the SAME continuous-
+batching LLMEngine the chat path uses (the reference drives HF
+``generate``; here TTS inherits batching, async dispatch and the
+compiled decode path for free) and decodes with the EnCodec-class SEANet
+decoder shared with bark (models/bark.py — HF EncodecModel layout; the
+model directory carries it under ``codec/``). Code tokens are recovered
+from the vocabulary strings (``<|c_123|>`` / ``<|123|>`` spellings), so
+any OuteTTS-style vocabulary works without a hardcoded id table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+# matches <|c_123|> and <|123|> but not <|t_0.23|> / <|text_end|>
+_CODE_RE = re.compile(r"^<\|(?:c_)?(\d+)\|>$")
+
+
+@dataclass
+class OuteTTSModel:
+    engine: Any
+    tokenizer: Any
+    codec: dict
+    ratios: list
+    model_dir: str = ""
+    sample_rate: int = 24000
+    n_q: int = 1  # codebooks per frame in the token stream
+    code_ids: dict = field(default_factory=dict)  # token id -> code
+    special: dict = field(default_factory=dict)  # name -> token string
+
+    @classmethod
+    def load(cls, model_dir: str, dtype=jnp.bfloat16,
+             n_slots: int = 2) -> "OuteTTSModel":
+        from ..engine.engine import LLMEngine
+        from ..engine.tokenizer import load_tokenizer
+        from .bark import load_encodec_decoder
+        from .hf_loader import load_hf_state, load_params
+
+        codec_dir = os.path.join(model_dir, "codec")
+        if not os.path.isdir(codec_dir):
+            raise ValueError(
+                f"{model_dir} has no codec/ directory (EnCodec-layout "
+                "audio codec) — an OuteTTS-class model needs one to "
+                "decode its audio tokens")
+        sd: dict = {}
+        for fname in sorted(os.listdir(codec_dir)):
+            if fname.endswith(".safetensors"):
+                from safetensors import safe_open
+
+                with safe_open(os.path.join(codec_dir, fname),
+                               framework="np") as f:
+                    for key in f.keys():
+                        sd[key] = f.get_tensor(key)
+        codec = load_encodec_decoder(sd, prefix="")
+        with open(os.path.join(codec_dir, "config.json")) as f:
+            ccfg = json.load(f)
+        state = load_hf_state(model_dir)
+        spec, params = load_params(model_dir, dtype=dtype, state=state)
+        tok = load_tokenizer(model_dir)
+        engine = LLMEngine(spec, params, tok, n_slots=n_slots,
+                           max_seq=min(spec.max_position, 4096),
+                           cache_dtype=dtype)
+        # audio-code token table from the vocabulary strings
+        code_ids: dict[int, int] = {}
+        vocab = tok._tk.get_vocab() if hasattr(tok, "_tk") else {}
+        for token, tid in vocab.items():
+            m = _CODE_RE.match(token)
+            if m:
+                code_ids[tid] = int(m.group(1))
+        if not code_ids:
+            raise ValueError(
+                "tokenizer has no audio code tokens (<|c_N|>/<|N|>) — "
+                "not an OuteTTS-class vocabulary")
+        return cls(
+            engine=engine, tokenizer=tok, codec=codec,
+            ratios=list(ccfg.get("upsampling_ratios", [8, 5, 4, 2])),
+            model_dir=model_dir,
+            sample_rate=int(ccfg.get("sampling_rate", 24000)),
+            code_ids=code_ids,
+        )
+
+    def _prompt(self, text: str, speaker: Optional[dict]) -> str:
+        parts = ["<|im_start|>\n"]
+        if speaker:
+            parts.append(str(speaker.get("text", "")).strip() + " ")
+        parts.append(text.strip())
+        parts.append("<|text_end|>\n<|audio_start|>\n")
+        if speaker:
+            parts.extend(f"<|c_{int(c)}|>"
+                         for c in speaker.get("codes", []))
+        return "".join(parts)
+
+    def synthesize(self, text: str, speaker: Optional[dict] = None,
+                   temperature: float = 0.4, seed: Optional[int] = 0,
+                   max_tokens: int = 1024) -> np.ndarray:
+        """text -> waveform [samples] f32. The LM emits code tokens
+        until <|audio_end|>/EOS or the budget; non-code tokens are
+        skipped (the reference's interface tolerates them the same
+        way)."""
+        from ..engine.engine import GenRequest
+
+        ids = self.tokenizer.encode(self._prompt(text, speaker),
+                                    add_bos=True)
+        q = self.engine.submit(GenRequest(
+            prompt_ids=ids, max_tokens=max_tokens,
+            temperature=temperature, top_k=64, top_p=1.0, seed=seed,
+            ignore_eos=False,
+        ))
+        out_ids: list[int] = []
+        while True:
+            ev = q.get()
+            if ev.token_id is not None:
+                out_ids.append(ev.token_id)
+            if ev.done:
+                if ev.error:
+                    raise RuntimeError(
+                        f"audio LM generation failed: {ev.error}")
+                break
+        codes = [self.code_ids[t] for t in out_ids if t in self.code_ids]
+        if not codes:
+            # a content-free generation must be audible as an error,
+            # not silence of plausible length
+            raise RuntimeError(
+                "model generated no audio code tokens for this prompt")
+        n_q = max(1, self.n_q)
+        frames = len(codes) // n_q
+        arr = np.asarray(codes[: frames * n_q],
+                         np.int32).reshape(frames, n_q).T
+        from .bark import encodec_decode
+
+        return np.asarray(encodec_decode(self.codec, jnp.asarray(arr),
+                                         self.ratios))
+
+    def close(self) -> None:
+        if self.engine is not None:
+            self.engine.close()
+
+
+def load_speaker(path: str) -> dict:
+    """OuteTTS speaker profile json: {"text": ..., "codes": [...]} (flat)
+    or the word-granular {"words": [{"word", "codes"}]} layout."""
+    with open(path) as f:
+        data = json.load(f)
+    if "words" in data and "codes" not in data:
+        data = {
+            "text": " ".join(w.get("word", "") for w in data["words"]),
+            "codes": [c for w in data["words"]
+                      for c in w.get("codes", [])],
+        }
+    return data

@@ -66,6 +66,8 @@ class JaxLLMBackend(Backend):
         self.vision: Any = None
         self._quantized = False  # int8 weight-only serving mode
         self.mamba: Any = None  # (MambaSpec, params) — SSM family
+        self.rwkv: Any = None  # (RwkvSpec, params) — RWKV recurrent
+        # family (ref fixture tests/models_fixtures/rwkv.yaml)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -124,8 +126,23 @@ class JaxLLMBackend(Backend):
                 # route reachable (predict() dispatches on self.mamba
                 # first — same invariant tts.py keeps for its slots)
                 self.mamba = None
+                self.rwkv = None
                 dtype = _DTYPES.get((opts.dtype or "bfloat16").lower(),
                                     jnp.bfloat16)
+                # quantized loads STAGE ON HOST CPU: the full-precision
+                # tree of an 8B model (~16 GB bf16) ResourceExhausts a
+                # 16 GB chip before quantization could halve it, so the
+                # checkpoint loads + LoRA-merges + quantizes on host and
+                # only the int8 tree ships to the accelerator (caught by
+                # the bench's disk-loaded 8B leg, r5)
+                import contextlib
+
+                will_quant = quant in ("int8", "q8", "q8_0", "w8",
+                                       "int8_full")
+
+                def staged():
+                    return (jax.default_device(jax.devices("cpu")[0])
+                            if will_quant else contextlib.nullcontext())
                 if is_gguf:
                     # GGUF: dequantize-on-load (ref: the reference's
                     # primary format — initializers.go:498-559); the
@@ -137,14 +154,29 @@ class JaxLLMBackend(Backend):
 
                     hf_state = None
                     gf = GGUFFile(model_dir)
-                    self.spec, params = load_gguf_params(
-                        model_dir, dtype=dtype, gf=gf)
+                    with staged():
+                        self.spec, params = load_gguf_params(
+                            model_dir, dtype=dtype, gf=gf)
                 else:
                     from ..models.hf_loader import load_hf_state
 
                     hf_state = load_hf_state(model_dir)
                     from ..models.mamba import is_mamba_config
+                    from ..models.rwkv import is_rwkv_config
 
+                    if is_rwkv_config(hf_state[0]):
+                        # RWKV: recurrent generate path like mamba (no
+                        # KV cache; ref serves RWKV via llama.cpp —
+                        # tests/models_fixtures/rwkv.yaml)
+                        from ..models.rwkv import load_rwkv
+
+                        if self.engine is not None:
+                            self.engine.close()
+                            self.engine = None
+                        self.rwkv = load_rwkv(model_dir, dtype=dtype)
+                        self.tokenizer = load_tokenizer(model_dir)
+                        self._state = "READY"
+                        return Result(True, "rwkv model loaded")
                     if is_mamba_config(hf_state[0]):
                         # SSM family (ref: transformers backend
                         # MambaForCausalLM, backend.py:24,248): no KV
@@ -159,21 +191,24 @@ class JaxLLMBackend(Backend):
                         self.tokenizer = load_tokenizer(model_dir)
                         self._state = "READY"
                         return Result(True, "mamba model loaded")
-                    self.spec, params = load_params(
-                        model_dir, dtype=dtype, state=hf_state)
+                    with staged():
+                        self.spec, params = load_params(
+                            model_dir, dtype=dtype, state=hf_state)
                 # merge LoRA adapters at load (ref: llama.cpp LoRA apply
                 # via LoadModel — proto LoraAdapter/LoraScale)
-                for i, adir in enumerate(opts.lora_adapters):
-                    if not os.path.isabs(adir):
-                        adir = os.path.join(opts.model_path or "", adir)
-                    # an explicit 0.0 scale disables the adapter; only a
-                    # MISSING entry defaults to 1.0
-                    scale = (float(opts.lora_scales[i])
-                             if i < len(opts.lora_scales) else 1.0)
-                    if scale == 0.0:
-                        continue
-                    params, n = merge_lora(self.spec, params, adir,
-                                           scale=scale)
+                with staged():
+                    for i, adir in enumerate(opts.lora_adapters):
+                        if not os.path.isabs(adir):
+                            adir = os.path.join(opts.model_path or "",
+                                                adir)
+                        # an explicit 0.0 scale disables the adapter;
+                        # only a MISSING entry defaults to 1.0
+                        scale = (float(opts.lora_scales[i])
+                                 if i < len(opts.lora_scales) else 1.0)
+                        if scale == 0.0:
+                            continue
+                        params, n = merge_lora(self.spec, params, adir,
+                                               scale=scale)
                 if is_gguf:
                     # no silent raw-byte fallback: a 128k-vocab model
                     # with a broken embedded vocab must fail the load
@@ -203,17 +238,28 @@ class JaxLLMBackend(Backend):
                     (opts.kv_cache_dtype or opts.dtype or "bfloat16").lower(),
                     dtype,
                 )
-                self._quantized = quant in ("int8", "q8", "q8_0", "w8",
-                                            "int8_full")
+                self._quantized = will_quant  # ONE predicate: staging
+                # and quantization must agree (host-committed params
+                # with no quantize, or device-committed full-precision
+                # 8B, are both failure modes)
                 if self._quantized:
                     # AFTER LoRA merge: adapters fold into full-precision
                     # weights first, then the projections quantize.
                     # int8_full also quantizes embed/lm_head (~2 GB on an
-                    # 8B — the batch-64-on-one-chip mode)
+                    # 8B — the batch-64-on-one-chip mode). Runs inside
+                    # the host staging (see staged()); only the int8
+                    # tree then ships to the accelerator.
                     from ..models.quant import quantize_params
 
-                    params = quantize_params(
-                        params, embeddings=quant == "int8_full")
+                    with staged():
+                        params = quantize_params(
+                            params, embeddings=quant == "int8_full")
+                        params = jax.block_until_ready(params)
+                    if opts.mesh:
+                        pass  # shard_params places shards itself
+                    else:
+                        params = jax.device_put(
+                            params, jax.devices()[0])
                 mesh = None
                 if opts.mesh:
                     from ..parallel.mesh import make_mesh
@@ -415,12 +461,17 @@ class JaxLLMBackend(Backend):
         if self.engine is not None:
             self.engine.cancel(request_id)
 
-    def _mamba_reply(self, opts: PredictOptions) -> Reply:
+    def _recurrent_reply(self, opts: PredictOptions) -> Reply:
         import time as _time
 
-        from ..models.mamba import generate
+        if self.rwkv is not None:
+            from ..models.rwkv import generate
 
-        spec, params = self.mamba
+            spec, params = self.rwkv
+        else:
+            from ..models.mamba import generate
+
+            spec, params = self.mamba
         ids = self.tokenizer.encode(opts.prompt, add_bos=True)
         t0 = _time.perf_counter()
         eos = next(iter(getattr(self.tokenizer, "eos_ids", []) or []),
@@ -449,8 +500,8 @@ class JaxLLMBackend(Backend):
         )
 
     def predict(self, opts: PredictOptions) -> Reply:
-        if self.mamba is not None:
-            return self._mamba_reply(opts)
+        if self.mamba is not None or self.rwkv is not None:
+            return self._recurrent_reply(opts)
         if self.engine is None:
             return Reply(error="model not loaded")
         ev = self.engine.generate(self._to_request(opts))
@@ -462,16 +513,17 @@ class JaxLLMBackend(Backend):
         every stream instead of a parked thread per stream. None for
         the non-engine paths (mamba / unloaded), which stream via the
         plain generator."""
-        if self.engine is None or self.mamba is not None:
+        if self.engine is None or self.mamba is not None \
+                or self.rwkv is not None:
             return None
         return self.engine.submit(self._to_request(opts))
 
     def predict_stream(self, opts: PredictOptions) -> Iterator[Reply]:
-        if self.mamba is not None:
+        if self.mamba is not None or self.rwkv is not None:
             # the recurrent generate is one device dispatch; stream the
             # text then the final (the reference's HF path has the same
             # whole-reply granularity for SSM models)
-            r = self._mamba_reply(opts)
+            r = self._recurrent_reply(opts)
             if r.message and not r.error:
                 yield Reply(message=r.message)
             yield r

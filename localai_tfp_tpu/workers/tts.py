@@ -154,6 +154,9 @@ class JaxTTSBackend(Backend):
         # dispatches on whichever slot is non-None)
         self._vits = self._musicgen = self._bark = self._kokoro = None
         self._xtts = None
+        if getattr(self, "_outetts", None) is not None:
+            self._outetts.close()
+        self._outetts = None
         self._bark_opts = {}
         model_dir = opts.model
         if model_dir and not os.path.isabs(model_dir):
@@ -164,6 +167,19 @@ class JaxTTSBackend(Backend):
 
             mtype = ""
             try:
+                want_oute = str(opts.extra.get("type", "")
+                                ).lower() == "outetts"
+                if want_oute or os.path.isdir(
+                        os.path.join(model_dir, "codec")):
+                    # LLM-driven TTS (ref: transformers backend
+                    # type==OuteTTS, backend.py:205-233): a causal LM
+                    # with audio-code tokens + an EnCodec-layout codec
+                    from ..models.outetts import OuteTTSModel
+
+                    mtype = "outetts"
+                    self._outetts = OuteTTSModel.load(model_dir)
+                    self._state = "READY"
+                    return Result(True, "outetts ready")
                 from ..models.kokoro import is_kokoro_dir
 
                 if is_kokoro_dir(model_dir):
@@ -223,6 +239,17 @@ class JaxTTSBackend(Backend):
     def health(self) -> bool:
         return self._state == "READY"
 
+    def shutdown(self) -> None:
+        # the OuteTTS family owns a live LLMEngine (scheduler thread +
+        # device KV cache) — unload must reclaim it, or model swaps
+        # accumulate engines until the device OOMs
+        if getattr(self, "_outetts", None) is not None:
+            self._outetts.close()
+            self._outetts = None
+        self._vits = self._musicgen = self._bark = self._kokoro = None
+        self._xtts = None
+        self._state = "UNINITIALIZED"
+
     def status(self) -> StatusResponse:
         return StatusResponse(state=self._state)
 
@@ -237,6 +264,27 @@ class JaxTTSBackend(Backend):
 
     def tts(self, text: str, voice: str = "", dst: str = "",
             language: str = "") -> Result:
+        if getattr(self, "_outetts", None) is not None:
+            from ..models.outetts import load_speaker
+
+            speaker = None
+            if voice:
+                vpath = voice if os.path.isabs(voice) else os.path.join(
+                    self._outetts.model_dir, voice)
+                if os.path.exists(vpath):
+                    speaker = load_speaker(vpath)
+                elif os.path.exists(voice):
+                    speaker = load_speaker(voice)
+                else:
+                    return Result(
+                        False, f"outetts speaker profile not found: "
+                               f"{voice!r} (a json with text + codes)")
+            try:
+                audio = self._outetts.synthesize(text, speaker=speaker)
+            except RuntimeError as e:
+                return Result(False, str(e))
+            write_wav(dst, audio, sr=self._outetts.sample_rate)
+            return Result(True, dst)
         if getattr(self, "_xtts", None) is not None:
             from ..models.xtts import synthesize
 

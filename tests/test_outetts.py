@@ -1,0 +1,147 @@
+"""OuteTTS-class LLM TTS: audio-code generation through the real
+engine + EnCodec decode, speaker-profile conditioning, worker
+integration (VERDICT r4 missing #5; ref:
+backend/python/transformers/backend.py:205-233, :509-527)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def oute_dir(tmp_path_factory):
+    """Tiny OuteTTS-style model dir: llama LM whose vocab is mostly
+    audio-code tokens, plus an EnCodec-layout codec/ subdir."""
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import WhitespaceSplit
+    from transformers import (EncodecConfig, EncodecModel, LlamaConfig,
+                              LlamaForCausalLM, PreTrainedTokenizerFast)
+
+    d = str(tmp_path_factory.mktemp("oute") / "model")
+    os.makedirs(d)
+    vocab = {"<unk>": 0, "<s>": 1, "</s>": 2, "<|im_start|>": 3,
+             "<|text_end|>": 4, "<|audio_start|>": 5,
+             "<|audio_end|>": 6, "<|t_0.50|>": 7}
+    for w in ("hello", "world", "speak", "test", "a", "b"):
+        vocab[w] = len(vocab)
+    n_codes = 64
+    for c in range(n_codes):
+        vocab[f"<|c_{c}|>"] = len(vocab)
+    tk = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+    tk.pre_tokenizer = WhitespaceSplit()
+    fast = PreTrainedTokenizerFast(
+        tokenizer_object=tk, bos_token="<s>", eos_token="</s>",
+        unk_token="<unk>")
+    fast.save_pretrained(d)
+
+    torch.manual_seed(0)
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=len(vocab), hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=512,
+    )).save_pretrained(d, safe_serialization=True)
+
+    torch.manual_seed(1)
+    codec = EncodecModel(EncodecConfig(
+        # bandwidth chosen so n_q = bw*1000 / (frame_rate * bits) = 1
+        # (frame_rate 24000/8 = 3000, bits = log2(64) = 6)
+        target_bandwidths=[18.0], sampling_rate=24000,
+        audio_channels=1, num_filters=8, num_residual_layers=1,
+        upsampling_ratios=[4, 2], codebook_size=n_codes,
+        codebook_dim=16, hidden_size=16, num_lstm_layers=1,
+        kernel_size=3, last_kernel_size=3, residual_kernel_size=3,
+    ))
+    codec.save_pretrained(os.path.join(d, "codec"),
+                          safe_serialization=True)
+    # a speaker profile in the flat layout
+    with open(os.path.join(d, "speaker.json"), "w") as f:
+        json.dump({"text": "hello world",
+                   "codes": [3, 9, 27, 14, 5, 40]}, f)
+    return d
+
+
+@pytest.fixture(scope="module")
+def model(oute_dir):
+    from localai_tfp_tpu.models.outetts import OuteTTSModel
+
+    m = OuteTTSModel.load(oute_dir)
+    yield m
+    m.close()
+
+
+def test_synthesize_produces_audio(model):
+    audio = model.synthesize("hello world", seed=5, max_tokens=48,
+                             temperature=0.7)
+    assert audio.ndim == 1 and len(audio) > 0
+    assert np.isfinite(audio).all()
+    assert float(np.abs(audio).max()) <= 1.0
+
+
+def test_repeated_synthesis_stays_healthy(model):
+    """Back-to-back requests through the shared engine keep producing
+    clean audio (slot reuse, prefix cache, sampler reset all cycle)."""
+    for seed in (9, 10):
+        audio = model.synthesize("speak test", seed=seed, max_tokens=32,
+                                 temperature=0.7)
+        assert len(audio) > 0 and np.isfinite(audio).all()
+
+
+def test_speaker_profile_shapes_prompt(model, oute_dir):
+    """The speaker profile's transcript AND code history are prepended
+    to the prompt (in-context voice cloning — ref outetts interface
+    speaker handling), and conditioned synthesis runs end to end.
+    (Whether a RANDOM-weight LM actually varies its output with the
+    prefix is not a stable oracle; the prompt contract is.)"""
+    from localai_tfp_tpu.models.outetts import load_speaker
+
+    spk = load_speaker(os.path.join(oute_dir, "speaker.json"))
+    assert spk["codes"] and "hello" in spk["text"]
+    prompt = model._prompt("speak test", spk)
+    assert "hello world" in prompt and "<|c_3|>" in prompt
+    assert prompt.index("hello world") < prompt.index("speak test")
+    audio = model.synthesize("speak test", speaker=spk, seed=9,
+                             max_tokens=32, temperature=0.7)
+    assert len(audio) > 0 and np.isfinite(audio).all()
+
+
+def test_word_granular_speaker_layout(tmp_path):
+    from localai_tfp_tpu.models.outetts import load_speaker
+
+    p = str(tmp_path / "s.json")
+    with open(p, "w") as f:
+        json.dump({"words": [{"word": "hi", "codes": [1, 2]},
+                             {"word": "there", "codes": [3]}]}, f)
+    spk = load_speaker(p)
+    assert spk["text"] == "hi there" and spk["codes"] == [1, 2, 3]
+
+
+def test_worker_serves_outetts(oute_dir, tmp_path):
+    from localai_tfp_tpu.workers.base import ModelLoadOptions
+    from localai_tfp_tpu.workers.tts import JaxTTSBackend
+
+    b = JaxTTSBackend()
+    res = b.load_model(ModelLoadOptions(model=oute_dir,
+                                        extra={"type": "OuteTTS"}))
+    assert res.success and "outetts" in res.message, res.message
+    dst = str(tmp_path / "out.wav")
+    out = b.tts("hello world", dst=dst)
+    assert out.success, out.message
+    assert open(dst, "rb").read(4) == b"RIFF"
+    # speaker voice file
+    out2 = b.tts("hello world", voice="speaker.json",
+                 dst=str(tmp_path / "o2.wav"))
+    assert out2.success, out2.message
+    missing = b.tts("x", voice="nope.json", dst=str(tmp_path / "n.wav"))
+    assert not missing.success and "speaker" in missing.message
+
+
+def test_load_rejects_codecless_dir(tmp_path):
+    from localai_tfp_tpu.models.outetts import OuteTTSModel
+
+    with pytest.raises(ValueError, match="codec"):
+        OuteTTSModel.load(str(tmp_path))
