@@ -111,49 +111,21 @@ def _bench_config(eng, tok, n_req, n_tok, runs=3):
     return round(best, 2), round(p50, 1), round(p95, 1)
 
 
-def _bench_http(eng, tok, n_req, n_tok, runs=2):
+def _bench_http(state, model, n_req, n_tok, runs=2):
     """Endpoint-level benchmark: boot the REAL aiohttp server (routes,
-    middleware, SSE writer) over an already-built engine and drive
-    ``n_req`` concurrent streaming /v1/chat/completions clients through
-    localhost TCP. Returns (decode tok/s, ttft p50 ms, ttft p95 ms) as a
-    stock OpenAI client would observe them (BASELINE.md: the north star
-    is measured "via stock /v1/chat/completions")."""
+    middleware, SSE writer) over the given Application (whose loader
+    already serves ``model``) and drive ``n_req`` concurrent streaming
+    /v1/chat/completions clients through localhost TCP. Returns (decode
+    tok/s, ttft p50 ms, ttft p95 ms, steady p50 ms) as a stock OpenAI
+    client would observe them (BASELINE.md: the north star is measured
+    "via stock /v1/chat/completions")."""
     import asyncio
     import json as _json
-    import os
-    import tempfile
 
     from aiohttp import ClientSession, ClientTimeout, TCPConnector, web
 
-    from localai_tfp_tpu.config.app_config import ApplicationConfig
-    from localai_tfp_tpu.engine.loader import LoadedModel
     from localai_tfp_tpu.server.app import build_app
-    from localai_tfp_tpu.server.state import Application
-    from localai_tfp_tpu.workers.llm import JaxLLMBackend
 
-    tmp = tempfile.mkdtemp(prefix="bench-srv-")
-    models = os.path.join(tmp, "models")
-    os.makedirs(models)
-    with open(os.path.join(models, "bench.yaml"), "w") as f:
-        f.write(
-            "name: bench\n"
-            "backend: jax-llm\n"
-            "parameters:\n  model: bench\n"
-            "template:\n"
-            '  chat_message: "{{.RoleName}}: {{.Content}}"\n'
-            '  chat: "{{.Input}}\\nassistant:"\n'
-        )
-    state = Application(ApplicationConfig(
-        models_path=models,
-        generated_content_dir=os.path.join(tmp, "generated"),
-        upload_dir=os.path.join(tmp, "uploads"),
-        config_dir=os.path.join(tmp, "configuration"),
-    ))
-    backend = JaxLLMBackend()
-    backend.engine, backend.tokenizer = eng, tok
-    backend.spec, backend._state = eng.spec, "READY"
-    state.model_loader._models["bench"] = LoadedModel(
-        "bench", "jax-llm", backend)
     app = build_app(state)
     out = {}
 
@@ -174,11 +146,12 @@ def _bench_http(eng, tok, n_req, n_tok, runs=2):
 
             async def one(i, t0, ttfts):
                 body = {
-                    "model": "bench",
-                    # the chat template adds ~17 tokens ("user: ",
-                    # "\nassistant:", BOS); 10 reps keeps the templated
-                    # prompt inside the SAME 128-token prefill bucket as
-                    # the engine leg, so the legs share compiled variants
+                    "model": model,
+                    # the chat template adds a handful of tokens
+                    # ("user: ", "\nassistant:", BOS); 10 reps keeps the
+                    # templated prompt inside the SAME 128-token prefill
+                    # bucket as the engine leg, so the legs share
+                    # compiled variants
                     "messages": [{"role": "user",
                                   "content": "benchmark " * 10 + str(i)}],
                     "max_tokens": n_tok, "stream": True,
@@ -259,11 +232,120 @@ def _bench_http(eng, tok, n_req, n_tok, runs=2):
     return out["tok_s"], out["p50"], out["p95"], out["p50_steady"]
 
 
+def _build_bpe_tokenizer(dirpath: str, vocab_size: int = 128256) -> None:
+    """A REAL byte-level BPE tokenizer covering every id in the model
+    vocab, built programmatically (zero egress): 256 byte symbols plus
+    ~128k generated merges. Encoding runs the genuine greedy BPE merge
+    loop over the rank table and any sampled id decodes to visible
+    text — so client-side TTFT includes real tokenize/detokenize work
+    (VERDICT r4 weak #4: the synthetic ASCII tokenizer excluded it)."""
+    import json
+    import os
+
+    from tokenizers import Tokenizer, decoders, pre_tokenizers
+    from tokenizers.models import BPE
+
+    alphabet = sorted(pre_tokenizers.ByteLevel.alphabet())
+    vocab = {tok: i for i, tok in enumerate(alphabet)}
+    merges = []
+    target = vocab_size - 2  # two specials appended below
+    lvl = list(alphabet)
+    while len(vocab) < target:
+        nxt = []
+        for a in lvl:
+            if len(vocab) >= target:
+                break
+            for b in alphabet:
+                if len(vocab) >= target:
+                    break
+                m = a + b
+                if m in vocab:
+                    continue
+                vocab[m] = len(vocab)
+                merges.append((a, b))
+                nxt.append(m)
+        lvl = nxt
+    tk = Tokenizer(BPE(vocab=vocab, merges=merges))
+    tk.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tk.decoder = decoders.ByteLevel()
+    tk.add_special_tokens(["<|begin_of_text|>", "<|end_of_text|>"])
+    os.makedirs(dirpath, exist_ok=True)
+    tk.save(os.path.join(dirpath, "tokenizer.json"))
+    with open(os.path.join(dirpath, "tokenizer_config.json"), "w") as f:
+        json.dump({"tokenizer_class": "PreTrainedTokenizerFast",
+                   "bos_token": "<|begin_of_text|>",
+                   "eos_token": "<|end_of_text|>"}, f)
+
+
+def _write_hf_checkpoint(dirpath: str, spec) -> None:
+    """Write a REAL-format Llama HF checkpoint (config.json +
+    model.safetensors, torch [out, in] layout, bf16) with synthetic
+    weights, so the 8B leg flows through the actual loader: safetensors
+    read -> llama key mapping -> int8 quantization -> engine + warmup
+    (VERDICT r4 weak #4: nothing previously proved the 8B bench config
+    is reachable from a disk checkpoint)."""
+    import json
+    import math
+    import os
+
+    import ml_dtypes
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    D, F, V, L = spec.d_model, spec.d_ff, spec.vocab_size, spec.n_layers
+    q_dim, kv_dim = spec.q_dim, spec.kv_dim
+
+    def w(out_d, in_d):
+        q = rng.integers(-127, 128, (out_d, in_d), np.int8)
+        scale = np.float32(1.0 / (127.0 * math.sqrt(in_d)))
+        return (q.astype(np.float32) * scale).astype(ml_dtypes.bfloat16)
+
+    t = {
+        "model.embed_tokens.weight": w(V, D),
+        "model.norm.weight": np.ones((D,), ml_dtypes.bfloat16),
+        "lm_head.weight": w(V, D),
+    }
+    for i in range(L):
+        lp = f"model.layers.{i}."
+        t[lp + "self_attn.q_proj.weight"] = w(q_dim, D)
+        t[lp + "self_attn.k_proj.weight"] = w(kv_dim, D)
+        t[lp + "self_attn.v_proj.weight"] = w(kv_dim, D)
+        t[lp + "self_attn.o_proj.weight"] = w(D, q_dim)
+        t[lp + "mlp.gate_proj.weight"] = w(F, D)
+        t[lp + "mlp.up_proj.weight"] = w(F, D)
+        t[lp + "mlp.down_proj.weight"] = w(D, F)
+        t[lp + "input_layernorm.weight"] = np.ones((D,),
+                                                   ml_dtypes.bfloat16)
+        t[lp + "post_attention_layernorm.weight"] = np.ones(
+            (D,), ml_dtypes.bfloat16)
+    from safetensors.numpy import save_file
+
+    os.makedirs(dirpath, exist_ok=True)
+    save_file(t, os.path.join(dirpath, "model.safetensors"))
+    with open(os.path.join(dirpath, "config.json"), "w") as f:
+        json.dump({
+            "architectures": ["LlamaForCausalLM"],
+            "model_type": "llama",
+            "hidden_size": D, "intermediate_size": F,
+            "num_attention_heads": spec.n_heads,
+            "num_key_value_heads": spec.n_kv_heads,
+            "num_hidden_layers": L, "vocab_size": V,
+            "head_dim": spec.d_head,
+            "rope_theta": spec.rope_theta,
+            "max_position_embeddings": spec.max_position,
+            "rms_norm_eps": 1e-5, "torch_dtype": "bfloat16",
+            "bos_token_id": V - 2, "eos_token_id": V - 1,
+        }, f)
+    _build_bpe_tokenizer(dirpath, V)
+
+
 def _fast_int8_params(spec):
-    """Random int8 weight-only params for the 8B bench leg, generated
-    with numpy (jax.random threefry on host CPU takes ~20 min for 8B
-    params; numpy does it in seconds — throughput does not depend on
-    weight values)."""
+    """Random int8 weight-only params, generated with numpy (jax.random
+    threefry on host CPU takes ~20 min at 8B scale; numpy does it in
+    seconds). The bench's own 8B leg now loads REAL-format disk
+    checkpoints (_write_hf_checkpoint) — this helper remains for the
+    engine microbenches (tools/profile_r5.py, tools/microbench_step.py),
+    which want params without the disk round trip."""
     import math
 
     import jax.numpy as jnp
@@ -393,36 +475,84 @@ def main() -> None:
         jax.clear_caches()
 
         # --- 8B leg (Llama-3.1-8B geometry) = THE HEADLINE, measured
-        # through the stock /v1/chat/completions endpoint. int8
-        # weights + int8 embed/lm_head + int8 KV (the Pallas ragged
-        # decode kernel reads int8 pages directly) buy batch 64 on one
-        # 16 GB chip ---
+        # through the stock /v1/chat/completions endpoint against a
+        # REAL-format disk checkpoint: safetensors written in the HF
+        # llama layout, loaded through the actual model loader (key
+        # mapping -> int8_full quantization -> engine + warmup), with a
+        # real byte-level BPE tokenizer — so TTFT includes genuine
+        # tokenize/template/detokenize work and the whole path a user's
+        # model YAML takes is the path measured ---
+        import os
+        import shutil
+        import tempfile
+        import time as _time
+
+        from localai_tfp_tpu.config.app_config import ApplicationConfig
+        from localai_tfp_tpu.server.state import Application
+
         spec8 = LLMSpec(
             vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
             n_kv_heads=8, d_head=128, d_ff=14336, max_position=4096,
             rope_theta=500000.0,
         )
-        params8 = _fast_int8_params(spec8)
-        eng8 = LLMEngine(
-            spec8, params8, tok, n_slots=64, max_seq=1024,
-            decode_steps=16, cache_dtype="int8", autostart=False,
-        )
-        eng8.start()
-        eng8.warmup()
-        # 512-token streams: admission raggedness amortizes over the
-        # stream length, so throughput reflects serving, not wave edges
-        tok_s8, p50_8, p95_8 = _bench_config(eng8, tok, 64, 512, runs=2)
-        extra["decode_tok_s_8b_engine"] = tok_s8
-        extra["ttft_p50_ms_8b_engine"] = p50_8
-        extra["ttft_p95_ms_8b_engine"] = p95_8
-        tok_s, p50_h, p95_h, p50_steady = _bench_http(
-            eng8, tok, 64, 512, runs=2)
-        extra["ttft_p50_ms_8b_http"] = p50_h
-        extra["ttft_p95_ms_8b_http"] = p95_h
-        extra["ttft_p50_ms_8b_http_steady"] = p50_steady
-        extra["http_vs_engine"] = round(tok_s / max(tok_s8, 1e-9), 4)
-        eng8.close()
-        del eng8, params8
+        tmp = tempfile.mkdtemp(prefix="bench8b-")
+        try:
+            models = os.path.join(tmp, "models")
+            t0 = _time.perf_counter()
+            _write_hf_checkpoint(os.path.join(models, "ckpt"), spec8)
+            extra["checkpoint_write_s"] = round(
+                _time.perf_counter() - t0, 1)
+            with open(os.path.join(models, "bench8b.yaml"), "w") as f:
+                f.write(
+                    "name: bench8b\n"
+                    "backend: jax-llm\n"
+                    "parameters:\n  model: ckpt\n"
+                    "context_size: 1024\n"
+                    "max_batch_slots: 64\n"
+                    "quantization: int8_full\n"
+                    "kv_cache_dtype: int8\n"
+                    "decode_steps: 16\n"
+                    "template:\n"
+                    '  chat_message: "{{.RoleName}}: {{.Content}}"\n'
+                    '  chat: "{{.Input}}\\nassistant:"\n'
+                )
+            state = Application(ApplicationConfig(
+                models_path=models,
+                generated_content_dir=os.path.join(tmp, "generated"),
+                upload_dir=os.path.join(tmp, "uploads"),
+                config_dir=os.path.join(tmp, "configuration"),
+            ))
+            # configs + backend registry normally initialize in the
+            # server's startup hook; the bench drives the loader directly
+            from localai_tfp_tpu.engine.loader import (
+                register_default_backends)
+
+            register_default_backends()
+            state.config_loader.load_configs_from_path()
+            t0 = _time.perf_counter()
+            backend = state.model_loader.load(
+                state.config_loader.get("bench8b"))
+            extra["checkpoint_load_s"] = round(
+                _time.perf_counter() - t0, 1)  # incl. int8 quantize +
+            # engine warmup (the jit-variant precompile)
+            eng8, tok8 = backend.engine, backend.tokenizer
+            # 512-token streams: admission raggedness amortizes over the
+            # stream length, so throughput reflects serving, not edges
+            tok_s8, p50_8, p95_8 = _bench_config(eng8, tok8, 64, 512,
+                                                 runs=2)
+            extra["decode_tok_s_8b_engine"] = tok_s8
+            extra["ttft_p50_ms_8b_engine"] = p50_8
+            extra["ttft_p95_ms_8b_engine"] = p95_8
+            tok_s, p50_h, p95_h, p50_steady = _bench_http(
+                state, "bench8b", 64, 512, runs=2)
+            extra["ttft_p50_ms_8b_http"] = p50_h
+            extra["ttft_p95_ms_8b_http"] = p95_h
+            extra["ttft_p50_ms_8b_http_steady"] = p50_steady
+            extra["http_vs_engine"] = round(tok_s / max(tok_s8, 1e-9), 4)
+            extra["tokenizer"] = "byte-bpe-128256 (real merge table)"
+            backend.shutdown()
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
         gc.collect()
         jax.clear_caches()
         # compiled-kernel parity on the real chip (VERDICT r3 next #5)
@@ -439,8 +569,48 @@ def main() -> None:
         eng.start()
         tok_s_eng, p50, p95 = _bench_config(eng, tok, 4, 32, runs=1)
         extra["decode_tok_s_engine"] = tok_s_eng
-        tok_s, p50_h, _, _ = _bench_http(eng, tok, 4, 32, runs=1)
-        eng.close()
+        # smoke HTTP leg: a minimal Application with the in-memory
+        # engine registered (the TPU leg exercises the full disk-loader
+        # path; here the endpoint plumbing is what's smoke-tested)
+        import os
+        import tempfile
+
+        from localai_tfp_tpu.config.app_config import ApplicationConfig
+        from localai_tfp_tpu.engine.loader import LoadedModel
+        from localai_tfp_tpu.server.state import Application
+        from localai_tfp_tpu.workers.llm import JaxLLMBackend
+
+        import shutil
+
+        tmp = tempfile.mkdtemp(prefix="bench-srv-")
+        try:
+            models = os.path.join(tmp, "models")
+            os.makedirs(models)
+            with open(os.path.join(models, "bench.yaml"), "w") as f:
+                f.write(
+                    "name: bench\n"
+                    "backend: jax-llm\n"
+                    "parameters:\n  model: bench\n"
+                    "template:\n"
+                    '  chat_message: "{{.RoleName}}: {{.Content}}"\n'
+                    '  chat: "{{.Input}}\\nassistant:"\n'
+                )
+            state = Application(ApplicationConfig(
+                models_path=models,
+                generated_content_dir=os.path.join(tmp, "generated"),
+                upload_dir=os.path.join(tmp, "uploads"),
+                config_dir=os.path.join(tmp, "configuration"),
+            ))
+            backend = JaxLLMBackend()
+            backend.engine, backend.tokenizer = eng, tok
+            backend.spec, backend._state = eng.spec, "READY"
+            state.model_loader._models["bench"] = LoadedModel(
+                "bench", "jax-llm", backend)
+            tok_s, p50_h, _, _ = _bench_http(state, "bench", 4, 32,
+                                             runs=1)
+            eng.close()
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
         extra["ttft_p50_ms"] = p50
         extra["ttft_p50_ms_http"] = p50_h
 
