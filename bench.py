@@ -573,14 +573,13 @@ def main() -> None:
         # engine registered (the TPU leg exercises the full disk-loader
         # path; here the endpoint plumbing is what's smoke-tested)
         import os
+        import shutil
         import tempfile
 
         from localai_tfp_tpu.config.app_config import ApplicationConfig
         from localai_tfp_tpu.engine.loader import LoadedModel
         from localai_tfp_tpu.server.state import Application
         from localai_tfp_tpu.workers.llm import JaxLLMBackend
-
-        import shutil
 
         tmp = tempfile.mkdtemp(prefix="bench-srv-")
         try:

@@ -500,6 +500,14 @@ def load_vits(model_dir: str) -> tuple[VitsSpec, VitsParams]:
     from .hf_loader import load_hf_state
 
     config, get, names = load_hf_state(model_dir)
+    return build_vits_params(config, get, names)
+
+
+def build_vits_params(config: dict, get, names) -> tuple[VitsSpec,
+                                                         VitsParams]:
+    """HF-name tensor view -> (spec, params). Shared by the HF loader
+    above and the piper .onnx importer (models/piper.py), which
+    presents original-VITS initializers through an HF-name shim."""
     spec = vits_spec_from_hf(config)
     nameset = set(names)
 

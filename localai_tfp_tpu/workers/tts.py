@@ -148,19 +148,35 @@ class JaxTTSBackend(Backend):
         self._bark = None  # models/bark.py BarkTTS
         self._kokoro = None  # (spec, params, voices)
         self._xtts = None  # (spec, params, tokenizer, voices)
+        self._piper = None  # models/piper.py PiperVoice
+        self._outetts = None  # models/outetts.py OuteTTSModel
 
     def load_model(self, opts: ModelLoadOptions) -> Result:
         # a reload must not leave a previous family reachable (tts()
         # dispatches on whichever slot is non-None)
         self._vits = self._musicgen = self._bark = self._kokoro = None
         self._xtts = None
-        if getattr(self, "_outetts", None) is not None:
+        if self._outetts is not None:
             self._outetts.close()
         self._outetts = None
         self._bark_opts = {}
         model_dir = opts.model
         if model_dir and not os.path.isabs(model_dir):
             model_dir = os.path.join(opts.model_path or "", model_dir)
+        self._piper = None
+        if model_dir and model_dir.endswith(".onnx"):
+            # piper voice: original-VITS onnx + sidecar json (ref:
+            # backend/go/tts/piper.go:49 — the gallery's piper YAMLs
+            # point parameters.model at the .onnx)
+            from ..models.piper import PiperVoice
+
+            try:
+                self._piper = PiperVoice.load(model_dir)
+            except Exception as e:
+                self._state = "ERROR"
+                return Result(False, f"piper load failed: {e}")
+            self._state = "READY"
+            return Result(True, "piper voice ready")
         cfg_path = os.path.join(model_dir or "", "config.json")
         if model_dir and os.path.exists(cfg_path):
             import json
@@ -243,11 +259,12 @@ class JaxTTSBackend(Backend):
         # the OuteTTS family owns a live LLMEngine (scheduler thread +
         # device KV cache) — unload must reclaim it, or model swaps
         # accumulate engines until the device OOMs
-        if getattr(self, "_outetts", None) is not None:
+        if self._outetts is not None:
             self._outetts.close()
             self._outetts = None
         self._vits = self._musicgen = self._bark = self._kokoro = None
         self._xtts = None
+        self._piper = None
         self._state = "UNINITIALIZED"
 
     def status(self) -> StatusResponse:
@@ -264,7 +281,11 @@ class JaxTTSBackend(Backend):
 
     def tts(self, text: str, voice: str = "", dst: str = "",
             language: str = "") -> Result:
-        if getattr(self, "_outetts", None) is not None:
+        if self._piper is not None:
+            audio = self._piper.synthesize(text)
+            write_wav(dst, audio, sr=self._piper.spec.sampling_rate)
+            return Result(True, dst)
+        if self._outetts is not None:
             from ..models.outetts import load_speaker
 
             speaker = None

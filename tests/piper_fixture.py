@@ -1,0 +1,124 @@
+"""Builds a piper-format voice (.onnx + .onnx.json) from a tiny REAL
+transformers VitsModel checkpoint: state-dict names translated to the
+original-VITS module paths piper exports carry, weight-norm fused (as
+torch.onnx.export fuses it), attention projections re-laid as 1x1
+convs. Because the weights are the SAME as the HF checkpoint's, the
+piper import path can be parity-tested bit-for-bit against the HF
+loader."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _ld(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    out = bytearray()
+    for d in arr.shape:
+        out += _tag(1, 0) + _varint(d)
+    out += _tag(2, 0) + _varint(1)  # data_type = FLOAT
+    out += _ld(8, name.encode())
+    out += _ld(9, np.ascontiguousarray(arr, np.float32).tobytes())
+    return bytes(out)
+
+
+def write_onnx(path: str, tensors: dict[str, np.ndarray]) -> None:
+    graph = bytearray()
+    for name, arr in tensors.items():
+        graph += _ld(5, _tensor_proto(name, arr))  # graph.initializer
+    model = _ld(7, bytes(graph))  # model.graph
+    with open(path, "wb") as f:
+        f.write(model)
+
+
+def hf_vits_to_piper_tensors(model_dir: str) -> dict[str, np.ndarray]:
+    """HF VitsModel checkpoint -> {piper initializer name: array}."""
+    from safetensors import safe_open
+
+    from localai_tfp_tpu.models.piper import _piper_name
+
+    sd: dict[str, np.ndarray] = {}
+    with safe_open(os.path.join(model_dir, "model.safetensors"),
+                   framework="np") as f:
+        for key in f.keys():
+            sd[key] = np.asarray(f.get_tensor(key), np.float32)
+
+    # fuse weight norm the way torch.onnx.export does
+    fused: dict[str, np.ndarray] = {}
+    for key, arr in sd.items():
+        if key.endswith(".parametrizations.weight.original0"):
+            base = key[: -len(".parametrizations.weight.original0")]
+            g = arr
+            v = sd[base + ".parametrizations.weight.original1"]
+            norm = np.sqrt((v ** 2).sum(
+                axis=tuple(range(1, v.ndim)), keepdims=True))
+            fused[base + ".weight"] = g * v / np.maximum(norm, 1e-12)
+        elif key.endswith(".weight_g"):
+            base = key[: -len(".weight_g")]
+            g, v = arr, sd[base + ".weight_v"]
+            norm = np.sqrt((v ** 2).sum(
+                axis=tuple(range(1, v.ndim)), keepdims=True))
+            fused[base + ".weight"] = g * v / np.maximum(norm, 1e-12)
+        elif (key.endswith((".parametrizations.weight.original1",
+                            ".weight_v"))):
+            continue
+        else:
+            fused.setdefault(key, arr)
+
+    out: dict[str, np.ndarray] = {}
+    for hf_name, arr in fused.items():
+        pn = _piper_name(hf_name)
+        if pn is None:
+            continue  # training-only branches piper does not export
+        if hf_name.endswith(("q_proj.weight", "k_proj.weight",
+                             "v_proj.weight", "out_proj.weight")):
+            arr = arr[..., None]  # HF linear -> the export's 1x1 conv
+        out[pn] = arr
+    return out
+
+
+def build_piper_voice(model_dir: str, out_dir: str,
+                      sample_rate: int = 16000) -> str:
+    """Write <out_dir>/voice.onnx + voice.onnx.json; returns the onnx
+    path. Uses a char-level phoneme_id_map ("text" phoneme_type) over
+    the tiny model's vocab so phonemization needs no espeak."""
+    os.makedirs(out_dir, exist_ok=True)
+    tensors = hf_vits_to_piper_tensors(model_dir)
+    onnx_path = os.path.join(out_dir, "voice.onnx")
+    write_onnx(onnx_path, tensors)
+    vocab = tensors["enc_p.emb.weight"].shape[0]
+    id_map = {"^": [1], "$": [2], "_": [0]}
+    for i, ch in enumerate("abcdefghijklmnopqrstuvwxyz ,.!?"):
+        id_map[ch] = [3 + i % max(vocab - 3, 1)]
+    with open(onnx_path + ".json", "w") as f:
+        json.dump({
+            "audio": {"sample_rate": sample_rate},
+            "num_speakers": 1,
+            "phoneme_type": "text",
+            "phoneme_id_map": id_map,
+            "inference": {"noise_scale": 0.667, "length_scale": 1.0,
+                          "noise_w": 0.8},
+        }, f)
+    return onnx_path

@@ -1,0 +1,125 @@
+"""Piper .onnx voice import: round-trip parity against the HF VITS
+loader (same weights, two formats), architecture inference from tensor
+shapes, phonemization framing, worker integration (VERDICT r4 missing
+#3; ref: backend/go/tts/piper.go:49 — every gallery piper voice is this
+format)."""
+
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from localai_tfp_tpu.models.piper import (PiperVoice,  # noqa: E402
+                                          read_onnx_initializers)
+
+from . import piper_fixture  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def hf_ckpt(tmp_path_factory):
+    """Tiny REAL transformers VitsModel in piper-compatible geometry
+    (uniform resblock dilations and dilation_rate 1, the shapes real
+    piper voices use — architecture inference recovers these)."""
+    from transformers import VitsConfig, VitsModel
+
+    torch.manual_seed(0)
+    cfg = VitsConfig(
+        vocab_size=40, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, ffn_dim=64, flow_size=32,
+        spectrogram_bins=33, upsample_initial_channel=64,
+        upsample_rates=[4, 4], upsample_kernel_sizes=[8, 8],
+        resblock_kernel_sizes=[3, 5],
+        resblock_dilation_sizes=[[1, 3], [1, 3]],
+        prior_encoder_num_flows=2, posterior_encoder_num_wavenet_layers=2,
+        prior_encoder_num_wavenet_layers=2,
+        depth_separable_num_layers=2, duration_predictor_flow_bins=4,
+        duration_predictor_num_flows=2, wavenet_dilation_rate=1,
+        wavenet_kernel_size=3, sampling_rate=16000,
+    )
+    d = tmp_path_factory.mktemp("pvits") / "hf"
+    VitsModel(cfg).save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def voice_path(hf_ckpt, tmp_path_factory):
+    return piper_fixture.build_piper_voice(
+        hf_ckpt, str(tmp_path_factory.mktemp("pvoice")))
+
+
+def test_onnx_reader_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    tensors = {"a.weight": rng.standard_normal((3, 4)).astype(np.float32),
+               "b.bias": rng.standard_normal((7,)).astype(np.float32)}
+    p = str(tmp_path / "t.onnx")
+    piper_fixture.write_onnx(p, tensors)
+    back = read_onnx_initializers(p)
+    assert set(back) == set(tensors)
+    for k in tensors:
+        np.testing.assert_array_equal(back[k], tensors[k])
+
+
+def test_piper_matches_hf_loader_bitwise(hf_ckpt, voice_path):
+    """The SAME weights through the piper name shim and through the HF
+    loader must produce identical waveforms — the name mapping, shape
+    relayout and architecture inference are all on the line."""
+    from localai_tfp_tpu.models.vits import load_vits, synthesize
+
+    voice = PiperVoice.load(voice_path)
+    hf_spec, hf_params = load_vits(hf_ckpt)
+    assert voice.spec.hidden == hf_spec.hidden
+    assert voice.spec.upsample_rates == hf_spec.upsample_rates
+    assert voice.spec.dp_bins == hf_spec.dp_bins
+    ids = voice.phoneme_ids("hello world")
+    a = voice.synthesize("hello world", seed=3)
+    b = np.asarray(synthesize(hf_spec, hf_params, ids, seed=3))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_phoneme_framing(voice_path):
+    voice = PiperVoice.load(voice_path)
+    ids = voice.phoneme_ids("ab")
+    # ^ then pad-interspersed phonemes then pad $
+    assert ids[0] == 1 and ids[-1] == 2
+    assert ids[1] == 0 and ids[3] == 0  # pad between phonemes
+    assert len(ids) == 2 + 2 * 2 + 1
+
+
+def test_espeak_fallback_g2p():
+    from localai_tfp_tpu.models.piper import _g2p_fallback
+
+    phs = _g2p_fallback("this shop")
+    assert "θ" in phs and "ʃ" in phs and " " in phs
+
+
+def test_multispeaker_rejected(voice_path, tmp_path):
+    import json
+    import shutil
+
+    d = str(tmp_path / "multi")
+    os.makedirs(d)
+    shutil.copy(voice_path, os.path.join(d, "voice.onnx"))
+    with open(voice_path + ".json") as f:
+        cfg = json.load(f)
+    cfg["num_speakers"] = 4
+    with open(os.path.join(d, "voice.onnx.json"), "w") as f:
+        json.dump(cfg, f)
+    with pytest.raises(ValueError, match="multi-speaker"):
+        PiperVoice.load(os.path.join(d, "voice.onnx"))
+
+
+def test_tts_worker_serves_piper_voice(voice_path, tmp_path):
+    """A stock piper-style model YAML (parameters.model pointing at the
+    .onnx) speaks through the TTS worker."""
+    from localai_tfp_tpu.workers.base import ModelLoadOptions
+    from localai_tfp_tpu.workers.tts import JaxTTSBackend
+
+    b = JaxTTSBackend()
+    res = b.load_model(ModelLoadOptions(model=voice_path))
+    assert res.success and "piper" in res.message, res.message
+    dst = str(tmp_path / "p.wav")
+    out = b.tts("hello world", dst=dst)
+    assert out.success, out.message
+    assert open(dst, "rb").read(4) == b"RIFF"
