@@ -145,11 +145,12 @@ class SlotState(Enum):
 @dataclass
 class _Flight:
     """An in-flight device dispatch whose host-visible results are still
-    on the wire. The scheduler enqueues dispatches without blocking
-    (device work and the ~100ms tunnel round trip pipeline behind one
-    another) and harvests results in FIFO order — device execution is
-    serialized by the donated cache/sampling buffers, so flight N's
-    arrays are always ready no later than flight N+1's."""
+    pending. The scheduler enqueues dispatches without blocking (device
+    queue time — hundreds of ms of scan work at serving shapes —
+    pipelines behind host work) and harvests results in FIFO order —
+    device execution is serialized by the donated cache/sampling
+    buffers, so flight N's arrays are always ready no later than flight
+    N+1's."""
 
     kind: str  # "prefill_final" | "decodek"
     arrays: list  # device arrays to harvest (copy_to_host_async started)
@@ -1078,36 +1079,41 @@ class LLMEngine:
             w *= 2
         win_ladder.append(self.max_seq)
         for bucket in self.prefill_buckets:
-            identity = bucket * self.n_slots <= self._PREFILL_GROUP_TOKENS
-            if identity:
-                sizes = {self.n_slots}  # ONE identity shape per bucket
-                # every live-context window variant, so no (window,
-                # bucket) shape can cold-compile mid-request
-                windows = win_ladder
-            else:
-                cap = self._prefill_group_cap(bucket)
-                sizes = {cap}
-                b = 1
-                while b < cap:
-                    sizes.add(b)
-                    b *= 8
-                windows = [self.max_seq]
-            for B in sorted(sizes):
+            id_capable = (bucket * self.n_slots
+                          <= self._PREFILL_GROUP_TOKENS)
+            # (B, window, identity) variants matching _enqueue's split:
+            # bursts -> ONE identity shape per live-context window (no
+            # (window, bucket) shape can cold-compile mid-request);
+            # trickles -> the small legacy sizes below the identity
+            # threshold at the pinned max_seq window
+            variants: list[tuple[int, int, bool]] = []
+            if id_capable:
+                variants += [(self.n_slots, w, True) for w in win_ladder]
+            cap = self._prefill_group_cap(bucket)
+            sizes = {cap}
+            b = 1
+            while b < cap:
+                sizes.add(b)
+                b *= 8
+            legacy_cap = (self._legacy_prefill_max if id_capable
+                          else cap)
+            variants += [(B, self.max_seq, False) for B in sorted(sizes)
+                         if B <= legacy_cap]
+            for B, win, identity in variants:
                 reset = {k: np.repeat(v, B, axis=0)
                          for k, v in pad_reset.items()}
-                for win in windows:
-                    self._run("prefill_final", {
-                        "toks": np.zeros((B, bucket), np.int32),
-                        "pos0": np.zeros((B,), np.int32),
-                        "slot_ids": np.full((B,), self.n_slots,
-                                            np.int32),
-                        "n_chunk": np.ones((B,), np.int32),
-                        "tails": np.zeros((B, W), np.int32),
-                        "tail_lens": np.zeros((B,), np.int32),
-                        "masks": None, "reset": reset, "soft": None,
-                        "window": win,
-                        "identity": identity,
-                    })
+                self._run("prefill_final", {
+                    "toks": np.zeros((B, bucket), np.int32),
+                    "pos0": np.zeros((B,), np.int32),
+                    "slot_ids": np.full((B,), self.n_slots,
+                                        np.int32),
+                    "n_chunk": np.ones((B,), np.int32),
+                    "tails": np.zeros((B, W), np.int32),
+                    "tail_lens": np.zeros((B,), np.int32),
+                    "masks": None, "reset": reset, "soft": None,
+                    "window": win,
+                    "identity": identity,
+                })
         if self.max_seq > self.prefill_buckets[-1]:
             # long prompts chunk through the "prefill" fn at live-context
             # window buckets — compile those too, or the first long
@@ -1142,7 +1148,8 @@ class LLMEngine:
             "pos0": np.zeros((S,), np.int32),
             "active": np.zeros((S,), bool),
         }
-        ks = {1, min(4, self.decode_steps), self.decode_steps}
+        ks = {1, min(4, self.decode_steps), self._half_k,
+              self.decode_steps}
         if self._use_kernel:
             windows_d = {self.max_seq}  # ragged kernel: one variant
         else:
@@ -1274,15 +1281,18 @@ class LLMEngine:
     def step(self) -> None:
         """One scheduler iteration (ref: update_slots, grpc-server.cpp:1639).
 
-        Async pipeline shape (the tunnel RTT redesign): every device
-        dispatch is ENQUEUED without waiting for its results — JAX
-        dispatch, the device work, and the ~100ms host<->device round
-        trip all pipeline — and results are harvested when their
-        device arrays turn ready. Admission therefore never waits
-        behind an in-flight prefill's download, and a deep burst's
-        prefill groups overlap on the wire: TTFT for group N is one
-        round trip plus the device compute of groups 1..N, not N
-        serialized (compute + RTT) blocks."""
+        Async pipeline shape: every device dispatch is ENQUEUED without
+        waiting for its results — JAX dispatch, the device work, and
+        the host<->device transfer all pipeline — and results are
+        harvested when their device arrays turn ready. Admission
+        therefore never waits behind an in-flight prefill's download,
+        and a deep burst's prefill groups overlap: TTFT for group N is
+        the device compute of groups 1..N plus one transfer, not N
+        serialized (compute + transfer) blocks. (r5 measurement note:
+        the tunnel's dispatch/readiness floor is ~0.1 ms — flight
+        latency is real device-queue time, so the pipelining hides
+        QUEUE time, and keeping the queue clean around latency-critical
+        dispatches matters more than wire round trips.)"""
         self._apply_cancellations()
         self._admit()
         harvested = self._harvest()
@@ -1644,6 +1654,26 @@ class LLMEngine:
     # group at 1B/2048-ctx needs 34 GB of scores on a 16 GB chip)
     _PREFILL_GROUP_TOKENS = 8192
 
+    @property
+    def _half_k(self) -> int:
+        """The half-length scan the steady-state arrival clamp snaps to:
+        the largest power of two <= decode_steps // 2 (floor 4). MUST be
+        in warmup()'s decode ks — a never-warmed k here would cold-jit
+        ~13 s on the latency path the clamp protects."""
+        h = max(self.decode_steps // 2, 4)
+        while h & (h - 1):
+            h &= h - 1
+        return h
+
+    @property
+    def _legacy_prefill_max(self) -> int:
+        """Identity/legacy prefill split point. warmup() precompiles
+        exactly the legacy shapes below it and _enqueue_prefill_final
+        dispatches identity at or above it — ONE definition, or a
+        trickle group lands on a never-warmed shape and eats a ~13 s
+        mid-request compile."""
+        return min(8, self.n_slots)
+
     def _prefill_group_cap(self, bucket: int) -> int:
         return max(1, min(self._group_cap,
                           self._PREFILL_GROUP_TOKENS // max(bucket, 1)))
@@ -1684,7 +1714,15 @@ class LLMEngine:
         harvest."""
         cap = self._prefill_group_cap(bucket)
         group = group[:cap]
-        identity = bucket * self.n_slots <= self._PREFILL_GROUP_TOKENS
+        # identity full-batch pays the whole [n_slots, bucket] forward —
+        # a huge win for burst groups (no cross-slot scatter, one jit
+        # shape) but a ~75 ms steady-state TTFT tax on a LONE arrival,
+        # whose [1, bucket] legacy dispatch reads the same weights with
+        # a fraction of the attention/sampler traffic. Split by group
+        # size at the largest warmed legacy shape: trickles stay small,
+        # a group reaching it is a genuine burst and goes identity.
+        identity = (bucket * self.n_slots <= self._PREFILL_GROUP_TOKENS
+                    and len(group) >= self._legacy_prefill_max)
         if identity:
             B = self.n_slots
             rows = [s.idx for s in group]
@@ -1995,6 +2033,14 @@ class LLMEngine:
             # the whole drain phase of a wave to 1/4 throughput,
             # measured on the 1B config.)
             k = min(k, 4)
+        elif waiting and now - self._last_arrival < 1.0:
+            # a fresh arrival's prefill is pending/in flight with every
+            # slot taken (so the clamp above is off): keep scans at half
+            # length so its first token is not hostage to a full k-scan
+            # already queued ahead — the steady-state TTFT counterpart
+            # of the burst clamp, at half the dispatch-overhead cost
+            # (_half_k is always in warmup's variant set)
+            k = min(k, self._half_k)
 
         S = self.n_slots
         if self._use_kernel:
