@@ -1,0 +1,202 @@
+"""On-disk cache of quantized parameter trees.
+
+The reference never quantizes at load time — users point it at
+pre-quantized GGUF files and llama.cpp mmaps them in seconds
+(ref: backend/cpp/llama grpc-server.cpp LoadModel; pkg/model
+initializers.go). Our int8 serving path starts from bf16/f16
+checkpoints, so the first load pays cast+quantize; this cache makes
+every later load of the same checkpoint behave like the reference's:
+read the int8 tree straight from disk and ship it to the chip.
+
+Format: one safetensors file per (checkpoint, quant-config)
+fingerprint. QTensor leaves flatten to ``<name>.q`` / ``<name>.scale``;
+plain leaves keep their name. The fingerprint hashes the source
+checkpoint's file stats (name, size, mtime_ns) plus the quant config
+and a format version, so edited checkpoints or changed quant settings
+miss cleanly. Writes go to a temp file and rename atomically; a failed
+or disabled write (LOCALAI_QUANT_ARTIFACTS=off) only costs the speedup.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import tempfile
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from .quant import QTensor
+
+log = logging.getLogger(__name__)
+
+FORMAT_VERSION = "int8-artifact-v1"
+
+
+def enabled() -> bool:
+    return os.environ.get("LOCALAI_QUANT_ARTIFACTS", "on").lower() not in (
+        "off", "0", "false", "no")
+
+
+def cache_dir() -> str:
+    root = os.environ.get("LOCALAI_QUANT_CACHE_DIR")
+    if not root:
+        xdg = os.environ.get("XDG_CACHE_HOME",
+                             os.path.expanduser("~/.cache"))
+        root = os.path.join(xdg, "localai_tpu", "quant")
+    return root
+
+
+def _canonical_quant(quant: str) -> str:
+    """Collapse quant aliases that produce the same tree ('int8', 'q8',
+    'q8_0', 'w8' all mean weight-only int8; 'int8_full' adds quantized
+    embeddings) so aliased configs share one artifact."""
+    return "int8_full" if quant == "int8_full" else "int8"
+
+
+def fingerprint(model_dir: str, quant: str, dtype_name: str) -> str:
+    """Hash the source checkpoint's identity + quant config."""
+    quant = _canonical_quant(quant)
+    entries = []
+    for f in sorted(os.listdir(model_dir)):
+        if f.endswith((".safetensors", ".bin", ".gguf")) or f in (
+                "config.json",):
+            st = os.stat(os.path.join(model_dir, f))
+            entries.append((f, st.st_size, st.st_mtime_ns))
+    blob = json.dumps({
+        "version": FORMAT_VERSION,
+        "files": entries,
+        "quant": quant,
+        "dtype": dtype_name,
+    }, sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:24]
+
+
+def artifact_path(model_dir: str, quant: str, dtype_name: str) -> str:
+    return os.path.join(
+        cache_dir(), f"{fingerprint(model_dir, quant, dtype_name)}.safetensors")
+
+
+def try_load(path: str, device) -> Optional[dict[str, Any]]:
+    """Read an artifact and place it on ``device``; None on any miss."""
+    if not enabled() or not os.path.exists(path):
+        return None
+    import jax
+
+    from safetensors import safe_open
+
+    try:
+        params: dict[str, Any] = {}
+        qparts: dict[str, dict[str, np.ndarray]] = {}
+        with safe_open(path, framework="np") as h:
+            meta = h.metadata() or {}
+            if meta.get("format") != FORMAT_VERSION:
+                return None
+            for name in h.keys():
+                arr = h.get_tensor(name)
+                if name.endswith(".q"):
+                    qparts.setdefault(name[:-2], {})["q"] = arr
+                elif name.endswith(".scale"):
+                    qparts.setdefault(name[:-6], {})["scale"] = arr
+                else:
+                    params[name] = jax.device_put(arr, device)
+        for name, parts in qparts.items():
+            if "q" not in parts or "scale" not in parts:
+                return None
+            params[name] = QTensor(
+                q=jax.device_put(parts["q"], device),
+                scale=jax.device_put(parts["scale"], device),
+            )
+        try:
+            # refresh the timestamp ourselves: noatime/relatime mounts
+            # never (or rarely) update atime on read, and eviction
+            # orders by it — a hit must mark the artifact as live
+            os.utime(path, None)
+        except OSError:
+            pass
+        return params
+    except Exception as e:
+        log.warning("quant artifact %s unreadable (%r) — full load", path, e)
+        return None
+
+
+def _host(x) -> np.ndarray:
+    # np.asarray of a device array whose layout is a transpose comes
+    # back as a STRIDED VIEW; safetensors serializes the underlying
+    # buffer, so a non-contiguous tensor would be written scrambled
+    # (caught by the roundtrip test on every out != in shape)
+    return np.ascontiguousarray(np.asarray(x))
+
+
+def _flatten(params: dict[str, Any]) -> dict[str, np.ndarray]:
+    flat: dict[str, np.ndarray] = {}
+    for name, leaf in params.items():
+        if isinstance(leaf, QTensor):
+            flat[name + ".q"] = _host(leaf.q)
+            flat[name + ".scale"] = _host(leaf.scale)
+        else:
+            flat[name] = _host(leaf)
+    return flat
+
+
+def _evict_over_budget(root: str, keep: str) -> None:
+    """Drop least-recently-used artifacts once the cache exceeds
+    LOCALAI_QUANT_CACHE_MAX_GB (default 50): a stale fingerprint (edited
+    checkpoint, changed quant config) is otherwise a multi-GB orphan
+    nothing ever deletes."""
+    try:
+        budget = float(os.environ.get(
+            "LOCALAI_QUANT_CACHE_MAX_GB", "50")) * 1e9
+        files = []
+        for f in os.listdir(root):
+            if not f.endswith(".safetensors"):
+                continue
+            p = os.path.join(root, f)
+            st = os.stat(p)
+            files.append((st.st_atime, st.st_size, p))
+        total = sum(s for _, s, _ in files)
+        for _, size, p in sorted(files):
+            if total <= budget:
+                break
+            if p == keep:
+                continue
+            os.unlink(p)
+            total -= size
+            log.info("quant artifact evicted (cache over budget): %s", p)
+    except Exception as e:
+        log.warning("quant artifact eviction skipped (%r)", e)
+
+
+def save_async(path: str, params: dict[str, Any]) -> Optional[threading.Thread]:
+    """Write the committed tree in a daemon thread (device->host pulls
+    ride the transfer link at low duty; the write renames atomically).
+    Returns the thread for tests to join."""
+    if not enabled():
+        return None
+
+    def work() -> None:
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            flat = _flatten(params)
+            from safetensors.numpy import save_file
+
+            fd, tmp = tempfile.mkstemp(
+                dir=os.path.dirname(path), suffix=".tmp")
+            os.close(fd)
+            try:
+                save_file(flat, tmp, metadata={"format": FORMAT_VERSION})
+                os.replace(tmp, path)
+                log.info("quant artifact written: %s", path)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+            _evict_over_budget(os.path.dirname(path), keep=path)
+        except Exception as e:  # cache write must never fail a load
+            log.warning("quant artifact write failed (%r): %s", e, path)
+
+    t = threading.Thread(target=work, name="quant-artifact", daemon=True)
+    t.start()
+    return t

@@ -67,6 +67,42 @@ def _cast(a: np.ndarray, dtype) -> jnp.ndarray:
     return x.astype(dtype)
 
 
+def _swap_last_two(a):
+    return jnp.swapaxes(a, -1, -2)
+
+
+_jit_swap_last_two = None  # built lazily: jax.jit at import time would
+# initialize backends before the caller's platform env is settled
+
+
+def _jitted_swap():
+    global _jit_swap_last_two
+    if _jit_swap_last_two is None:
+        import jax
+
+        # ONE jitted function reused across leaves/loads so equal shapes
+        # share a compiled program (a per-call lambda would retrace every
+        # leaf); donated so the load holds one stack-sized transient
+        _jit_swap_last_two = jax.jit(_swap_last_two, donate_argnums=0)
+    return _jit_swap_last_two
+
+
+class DeferredT:
+    """A parameter leaf held as the RAW host array ([..., out, in] torch
+    layout, on-disk dtype) whose transpose/cast is deferred to the
+    consumer. ``load_params(..., defer_transpose=True)`` returns these
+    for every transposed leaf so the loader can stream them to the
+    accelerator and run cast+transpose(+quantize) as ONE fused XLA op
+    there — the host-staged eager pipeline (numpy strided copy, CPU
+    swapaxes, eager quantize) measured ~10 min for an 8B where the
+    device path is tens of seconds."""
+
+    __slots__ = ("raw",)
+
+    def __init__(self, raw: np.ndarray) -> None:
+        self.raw = raw
+
+
 def load_multimodal(model_dir: str, dtype: Any = jnp.bfloat16,
                     state: Optional[tuple] = None):
     """Load the vision tower of a multimodal checkpoint (gemma3 SigLIP).
@@ -134,6 +170,8 @@ def load_params(
     spec_override: Optional[LLMSpec] = None,
     state: Optional[tuple] = None,  # pre-read load_hf_state result, so a
     # caller loading text + vision opens the checkpoint index once
+    defer_transpose: bool = False,  # transposed leaves come back as
+    # DeferredT raw host arrays; see DeferredT
 ) -> tuple[LLMSpec, Params]:
     """Load an HF checkpoint directory -> (spec, stacked params)."""
     config, get, names = state or load_hf_state(model_dir)
@@ -141,8 +179,26 @@ def load_params(
     mt = (config.get("model_type") or "").lower()
     L = spec.n_layers
 
-    def t(name: str) -> np.ndarray:  # weight, transposed to [in, out]
-        return np.ascontiguousarray(get(name).T)
+    def t(name: str) -> np.ndarray:
+        """Weight in the checkpoint's torch [out, in] layout, untransposed.
+
+        The [in, out] layout the models consume is produced AFTER
+        stacking by one XLA transpose per stacked tensor (``stack_t`` /
+        ``tcast``): a numpy ``ascontiguousarray(w.T)`` per projection is
+        a single-threaded strided copy (~60-250 MB/s) that cost minutes
+        on an 8B load, while XLA's transpose is multithreaded and
+        cache-blocked (seconds for the whole tree)."""
+        return get(name)
+
+    def tcast(x: np.ndarray):
+        """Cast then swap the last two axes ([..., out, in] -> [..., in,
+        out]) on the jax backend (host-staged CPU or device) — or hand
+        the raw array to the consumer under ``defer_transpose``. The
+        transpose donates its input so an on-device (non-staged) load
+        holds one stack-sized transient, not two."""
+        if defer_transpose:
+            return DeferredT(np.asarray(x))
+        return _jitted_swap()(_cast(x, dtype))
 
     p: dict[str, Any] = {}
     prefix = ""
@@ -156,25 +212,30 @@ def load_params(
     def stack(fn: Callable[[int], np.ndarray]) -> jnp.ndarray:
         return _cast(np.stack([fn(i) for i in range(L)]), dtype)
 
+    def stack_t(fn: Callable[[int], np.ndarray]) -> jnp.ndarray:
+        """Stack raw [out, in]-layout layers (contiguous memcpy), then
+        transpose the trailing axes once in XLA — see ``t``."""
+        return tcast(np.stack([fn(i) for i in range(L)]))
+
     lp = f"{prefix}layers." + "{i}."
     if mt == "phi":
-        p["wq"] = stack(lambda i: t(lp.format(i=i) + "self_attn.q_proj.weight"))
-        p["wk"] = stack(lambda i: t(lp.format(i=i) + "self_attn.k_proj.weight"))
-        p["wv"] = stack(lambda i: t(lp.format(i=i) + "self_attn.v_proj.weight"))
-        p["wo"] = stack(lambda i: t(lp.format(i=i) + "self_attn.dense.weight"))
+        p["wq"] = stack_t(lambda i: t(lp.format(i=i) + "self_attn.q_proj.weight"))
+        p["wk"] = stack_t(lambda i: t(lp.format(i=i) + "self_attn.k_proj.weight"))
+        p["wv"] = stack_t(lambda i: t(lp.format(i=i) + "self_attn.v_proj.weight"))
+        p["wo"] = stack_t(lambda i: t(lp.format(i=i) + "self_attn.dense.weight"))
         p["bq"] = stack(lambda i: get(lp.format(i=i) + "self_attn.q_proj.bias"))
         p["bk"] = stack(lambda i: get(lp.format(i=i) + "self_attn.k_proj.bias"))
         p["bv"] = stack(lambda i: get(lp.format(i=i) + "self_attn.v_proj.bias"))
         p["bo"] = stack(lambda i: get(lp.format(i=i) + "self_attn.dense.bias"))
-        p["w_up"] = stack(lambda i: t(lp.format(i=i) + "mlp.fc1.weight"))
+        p["w_up"] = stack_t(lambda i: t(lp.format(i=i) + "mlp.fc1.weight"))
         p["b_up"] = stack(lambda i: get(lp.format(i=i) + "mlp.fc1.bias"))
-        p["w_down"] = stack(lambda i: t(lp.format(i=i) + "mlp.fc2.weight"))
+        p["w_down"] = stack_t(lambda i: t(lp.format(i=i) + "mlp.fc2.weight"))
         p["b_down"] = stack(lambda i: get(lp.format(i=i) + "mlp.fc2.bias"))
         p["ln1_w"] = stack(lambda i: get(lp.format(i=i) + "input_layernorm.weight"))
         p["ln1_b"] = stack(lambda i: get(lp.format(i=i) + "input_layernorm.bias"))
         p["final_norm_w"] = _cast(get(f"{prefix}final_layernorm.weight"), dtype)
         p["final_norm_b"] = _cast(get(f"{prefix}final_layernorm.bias"), dtype)
-        p["lm_head"] = _cast(t("lm_head.weight"), dtype)
+        p["lm_head"] = tcast(t("lm_head.weight"))
         p["lm_head_b"] = _cast(get("lm_head.bias"), dtype)
         return spec, p
 
@@ -187,20 +248,20 @@ def load_params(
         def split_qkv(i, part):
             w = get(lp.format(i=i) + "self_attn.qkv_proj.weight")  # [q+2kv, D]
             q, k, v = w[:qd], w[qd : qd + kvd], w[qd + kvd :]
-            return np.ascontiguousarray({"q": q, "k": k, "v": v}[part].T)
+            return {"q": q, "k": k, "v": v}[part]  # raw [out, in]
 
-        p["wq"] = stack(lambda i: split_qkv(i, "q"))
-        p["wk"] = stack(lambda i: split_qkv(i, "k"))
-        p["wv"] = stack(lambda i: split_qkv(i, "v"))
+        p["wq"] = stack_t(lambda i: split_qkv(i, "q"))
+        p["wk"] = stack_t(lambda i: split_qkv(i, "k"))
+        p["wv"] = stack_t(lambda i: split_qkv(i, "v"))
     else:
-        p["wq"] = stack(lambda i: t(lp.format(i=i) + "self_attn.q_proj.weight"))
-        p["wk"] = stack(lambda i: t(lp.format(i=i) + "self_attn.k_proj.weight"))
-        p["wv"] = stack(lambda i: t(lp.format(i=i) + "self_attn.v_proj.weight"))
+        p["wq"] = stack_t(lambda i: t(lp.format(i=i) + "self_attn.q_proj.weight"))
+        p["wk"] = stack_t(lambda i: t(lp.format(i=i) + "self_attn.k_proj.weight"))
+        p["wv"] = stack_t(lambda i: t(lp.format(i=i) + "self_attn.v_proj.weight"))
         if spec.qkv_bias:
             p["bq"] = stack(lambda i: get(lp.format(i=i) + "self_attn.q_proj.bias"))
             p["bk"] = stack(lambda i: get(lp.format(i=i) + "self_attn.k_proj.bias"))
             p["bv"] = stack(lambda i: get(lp.format(i=i) + "self_attn.v_proj.bias"))
-    p["wo"] = stack(lambda i: t(lp.format(i=i) + "self_attn.o_proj.weight"))
+    p["wo"] = stack_t(lambda i: t(lp.format(i=i) + "self_attn.o_proj.weight"))
 
     if spec.n_experts and mt in ("qwen2_moe", "qwen3_moe"):
         # qwen-family MoE: mlp.gate [E,D] router + mlp.experts.{e}.gate/
@@ -220,13 +281,12 @@ def load_params(
             )
 
         def experts(i, name):
+            # raw torch [E, out, in]; stack_t transposes the trailing axes
             if i in dense_set:
-                shape = (E, Fm, D) if name == "down_proj" else (E, D, Fm)
+                shape = (E, D, Fm) if name == "down_proj" else (E, Fm, D)
                 return np.zeros(shape, np.float32)
             return np.stack([
-                np.ascontiguousarray(get(
-                    lp.format(i=i)
-                    + f"mlp.experts.{e}.{name}.weight").T)
+                get(lp.format(i=i) + f"mlp.experts.{e}.{name}.weight")
                 for e in range(E)
             ])
 
@@ -234,16 +294,16 @@ def load_params(
             base = "mlp." if i in dense_set else "mlp.shared_expert."
             return t(lp.format(i=i) + base + f"{name}.weight")
 
-        p["router"] = stack(
-            lambda i: np.zeros((D, E), np.float32) if i in dense_set
+        p["router"] = stack_t(
+            lambda i: np.zeros((E, D), np.float32) if i in dense_set
             else t(lp.format(i=i) + "mlp.gate.weight"))
-        p["moe_gate"] = stack(lambda i: experts(i, "gate_proj"))
-        p["moe_up"] = stack(lambda i: experts(i, "up_proj"))
-        p["moe_down"] = stack(lambda i: experts(i, "down_proj"))
+        p["moe_gate"] = stack_t(lambda i: experts(i, "gate_proj"))
+        p["moe_up"] = stack_t(lambda i: experts(i, "up_proj"))
+        p["moe_down"] = stack_t(lambda i: experts(i, "down_proj"))
         if spec.moe_shared_expert:
-            p["shared_gate"] = stack(lambda i: shared(i, "gate_proj"))
-            p["shared_up"] = stack(lambda i: shared(i, "up_proj"))
-            p["shared_down"] = stack(lambda i: shared(i, "down_proj"))
+            p["shared_gate"] = stack_t(lambda i: shared(i, "gate_proj"))
+            p["shared_up"] = stack_t(lambda i: shared(i, "up_proj"))
+            p["shared_down"] = stack_t(lambda i: shared(i, "down_proj"))
             p["shared_router"] = stack(
                 lambda i: np.zeros((D,), np.float32) if i in dense_set
                 else get(lp.format(i=i)
@@ -254,34 +314,34 @@ def load_params(
         E = spec.n_experts
 
         def experts(i, name):
+            # raw torch [E, out, in]; stack_t transposes the trailing axes
             return np.stack([
-                np.ascontiguousarray(get(
-                    lp.format(i=i)
-                    + f"block_sparse_moe.experts.{e}.{name}.weight").T)
+                get(lp.format(i=i)
+                    + f"block_sparse_moe.experts.{e}.{name}.weight")
                 for e in range(E)
             ])
 
-        p["router"] = stack(
+        p["router"] = stack_t(
             lambda i: t(lp.format(i=i) + "block_sparse_moe.gate.weight"))
-        p["moe_gate"] = stack(lambda i: experts(i, "w1"))
-        p["moe_up"] = stack(lambda i: experts(i, "w3"))
-        p["moe_down"] = stack(lambda i: experts(i, "w2"))
+        p["moe_gate"] = stack_t(lambda i: experts(i, "w1"))
+        p["moe_up"] = stack_t(lambda i: experts(i, "w3"))
+        p["moe_down"] = stack_t(lambda i: experts(i, "w2"))
     elif fused_gate:
         F = spec.d_ff
 
         def split_gate(i, part):
             w = get(lp.format(i=i) + "mlp.gate_up_proj.weight")  # [2F, D]
             g, u = w[:F], w[F:]
-            return np.ascontiguousarray((g if part == "g" else u).T)
+            return g if part == "g" else u  # raw [out, in]
 
-        p["w_gate"] = stack(lambda i: split_gate(i, "g"))
-        p["w_up"] = stack(lambda i: split_gate(i, "u"))
+        p["w_gate"] = stack_t(lambda i: split_gate(i, "g"))
+        p["w_up"] = stack_t(lambda i: split_gate(i, "u"))
     else:
         if spec.gated_mlp:
-            p["w_gate"] = stack(lambda i: t(lp.format(i=i) + "mlp.gate_proj.weight"))
-        p["w_up"] = stack(lambda i: t(lp.format(i=i) + "mlp.up_proj.weight"))
+            p["w_gate"] = stack_t(lambda i: t(lp.format(i=i) + "mlp.gate_proj.weight"))
+        p["w_up"] = stack_t(lambda i: t(lp.format(i=i) + "mlp.up_proj.weight"))
     if not spec.n_experts:
-        p["w_down"] = stack(lambda i: t(lp.format(i=i) + "mlp.down_proj.weight"))
+        p["w_down"] = stack_t(lambda i: t(lp.format(i=i) + "mlp.down_proj.weight"))
 
     if spec.qk_norm:  # qwen3 per-head q/k norms
         p["q_norm_w"] = stack(
@@ -308,7 +368,7 @@ def load_params(
         # multimodal wrappers nest the head (llava: language_model.lm_head)
         for head in ("lm_head.weight", "language_model.lm_head.weight"):
             if head in names:
-                p["lm_head"] = _cast(t(head), dtype)
+                p["lm_head"] = tcast(t(head))
                 break
         else:  # checkpoint ties despite config
             object.__setattr__(spec, "tie_word_embeddings", True)

@@ -54,6 +54,22 @@ def quantize_embed(w: jax.Array) -> QTensor:
     return QTensor(q=q.astype(jnp.int8), scale=scale)
 
 
+def quantize_raw_tensor(w_raw: jax.Array) -> QTensor:
+    """Quantize a RAW torch-layout weight ([..., out, in]) and transpose
+    the int8 result into the serving layout ([..., in, out]).
+
+    The scale reduces over the input dim (axis -1 in raw layout), so the
+    values are identical to ``quantize_tensor`` on the transposed array;
+    the transpose then moves 1-byte int8 instead of 2-byte bf16, and
+    under jit the cast+scale+round+transpose fuse into one XLA op —
+    this is the device-streaming load path's kernel."""
+    wf = w_raw.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(wf), axis=-1) / 127.0 + 1e-12  # [..., out]
+    q = jnp.clip(jnp.round(wf / scale[..., None]), -127, 127)
+    return QTensor(q=jnp.swapaxes(q.astype(jnp.int8), -1, -2),
+                   scale=scale)
+
+
 def quantize_params(params: dict[str, Any],
                     embeddings: bool = False) -> dict[str, Any]:
     """Quantize the eligible projection stacks in place of their bf16

@@ -143,6 +143,11 @@ class JaxLLMBackend(Backend):
                 def staged():
                     return (jax.default_device(jax.devices("cpu")[0])
                             if will_quant else contextlib.nullcontext())
+
+                defer_commit = False  # streaming device commit
+                artifact_hit = False  # pre-quantized tree from cache
+                artifact_file = None
+                params = None
                 if is_gguf:
                     # GGUF: dequantize-on-load (ref: the reference's
                     # primary format — initializers.go:498-559); the
@@ -191,9 +196,43 @@ class JaxLLMBackend(Backend):
                         self.tokenizer = load_tokenizer(model_dir)
                         self._state = "READY"
                         return Result(True, "mamba model loaded")
-                    with staged():
-                        self.spec, params = load_params(
-                            model_dir, dtype=dtype, state=hf_state)
+                    # single-chip quantized loads stream raw leaves to
+                    # the chip and fuse cast+transpose+quantize there
+                    # (models/staging.py) — the host-staged eager
+                    # pipeline measured ~10 min on an 8B where this is
+                    # tens of seconds; an on-disk int8 artifact
+                    # (models/artifact_cache.py) makes repeat loads skip
+                    # the bf16 tree entirely, like the reference's
+                    # pre-quantized GGUF flow
+                    defer_commit = (
+                        will_quant and not opts.mesh
+                        and not opts.lora_adapters)
+                    if defer_commit:
+                        from ..models.artifact_cache import (
+                            artifact_path, try_load)
+                        from ..models.llm_spec import spec_from_hf_config
+
+                        artifact_file = artifact_path(
+                            model_dir, quant, str(dtype.__name__))
+                        params = try_load(artifact_file,
+                                          jax.devices()[0])
+                        if params is not None:
+                            self.spec = spec_from_hf_config(hf_state[0])
+                            if "lm_head" not in params:
+                                # mirror load_params' correction for
+                                # checkpoints that tie despite config
+                                # (hf_loader tie fallback) — the
+                                # artifact has no lm_head leaf then
+                                object.__setattr__(
+                                    self.spec, "tie_word_embeddings",
+                                    True)
+                            artifact_hit = True
+                            defer_commit = False
+                    if params is None:
+                        with staged():
+                            self.spec, params = load_params(
+                                model_dir, dtype=dtype, state=hf_state,
+                                defer_transpose=defer_commit)
                 # merge LoRA adapters at load (ref: llama.cpp LoRA apply
                 # via LoadModel — proto LoraAdapter/LoraScale)
                 with staged():
@@ -242,7 +281,20 @@ class JaxLLMBackend(Backend):
                 # and quantization must agree (host-committed params
                 # with no quantize, or device-committed full-precision
                 # 8B, are both failure modes)
-                if self._quantized:
+                if defer_commit:  # implies self._quantized
+                    # streaming commit: raw leaves -> device, fused
+                    # cast+transpose+quantize there; then persist the
+                    # int8 tree for the next load of this checkpoint
+                    from ..models.artifact_cache import save_async
+                    from ..models.staging import commit_deferred
+
+                    params = commit_deferred(
+                        params, dtype, jax.devices()[0],
+                        quantize=True,
+                        quantize_embeddings=quant == "int8_full")
+                    if artifact_file:
+                        save_async(artifact_file, params)
+                elif self._quantized and not artifact_hit:
                     # AFTER LoRA merge: adapters fold into full-precision
                     # weights first, then the projections quantize.
                     # int8_full also quantizes embed/lm_head (~2 GB on an

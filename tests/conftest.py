@@ -10,6 +10,9 @@ Env must be set before the first ``import jax`` anywhere in the process.
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# quantized-load artifacts would leak between runs via ~/.cache and flip
+# which load path a test exercises; the dedicated tests opt back in
+os.environ.setdefault("LOCALAI_QUANT_ARTIFACTS", "off")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

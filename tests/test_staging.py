@@ -1,0 +1,191 @@
+"""Device-streaming load commit + quantized artifact cache.
+
+The streaming path (models/staging.py) must produce bit-identical
+parameters to the host-staged quantize it replaces, and the artifact
+cache (models/artifact_cache.py) must round-trip the committed tree and
+miss cleanly on any checkpoint/config change — these are load-path
+correctness guarantees for the serving int8 mode (ref: the reference
+loads pre-quantized GGUFs, initializers.go:498-559; our artifact gives
+repeat loads the same property).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from .test_model import _save_tiny
+
+
+def test_quantize_raw_matches_transposed():
+    from localai_tfp_tpu.models.quant import (
+        quantize_raw_tensor, quantize_tensor)
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(3, 64, 48)).astype(np.float32))
+    a = quantize_tensor(w)
+    b = quantize_raw_tensor(jnp.swapaxes(w, -1, -2))
+    np.testing.assert_array_equal(np.asarray(a.q), np.asarray(b.q))
+    np.testing.assert_array_equal(np.asarray(a.scale), np.asarray(b.scale))
+
+
+def _tree_equal(a, b, exact_q=True):
+    """exact_q=False tolerates ±1 int8 on a <0.5% sliver of elements:
+    jit fuses the divide+round differently from the eager path (fma /
+    reciprocal choices), so values exactly on a rounding knife-edge can
+    land one code apart — same quantization quality, not a layout or
+    math bug."""
+    from localai_tfp_tpu.models.quant import QTensor
+
+    assert set(a) == set(b), (sorted(a), sorted(b))
+    for name in a:
+        la, lb = a[name], b[name]
+        if isinstance(la, QTensor) or isinstance(lb, QTensor):
+            assert isinstance(la, QTensor) and isinstance(lb, QTensor), name
+            qa = np.asarray(la.q).astype(np.int32)
+            qb = np.asarray(lb.q).astype(np.int32)
+            if exact_q:
+                np.testing.assert_array_equal(qa, qb, err_msg=name)
+            else:
+                diff = np.abs(qa - qb)
+                assert diff.max() <= 1, (name, diff.max())
+                frac = (diff > 0).mean()
+                assert frac < 0.005, (name, frac)
+            np.testing.assert_allclose(
+                np.asarray(la.scale), np.asarray(lb.scale), rtol=1e-6,
+                err_msg=name)
+        else:
+            np.testing.assert_allclose(
+                np.asarray(la, dtype=np.float32),
+                np.asarray(lb, dtype=np.float32), rtol=1e-2, atol=1e-2,
+                err_msg=name)
+
+
+@pytest.mark.parametrize("family", ["llama", "qwen2_moe"])
+def test_defer_commit_matches_staged_quantize(tmp_path, family):
+    from localai_tfp_tpu.models.hf_loader import load_params
+    from localai_tfp_tpu.models.quant import quantize_params
+    from localai_tfp_tpu.models.staging import commit_deferred
+
+    model_dir = _save_tiny(tmp_path, family)
+    _, staged = load_params(model_dir, dtype=jnp.bfloat16)
+    staged = quantize_params(staged, embeddings=True)
+
+    _, deferred = load_params(model_dir, dtype=jnp.bfloat16,
+                              defer_transpose=True)
+    committed = commit_deferred(deferred, jnp.bfloat16, jax.devices()[0],
+                                quantize=True, quantize_embeddings=True)
+    _tree_equal(staged, committed, exact_q=False)
+
+
+def test_artifact_roundtrip_and_fingerprint(tmp_path, monkeypatch):
+    from localai_tfp_tpu.models import artifact_cache as ac
+    from localai_tfp_tpu.models.hf_loader import load_params
+    from localai_tfp_tpu.models.staging import commit_deferred
+
+    monkeypatch.setenv("LOCALAI_QUANT_ARTIFACTS", "on")
+    monkeypatch.setenv("LOCALAI_QUANT_CACHE_DIR", str(tmp_path / "qc"))
+
+    model_dir = _save_tiny(tmp_path, "llama")
+    _, deferred = load_params(model_dir, dtype=jnp.bfloat16,
+                              defer_transpose=True)
+    committed = commit_deferred(deferred, jnp.bfloat16, jax.devices()[0],
+                                quantize=True, quantize_embeddings=True)
+
+    path = ac.artifact_path(model_dir, "int8_full", "bfloat16")
+    t = ac.save_async(path, committed)
+    assert t is not None
+    t.join(timeout=120)
+    assert os.path.exists(path)
+
+    loaded = ac.try_load(path, jax.devices()[0])
+    assert loaded is not None
+    _tree_equal(committed, loaded)
+
+    # a different quant config is a different artifact
+    assert ac.artifact_path(model_dir, "int8", "bfloat16") != path
+    # touching the checkpoint invalidates the fingerprint
+    st_file = os.path.join(model_dir, "model.safetensors")
+    os.utime(st_file, ns=(123456789, 987654321012345678))
+    assert ac.artifact_path(model_dir, "int8_full", "bfloat16") != path
+    # disabled -> no read, no write
+    monkeypatch.setenv("LOCALAI_QUANT_ARTIFACTS", "off")
+    assert ac.try_load(path, jax.devices()[0]) is None
+    assert ac.save_async(path, committed) is None
+
+
+def test_artifact_eviction_and_alias(tmp_path, monkeypatch):
+    from localai_tfp_tpu.models import artifact_cache as ac
+
+    # quant aliases share one artifact; int8_full stays distinct
+    model_dir = _save_tiny(tmp_path, "llama")
+    assert ac.artifact_path(model_dir, "q8", "bfloat16") == \
+        ac.artifact_path(model_dir, "int8", "bfloat16")
+    assert ac.artifact_path(model_dir, "int8", "bfloat16") != \
+        ac.artifact_path(model_dir, "int8_full", "bfloat16")
+
+    root = tmp_path / "qc"
+    root.mkdir()
+    old = root / "old.safetensors"
+    new = root / "new.safetensors"
+    old.write_bytes(b"x" * 2048)
+    new.write_bytes(b"y" * 2048)
+    os.utime(old, (1, 1))  # least recently used
+    monkeypatch.setenv("LOCALAI_QUANT_CACHE_MAX_GB", str(3000 / 1e9))
+    ac._evict_over_budget(str(root), keep=str(new))
+    assert not old.exists()
+    assert new.exists()
+
+
+def test_worker_load_hits_artifact_second_time(tmp_path, monkeypatch):
+    """End-to-end through JaxLLMBackend: first quantized load writes the
+    artifact, a second load of the same checkpoint reads it back and
+    serves identical text."""
+    from localai_tfp_tpu.models import artifact_cache as ac
+    from localai_tfp_tpu.workers.base import ModelLoadOptions, PredictOptions
+    from localai_tfp_tpu.workers.llm import JaxLLMBackend
+
+    monkeypatch.setenv("LOCALAI_QUANT_ARTIFACTS", "on")
+    monkeypatch.setenv("LOCALAI_QUANT_CACHE_DIR", str(tmp_path / "qc"))
+
+    model_dir = _save_tiny(tmp_path, "llama")
+
+    def load_once():
+        be = JaxLLMBackend()
+        res = be.load_model(ModelLoadOptions(
+            model=model_dir, quantization="int8_full",
+            context_size=64, batch_slots=2))
+        assert res.success, res.message
+        rep = be.predict(PredictOptions(
+            prompt="ab", tokens=4, ignore_eos=True, temperature=0.0))
+        assert not rep.error
+        be.shutdown()
+        return rep.message
+
+    calls = {"hit": 0}
+    real = ac.try_load
+
+    def counting(path, device):
+        r = real(path, device)
+        if r is not None:
+            calls["hit"] += 1
+        return r
+
+    monkeypatch.setattr(ac, "try_load", counting)
+
+    first = load_once()
+    # the artifact write is async; wait for the file
+    import glob
+    import time
+
+    deadline = time.time() + 120
+    while time.time() < deadline and not glob.glob(
+            str(tmp_path / "qc" / "*.safetensors")):
+        time.sleep(0.2)
+    assert glob.glob(str(tmp_path / "qc" / "*.safetensors"))
+
+    second = load_once()
+    assert calls["hit"] == 1
+    assert first == second
