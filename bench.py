@@ -557,24 +557,18 @@ def main() -> None:
 
             register_default_backends()
             state.config_loader.load_configs_from_path()
-            # the quant-artifact cache (models/artifact_cache.py) makes
-            # repeat loads of the same checkpoint skip the bf16 tree;
-            # report which path this run actually took so the number is
-            # interpretable (cold ~11 min: disk+stream-quantize+warmup;
-            # artifact ~90 s: int8 read+transfer+warmup)
-            from localai_tfp_tpu.models.artifact_cache import (
-                artifact_path as _ap, enabled as _ap_on)
-
-            extra["checkpoint_load_mode"] = (
-                "artifact" if _ap_on() and os.path.exists(
-                    _ap(cache_ckpt, "int8_full", "bfloat16"))
-                else "full")
             t0 = _time.perf_counter()
             backend = state.model_loader.load(
                 state.config_loader.get("bench8b"))
             extra["checkpoint_load_s"] = round(
                 _time.perf_counter() - t0, 1)  # incl. int8 quantize +
             # engine warmup (the jit-variant precompile)
+            # which path the load ACTUALLY took, from the worker itself
+            # (cold ~11 min: disk+stream-quantize+warmup; artifact
+            # ~90 s: int8 read+transfer+warmup) — so the number above
+            # is interpretable
+            extra["checkpoint_load_mode"] = getattr(
+                backend, "load_mode", "unknown")
             eng8, tok8 = backend.engine, backend.tokenizer
             # 512-token streams: admission raggedness amortizes over the
             # stream length, so throughput reflects serving, not edges

@@ -25,6 +25,7 @@ import logging
 import os
 import tempfile
 import threading
+import time
 from typing import Any, Optional
 
 import numpy as np
@@ -117,6 +118,9 @@ def try_load(path: str, device) -> Optional[dict[str, Any]]:
             os.utime(path, None)
         except OSError:
             pass
+        # hit path never writes, so it is the only chance to reap a
+        # .tmp orphaned by a process killed mid-write
+        _evict_over_budget(os.path.dirname(path), keep=path)
         return params
     except Exception as e:
         log.warning("quant artifact %s unreadable (%r) — full load", path, e)
@@ -131,9 +135,17 @@ def _host(x) -> np.ndarray:
     return np.ascontiguousarray(np.asarray(x))
 
 
-def _flatten(params: dict[str, Any]) -> dict[str, np.ndarray]:
+def _flatten(params: dict[str, Any],
+             yield_fn=None) -> dict[str, np.ndarray]:
+    """Pull every leaf to host. ``yield_fn`` (if given) runs before
+    each leaf pull so a live engine's dispatches interleave with ours
+    on the host<->device link instead of queueing behind a 7.5 GB
+    drain (before, not after: the pull following the last leaf is the
+    disk write, which contends with nothing)."""
     flat: dict[str, np.ndarray] = {}
     for name, leaf in params.items():
+        if yield_fn is not None:
+            yield_fn()
         if isinstance(leaf, QTensor):
             flat[name + ".q"] = _host(leaf.q)
             flat[name + ".scale"] = _host(leaf.scale)
@@ -151,11 +163,25 @@ def _evict_over_budget(root: str, keep: str) -> None:
         budget = float(os.environ.get(
             "LOCALAI_QUANT_CACHE_MAX_GB", "50")) * 1e9
         files = []
+        now = time.time()
         for f in os.listdir(root):
-            if not f.endswith(".safetensors"):
-                continue
             p = os.path.join(root, f)
-            st = os.stat(p)
+            try:
+                if f.endswith(".tmp"):
+                    # a killed process (daemon writer dies with it)
+                    # leaves the temp file behind; anything an hour old
+                    # is not a write in progress (save_file refreshes
+                    # mtime as it streams)
+                    if now - os.stat(p).st_mtime > 3600:
+                        os.unlink(p)
+                        log.info("stale quant artifact temp removed: "
+                                 "%s", p)
+                    continue
+                if not f.endswith(".safetensors"):
+                    continue
+                st = os.stat(p)
+            except FileNotFoundError:
+                continue  # concurrent writer renamed/removed it
             files.append((st.st_atime, st.st_size, p))
         total = sum(s for _, s, _ in files)
         for _, size, p in sorted(files):
@@ -170,17 +196,70 @@ def _evict_over_budget(root: str, keep: str) -> None:
         log.warning("quant artifact eviction skipped (%r)", e)
 
 
-def save_async(path: str, params: dict[str, Any]) -> Optional[threading.Thread]:
-    """Write the committed tree in a daemon thread (device->host pulls
-    ride the transfer link at low duty; the write renames atomically).
-    Returns the thread for tests to join."""
+class _Aborted(Exception):
+    pass
+
+
+def save_async(path: str, params: dict[str, Any],
+               idle: Optional[Any] = None,
+               idle_wait_s: float = 600.0,
+               pace_s: float = 0.02,
+               abort: Optional[threading.Event] = None,
+               ) -> Optional[threading.Thread]:
+    """Write the committed tree in a daemon thread, deferring to live
+    traffic. The measured failure mode this guards against: an 8B int8
+    tree is ~7.5 GB, and pulling it device->host while the engine is
+    serving its first requests rides the same transfer link as every
+    dispatch — a bench round that overlapped the write saw steady-state
+    TTFT triple. So the thread first waits (up to ``idle_wait_s``) for
+    ``idle()`` to hold over three consecutive 0.5 s polls, then pulls
+    leaf-at-a-time with a ``pace_s`` gap, re-checking ``idle()`` before
+    each pull and pausing (bounded) while traffic is in flight. Setting
+    ``abort`` (model reload, worker shutdown) abandons the write — the
+    thread would otherwise pin the OLD model's device tree while a new
+    one loads. The write renames atomically. Returns the thread for
+    tests to join."""
     if not enabled():
         return None
 
+    # the thread takes its params reference through this box and drops
+    # it once every leaf is on host — a reload during the (long) disk
+    # write must not find the old device tree still pinned by us
+    box = [params]
+    del params
+
+    def _quiet(consecutive: int, budget_s: float) -> None:
+        if idle is None:
+            return
+        deadline = time.monotonic() + budget_s
+        streak = 0
+        while streak < consecutive and time.monotonic() < deadline:
+            if abort is not None and abort.is_set():
+                raise _Aborted
+            try:
+                ok = bool(idle())
+            except Exception:
+                ok = True  # a dead engine can't contend
+            streak = streak + 1 if ok else 0
+            if streak < consecutive:
+                time.sleep(0.5)
+
     def work() -> None:
         try:
+            _quiet(consecutive=3, budget_s=idle_wait_s)
+
+            def breathe() -> None:
+                if abort is not None and abort.is_set():
+                    raise _Aborted
+                time.sleep(pace_s)
+                # a request arrived mid-drain: back off (bounded, so
+                # nonstop traffic still lets the write finish)
+                _quiet(consecutive=1, budget_s=5.0)
+
             os.makedirs(os.path.dirname(path), exist_ok=True)
-            flat = _flatten(params)
+            flat = _flatten(box.pop(), yield_fn=breathe)
+            if abort is not None and abort.is_set():
+                raise _Aborted
             from safetensors.numpy import save_file
 
             fd, tmp = tempfile.mkstemp(
@@ -194,6 +273,9 @@ def save_async(path: str, params: dict[str, Any]) -> Optional[threading.Thread]:
                 if os.path.exists(tmp):
                     os.unlink(tmp)
             _evict_over_budget(os.path.dirname(path), keep=path)
+        except _Aborted:
+            log.info("quant artifact write abandoned (reload/shutdown): "
+                     "%s", path)
         except Exception as e:  # cache write must never fail a load
             log.warning("quant artifact write failed (%r): %s", e, path)
 

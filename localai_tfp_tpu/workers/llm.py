@@ -68,8 +68,28 @@ class JaxLLMBackend(Backend):
         self.mamba: Any = None  # (MambaSpec, params) — SSM family
         self.rwkv: Any = None  # (RwkvSpec, params) — RWKV recurrent
         # family (ref fixture tests/models_fixtures/rwkv.yaml)
+        self._artifact_thread: Any = None  # deferred quant-cache write
+        self._artifact_abort = threading.Event()
+        self.load_mode = "unknown"  # "artifact" | "full" after a load
 
     # ------------------------------------------------------------- lifecycle
+
+    def _abort_pending_artifact(self) -> None:
+        """A quant-cache drain still in flight pins the OLD device tree
+        (7.5 GB at 8B) and contends on the transfer link — both fatal
+        to a reload on a 16 GB chip. Abandon it before proceeding."""
+        t = self._artifact_thread
+        if t is not None and t.is_alive():
+            self._artifact_abort.set()
+            t.join(timeout=30)
+            if t.is_alive():  # stuck in one huge pull or save_file
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "quant artifact writer did not stop within 30s; "
+                    "proceeding — expect transfer-link/host-RAM "
+                    "contention until it exits")
+        self._artifact_thread = None
 
     def load_model(self, opts: ModelLoadOptions) -> Result:
         from ..parallel import multihost
@@ -78,8 +98,10 @@ class JaxLLMBackend(Backend):
         role = self._role or multihost.role()
         with self._lock:
             # cheap validations FIRST: a typo'd knob must fail in
-            # milliseconds, before checkpoint IO and before the multihost
-            # load broadcast fans the doomed load out to followers
+            # milliseconds, before checkpoint IO, before the multihost
+            # load broadcast fans the doomed load out to followers, and
+            # before a doomed load abandons the PREVIOUS model's pending
+            # artifact write
             quant = (opts.quantization or "").lower()
             if quant and quant not in ("int8", "q8", "q8_0", "w8",
                                        "int8_full", "none", "f16", "fp16",
@@ -113,6 +135,7 @@ class JaxLLMBackend(Backend):
                     False,
                     f"load failed: model not found: {model_dir}",
                 )
+            self._abort_pending_artifact()  # the real load begins here
             if channel is not None and role == "leader":
                 # followers load the identical checkpoint from their own
                 # disk (in parallel with ours) and then replay this
@@ -147,6 +170,7 @@ class JaxLLMBackend(Backend):
                 defer_commit = False  # streaming device commit
                 artifact_hit = False  # pre-quantized tree from cache
                 artifact_file = None
+                pending_artifact = None  # written after warmup
                 params = None
                 if is_gguf:
                     # GGUF: dequantize-on-load (ref: the reference's
@@ -283,17 +307,17 @@ class JaxLLMBackend(Backend):
                 # 8B, are both failure modes)
                 if defer_commit:  # implies self._quantized
                     # streaming commit: raw leaves -> device, fused
-                    # cast+transpose+quantize there; then persist the
-                    # int8 tree for the next load of this checkpoint
-                    from ..models.artifact_cache import save_async
+                    # cast+transpose+quantize there; the int8 tree
+                    # persists for the next load AFTER warmup (below) —
+                    # the 7.5 GB device->host drain must not contend
+                    # with warmup or first requests
                     from ..models.staging import commit_deferred
 
                     params = commit_deferred(
                         params, dtype, jax.devices()[0],
                         quantize=True,
                         quantize_embeddings=quant == "int8_full")
-                    if artifact_file:
-                        save_async(artifact_file, params)
+                    pending_artifact = artifact_file
                 elif self._quantized and not artifact_hit:
                     # AFTER LoRA merge: adapters fold into full-precision
                     # weights first, then the projections quantize.
@@ -351,6 +375,24 @@ class JaxLLMBackend(Backend):
                     # landing mid-request is a ~13s TTFT outlier at 8B
                     # scale (engine.warmup docstring)
                     self.engine.warmup()
+                # which load path this load ACTUALLY took (bench and
+                # operators read it; inferring it from artifact-file
+                # existence mislabels version-mismatch/corrupt misses)
+                self.load_mode = "artifact" if artifact_hit else "full"
+                if pending_artifact:
+                    from ..models.artifact_cache import save_async
+
+                    eng = self.engine
+
+                    def _engine_idle() -> bool:
+                        # _has_work covers queued requests and in-flight
+                        # dispatches, not just occupied slots
+                        return eng is None or not eng._has_work()
+
+                    self._artifact_abort = threading.Event()
+                    self._artifact_thread = save_async(
+                        pending_artifact, params, idle=_engine_idle,
+                        abort=self._artifact_abort)
                 self._state = "READY"
                 return Result(True, "model loaded")
             except Exception as e:
@@ -364,6 +406,7 @@ class JaxLLMBackend(Backend):
     def shutdown(self) -> None:
         from ..parallel import multihost
 
+        self._abort_pending_artifact()
         tag = self.engine.tag if self.engine is not None else ""
         if self.engine is not None:
             # close BEFORE broadcasting unload: the scheduler thread must

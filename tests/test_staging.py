@@ -161,6 +161,12 @@ def test_worker_load_hits_artifact_second_time(tmp_path, monkeypatch):
         rep = be.predict(PredictOptions(
             prompt="ab", tokens=4, ignore_eos=True, temperature=0.0))
         assert not rep.error
+        # the write is deferred until the engine idles; shutdown()
+        # ABANDONS an unfinished write (it pins the device tree), so a
+        # server that wants the cache must outlive the drain — as any
+        # real deployment does
+        if be._artifact_thread is not None:
+            be._artifact_thread.join(timeout=120)
         be.shutdown()
         return rep.message
 
@@ -189,3 +195,71 @@ def test_worker_load_hits_artifact_second_time(tmp_path, monkeypatch):
     second = load_once()
     assert calls["hit"] == 1
     assert first == second
+
+
+def test_save_async_defers_to_busy_engine(tmp_path, monkeypatch):
+    """The artifact drain must wait for the idle predicate before
+    pulling any leaf (a 7.5 GB device->host drain overlapping first
+    requests tripled steady-state TTFT in a bench round)."""
+    import threading
+    import time as _time
+
+    from localai_tfp_tpu.models import artifact_cache as ac
+
+    monkeypatch.setenv("LOCALAI_QUANT_ARTIFACTS", "on")
+
+    busy = threading.Event()
+    busy.set()
+    pulled = []
+    real_host = ac._host
+
+    def spying_host(x):
+        pulled.append(busy.is_set())
+        return real_host(x)
+
+    monkeypatch.setattr(ac, "_host", spying_host)
+
+    params = {"a": jnp.ones((4, 4)), "b": jnp.zeros((2,))}
+    path = str(tmp_path / "qc" / "x.safetensors")
+    t = ac.save_async(path, params, idle=lambda: not busy.is_set(),
+                      idle_wait_s=30.0, pace_s=0.0)
+    assert t is not None
+    _time.sleep(1.0)
+    assert pulled == []  # no pull while busy
+    busy.clear()
+    t.join(timeout=30)
+    assert os.path.exists(path)
+    assert pulled and not any(pulled)  # every pull happened while idle
+
+
+def test_save_async_abort_and_tmp_sweep(tmp_path, monkeypatch):
+    """Reload/shutdown abandons an in-flight write; a .tmp orphaned by
+    a killed process is reaped by the next eviction pass."""
+    import threading
+    import time as _time
+
+    from localai_tfp_tpu.models import artifact_cache as ac
+
+    monkeypatch.setenv("LOCALAI_QUANT_ARTIFACTS", "on")
+
+    root = tmp_path / "qc"
+    root.mkdir()
+    path = str(root / "x.safetensors")
+
+    abort = threading.Event()
+    abort.set()  # abort before the first pull
+    t = ac.save_async(path, {"a": jnp.ones((4, 4))},
+                      idle=lambda: True, abort=abort)
+    t.join(timeout=30)
+    assert not os.path.exists(path)
+    assert not list(root.glob("*.tmp"))
+
+    # stale tmp (old mtime) is swept; a fresh one is left alone
+    stale = root / "dead.tmp"
+    stale.write_bytes(b"x" * 16)
+    os.utime(stale, ns=(1, 1))
+    fresh = root / "live.tmp"
+    fresh.write_bytes(b"y" * 16)
+    ac._evict_over_budget(str(root), keep=path)
+    assert not stale.exists()
+    assert fresh.exists()
