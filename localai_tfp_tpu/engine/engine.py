@@ -317,6 +317,12 @@ class LLMEngine:
         draft: Optional[tuple[LLMSpec, Params]] = None,  # speculative
         # decoding draft model (ref: proto DraftModel/NDraft plumbing)
         n_draft: int = 4,
+        latency_target_ms: Optional[float] = None,  # open-capacity
+        # latency/throughput knob: bound in-flight decode device-time to
+        # this budget whenever a slot is free, so an unpredicted
+        # arrival's prefill queues behind at most ~one short scan.
+        # None = balanced (scans stay long enough to cover the dispatch
+        # RTT; see _latency_k)
         autostart: bool = True,
         channel: Any = None,  # multihost dispatch publisher (leader side);
         # every device dispatch is published as a (kind, payload) record
@@ -333,6 +339,7 @@ class LLMEngine:
         if follower:
             autostart = False
         self.decode_steps = max(1, decode_steps)
+        self.latency_target_ms = latency_target_ms
         self.mesh = mesh
         self.draft = draft
         self.n_draft = max(2, n_draft)
@@ -424,6 +431,9 @@ class LLMEngine:
         # decode scheduling yields briefly to an admission burst
         self._hold_start = 0.0  # when the current admission-burst hold
         # began (0 = not holding); bounds hold duration
+        self._step_ms = 0.0  # EWMA of device ms per decode step,
+        # measured at scan harvest; _latency_k sizes open-capacity
+        # scans from it
 
     def _kernel_eligible(self) -> bool:
         """Use the Pallas ragged decode kernels when the mosaic path is
@@ -1148,8 +1158,7 @@ class LLMEngine:
             "pos0": np.zeros((S,), np.int32),
             "active": np.zeros((S,), bool),
         }
-        ks = {1, min(4, self.decode_steps), self._half_k,
-              self.decode_steps}
+        ks = self._warm_ks
         if self._use_kernel:
             windows_d = {self.max_seq}  # ragged kernel: one variant
         else:
@@ -1666,6 +1675,53 @@ class LLMEngine:
         return h
 
     @property
+    def _warm_ks(self) -> set:
+        """Every scan length warmup() precompiles — the ONLY values any
+        runtime k decision may produce (a cold k jits ~13 s mid-request
+        at 8B scale). The {2,4,8,16} rungs give _latency_k a dense
+        ladder to snap to across model scales."""
+        return {k for k in (1, 2, 4, 8, 16) if k <= self.decode_steps} | {
+            self._half_k, self.decode_steps}
+
+    # the shortest scan worth dispatching: device work per scan should
+    # cover the dispatch round trip (~100 ms through the tunnel; a few
+    # ms PCIe-attached) or the device idles between scans — measured as
+    # the 1B drain collapsing to 1/4 throughput under a flat k=4 clamp
+    _LAT_TARGET_MS = 90.0
+
+    def _latency_k(self, lat_mode: bool = False) -> int:
+        """Scan length for open-capacity periods, from the
+        harvest-measured per-step EWMA.
+
+        Balanced (lat_mode False): the smallest WARMED k whose device
+        time still covers the dispatch RTT — an unpredicted arrival
+        waits behind short scans (steady p50 404 -> ~320 ms measured at
+        8B, k snaps to 4 at 32 ms/step) and open-capacity throughput
+        stays roofline across scales (the 1B config, 9 ms/step, keeps
+        k=16 and its drain throughput).
+
+        Latency mode (lat_mode True: latency_target_ms set, open
+        capacity, not a drain tail): the LARGEST warmed k that fits the
+        budget — combined with the depth-1 gate in the scan decision,
+        total queued decode work stays under the budget, so steady TTFT
+        rides the dispatch floor (p50 404 -> 255 ms, min at the ~145 ms
+        tunnel floor, measured by tools/profile_steady.py). Open-
+        capacity decode deliberately stops covering the RTT: that is
+        the knob."""
+        if self._step_ms <= 0.0:
+            return self.decode_steps  # no samples yet: don't throttle
+        if lat_mode and self.latency_target_ms is not None:
+            best = 0
+            for k in sorted(self._warm_ks):
+                if k > 1 and k * self._step_ms <= self.latency_target_ms:
+                    best = k
+            return best or min(k for k in self._warm_ks if k > 1)
+        for k in sorted(self._warm_ks):
+            if k > 1 and k * self._step_ms >= self._LAT_TARGET_MS:
+                return k
+        return self.decode_steps
+
+    @property
     def _legacy_prefill_max(self) -> int:
         """Identity/legacy prefill split point. warmup() precompiles
         exactly the legacy shapes below it and _enqueue_prefill_final
@@ -2020,18 +2076,34 @@ class LLMEngine:
                 return False
             self._decode1_step(decoding)
             return True
-        if len(dflights) >= self._pipeline_depth or room < k:
+        free = any(not s.active for s in self.slots)
+        depth = self._pipeline_depth
+        lat_mode = (self.latency_target_ms is not None and free
+                    and not self._pending
+                    and now - self._last_arrival >= 1.0
+                    # a wave's drain tail (every stream within ONE full
+                    # scan of its budget) finishes at full k: throttling
+                    # it only delays the wall clock, no arrival benefits.
+                    # Kept at one scan, not more: continuous short-
+                    # generation service must still engage the clamp
+                    and need_tokens > self.decode_steps)
+        if lat_mode:
+            # latency mode at open capacity: ONE short scan in flight at
+            # a time, so total queued decode work stays under the
+            # budget. The device idles the dispatch RTT between scans —
+            # the throughput half of the knob's tradeoff.
+            depth = 1
+        if len(dflights) >= depth or room < k:
             return False
         if need_tokens <= in_flight:
             return False  # everything already covered by in-flight scans
-        if ((self._pending or now - self._last_arrival < 1.0)
-                and any(not s.active for s in self.slots)):
+        if (self._pending or now - self._last_arrival < 1.0) and free:
             # arrivals active with admissible room: a late request's
             # prefill dispatch queues on the device BEHIND this scan —
             # keep it short so burst TTFT is not hostage to a long
-            # scan. (Free slots alone must NOT shrink k: that throttled
-            # the whole drain phase of a wave to 1/4 throughput,
-            # measured on the 1B config.)
+            # scan. (A flat k=4 on free slots ALONE throttled the 1B
+            # drain to 1/4 throughput; the open-capacity case below
+            # sizes k from measured step time instead.)
             k = min(k, 4)
         elif waiting and now - self._last_arrival < 1.0:
             # a fresh arrival's prefill is pending/in flight with every
@@ -2041,6 +2113,13 @@ class LLMEngine:
             # of the burst clamp, at half the dispatch-overhead cost
             # (_half_k is always in warmup's variant set)
             k = min(k, self._half_k)
+        elif free:
+            # open capacity, no arrival in sight: an UNPREDICTED
+            # arrival's prefill queues behind whatever scans are in
+            # flight when it lands, so bound that queue in TIME (see
+            # _latency_k for the balanced/latency-mode policies and
+            # their measured effect).
+            k = min(k, self._latency_k(lat_mode))
 
         S = self.n_slots
         if self._use_kernel:
@@ -2121,6 +2200,11 @@ class LLMEngine:
                 "prev_last": (None if dflights else
                               {s.idx: int(tokens[s.idx, 0])
                                for s in decoding}),
+                # enqueued behind other device work: its harvest-to-
+                # harvest gap measures DEVICE time (the step EWMA's
+                # input); a scan enqueued onto an idle device measures
+                # device time + dispatch RTT, which must not pollute it
+                "saturated": bool(self._flights),
             },
             t_enqueue=time.perf_counter(),
         ))
@@ -2134,6 +2218,20 @@ class LLMEngine:
         now = time.perf_counter()
         dt_ms = (now - max(fl.t_enqueue, self._last_harvest_t)) * 1e3
         self._last_harvest_t = now
+        step = dt_ms / k
+        if (fl.meta.get("saturated") and 0.0 < step
+                and (self._step_ms == 0.0
+                     or step < 8.0 * self._step_ms)):
+            # EWMA per-step device time, from SATURATED samples only: a
+            # scan enqueued onto an idle device (latency mode's depth-1
+            # cadence) measures step + RTT, and feeding that back into
+            # _latency_k collapses k to the floor and then mis-sizes
+            # the balanced clamp too. Saturated samples keep flowing
+            # whenever all slots are busy (full k, depth 2), which is
+            # exactly when step time is cleanly observable. The 8x
+            # outlier guard drops compile/transfer stalls.
+            self._step_ms = (step if self._step_ms == 0.0
+                             else 0.8 * self._step_ms + 0.2 * step)
         prev_last = fl.meta["prev_last"]
         if prev_last is None:
             prev_last = self._harvest_last

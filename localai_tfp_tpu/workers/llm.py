@@ -360,6 +360,10 @@ class JaxLLMBackend(Backend):
                     max_seq=opts.context_size,
                     cache_dtype=kv_dtype,
                     decode_steps=int(opts.extra.get("decode_steps", 8)),
+                    latency_target_ms=(
+                        float(opts.extra["latency_target_ms"])
+                        if opts.extra.get("latency_target_ms") is not None
+                        else None),
                     mesh=mesh,
                     draft=draft,
                     n_draft=opts.n_draft or 4,

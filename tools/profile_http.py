@@ -1,0 +1,201 @@
+"""HTTP 64-burst TTFT phase timeline for the 8B serving config.
+
+BENCH r5 gap: engine-side burst p50 ~237 ms, HTTP-side ~818 ms. This
+stamps every stage each request passes through, aggregated across the
+wave (all times ms relative to the wave's t0):
+
+  recv    — handler reached (_body awaited): aiohttp accept+parse+route
+  built   — PredictOptions ready in the producer thread (template
+            render + tokenize done)
+  submit  — engine.submit returned (admission queue)
+  prefill — the engine dispatched the wave's prefill_final group(s)
+  harvest — first tokens harvested (bridge put)
+  write   — client saw the first CONTENT SSE event (TTFT)
+
+Run manually on the chip:  python tools/profile_http.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import tempfile
+import time
+
+import jax
+
+
+def pct(xs, q):
+    xs = sorted(xs)
+    return round(xs[min(len(xs) - 1, int(len(xs) * q))], 1) if xs else None
+
+
+def main() -> None:
+    jax.config.update("jax_compilation_cache_dir", "/root/.cache/localai_xla")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+    from tools.profile_ttft import build_engine
+
+    from aiohttp import ClientSession, ClientTimeout, TCPConnector, web
+
+    from localai_tfp_tpu.config.app_config import ApplicationConfig
+    from localai_tfp_tpu.engine.loader import LoadedModel
+    from localai_tfp_tpu.server import openai_routes
+    from localai_tfp_tpu.server.app import build_app
+    from localai_tfp_tpu.server.state import Application
+    from localai_tfp_tpu.workers.llm import JaxLLMBackend
+
+    eng, tok, n_req, n_tok = build_engine(False)
+    eng.latency_target_ms = 70.0  # bench8b.yaml parity
+
+    tmp = tempfile.mkdtemp(prefix="prof-http-")
+    models = os.path.join(tmp, "models")
+    os.makedirs(models)
+    with open(os.path.join(models, "bench.yaml"), "w") as f:
+        f.write(
+            "name: bench\nbackend: jax-llm\n"
+            "parameters:\n  model: bench\n"
+            "template:\n"
+            '  chat_message: "{{.RoleName}}: {{.Content}}"\n'
+            '  chat: "{{.Input}}\\nassistant:"\n'
+        )
+    state = Application(ApplicationConfig(
+        models_path=models,
+        generated_content_dir=os.path.join(tmp, "generated"),
+        upload_dir=os.path.join(tmp, "uploads"),
+        config_dir=os.path.join(tmp, "configuration"),
+    ))
+    backend = JaxLLMBackend()
+    backend.engine, backend.tokenizer = eng, tok
+    backend.spec, backend._state = eng.spec, "READY"
+    state.model_loader._models["bench"] = LoadedModel(
+        "bench", "jax-llm", backend)
+    app = build_app(state)
+
+    # ---- stage stamps ----
+    stamps: dict[str, list[float]] = {
+        k: [] for k in ("recv", "built", "submit", "prefill", "harvest")}
+    t0_box = [0.0]
+
+    orig_body = openai_routes._body
+
+    async def stamped_body(request):
+        stamps["recv"].append(time.perf_counter() - t0_box[0])
+        return await orig_body(request)
+
+    openai_routes._body = stamped_body
+
+    orig_to_request = backend._to_request
+
+    def stamped_to_request(opts):
+        r = orig_to_request(opts)
+        stamps["built"].append(time.perf_counter() - t0_box[0])
+        return r
+
+    backend._to_request = stamped_to_request
+
+    orig_submit = eng.submit
+
+    def stamped_submit(req):
+        q = orig_submit(req)
+        stamps["submit"].append(time.perf_counter() - t0_box[0])
+        return q
+
+    eng.submit = stamped_submit
+
+    orig_run = eng._run
+
+    def stamped_run(kind, payload):
+        if kind == "prefill_final":
+            stamps["prefill"].append(time.perf_counter() - t0_box[0])
+        return orig_run(kind, payload)
+
+    eng._run = stamped_run
+
+    orig_complete = eng._complete_prefill_final
+
+    def stamped_complete(fl):
+        stamps["harvest"].append(time.perf_counter() - t0_box[0])
+        return orig_complete(fl)
+
+    eng._complete_prefill_final = stamped_complete
+
+    async def drive():
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        url = f"http://127.0.0.1:{port}/v1/chat/completions"
+        async with ClientSession(
+            connector=TCPConnector(limit=0),
+            timeout=ClientTimeout(total=3600),
+        ) as sess:
+
+            async def one(i, ttfts, first_byte, sent):
+                body = {
+                    "model": "bench",
+                    "messages": [{"role": "user",
+                                  "content": "benchmark " * 2 + str(i)}],
+                    "max_tokens": n_tok, "stream": True,
+                    "temperature": 0.8, "top_k": 40, "top_p": 0.95,
+                    "ignore_eos": True,
+                }
+                sent[i] = time.perf_counter() - t0_box[0]
+                async with sess.post(url, json=body) as r:
+                    assert r.status == 200, await r.text()
+                    async for line in r.content:
+                        now = time.perf_counter() - t0_box[0]
+                        if first_byte[i] is None:
+                            first_byte[i] = now
+                        if not line.startswith(b"data: "):
+                            continue
+                        if line.strip() == b"data: [DONE]":
+                            break
+                        d = json.loads(line[6:])
+                        ch = d["choices"][0]
+                        if (ch["delta"].get("content")
+                                and ttfts[i] is None):
+                            ttfts[i] = now
+                        if ch.get("finish_reason"):
+                            break
+
+            out = {}
+            for run in range(4):  # 3 warmup (compile + settle), 1 measured
+                for v in stamps.values():
+                    v.clear()
+                ttfts = [None] * 64
+                first_byte = [None] * 64
+                sent = [None] * 64
+                t0_box[0] = time.perf_counter()
+                await asyncio.gather(
+                    *[one(i, ttfts, first_byte, sent) for i in range(64)])
+                if run < 3:
+                    continue
+                s = {k: [x * 1e3 for x in v] for k, v in stamps.items()}
+                out = {
+                    "sent": {"p50": pct([x * 1e3 for x in sent], .5),
+                             "max": pct([x * 1e3 for x in sent], 1.0)},
+                    **{k: {"min": pct(v, 0.0), "p50": pct(v, .5),
+                           "max": pct(v, 1.0), "n": len(v)}
+                       for k, v in s.items()},
+                    "ttft": {"min": pct([x * 1e3 for x in ttfts if x], 0.0),
+                             "p50": pct([x * 1e3 for x in ttfts if x], .5),
+                             "p95": pct([x * 1e3 for x in ttfts if x], .95)},
+                    "first_byte_p50": pct(
+                        [x * 1e3 for x in first_byte if x], .5),
+                }
+            return out
+
+    loop = asyncio.new_event_loop()
+    try:
+        report = loop.run_until_complete(drive())
+    finally:
+        loop.close()
+    print(json.dumps(report, indent=1), flush=True)
+    eng.close()
+
+
+if __name__ == "__main__":
+    main()
