@@ -434,6 +434,12 @@ class LLMEngine:
         self._step_ms = 0.0  # EWMA of device ms per decode step,
         # measured at scan harvest; _latency_k sizes open-capacity
         # scans from it
+        self._arrivals: deque[float] = deque(maxlen=8)  # submit-call
+        # timestamps (one per submit/submit_many); _prefill_hold reads
+        # their spread to tell a still-landing burst from a lone
+        # arrival or a single batched wave
+        self._prefill_hold0 = 0.0  # when the current prefill-formation
+        # hold began (0 = not holding); bounds hold duration
 
     def _kernel_eligible(self) -> bool:
         """Use the Pallas ragged decode kernels when the mosaic path is
@@ -1209,6 +1215,7 @@ class LLMEngine:
         with self._lock:
             self._pending.extend(ok)
             self._last_arrival = time.perf_counter()
+            self._arrivals.append(self._last_arrival)
             self._lock.notify_all()
         if self._autostart:
             self.start()
@@ -1325,8 +1332,9 @@ class LLMEngine:
                 else:
                     self._prefill_step(s)  # enqueue-only, no result
                     did = True
-            if finals and self._gather_prefill():
+            if finals and self._prefill_hold():
                 finals = {}
+                did = True  # keep the loop spinning through the hold
             for bucket in sorted(finals, key=lambda b: -len(finals[b])):
                 group = finals[bucket]
                 cap = self._prefill_group_cap(bucket)
@@ -1339,24 +1347,52 @@ class LLMEngine:
             did = self._dispatch_decode(decoding) or did
         return did
 
-    def _gather_prefill(self) -> bool:
-        """While an admission burst is still landing, keep at most ONE
-        prefill_final flight in the air: the in-flight group's ~300 ms
-        tunnel round trip is the gather window that coalesces trickling
-        HTTP arrivals into one big batched prefill. Without this, a
-        64-deep HTTP wave fragments into ~10 ragged groups whose
-        serialized flights push p50 first-token PAST TWO SECONDS (engine
-        submit_many wave: 303 ms — measured r5, tools/profile_r5.py),
-        and the decode phase runs under-width until the last straggler
-        group lands. A lone request (no prefill in flight) dispatches
-        immediately; an all-at-once submit_many wave admits in one step
-        and is never split by this gate."""
-        if not any(f.kind == "prefill_final" for f in self._flights):
-            return False
+    def _prefill_hold(self) -> bool:
+        """Delay prefill dispatch while an admission burst is STILL
+        LANDING, so the burst forms one wide group instead of
+        fragmenting. Without a gate, a 64-deep HTTP wave fragments
+        into ~10 ragged serialized groups (p50 first-token past two
+        seconds, measured r5); the r5 harvest-window variant of this
+        gate (gather behind an in-flight flight until ITS harvest) left
+        a premature 2-request group in the air and made the other 62
+        wait out its whole ~230 ms round trip (tools/profile_http.py:
+        big-group prefill at t+118 ms of a burst fully submitted by
+        t+53).
+
+        "Still landing" is evidence-based: requests queued but not yet
+        admitted, >=2 distinct submit EVENTS with the newest <12 ms
+        old (loop-serialized HTTP arrivals land ~0.6 ms apart and keep
+        refreshing this; a submit_many wave is ONE event however large,
+        so a lone wave dispatches immediately — two separate waves
+        inside 12 ms pay a short bounded hold), or a single <3 ms-old
+        first arrival (grace while its burst-mates are still on the
+        wire). The total hold is bounded so a steady drip can never
+        starve prefill."""
+        now = time.perf_counter()
         with self._lock:
             pending = bool(self._pending)
-        return (pending
-                or time.perf_counter() - self._last_arrival < 0.25)
+            recent = [t for t in self._arrivals if now - t < 0.04]
+        landing = pending or (
+            # >=2 DISTINCT submit events in the window: concurrent
+            # arrivals (a submit_many wave is ONE event regardless of
+            # size, so it never trips this — loop-serialized HTTP
+            # arrivals land ~0.6 ms apart and do)
+            len(recent) >= 2 and now - recent[-1] < 0.012
+        ) or (
+            # first-arrival grace: the very first submit of a burst has
+            # no spread evidence yet, and its premature 1-2 row group
+            # cost the other 62 a full extra round trip (profile_http:
+            # p50 292 with the split vs ~255 one-group). A lone steady
+            # arrival pays only these 3 ms on its ~245 ms TTFT.
+            len(recent) == 1 and now - recent[-1] < 0.003)
+        if landing:
+            if self._prefill_hold0 == 0.0:
+                self._prefill_hold0 = now
+            if now - self._prefill_hold0 < 0.06:
+                time.sleep(1e-3)
+                return True
+        self._prefill_hold0 = 0.0
+        return False
 
     def _wait_for_event(self) -> None:
         """Nothing to enqueue and nothing ready: block until the oldest
@@ -2200,11 +2236,14 @@ class LLMEngine:
                 "prev_last": (None if dflights else
                               {s.idx: int(tokens[s.idx, 0])
                                for s in decoding}),
-                # enqueued behind other device work: its harvest-to-
-                # harvest gap measures DEVICE time (the step EWMA's
-                # input); a scan enqueued onto an idle device measures
-                # device time + dispatch RTT, which must not pollute it
-                "saturated": bool(self._flights),
+                # enqueued behind another DECODE scan: its harvest-to-
+                # harvest gap measures decode device time (the step
+                # EWMA's input). A scan enqueued onto an idle device
+                # measures device time + dispatch RTT, and one behind a
+                # prefill_final measures prefill time too (_last_harvest_t
+                # only advances on decode harvests) — neither may
+                # pollute the EWMA
+                "saturated": bool(dflights),
             },
             t_enqueue=time.perf_counter(),
         ))

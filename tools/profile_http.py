@@ -20,15 +20,20 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import sys
 import tempfile
 import time
 
-import jax
+sys.path.insert(0, "/root/repo")
+
+import jax  # noqa: E402
+
+
+from tools.profile_r5 import pct as _pct  # noqa: E402  (shared helper)
 
 
 def pct(xs, q):
-    xs = sorted(xs)
-    return round(xs[min(len(xs) - 1, int(len(xs) * q))], 1) if xs else None
+    return round(_pct(xs, q), 1) if xs else None
 
 
 def main() -> None:

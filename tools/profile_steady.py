@@ -147,7 +147,7 @@ def main() -> None:
                 }), flush=True)
                 raise
             if ev.error:
-                print("ARRIVAL ERROR:", ev.error, flush=True)
+                raise RuntimeError(f"arrival errored: {ev.error}")
             if ev.token_id is not None and ttft is None:
                 ttft = (time.perf_counter() - t0) * 1e3
             if ev.done:
