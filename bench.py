@@ -129,6 +129,64 @@ def _bench_http(state, model, n_req, n_tok, runs=2):
     app = build_app(state)
     out = {}
 
+    # LOCALAI_BENCH_TRACE=1: per-run TTFT + engine dispatch timeline to
+    # stderr — the in-context profiler for when the stock numbers and
+    # tools/profile_http.py disagree (they construct subtly different
+    # engines: this one has the engine leg's warm KV prefixes)
+    import os
+
+    trace = os.environ.get("LOCALAI_BENCH_TRACE", "") not in ("", "0")
+    eng_t = state.model_loader.get(model).backend.engine if trace else None
+    tlog: list = []
+    if trace:
+        _orig_run = eng_t._run
+
+        def _traced(kind, payload):
+            t = time.perf_counter()
+            sh = (list(payload["toks"].shape)
+                  if kind.startswith("prefill") else payload.get("k"))
+            tlog.append((kind, sh, t))
+            return _orig_run(kind, payload)
+
+        eng_t._run = _traced
+        _orig_pf = eng_t._complete_prefill_final
+        _orig_dk = eng_t._complete_decodek
+
+        def _tpf(fl):
+            t = time.perf_counter()
+            r = _orig_pf(fl)
+            tlog.append(("harvest_pf",
+                         round((time.perf_counter() - t) * 1e3, 1), t))
+            return r
+
+        def _tdk(fl):
+            t = time.perf_counter()
+            r = _orig_dk(fl)
+            tlog.append(("harvest_dk",
+                         round((time.perf_counter() - t) * 1e3, 1), t))
+            return r
+
+        eng_t._complete_prefill_final = _tpf
+        eng_t._complete_decodek = _tdk
+
+    def _trace_dump(label, t0, tts):
+        if not trace:
+            return
+        import sys as _sys
+
+        tt = sorted(t for t in tts if t is not None)
+        line = {
+            "run": label,
+            "ttft_p50": round(tt[len(tt) // 2], 1) if tt else None,
+            "ttft_p95": (round(tt[int(len(tt) * 0.95)], 1)
+                         if tt else None),
+            "dispatches": [
+                (k, sh, round((at - t0) * 1e3, 1))
+                for k, sh, at in tlog if at >= t0][:24],
+        }
+        print(f"TRACE {json.dumps(line)}", file=_sys.stderr, flush=True)
+        tlog.clear()
+
     async def drive():
         runner = web.AppRunner(app)
         await runner.setup()
@@ -163,19 +221,28 @@ def _bench_http(state, model, n_req, n_tok, runs=2):
                     url, json=body, headers={"Extra-Usage": "1"},
                 ) as r:
                     assert r.status == 200, await r.text()
+                    # lean SSE client: the bench client shares ONE host
+                    # CPU with the server it measures, and a full
+                    # json.loads of every chunk across 64 concurrent
+                    # streams showed up IN the measured TTFT (the
+                    # server's first-token write sat behind client
+                    # parse callbacks on the loop). Parse only the two
+                    # chunks that matter: first content (byte sniff)
+                    # and the finaljson with usage. A real client runs
+                    # on its own machine.
                     async for line in r.content:
                         if not line.startswith(b"data: "):
                             continue
                         if line.strip() == b"data: [DONE]":
                             break
-                        d = _json.loads(line[6:])
-                        ch = d["choices"][0]
-                        if (ch["delta"].get("content")
-                                and ttfts[i] is None):
+                        if (ttfts[i] is None
+                                and b'"content": "' in line
+                                and b'"content": ""' not in line):
                             ttfts[i] = (time.perf_counter() - t0) * 1e3
-                        if ch.get("finish_reason"):
+                        if b'"usage"' in line:
+                            d = _json.loads(line[6:])
                             u = d.get("usage") or {}
-                            total = u.get("completion_tokens", 0)
+                            total = u.get("completion_tokens", total)
                 return total
 
             best, tt_all = 0.0, []
@@ -188,10 +255,19 @@ def _bench_http(state, model, n_req, n_tok, runs=2):
                 totals = await asyncio.gather(
                     *[one(i, t0, ttfts) for i in range(n_req)])
                 wall = time.perf_counter() - t0
+                _trace_dump(f"wave{run}", t0, ttfts)
                 if run < 2:
                     continue
                 best = max(best, sum(totals) / wall)
-                tt_all.extend(t for t in ttfts if t is not None)
+                got = [t for t in ttfts if t is not None]
+                if not got:
+                    # the TTFT byte-sniff above is coupled to the
+                    # server's json.dumps separators — if that drifts,
+                    # fail the bench loudly instead of reporting None
+                    raise RuntimeError(
+                        "no stream produced a first-content TTFT — "
+                        "SSE sniff out of sync with the server format?")
+                tt_all.extend(got)
 
             # steady-state TTFT: one new request arriving while the
             # engine is BUSY serving a near-full wave — the classic
@@ -201,11 +277,12 @@ def _bench_http(state, model, n_req, n_tok, runs=2):
             steady: list[float] = []
 
             async def stagger():
-                for _ in range(8):
+                for j in range(8):
                     await asyncio.sleep(0.35)
                     tt = [None]
                     t1 = time.perf_counter()
                     await one(0, t1, tt)
+                    _trace_dump(f"steady{j}", t1, tt)
                     if tt[0] is not None:
                         steady.append(tt[0])
 
@@ -229,6 +306,10 @@ def _bench_http(state, model, n_req, n_tok, runs=2):
         loop.run_until_complete(drive())
     finally:
         loop.close()
+        if trace:
+            eng_t._run = _orig_run
+            eng_t._complete_prefill_final = _orig_pf
+            eng_t._complete_decodek = _orig_dk
     return out["tok_s"], out["p50"], out["p95"], out["p50_steady"]
 
 
@@ -247,15 +328,28 @@ def _build_bpe_tokenizer(dirpath: str, vocab_size: int = 128256) -> None:
 
     alphabet = sorted(pre_tokenizers.ByteLevel.alphabet())
     vocab = {tok: i for i, tok in enumerate(alphabet)}
+    # merges only over symbols that DECODE to printable ASCII (the
+    # GPT-2 byte map sends 0x21-0x7E to themselves and space to 'Ġ'),
+    # so any merged token is valid standalone UTF-8: a random sampled
+    # id must stream as visible text IMMEDIATELY, not sit in the
+    # incremental UTF-8 decoder awaiting continuation bytes. Random
+    # ids over the full byte alphabet were withheld often enough to
+    # slide measured first-content from the prefill harvest to the
+    # NEXT decode harvest (~+230 ms of pure tokenizer artifact on
+    # steady TTFT; same failure the 1B leg's WideByteTok docstring
+    # records). The 256 raw-byte symbols stay in the vocab for
+    # encoding coverage — they are 0.2% of sampled ids.
+    printable = [c for c in alphabet
+                 if (len(c) == 1 and 0x21 <= ord(c) <= 0x7E)] + ["Ġ"]
     merges = []
     target = vocab_size - 2  # two specials appended below
-    lvl = list(alphabet)
+    lvl = list(printable)
     while len(vocab) < target:
         nxt = []
         for a in lvl:
             if len(vocab) >= target:
                 break
-            for b in alphabet:
+            for b in printable:
                 if len(vocab) >= target:
                     break
                 m = a + b
@@ -503,7 +597,7 @@ def main() -> None:
             # write across runs (4-10 min of pure disk IO per run
             # otherwise); the LOAD path is still exercised every run.
             # The key hashes the spec plus a writer-version literal —
-            # BUMP "writer-v1" when _write_hf_checkpoint or
+            # BUMP "writer-v2" when _write_hf_checkpoint or
             # _build_bpe_tokenizer changes what they emit, or the stale
             # cache gets benched. Stale keys are swept so edits don't
             # strand 16 GB orphans.
@@ -511,7 +605,7 @@ def main() -> None:
             import hashlib
 
             key = hashlib.sha256(
-                (repr(spec8) + "|writer-v1").encode()).hexdigest()[:16]
+                (repr(spec8) + "|writer-v2").encode()).hexdigest()[:16]
             cache_root = os.environ.get(
                 "XDG_CACHE_HOME", os.path.expanduser("~/.cache"))
             cache_ckpt = os.path.join(cache_root,

@@ -1372,6 +1372,12 @@ class LLMEngine:
         with self._lock:
             pending = bool(self._pending)
             recent = [t for t in self._arrivals if now - t < 0.04]
+        if pending and not any(not s.active for s in self.slots):
+            # a queued request with ZERO free slots can never join the
+            # group being held — under sustained saturation the pending
+            # clause would otherwise tax every occupied slot's final
+            # chunk with the full hold for no coalescing gain
+            pending = False
         landing = pending or (
             # >=2 DISTINCT submit events in the window: concurrent
             # arrivals (a submit_many wave is ONE event regardless of
