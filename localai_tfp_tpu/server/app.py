@@ -26,7 +26,15 @@ from . import (
 log = logging.getLogger(__name__)
 
 # endpoints exempt from API-key auth (ref: app.go:139-174 default filters)
-AUTH_EXEMPT = {"/healthz", "/readyz", "/metrics", "/version"}
+AUTH_EXEMPT = {"/healthz", "/readyz", "/metrics", "/version", "/login"}
+
+# server-rendered UI pages: browsers cannot attach a Bearer header on
+# NAVIGATION, so an unauthorized text/html GET redirects to /login
+# (which stores the key as both localStorage for fetches and a cookie
+# for page loads) instead of a bare 401 — ref: core/http views login
+# flow
+UI_PREFIXES = ("/", "/browse", "/chat/", "/text2image/", "/tts/",
+               "/talk/", "/p2p", "/swagger/")
 
 
 def json_error(status: int, message: str, opaque: bool = False) -> web.Response:
@@ -61,7 +69,17 @@ async def auth_middleware(request: web.Request, handler):
         auth = request.headers.get("Authorization", "")
         xkey = request.headers.get("x-api-key", "")
         token = auth[7:] if auth.startswith("Bearer ") else xkey
+        if not token:
+            # page navigations authenticate via the /login cookie
+            token = request.cookies.get("localai_api_key", "")
         if token not in keys:
+            is_ui_page = request.method == "GET" and (
+                request.path == "/" or any(
+                    request.path.startswith(p)
+                    for p in UI_PREFIXES if p != "/")
+            ) and "text/html" in request.headers.get("Accept", "")
+            if is_ui_page:
+                raise web.HTTPFound("/login")
             return json_error(401, "unauthorized")
     return await handler(request)
 

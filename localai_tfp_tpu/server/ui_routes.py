@@ -28,6 +28,7 @@ def register(app: web.Application) -> None:
     r.add_get("/tts/{model}", tts_page)
     r.add_get("/talk/", talk)
     r.add_get("/p2p", p2p_page)
+    r.add_get("/login", login)
     r.add_get("/swagger/index.html", swagger_ui)
     r.add_get("/swagger/doc.json", swagger_json)
 
@@ -49,14 +50,68 @@ _STYLE = """
 """
 
 
+_AUTH_JS = """
+<script>
+// API-key support (ref: core/http/views/login.html): the key saved on
+// /login rides every fetch as a Bearer header
+function authHeaders(extra){
+ const h=Object.assign({},extra||{});
+ const k=localStorage.getItem('localai_api_key');
+ if(k)h['Authorization']='Bearer '+k;
+ return h;
+}
+// HTML-escape for anything interpolated into innerHTML: gallery
+// descriptions, federation node names, transcribed/generated text are
+// all REMOTE data, and the UI now persists an API key worth stealing
+function esc(s){return String(s==null?'':s).replace(/[&<>"']/g,
+ c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',
+      "'":'&#39;'}[c]));}
+</script>
+"""
+
+
 def _page(title: str, body: str) -> web.Response:
     html = f"""<!doctype html><html><head><meta charset="utf-8">
-<title>{title} — LocalAI-TPU</title>{_STYLE}</head><body>
+<title>{title} — LocalAI-TPU</title>{_STYLE}</head><body>{_AUTH_JS}
 <nav><a href="/">home</a><a href="/browse">gallery</a>
-<a href="/p2p">federation</a><a href="/swagger/index.html">api</a></nav>
+<a href="/talk/">talk</a><a href="/p2p">federation</a>
+<a href="/swagger/index.html">api</a><a href="/login">key</a></nav>
 <h1>{title}</h1>{body}
 <p class="muted">localai_tfp_tpu {__version__}</p></body></html>"""
     return web.Response(text=html, content_type="text/html")
+
+
+async def login(request: web.Request) -> web.Response:
+    """API-key entry (ref: core/http/views/login.html) — stored in
+    localStorage, attached by authHeaders() on every UI fetch."""
+    body = """
+<div class="card"><p>Paste an API key if this server requires one
+(<code>LOCALAI_API_KEY</code>). Stored only in this browser.</p>
+<input id="key" type="password" placeholder="sk-...">
+<button onclick="save()">Save</button>
+<button class="muted" onclick="clearKey()">Forget</button>
+<div id="st" class="muted"></div></div>
+<script>
+document.getElementById('key').value=
+  localStorage.getItem('localai_api_key')||'';
+async function save(){
+ const k=document.getElementById('key').value;
+ localStorage.setItem('localai_api_key',k);
+ // cookie authenticates server-rendered PAGE loads (a navigation
+ // cannot carry the Bearer header); SameSite keeps it off
+ // cross-site requests
+ document.cookie='localai_api_key='+encodeURIComponent(k)
+   +'; path=/; SameSite=Strict';
+ const r=await fetch('/v1/models',{headers:authHeaders()});
+ document.getElementById('st').textContent=
+   r.ok?'key accepted':'server rejected the key ('+r.status+')';
+}
+function clearKey(){localStorage.removeItem('localai_api_key');
+ document.cookie='localai_api_key=; path=/; Max-Age=0';
+ document.getElementById('key').value='';
+ document.getElementById('st').textContent='cleared';}
+</script>"""
+    return _page("API key", body)
 
 
 async def home(request: web.Request) -> web.Response:
@@ -93,11 +148,11 @@ async function del(name,btn){
  btn.disabled=true;btn.textContent='deleting…';
  try{
   const r=await (await fetch('/models/delete/'+encodeURIComponent(name),
-    {method:'POST'})).json();
+    {method:'POST',headers:authHeaders()})).json();
   const id=r.uuid;
   const poll=async()=>{
    try{
-    const s=await (await fetch('/models/jobs/'+id)).json();
+    const s=await (await fetch('/models/jobs/'+id,{headers:authHeaders()})).json();
     if(s.processed){
      if(s.error){btn.textContent='error: '+s.error;}
      else location.reload();
@@ -111,33 +166,81 @@ async function del(name,btn){
 
 
 async def chat(request: web.Request) -> web.Response:
+    """Chat UI (ref: core/http/views/chat.html — model selector,
+    system prompt, stop/clear, token-rate footer)."""
     model = request.match_info.get("model", "")
     body = f"""
+<div class="card">
+<select id="model"></select>
+<input id="system" placeholder="System prompt (optional)">
+</div>
 <div class="card"><div id="log"></div>
-<textarea id="msg" rows="3" placeholder="Say something"></textarea>
-<button onclick="send()">Send</button></div>
+<textarea id="msg" rows="3" placeholder="Say something"
+ onkeydown="if(event.key==='Enter'&&!event.shiftKey){{event.preventDefault();send();}}"></textarea>
+<button id="send" onclick="send()">Send</button>
+<button id="stop" onclick="stop()" disabled>Stop</button>
+<button class="muted" onclick="clearChat()">Clear</button>
+<div id="usage" class="muted"></div></div>
 <script>
-const model={json.dumps(model)};
-let history=[];
+const pre={json.dumps(model)};
+let history=[],ctrl=null;
+(async()=>{{
+ const d=await (await fetch('/v1/models',{{headers:authHeaders()}})).json();
+ const sel=document.getElementById('model');
+ for(const m of d.data||[]){{
+  const o=document.createElement('option');
+  o.value=o.textContent=m.id;if(m.id===pre)o.selected=true;
+  sel.appendChild(o);}}
+}})();
+function busy(b){{document.getElementById('send').disabled=b;
+ document.getElementById('stop').disabled=!b;}}
+function stop(){{if(ctrl)ctrl.abort();}}
+function clearChat(){{history=[];
+ document.getElementById('log').innerHTML='';
+ document.getElementById('usage').textContent='';}}
 async function send(){{
  const text=document.getElementById('msg').value;
  if(!text)return;
  history.push({{role:'user',content:text}});
  log('user',text);
  document.getElementById('msg').value='';
- const r=await fetch('/v1/chat/completions',{{method:'POST',
-   headers:{{'Content-Type':'application/json'}},
-   body:JSON.stringify({{model:model||undefined,messages:history,
-                         stream:true}})}});
- const reader=r.body.getReader();const dec=new TextDecoder();
+ const sys=document.getElementById('system').value;
+ const msgs=sys?[{{role:'system',content:sys}},...history]:history;
+ ctrl=new AbortController();busy(true);
+ const t0=performance.now();let ttft=null;
  let acc='';const el=log('assistant','');
- for(;;){{const{{done,value}}=await reader.read();if(done)break;
-  for(const line of dec.decode(value).split('\\n')){{
-   if(!line.startsWith('data: ')||line.includes('[DONE]'))continue;
-   try{{const d=JSON.parse(line.slice(6));
-    acc+=(d.choices[0].delta&&d.choices[0].delta.content)||'';
-    el.textContent=acc;}}catch(e){{}}}}}}
- history.push({{role:'assistant',content:acc}});
+ try{{
+  const r=await fetch('/v1/chat/completions',{{method:'POST',
+    headers:authHeaders({{'Content-Type':'application/json',
+                          'Extra-Usage':'1'}}),
+    signal:ctrl.signal,
+    body:JSON.stringify({{
+      model:document.getElementById('model').value||undefined,
+      messages:msgs,stream:true}})}});
+  if(!r.ok){{el.textContent='[error '+r.status+'] '+await r.text();
+   busy(false);return;}}
+  const reader=r.body.getReader();const dec=new TextDecoder();
+  let buf='';
+  for(;;){{const{{done,value}}=await reader.read();if(done)break;
+   buf+=dec.decode(value,{{stream:true}});
+   const lines=buf.split('\\n');buf=lines.pop();
+   for(const line of lines){{
+    if(!line.startsWith('data: ')||line.includes('[DONE]'))continue;
+    try{{const d=JSON.parse(line.slice(6));
+     const delta=(d.choices[0].delta&&d.choices[0].delta.content)||'';
+     if(delta&&ttft===null)ttft=performance.now()-t0;
+     acc+=delta;el.textContent=acc;
+     if(d.usage){{const s=(performance.now()-t0)/1e3;
+      document.getElementById('usage').textContent=
+       d.usage.completion_tokens+' tokens · '+
+       (d.usage.completion_tokens/s).toFixed(1)+' tok/s · first token '+
+       (ttft||0).toFixed(0)+' ms';}}
+    }}catch(e){{}}}}}}
+ }}catch(e){{if(e.name!=='AbortError')el.textContent=acc+' [error: '+e+']';
+ }}finally{{busy(false);ctrl=null;}}
+ if(acc)history.push({{role:'assistant',content:acc}});
+ else history.pop();  // aborted before any token: drop the user turn
+                      // too so a retry resends it cleanly
 }}
 function log(role,text){{const d=document.createElement('pre');
  d.innerHTML='<b>'+role+':</b> ';const s=document.createElement('span');
@@ -148,36 +251,60 @@ function log(role,text){{const d=document.createElement('pre');
 
 
 async def text2image(request: web.Request) -> web.Response:
+    """Image UI (ref: core/http/views/text2image.html) — size/steps
+    controls and negative prompt."""
     model = request.match_info["model"]
     body = f"""
 <div class="card"><input id="prompt" placeholder="a sunset over the sea">
-<button onclick="gen()">Generate</button><div id="out"></div></div>
+<input id="neg" placeholder="negative prompt (optional)">
+<select id="size"><option>256x256</option><option>512x512</option>
+<option>768x768</option><option>1024x1024</option></select>
+<input id="steps" type="number" value="20" min="1" max="100"
+ title="denoising steps">
+<button id="go" onclick="gen()">Generate</button>
+<div id="out"></div></div>
 <script>
 async function gen(){{
- const r=await fetch('/v1/images/generations',{{method:'POST',
-  headers:{{'Content-Type':'application/json'}},
-  body:JSON.stringify({{model:{json.dumps(model)},
-   prompt:document.getElementById('prompt').value,size:'256x256'}})}});
- const d=await r.json();
- document.getElementById('out').innerHTML=
-  d.data?d.data.map(x=>'<img src="'+x.url+'" width=256>').join(''):
-  '<pre>'+JSON.stringify(d)+'</pre>';
+ const b=document.getElementById('go');b.disabled=true;
+ b.textContent='generating…';
+ const p=document.getElementById('prompt').value;
+ const neg=document.getElementById('neg').value;
+ try{{
+  const r=await fetch('/v1/images/generations',{{method:'POST',
+   headers:authHeaders({{'Content-Type':'application/json'}}),
+   body:JSON.stringify({{model:{json.dumps(model)},
+    prompt:p,negative_prompt:neg||undefined,
+    size:document.getElementById('size').value,
+    step:parseInt(document.getElementById('steps').value)||20}})}});
+  const d=await r.json();
+  document.getElementById('out').innerHTML=
+   d.data?d.data.map(x=>'<img src="'+x.url+'" width=256>').join(''):
+   '<pre>'+JSON.stringify(d)+'</pre>';
+ }}finally{{b.disabled=false;b.textContent='Generate';}}
 }}
 </script>"""
     return _page(f"Text to image — {model}", body)
 
 
 async def tts_page(request: web.Request) -> web.Response:
+    """TTS UI (ref: core/http/views/tts.html) — voice field + error
+    surfacing."""
     model = request.match_info["model"]
     body = f"""
 <div class="card"><input id="text" placeholder="Hello world">
+<input id="voice" placeholder="voice (optional)">
 <button onclick="speak()">Speak</button><div id="out"></div></div>
 <script>
 async function speak(){{
+ const body={{model:{json.dumps(model)},
+   input:document.getElementById('text').value}};
+ const v=document.getElementById('voice').value;
+ if(v)body.voice=v;
  const r=await fetch('/v1/audio/speech',{{method:'POST',
-  headers:{{'Content-Type':'application/json'}},
-  body:JSON.stringify({{model:{json.dumps(model)},
-   input:document.getElementById('text').value}})}});
+  headers:authHeaders({{'Content-Type':'application/json'}}),
+  body:JSON.stringify(body)}});
+ if(!r.ok){{document.getElementById('out').innerHTML=
+  '<pre>error '+r.status+': '+(await r.text())+'</pre>';return;}}
  const b=await r.blob();
  document.getElementById('out').innerHTML=
   '<audio controls autoplay src="'+URL.createObjectURL(b)+'"></audio>';
@@ -206,16 +333,16 @@ async function run(){
  const form=new FormData();
  form.append('file',new Blob(chunks),'audio.webm');
  const t=await (await fetch('/v1/audio/transcriptions',
-   {method:'POST',body:form})).json();
+   {method:'POST',headers:authHeaders(),body:form})).json();
  const out=document.getElementById('out');
- out.innerHTML='<pre>you: '+t.text+'</pre>';
+ out.innerHTML='<pre>you: '+esc(t.text)+'</pre>';
  const c=await (await fetch('/v1/chat/completions',{method:'POST',
-  headers:{'Content-Type':'application/json'},
+  headers:authHeaders({'Content-Type':'application/json'}),
   body:JSON.stringify({messages:[{role:'user',content:t.text}]})})).json();
  const reply=c.choices[0].message.content;
- out.innerHTML+='<pre>assistant: '+reply+'</pre>';
+ out.innerHTML+='<pre>assistant: '+esc(reply)+'</pre>';
  const a=await (await fetch('/v1/audio/speech',{method:'POST',
-  headers:{'Content-Type':'application/json'},
+  headers:authHeaders({'Content-Type':'application/json'}),
   body:JSON.stringify({input:reply})})).blob();
  out.innerHTML+='<audio controls autoplay src="'
    +URL.createObjectURL(a)+'"></audio>';
@@ -231,24 +358,25 @@ async def browse(request: web.Request) -> web.Response:
 <script>
 let models=[];
 async function load(){
- models=await (await fetch('/models/available')).json();render();}
+ models=await (await fetch('/models/available',{headers:authHeaders()})).json();render();}
 function render(){
  const q=document.getElementById('q').value.toLowerCase();
  document.getElementById('list').innerHTML=models
   .filter(m=>m.name.toLowerCase().includes(q))
-  .map(m=>'<div class="card"><b>'+m.name+'</b> '+
+  .map(m=>'<div class="card"><b>'+esc(m.name)+'</b> '+
    (m.installed?'<span class="muted">installed</span>':
-    '<button onclick="install(\\''+m.name+'\\',this)">install</button>')+
-   '<br><span class="muted">'+(m.description||'')+'</span></div>')
+    '<button data-name="'+esc(m.name)
+     +'" onclick="install(this.dataset.name,this)">install</button>')+
+   '<br><span class="muted">'+esc(m.description)+'</span></div>')
   .join('')||'<p>No gallery models (configure galleries).</p>';}
 async function install(name,btn){
  btn.disabled=true;
  const r=await (await fetch('/models/apply',{method:'POST',
-  headers:{'Content-Type':'application/json'},
+  headers:authHeaders({'Content-Type':'application/json'}),
   body:JSON.stringify({id:name})})).json();
  poll(r.uuid,btn);}
 async function poll(id,btn){
- const s=await (await fetch('/models/jobs/'+id)).json();
+ const s=await (await fetch('/models/jobs/'+id,{headers:authHeaders()})).json();
  btn.textContent=s.processed?(s.error?'error':'done')
    :(s.progress|0)+'%';
  if(!s.processed)setTimeout(()=>poll(id,btn),700);else load();}
@@ -262,12 +390,12 @@ async def p2p_page(request: web.Request) -> web.Response:
 <div class="card"><div id="out">loading…</div></div>
 <script>
 async function load(){
- const d=await (await fetch('/api/p2p')).json();
+ const d=await (await fetch('/api/p2p',{headers:authHeaders()})).json();
  document.getElementById('out').innerHTML=
   (d.enabled?'':'<p>Federation disabled (no token configured).</p>')+
-  (d.nodes||[]).map(n=>'<div class="card"><b>'+n.name+'</b> '+n.address+
-   ' — '+(n.online?'online':'offline')+
-   ' · served '+n.requests_served+'</div>').join('');}
+  (d.nodes||[]).map(n=>'<div class="card"><b>'+esc(n.name)+'</b> '
+   +esc(n.address)+' — '+(n.online?'online':'offline')+
+   ' · served '+esc(n.requests_served)+'</div>').join('');}
 load();setInterval(load,5000);
 </script>"""
     return _page("Federation", body)

@@ -443,3 +443,54 @@ def test_finetune_completion_endpoint(client, finetune_primed):
     text = "".join(json.loads(e)["choices"][0]["text"] or ""
                    for e in events[:-1])
     assert text == want
+
+
+@pytest.fixture(scope="module")
+def auth_client(workdir):
+    """A key-gated app: the UI login flow (redirect + cookie) rides the
+    same auth middleware the API's Bearer/x-api-key checks use."""
+    loop = asyncio.new_event_loop()
+    cfg = ApplicationConfig(
+        models_path=str(workdir / "models"),
+        generated_content_dir=str(workdir / "generated"),
+        upload_dir=str(workdir / "uploads"),
+        config_dir=str(workdir / "configuration"),
+        api_keys=["sk-test"],
+    )
+    state = Application(cfg)
+    app = build_app(state)
+    tc = TestClient(TestServer(app), loop=loop)
+    loop.run_until_complete(tc.start_server())
+    yield SyncClient(loop, tc)
+    loop.run_until_complete(tc.close())
+    loop.close()
+
+
+def test_ui_login_flow_under_api_keys(auth_client):
+    """Ref: core/http/views/login.html flow. A browser NAVIGATION
+    cannot carry a Bearer header, so unauthorized text/html page loads
+    redirect to /login (itself exempt); the key then authenticates
+    pages via cookie and API calls via Bearer."""
+    # page nav without key -> redirect to /login
+    r = auth_client.get("/", headers={"Accept": "text/html"},
+                        allow_redirects=False)
+    assert r.status == 302 and r.headers["Location"] == "/login"
+    # /login reachable without a key
+    r = auth_client.get("/login", headers={"Accept": "text/html"})
+    assert r.status == 200
+    # API without key: plain 401, no redirect
+    r = auth_client.get("/v1/models", allow_redirects=False)
+    assert r.status == 401
+    # cookie authenticates page loads
+    r = auth_client.get("/", headers={
+        "Accept": "text/html", "Cookie": "localai_api_key=sk-test"})
+    assert r.status == 200
+    # Bearer authenticates API calls
+    r = auth_client.get("/v1/models", headers={
+        "Authorization": "Bearer sk-test"})
+    assert r.status == 200
+    # wrong cookie: back to /login, not a 200
+    r = auth_client.get("/", headers={
+        "Accept": "text/html", "Cookie": "localai_api_key=nope"},
+        allow_redirects=False)
+    assert r.status == 302
