@@ -159,7 +159,54 @@ def build_app(db: ExplorerDB, discovery: DiscoveryServer):
             raise web.HTTPNotFound()
         return web.json_response({"ok": True})
 
+    async def dashboard(request):
+        # ref: core/http/views/explorer.html — network directory with
+        # an add form; remote names/descriptions are HTML-escaped (the
+        # directory accepts registrations from anyone)
+        html = """<!doctype html><html><head><meta charset="utf-8">
+<title>LocalAI-TPU network explorer</title><style>
+ body{font-family:system-ui,sans-serif;margin:2rem auto;max-width:60rem;
+      padding:0 1rem;background:#10141a;color:#e6e6e6}
+ .card{background:#1a212b;border-radius:8px;padding:1rem;margin:.6rem 0}
+ input{width:100%;box-sizing:border-box;background:#0d1117;color:#e6e6e6;
+      border:1px solid #333;border-radius:6px;padding:.5rem;margin:.2rem 0}
+ button{background:#2d6cdf;color:#fff;border:0;border-radius:6px;
+      padding:.5rem 1rem;cursor:pointer;margin-top:.5rem}
+ .muted{color:#8a93a2;font-size:.85rem}</style></head><body>
+<h1>Federated networks</h1>
+<div class="card"><div id="list">loading…</div></div>
+<div class="card"><h2>Register a network</h2>
+<input id="name" placeholder="name"><input id="url" placeholder="url">
+<input id="desc" placeholder="description (optional)">
+<button onclick="reg()">Register</button><div id="st" class="muted">
+</div></div>
+<script>
+function esc(s){return String(s==null?'':s).replace(/[&<>"']/g,
+ c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',
+      "'":'&#39;'}[c]));}
+async function load(){
+ const d=await (await fetch('/networks')).json();
+ document.getElementById('list').innerHTML=d.length?d.map(n=>
+  '<div class="card"><b>'+esc(n.name)+'</b> '+esc(n.url)+
+  ' <span class="muted">nodes online '+esc(n.nodes_online)+
+  ' · failures '+esc(n.failures)+'</span><br><span class="muted">'+
+  esc(n.description)+'</span></div>').join('')
+  :'<p>No networks registered.</p>';}
+async function reg(){
+ const r=await fetch('/network',{method:'POST',
+  headers:{'Content-Type':'application/json'},
+  body:JSON.stringify({name:document.getElementById('name').value,
+   url:document.getElementById('url').value,
+   description:document.getElementById('desc').value})});
+ document.getElementById('st').textContent=
+  r.ok?'registered':'error: '+(await r.text());
+ load();}
+load();setInterval(load,10000);
+</script></body></html>"""
+        return web.Response(text=html, content_type="text/html")
+
     app = web.Application()
+    app.router.add_get("/", dashboard)
     app.router.add_get("/networks", networks)
     app.router.add_post("/network", add)
     app.router.add_delete("/network/{name}", remove)
