@@ -668,6 +668,13 @@ def main() -> None:
             # is interpretable
             extra["checkpoint_load_mode"] = getattr(
                 backend, "load_mode", "unknown")
+            # per-phase wall-time breakdown (models/load_timing.py):
+            # read/dequant/transfer/compile/warmup + other must
+            # reconcile against checkpoint_load_s, so a regression in
+            # any one phase is attributable instead of vanishing into
+            # the total (the r5 167-missing-seconds problem)
+            extra["checkpoint_load_breakdown"] = getattr(
+                backend, "load_breakdown", {})
             eng8, tok8 = backend.engine, backend.tokenizer
             # 512-token streams: admission raggedness amortizes over the
             # stream length, so throughput reflects serving, not edges

@@ -27,6 +27,7 @@ TPU-first re-design rather than translation:
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -48,6 +49,8 @@ from ..ops.sampling import (
     SamplingState, observe_tokens, sample, seed_windows,
 )
 from .tokenizer import StreamDecoder, Tokenizer
+
+log = logging.getLogger(__name__)
 
 # Padded-prefill size ladder. The 4-bucket exists for the prefix-reuse
 # fast path: a warm request re-processes only its last token(s), and at
@@ -440,6 +443,10 @@ class LLMEngine:
         # arrival or a single batched wave
         self._prefill_hold0 = 0.0  # when the current prefill-formation
         # hold began (0 = not holding); bounds hold duration
+        self.warmup_reused = False  # True when warmup() was skipped
+        # because an identical variant set is already in the persistent
+        # compile cache (see warmup docstring); surfaced in the load
+        # phase breakdown
 
     def _kernel_eligible(self) -> bool:
         """Use the Pallas ragged decode kernels when the mosaic path is
@@ -1074,6 +1081,42 @@ class LLMEngine:
 
             quant.set_meshed_serving(False)
 
+    def _warmup_signature(self) -> str:
+        """Fingerprint of everything the warmup variant set depends on:
+        model geometry, engine shape knobs, backend/device kind. Two
+        engines with equal signatures compile the identical HLO set."""
+        import hashlib
+
+        mesh_desc = (tuple(sorted(self.mesh.shape.items()))
+                     if self.mesh is not None else None)
+        dev = jax.devices()[0]
+        blob = repr((
+            repr(self.spec), self.n_slots, self.max_seq,
+            tuple(self.prefill_buckets),
+            str(jnp.dtype(self.cache.k.dtype)), self.decode_steps,
+            self.latency_target_ms, self.sampling.window,
+            self._use_kernel, mesh_desc, jax.default_backend(),
+            getattr(dev, "device_kind", ""), jax.__version__,
+        ))
+        return hashlib.sha256(blob.encode()).hexdigest()[:20]
+
+    def _warmup_marker_path(self) -> Optional[str]:
+        """Marker file recording a COMPLETED warmup of this signature in
+        the persistent compilation cache dir (None when no persistent
+        cache is configured — skipping warmup is only safe when a
+        mid-request 'compile' would be a fast cache load, not a real
+        compile)."""
+        import os
+
+        try:
+            cache_dir = jax.config.jax_compilation_cache_dir
+        except AttributeError:
+            cache_dir = None
+        if not cache_dir:
+            return None
+        return os.path.join(
+            cache_dir, f"warmup-{self._warmup_signature()}.ok")
+
     def warmup(self) -> None:
         """Compile the serving dispatch-variant set up front.
 
@@ -1085,7 +1128,28 @@ class LLMEngine:
         an all-inactive scan — exercise the identical jit shapes
         without touching engine state, so this is safe before serving.
         With the persistent compilation cache the cost after a code
-        change is one cold pass; afterwards seconds."""
+        change is one cold pass; afterwards seconds.
+
+        Even cache-hit warmups are not free at 8B scale: every variant
+        still TRACES its python graph and round-trips the cache
+        (seconds apiece across dozens of variants — load wall time the
+        r5 bench measured but could not attribute). When a previous
+        load of the IDENTICAL signature completed a warmup into the
+        configured persistent cache (marker file), the whole pass is
+        skipped: any variant a request later touches jit-compiles as a
+        fast persistent-cache load instead of a cold compile. Kill
+        switch: LOCALAI_WARMUP_REUSE=off (e.g. after pruning the cache
+        dir without removing the warmup markers)."""
+        import os
+
+        marker = self._warmup_marker_path()
+        reuse_ok = os.environ.get("LOCALAI_WARMUP_REUSE", "1") not in (
+            "0", "false", "off")
+        if marker is not None and reuse_ok and os.path.exists(marker):
+            self.warmup_reused = True
+            log.info("warmup skipped: variant set %s already in the "
+                     "persistent compile cache", os.path.basename(marker))
+            return
         W = self.sampling.window
         pad_reset = self._reset_columns([], 1)
         win_ladder = []
@@ -1186,6 +1250,15 @@ class LLMEngine:
         # block until every warmup compile retires so the first real
         # request measures serving, not the compiler
         jax.block_until_ready(self.cache.k)
+        if marker is not None:
+            # record the completed variant set so the next load of this
+            # exact signature skips the whole pass (best effort: losing
+            # the marker only costs the speedup)
+            try:
+                with open(marker, "w") as f:
+                    f.write("ok")
+            except OSError:
+                pass
 
     def submit(self, req: GenRequest) -> queue.SimpleQueue:
         """Queue a request; returns the event stream queue."""
@@ -1212,13 +1285,19 @@ class LLMEngine:
                                     error="empty prompt"))
             else:
                 ok.append((req, out))
-        with self._lock:
-            self._pending.extend(ok)
-            self._last_arrival = time.perf_counter()
-            self._arrivals.append(self._last_arrival)
-            self._lock.notify_all()
-        if self._autostart:
-            self.start()
+        if ok:
+            # arrival bookkeeping only for ADMITTED work: a stream of
+            # rejected requests (empty/over-context prompts) must not
+            # engage the burst clamp or the prefill-formation hold —
+            # they contribute nothing a prefill could serve (ADVICE
+            # r5 #4)
+            with self._lock:
+                self._pending.extend(ok)
+                self._last_arrival = time.perf_counter()
+                self._arrivals.append(self._last_arrival)
+                self._lock.notify_all()
+            if self._autostart:
+                self.start()
         return outs
 
     def generate(self, req: GenRequest) -> StreamEvent:
@@ -2248,8 +2327,13 @@ class LLMEngine:
                 # measures device time + dispatch RTT, and one behind a
                 # prefill_final measures prefill time too (_last_harvest_t
                 # only advances on decode harvests) — neither may
-                # pollute the EWMA
-                "saturated": bool(dflights),
+                # pollute the EWMA, so a prefill anywhere in the
+                # pipeline disqualifies the sample even when another
+                # decode scan is also in flight (ADVICE r5 #1: the 8x
+                # outlier guard alone let prefill-inflated samples
+                # through and mis-sized the k clamps)
+                "saturated": bool(dflights) and not any(
+                    f.kind == "prefill_final" for f in self._flights),
             },
             t_enqueue=time.perf_counter(),
         ))

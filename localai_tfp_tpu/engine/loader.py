@@ -145,6 +145,9 @@ class LoadedModel:
         self.backend = backend
         self.last_used = time.monotonic()
         self.busy_since: Optional[float] = None
+        self.load_s: float = 0.0  # wall time of the backend load that
+        # produced this instance (phase breakdown lives on the backend
+        # as ``load_breakdown``; /backend/monitor surfaces both)
 
     def mark_busy(self) -> None:
         self.busy_since = time.monotonic()
@@ -155,9 +158,29 @@ class LoadedModel:
         self.last_used = time.monotonic()
 
 
+class _InFlightLoad:
+    """One coalesced load of one model name: the first caller becomes
+    the leader and performs the load; concurrent callers for the same
+    name park on ``done`` and share the leader's outcome."""
+
+    __slots__ = ("done", "backend", "error")
+
+    def __init__(self) -> None:
+        self.done = threading.Event()
+        self.backend: Optional[Backend] = None
+        self.error: Optional[BaseException] = None
+
+
 class ModelLoader:
     """Keyed registry of live backends with load-or-reuse semantics
-    (ref: pkg/model/loader.go ModelLoader)."""
+    (ref: pkg/model/loader.go ModelLoader).
+
+    Concurrency contract: ``_lock`` guards ONLY the registry maps and is
+    never held across a backend load. A load of model B (checkpoint IO +
+    compiles + warmup — minutes at 8B scale) therefore never blocks
+    ``get_loaded(A)``/``load(A)`` of an already-loaded model A, and
+    duplicate concurrent ``load(B)`` calls coalesce onto one in-flight
+    load (``_InFlightLoad``) instead of building two backends."""
 
     def __init__(
         self,
@@ -168,46 +191,92 @@ class ModelLoader:
         self.models_path = models_path
         self.single_active = single_active_backend
         self._models: dict[str, LoadedModel] = {}
-        self._lock = threading.RLock()
+        self._lock = threading.Lock()  # registry map mutations only
+        self._loads: dict[str, _InFlightLoad] = {}  # per-model loads
+        # single-active mode needs whole-load serialization: two
+        # concurrent leaders would each evict the other, then both
+        # publish — two live backends in a mode whose point is one
+        self._single_gate = threading.Lock()
 
     # ------------------------------------------------------------- loading
 
     def get_loaded(self, name: str) -> Optional[Backend]:
-        """Non-blocking fast path: the already-loaded healthy backend, or
-        None. Routes call this on the EVENT LOOP to skip the thread-pool
-        hop for the common already-loaded case, so it must never wait:
-        ``load()`` holds the loader lock for a whole model load
-        (checkpoint IO + compiles + warmup — minutes at 8B scale), and a
-        blocking acquire here would freeze every request on the server
-        for that long. If the lock is contended, fall back to the
-        executor path (returns None)."""
-        if not self._lock.acquire(blocking=False):
-            return None
-        try:
+        """Fast path: the already-loaded healthy backend, or None.
+        Routes call this on the EVENT LOOP to skip the thread-pool hop
+        for the common already-loaded case. ``_lock`` only ever guards
+        map mutations (loads run OUTSIDE it), so this acquire is
+        microseconds even while another model is mid-load."""
+        with self._lock:
             lm = self._models.get(name)
             if lm is not None and lm.backend.health():
                 lm.last_used = time.monotonic()
                 return lm.backend
-        finally:
-            self._lock.release()
         return None
 
     def load(self, cfg: ModelConfig) -> Backend:
         """Load-or-reuse (ref: loader.go:119-188 CheckIsLoaded: health-check
-        a cached backend and rebuild it if dead)."""
-        with self._lock:
-            lm = self._models.get(cfg.name)
-            if lm is not None:
-                if lm.backend.health():
+        a cached backend and rebuild it if dead). Concurrent loads of
+        the SAME name coalesce onto one backend build; loads of
+        DIFFERENT names proceed in parallel (per-model load locks)."""
+        while True:
+            with self._lock:
+                lm = self._models.get(cfg.name)
+                if lm is not None and lm.backend.health():
                     lm.last_used = time.monotonic()
                     return lm.backend
-                log.warning("backend for %s unhealthy; rebuilding", cfg.name)
-                self._shutdown_locked(cfg.name)
+                fl = self._loads.get(cfg.name)
+                if fl is None:
+                    fl = _InFlightLoad()
+                    self._loads[cfg.name] = fl
+                    break  # we are the leader
+            # another caller is already loading this name: share its
+            # outcome instead of building a duplicate backend
+            fl.done.wait()
+            if fl.error is not None:
+                raise RuntimeError(
+                    f"loading model '{cfg.name}': coalesced onto a "
+                    f"concurrent load that failed: {fl.error}"
+                ) from fl.error
+            if fl.backend is not None:
+                return fl.backend
+            # leader vanished without outcome (shouldn't happen);
+            # re-enter and try to lead
+        try:
+            backend = self._load_as_leader(cfg)
+            fl.backend = backend
+            return backend
+        except BaseException as e:
+            fl.error = e
+            raise
+        finally:
+            with self._lock:
+                if self._loads.get(cfg.name) is fl:
+                    del self._loads[cfg.name]
+            fl.done.set()
 
+    def _load_as_leader(self, cfg: ModelConfig) -> Backend:
+        """The actual load, run WITHOUT the registry lock held (only
+        brief map mutations take it)."""
+        if self.single_active:
+            self._single_gate.acquire()
+        try:
+            stale = None
+            with self._lock:
+                lm = self._models.get(cfg.name)
+                if lm is not None:
+                    # the pre-leader check saw this entry unhealthy
+                    stale = self._models.pop(cfg.name)
+            if stale is not None:
+                log.warning("backend for %s unhealthy; rebuilding",
+                            cfg.name)
+                self._shutdown_backend(stale)
             if self.single_active:
-                for other in list(self._models):
-                    if other != cfg.name:
-                        self._shutdown_locked(other)
+                with self._lock:
+                    victims = [self._models.pop(n)
+                               for n in list(self._models)
+                               if n != cfg.name]
+                for v in victims:
+                    self._shutdown_backend(v)
 
             if cfg.isolation == "subprocess":
                 # child-process containment (workers/subprocess_worker):
@@ -216,14 +285,28 @@ class ModelLoader:
             else:
                 btype = resolve_backend(cfg.backend)
             backend = registry.create(btype)
+            t0 = time.monotonic()
             res = backend.load_model(self._load_options(cfg))
             if not res.success:
                 backend.shutdown()
                 raise RuntimeError(
                     f"loading model '{cfg.name}': {res.message}"
                 )
-            self._models[cfg.name] = LoadedModel(cfg.name, btype, backend)
+            lm = LoadedModel(cfg.name, btype, backend)
+            lm.load_s = time.monotonic() - t0
+            with self._lock:
+                self._models[cfg.name] = lm
             return backend
+        finally:
+            if self.single_active:
+                self._single_gate.release()
+
+    @staticmethod
+    def _shutdown_backend(lm: LoadedModel) -> None:
+        try:
+            lm.backend.shutdown()
+        except Exception as e:
+            log.warning("shutdown of %s raised: %s", lm.name, e)
 
     def _load_options(self, cfg: ModelConfig) -> ModelLoadOptions:
         return ModelLoadOptions(
@@ -272,23 +355,24 @@ class ModelLoader:
             return sorted(self._models)
 
     def shutdown_model(self, name: str) -> bool:
+        """Unload one model. The registry entry is removed under the map
+        lock; the (potentially slow — engine thread join) backend
+        shutdown runs outside it so other models keep serving. A
+        shutdown racing a concurrent load of the same name can land
+        before the load publishes; the load then wins — callers that
+        need the model gone for good should stop issuing loads first."""
         with self._lock:
-            return self._shutdown_locked(name)
-
-    def _shutdown_locked(self, name: str) -> bool:
-        lm = self._models.pop(name, None)
+            lm = self._models.pop(name, None)
         if lm is None:
             return False
-        try:
-            lm.backend.shutdown()
-        except Exception as e:
-            log.warning("shutdown of %s raised: %s", name, e)
+        self._shutdown_backend(lm)
         return True
 
     def stop_all(self) -> None:
         with self._lock:
-            for name in list(self._models):
-                self._shutdown_locked(name)
+            victims = [self._models.pop(n) for n in list(self._models)]
+        for lm in victims:
+            self._shutdown_backend(lm)
 
     # ------------------------------------------------- busy/idle accounting
 

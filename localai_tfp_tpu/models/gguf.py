@@ -79,6 +79,8 @@ class GGUFFile:
         self.path = path
         self.metadata: dict[str, Any] = {}
         self.tensors: dict[str, GGUFTensorInfo] = {}
+        self.phases: Any = None  # optional LoadPhases: tensor() bills
+        # file reads as read_s and block dequantization as dequant_s
         with open(path, "rb") as f:
             magic, version = struct.unpack("<II", f.read(8))
             if magic != GGUF_MAGIC:
@@ -108,6 +110,8 @@ class GGUFFile:
 
     def tensor(self, name: str) -> np.ndarray:
         """Dequantized f32 tensor in numpy (outermost-first) order."""
+        import time as _time
+
         ti = self.tensors[name]
         kind = _GGML_TYPES.get(ti.ggml_type)
         if kind is None:
@@ -116,10 +120,16 @@ class GGUFFile:
         dequant, block, block_bytes = kind
         n = int(np.prod(ti.shape))
         nbytes = n // block * block_bytes
+        t0 = _time.perf_counter()
         with open(self.path, "rb") as f:
             f.seek(self.data_start + ti.offset)
             raw = f.read(nbytes)
-        return dequant(np.frombuffer(raw, np.uint8)).reshape(ti.shape)
+        t1 = _time.perf_counter()
+        out = dequant(np.frombuffer(raw, np.uint8)).reshape(ti.shape)
+        if self.phases is not None:
+            self.phases.add("read_s", t1 - t0)
+            self.phases.add("dequant_s", _time.perf_counter() - t1)
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -95,12 +95,41 @@ class DeferredT:
     accelerator and run cast+transpose(+quantize) as ONE fused XLA op
     there — the host-staged eager pipeline (numpy strided copy, CPU
     swapaxes, eager quantize) measured ~10 min for an 8B where the
-    device path is tens of seconds."""
+    device path is tens of seconds.
 
-    __slots__ = ("raw",)
+    The leaf may also be LAZY: constructed with a ``thunk`` instead of
+    a materialized array, the disk read itself is deferred until
+    ``materialize()``/``raw``. The streaming committer
+    (``staging.commit_deferred``) materializes lazy leaves on a reader
+    thread pool while earlier leaves transfer to the device, so host IO
+    and the host->device link overlap instead of serializing — and the
+    host never holds the whole raw tree (only the prefetch window),
+    where the eager path staged all ~16 GB of an 8B checkpoint at
+    once."""
 
-    def __init__(self, raw: np.ndarray) -> None:
-        self.raw = raw
+    __slots__ = ("_raw", "_thunk")
+
+    def __init__(self, raw: Optional[np.ndarray] = None,
+                 thunk: Optional[Callable[[], np.ndarray]] = None) -> None:
+        if (raw is None) == (thunk is None):
+            raise ValueError("DeferredT takes exactly one of raw/thunk")
+        self._raw = raw
+        self._thunk = thunk
+
+    @property
+    def materialized(self) -> bool:
+        return self._raw is not None
+
+    def materialize(self) -> np.ndarray:
+        """Run the deferred read (idempotent); returns the raw array."""
+        if self._raw is None:
+            self._raw = np.asarray(self._thunk())
+            self._thunk = None
+        return self._raw
+
+    @property
+    def raw(self) -> np.ndarray:
+        return self.materialize()
 
 
 def load_multimodal(model_dir: str, dtype: Any = jnp.bfloat16,
@@ -171,10 +200,19 @@ def load_params(
     state: Optional[tuple] = None,  # pre-read load_hf_state result, so a
     # caller loading text + vision opens the checkpoint index once
     defer_transpose: bool = False,  # transposed leaves come back as
-    # DeferredT raw host arrays; see DeferredT
+    # LAZY DeferredT leaves (the read itself deferred); see DeferredT
+    phases: Optional[Any] = None,  # LoadPhases accumulator: eager reads
+    # bill read_s here; lazy leaves bill at materialization
 ) -> tuple[LLMSpec, Params]:
     """Load an HF checkpoint directory -> (spec, stacked params)."""
     config, get, names = state or load_hf_state(model_dir)
+    if phases is not None:
+        _get_raw = get
+
+        def get(name: str) -> np.ndarray:  # noqa: F811
+            with phases.timed("read_s"):
+                return _get_raw(name)
+
     spec = spec_override or spec_from_hf_config(config)
     mt = (config.get("model_type") or "").lower()
     L = spec.n_layers
@@ -190,14 +228,21 @@ def load_params(
         cache-blocked (seconds for the whole tree)."""
         return get(name)
 
-    def tcast(x: np.ndarray):
+    def tcast(x):
         """Cast then swap the last two axes ([..., out, in] -> [..., in,
         out]) on the jax backend (host-staged CPU or device) — or hand
-        the raw array to the consumer under ``defer_transpose``. The
-        transpose donates its input so an on-device (non-staged) load
-        holds one stack-sized transient, not two."""
+        a LAZY leaf to the consumer under ``defer_transpose`` (the read
+        runs when the streaming committer materializes it, overlapped
+        with earlier leaves' device transfers). ``x`` may be an array
+        or a zero-arg thunk producing one. The transpose donates its
+        input so an on-device (non-staged) load holds one stack-sized
+        transient, not two."""
         if defer_transpose:
+            if callable(x):
+                return DeferredT(thunk=lambda: np.asarray(x()))
             return DeferredT(np.asarray(x))
+        if callable(x):
+            x = x()
         return _jitted_swap()(_cast(x, dtype))
 
     p: dict[str, Any] = {}
@@ -212,10 +257,11 @@ def load_params(
     def stack(fn: Callable[[int], np.ndarray]) -> jnp.ndarray:
         return _cast(np.stack([fn(i) for i in range(L)]), dtype)
 
-    def stack_t(fn: Callable[[int], np.ndarray]) -> jnp.ndarray:
+    def stack_t(fn: Callable[[int], np.ndarray]):
         """Stack raw [out, in]-layout layers (contiguous memcpy), then
-        transpose the trailing axes once in XLA — see ``t``."""
-        return tcast(np.stack([fn(i) for i in range(L)]))
+        transpose the trailing axes once in XLA — see ``t``. Passed as
+        a thunk so the defer path can postpone the whole read+stack."""
+        return tcast(lambda: np.stack([fn(i) for i in range(L)]))
 
     lp = f"{prefix}layers." + "{i}."
     if mt == "phi":
@@ -235,7 +281,7 @@ def load_params(
         p["ln1_b"] = stack(lambda i: get(lp.format(i=i) + "input_layernorm.bias"))
         p["final_norm_w"] = _cast(get(f"{prefix}final_layernorm.weight"), dtype)
         p["final_norm_b"] = _cast(get(f"{prefix}final_layernorm.bias"), dtype)
-        p["lm_head"] = tcast(t("lm_head.weight"))
+        p["lm_head"] = tcast(lambda: t("lm_head.weight"))
         p["lm_head_b"] = _cast(get("lm_head.bias"), dtype)
         return spec, p
 
@@ -368,7 +414,7 @@ def load_params(
         # multimodal wrappers nest the head (llava: language_model.lm_head)
         for head in ("lm_head.weight", "language_model.lm_head.weight"):
             if head in names:
-                p["lm_head"] = tcast(t(head))
+                p["lm_head"] = tcast(lambda head=head: t(head))
                 break
         else:  # checkpoint ties despite config
             object.__setattr__(spec, "tie_word_embeddings", True)

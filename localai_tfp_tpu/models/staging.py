@@ -24,7 +24,11 @@ intermediate stays one layer wide instead of one stack wide.
 
 from __future__ import annotations
 
-from typing import Any
+import contextlib
+import os
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -91,6 +95,9 @@ def commit_deferred(
     device,
     quantize: bool,
     quantize_embeddings: bool,
+    phases: Optional[Any] = None,  # LoadPhases: read_s = main-thread
+    # wait on leaf materialization, transfer_s = device placement+commit
+    readers: int = 2,
 ) -> dict[str, Any]:
     """Stream a ``defer_transpose`` parameter tree onto ``device``.
 
@@ -98,6 +105,18 @@ def commit_deferred(
     Plain leaves: device_put (+cast; embed/lm_head quantize when
     ``quantize_embeddings``). Returns the committed tree; the input
     dict's raw buffers are released as each leaf lands.
+
+    Pipelined: LAZY leaves (thunk-backed DeferredT from ``load_params``)
+    are materialized by a small reader thread pool a bounded window
+    ahead, so checkpoint reads overlap the previous leaves' host->device
+    transfer + fused commit instead of serializing read -> transfer per
+    leaf. Device transfers are double-buffered the same way: a leaf's
+    ``block_until_ready`` is deferred until the in-flight raw bytes
+    exceed ``LOCALAI_COMMIT_INFLIGHT_MB`` (default 1024), so small
+    leaves stream back-to-back while the multi-GB stacks keep the old
+    one-at-a-time HBM bound (an over-budget leaf waits for an empty
+    pipe). Peak HBM stays committed-tree + max(budget, one big stack);
+    peak host RAM drops from the whole raw tree to the prefetch window.
     """
     from .quant import quantize_embed
 
@@ -106,40 +125,120 @@ def commit_deferred(
     jq = _jit_quant(dtype)
     jswap = _jit_swap(dtype)
     jcast = _jit_cast(dtype)
+    timed = (phases.timed if phases is not None
+             else lambda _p: contextlib.nullcontext())
     # largest-last: the committed tree grows with small leaves first so
-    # peak HBM = tree + one big in-flight stack, not two
+    # peak HBM = tree + one big in-flight stack, not two. Lazy leaves
+    # (size unknown until read) are exactly the big projection stacks,
+    # so they sort last as a class; order within them is immaterial for
+    # the peak (each is ~the same size and commits one at a time).
     names = sorted(params, key=lambda n: _leaf_bytes(params[n]))
-    for name in names:
-        leaf = params.pop(name)
-        if isinstance(leaf, DeferredT):
-            x = jax.device_put(leaf.raw, device)
-            del leaf
-            if name in quant_names or (
-                name == "lm_head" and quantize and quantize_embeddings
-            ):
-                out[name] = jq(x)
-            else:
-                out[name] = jswap(x)
-        else:
-            # plain leaves from load_params are already jax arrays (on
-            # the default device); np.asarray on those would round-trip
-            # through host memory
-            if isinstance(leaf, jax.Array):
-                x = jax.device_put(leaf, device)
-            else:
-                x = jax.device_put(np.asarray(leaf), device)
-            if (name == "embed" and quantize and quantize_embeddings
-                    and not isinstance(x, QTensor)):
-                out[name] = jax.jit(quantize_embed, donate_argnums=0)(
-                    x.astype(dtype))
-            elif hasattr(x, "astype") and not isinstance(x, QTensor):
-                out[name] = jcast(x) if x.dtype != dtype else x
-            else:
-                out[name] = x
-        jax.block_until_ready(out[name])
+    budget = int(os.environ.get(
+        "LOCALAI_COMMIT_INFLIGHT_MB", "1024")) * (1 << 20)
+    in_flight: deque[tuple[str, int]] = deque()
+    flying = 0
+
+    def drain(need: int) -> None:
+        nonlocal flying
+        while in_flight and (flying + need > budget
+                             or (need > budget and flying)):
+            n, b = in_flight.popleft()
+            with timed("transfer_s"):
+                jax.block_until_ready(out[n])
+            flying -= b
+
+    pool = ThreadPoolExecutor(
+        max_workers=max(1, readers), thread_name_prefix="ckpt-reader")
+    try:
+        # prefetch window: materialize the next few lazy leaves while
+        # the current one transfers. One leaf per future; window kept
+        # small so host RAM holds a few raw stacks, not the whole tree.
+        window = max(1, readers)
+        futures: dict[str, Any] = {}
+        lazy = [n for n in names
+                if isinstance(params[n], DeferredT)
+                and not params[n].materialized]
+
+        def _materialize(leaf: DeferredT):
+            ctx = phases.muted() if phases is not None \
+                else contextlib.nullcontext()
+            with ctx:
+                return leaf.materialize()
+
+        def top_up() -> None:
+            for n in lazy:
+                if len(futures) >= window:
+                    break
+                if n not in futures and n in params:
+                    futures[n] = pool.submit(_materialize, params[n])
+
+        top_up()
+        for name in names:
+            fut = futures.pop(name, None)
+            if fut is not None:
+                with timed("read_s"):
+                    fut.result()  # re-raises reader failures
+            leaf = params.pop(name)
+            if isinstance(leaf, DeferredT):
+                # mute inner instrumentation (load_params wraps the
+                # getter): the outer timer bills this read once; exit
+                # order un-mutes before the timer adds
+                with timed("read_s"), (
+                        phases.muted() if phases is not None
+                        else contextlib.nullcontext()):
+                    raw = leaf.materialize()  # no-op when prefetched
+                top_up()  # next reads overlap this leaf's transfer
+                nbytes = int(getattr(raw, "nbytes", 0))
+                drain(nbytes)
+                with timed("transfer_s"):
+                    x = jax.device_put(raw, device)
+                    del raw, leaf
+                    if name in quant_names or (
+                        name == "lm_head" and quantize
+                        and quantize_embeddings
+                    ):
+                        out[name] = jq(x)
+                    else:
+                        out[name] = jswap(x)
+                in_flight.append((name, nbytes))
+                flying += nbytes
+                continue
+            nbytes = int(getattr(leaf, "nbytes", 0))
+            drain(nbytes)
+            with timed("transfer_s"):
+                # plain leaves from load_params are already jax arrays
+                # (on the default device); np.asarray on those would
+                # round-trip through host memory
+                if isinstance(leaf, jax.Array):
+                    x = jax.device_put(leaf, device)
+                else:
+                    x = jax.device_put(np.asarray(leaf), device)
+                if (name == "embed" and quantize and quantize_embeddings
+                        and not isinstance(x, QTensor)):
+                    out[name] = jax.jit(quantize_embed, donate_argnums=0)(
+                        x.astype(dtype))
+                elif hasattr(x, "astype") and not isinstance(x, QTensor):
+                    out[name] = jcast(x) if x.dtype != dtype else x
+                else:
+                    out[name] = x
+            in_flight.append((name, nbytes))
+            flying += nbytes
+        while in_flight:
+            n, b = in_flight.popleft()
+            with timed("transfer_s"):
+                jax.block_until_ready(out[n])
+            flying -= b
+    finally:
+        pool.shutdown(wait=True)
     return out
 
 
 def _leaf_bytes(leaf) -> int:
-    raw = leaf.raw if isinstance(leaf, DeferredT) else leaf
+    if isinstance(leaf, DeferredT):
+        if not leaf.materialized:
+            # lazy = unread big stack; sort after every known leaf
+            return 1 << 62
+        raw = leaf.raw
+    else:
+        raw = leaf
     return getattr(raw, "nbytes", 0)

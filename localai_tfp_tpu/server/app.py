@@ -14,6 +14,7 @@ import json
 import logging
 import time
 import uuid
+from urllib.parse import unquote
 
 from aiohttp import web
 
@@ -69,9 +70,19 @@ async def auth_middleware(request: web.Request, handler):
         auth = request.headers.get("Authorization", "")
         xkey = request.headers.get("x-api-key", "")
         token = auth[7:] if auth.startswith("Bearer ") else xkey
-        if not token:
-            # page navigations authenticate via the /login cookie
-            token = request.cookies.get("localai_api_key", "")
+        if (not token and request.method == "GET"
+                and "text/html" in request.headers.get("Accept", "")):
+            # page NAVIGATIONS authenticate via the /login cookie — and
+            # ONLY navigations: a cookie rides along on every request
+            # the browser makes, so honoring it for API or mutating
+            # endpoints would rest CSRF safety entirely on the
+            # client-set SameSite attribute (ADVICE r5 #2). API calls
+            # keep Bearer/x-api-key mandatory. The /login page stores
+            # the cookie percent-encoded (encodeURIComponent — cookie
+            # values cannot carry ';' etc.), so decode before comparing:
+            # keys with '+'/'='/'/' otherwise never match and every
+            # navigation 302-loops back to /login (ADVICE r5 #3).
+            token = unquote(request.cookies.get("localai_api_key", ""))
         if token not in keys:
             is_ui_page = request.method == "GET" and (
                 request.path == "/" or any(

@@ -157,6 +157,11 @@ async def backend_monitor(request: web.Request) -> web.Response:
         "status": status.state,
         "backend": lm.backend_type,
         "busy": lm.busy_since is not None,
+        # cold-start observability (models/load_timing.py): where the
+        # load's wall time went — read/dequant/transfer/compile/warmup
+        "load_s": round(lm.load_s, 2),
+        "load_breakdown": getattr(lm.backend, "load_breakdown",
+                                  None) or None,
     })
 
 

@@ -97,9 +97,12 @@ document.getElementById('key').value=
 async function save(){
  const k=document.getElementById('key').value;
  localStorage.setItem('localai_api_key',k);
- // cookie authenticates server-rendered PAGE loads (a navigation
- // cannot carry the Bearer header); SameSite keeps it off
- // cross-site requests
+ // cookie authenticates server-rendered PAGE loads only (a navigation
+ // cannot carry the Bearer header; the middleware accepts it solely
+ // for GET text/html requests, so API/mutating endpoints never rely
+ // on it). Stored percent-encoded — cookie values cannot carry ';' —
+ // and the server percent-decodes before comparing, so keys with
+ // '+'/'='/'/' round-trip. SameSite keeps it off cross-site requests.
  document.cookie='localai_api_key='+encodeURIComponent(k)
    +'; path=/; SameSite=Strict';
  const r=await fetch('/v1/models',{headers:authHeaders()});

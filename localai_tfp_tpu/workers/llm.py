@@ -71,6 +71,10 @@ class JaxLLMBackend(Backend):
         self._artifact_thread: Any = None  # deferred quant-cache write
         self._artifact_abort = threading.Event()
         self.load_mode = "unknown"  # "artifact" | "full" after a load
+        self.load_breakdown: dict = {}  # phase-timing breakdown of the
+        # last load (models/load_timing.py): read/dequant/transfer/
+        # compile/warmup seconds + total. Surfaced by /backend/monitor
+        # and bench.py extra.checkpoint_load_breakdown.
 
     # ------------------------------------------------------------- lifecycle
 
@@ -136,6 +140,10 @@ class JaxLLMBackend(Backend):
                     f"load failed: model not found: {model_dir}",
                 )
             self._abort_pending_artifact()  # the real load begins here
+            from ..models.load_timing import LoadPhases
+
+            phases = LoadPhases()
+            self.load_breakdown = {}
             if channel is not None and role == "leader":
                 # followers load the identical checkpoint from their own
                 # disk (in parallel with ours) and then replay this
@@ -182,14 +190,17 @@ class JaxLLMBackend(Backend):
                     )
 
                     hf_state = None
-                    gf = GGUFFile(model_dir)
+                    with phases.timed("read_s"):  # vocab-heavy header
+                        gf = GGUFFile(model_dir)
+                    gf.phases = phases  # per-tensor read/dequant split
                     with staged():
                         self.spec, params = load_gguf_params(
                             model_dir, dtype=dtype, gf=gf)
                 else:
                     from ..models.hf_loader import load_hf_state
 
-                    hf_state = load_hf_state(model_dir)
+                    with phases.timed("read_s"):
+                        hf_state = load_hf_state(model_dir)
                     from ..models.mamba import is_mamba_config
                     from ..models.rwkv import is_rwkv_config
 
@@ -205,6 +216,8 @@ class JaxLLMBackend(Backend):
                         self.rwkv = load_rwkv(model_dir, dtype=dtype)
                         self.tokenizer = load_tokenizer(model_dir)
                         self._state = "READY"
+                        self.load_mode = "full"
+                        self.load_breakdown = phases.as_dict()
                         return Result(True, "rwkv model loaded")
                     if is_mamba_config(hf_state[0]):
                         # SSM family (ref: transformers backend
@@ -219,6 +232,8 @@ class JaxLLMBackend(Backend):
                         self.mamba = load_mamba(model_dir, dtype=dtype)
                         self.tokenizer = load_tokenizer(model_dir)
                         self._state = "READY"
+                        self.load_mode = "full"
+                        self.load_breakdown = phases.as_dict()
                         return Result(True, "mamba model loaded")
                     # single-chip quantized loads stream raw leaves to
                     # the chip and fuse cast+transpose+quantize there
@@ -239,7 +254,8 @@ class JaxLLMBackend(Backend):
                         artifact_file = artifact_path(
                             model_dir, quant, str(dtype.__name__))
                         params = try_load(artifact_file,
-                                          jax.devices()[0])
+                                          jax.devices()[0],
+                                          phases=phases)
                         if params is not None:
                             self.spec = spec_from_hf_config(hf_state[0])
                             if "lm_head" not in params:
@@ -256,7 +272,8 @@ class JaxLLMBackend(Backend):
                         with staged():
                             self.spec, params = load_params(
                                 model_dir, dtype=dtype, state=hf_state,
-                                defer_transpose=defer_commit)
+                                defer_transpose=defer_commit,
+                                phases=phases)
                 # merge LoRA adapters at load (ref: llama.cpp LoRA apply
                 # via LoadModel — proto LoraAdapter/LoraScale)
                 with staged():
@@ -316,7 +333,8 @@ class JaxLLMBackend(Backend):
                     params = commit_deferred(
                         params, dtype, jax.devices()[0],
                         quantize=True,
-                        quantize_embeddings=quant == "int8_full")
+                        quantize_embeddings=quant == "int8_full",
+                        phases=phases)
                     pending_artifact = artifact_file
                 elif self._quantized and not artifact_hit:
                     # AFTER LoRA merge: adapters fold into full-precision
@@ -327,15 +345,17 @@ class JaxLLMBackend(Backend):
                     # tree then ships to the accelerator.
                     from ..models.quant import quantize_params
 
-                    with staged():
+                    with staged(), phases.timed("dequant_s"):
                         params = quantize_params(
                             params, embeddings=quant == "int8_full")
                         params = jax.block_until_ready(params)
                     if opts.mesh:
                         pass  # shard_params places shards itself
                     else:
-                        params = jax.device_put(
-                            params, jax.devices()[0])
+                        with phases.timed("transfer_s"):
+                            params = jax.device_put(
+                                params, jax.devices()[0])
+                            params = jax.block_until_ready(params)
                 mesh = None
                 if opts.mesh:
                     from ..parallel.mesh import make_mesh
@@ -352,37 +372,49 @@ class JaxLLMBackend(Backend):
                         draft = load_gguf_params(ddir, dtype=dtype)
                     else:
                         draft = load_params(ddir, dtype=dtype)
-                self.engine = LLMEngine(
-                    self.spec,
-                    params,
-                    self.tokenizer,
-                    n_slots=max(1, opts.batch_slots),
-                    max_seq=opts.context_size,
-                    cache_dtype=kv_dtype,
-                    decode_steps=int(opts.extra.get("decode_steps", 8)),
-                    latency_target_ms=(
-                        float(opts.extra["latency_target_ms"])
-                        if opts.extra.get("latency_target_ms") is not None
-                        else None),
-                    mesh=mesh,
-                    draft=draft,
-                    n_draft=opts.n_draft or 4,
-                    channel=channel if role == "leader" else None,
-                    follower=role == "follower",
-                    tag=opts.model,
-                )
-                self.engine.start()
+                with phases.timed("compile_s"):
+                    self.engine = LLMEngine(
+                        self.spec,
+                        params,
+                        self.tokenizer,
+                        n_slots=max(1, opts.batch_slots),
+                        max_seq=opts.context_size,
+                        cache_dtype=kv_dtype,
+                        decode_steps=int(opts.extra.get("decode_steps",
+                                                        8)),
+                        latency_target_ms=(
+                            float(opts.extra["latency_target_ms"])
+                            if opts.extra.get("latency_target_ms")
+                            is not None
+                            else None),
+                        mesh=mesh,
+                        draft=draft,
+                        n_draft=opts.n_draft or 4,
+                        channel=channel if role == "leader" else None,
+                        follower=role == "follower",
+                        tag=opts.model,
+                    )
+                    self.engine.start()
                 if (role != "follower"
                         and os.environ.get("LOCALAI_WARMUP", "1")
                         not in ("0", "false", "off")):
                     # precompile the dispatch-variant set: a cold jit
                     # landing mid-request is a ~13s TTFT outlier at 8B
-                    # scale (engine.warmup docstring)
-                    self.engine.warmup()
+                    # scale (engine.warmup docstring); an identical
+                    # variant set already in the persistent compile
+                    # cache skips the pass (warmup_reused)
+                    with phases.timed("warmup_s"):
+                        self.engine.warmup()
                 # which load path this load ACTUALLY took (bench and
                 # operators read it; inferring it from artifact-file
                 # existence mislabels version-mismatch/corrupt misses)
                 self.load_mode = "artifact" if artifact_hit else "full"
+                self.load_breakdown = {
+                    **phases.as_dict(),
+                    "load_mode": self.load_mode,
+                    "warmup_reused": bool(
+                        getattr(self.engine, "warmup_reused", False)),
+                }
                 if pending_artifact:
                     from ..models.artifact_cache import save_async
 

@@ -1,6 +1,9 @@
 """Model lifecycle: registry, load-or-reuse, watchdog, JAX LLM worker
-(ref: pkg/model/loader_test.go; watchdog.go semantics)."""
+(ref: pkg/model/loader_test.go; watchdog.go semantics), and the
+concurrency contract: a model mid-load never blocks serving of an
+already-loaded model, and duplicate concurrent loads coalesce."""
 
+import threading
 import time
 
 import pytest
@@ -143,6 +146,128 @@ def test_stop_all():
     ml.load(_cfg("a"))
     ml.load(_cfg("b"))
     ml.stop_all()
+    assert ml.loaded_names() == []
+
+
+# ------------------------------------------------- loader concurrency
+
+
+class SlowBackend(FakeBackend):
+    """FakeBackend whose load parks on a gate: tests stage a load
+    mid-flight, assert the registry stays responsive, then release."""
+
+    instances = 0
+    started = threading.Event()
+    gate = threading.Event()
+
+    def __init__(self):
+        SlowBackend.instances += 1
+        super().__init__()
+
+    def load_model(self, opts):
+        SlowBackend.started.set()
+        assert SlowBackend.gate.wait(timeout=30), "gate never released"
+        return super().load_model(opts)
+
+
+@pytest.fixture
+def slow_registry():
+    registry.register("slow", SlowBackend)
+    SlowBackend.instances = 0
+    SlowBackend.started = threading.Event()
+    SlowBackend.gate = threading.Event()
+    yield
+    SlowBackend.gate.set()  # never leave a loader thread parked
+
+
+def test_loaded_model_served_while_other_load_in_flight(slow_registry):
+    """The ISSUE's acceptance bar: a registry with model B mid-load
+    (checkpoint IO + compiles — minutes at 8B scale) serves the
+    already-loaded model A without blocking. Proven by wall clock, not
+    inspection: A's lookups return while B's load is parked."""
+    ml = ModelLoader()
+    a = ml.load(_cfg("a"))
+
+    t = threading.Thread(target=ml.load,
+                         args=(_cfg("b", backend="slow"),), daemon=True)
+    t.start()
+    assert SlowBackend.started.wait(timeout=10)
+
+    # B is mid-load NOW. Both the event-loop fast path and the full
+    # load-or-reuse path of A must return promptly.
+    t0 = time.monotonic()
+    assert ml.get_loaded("a") is a
+    assert ml.load(_cfg("a")) is a
+    assert ml.loaded_names() == ["a"]  # map reads don't block either
+    assert time.monotonic() - t0 < 5.0
+    assert "b" not in ml.loaded_names()  # B genuinely still loading
+
+    SlowBackend.gate.set()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert ml.get_loaded("b") is not None
+
+
+def test_concurrent_same_model_loads_coalesce(slow_registry):
+    """Two concurrent load(B) calls build ONE backend: the second call
+    parks on the first's in-flight load and shares its instance."""
+    ml = ModelLoader()
+    results: list = [None, None]
+
+    def call(i):
+        results[i] = ml.load(_cfg("b", backend="slow"))
+
+    t1 = threading.Thread(target=call, args=(0,), daemon=True)
+    t1.start()
+    assert SlowBackend.started.wait(timeout=10)
+    t2 = threading.Thread(target=call, args=(1,), daemon=True)
+    t2.start()
+    # give the second caller time to reach (and park on) the in-flight
+    # load; a non-coalescing loader would have built instance #2 by now
+    deadline = time.monotonic() + 5
+    while SlowBackend.instances < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.2)
+    assert SlowBackend.instances == 1
+
+    SlowBackend.gate.set()
+    t1.join(timeout=30)
+    t2.join(timeout=30)
+    assert results[0] is results[1] is ml.get_loaded("b")
+    assert SlowBackend.instances == 1
+
+
+def test_coalesced_load_failure_propagates(slow_registry):
+    """A waiter coalesced onto a failing load gets the error too (no
+    half-registered backend)."""
+
+    class SlowFailing(SlowBackend):
+        def load_model(self, opts):
+            SlowBackend.started.set()
+            assert SlowBackend.gate.wait(timeout=30)
+            return Result(False, "disk on fire")
+
+    registry.register("slowfail", SlowFailing)
+    ml = ModelLoader()
+    errs: list = []
+
+    def call():
+        try:
+            ml.load(_cfg("b", backend="slowfail"))
+        except RuntimeError as e:
+            errs.append(str(e))
+
+    t1 = threading.Thread(target=call, daemon=True)
+    t1.start()
+    assert SlowBackend.started.wait(timeout=10)
+    t2 = threading.Thread(target=call, daemon=True)
+    t2.start()
+    time.sleep(0.2)
+    SlowBackend.gate.set()
+    t1.join(timeout=30)
+    t2.join(timeout=30)
+    assert len(errs) == 2
+    assert all("disk on fire" in e for e in errs)
     assert ml.loaded_names() == []
 
 

@@ -494,3 +494,52 @@ def test_ui_login_flow_under_api_keys(auth_client):
         "Accept": "text/html", "Cookie": "localai_api_key=nope"},
         allow_redirects=False)
     assert r.status == 302
+
+
+def test_cookie_never_authenticates_api_or_mutations(auth_client):
+    """The cookie is NAVIGATION auth only (GET + Accept: text/html).
+    Accepting it elsewhere would make every API and mutating endpoint
+    CSRF-reachable with nothing but the client-set SameSite attribute
+    in the way (ADVICE r5 #2)."""
+    # mutating endpoint with only the cookie: 401, not executed
+    r = auth_client.post("/models/delete/x", headers={
+        "Cookie": "localai_api_key=sk-test"}, allow_redirects=False)
+    assert r.status == 401
+    # API GET without text/html Accept: cookie ignored
+    r = auth_client.get("/v1/models", headers={
+        "Cookie": "localai_api_key=sk-test"}, allow_redirects=False)
+    assert r.status == 401
+    # even a text/html POST must not ride the cookie
+    r = auth_client.post("/models/delete/x", headers={
+        "Cookie": "localai_api_key=sk-test", "Accept": "text/html"},
+        allow_redirects=False)
+    assert r.status == 401
+
+
+def test_cookie_percent_decoded_before_compare(workdir):
+    """Keys with '+'/'='/'/' are stored percent-encoded by the /login
+    page JS; the middleware must decode or navigations 302-loop
+    (ADVICE r5 #3)."""
+    loop = asyncio.new_event_loop()
+    cfg = ApplicationConfig(
+        models_path=str(workdir / "models"),
+        generated_content_dir=str(workdir / "generated"),
+        upload_dir=str(workdir / "uploads"),
+        config_dir=str(workdir / "configuration"),
+        api_keys=["sk+odd/chars="],
+    )
+    state = Application(cfg)
+    app = build_app(state)
+    tc = TestClient(TestServer(app), loop=loop)
+    loop.run_until_complete(tc.start_server())
+    try:
+        client = SyncClient(loop, tc)
+        # encodeURIComponent("sk+odd/chars=")
+        r = client.get("/", headers={
+            "Accept": "text/html",
+            "Cookie": "localai_api_key=sk%2Bodd%2Fchars%3D"},
+            allow_redirects=False)
+        assert r.status == 200
+    finally:
+        loop.run_until_complete(tc.close())
+        loop.close()

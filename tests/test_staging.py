@@ -173,8 +173,8 @@ def test_worker_load_hits_artifact_second_time(tmp_path, monkeypatch):
     calls = {"hit": 0}
     real = ac.try_load
 
-    def counting(path, device):
-        r = real(path, device)
+    def counting(path, device, **kw):
+        r = real(path, device, **kw)
         if r is not None:
             calls["hit"] += 1
         return r

@@ -1,0 +1,158 @@
+"""Cold-start regression check: load the bench 8B artifact once and
+print the phase-timing breakdown table.
+
+The r5 bench reported `checkpoint_load_s = 256.9` in artifact mode
+against a ~90 s annotation — 167 unattributed seconds. The loader now
+bills every load into phases (models/load_timing.py:
+read/dequant/transfer/compile/warmup + other); this tool makes the
+breakdown a one-command check so a regression in any single phase is
+visible the day it lands, not at the end-of-round bench.
+
+Runs the SAME path bench.py's 8B leg takes: real-format HF checkpoint
+(cached across runs) -> Application -> ModelLoader -> JaxLLMBackend
+(artifact cache on, so the second run measures the artifact-mode load).
+On CPU hosts a tiny geometry is substituted so the tool runs anywhere.
+
+Usage:
+  python tools/profile_coldstart.py            # geometry by backend
+  python tools/profile_coldstart.py --tiny     # force tiny (CPU smoke)
+  python tools/profile_coldstart.py --cold     # drop the quant artifact
+                                               # first: measure the full
+                                               # (streamed) load
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true",
+                    help="force the tiny CPU geometry")
+    ap.add_argument("--cold", action="store_true",
+                    help="remove the quant artifact first (full load)")
+    ap.add_argument("--no-warmup-reuse", action="store_true",
+                    help="ignore persistent-cache warmup markers")
+    args = ap.parse_args()
+
+    if args.no_warmup_reuse:
+        os.environ["LOCALAI_WARMUP_REUSE"] = "off"
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      "/root/.cache/localai_xla")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+    import shutil
+    import tempfile
+    import time
+
+    from bench import _write_hf_checkpoint
+    from localai_tfp_tpu.config.app_config import ApplicationConfig
+    from localai_tfp_tpu.engine.loader import register_default_backends
+    from localai_tfp_tpu.models.llm_spec import LLMSpec
+    from localai_tfp_tpu.server.state import Application
+
+    on_tpu = jax.default_backend() == "tpu" and not args.tiny
+    if on_tpu:
+        spec = LLMSpec(
+            vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, d_head=128, d_ff=14336, max_position=4096,
+            rope_theta=500000.0,
+        )
+        slots, ctx = 64, 1024
+    else:
+        spec = LLMSpec(
+            vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_head=16, d_ff=128, max_position=256,
+        )
+        slots, ctx = 2, 128
+
+    import hashlib
+
+    key = hashlib.sha256(
+        (repr(spec) + "|writer-v2").encode()).hexdigest()[:16]
+    cache_root = os.environ.get(
+        "XDG_CACHE_HOME", os.path.expanduser("~/.cache"))
+    ckpt = os.path.join(cache_root, f"localai_bench_ckpt_{key}")
+    if not os.path.exists(os.path.join(ckpt, ".complete")):
+        shutil.rmtree(ckpt, ignore_errors=True)
+        print(f"writing checkpoint {ckpt} ...", flush=True)
+        _write_hf_checkpoint(ckpt, spec)
+        with open(os.path.join(ckpt, ".complete"), "w") as f:
+            f.write("ok")
+
+    if args.cold:
+        from localai_tfp_tpu.models.artifact_cache import artifact_path
+
+        p = artifact_path(ckpt, "int8_full", "bfloat16")
+        if os.path.exists(p):
+            os.unlink(p)
+            print(f"dropped artifact {p} (cold full load)", flush=True)
+
+    tmp = tempfile.mkdtemp(prefix="coldstart-")
+    try:
+        models = os.path.join(tmp, "models")
+        os.makedirs(models)
+        os.symlink(ckpt, os.path.join(models, "ckpt"))
+        with open(os.path.join(models, "prof.yaml"), "w") as f:
+            f.write(
+                "name: prof\n"
+                "backend: jax-llm\n"
+                "parameters:\n  model: ckpt\n"
+                f"context_size: {ctx}\n"
+                f"max_batch_slots: {slots}\n"
+                "quantization: int8_full\n"
+                "kv_cache_dtype: int8\n"
+                "decode_steps: 16\n"
+                "latency_target_ms: 70\n"
+            )
+        state = Application(ApplicationConfig(
+            models_path=models,
+            generated_content_dir=os.path.join(tmp, "generated"),
+            upload_dir=os.path.join(tmp, "uploads"),
+            config_dir=os.path.join(tmp, "configuration"),
+        ))
+        register_default_backends()
+        state.config_loader.load_configs_from_path()
+        t0 = time.perf_counter()
+        backend = state.model_loader.load(state.config_loader.get("prof"))
+        total = time.perf_counter() - t0
+        bd = dict(getattr(backend, "load_breakdown", {}) or {})
+        mode = bd.pop("load_mode", getattr(backend, "load_mode", "?"))
+        reused = bd.pop("warmup_reused", False)
+
+        print(f"\ncold-start load: {total:.1f}s  mode={mode}  "
+              f"warmup_reused={reused}")
+        print(f"{'phase':<12}{'seconds':>9}   share")
+        tot = bd.get("total_s") or total
+        for p in ("read_s", "dequant_s", "transfer_s", "compile_s",
+                  "warmup_s", "other_s"):
+            v = float(bd.get(p, 0.0))
+            bar = "#" * int(40 * v / tot) if tot else ""
+            print(f"{p:<12}{v:>9.2f}   {bar}")
+        print(f"{'total_s':<12}{float(bd.get('total_s', total)):>9.2f}")
+        print("\nJSON: " + json.dumps(
+            {**bd, "load_mode": mode, "warmup_reused": reused}))
+        # leave the artifact behind so the NEXT run measures artifact
+        # mode: the deferred write is abandoned by shutdown(), so wait
+        # for it here (idle engine -> starts immediately)
+        t = getattr(backend, "_artifact_thread", None)
+        if t is not None:
+            print("waiting for quant artifact write ...", flush=True)
+            t.join(timeout=600)
+        backend.shutdown()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
