@@ -525,6 +525,14 @@ def main() -> None:
     tok = WideByteTok()
     extra: dict = {}
 
+    # telemetry registry delta across the whole bench run: the counter/
+    # histogram movement (requests by reason, tokens, TTFT/queue-wait
+    # counts) lands in extra.telemetry so a regression in serving
+    # signals is visible next to the throughput headline
+    from localai_tfp_tpu.telemetry.registry import REGISTRY
+
+    tel_snap = REGISTRY.snapshot()
+
     if on_tpu:
         # --- 1B-class config (driver-tracked geometry since round 1;
         # kept in extra for cross-round continuity) ---
@@ -753,6 +761,7 @@ def main() -> None:
         extra["ttft_p50_ms"] = p50
         extra["ttft_p50_ms_http"] = p50_h
 
+    extra["telemetry"] = REGISTRY.delta(tel_snap)
     print(json.dumps({
         "metric": "decode_throughput",
         "value": tok_s,

@@ -48,6 +48,8 @@ from ..models.transformer import KVCache, Params, forward, forward_hidden
 from ..ops.sampling import (
     SamplingState, observe_tokens, sample, seed_windows,
 )
+from ..telemetry import metrics as tm
+from ..telemetry.tracing import TRACER
 from .tokenizer import StreamDecoder, Tokenizer
 
 log = logging.getLogger(__name__)
@@ -99,6 +101,8 @@ class GenRequest:
     soft_embeds: Optional[np.ndarray] = None
     soft_positions: Optional[np.ndarray] = None
     id: str = field(default_factory=lambda: uuid.uuid4().hex)
+    t_submit: float = 0.0  # perf_counter at submit (queue-wait/TTFT
+    # attribution; set by submit_many, 0 for directly-assigned tests)
 
 
 class _PadReq:
@@ -134,6 +138,10 @@ class StreamEvent:
     completion_tokens: int = 0
     timing_prompt_processing_ms: float = 0.0
     timing_token_generation_ms: float = 0.0
+    # request-lifecycle attribution (Extra-Usage surface): time queued
+    # before admission, and submit-to-first-token latency
+    timing_queue_ms: float = 0.0
+    timing_first_token_ms: float = 0.0
 
 
 class SlotState(Enum):
@@ -183,6 +191,7 @@ class _Slot:
     constraint_state: Any = None
     cache_loaded: Any = None  # (path, n) the on-disk prompt cache holds
     t_start: float = 0.0
+    t_first: float = 0.0  # perf_counter at first emitted token
     t_prefill_ms: float = 0.0
     t_decode_ms: float = 0.0
     t_last: float = 0.0
@@ -339,6 +348,9 @@ class LLMEngine:
         self.channel = channel
         self.follower = follower
         self.tag = tag
+        # Prometheus model label: the serving tag, or a stable fallback
+        # for directly-constructed engines (tests/bench)
+        self._mlabel = tag or "default"
         if follower:
             autostart = False
         self.decode_steps = max(1, decode_steps)
@@ -881,6 +893,9 @@ class LLMEngine:
                 self._flush_emit(s)
         self.metrics.spec_tokens += emitted_total
         self.metrics.spec_dispatches += 1
+        if emitted_total:
+            tm.ENGINE_GENERATED_TOKENS.labels(model=self._mlabel).inc(
+                emitted_total)
         # spec advanced positions the decodek device-resident carry may
         # still hold stale copies of; a stale inactive-row position would
         # write K/V inside the advanced prefix
@@ -1074,6 +1089,10 @@ class LLMEngine:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        # a closed engine must not leave stale occupancy on /metrics
+        tm.ENGINE_SLOTS_BUSY.labels(model=self._mlabel).set(0)
+        tm.ENGINE_QUEUE_DEPTH.labels(model=self._mlabel).set(0)
+        tm.ENGINE_KV_UTIL.labels(model=self._mlabel).set(0.0)
         if self.mesh is not None:
             # release the process-wide meshed gate so a later unmeshed
             # engine regains the fused int8 kernel (single-owner rule)
@@ -1291,11 +1310,18 @@ class LLMEngine:
             # engage the burst clamp or the prefill-formation hold —
             # they contribute nothing a prefill could serve (ADVICE
             # r5 #4)
+            now = time.perf_counter()
+            for req, _ in ok:
+                req.t_submit = now
             with self._lock:
                 self._pending.extend(ok)
-                self._last_arrival = time.perf_counter()
+                self._last_arrival = now
                 self._arrivals.append(self._last_arrival)
+                depth = len(self._pending)
                 self._lock.notify_all()
+            for req, _ in ok:
+                TRACER.event(req.id, "queue", t=now, model=self._mlabel)
+            tm.ENGINE_QUEUE_DEPTH.labels(model=self._mlabel).set(depth)
             if self._autostart:
                 self.start()
         return outs
@@ -1331,14 +1357,22 @@ class LLMEngine:
             cancelled = self._cancelled
             # queued requests: drop before admission
             still = []
+            dropped = []
             for req, out in self._pending:
                 if req.id in cancelled:
                     del cancelled[req.id]
                     out.put(StreamEvent(done=True,
                                         finish_reason="cancelled"))
+                    dropped.append(req.id)
                 else:
                     still.append((req, out))
             self._pending = still
+        for rid in dropped:
+            TRACER.event(rid, "done")
+            TRACER.finish(rid, status="cancelled")
+            tm.ENGINE_REQUESTS.labels(model=self._mlabel,
+                                      reason="cancelled").inc()
+            tm.ENGINE_CANCELLATIONS.labels(model=self._mlabel).inc()
         hit = [s for s in self.slots
                if s.active and s.request is not None
                and s.request.id in cancelled]
@@ -1371,6 +1405,12 @@ class LLMEngine:
             if s.active and s.out is not None:
                 s.out.put(StreamEvent(done=True, finish_reason="error",
                                       error=msg))
+                if s.request is not None:
+                    TRACER.event(s.request.id, "done")
+                    TRACER.finish(s.request.id, status="error")
+                    tm.ENGINE_REQUESTS.labels(model=self._mlabel,
+                                              reason="error").inc()
+                    tm.ENGINE_PREEMPTIONS.labels(model=self._mlabel).inc()
                 self._release(s)
 
     def step(self) -> None:
@@ -1392,8 +1432,21 @@ class LLMEngine:
         self._admit()
         harvested = self._harvest()
         dispatched = self._dispatch()
+        self._update_gauges()
         if not (harvested or dispatched):
             self._wait_for_event()
+
+    def _update_gauges(self) -> None:
+        """Scheduler-state gauges, refreshed once per iteration from
+        values the scheduler already holds on the host (no device syncs;
+        three lock-guarded stores per ms-scale iteration)."""
+        m = self._mlabel
+        busy = sum(1 for s in self.slots if s.active)
+        tm.ENGINE_SLOTS_BUSY.labels(model=m).set(busy)
+        tm.ENGINE_QUEUE_DEPTH.labels(model=m).set(len(self._pending))
+        used = sum(s.n_past for s in self.slots if s.active)
+        tm.ENGINE_KV_UTIL.labels(model=m).set(
+            used / float(self.n_slots * self.max_seq))
 
     def _dispatch(self) -> bool:
         """Enqueue device work for the current slot states. Returns
@@ -1705,6 +1758,11 @@ class LLMEngine:
 
     def _assign(self, slot: _Slot, req: GenRequest,
                 out: queue.SimpleQueue) -> None:
+        now = time.perf_counter()
+        TRACER.event(req.id, "admit", t=now, model=self._mlabel)
+        if req.t_submit:
+            tm.ENGINE_QUEUE_WAIT.labels(model=self._mlabel).observe(
+                max(0.0, now - req.t_submit))
         slot.cache_loaded = None
         if req.soft_embeds is not None:
             common = 0  # image-conditioned K/V: no token-id prefix reuse
@@ -1722,7 +1780,8 @@ class LLMEngine:
         slot.generated = []
         slot.decoder = StreamDecoder(self.tokenizer)
         slot.pending_text = ""
-        slot.t_start = time.perf_counter()
+        slot.t_start = now
+        slot.t_first = 0.0
         slot.t_prefill_ms = 0.0
         slot.t_decode_ms = 0.0
         slot.constraint_state = (
@@ -1967,12 +2026,14 @@ class LLMEngine:
             toks_out.copy_to_host_async()
         except Exception:
             pass  # not all backends expose it; harvest still works
+        t_disp = time.perf_counter()
         for s in group:
             req = s.request
             chunk_len = len(req.prompt_ids) - s.n_past
             s.cache_tokens.extend(req.prompt_ids[s.n_past:])
             s.n_past += chunk_len
             s.state = SlotState.PENDING_FIRST
+            TRACER.event(req.id, "prefill_dispatch", t=t_disp)
         self._flights.append(_Flight(
             kind="prefill_final", arrays=[toks_out],
             meta={"pairs": [(s, s.request) for s in group], "rows": rows},
@@ -1986,15 +2047,24 @@ class LLMEngine:
         dt_ms = (time.perf_counter() - fl.t_enqueue) * 1e3
         now = time.perf_counter()
         rows = fl.meta.get("rows") or range(len(fl.meta["pairs"]))
+        prompt_toks = first_toks = 0
         for r, (s, req) in zip(rows, fl.meta["pairs"]):
             if s.request is not req:  # cancelled mid-flight
                 continue
             s.t_prefill_ms += dt_ms
             self.metrics.prompt_tokens_processed += s.n_prompt
+            prompt_toks += s.n_prompt
+            first_toks += 1
             s.state = SlotState.DECODE
             s.t_last = now
             self._epoch += 1
             self._emit_token(s, int(toks_host[r]))
+        if prompt_toks:
+            tm.ENGINE_PROMPT_TOKENS.labels(model=self._mlabel).inc(
+                prompt_toks)
+        if first_toks:
+            tm.ENGINE_GENERATED_TOKENS.labels(model=self._mlabel).inc(
+                first_toks)
 
     def _soft_payload(self, group: list[_Slot], pos0: Any,
                       bucket: int,
@@ -2361,6 +2431,8 @@ class LLMEngine:
             # outlier guard drops compile/transfer stalls.
             self._step_ms = (step if self._step_ms == 0.0
                              else 0.8 * self._step_ms + 0.2 * step)
+            tm.ENGINE_DECODE_STEP.labels(model=self._mlabel).observe(
+                step / 1e3)
         prev_last = fl.meta["prev_last"]
         if prev_last is None:
             prev_last = self._harvest_last
@@ -2387,6 +2459,10 @@ class LLMEngine:
         self._harvest_last = next_last
         if dt_ms > 0 and emitted:
             self.metrics.tokens_per_second = emitted / (dt_ms / 1e3)
+            tm.ENGINE_GENERATED_TOKENS.labels(model=self._mlabel).inc(
+                emitted)
+            tm.ENGINE_INTER_TOKEN.labels(model=self._mlabel).observe(
+                dt_ms / 1e3 / k)
         self.metrics.slots_busy = sum(1 for s in self.slots if s.active)
 
     def _decode1_step(self, decoding: list[_Slot]) -> None:
@@ -2422,6 +2498,8 @@ class LLMEngine:
         self._epoch += 1  # device carry (if any) is now stale
         if dt_ms > 0 and emitted:
             self.metrics.tokens_per_second = emitted / (dt_ms / 1e3)
+            tm.ENGINE_GENERATED_TOKENS.labels(model=self._mlabel).inc(
+                emitted)
         self.metrics.slots_busy = sum(1 for s in self.slots if s.active)
 
     # ---------------------------------------------------- token → stream
@@ -2443,6 +2521,17 @@ class LLMEngine:
             slot.constraint_state = req.constraint.advance(
                 slot.constraint_state, token_id
             )
+        if not slot.generated:
+            # first token of the request: TTFT and prefill attribution
+            # (host timestamps only; guarded so the per-token path pays
+            # one list check)
+            slot.t_first = time.perf_counter()
+            TRACER.event(req.id, "first_token", t=slot.t_first)
+            if req.t_submit:
+                tm.ENGINE_TTFT.labels(model=self._mlabel).observe(
+                    slot.t_first - req.t_submit)
+            tm.ENGINE_PREFILL.labels(model=self._mlabel).observe(
+                slot.t_prefill_ms / 1e3)
         slot.generated.append(token_id)
         self.metrics.tokens_generated += 1
 
@@ -2510,6 +2599,12 @@ class LLMEngine:
             if slot.out is not None and slot.pending_text:
                 slot.out.put(StreamEvent(text=slot.pending_text))
         dt_decode = slot.t_decode_ms
+        now = time.perf_counter()
+        queue_ms = ttft_ms = 0.0
+        if req is not None and req.t_submit:
+            queue_ms = max(0.0, (slot.t_start - req.t_submit) * 1e3)
+            if slot.t_first:
+                ttft_ms = (slot.t_first - req.t_submit) * 1e3
         ev = StreamEvent(
             done=True,
             finish_reason=reason,
@@ -2518,10 +2613,18 @@ class LLMEngine:
             completion_tokens=len(slot.generated),
             timing_prompt_processing_ms=slot.t_prefill_ms,
             timing_token_generation_ms=dt_decode,
+            timing_queue_ms=queue_ms,
+            timing_first_token_ms=ttft_ms,
         )
         if slot.out is not None:
             slot.out.put(ev)
         self.metrics.requests_completed += 1
+        tm.ENGINE_REQUESTS.labels(model=self._mlabel, reason=reason).inc()
+        if reason == "cancelled":
+            tm.ENGINE_CANCELLATIONS.labels(model=self._mlabel).inc()
+        if req is not None:
+            TRACER.event(req.id, "done", t=now)
+            TRACER.finish(req.id, status=reason)
         self._release(slot)
 
     def _release(self, slot: _Slot) -> None:

@@ -20,9 +20,15 @@ import time
 from typing import Callable, Optional
 
 from ..config.model_config import ModelConfig
+from ..telemetry import metrics as tm
 from ..workers.base import Backend, ModelLoadOptions, Result
 
 log = logging.getLogger(__name__)
+
+# load_timing.py phase keys -> prometheus phase label (other_s is the
+# reconciling remainder the breakdown always carries)
+_LOAD_PHASES = ("read_s", "dequant_s", "transfer_s", "compile_s",
+                "warmup_s", "other_s")
 
 BackendFactory = Callable[[], Backend]
 
@@ -247,6 +253,7 @@ class ModelLoader:
             return backend
         except BaseException as e:
             fl.error = e
+            tm.MODEL_LOADS.labels(model=cfg.name, result="error").inc()
             raise
         finally:
             with self._lock:
@@ -276,7 +283,10 @@ class ModelLoader:
                                for n in list(self._models)
                                if n != cfg.name]
                 for v in victims:
+                    tm.MODEL_EVICTIONS.labels(reason="single_active").inc()
                     self._shutdown_backend(v)
+                if victims:
+                    self._update_gauges()
 
             if cfg.isolation == "subprocess":
                 # child-process containment (workers/subprocess_worker):
@@ -296,6 +306,16 @@ class ModelLoader:
             lm.load_s = time.monotonic() - t0
             with self._lock:
                 self._models[cfg.name] = lm
+            tm.MODEL_LOADS.labels(model=cfg.name, result="success").inc()
+            # fold the cold-start phase breakdown (models/load_timing.py,
+            # already on the backend) into cumulative per-phase counters
+            bd = getattr(backend, "load_breakdown", None) or {}
+            for phase in _LOAD_PHASES:
+                v = bd.get(phase)
+                if v:
+                    tm.MODEL_LOAD_PHASE.labels(
+                        phase=phase[:-2]).inc(float(v))
+            self._update_gauges()
             return backend
         finally:
             if self.single_active:
@@ -354,17 +374,21 @@ class ModelLoader:
         with self._lock:
             return sorted(self._models)
 
-    def shutdown_model(self, name: str) -> bool:
+    def shutdown_model(self, name: str, reason: str = "api") -> bool:
         """Unload one model. The registry entry is removed under the map
         lock; the (potentially slow — engine thread join) backend
         shutdown runs outside it so other models keep serving. A
         shutdown racing a concurrent load of the same name can land
         before the load publishes; the load then wins — callers that
-        need the model gone for good should stop issuing loads first."""
+        need the model gone for good should stop issuing loads first.
+        ``reason`` labels the eviction metric (api/watchdog_busy/
+        watchdog_idle/shutdown/...)."""
         with self._lock:
             lm = self._models.pop(name, None)
         if lm is None:
             return False
+        tm.MODEL_EVICTIONS.labels(reason=reason).inc()
+        self._update_gauges()
         self._shutdown_backend(lm)
         return True
 
@@ -372,7 +396,17 @@ class ModelLoader:
         with self._lock:
             victims = [self._models.pop(n) for n in list(self._models)]
         for lm in victims:
+            tm.MODEL_EVICTIONS.labels(reason="shutdown").inc()
             self._shutdown_backend(lm)
+        self._update_gauges()
+
+    def _update_gauges(self) -> None:
+        with self._lock:
+            n = len(self._models)
+            busy = sum(1 for lm in self._models.values()
+                       if lm.busy_since is not None)
+        tm.MODELS_LOADED.set(n)
+        tm.MODELS_BUSY.set(busy)
 
     # ------------------------------------------------- busy/idle accounting
 
@@ -380,11 +414,13 @@ class ModelLoader:
         lm = self.get(name)
         if lm:
             lm.mark_busy()
+            self._update_gauges()
 
     def mark_idle(self, name: str) -> None:
         lm = self.get(name)
         if lm:
             lm.mark_idle()
+            self._update_gauges()
 
 
 class WatchDog:
@@ -441,7 +477,8 @@ class WatchDog:
             ):
                 log.warning("watchdog: %s busy > %.0fs, killing",
                             name, self.busy_timeout)
-                self.loader.shutdown_model(name)
+                tm.WATCHDOG_KILLS.labels(kind="busy").inc()
+                self.loader.shutdown_model(name, reason="watchdog_busy")
                 killed.append(name)
             elif (
                 self.enable_idle
@@ -450,6 +487,7 @@ class WatchDog:
             ):
                 log.warning("watchdog: %s idle > %.0fs, killing",
                             name, self.idle_timeout)
-                self.loader.shutdown_model(name)
+                tm.WATCHDOG_KILLS.labels(kind="idle").inc()
+                self.loader.shutdown_model(name, reason="watchdog_idle")
                 killed.append(name)
         return killed

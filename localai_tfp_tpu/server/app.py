@@ -18,6 +18,7 @@ from urllib.parse import unquote
 
 from aiohttp import web
 
+from ..telemetry.metrics import API_CALL
 from .state import Application
 from . import (
     assistants_routes, media_routes, openai_routes, localai_routes,
@@ -92,16 +93,33 @@ async def auth_middleware(request: web.Request, handler):
             if is_ui_page:
                 raise web.HTTPFound("/login")
             return json_error(401, "unauthorized")
+    request["t_auth"] = time.perf_counter()  # trace milestone: auth done
     return await handler(request)
+
+
+def _route_template(request: web.Request) -> str:
+    """The MATCHED route template ("/models/jobs/{uuid}"), not the raw
+    path: raw paths make the metric label set grow with every distinct
+    URL a scanner throws at the server. Unmatched/404 requests bucket
+    as "other"; the family's label-set cap collapses any residue."""
+    try:
+        resource = request.match_info.route.resource
+        tmpl = resource.canonical if resource is not None else ""
+    except AttributeError:
+        tmpl = ""
+    return tmpl or "other"
 
 
 @web.middleware
 async def telemetry_middleware(request: web.Request, handler):
-    """api_call histogram + correlation-id capture (ref: app.go:123-135;
-    chat.go:326). Response headers are injected in ``on_response_prepare``
-    so they reach error AND streamed responses."""
+    """api_call_seconds histogram + correlation-id capture (ref:
+    app.go:123-135; chat.go:326). Response headers are injected in
+    ``on_response_prepare`` so they reach error AND streamed
+    responses. The receive timestamp seeds request traces
+    (telemetry/tracing.py)."""
     app: Application = request.app["state"]
     t0 = time.perf_counter()
+    request["t_receive"] = t0
     request["correlation_id"] = (
         request.headers.get("X-Correlation-ID") or uuid.uuid4().hex
     )
@@ -109,9 +127,9 @@ async def telemetry_middleware(request: web.Request, handler):
         return await handler(request)
     finally:
         if not app.config.disable_metrics:
-            app.metrics.observe(
-                request.method, request.path, time.perf_counter() - t0
-            )
+            API_CALL.labels(
+                method=request.method, path=_route_template(request)
+            ).observe(time.perf_counter() - t0)
 
 
 async def _prepare_headers(request: web.Request, response) -> None:

@@ -28,6 +28,7 @@ def register(app: web.Application) -> None:
     r.add_get("/readyz", health)
     r.add_get("/version", version)
     r.add_get("/metrics", metrics)
+    r.add_get("/debug/traces", debug_traces)
     r.add_get("/system", system)
     r.add_get("/backend/monitor", backend_monitor)
     r.add_post("/backend/shutdown", backend_shutdown)
@@ -82,8 +83,28 @@ async def metrics(request: web.Request) -> web.Response:
     st = _state(request)
     if st.config.disable_metrics:
         raise web.HTTPNotFound()
-    return web.Response(text=st.metrics.render(),
-                        content_type="text/plain")
+    from ..telemetry.registry import CONTENT_TYPE
+
+    # the full exposition header (version + charset) — some scrapers
+    # refuse bare text/plain
+    return web.Response(body=st.metrics.render().encode("utf-8"),
+                        headers={"Content-Type": CONTENT_TYPE})
+
+
+async def debug_traces(request: web.Request) -> web.Response:
+    """Request-lifecycle timelines (telemetry/tracing.py): newest-first
+    JSON, ``?model=`` filter, ``?limit=`` cap (default 50). Pretty-
+    printer: tools/trace_report.py."""
+    from ..telemetry.tracing import TRACER
+
+    try:
+        limit = int(request.query.get("limit") or 50)
+    except ValueError:
+        raise web.HTTPBadRequest(reason="'limit' must be an integer")
+    return web.json_response({
+        "traces": TRACER.traces(model=request.query.get("model") or None,
+                                limit=limit),
+    })
 
 
 async def system(request: web.Request) -> web.Response:
@@ -162,6 +183,10 @@ async def backend_monitor(request: web.Request) -> web.Response:
         "load_s": round(lm.load_s, 2),
         "load_breakdown": getattr(lm.backend, "load_breakdown",
                                   None) or None,
+        # live serving-state snapshot (engine-backed models): queue
+        # depth, slot occupancy, KV utilization, token counters
+        "engine": (lm.backend.engine_stats()
+                   if hasattr(lm.backend, "engine_stats") else None),
     })
 
 

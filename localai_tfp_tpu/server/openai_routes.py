@@ -22,6 +22,7 @@ from typing import Any, Optional
 from aiohttp import web
 
 from ..config.model_config import ModelConfig, Usecase
+from ..telemetry.tracing import TRACER
 from ..grammars.json_schema import functions_grammar, schema_to_gbnf
 from ..grammars.parse import (FinetuneStream, apply_finetune,
                               parse_function_call, parse_text_content)
@@ -217,7 +218,23 @@ def _usage(reply: Reply, extra_usage: bool) -> dict:
     if extra_usage:  # ref: chat.go:184 Extra-Usage header gate
         u["timing_prompt_processing"] = reply.timing_prompt_processing
         u["timing_token_generation"] = reply.timing_token_generation
+        # request-lifecycle attribution (ms) from the engine trace:
+        # queue wait before admission and submit-to-first-token
+        u["timing_queue"] = reply.timing_queue
+        u["timing_first_token"] = reply.timing_first_token
     return u
+
+
+def _trace_seed(request: web.Request) -> list:
+    """HTTP milestones measured by the middlewares, handed to
+    TRACER.start so a request's timeline begins at receive, not at
+    engine submit."""
+    seed = []
+    for phase, key in (("receive", "t_receive"), ("auth", "t_auth")):
+        t = request.get(key)
+        if t:
+            seed.append((phase, t))
+    return seed
 
 
 def _grammar_for_request(cfg: ModelConfig, body: dict,
@@ -499,6 +516,12 @@ async def _stream_chat(
     loop = asyncio.get_running_loop()
     q: asyncio.Queue = asyncio.Queue()
     rid = uuid.uuid4().hex
+    # open the request's lifecycle trace before the producer can submit:
+    # receive/auth milestones from the middlewares, engine milestones
+    # (queue/admit/.../done) appended by the scheduler under this id
+    TRACER.start(rid, model=cfg.name,
+                 correlation_id=request.get("correlation_id", ""),
+                 events=_trace_seed(request))
     prompt_box: dict[str, str] = {}  # templated prompt, set by the
     # producer BEFORE submit — stream events (and thus any finetune echo
     # use of it) can only arrive after
@@ -513,7 +536,7 @@ async def _stream_chat(
             # keep the thread-per-stream generator
             sq = backend.stream_queue(opts)
             if sq is not None:
-                BRIDGE.register(sq, loop, q)
+                BRIDGE.register(sq, loop, q, rid)
                 return
             for r in backend.predict_stream(opts):
                 loop.call_soon_threadsafe(q.put_nowait, r)
@@ -697,12 +720,15 @@ async def _stream_completion(request, backend, opts, cfg, cid, created,
     loop = asyncio.get_running_loop()
     q: asyncio.Queue = asyncio.Queue()
     opts.request_id = opts.request_id or uuid.uuid4().hex
+    TRACER.start(opts.request_id, model=cfg.name,
+                 correlation_id=request.get("correlation_id", ""),
+                 events=_trace_seed(request))
 
     def producer() -> None:
         try:
             sq = backend.stream_queue(opts)
             if sq is not None:
-                BRIDGE.register(sq, loop, q)
+                BRIDGE.register(sq, loop, q, opts.request_id)
                 return
             for r in backend.predict_stream(opts):
                 loop.call_soon_threadsafe(q.put_nowait, r)

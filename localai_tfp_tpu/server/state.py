@@ -9,59 +9,15 @@ from __future__ import annotations
 
 import logging
 import time
-from dataclasses import dataclass, field
 from typing import Optional
 
 from ..config.app_config import ApplicationConfig
 from ..config.loader import ConfigLoader
 from ..engine.loader import ModelLoader, WatchDog, register_default_backends
 from ..engine.templating import Evaluator
+from ..telemetry.registry import REGISTRY
 
 log = logging.getLogger(__name__)
-
-
-@dataclass
-class MetricsStore:
-    """Prometheus-style api_call histogram data
-    (ref: core/services/metrics.go:13-46 — one histogram api_call
-    {method,path}; exposition at GET /metrics)."""
-
-    buckets: tuple[float, ...] = (
-        0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
-    )
-    counts: dict[tuple[str, str], list[int]] = field(default_factory=dict)
-    sums: dict[tuple[str, str], float] = field(default_factory=dict)
-    totals: dict[tuple[str, str], int] = field(default_factory=dict)
-
-    def observe(self, method: str, path: str, seconds: float) -> None:
-        key = (method, path)
-        if key not in self.counts:
-            self.counts[key] = [0] * (len(self.buckets) + 1)
-            self.sums[key] = 0.0
-            self.totals[key] = 0
-        row = self.counts[key]
-        for i, b in enumerate(self.buckets):
-            if seconds <= b:
-                row[i] += 1
-        row[-1] += 1  # +Inf
-        self.sums[key] += seconds
-        self.totals[key] += 1
-
-    def render(self) -> str:
-        lines = [
-            "# HELP api_call Api calls",
-            "# TYPE api_call histogram",
-        ]
-        for (method, path), row in sorted(self.counts.items()):
-            labels = f'method="{method}",path="{path}"'
-            for i, b in enumerate(self.buckets):
-                lines.append(
-                    f'api_call_bucket{{{labels},le="{b}"}} {row[i]}'
-                )
-            lines.append(f'api_call_bucket{{{labels},le="+Inf"}} {row[-1]}')
-            lines.append(f"api_call_sum{{{labels}}} {self.sums[(method, path)]}")
-            lines.append(f"api_call_count{{{labels}}} {self.totals[(method, path)]}")
-        return "\n".join(lines) + "\n"
 
 
 class Application:
@@ -81,7 +37,11 @@ class Application:
         self.gallery = GalleryService(
             str(self.config.models_path), self.config.galleries
         )
-        self.metrics = MetricsStore()
+        # the process-wide telemetry registry (telemetry/ — the
+        # successor of the reference's metrics service, core/services/
+        # metrics.go): HTTP middleware, engine scheduler, loader and
+        # watchdog all record into it; GET /metrics renders it
+        self.metrics = REGISTRY
         self.registry = None  # federation membership (when p2p_token set)
         if self.config.p2p_token:
             from ..parallel.federated import NodeRegistry

@@ -21,17 +21,19 @@ import asyncio
 import threading
 from typing import Any, Optional
 
+from ..telemetry.tracing import TRACER
 from ..workers.base import Reply
 
 
 class _Stream:
-    __slots__ = ("sq", "aq", "loop", "done")
+    __slots__ = ("sq", "aq", "loop", "done", "rid")
 
-    def __init__(self, sq, aq, loop):
+    def __init__(self, sq, aq, loop, rid=""):
         self.sq = sq  # engine queue.SimpleQueue of StreamEvent
         self.aq = aq  # asyncio.Queue of Optional[Reply]
         self.loop = loop
         self.done = False
+        self.rid = rid  # request id for the stream_done trace milestone
 
 
 def _to_replies(ev) -> tuple[Optional[Reply], bool]:
@@ -44,6 +46,8 @@ def _to_replies(ev) -> tuple[Optional[Reply], bool]:
             prompt_tokens=ev.prompt_tokens,
             timing_prompt_processing=ev.timing_prompt_processing_ms,
             timing_token_generation=ev.timing_token_generation_ms,
+            timing_queue=ev.timing_queue_ms,
+            timing_first_token=ev.timing_first_token_ms,
             finish_reason=ev.finish_reason,
             error=ev.error,
         ), True
@@ -59,10 +63,13 @@ class StreamBridge:
         self._thread: Optional[threading.Thread] = None
         self._wake = threading.Event()
 
-    def register(self, sq, loop, aq: asyncio.Queue) -> asyncio.Queue:
+    def register(self, sq, loop, aq: asyncio.Queue,
+                 request_id: str = "") -> asyncio.Queue:
         """Attach an engine event queue feeding the handler's asyncio
-        queue (None terminates the stream)."""
-        st = _Stream(sq, aq, loop)
+        queue (None terminates the stream). ``request_id`` lets the
+        pump stamp the trace's stream_done milestone when the final
+        event leaves the engine queue."""
+        st = _Stream(sq, aq, loop, request_id)
         with self._lock:
             self._streams.append(st)
             if self._thread is None or not self._thread.is_alive():
@@ -101,6 +108,11 @@ class StreamBridge:
                     if final:
                         items.append(None)  # stream terminator
                         st.done = True
+                        if st.rid:
+                            # closes the request's trace timeline: the
+                            # tokens have left the engine for the
+                            # transport (telemetry/tracing.py)
+                            TRACER.event(st.rid, "stream_done")
                         break
                 if items:
                     sweeps.setdefault(st.loop, []).append((st, items))

@@ -1,0 +1,23 @@
+"""Unified telemetry: engine-level Prometheus registry + request tracing.
+
+Capability counterpart of the reference's metrics service
+(ref: core/services/metrics.go — one api_call histogram behind
+GET /metrics), grown into what a TPU serving engine actually needs:
+
+- ``registry``: a thread-safe, label-aware Prometheus registry
+  (counters / gauges / histograms) with exposition-format rendering,
+  label-value escaping, and per-family label-cardinality caps.
+- ``metrics``: the canonical metric families instrumented across the
+  HTTP, engine-scheduler, model-loader, and worker layers. Every
+  family registered there must appear in the README "Observability"
+  table — tools/check_metrics.py enforces the naming contract.
+- ``tracing``: a request-lifecycle span recorder keyed by request id
+  (receive → auth → queue → admit → prefill → first-token → decode →
+  stream-done), bounded ring buffer, exported via GET /debug/traces.
+
+All samples are host-held scalars the scheduler already owns — nothing
+in this package touches a device array or calls block_until_ready.
+"""
+
+from .registry import CONTENT_TYPE, REGISTRY, Registry  # noqa: F401
+from .tracing import TRACER, TraceRecorder  # noqa: F401

@@ -1,0 +1,145 @@
+"""Canonical metric families, instrumented across the serving layers.
+
+Every family registered here MUST:
+- be snake_case with a unit suffix (counters end in ``_total``;
+  time/size series end in ``_seconds``/``_bytes``; dimensionless gauges
+  end in ``_count``/``_ratio``), and
+- appear in the README.md "Observability" table.
+
+``tools/check_metrics.py`` statically enforces both (wired into the
+test suite), so metric drift fails fast instead of rotting dashboards.
+
+Layer map (where each family is recorded):
+- HTTP         server/app.py telemetry middleware
+- engine       engine/engine.py scheduler (host-held values only — no
+               device syncs ride a metric sample)
+- loader       engine/loader.py ModelLoader (reuses the per-phase
+               breakdown from models/load_timing.py)
+- workers      engine/loader.py busy/idle accounting + WatchDog
+"""
+
+from __future__ import annotations
+
+from .registry import REGISTRY
+
+# sub-millisecond ladder for per-token / per-step series; the default
+# ladder (1ms..60s) fits request-scale latencies
+_STEP_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+)
+
+# ------------------------------------------------------------------ HTTP
+
+# successor of the reference's api_call histogram (core/services/
+# metrics.go) — re-keyed by matched ROUTE TEMPLATE, not the raw path:
+# unmatched/404 paths bucket as "other" and the label-set cap collapses
+# any residual explosion into path="other"
+API_CALL = REGISTRY.histogram(
+    "api_call_seconds",
+    "HTTP API call latency by method and matched route template",
+    labels=("method", "path"),
+    max_label_sets=128,
+    overflow={"path": "other"},
+)
+
+# ---------------------------------------------------------------- engine
+
+ENGINE_QUEUE_WAIT = REGISTRY.histogram(
+    "engine_queue_wait_seconds",
+    "Time a request spent queued before slot admission",
+    labels=("model",),
+)
+ENGINE_TTFT = REGISTRY.histogram(
+    "engine_ttft_seconds",
+    "Submit-to-first-token latency per request",
+    labels=("model",),
+)
+ENGINE_PREFILL = REGISTRY.histogram(
+    "engine_prefill_seconds",
+    "Prompt-processing (prefill) time per request",
+    labels=("model",),
+)
+ENGINE_INTER_TOKEN = REGISTRY.histogram(
+    "engine_inter_token_seconds",
+    "Mean inter-token latency per harvested decode scan",
+    labels=("model",), buckets=_STEP_BUCKETS,
+)
+ENGINE_DECODE_STEP = REGISTRY.histogram(
+    "engine_decode_step_seconds",
+    "Device time per decode step (saturated-pipeline samples only)",
+    labels=("model",), buckets=_STEP_BUCKETS,
+)
+ENGINE_QUEUE_DEPTH = REGISTRY.gauge(
+    "engine_queue_depth_count",
+    "Requests queued awaiting a slot",
+    labels=("model",),
+)
+ENGINE_SLOTS_BUSY = REGISTRY.gauge(
+    "engine_slots_busy_count",
+    "Slots occupied by an active request (batch occupancy)",
+    labels=("model",),
+)
+ENGINE_KV_UTIL = REGISTRY.gauge(
+    "engine_kv_slot_utilization_ratio",
+    "Fraction of KV-cache positions held by active slots",
+    labels=("model",),
+)
+ENGINE_REQUESTS = REGISTRY.counter(
+    "engine_requests_total",
+    "Completed engine requests by finish reason",
+    labels=("model", "reason"),
+)
+ENGINE_CANCELLATIONS = REGISTRY.counter(
+    "engine_cancellations_total",
+    "Requests cancelled while queued or in flight",
+    labels=("model",),
+)
+ENGINE_PREEMPTIONS = REGISTRY.counter(
+    "engine_preemptions_total",
+    "Active requests force-failed by the engine (scheduler error paths)",
+    labels=("model",),
+)
+ENGINE_PROMPT_TOKENS = REGISTRY.counter(
+    "engine_prompt_tokens_total",
+    "Prompt tokens processed through prefill",
+    labels=("model",),
+)
+ENGINE_GENERATED_TOKENS = REGISTRY.counter(
+    "engine_generated_tokens_total",
+    "Tokens sampled and emitted to streams",
+    labels=("model",),
+)
+
+# ---------------------------------------------------------------- loader
+
+MODEL_LOADS = REGISTRY.counter(
+    "model_loads_total",
+    "Backend model loads by outcome",
+    labels=("model", "result"),
+)
+MODEL_LOAD_PHASE = REGISTRY.counter(
+    "model_load_phase_seconds_total",
+    "Cumulative load wall time by phase (models/load_timing.py)",
+    labels=("phase",),
+)
+MODEL_EVICTIONS = REGISTRY.counter(
+    "model_evictions_total",
+    "Model unloads by reason (api/watchdog/single_active/shutdown)",
+    labels=("reason",),
+)
+MODELS_LOADED = REGISTRY.gauge(
+    "models_loaded_count",
+    "Live loaded backends",
+)
+
+# --------------------------------------------------------------- workers
+
+MODELS_BUSY = REGISTRY.gauge(
+    "models_busy_count",
+    "Loaded backends currently serving at least one request",
+)
+WATCHDOG_KILLS = REGISTRY.counter(
+    "watchdog_kills_total",
+    "Models killed by the busy/idle watchdog",
+    labels=("kind",),
+)

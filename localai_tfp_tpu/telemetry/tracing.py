@@ -1,0 +1,171 @@
+"""Request-lifecycle tracing: a lightweight span recorder keyed by
+request id.
+
+A request flows receive → auth → queue → admit → prefill_dispatch →
+first_token → done → stream_done across server/openai_routes.py,
+engine/engine.py and server/stream_bridge.py; each layer stamps its
+milestone with ``TRACER.event(request_id, phase)`` (perf_counter
+timestamps, microseconds of host work, no locks held across anything
+slow). Finished traces live in a bounded ring buffer served by
+``GET /debug/traces`` (newest first, filterable by model) and
+pretty-printed by tools/trace_report.py.
+
+Spans are derived between consecutive milestones and named for what the
+request was DOING during that interval — so "queue" is queue→admit,
+"prefill" is admit→prefill_dispatch (host-side chunking + group
+formation), "first_token" is dispatch→first sampled token (device
+prefill), "decode" is first_token→done. Their sum is exactly the
+traced wall time, which is what makes an unattributable 167-second
+mystery (PR 1's cold-start hunt) impossible on the request path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+# milestone order (a layer may legitimately skip phases — e.g. an
+# engine-level request has no receive/auth, a cancelled-in-queue
+# request has no first_token)
+PHASES = ("receive", "auth", "queue", "admit", "prefill_dispatch",
+          "first_token", "done", "stream_done")
+
+# span name keyed by the milestone that STARTS the interval
+_SPAN_NAME = {
+    "receive": "receive",
+    "auth": "preprocess",
+    "queue": "queue",
+    "admit": "prefill",
+    "prefill_dispatch": "first_token",
+    "first_token": "decode",
+    "done": "stream_flush",
+}
+
+
+class _Trace:
+    __slots__ = ("request_id", "model", "correlation_id", "status",
+                 "wall_start", "t0", "events")
+
+    def __init__(self, request_id: str, model: str = "",
+                 correlation_id: str = "") -> None:
+        self.request_id = request_id
+        self.model = model
+        self.correlation_id = correlation_id
+        self.status = "active"
+        self.wall_start = time.time()
+        self.t0: Optional[float] = None  # perf_counter of first event
+        self.events: list[tuple[str, float]] = []
+
+    def add(self, phase: str, t: float) -> None:
+        if self.t0 is None:
+            self.t0 = t
+        self.events.append((phase, t))
+
+    def as_dict(self) -> dict:
+        t0 = self.t0 if self.t0 is not None else 0.0
+        events = [{"phase": p, "t_ms": round((t - t0) * 1e3, 3)}
+                  for p, t in self.events]
+        spans = []
+        for (p_a, t_a), (_, t_b) in zip(self.events, self.events[1:]):
+            spans.append({
+                "name": _SPAN_NAME.get(p_a, p_a),
+                "start_ms": round((t_a - t0) * 1e3, 3),
+                "dur_ms": round((t_b - t_a) * 1e3, 3),
+            })
+        total = (self.events[-1][1] - t0) * 1e3 if self.events else 0.0
+        return {
+            "request_id": self.request_id,
+            "model": self.model,
+            "correlation_id": self.correlation_id,
+            "status": self.status,
+            "start_unix": round(self.wall_start, 3),
+            "total_ms": round(total, 3),
+            "events": events,
+            "spans": spans,
+        }
+
+
+class TraceRecorder:
+    """Bounded recorder: ``capacity`` finished traces in a ring,
+    ``active_cap`` in-flight traces (oldest evicted — a handler that
+    dies before its request reaches the engine cannot leak entries)."""
+
+    def __init__(self, capacity: int = 256, active_cap: int = 1024) -> None:
+        self.capacity = capacity
+        self.active_cap = active_cap
+        self._lock = threading.Lock()
+        self._active: "OrderedDict[str, _Trace]" = OrderedDict()
+        self._done: "OrderedDict[str, _Trace]" = OrderedDict()
+
+    def start(self, request_id: str, model: str = "",
+              correlation_id: str = "",
+              events: Optional[list[tuple[str, float]]] = None) -> None:
+        """Open a trace, optionally seeding milestones already measured
+        by an outer layer (the HTTP middlewares' receive/auth stamps)."""
+        if not request_id:
+            return
+        with self._lock:
+            tr = self._active.get(request_id)
+            if tr is None:
+                tr = _Trace(request_id, model, correlation_id)
+                self._active[request_id] = tr
+                while len(self._active) > self.active_cap:
+                    self._active.popitem(last=False)
+            else:
+                tr.model = model or tr.model
+                tr.correlation_id = correlation_id or tr.correlation_id
+            for phase, t in events or []:
+                tr.add(phase, t)
+
+    def event(self, request_id: str, phase: str,
+              t: Optional[float] = None, model: str = "") -> None:
+        """Stamp a milestone. Auto-opens the trace (engine-only callers
+        have no HTTP layer to call start()); a late milestone landing
+        after finish() — the bridge's stream_done — appends to the
+        finished trace in the ring."""
+        if not request_id:
+            return
+        t = time.perf_counter() if t is None else t
+        with self._lock:
+            tr = self._active.get(request_id)
+            if tr is None:
+                tr = self._done.get(request_id)
+            if tr is None:
+                tr = _Trace(request_id, model)
+                self._active[request_id] = tr
+                while len(self._active) > self.active_cap:
+                    self._active.popitem(last=False)
+            tr.add(phase, t)
+
+    def finish(self, request_id: str, status: str = "done") -> None:
+        with self._lock:
+            tr = self._active.pop(request_id, None)
+            if tr is None:
+                return
+            tr.status = status
+            self._done[request_id] = tr
+            while len(self._done) > self.capacity:
+                self._done.popitem(last=False)
+
+    def traces(self, model: Optional[str] = None, limit: int = 50,
+               include_active: bool = True) -> list[dict]:
+        """Timelines newest-first: in-flight traces (status "active")
+        ahead of finished ones."""
+        with self._lock:
+            rows = []
+            if include_active:
+                rows.extend(reversed(self._active.values()))
+            rows.extend(reversed(self._done.values()))
+            out = []
+            for tr in rows:
+                if model and tr.model != model:
+                    continue
+                out.append(tr.as_dict())
+                if len(out) >= max(1, limit):
+                    break
+        return out
+
+
+TRACER = TraceRecorder()

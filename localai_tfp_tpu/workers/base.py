@@ -75,6 +75,11 @@ class Reply:
     prompt_tokens: int = 0
     timing_prompt_processing: float = 0.0  # ms (proto:163)
     timing_token_generation: float = 0.0  # ms (proto:164)
+    # request-lifecycle attribution (beyond the proto; served behind
+    # the Extra-Usage gate): ms queued before admission, and
+    # submit-to-first-token ms
+    timing_queue: float = 0.0
+    timing_first_token: float = 0.0
     finish_reason: str = ""
     error: str = ""
 

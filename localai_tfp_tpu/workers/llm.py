@@ -732,6 +732,30 @@ class JaxLLMBackend(Backend):
             prompt_tokens_processed=m.prompt_tokens_processed,
         )
 
+    def engine_stats(self) -> Optional[dict]:
+        """Live serving-state snapshot for /backend/monitor — host-held
+        scheduler values only (no device sync rides a monitor poll)."""
+        eng = self.engine
+        if eng is None:
+            return None
+        m = eng.metrics
+        with eng._lock:
+            queue_depth = len(eng._pending)
+        busy = sum(1 for s in eng.slots if s.active)
+        used = sum(s.n_past for s in eng.slots if s.active)
+        return {
+            "n_slots": eng.n_slots,
+            "slots_busy": busy,
+            "queue_depth": queue_depth,
+            "kv_slot_utilization": round(
+                used / float(eng.n_slots * eng.max_seq), 4),
+            "tokens_per_second": round(m.tokens_per_second, 2),
+            "tokens_generated": m.tokens_generated,
+            "prompt_tokens_processed": m.prompt_tokens_processed,
+            "requests_completed": m.requests_completed,
+            "spec_tokens": m.spec_tokens,
+        }
+
 
 def _final_reply(ev: StreamEvent) -> Reply:
     return Reply(
@@ -740,6 +764,8 @@ def _final_reply(ev: StreamEvent) -> Reply:
         prompt_tokens=ev.prompt_tokens,
         timing_prompt_processing=ev.timing_prompt_processing_ms,
         timing_token_generation=ev.timing_token_generation_ms,
+        timing_queue=ev.timing_queue_ms,
+        timing_first_token=ev.timing_first_token_ms,
         finish_reason=ev.finish_reason,
         error=ev.error,
     )

@@ -260,8 +260,38 @@ def test_bad_json_400(client):
 def test_metrics_exposition(client):
     client.get("/healthz")
     r = client.get("/metrics")
-    assert "api_call_bucket" in r.text
+    # proper exposition content type (version + charset)
+    assert r.headers["Content-Type"].startswith(
+        "text/plain; version=0.0.4")
+    assert "api_call_seconds_bucket" in r.text
     assert 'path="/healthz"' in r.text
+    # labels are ROUTE TEMPLATES: an unmatched path must bucket as
+    # "other", not mint a fresh label set per scanned URL
+    client.get("/no/such/route/ever")
+    r = client.get("/metrics")
+    assert 'path="other"' in r.text
+    assert 'path="/no/such/route/ever"' not in r.text
+
+
+def test_debug_traces_endpoint(client):
+    # the streaming/completion tests above ran real engine requests, so
+    # the ring buffer holds finished timelines with ordered spans
+    r = client.get("/debug/traces")
+    assert r.status == 200
+    traces = r.json["traces"]
+    done = [t for t in traces if t["status"] in ("stop", "length")]
+    assert done, traces
+    tr = done[0]
+    assert tr["model"]
+    phases = {e["phase"]: e["t_ms"] for e in tr["events"]}
+    assert phases["queue"] <= phases["admit"] <= phases["first_token"]
+    assert abs(sum(s["dur_ms"] for s in tr["spans"])
+               - tr["total_ms"]) < 0.05
+    # model filter
+    r = client.get(f"/debug/traces?model={tr['model']}")
+    assert all(t["model"] == tr["model"] for t in r.json["traces"])
+    r = client.get("/debug/traces?model=no-such-model")
+    assert r.json["traces"] == []
 
 
 def test_system_endpoint(client):

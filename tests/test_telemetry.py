@@ -1,0 +1,426 @@
+"""Unified telemetry: registry thread-safety, exposition correctness
+(validated by a minimal promtext parser against a live test app),
+label escaping/cardinality caps, request-lifecycle tracing span
+ordering, and the static metric-name contract (tools/check_metrics.py).
+"""
+
+import asyncio
+import re
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from localai_tfp_tpu.telemetry import metrics as tm
+from localai_tfp_tpu.telemetry.registry import (
+    CONTENT_TYPE, REGISTRY, Registry, escape_label_value,
+)
+from localai_tfp_tpu.telemetry.tracing import TRACER, TraceRecorder
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+# ------------------------------------------------ minimal promtext parser
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    return re.sub(r"\\(.)",
+                  lambda m: {"n": "\n"}.get(m.group(1), m.group(1)), v)
+
+
+def _value(s: str) -> float:
+    if s == "+Inf":
+        return float("inf")
+    return float(s)
+
+
+def parse_prom(text: str) -> dict:
+    """Exposition text -> {family: {help, type, samples}} where samples
+    is a list of (sample_name, labels_dict, value). Asserts structural
+    correctness while parsing: HELP/TYPE precede samples, every sample
+    belongs to a declared family."""
+    families: dict = {}
+    current = None
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            name = line.split(" ", 3)[2]
+            families.setdefault(
+                name, {"help": None, "type": None, "samples": []})
+            families[name]["help"] = line.split(" ", 3)[3]
+            current = name
+        elif line.startswith("# TYPE "):
+            parts = line.split(" ")
+            name, kind = parts[2], parts[3]
+            assert name in families, f"TYPE before HELP for {name}"
+            families[name]["type"] = kind
+            current = name
+        elif line.startswith("#"):
+            continue
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"malformed sample line: {line!r}"
+            sname, blob, val = m.group(1), m.group(2) or "", m.group(3)
+            fam = None
+            for cand in (sname, sname.rsplit("_", 1)[0]):
+                if cand in families:
+                    fam = cand
+                    break
+            assert fam is not None, f"sample {sname} has no family"
+            assert fam == current or sname.startswith(current or ""), \
+                f"sample {sname} outside its family block"
+            labels = {k: _unescape(v)
+                      for k, v in _LABEL_RE.findall(blob)}
+            families[fam]["samples"].append((sname, labels, _value(val)))
+    return families
+
+
+def validate_families(families: dict) -> None:
+    """Every family: HELP+TYPE present; histograms: per-label-set
+    buckets cumulative/monotone, +Inf == _count, _sum present."""
+    for name, fam in families.items():
+        assert fam["help"], f"{name}: missing HELP"
+        assert fam["type"] in ("counter", "gauge", "histogram"), name
+        if fam["type"] != "histogram":
+            continue
+        series: dict = {}
+        for sname, labels, val in fam["samples"]:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            entry = series.setdefault(
+                key, {"buckets": [], "sum": None, "count": None})
+            if sname == f"{name}_bucket":
+                entry["buckets"].append((_value(labels["le"]), val))
+            elif sname == f"{name}_sum":
+                entry["sum"] = val
+            elif sname == f"{name}_count":
+                entry["count"] = val
+        for key, entry in series.items():  # empty families are legal
+            bounds = [b for b, _ in entry["buckets"]]
+            assert bounds == sorted(bounds), f"{name}{key}: le unsorted"
+            counts = [c for _, c in entry["buckets"]]
+            assert all(a <= b for a, b in zip(counts, counts[1:])), \
+                f"{name}{key}: buckets not cumulative"
+            assert bounds and bounds[-1] == float("inf"), \
+                f"{name}{key}: no +Inf bucket"
+            assert entry["count"] == counts[-1], \
+                f"{name}{key}: _count != +Inf bucket"
+            assert entry["sum"] is not None, f"{name}{key}: no _sum"
+
+
+# --------------------------------------------------- registry unit tests
+
+
+def test_registry_thread_safety_hammer():
+    """Two threads hammer one counter + one histogram; totals must be
+    exact (the old MetricsStore mutated shared dicts with no lock)."""
+    reg = Registry()
+    c = reg.counter("hammer_total", "h", labels=("who",))
+    h = reg.histogram("hammer_seconds", "h", labels=("who",))
+    n = 20000
+
+    def work(tag):
+        child_c = c.labels(who=tag)
+        child_h = h.labels(who="shared")
+        for _ in range(n):
+            child_c.inc()
+            child_h.observe(0.01)
+
+    threads = [threading.Thread(target=work, args=(t,))
+               for t in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    fams = parse_prom(reg.render())
+    validate_families(fams)
+    got = {tuple(sorted(lbl.items())): v
+           for s, lbl, v in fams["hammer_total"]["samples"]}
+    assert got[(("who", "a"),)] == n
+    assert got[(("who", "b"),)] == n
+    counts = [v for s, lbl, v in fams["hammer_seconds"]["samples"]
+              if s == "hammer_seconds_count"]
+    assert counts == [2 * n]
+
+
+def test_label_escaping_roundtrip():
+    nasty = 'he"llo\nwor\\ld'
+    reg = Registry()
+    g = reg.gauge("escape_test_count", "g", labels=("model",))
+    g.labels(model=nasty).set(7)
+    text = reg.render()
+    assert "\n\n" not in text.replace("\n\n", "\n")  # no broken lines
+    fams = parse_prom(text)
+    validate_families(fams)
+    (sname, labels, val), = fams["escape_test_count"]["samples"]
+    assert labels["model"] == nasty
+    assert val == 7
+    # the escaped form appears on the wire
+    assert escape_label_value(nasty) in text
+
+
+def test_cardinality_cap_overflows_to_other():
+    reg = Registry()
+    h = reg.histogram("cap_seconds", "h", labels=("method", "path"),
+                      max_label_sets=8, overflow={"path": "other"})
+    for i in range(50):
+        h.labels(method="GET", path=f"/scan/{i}").observe(0.01)
+    kids = h.collect()
+    assert len(kids) <= 9  # 8 distinct + the overflow set
+    other = {k: snap for k, snap in kids}[("GET", "other")]
+    assert sum(other["counts"]) == 50 - 8
+
+
+def test_counter_rejects_negative():
+    reg = Registry()
+    c = reg.counter("neg_total", "c")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_duplicate_registration_rejected():
+    reg = Registry()
+    reg.counter("dup_total", "c")
+    with pytest.raises(ValueError):
+        reg.counter("dup_total", "again")
+
+
+def test_snapshot_delta():
+    reg = Registry()
+    c = reg.counter("delta_total", "c")
+    h = reg.histogram("delta_seconds", "h")
+    c.inc(3)
+    snap = reg.snapshot()
+    c.inc(2)
+    h.observe(0.5)
+    d = reg.delta(snap)
+    assert d["delta_total"] == 2
+    assert d["delta_seconds_count"] == 1
+    assert d["delta_seconds_sum"] == 0.5
+
+
+# -------------------------------------------- exposition from a live app
+
+
+class _SyncClient:
+    def __init__(self, loop, client):
+        self._loop = loop
+        self._client = client
+
+    def get(self, path, **kw):
+        async def go():
+            r = await self._client.request("GET", path, **kw)
+            body = await r.read()
+            return r.status, r.headers, body.decode()
+
+        return self._loop.run_until_complete(go())
+
+
+@pytest.fixture(scope="module")
+def app_client(tmp_path_factory):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from localai_tfp_tpu.config.app_config import ApplicationConfig
+    from localai_tfp_tpu.server.app import build_app
+    from localai_tfp_tpu.server.state import Application
+
+    root = tmp_path_factory.mktemp("telemetry-srv")
+    (root / "models").mkdir()
+    loop = asyncio.new_event_loop()
+    cfg = ApplicationConfig(
+        models_path=str(root / "models"),
+        generated_content_dir=str(root / "generated"),
+        upload_dir=str(root / "uploads"),
+        config_dir=str(root / "configuration"),
+    )
+    state = Application(cfg)
+    app = build_app(state)
+    tc = TestClient(TestServer(app), loop=loop)
+    loop.run_until_complete(tc.start_server())
+    yield _SyncClient(loop, tc)
+    loop.run_until_complete(tc.close())
+    loop.close()
+
+
+def test_exposition_valid_against_live_app(app_client):
+    app_client.get("/healthz")
+    app_client.get("/version")
+    app_client.get("/no/such/path")  # unmatched -> path="other"
+    app_client.get("/models/jobs/deadbeef")  # matched template, 404 body
+    status, headers, text = app_client.get("/metrics")
+    assert status == 200
+    assert headers["Content-Type"] == CONTENT_TYPE
+    fams = parse_prom(text)
+    validate_families(fams)
+    # >= 12 families spanning the HTTP, engine, loader and worker layers
+    assert len(fams) >= 12, sorted(fams)
+    for prefix in ("api_", "engine_", "model", "watchdog_"):
+        assert any(n.startswith(prefix) for n in fams), prefix
+    paths = {lbl.get("path") for _, lbl, _ in
+             fams["api_call_seconds"]["samples"]}
+    assert "/healthz" in paths
+    assert "other" in paths  # the 404 bucketed, not a fresh label
+    assert "/no/such/path" not in paths
+    assert "/models/jobs/{uuid}" in paths  # template, not the raw path
+
+
+# --------------------------------------------------- engine-level tracing
+
+
+@pytest.fixture(scope="module")
+def model():
+    import jax
+    import jax.numpy as jnp
+
+    from localai_tfp_tpu.engine.tokenizer import ByteTokenizer
+    from localai_tfp_tpu.models.llm_spec import tiny_spec
+    from localai_tfp_tpu.models.transformer import init_params
+
+    tk = ByteTokenizer()
+    spec = tiny_spec(vocab_size=tk.vocab_size, max_position=512)
+    params = init_params(jax.random.PRNGKey(0), spec, dtype=jnp.float32)
+    return spec, params, tk
+
+
+def _engine(model, **kw):
+    import jax.numpy as jnp
+
+    from localai_tfp_tpu.engine.engine import LLMEngine
+
+    spec, params, tk = model
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_seq", 128)
+    kw.setdefault("prefill_buckets", (8, 32, 128))
+    kw.setdefault("cache_dtype", jnp.float32)
+    return LLMEngine(spec, params, tk, **kw)
+
+
+def _drain(q, timeout=120):
+    final = None
+    while final is None:
+        ev = q.get(timeout=timeout)
+        if ev.done:
+            final = ev
+    return final
+
+
+def _trace_for(request_id):
+    for tr in TRACER.traces(limit=500):
+        if tr["request_id"] == request_id:
+            return tr
+    raise AssertionError(f"no trace for {request_id}")
+
+
+def test_trace_streamed_request_span_ordering(model):
+    from localai_tfp_tpu.engine.engine import GenRequest
+
+    eng = _engine(model, tag="trace-test")
+    req = GenRequest(prompt_ids=eng.tokenize("hello trace"),
+                     max_tokens=6, ignore_eos=True)
+    t0 = time.perf_counter()
+    final = _drain(eng.submit(req))
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    eng.close()
+    assert final.finish_reason == "length"
+    # per-response lifecycle timings (served behind Extra-Usage)
+    assert final.timing_first_token_ms > 0
+    assert final.timing_queue_ms >= 0
+    tr = _trace_for(req.id)
+    assert tr["status"] == "length"
+    assert tr["model"] == "trace-test"
+    ph = {e["phase"]: e["t_ms"] for e in tr["events"]}
+    assert ph["queue"] <= ph["admit"] <= ph["first_token"] <= ph["done"]
+    # spans tile the timeline exactly...
+    assert abs(sum(s["dur_ms"] for s in tr["spans"])
+               - tr["total_ms"]) < 0.05
+    # ...and the timeline accounts for the measured wall clock (the
+    # acceptance bound: queue/prefill/first-token/decode within 10%)
+    assert tr["total_ms"] <= wall_ms + 1.0
+    assert tr["total_ms"] >= 0.9 * wall_ms - 5.0
+
+
+def test_trace_cancelled_request(model):
+    from localai_tfp_tpu.engine.engine import GenRequest
+
+    eng = _engine(model, tag="trace-test-cancel")
+    req = GenRequest(prompt_ids=eng.tokenize("cancel me"),
+                     max_tokens=400, ignore_eos=True)
+    q = eng.submit(req)
+    q.get(timeout=120)  # first event: the request is in flight
+    eng.cancel(req.id)
+    final = _drain(q)
+    eng.close()
+    assert final.finish_reason == "cancelled"
+    tr = _trace_for(req.id)
+    assert tr["status"] == "cancelled"
+    ph = {e["phase"]: e["t_ms"] for e in tr["events"]}
+    assert ph["queue"] <= ph["done"]
+    assert abs(sum(s["dur_ms"] for s in tr["spans"])
+               - tr["total_ms"]) < 0.05
+
+
+def test_engine_families_populated_after_serving(model):
+    """A served request moves the engine-layer families: requests by
+    reason, token counters, TTFT/prefill observations, gauges zeroed on
+    close."""
+    fams = parse_prom(REGISTRY.render())
+    validate_families(fams)
+    req_samples = {(lbl["model"], lbl["reason"]): v
+                   for s, lbl, v in fams["engine_requests_total"]["samples"]}
+    assert req_samples.get(("trace-test", "length"), 0) >= 1
+    assert req_samples.get(("trace-test-cancel", "cancelled"), 0) >= 1
+    ttft_counts = {lbl["model"]: v
+                   for s, lbl, v in fams["engine_ttft_seconds"]["samples"]
+                   if s == "engine_ttft_seconds_count"}
+    assert ttft_counts.get("trace-test", 0) >= 1
+    tok = {lbl["model"]: v for s, lbl, v in
+           fams["engine_generated_tokens_total"]["samples"]}
+    assert tok.get("trace-test", 0) >= 6
+    busy = {lbl["model"]: v
+            for s, lbl, v in fams["engine_slots_busy_count"]["samples"]}
+    assert busy.get("trace-test") == 0  # closed engine left no residue
+
+
+def test_trace_recorder_bounded():
+    rec = TraceRecorder(capacity=4, active_cap=4)
+    for i in range(10):
+        rec.event(f"req-{i}", "queue")
+        rec.finish(f"req-{i}")
+    assert len(rec.traces(limit=100)) == 4
+    # active traces are bounded too (handler death cannot leak)
+    for i in range(10):
+        rec.event(f"act-{i}", "queue")
+    assert len(rec.traces(limit=100, include_active=True)) <= 8
+
+
+def test_extra_usage_gate_includes_lifecycle_timings():
+    from localai_tfp_tpu.server.openai_routes import _usage
+    from localai_tfp_tpu.workers.base import Reply
+
+    r = Reply(tokens=3, prompt_tokens=5, timing_queue=1.5,
+              timing_first_token=42.0)
+    gated = _usage(r, False)
+    assert "timing_queue" not in gated
+    full = _usage(r, True)
+    assert full["timing_queue"] == 1.5
+    assert full["timing_first_token"] == 42.0
+
+
+# -------------------------------------------------- static naming contract
+
+
+def test_check_metrics_static_contract():
+    """tools/check_metrics.py as a tier-1 gate: snake_case + unit
+    suffix + README table coverage for every registered metric."""
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "check_metrics.py")],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

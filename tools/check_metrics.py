@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""Static telemetry lint: metric-name contract + README coverage.
+
+Scans ``localai_tfp_tpu/`` for registry registrations
+(``REGISTRY.counter("...")`` / ``.gauge`` / ``.histogram``) and fails
+when any registered name
+
+- is not snake_case,
+- is missing a unit suffix — counters MUST end in ``_total``;
+  histograms in ``_seconds``/``_bytes``; gauges in one of
+  ``_seconds``/``_bytes``/``_count``/``_ratio``/``_info`` — or
+- does not appear in the README.md "Observability" table.
+
+Run from the repo root:  python tools/check_metrics.py
+Wired into the test suite (tests/test_telemetry.py) so metric drift
+fails tier-1 instead of silently rotting dashboards and this table.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+PKG = ROOT / "localai_tfp_tpu"
+README = ROOT / "README.md"
+
+_SNAKE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)*$")
+# one registration: `<registry>.counter(\n?  "name"` — literal names
+# only; a computed name cannot be linted or documented and is a finding
+_REG = re.compile(
+    r"\.\s*(counter|gauge|histogram)\(\s*\n?\s*['\"]([A-Za-z0-9_]+)['\"]"
+)
+
+_SUFFIXES = {
+    "counter": ("_total",),
+    "histogram": ("_seconds", "_bytes"),
+    "gauge": ("_seconds", "_bytes", "_count", "_ratio", "_info"),
+}
+
+
+def find_registrations() -> list[tuple[str, str, str]]:
+    """(kind, name, file) for every literal registration in the
+    package."""
+    out = []
+    for path in sorted(PKG.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        for m in _REG.finditer(text):
+            out.append((m.group(1), m.group(2),
+                        str(path.relative_to(ROOT))))
+    return out
+
+
+def main(argv=None) -> int:
+    regs = find_registrations()
+    problems: list[str] = []
+    if not regs:
+        problems.append("no metric registrations found under "
+                        f"{PKG} — scanner or layout broke")
+    try:
+        readme = README.read_text(encoding="utf-8")
+    except OSError:
+        readme = ""
+        problems.append(f"cannot read {README}")
+    for kind, name, where in regs:
+        if not _SNAKE.match(name):
+            problems.append(
+                f"{where}: metric '{name}' is not snake_case")
+        if not name.endswith(_SUFFIXES[kind]):
+            problems.append(
+                f"{where}: {kind} '{name}' lacks a unit suffix "
+                f"(one of {', '.join(_SUFFIXES[kind])})")
+        if readme and f"`{name}`" not in readme:
+            problems.append(
+                f"{where}: metric '{name}' is not documented in the "
+                f"README.md Observability table (add a `{name}` row)")
+    if problems:
+        for p in problems:
+            print(f"check_metrics: {p}", file=sys.stderr)
+        print(f"check_metrics: {len(problems)} problem(s) in "
+              f"{len(regs)} registration(s)", file=sys.stderr)
+        return 1
+    print(f"check_metrics: OK ({len(regs)} metric registrations)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""Pretty-print request-lifecycle traces from a running server.
+
+Fetches ``GET /debug/traces`` (telemetry/tracing.py) and renders each
+request as a span timeline:
+
+    $ python tools/trace_report.py --url http://localhost:8080 --model tiny
+    a3f9…  tiny  stop  total 412.7 ms  (corr 9bc2…)
+      queue          0.0 ms ▕█▏                 3.1 ms
+      prefill        3.1 ms ▕██████▏           61.0 ms
+      first_token   64.1 ms ▕█████████▏        96.4 ms
+      decode       160.5 ms ▕███████████████▏ 252.2 ms
+
+Options: --model filters server-side, --limit caps the count,
+--api-key sends a Bearer token, --json reads a saved payload instead
+of a URL (offline triage of a pasted /debug/traces body).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.parse
+import urllib.request
+
+BAR_COLS = 34
+
+
+def fetch(url: str, model: str, limit: int, api_key: str) -> dict:
+    q = {"limit": str(limit)}
+    if model:
+        q["model"] = model
+    full = f"{url.rstrip('/')}/debug/traces?{urllib.parse.urlencode(q)}"
+    req = urllib.request.Request(full)
+    if api_key:
+        req.add_header("Authorization", f"Bearer {api_key}")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def render(trace: dict, out) -> None:
+    rid = trace.get("request_id", "")[:12]
+    corr = trace.get("correlation_id", "")
+    head = (f"{rid}  {trace.get('model') or '-'}  "
+            f"{trace.get('status')}  total {trace.get('total_ms')} ms")
+    if corr:
+        head += f"  (corr {corr[:12]})"
+    print(head, file=out)
+    spans = trace.get("spans") or []
+    total = max(float(trace.get("total_ms") or 0.0), 1e-9)
+    width = max((len(s["name"]) for s in spans), default=4)
+    for s in spans:
+        frac = max(float(s["dur_ms"]), 0.0) / total
+        bar = "█" * max(1, round(frac * BAR_COLS))
+        print(f"  {s['name']:<{width}} {s['start_ms']:>9.1f} ms "
+              f"▕{bar:<{BAR_COLS}}▏ {s['dur_ms']:>9.1f} ms", file=out)
+    if not spans:
+        events = trace.get("events") or []
+        for e in events:
+            print(f"  {e['phase']:<16} {e['t_ms']:>9.1f} ms", file=out)
+    print(file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="pretty-print /debug/traces timelines")
+    ap.add_argument("--url", default="http://localhost:8080",
+                    help="server base URL")
+    ap.add_argument("--model", default="", help="filter by model name")
+    ap.add_argument("--limit", type=int, default=10)
+    ap.add_argument("--api-key", default="", help="Bearer token")
+    ap.add_argument("--json", default="",
+                    help="read a saved /debug/traces JSON file instead")
+    args = ap.parse_args(argv)
+
+    if args.json:
+        with open(args.json, encoding="utf-8") as f:
+            payload = json.load(f)
+    else:
+        try:
+            payload = fetch(args.url, args.model, args.limit,
+                            args.api_key)
+        except OSError as e:
+            print(f"trace_report: cannot reach {args.url}: {e}",
+                  file=sys.stderr)
+            return 1
+    traces = payload.get("traces") or []
+    if not traces:
+        print("no traces recorded (is the server serving requests?)")
+        return 0
+    for tr in traces:
+        render(tr, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
