@@ -111,6 +111,21 @@ def _bench_config(eng, tok, n_req, n_tok, runs=3):
     return round(best, 2), round(p50, 1), round(p95, 1)
 
 
+def _prefix_cache_extra(eng) -> dict:
+    """Cross-slot prefix cache effectiveness over the whole bench run:
+    tokens reused (resident/copy/disk) vs tokens actually prefilled,
+    copy dispatches, and the resulting hit rate."""
+    m = eng.metrics
+    reused, filled = m.prefix_reused_tokens, m.prefill_tokens
+    return {
+        "reused_tokens": reused,
+        "prefilled_tokens": filled,
+        "copies": m.prefix_copies,
+        "hit_rate": round(reused / max(reused + filled, 1), 4),
+        "enabled": eng._prefix_enabled,
+    }
+
+
 def _bench_http(state, model, n_req, n_tok, runs=2):
     """Endpoint-level benchmark: boot the REAL aiohttp server (routes,
     middleware, SSE writer) over the given Application (whose loader
@@ -567,6 +582,7 @@ def main() -> None:
             raise RuntimeError("single-request TTFT produced no samples")
         singles.sort()
         extra["ttft_ms_1b_single"] = round(singles[len(singles) // 2], 1)
+        extra["prefix_cache_1b"] = _prefix_cache_extra(eng)
         eng.close()
         del params, eng
         # release the 1B leg's HBM (params + KV cache + jit executables
@@ -698,6 +714,7 @@ def main() -> None:
             extra["ttft_p50_ms_8b_http_steady"] = p50_steady
             extra["http_vs_engine"] = round(tok_s / max(tok_s8, 1e-9), 4)
             extra["tokenizer"] = "byte-bpe-128256 (real merge table)"
+            extra["prefix_cache"] = _prefix_cache_extra(eng8)
             backend.shutdown()
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
@@ -755,6 +772,7 @@ def main() -> None:
                 "bench", "jax-llm", backend)
             tok_s, p50_h, _, _ = _bench_http(state, "bench", 4, 32,
                                              runs=1)
+            extra["prefix_cache"] = _prefix_cache_extra(eng)
             eng.close()
         finally:
             shutil.rmtree(tmp, ignore_errors=True)

@@ -23,6 +23,12 @@ TPU-first re-design rather than translation:
 - Inactive slots still flow through the batched decode but write their K/V
   at their own row's tail position, so a free slot's cached prefix stays
   intact for prefix reuse.
+- Prefix reuse is GLOBAL, not per-slot: a radix index over every slot's
+  resident prefix (engine/prefix_index.py) plus an on-device row-to-row
+  KV copy dispatch ("kvcopy") let an admitted request start from the
+  best matching prefix held by ANY slot — free or active — with
+  prefix-aware wave admission and LRU x length victim selection
+  (see the README "Serving: cross-slot prefix KV cache" section).
 """
 
 from __future__ import annotations
@@ -50,6 +56,7 @@ from ..ops.sampling import (
 )
 from ..telemetry import metrics as tm
 from ..telemetry.tracing import TRACER
+from .prefix_index import PrefixIndex, common_prefix_len
 from .tokenizer import StreamDecoder, Tokenizer
 
 log = logging.getLogger(__name__)
@@ -190,6 +197,8 @@ class _Slot:
     emit_tok: Optional[int] = None  # first token id of the buffered span
     constraint_state: Any = None
     cache_loaded: Any = None  # (path, n) the on-disk prompt cache holds
+    n_reused: int = 0  # prompt tokens served from resident/copied KV
+    # instead of prefill (set at _assign; read at prefill harvest)
     t_start: float = 0.0
     t_first: float = 0.0  # perf_counter at first emitted token
     t_prefill_ms: float = 0.0
@@ -214,6 +223,12 @@ class EngineMetrics:
     slots_busy: int = 0
     spec_tokens: int = 0  # tokens emitted via speculative decoding
     spec_dispatches: int = 0
+    # cross-slot prefix cache: tokens served from KV-resident prefixes
+    # (same-slot resident, cross-slot copy, or disk restore) vs tokens
+    # actually pushed through prefill dispatches
+    prefix_reused_tokens: int = 0
+    prefill_tokens: int = 0
+    prefix_copies: int = 0  # kvcopy dispatches enqueued
 
 
 def _soft_expand(tokens: jax.Array, rows: jax.Array, brow: jax.Array,
@@ -249,13 +264,10 @@ def _unpack_masks(p) -> Optional[jax.Array]:
     return jnp.asarray(p)
 
 
-def _common_prefix(a: list[int], b: list[int]) -> int:
-    n = 0
-    for x, y in zip(a, b):
-        if x != y:
-            break
-        n += 1
-    return n
+# vectorized common-prefix (one elementwise compare + argmax instead of
+# a per-token Python loop — this ran O(n_slots) times per admission);
+# kept as the radix-index fallback and for the on-disk cache path
+_common_prefix = common_prefix_len
 
 
 def _sel_active(active, new, old):
@@ -388,6 +400,32 @@ class LLMEngine:
             )
         self.slots = [_Slot(i) for i in range(n_slots)]
         self._use_kernel = self._kernel_eligible()
+        # cross-slot prefix cache: radix index over every slot's
+        # resident cache_tokens + on-device row-to-row KV copies
+        # (engine/prefix_index.py). LOCALAI_PREFIX_CACHE=off restores
+        # the old own-slot-only reuse.
+        import os as _os
+
+        self._prefix_enabled = _os.environ.get(
+            "LOCALAI_PREFIX_CACHE", "on").lower() not in (
+            "0", "off", "false")
+        # minimum token GAIN over the destination's own resident prefix
+        # before a copy is worth dispatching (a copy is a sub-ms HBM
+        # move, so the floor is low)
+        self._prefix_min_copy = max(1, int(_os.environ.get(
+            "LOCALAI_PREFIX_CACHE_MIN", "8")))
+        # minimum SHARED-prefix length before a same-wave request
+        # defers behind a wave-mate's prefill: deferral delays the
+        # sharer's TTFT by a scheduler iteration and splits the wave's
+        # prefill group, so it must buy substantially more than the
+        # ~6-token chat-template prefix every request shares
+        self._prefix_defer_min = max(self._prefix_min_copy, int(
+            _os.environ.get("LOCALAI_PREFIX_CACHE_DEFER_MIN", "64")))
+        self._prefix_index = PrefixIndex()
+        # same-wave prefix grouping: request id -> (deadline, want_len)
+        # for admissions deferred one scheduler iteration so a
+        # wave-mate's prefill commits the shared prefix they copy from
+        self._deferred: dict[str, tuple[float, int]] = {}
         self._pending: list[tuple[GenRequest, queue.SimpleQueue]] = []
         self._cancelled: dict[str, float] = {}  # id -> cancel time
         self._lock = threading.Condition()
@@ -789,6 +827,52 @@ class LLMEngine:
         self._decode_k_fns[("draft_prefill",)] = _dp
         return _dp
 
+    def _kv_copy_fn(self, n: int, with_draft: bool):
+        """Jitted, donated row-to-row KV prefix copy: ``n`` (static,
+        power-of-two bucket) leading positions of the src slot's rows —
+        k/v and, when quantized, k_scale/v_scale — land in the dst
+        slot's rows via per-layer dynamic_slice/dynamic_update_slice.
+        Copying past the actual match length is harmless (positions
+        beyond dst's valid prefix are rewritten by prefill or causally
+        invisible) and keeps the jit variant set tiny. ``with_draft``
+        copies the draft cache rows in the SAME dispatch so speculative
+        decoding's draft prefix stays exactly as coherent at dst as it
+        was at src."""
+        key = ("kvcopy", n, with_draft)
+        fn = self._decode_k_fns.get(key)
+        if fn is not None:
+            return fn
+
+        def _copy_rows(cache: KVCache, src, dst) -> KVCache:
+            def cp4(a):
+                L, _, _, F = a.shape
+                row = lax.dynamic_slice(a, (0, src, 0, 0), (L, 1, n, F))
+                return lax.dynamic_update_slice(a, row, (0, dst, 0, 0))
+
+            def cp3(a):
+                row = lax.dynamic_slice(a, (0, src, 0),
+                                        (a.shape[0], 1, n))
+                return lax.dynamic_update_slice(a, row, (0, dst, 0))
+
+            return KVCache(
+                k=cp4(cache.k), v=cp4(cache.v),
+                k_scale=cp3(cache.k_scale) if cache.quantized else None,
+                v_scale=cp3(cache.v_scale) if cache.quantized else None,
+            )
+
+        if with_draft:
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def _copy(cache, dcache, src, dst):
+                return (_copy_rows(cache, src, dst),
+                        _copy_rows(dcache, src, dst))
+        else:
+            @partial(jax.jit, donate_argnums=(0,))
+            def _copy(cache, src, dst):
+                return _copy_rows(cache, src, dst)
+
+        self._decode_k_fns[key] = _copy
+        return _copy
+
     @staticmethod
     def _spec_eligible(s: _Slot) -> bool:
         """Penalty/grammar/bias/multimodal/mirostat slots need per-token
@@ -1061,6 +1145,19 @@ class LLMEngine:
             )
             self.sampling = dataclasses.replace(self.sampling, rng=rng)
             return D, Fin, J
+        if kind == "kvcopy":
+            # cross-slot prefix copy: pure device op with a scalar
+            # payload, so it broadcasts to multihost followers like any
+            # other dispatch record (no KV bytes cross the wire)
+            src = jnp.asarray(p["src"], jnp.int32)
+            dst = jnp.asarray(p["dst"], jnp.int32)
+            fn = self._kv_copy_fn(p["n"], self.draft is not None)
+            if self.draft is not None:
+                self.cache, self.draft_cache = fn(
+                    self.cache, self.draft_cache, src, dst)
+            else:
+                self.cache = fn(self.cache, src, dst)
+            return None
         if kind == "embed":
             cache = KVCache.create(self.spec, 1, p["bucket"],
                                    self.cache.k.dtype)
@@ -1093,6 +1190,7 @@ class LLMEngine:
         tm.ENGINE_SLOTS_BUSY.labels(model=self._mlabel).set(0)
         tm.ENGINE_QUEUE_DEPTH.labels(model=self._mlabel).set(0)
         tm.ENGINE_KV_UTIL.labels(model=self._mlabel).set(0.0)
+        tm.ENGINE_KV_RESIDENT_PREFIX.labels(model=self._mlabel).set(0.0)
         if self.mesh is not None:
             # release the process-wide meshed gate so a later unmeshed
             # engine regains the fused int8 kernel (single-owner rule)
@@ -1241,6 +1339,12 @@ class LLMEngine:
                                             np.int32),
                         "soft": None, "window": w, "ring": ring,
                     })
+        if self._prefix_enabled:
+            # cross-slot KV copy variants (cheap compiles — pure DUS,
+            # no matmuls — but a mid-admission stall is still a stall);
+            # src == dst == 0 is a self-copy no-op on device state
+            for w in win_ladder:
+                self._run("kvcopy", {"src": 0, "dst": 0, "n": w})
         S = self.n_slots
         inactive = {
             "tokens": np.zeros((S, 1), np.int32),
@@ -1361,6 +1465,7 @@ class LLMEngine:
             for req, out in self._pending:
                 if req.id in cancelled:
                     del cancelled[req.id]
+                    self._deferred.pop(req.id, None)
                     out.put(StreamEvent(done=True,
                                         finish_reason="cancelled"))
                     dropped.append(req.id)
@@ -1447,6 +1552,11 @@ class LLMEngine:
         used = sum(s.n_past for s in self.slots if s.active)
         tm.ENGINE_KV_UTIL.labels(model=m).set(
             used / float(self.n_slots * self.max_seq))
+        # reusable-but-idle KV is real capacity the cross-slot cache can
+        # serve: count resident prefix tokens across ALL slots (a free
+        # slot's resident prefix is invisible to ENGINE_KV_UTIL)
+        tm.ENGINE_KV_RESIDENT_PREFIX.labels(model=m).set(
+            float(sum(len(s.cache_tokens) for s in self.slots)))
 
     def _dispatch(self) -> bool:
         """Enqueue device work for the current slot states. Returns
@@ -1502,7 +1612,11 @@ class LLMEngine:
         starve prefill."""
         now = time.perf_counter()
         with self._lock:
-            pending = bool(self._pending)
+            # prefix-deferred requests are waiting ON a forming prefill,
+            # not waiting to JOIN the group being held — they must not
+            # hold their own donor's dispatch hostage
+            pending = any(r.id not in self._deferred
+                          for r, _ in self._pending)
             recent = [t for t in self._arrivals if now - t < 0.04]
         if pending and not any(not s.active for s in self.slots):
             # a queued request with ZERO free slots can never join the
@@ -1565,23 +1679,92 @@ class LLMEngine:
             did = True
         return did
 
-    # admission + prefix reuse (ref: grpc-server.cpp:1749-1900)
+    # admission + prefix reuse (ref: grpc-server.cpp:1749-1900; extended
+    # to a GLOBAL prefix cache: radix index over every slot's resident
+    # prefix + on-device cross-slot row copies)
     def _admit(self) -> None:
         with self._lock:
             pending, self._pending = self._pending, []
+        if not pending:
+            return
+        if self._prefix_enabled:
+            # lazy re-register: decode appends / window clamps since the
+            # last wave are diffed in (extension is the common case)
+            self._prefix_index.sync(
+                (s.idx, s.cache_tokens) for s in self.slots)
+        # prompts admitted but whose prefill has NOT yet dispatched:
+        # their KV is uncommitted, so the index cannot serve them yet —
+        # same-wave sharers defer one iteration behind them instead
+        # (one prefix prefill + N copies serves the whole wave)
+        forming = [s.request.prompt_ids for s in self.slots
+                   if s.state is SlotState.PREFILL
+                   and s.request is not None
+                   and s.request.soft_embeds is None]
+        requeue: list[tuple[GenRequest, queue.SimpleQueue]] = []
+        now = time.perf_counter()
         for req, out in pending:
             with self._lock:
-                if req.id in self._cancelled:  # cancel raced ahead
+                cancelled = req.id in self._cancelled
+                if cancelled:  # cancel raced ahead
                     del self._cancelled[req.id]
+                    self._deferred.pop(req.id, None)
                     out.put(StreamEvent(done=True,
                                         finish_reason="cancelled"))
-                    continue
+            if cancelled:
+                continue
+            if self._defer_for_prefix(req, forming, now):
+                requeue.append((req, out))
+                continue
             slot = self._pick_slot(req)
             if slot is None:
-                with self._lock:  # no free slot; requeue preserving order
-                    self._pending.append((req, out))
+                requeue.append((req, out))  # no free slot
                 continue
+            self._deferred.pop(req.id, None)
             self._assign(slot, req, out)
+            if req.soft_embeds is None:
+                forming.append(req.prompt_ids)
+        if requeue:
+            with self._lock:  # preserve arrival order over new arrivals
+                self._pending[:0] = requeue
+
+    def _defer_for_prefix(self, req: GenRequest, forming: list,
+                          now: float) -> bool:
+        """Same-wave prefix grouping: when requests in one admission
+        wave share a >= _prefix_defer_min-token prefix the index cannot
+        yet serve, the FIRST prefills it and the rest defer until that
+        prefill's KV commits (its dispatch extends the donor's
+        cache_tokens), then admit as copy + tail-prefill. Bounded by a
+        deadline so a stalled/cancelled donor can never strand its
+        sharers (they admit normally and re-prefill)."""
+        if not self._prefix_enabled or req.soft_embeds is not None:
+            return False
+        cap = min(len(req.prompt_ids) - 1, self.max_seq - 1)
+        state = self._deferred.get(req.id)
+        if state is not None:
+            deadline, want = state
+            if now > deadline:
+                self._deferred.pop(req.id, None)
+                return False  # donor stalled: admit normally
+            have, _ = self._prefix_index.match(req.prompt_ids)
+            if min(have, cap) >= want:
+                self._deferred.pop(req.id, None)
+                return False  # shared prefix committed: admit w/ copy
+            if not any(_common_prefix(p, req.prompt_ids) >= want
+                       for p in forming):
+                self._deferred.pop(req.id, None)
+                return False  # donor vanished: admit normally
+            return True
+        share = max((_common_prefix(p, req.prompt_ids)
+                     for p in forming), default=0)
+        share = min(share, cap)
+        have, _ = self._prefix_index.match(req.prompt_ids)
+        have = min(have, cap)
+        if share >= have + self._prefix_defer_min:
+            self._deferred[req.id] = (now + 0.25, share)
+            tm.ENGINE_PREFIX_EVENTS.labels(
+                model=self._mlabel, event="deferred").inc()
+            return True
+        return False
 
     def _reset_columns(self, group: list[_Slot], pad_to: int,
                        rows: Optional[list[int]] = None) -> dict:
@@ -1647,27 +1830,95 @@ class LLMEngine:
         free = [s for s in self.slots if not s.active]
         if not free:
             return None
-        return max(
-            free, key=lambda s: _common_prefix(s.cache_tokens, req.prompt_ids)
-        )
+        best = max(free, key=lambda s: _common_prefix(s.cache_tokens,
+                                                      req.prompt_ids))
+        if (not self._prefix_enabled
+                or _common_prefix(best.cache_tokens, req.prompt_ids)
+                >= self._prefix_min_copy):
+            return best
+        # no free slot meaningfully matches this prompt: evict the
+        # resident prefix with the LOWEST reuse value (LRU x length) so
+        # hot donor prefixes survive for future cross-slot copies
+        now = time.monotonic()
+        return min(free,
+                   key=lambda s: self._prefix_index.value(s.idx, now))
+
+    def _maybe_prefix_copy(self, slot: _Slot, req: GenRequest,
+                           common: int) -> tuple[int, int]:
+        """Cross-slot prefix reuse: when another slot's committed
+        resident prefix beats this slot's by >= _prefix_min_copy
+        tokens, enqueue an on-device row-to-row KV copy (donor row ->
+        this row) and start prefill from the copied length. The donor
+        may be ACTIVE — its committed prefix [0, n_past) is immutable
+        (decode/prefill writes land at or beyond n_past, and device
+        execution is serialized behind everything already enqueued) —
+        so an admitted request reuses the best prefix held by ANY
+        slot, not just its own. Returns (new common, tokens gained)."""
+        if not self._prefix_enabled:
+            return common, 0
+        m = self._mlabel
+        best, donors = self._prefix_index.match(req.prompt_ids)
+        best = min(best, len(req.prompt_ids) - 1, self.max_seq - 1)
+        if best >= common + self._prefix_min_copy:
+            donors = donors - {slot.idx}
+        else:
+            donors = set()
+        if not donors:
+            tm.ENGINE_PREFIX_EVENTS.labels(
+                model=m,
+                event="hit_resident" if common > 0 else "miss").inc()
+            return common, 0
+        now = time.monotonic()
+        # most-valuable donor: longest registration is implied (all
+        # cover >= best); prefer the most recently useful row
+        donor = max(donors,
+                    key=lambda i: self._prefix_index.value(i, now))
+        # static-shape length bucket: copying past `best` is harmless
+        # (dst positions beyond its valid prefix are rewritten by
+        # prefill or causally invisible) and keeps the jit set tiny
+        self._run("kvcopy", {"src": donor, "dst": slot.idx,
+                             "n": self._window_bucket(best)})
+        self._prefix_index.touch(donor, now)
+        gain = best - common
+        self.metrics.prefix_copies += 1
+        tm.ENGINE_PREFIX_COPIES.labels(model=m).inc()
+        tm.ENGINE_PREFIX_EVENTS.labels(model=m, event="hit_copy").inc()
+        slot.cache_tokens = list(req.prompt_ids[:best])
+        slot.n_past = best
+        return best, gain
 
     # ------------------------------------------------- on-disk prompt cache
 
-    def _try_load_prompt_cache(self, slot: _Slot, req: GenRequest) -> None:
+    def _try_load_prompt_cache(self, slot: _Slot, req: GenRequest) -> str:
         """Restore a saved prompt's KV rows into the slot when the file's
         token prefix beats the slot's resident prefix (ref: llama.cpp
-        prompt cache restore via PromptCachePath)."""
+        prompt cache restore via PromptCachePath). Every outcome is
+        counted (engine_prompt_cache_restores_total{result=...}) and
+        traced, so a corrupt on-disk cache silently re-prefilling every
+        request is visible instead of invisible. Returns the result
+        string ("unset" when the request carries no cache path)."""
         import os
 
-        if self.channel is not None:
-            # multihost: row restores would need the KV payload broadcast
-            # to every follower; prefix reuse still works, on-disk cache off
-            return
         path = req.prompt_cache_path
-        if not path or not os.path.exists(path):
-            return
+        if not path:
+            return "unset"  # the common no-cache case: not counted
+
+        def done(result: str) -> str:
+            tm.ENGINE_PROMPT_CACHE_RESTORES.labels(
+                model=self._mlabel, result=result).inc()
+            TRACER.event(req.id, f"prompt_cache:{result}")
+            return result
+
+        if self.channel is not None:
+            # multihost: a row restore would need the KV payload
+            # broadcast to every follower. CROSS-SLOT copies still work
+            # (pure device ops); only the disk path stays off.
+            return done("skipped_multihost")
         if self.draft is not None:
-            return  # restored rows would leave the draft cache stale
+            # restored rows would leave the draft cache stale
+            return done("skipped_draft")
+        if not os.path.exists(path):
+            return done("no_file")
         try:
             data = np.load(path)
             cached_tokens = [int(t) for t in data["tokens"]]
@@ -1677,14 +1928,14 @@ class LLMEngine:
             # ignored, not crash the scheduler or corrupt KV
             if (k_all.shape[0] != L or k_all.shape[2] != F
                     or v_all.shape != k_all.shape):
-                return
+                return done("shape_mismatch")
             if self.cache.quantized != (k_all.dtype == np.int8):
-                return
+                return done("dtype_mismatch")
             if self.cache.quantized and "k_scale" not in data:
-                return
+                return done("dtype_mismatch")
             common = _common_prefix(cached_tokens, req.prompt_ids)
             if common <= _common_prefix(slot.cache_tokens, req.prompt_ids):
-                return
+                return done("stale")
             n = min(common, len(cached_tokens), self.max_seq - 1,
                     k_all.shape[1])
             ck = self.cache.k.at[:, slot.idx, :n].set(
@@ -1697,13 +1948,20 @@ class LLMEngine:
                     jnp.asarray(data["k_scale"][:, :n]))
                 vs = vs.at[:, slot.idx, :n].set(
                     jnp.asarray(data["v_scale"][:, :n]))
-        except Exception:
-            return  # unreadable/incompatible cache: prefill normally
+        except Exception as e:
+            # unreadable/incompatible cache: prefill normally — but
+            # say so, a corrupt file re-prefilling forever is a real
+            # cost someone is paying
+            log.warning("prompt cache %s unusable: %r", path, e)
+            return done("error")
         self.cache = KVCache(k=ck, v=cv, k_scale=ks, v_scale=vs)
         slot.cache_tokens = cached_tokens[:n]
         slot.n_past = n
         slot.cache_loaded = (path, n)
+        if self._prefix_enabled:
+            self._prefix_index.set_tokens(slot.idx, slot.cache_tokens)
         self._epoch += 1
+        return done("restored")
 
     def _maybe_save_prompt_cache(self, slot: _Slot) -> None:
         """Persist the slot's prefix rows (ref: llama.cpp prompt cache
@@ -1764,11 +2022,19 @@ class LLMEngine:
             tm.ENGINE_QUEUE_WAIT.labels(model=self._mlabel).observe(
                 max(0.0, now - req.t_submit))
         slot.cache_loaded = None
+        copy_gain = disk_gain = 0
         if req.soft_embeds is not None:
             common = 0  # image-conditioned K/V: no token-id prefix reuse
         else:
-            self._try_load_prompt_cache(slot, req)
             common = _common_prefix(slot.cache_tokens, req.prompt_ids)
+            common, copy_gain = self._maybe_prefix_copy(slot, req, common)
+            # the on-disk cache can still beat a live resident/copied
+            # prefix (it persists across restarts); it checks the
+            # slot's CURRENT tokens, so it only applies when longer
+            before_disk = common
+            if self._try_load_prompt_cache(slot, req) == "restored":
+                common = _common_prefix(slot.cache_tokens, req.prompt_ids)
+                disk_gain = common - before_disk
             if common == len(req.prompt_ids):
                 common -= 1  # reprocess last token for logits (ref :1882-1890)
         slot.request = req
@@ -1777,6 +2043,27 @@ class LLMEngine:
         slot.n_past = common
         slot.n_prompt = len(req.prompt_ids)
         slot.cache_tokens = list(req.prompt_ids[:common])
+        slot.n_reused = common
+        if self._prefix_enabled:
+            # eager re-register: the row now holds (only) this truncated
+            # prefix — later admissions in the SAME wave must not match
+            # the stale longer registration
+            self._prefix_index.set_tokens(slot.idx, slot.cache_tokens)
+            self._prefix_index.touch(slot.idx)
+        if common > 0:
+            # attribute reuse by source; clamp so the three sources sum
+            # exactly to `common` even across the relogit -1 adjustment
+            disk_gain = min(disk_gain, common)
+            copy_gain = min(copy_gain, common - disk_gain)
+            resident = common - disk_gain - copy_gain
+            m = self._mlabel
+            self.metrics.prefix_reused_tokens += common
+            for src_name, val in (("resident", resident),
+                                  ("copy", copy_gain),
+                                  ("disk", disk_gain)):
+                if val > 0:
+                    tm.ENGINE_PREFIX_REUSED_TOKENS.labels(
+                        model=m, source=src_name).inc(val)
         slot.generated = []
         slot.decoder = StreamDecoder(self.tokenizer)
         slot.pending_text = ""
@@ -2053,7 +2340,13 @@ class LLMEngine:
                 continue
             s.t_prefill_ms += dt_ms
             self.metrics.prompt_tokens_processed += s.n_prompt
-            prompt_toks += s.n_prompt
+            # the Prometheus counter reports tokens that actually went
+            # THROUGH prefill — reused (resident/copied/restored)
+            # tokens are counted in engine_prefix_reused_tokens_total,
+            # so reused + prefilled == submitted prompt tokens
+            actual = max(0, s.n_prompt - s.n_reused)
+            self.metrics.prefill_tokens += actual
+            prompt_toks += actual
             first_toks += 1
             s.state = SlotState.DECODE
             s.t_last = now

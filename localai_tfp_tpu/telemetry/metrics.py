@@ -109,6 +109,39 @@ ENGINE_GENERATED_TOKENS = REGISTRY.counter(
     "Tokens sampled and emitted to streams",
     labels=("model",),
 )
+# cross-slot prefix cache (engine/prefix_index.py + kvcopy dispatch)
+ENGINE_PREFIX_REUSED_TOKENS = REGISTRY.counter(
+    "engine_prefix_reused_tokens_total",
+    "Prompt tokens served from KV-resident prefixes instead of prefill "
+    "(source: resident = destination slot already held them, copy = "
+    "row-to-row on-device copy from another slot, disk = on-disk "
+    "prompt cache restore)",
+    labels=("model", "source"),
+)
+ENGINE_PREFIX_COPIES = REGISTRY.counter(
+    "engine_prefix_copies_total",
+    "On-device cross-slot KV prefix row copies dispatched",
+    labels=("model",),
+)
+ENGINE_PREFIX_EVENTS = REGISTRY.counter(
+    "engine_prefix_cache_events_total",
+    "Cross-slot prefix cache admission outcomes "
+    "(hit_copy/hit_resident/miss/deferred/off)",
+    labels=("model", "event"),
+)
+ENGINE_PROMPT_CACHE_RESTORES = REGISTRY.counter(
+    "engine_prompt_cache_restores_total",
+    "On-disk prompt cache restore attempts by result (restored/stale/"
+    "shape_mismatch/dtype_mismatch/error/skipped_multihost/"
+    "skipped_draft/no_file)",
+    labels=("model", "result"),
+)
+ENGINE_KV_RESIDENT_PREFIX = REGISTRY.gauge(
+    "engine_kv_resident_prefix_tokens_count",
+    "KV-resident reusable prefix tokens across ALL slots (free and "
+    "active) — the cross-slot cache's working set",
+    labels=("model",),
+)
 
 # ---------------------------------------------------------------- loader
 

@@ -743,17 +743,26 @@ class JaxLLMBackend(Backend):
             queue_depth = len(eng._pending)
         busy = sum(1 for s in eng.slots if s.active)
         used = sum(s.n_past for s in eng.slots if s.active)
+        resident = sum(len(s.cache_tokens) for s in eng.slots)
+        reused, filled = m.prefix_reused_tokens, m.prefill_tokens
         return {
             "n_slots": eng.n_slots,
             "slots_busy": busy,
             "queue_depth": queue_depth,
             "kv_slot_utilization": round(
                 used / float(eng.n_slots * eng.max_seq), 4),
+            "kv_resident_prefix_tokens": resident,
             "tokens_per_second": round(m.tokens_per_second, 2),
             "tokens_generated": m.tokens_generated,
             "prompt_tokens_processed": m.prompt_tokens_processed,
             "requests_completed": m.requests_completed,
             "spec_tokens": m.spec_tokens,
+            "prefix_cache": {
+                "reused_tokens": reused,
+                "prefilled_tokens": filled,
+                "copies": m.prefix_copies,
+                "hit_rate": round(reused / max(reused + filled, 1), 4),
+            },
         }
 
 

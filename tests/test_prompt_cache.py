@@ -27,8 +27,20 @@ def _wait_for(path, timeout=10.0):
 
 
 def _engine(params, spec, **kw):
+    kw.setdefault("cache_dtype", jnp.float32)
     return LLMEngine(spec, params, ByteTokenizer(), n_slots=2, max_seq=256,
-                     cache_dtype=jnp.float32, autostart=False, **kw)
+                     autostart=False, **kw)
+
+
+def _restore_delta(snap):
+    """engine_prompt_cache_restores_total movement by result label."""
+    from localai_tfp_tpu.telemetry.registry import REGISTRY
+
+    out = {}
+    for k, v in REGISTRY.delta(snap).items():
+        if k.startswith("engine_prompt_cache_restores_total"):
+            out[k.split('result="')[1].rstrip('"}')] = v
+    return out
 
 
 def _gen(eng, path="", all_=False, ro=False, max_tokens=8):
@@ -94,13 +106,104 @@ def test_prompt_cache_all_includes_generation(tmp_path):
     assert data["tokens"].shape[0] > n_prompt  # generation rows included
 
 
-def test_corrupt_cache_ignored(tmp_path):
+def test_corrupt_cache_ignored_and_counted(tmp_path):
+    from localai_tfp_tpu.telemetry.registry import REGISTRY
+
     spec = tiny_spec()
     params = init_params(jax.random.PRNGKey(3), spec, dtype=jnp.float32)
     path = str(tmp_path / "bad.cache")
     open(path, "wb").write(b"not-an-npz")
     eng = _engine(params, spec)
     eng.start()
+    snap = REGISTRY.snapshot()
     ev = _gen(eng, path)  # must not crash; falls back to normal prefill
     eng.close()
     assert ev.completion_tokens == 8
+    # the failure is COUNTED, not swallowed: a corrupt file silently
+    # re-prefilling every request was invisible before
+    assert _restore_delta(snap).get("error") == 1
+
+
+def test_prompt_cache_quantized_round_trip(tmp_path):
+    """int8 KV + per-row scales must survive the disk round trip; a
+    restored engine reproduces the float-path contract byte for byte."""
+    from localai_tfp_tpu.telemetry.registry import REGISTRY
+
+    spec = tiny_spec()
+    params = init_params(jax.random.PRNGKey(4), spec, dtype=jnp.float32)
+    path = str(tmp_path / "q8.cache")
+
+    eng1 = _engine(params, spec, cache_dtype="int8")
+    eng1.start()
+    ev1 = _gen(eng1, path)
+    eng1.close()
+    _wait_for(path)
+    data = np.load(path)
+    assert data["k"].dtype == np.int8
+    assert data["k_scale"].dtype == np.float32
+    assert data["k_scale"].shape == data["k"].shape[:2]
+
+    eng2 = _engine(params, spec, cache_dtype="int8")
+    eng2.start()
+    snap = REGISTRY.snapshot()
+    ev2 = _gen(eng2, path)
+    eng2.close()
+    assert ev2.full_text == ev1.full_text
+    assert _restore_delta(snap).get("restored") == 1
+    # the restore, not prefill, supplied the prompt prefix
+    n_prompt = len(ByteTokenizer().encode(PROMPT)) + 1
+    assert eng2.metrics.prefill_tokens < n_prompt
+
+
+def test_prompt_cache_dtype_mismatch_rejected(tmp_path):
+    """A cache written by an int8 engine must be REJECTED (and counted)
+    by a float engine, not corrupt its KV."""
+    spec = tiny_spec()
+    params = init_params(jax.random.PRNGKey(5), spec, dtype=jnp.float32)
+    path = str(tmp_path / "mix.cache")
+
+    eng1 = _engine(params, spec, cache_dtype="int8")
+    eng1.start()
+    _gen(eng1, path)
+    eng1.close()
+    _wait_for(path)
+
+    from localai_tfp_tpu.telemetry.registry import REGISTRY
+
+    eng2 = _engine(params, spec)  # float engine
+    eng2.start()
+    snap = REGISTRY.snapshot()
+    ev = _gen(eng2, path)
+    eng2.close()
+    assert ev.completion_tokens == 8
+    assert _restore_delta(snap).get("dtype_mismatch") == 1
+    # full prefill happened — nothing was restored
+    n_prompt = len(ByteTokenizer().encode(PROMPT)) + 1
+    assert eng2.metrics.prefill_tokens == n_prompt
+
+
+def test_prompt_cache_shape_mismatch_rejected(tmp_path):
+    """A cache from a different model geometry is ignored + counted."""
+    spec = tiny_spec()
+    params = init_params(jax.random.PRNGKey(6), spec, dtype=jnp.float32)
+    path = str(tmp_path / "shape.cache")
+    tokens = np.asarray(ByteTokenizer().encode(PROMPT, add_bos=True),
+                        np.int32)
+    n = len(tokens)
+    # wrong layer count AND feature dim vs tiny_spec (np.savez would
+    # append .npz to a bare path; write through a handle like the
+    # engine's own saver)
+    with open(path, "wb") as f:
+        np.savez(f, tokens=tokens,
+                 k=np.zeros((7, n, 24), np.float32),
+                 v=np.zeros((7, n, 24), np.float32))
+
+    from localai_tfp_tpu.telemetry.registry import REGISTRY
+
+    eng = _engine(params, spec)
+    eng.start()
+    snap = REGISTRY.snapshot()
+    ev = _gen(eng, path)
+    eng.close()
+    assert ev.completion_tokens == 8
+    assert _restore_delta(snap).get("shape_mismatch") == 1

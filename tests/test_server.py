@@ -394,7 +394,26 @@ def finetune_primed(client):
     (bucketed-prefill vs cached-KV numerics flip the argmax on this
     near-flat tiny random model); after priming, every engine serves the
     prompt from the same cached-prefix state, so tiny and tinyft emit
-    identical raw tokens and the transforms are directly comparable."""
+    identical raw tokens and the transforms are directly comparable.
+
+    The CROSS-SLOT prefix cache makes warm state depend on each
+    engine's full request history (earlier module tests hit `tiny`
+    constantly, `tinyft` never — different donors, different KV
+    rounding), so first drop every engine's resident prefixes: all
+    engines then prime through identical dispatch shapes from an
+    identical clean state."""
+    from localai_tfp_tpu.engine.prefix_index import PrefixIndex
+
+    state = client._client.app["state"]
+    for lm in state.model_loader._models.values():
+        eng = getattr(lm.backend, "engine", None)
+        if eng is None:
+            continue
+        for s in eng.slots:
+            if not s.active:
+                s.cache_tokens = []
+                s.n_past = 0
+        eng._prefix_index = PrefixIndex()
     cbody = {"prompt": "abc", "max_tokens": 6, "ignore_eos": True,
              "temperature": 0.0}
     for m in ("tiny", "tinyft", "tinyft2"):

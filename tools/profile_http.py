@@ -13,10 +13,23 @@ wave (all times ms relative to the wave's t0):
   write   — client saw the first CONTENT SSE event (TTFT)
 
 Run manually on the chip:  python tools/profile_http.py
+
+Shared-system-prompt burst scenario (cross-slot prefix cache):
+
+  python tools/profile_http.py --shared-prefix [--small] \
+      [--requests N] [--prefix-tokens P]
+
+drives two bursts through the stock endpoint — N requests sharing a
+P-token prefix, and N fully distinct requests — each with the prefix
+cache ON and OFF, reporting client TTFT, prefill tokens actually
+dispatched (counted at the dispatch layer), kvcopy count, and the
+telemetry counters cross-checked against the dispatch-level ground
+truth. ``--small`` runs the tiny CPU config (smoke).
 """
 
 from __future__ import annotations
 
+import argparse
 import asyncio
 import json
 import os
@@ -36,23 +49,13 @@ def pct(xs, q):
     return round(_pct(xs, q), 1) if xs else None
 
 
-def main() -> None:
-    jax.config.update("jax_compilation_cache_dir", "/root/.cache/localai_xla")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-
-    from tools.profile_ttft import build_engine
-
-    from aiohttp import ClientSession, ClientTimeout, TCPConnector, web
-
+def _mk_state(eng, tok):
+    """Minimal Application with the in-memory engine registered as
+    model "bench" (the scenario measures serving, not the loader)."""
     from localai_tfp_tpu.config.app_config import ApplicationConfig
     from localai_tfp_tpu.engine.loader import LoadedModel
-    from localai_tfp_tpu.server import openai_routes
-    from localai_tfp_tpu.server.app import build_app
     from localai_tfp_tpu.server.state import Application
     from localai_tfp_tpu.workers.llm import JaxLLMBackend
-
-    eng, tok, n_req, n_tok = build_engine(False)
-    eng.latency_target_ms = 70.0  # bench8b.yaml parity
 
     tmp = tempfile.mkdtemp(prefix="prof-http-")
     models = os.path.join(tmp, "models")
@@ -76,6 +79,175 @@ def main() -> None:
     backend.spec, backend._state = eng.spec, "READY"
     state.model_loader._models["bench"] = LoadedModel(
         "bench", "jax-llm", backend)
+    return state
+
+
+class _DispatchSpy:
+    """Count REAL prefill tokens (pad rows excluded) and kvcopy
+    dispatches at the engine._run layer — ground truth for the
+    telemetry cross-check."""
+
+    def __init__(self, eng):
+        self.eng = eng
+        self.prefill_tokens = 0
+        self.copies = 0
+        self._orig = eng._run
+        eng._run = self._run
+
+    def reset(self):
+        self.prefill_tokens = 0
+        self.copies = 0
+
+    def _run(self, kind, payload):
+        if kind == "prefill_final":
+            self.prefill_tokens += int(sum(
+                int(c) for sid, c in zip(payload["slot_ids"],
+                                         payload["n_chunk"])
+                if int(sid) < self.eng.n_slots))
+        elif kind == "prefill":
+            self.prefill_tokens += payload["toks"].shape[1]
+        elif kind == "kvcopy":
+            self.copies += 1
+        return self._orig(kind, payload)
+
+
+def shared_prefix_scenario(small: bool, n_req: int,
+                           prefix_tokens: int) -> None:
+    from aiohttp import ClientSession, ClientTimeout, TCPConnector, web
+
+    from localai_tfp_tpu.engine.prefix_index import PrefixIndex
+    from localai_tfp_tpu.server.app import build_app
+    from localai_tfp_tpu.telemetry.registry import REGISTRY
+
+    from tools.profile_ttft import build_engine
+
+    eng, tok, _, _ = build_engine(small)
+    if small:
+        n_req = min(n_req, eng.n_slots)
+        prefix_tokens = min(prefix_tokens, eng.max_seq // 2)
+    n_tok = 16 if small else 64
+    app = build_app(_mk_state(eng, tok))
+    spy = _DispatchSpy(eng)
+    # byte-level bench tokenizers: 1 char ~ 1 token
+    shared = "S" * prefix_tokens
+    scenarios = {
+        "shared": [shared + f" req {i:03d}" for i in range(n_req)],
+        "distinct": [f"{i:03d} " + os.urandom(8).hex() + " distinct"
+                     for i in range(n_req)],
+    }
+
+    def reset_engine():
+        # drop all resident prefixes so each mode starts cold
+        for s in eng.slots:
+            s.cache_tokens = []
+            s.n_past = 0
+        eng._prefix_index = PrefixIndex()
+        eng._deferred.clear()
+        spy.reset()
+
+    async def drive():
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        url = f"http://127.0.0.1:{port}/v1/chat/completions"
+        out: dict = {}
+        async with ClientSession(
+            connector=TCPConnector(limit=0),
+            timeout=ClientTimeout(total=3600),
+        ) as sess:
+
+            async def one(content, ttfts, i, t0):
+                body = {
+                    "model": "bench",
+                    "messages": [{"role": "user", "content": content}],
+                    "max_tokens": n_tok, "stream": True,
+                    "temperature": 0.0, "ignore_eos": True,
+                }
+                async with sess.post(url, json=body) as r:
+                    assert r.status == 200, await r.text()
+                    async for line in r.content:
+                        if not line.startswith(b"data: "):
+                            continue
+                        if line.strip() == b"data: [DONE]":
+                            break
+                        d = json.loads(line[6:])
+                        ch = d["choices"][0]
+                        if (ch["delta"].get("content")
+                                and ttfts[i] is None):
+                            ttfts[i] = time.perf_counter() - t0
+                        if ch.get("finish_reason"):
+                            break
+
+            async def wave(contents):
+                ttfts = [None] * len(contents)
+                t0 = time.perf_counter()
+                await asyncio.gather(
+                    *[one(c, ttfts, i, t0)
+                      for i, c in enumerate(contents)])
+                return [x * 1e3 for x in ttfts if x is not None]
+
+            # untimed warm waves in BOTH modes: each mode takes
+            # different dispatch shapes (full prefill vs copy + tail)
+            # and a first-wave compile would be charged to whichever
+            # mode ran first
+            for warm_mode in ("off", "on"):
+                eng._prefix_enabled = (warm_mode == "on")
+                reset_engine()
+                await wave(scenarios["shared"])
+            for name, contents in scenarios.items():
+                out[name] = {}
+                for mode in ("off", "on"):
+                    eng._prefix_enabled = (mode == "on")
+                    reset_engine()
+                    snap = REGISTRY.snapshot()
+                    ttfts = await wave(contents)
+                    delta = REGISTRY.delta(snap)
+                    reused = sum(
+                        v for k, v in delta.items()
+                        if k.startswith("engine_prefix_reused_tokens"))
+                    prefilled = sum(
+                        v for k, v in delta.items()
+                        if k.startswith("engine_prompt_tokens_total"))
+                    out[name][mode] = {
+                        "ttft_p50_ms": pct(ttfts, .5),
+                        "ttft_p95_ms": pct(ttfts, .95),
+                        "prefill_tokens_dispatched": spy.prefill_tokens,
+                        "kv_copies": spy.copies,
+                        "telemetry_reused_tokens": int(reused),
+                        "telemetry_prefilled_tokens": int(prefilled),
+                        "telemetry_matches_dispatch":
+                            int(prefilled) == spy.prefill_tokens,
+                    }
+        s = out["shared"]
+        s["prefill_tokens_saved"] = (
+            s["off"]["prefill_tokens_dispatched"]
+            - s["on"]["prefill_tokens_dispatched"])
+        return out
+
+    loop = asyncio.new_event_loop()
+    try:
+        report = loop.run_until_complete(drive())
+    finally:
+        loop.close()
+    print(json.dumps(report, indent=1), flush=True)
+    eng.close()
+
+
+def main() -> None:
+    from tools.profile_ttft import build_engine
+
+    from aiohttp import ClientSession, ClientTimeout, TCPConnector, web
+
+    from localai_tfp_tpu.server import openai_routes
+    from localai_tfp_tpu.server.app import build_app
+
+    eng, tok, n_req, n_tok = build_engine(False)
+    eng.latency_target_ms = 70.0  # bench8b.yaml parity
+
+    state = _mk_state(eng, tok)
+    backend = state.model_loader._models["bench"].backend
     app = build_app(state)
 
     # ---- stage stamps ----
@@ -203,4 +375,20 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shared-prefix", action="store_true",
+                    help="shared-system-prompt burst scenario "
+                         "(prefix cache on vs off)")
+    ap.add_argument("--small", action="store_true",
+                    help="tiny CPU config (smoke) instead of 8B")
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--prefix-tokens", type=int, default=512)
+    args = ap.parse_args()
+    jax.config.update("jax_compilation_cache_dir",
+                      "/root/.cache/localai_xla")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    if args.shared_prefix:
+        shared_prefix_scenario(args.small, args.requests,
+                               args.prefix_tokens)
+    else:
+        main()
