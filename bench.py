@@ -126,6 +126,102 @@ def _prefix_cache_extra(eng) -> dict:
     }
 
 
+def _mixed_itl_extra(eng, tok, n_tok=96) -> dict:
+    """ITL under admission pressure (extra.mixed_itl): sustain decode
+    streams on half the slots, inject an admission burst mid-stream,
+    and report the live streams' inter-event gaps — p50/p95 and the
+    max gap any stream saw — plus burst TTFT. The series BENCH_r*.json
+    tracks for the stall-free mixed dispatcher (an admission wave must
+    not spike active streams' ITL to the prefill round trip). Must run
+    while the engine is LIVE (before _bench_http, whose teardown fires
+    the app cleanup that closes the serving engine)."""
+    import queue as _queue
+
+    from localai_tfp_tpu.engine.engine import GenRequest
+
+    n_streams = max(1, eng.n_slots // 2)
+    burst_size = max(1, eng.n_slots - n_streams)
+    bp = "burst " * max(1, min(eng.max_seq // 2, 512) // 6)
+    # untimed warm pass: compile the mixed variant (engines without a
+    # full warmup() jit it on first mixed dispatch — seconds that would
+    # otherwise land in the measured gaps)
+    wq = eng.submit_many([GenRequest(
+        prompt_ids=tok.encode("warm stream"), max_tokens=24,
+        temperature=0.0, ignore_eos=True)])[0]
+    ev = wq.get(timeout=300)
+    assert not ev.done, ev.error
+    wb = eng.submit_many([GenRequest(
+        prompt_ids=tok.encode(bp + "w"), max_tokens=4,
+        temperature=0.0, ignore_eos=True)])[0]
+    for q in (wb, wq):
+        while not q.get(timeout=300).done:
+            pass
+    qs = eng.submit_many([
+        GenRequest(prompt_ids=tok.encode(f"sustained stream {i:02d}"),
+                   max_tokens=n_tok, temperature=0.0, ignore_eos=True)
+        for i in range(n_streams)])
+    times: list[list[float]] = [[] for _ in range(n_streams)]
+    done = [False] * n_streams
+    for i, q in enumerate(qs):  # all streams live before the burst
+        ev = q.get(timeout=120)
+        assert not ev.done, ev.error
+        times[i].append(time.perf_counter())
+    t0 = time.perf_counter()
+    bqs = eng.submit_many([
+        GenRequest(prompt_ids=tok.encode(bp + f"{j:02d}"), max_tokens=8,
+                   temperature=0.0, ignore_eos=True)
+        for j in range(burst_size)])
+    burst_ttft: list[float] = [None] * burst_size
+    burst_done = [False] * burst_size
+    while not (all(done) and all(burst_done)):
+        idle = True
+        for i, q in enumerate(qs):
+            if done[i]:
+                continue
+            try:
+                ev = q.get_nowait()
+            except _queue.Empty:
+                continue
+            idle = False
+            if ev.done:
+                done[i] = True
+            elif ev.token_id is not None:
+                times[i].append(time.perf_counter())
+        for j, q in enumerate(bqs):
+            if burst_done[j]:
+                continue
+            try:
+                ev = q.get_nowait()
+            except _queue.Empty:
+                continue
+            idle = False
+            if ev.done:
+                burst_done[j] = True
+            elif ev.token_id is not None and burst_ttft[j] is None:
+                burst_ttft[j] = (time.perf_counter() - t0) * 1e3
+        if idle:
+            time.sleep(0.001)
+    gaps: list[float] = []
+    max_gaps: list[float] = []
+    for ts in times:
+        g = [(b - a) * 1e3 for a, b in zip(ts, ts[1:])]
+        if g:
+            gaps += g
+            max_gaps.append(max(g))
+    gaps.sort()
+    tt = sorted(t for t in burst_ttft if t is not None)
+    return {
+        "streams": n_streams,
+        "burst_size": burst_size,
+        "itl_p50_ms": round(gaps[len(gaps) // 2], 1) if gaps else None,
+        "itl_p95_ms": round(gaps[int(len(gaps) * 0.95)], 1)
+        if gaps else None,
+        "max_gap_ms": round(max(max_gaps), 1) if max_gaps else None,
+        "burst_ttft_p50_ms": round(tt[len(tt) // 2], 1) if tt else None,
+        "mixed_dispatch": eng._mixed,
+    }
+
+
 def _bench_http(state, model, n_req, n_tok, runs=2):
     """Endpoint-level benchmark: boot the REAL aiohttp server (routes,
     middleware, SSE writer) over the given Application (whose loader
@@ -707,6 +803,9 @@ def main() -> None:
             extra["decode_tok_s_8b_engine"] = tok_s8
             extra["ttft_p50_ms_8b_engine"] = p50_8
             extra["ttft_p95_ms_8b_engine"] = p95_8
+            # live-engine measurement: must precede _bench_http (its
+            # teardown closes the serving engine via app cleanup)
+            extra["mixed_itl"] = _mixed_itl_extra(eng8, tok8)
             tok_s, p50_h, p95_h, p50_steady = _bench_http(
                 state, "bench8b", 64, 512, runs=2)
             extra["ttft_p50_ms_8b_http"] = p50_h
@@ -734,6 +833,9 @@ def main() -> None:
         eng.start()
         tok_s_eng, p50, p95 = _bench_config(eng, tok, 4, 32, runs=1)
         extra["decode_tok_s_engine"] = tok_s_eng
+        # live-engine measurement: must precede _bench_http (its
+        # teardown closes the serving engine via app cleanup)
+        extra["mixed_itl"] = _mixed_itl_extra(eng, tok)
         # smoke HTTP leg: a minimal Application with the in-memory
         # engine registered (the TPU leg exercises the full disk-loader
         # path; here the endpoint plumbing is what's smoke-tested)

@@ -29,6 +29,13 @@ TPU-first re-design rather than translation:
   best matching prefix held by ANY slot — free or active — with
   prefix-aware wave admission and LRU x length victim selection
   (see the README "Serving: cross-slot prefix KV cache" section).
+- Prefill and decode are NOT mutually exclusive: when both coexist, a
+  fused token-budgeted "mixed" dispatch advances prefill chunks and
+  decode rows in the SAME identity-batch device step (the ragged-batch
+  discipline of RTP-LLM / Ragged Paged Attention, PAPERS.md), so an
+  admission wave never stalls active streams. Escape hatch:
+  LOCALAI_MIXED_DISPATCH=off restores the legacy alternating scheduler
+  (see the README "Scheduling" section).
 """
 
 from __future__ import annotations
@@ -149,6 +156,12 @@ class StreamEvent:
     # before admission, and submit-to-first-token latency
     timing_queue_ms: float = 0.0
     timing_first_token_ms: float = 0.0
+    # prefill phase split: timing_prompt_processing_ms is DEVICE time
+    # attributed at harvest of the covering flight(s); this is the
+    # host-side enqueue component (payload build + dispatch call),
+    # which used to be miscounted as prompt processing for chunked
+    # prompts
+    timing_prefill_enqueue_ms: float = 0.0
 
 
 class SlotState(Enum):
@@ -201,7 +214,14 @@ class _Slot:
     # instead of prefill (set at _assign; read at prefill harvest)
     t_start: float = 0.0
     t_first: float = 0.0  # perf_counter at first emitted token
-    t_prefill_ms: float = 0.0
+    t_prefill_ms: float = 0.0  # DEVICE prefill time, attributed at
+    # harvest of the covering flight(s) — enqueue-only host time must
+    # not land here (it made chunked prompts report near-zero prefill)
+    t_prefill_enq_ms: float = 0.0  # host-side prefill enqueue time
+    t_prefill_t0: float = 0.0  # perf_counter at the slot's FIRST
+    # prefill dispatch; the covering flight's harvest attributes
+    # (harvest - t0) as device+queue prefill time, so chunk dispatches
+    # enqueued in earlier iterations are not lost
     t_decode_ms: float = 0.0
     t_last: float = 0.0
 
@@ -421,6 +441,19 @@ class LLMEngine:
         # ~6-token chat-template prefix every request shares
         self._prefix_defer_min = max(self._prefix_min_copy, int(
             _os.environ.get("LOCALAI_PREFIX_CACHE_DEFER_MIN", "64")))
+        # stall-free mixed prefill+decode dispatch: ONE fused identity-
+        # batch device step advances prefill chunks AND decode rows, so
+        # an admission wave never serializes against active streams
+        # (the legacy scheduler's _prefill_hold/_dispatch_decode sleep
+        # holds). LOCALAI_MIXED_DISPATCH=off restores the legacy
+        # alternating-phase scheduler (the escape hatch). Forced off
+        # when no prefill bucket fits the identity-batch token budget.
+        self._mixed = _os.environ.get(
+            "LOCALAI_MIXED_DISPATCH", "on").lower() not in (
+            "0", "off", "false")
+        if not any(b * n_slots <= self._PREFILL_GROUP_TOKENS
+                   for b in self.prefill_buckets):
+            self._mixed = False
         self._prefix_index = PrefixIndex()
         # same-wave prefix grouping: request id -> (deadline, want_len)
         # for admissions deferred one scheduler iteration so a
@@ -492,7 +525,13 @@ class LLMEngine:
         # their spread to tell a still-landing burst from a lone
         # arrival or a single batched wave
         self._prefill_hold0 = 0.0  # when the current prefill-formation
-        # hold began (0 = not holding); bounds hold duration
+        # hold began (0 = not holding); bounds hold duration.
+        # _hold_start/_prefill_hold0 are LEGACY-ONLY state: the mixed
+        # dispatcher has no hold loops (its decode/prefill fusion is
+        # what the holds were approximating)
+        self._last_decode_adv = 0.0  # perf_counter of the last dispatch
+        # that advanced >=1 decode row; gaps between consecutive ones
+        # while a slot decodes feed engine_decode_stall_seconds
         self.warmup_reused = False  # True when warmup() was skipped
         # because an identical variant set is already in the persistent
         # compile cache (see warmup docstring); surfaced in the load
@@ -803,6 +842,88 @@ class LLMEngine:
         self._decode_k_fns[key] = _prefill_final
         return _prefill_final
 
+    def _mixed_fn(self, window: int):
+        """Fused mixed-step dispatch: ONE identity-batch device function
+        ([n_slots, bucket], row b == slot b) that, per step, runs a
+        token-budgeted prefill chunk for PREFILL rows AND one decode
+        step for DECODE rows — the ragged-batch discipline production
+        engines converged on (RTP-LLM / Ragged Paged Attention,
+        PAPERS.md), expressed as a single static shape so the variant
+        set stays tiny (warmup-precompiled like the identity
+        prefill_final).
+
+        Row roles are encoded entirely in the per-row index vectors, so
+        one compiled variant serves every composition:
+        - decode rows: n_chunk=1 (their last sampled token at column
+          0), sample_sids = own idx, reset_sids = OOB sentinel (their
+          live sampler state must NOT be reset);
+        - prefill final-chunk rows: n_chunk = remaining prompt,
+          sample_sids = reset_sids = own idx — sampler reset, penalty-
+          window seed, and first-token sample ride this dispatch
+          exactly as in _prefill_final_fn;
+        - prefill non-final chunk rows: sample_sids = sentinel (K/V
+          writes only; their last-position logits are computed but the
+          sampler scatters drop);
+        - parked rows (FREE): write_mask False — a no-op re-write of
+          what is already at their positions, so resident prefixes
+          survive untouched (no tail clamping needed, unlike the
+          decode scan's inactive rows).
+
+        Per-slot sampler math is IDENTICAL to the split paths (same
+        sample()/reset_slots/seed_windows calls, sentinel-id scatter
+        drops instead of active-mask merges), so an identical request
+        schedule produces byte-identical outputs with this path on or
+        off (test_mixed_dispatch.py enforces it)."""
+        key = ("mixed", window)
+        fn = self._decode_k_fns.get(key)
+        if fn is not None:
+            return fn
+        spec = self.spec
+
+        @partial(jax.jit, donate_argnums=(2, 4))
+        def _mixed(params, tokens, cache, pos0, sampling, write_mask,
+                   n_chunk, sample_sids, reset_sids, tails, tail_lens,
+                   masks, reset, soft=None):
+            if soft is not None:
+                soft = _soft_expand(tokens, *soft)
+            win, restore = _window_cache(cache, window)
+            hidden, win = forward_hidden(
+                spec, params, tokens, pos0, win, None, soft=soft,
+                write_mask=write_mask,
+            )
+            cache = restore(win)
+            from ..models.transformer import _lm_head
+            from ..ops.sampling import reset_slots
+
+            # same phase order as _prefill_final_fn: reset -> seed ->
+            # sample. Decode rows carry the sentinel in reset_sids, so
+            # the scatters leave their live sampler state untouched.
+            sampling = reset_slots(sampling, reset_sids, *reset)
+            sampling = seed_windows(sampling, reset_sids, tails,
+                                    tail_lens)
+            last_h = jax.vmap(
+                lambda h, n: lax.dynamic_slice_in_dim(h, n - 1, 1, 0)[0]
+            )(hidden, n_chunk)  # [S, D] at each row's true last position
+            logits = _lm_head(spec, params, last_h[:, None, :])[:, 0]
+            toks, sampling = sample(sampling, sample_sids, logits,
+                                    mask=masks)
+            return toks, cache, sampling
+
+        self._decode_k_fns[key] = _mixed
+        return _mixed
+
+    @property
+    def _mixed_buckets(self) -> tuple[int, ...]:
+        """Prefill buckets whose identity-batch dispatch fits the
+        per-dispatch token budget (_PREFILL_GROUP_TOKENS): the mixed
+        step is always [n_slots, bucket], so n_slots*bucket bounds its
+        device work — decode rows are admitted first (they cost one
+        real token each) and the rest of the budget carries prefill
+        chunk tokens, which is what bounds decode ITL under admission
+        pressure."""
+        return tuple(b for b in self.prefill_buckets
+                     if b * self.n_slots <= self._PREFILL_GROUP_TOKENS)
+
     def _window_bucket(self, need: int) -> int:
         """Smallest power-of-two window >= need (floor 256, cap max_seq)."""
         w = 256
@@ -986,7 +1107,10 @@ class LLMEngine:
         self._epoch += 1
         dt = time.perf_counter() - t0
         if dt > 0 and emitted_total:
-            self.metrics.tokens_per_second = emitted_total / dt
+            self._note_tokens_per_second(emitted_total, dt)
+        tm.ENGINE_MIXED_DISPATCH.labels(
+            model=self._mlabel, composition="decode_only").inc()
+        self._note_decode_advance(t0)
         self.metrics.slots_busy = sum(1 for s in self.slots if s.active)
 
     def _decode_k_fn(self, k: int, window: int):
@@ -1095,6 +1219,37 @@ class LLMEngine:
             if self.draft is not None:
                 self.draft_cache = self._draft_prefill_fn()(
                     self.draft[1], toks, self.draft_cache, pos0, sids
+                )
+            return toks_out
+        if kind == "mixed":
+            # fused mixed prefill+decode step: like prefill_final, a
+            # pure device op with a scalar payload (token ids + per-row
+            # index vectors only), so multihost followers replay it
+            # like any other record
+            toks = jnp.asarray(p["toks"])
+            pos0 = jnp.asarray(p["pos0"])
+            masks = _unpack_masks(p["masks"])
+            soft = self._soft_dense(p.get("soft"), *p["toks"].shape)
+            reset = tuple(jnp.asarray(p["reset"][k]) for k in (
+                "temperature", "top_k", "top_p", "min_p",
+                "repeat_penalty", "freq_penalty", "presence_penalty",
+                "repeat_last_n", "seeds", "has_seed",
+                "typical_p", "mirostat", "mirostat_tau", "mirostat_eta"))
+            toks_out, self.cache, self.sampling = self._mixed_fn(
+                p.get("window", self.max_seq))(
+                self.params, toks, self.cache, pos0, self.sampling,
+                jnp.asarray(p["write_mask"]), jnp.asarray(p["n_chunk"]),
+                jnp.asarray(p["sample_sids"]),
+                jnp.asarray(p["reset_sids"]), jnp.asarray(p["tails"]),
+                jnp.asarray(p["tail_lens"]), masks, reset, soft,
+            )
+            if self.draft is not None:
+                # mirror ONLY the prefill rows into the draft cache
+                # (decode rows advance without draft writes, exactly as
+                # on the decodek path)
+                self.draft_cache = self._draft_prefill_fn()(
+                    self.draft[1], toks, self.draft_cache, pos0,
+                    jnp.asarray(p["prefill_sids"]),
                 )
             return toks_out
         if kind == "decode1":
@@ -1214,6 +1369,7 @@ class LLMEngine:
             self.latency_target_ms, self.sampling.window,
             self._use_kernel, mesh_desc, jax.default_backend(),
             getattr(dev, "device_kind", ""), jax.__version__,
+            self._mixed,  # the mixed dispatcher adds its own variants
         ))
         return hashlib.sha256(blob.encode()).hexdigest()[:20]
 
@@ -1338,6 +1494,29 @@ class LLMEngine:
                         "slot_ids": np.full((1,), self.n_slots,
                                             np.int32),
                         "soft": None, "window": w, "ring": ring,
+                    })
+        if self._mixed:
+            # mixed prefill+decode step variants: one per (bucket that
+            # fits the identity budget, live-context window). All-pad
+            # rows (write_mask False, sentinel sids) exercise the
+            # identical jit shapes without touching engine state.
+            S = self.n_slots
+            for bucket in self._mixed_buckets:
+                reset = {k: np.repeat(v, S, axis=0)
+                         for k, v in pad_reset.items()}
+                for w in win_ladder:
+                    self._run("mixed", {
+                        "toks": np.zeros((S, bucket), np.int32),
+                        "pos0": np.zeros((S,), np.int32),
+                        "n_chunk": np.ones((S,), np.int32),
+                        "write_mask": np.zeros((S,), bool),
+                        "sample_sids": np.full((S,), S, np.int32),
+                        "reset_sids": np.full((S,), S, np.int32),
+                        "tails": np.zeros((S, W), np.int32),
+                        "tail_lens": np.zeros((S,), np.int32),
+                        "masks": None, "reset": reset, "soft": None,
+                        "prefill_sids": np.full((S,), S, np.int32),
+                        "window": w,
                     })
         if self._prefix_enabled:
             # cross-slot KV copy variants (cheap compiles — pure DUS,
@@ -1557,12 +1736,42 @@ class LLMEngine:
         # slot's resident prefix is invisible to ENGINE_KV_UTIL)
         tm.ENGINE_KV_RESIDENT_PREFIX.labels(model=m).set(
             float(sum(len(s.cache_tokens) for s in self.slots)))
+        if not any(s.state is SlotState.DECODE for s in self.slots):
+            # decode-stall gaps are only meaningful while a slot
+            # decodes; reset the clock when the decode set drains
+            self._last_decode_adv = 0.0
 
     def _dispatch(self) -> bool:
         """Enqueue device work for the current slot states. Returns
-        whether anything was enqueued."""
+        whether anything was enqueued.
+
+        Budget-based mixed scheduler (default): whenever prefill AND
+        decode work coexist, ONE fused mixed dispatch advances both —
+        decode rows first (they cost one token each), the remaining
+        token budget filled with prefill chunk tokens — so an
+        admission wave never stalls active streams and decode ITL is
+        bounded by the budget, not by prefill-group round trips. The
+        mixed step needs current host state (decode input tokens,
+        grammar masks), so it waits for in-flight dispatches to
+        harvest; a landing wave's requests keep joining the NEXT mixed
+        dispatch while one is in flight, which preserves the burst-
+        coalescing TTFT wins the legacy sleep-holds bought.
+
+        Single-phase work keeps the specialized paths: pure prefill
+        uses the grouped final/chunk dispatches (without the legacy
+        formation hold), pure decode the pipelined k-step scans.
+        LOCALAI_MIXED_DISPATCH=off restores the legacy alternating
+        scheduler, sleep-holds included."""
         did = False
         prefilling = [s for s in self.slots if s.state is SlotState.PREFILL]
+        decoding = [s for s in self.slots if s.state is SlotState.DECODE]
+        if self._mixed and prefilling and decoding and self._mixed_buckets:
+            if self._flights:
+                return False  # host state is current only once every
+                # in-flight dispatch harvests; _wait_for_event blocks
+                # on the oldest flight's readiness (no sleep-hold)
+            self._enqueue_mixed(prefilling, decoding)
+            return True
         if prefilling:
             # batch final chunks of the same bucket together (one
             # dispatch per admission wave); long prompts chunk ahead
@@ -1574,7 +1783,9 @@ class LLMEngine:
                 else:
                     self._prefill_step(s)  # enqueue-only, no result
                     did = True
-            if finals and self._prefill_hold():
+            if finals and not self._mixed and self._prefill_hold():
+                # LEGACY-ONLY formation hold: the mixed dispatcher
+                # coalesces at dispatch granularity instead
                 finals = {}
                 did = True  # keep the loop spinning through the hold
             for bucket in sorted(finals, key=lambda b: -len(finals[b])):
@@ -1584,7 +1795,6 @@ class LLMEngine:
                     self._enqueue_prefill_final(group[:cap], bucket)
                     group = group[cap:]
                     did = True
-        decoding = [s for s in self.slots if s.state is SlotState.DECODE]
         if decoding:
             did = self._dispatch_decode(decoding) or did
         return did
@@ -1674,6 +1884,8 @@ class LLMEngine:
             fl = self._flights.popleft()
             if fl.kind == "prefill_final":
                 self._complete_prefill_final(fl)
+            elif fl.kind == "mixed":
+                self._complete_mixed(fl)
             else:
                 self._complete_decodek(fl)
             did = True
@@ -2070,6 +2282,8 @@ class LLMEngine:
         slot.t_start = now
         slot.t_first = 0.0
         slot.t_prefill_ms = 0.0
+        slot.t_prefill_enq_ms = 0.0
+        slot.t_prefill_t0 = 0.0
         slot.t_decode_ms = 0.0
         slot.constraint_state = (
             req.constraint.initial_state() if req.constraint else None
@@ -2118,7 +2332,16 @@ class LLMEngine:
         })
         slot.n_past += len(chunk)
         slot.cache_tokens.extend(chunk)
-        slot.t_prefill_ms += (time.perf_counter() - t0) * 1e3
+        if slot.t_prefill_t0 == 0.0:
+            slot.t_prefill_t0 = t0
+        # _run only ENQUEUES: charging its wall time to t_prefill_ms
+        # made chunked prompts report near-zero prompt processing.
+        # Device time is attributed at harvest of the covering flight
+        # (_complete_prefill_final / _complete_mixed); the host-side
+        # enqueue cost is tracked as its own phase component.
+        slot.t_prefill_enq_ms += (time.perf_counter() - t0) * 1e3
+        tm.ENGINE_MIXED_DISPATCH.labels(
+            model=self._mlabel, composition="prefill_only").inc()
 
     @property
     def _group_cap(self) -> int:
@@ -2314,13 +2537,19 @@ class LLMEngine:
         except Exception:
             pass  # not all backends expose it; harvest still works
         t_disp = time.perf_counter()
+        enq_ms = (t_disp - t0) * 1e3
         for s in group:
             req = s.request
             chunk_len = len(req.prompt_ids) - s.n_past
             s.cache_tokens.extend(req.prompt_ids[s.n_past:])
             s.n_past += chunk_len
             s.state = SlotState.PENDING_FIRST
+            if s.t_prefill_t0 == 0.0:
+                s.t_prefill_t0 = t0
+            s.t_prefill_enq_ms += enq_ms
             TRACER.event(req.id, "prefill_dispatch", t=t_disp)
+        tm.ENGINE_MIXED_DISPATCH.labels(
+            model=self._mlabel, composition="prefill_only").inc()
         self._flights.append(_Flight(
             kind="prefill_final", arrays=[toks_out],
             meta={"pairs": [(s, s.request) for s in group], "rows": rows},
@@ -2331,14 +2560,18 @@ class LLMEngine:
         """Harvest a prefill flight: emit each slot's first token and
         move it into the decode set."""
         toks_host = np.asarray(fl.arrays[0])
-        dt_ms = (time.perf_counter() - fl.t_enqueue) * 1e3
         now = time.perf_counter()
         rows = fl.meta.get("rows") or range(len(fl.meta["pairs"]))
         prompt_toks = first_toks = 0
         for r, (s, req) in zip(rows, fl.meta["pairs"]):
             if s.request is not req:  # cancelled mid-flight
                 continue
-            s.t_prefill_ms += dt_ms
+            # device+queue prefill time from the slot's FIRST prefill
+            # dispatch (chunk dispatches have no flight of their own;
+            # device execution is serialized, so this flight's harvest
+            # bounds when every earlier chunk retired)
+            s.t_prefill_ms += (now - (s.t_prefill_t0
+                                      or fl.t_enqueue)) * 1e3
             self.metrics.prompt_tokens_processed += s.n_prompt
             # the Prometheus counter reports tokens that actually went
             # THROUGH prefill — reused (resident/copied/restored)
@@ -2358,6 +2591,198 @@ class LLMEngine:
         if first_toks:
             tm.ENGINE_GENERATED_TOKENS.labels(model=self._mlabel).inc(
                 first_toks)
+
+    def _enqueue_mixed(self, prefilling: list[_Slot],
+                       decoding: list[_Slot]) -> None:
+        """Enqueue ONE fused mixed prefill+decode step (_mixed_fn).
+
+        Budget policy: the dispatch is always [n_slots, bucket], so the
+        per-dispatch token budget (_PREFILL_GROUP_TOKENS) bounds the
+        bucket to _mixed_buckets. Decode rows ride every dispatch (one
+        token each — decode priority, so their inter-token gap is
+        bounded by one budget's worth of device work); the bucket then
+        grows just enough to cover the largest remaining prompt, capped
+        by the budget — rows whose remainder exceeds it take a
+        bucket-wide non-final chunk and continue next dispatch.
+
+        Prefill bookkeeping (n_past/cache_tokens) advances HERE, like
+        _enqueue_prefill_final: device execution order equals enqueue
+        order, so anything enqueued later (kvcopy from a same-wave
+        prefix sharer included) sees this chunk committed. Decode rows
+        advance at harvest (_complete_mixed), exactly like the decode
+        scan path."""
+        t0 = time.perf_counter()
+        S = self.n_slots
+        W = self.sampling.window
+        buckets = self._mixed_buckets
+        need = min(max(s.n_prompt - s.n_past for s in prefilling),
+                   buckets[-1])
+        bucket = next(b for b in buckets if b >= need)
+        toks = np.zeros((S, bucket), np.int32)
+        pos0 = np.zeros((S,), np.int32)
+        n_chunk = np.ones((S,), np.int32)
+        write_mask = np.zeros((S,), bool)
+        sample_sids = np.full((S,), S, np.int32)  # OOB sentinel
+        reset_sids = np.full((S,), S, np.int32)
+        prefill_sids = np.full((S,), S, np.int32)
+        tails = np.zeros((S, W), np.int32)
+        tail_lens = np.zeros((S,), np.int32)
+        rows: list[tuple] = []  # (role, slot, request, aux)
+        finals: list[_Slot] = []
+        chunk_tokens = 0
+        for s in decoding:
+            last_tok = (s.generated[-1] if s.generated
+                        else s.request.prompt_ids[-1])
+            toks[s.idx, 0] = last_tok
+            pos0[s.idx] = s.n_past
+            write_mask[s.idx] = True
+            sample_sids[s.idx] = s.idx
+            rows.append(("decode", s, s.request, last_tok))
+        for s in prefilling:
+            req = s.request
+            rem = s.n_prompt - s.n_past
+            chunk = req.prompt_ids[s.n_past: s.n_past + min(rem, bucket)]
+            toks[s.idx, : len(chunk)] = chunk
+            pos0[s.idx] = s.n_past
+            n_chunk[s.idx] = len(chunk)
+            write_mask[s.idx] = True
+            prefill_sids[s.idx] = s.idx
+            chunk_tokens += len(chunk)
+            if rem <= bucket:  # final chunk: reset+seed+sample ride
+                finals.append(s)
+                sample_sids[s.idx] = s.idx
+                reset_sids[s.idx] = s.idx
+                tail = req.prompt_ids[-W:]
+                tails[s.idx, : len(tail)] = tail
+                tail_lens[s.idx] = len(tail)
+                rows.append(("final", s, req, None))
+            else:
+                rows.append(("chunk", s, req, None))
+        # parked (FREE) rows keep the zero defaults: pos0 == 0 with
+        # write_mask False is a pure no-op — their resident prefixes
+        # survive untouched (no tail clamping, unlike the decode scan)
+        masks = self._constraint_mask_rows(self.slots)
+        need_w = max(int(pos0[i]) + int(n_chunk[i])
+                     for i in range(S) if write_mask[i]) + 1
+        window = self._window_bucket(need_w)
+        compiled = [k[1] for k in self._decode_k_fns
+                    if k[0] == "mixed" and window <= k[1]]
+        window = min(compiled) if compiled else self.max_seq
+        toks_out = self._run("mixed", {
+            "toks": toks, "pos0": pos0, "n_chunk": n_chunk,
+            "write_mask": write_mask, "sample_sids": sample_sids,
+            "reset_sids": reset_sids, "tails": tails,
+            "tail_lens": tail_lens, "masks": masks,
+            "reset": self._reset_columns(finals, S,
+                                         [s.idx for s in finals]),
+            "soft": self._soft_payload(prefilling, pos0, bucket,
+                                       [s.idx for s in prefilling]),
+            "prefill_sids": prefill_sids,
+            "window": window,
+        })
+        try:
+            toks_out.copy_to_host_async()
+        except Exception:
+            pass  # not all backends expose it; harvest still works
+        t_disp = time.perf_counter()
+        enq_ms = (t_disp - t0) * 1e3
+        for s in prefilling:
+            chunk_len = min(s.n_prompt - s.n_past, bucket)
+            s.cache_tokens.extend(
+                s.request.prompt_ids[s.n_past: s.n_past + chunk_len])
+            s.n_past += chunk_len
+            if s.t_prefill_t0 == 0.0:
+                s.t_prefill_t0 = t0
+            s.t_prefill_enq_ms += enq_ms
+        for s in finals:
+            s.state = SlotState.PENDING_FIRST
+            TRACER.event(s.request.id, "prefill_dispatch", t=t_disp)
+        tm.ENGINE_MIXED_DISPATCH.labels(
+            model=self._mlabel,
+            composition="mixed" if decoding else "prefill_only").inc()
+        if decoding:
+            self._note_decode_advance(t_disp)
+        self._flights.append(_Flight(
+            kind="mixed", arrays=[toks_out],
+            meta={"rows": rows, "chunk_tokens": chunk_tokens},
+            t_enqueue=t0,
+        ))
+
+    def _complete_mixed(self, fl: _Flight) -> None:
+        """Harvest a mixed flight: decode rows emit their sampled token
+        (and commit the consumed input token, like the scan harvest),
+        final-chunk rows emit their first token and join the decode
+        set, non-final chunk rows only collect prefill-time
+        attribution."""
+        toks_host = np.asarray(fl.arrays[0])  # [S]
+        now = time.perf_counter()
+        dt_ms = (now - fl.t_enqueue) * 1e3
+        decode_emitted = first_toks = prompt_toks = 0
+        for role, s, req, aux in fl.meta["rows"]:
+            if s.request is not req:  # cancelled mid-flight
+                continue
+            if role == "decode":
+                if s.state is not SlotState.DECODE:
+                    continue
+                s.cache_tokens.append(aux)
+                s.n_past += 1
+                s.t_decode_ms += dt_ms
+                decode_emitted += 1
+                self._emit_token(s, int(toks_host[s.idx]), defer=True)
+                if s.state is SlotState.DECODE:
+                    self._flush_emit(s)
+            elif role == "final":
+                s.t_prefill_ms += (now - (s.t_prefill_t0
+                                          or fl.t_enqueue)) * 1e3
+                self.metrics.prompt_tokens_processed += s.n_prompt
+                actual = max(0, s.n_prompt - s.n_reused)
+                self.metrics.prefill_tokens += actual
+                prompt_toks += actual
+                first_toks += 1
+                s.state = SlotState.DECODE
+                s.t_last = now
+                self._emit_token(s, int(toks_host[s.idx]))
+            # role == "chunk": bookkeeping advanced at enqueue; device
+            # time lands at the covering final's harvest (t_prefill_t0)
+        # decode rows advanced: any saved decodek device carry is stale
+        self._epoch += 1
+        m = self._mlabel
+        if prompt_toks:
+            tm.ENGINE_PROMPT_TOKENS.labels(model=m).inc(prompt_toks)
+        if decode_emitted + first_toks:
+            tm.ENGINE_GENERATED_TOKENS.labels(model=m).inc(
+                decode_emitted + first_toks)
+        if decode_emitted:
+            tm.ENGINE_INTER_TOKEN.labels(model=m).observe(dt_ms / 1e3)
+            self._note_tokens_per_second(decode_emitted, dt_ms / 1e3)
+        self.metrics.slots_busy = sum(1 for s in self.slots if s.active)
+
+    def _note_decode_advance(self, now: float) -> None:
+        """Stall accounting: observe the gap between consecutive
+        decode-advancing dispatches while >=1 slot decodes
+        (engine_decode_stall_seconds — the series the legacy holds
+        spiked and the mixed dispatcher bounds). _update_gauges resets
+        the clock whenever no slot is decoding."""
+        if self._last_decode_adv:
+            tm.ENGINE_DECODE_STALL.labels(model=self._mlabel).observe(
+                max(0.0, now - self._last_decode_adv))
+        self._last_decode_adv = now
+
+    _TPS_ALPHA = 0.3
+
+    def _note_tokens_per_second(self, emitted: int, dt_s: float) -> None:
+        """ONE EWMA for metrics.tokens_per_second across every decode
+        flavor (k-scan harvest, blocking single-step, speculative,
+        mixed). The previous per-site stores each stomped the value
+        with a single-dispatch instantaneous rate, so /backend/monitor
+        flapped between k-step and blocking-path numbers."""
+        if emitted <= 0 or dt_s <= 0:
+            return
+        inst = emitted / dt_s
+        cur = self.metrics.tokens_per_second
+        self.metrics.tokens_per_second = (
+            inst if cur <= 0.0
+            else (1.0 - self._TPS_ALPHA) * cur + self._TPS_ALPHA * inst)
 
     def _soft_payload(self, group: list[_Slot], pos0: Any,
                       bucket: int,
@@ -2510,44 +2935,58 @@ class LLMEngine:
             if not decoding:
                 return True
         now = time.perf_counter()
-        # a prefill flight serving MORE waiters than there are decoders
-        # counts as a burst even after the arrival window lapses: the
-        # flight's ~200ms round trip outlives the 0.15s freshness test,
-        # and a decode scan slipping into that gap queues ~450ms of
-        # device work between the flight and its harvest detection —
-        # measured r5: the 63-slot gathered group's observed latency
-        # went 497ms with scans trailing it vs 174ms clean. In steady
-        # state (decoders >> waiters) decode proceeds: holding every
-        # scan behind each lone arrival's prefill would halve
-        # throughput under continuous load.
         waiting = sum(1 for s in self.slots
                       if s.state in (SlotState.PREFILL,
                                      SlotState.PENDING_FIRST))
-        gathering = (
-            waiting > len(decoding)
-            and any(f.kind == "prefill_final" for f in self._flights))
-        burst = bool(self._pending) or now - self._last_arrival < 0.15
-        if gathering or (burst and any(not s.active
-                                       or s.state is SlotState.PREFILL
-                                       for s in self.slots)):
-            # an admission burst is landing (free slots await requests,
-            # or assigned slots await their prefill — a gathered group
-            # held behind an in-flight prefill counts: r5 flight traces
-            # showed a 23-slot group queueing behind 900 ms of decode
-            # scans that slipped in the moment every slot was assigned):
-            # hold decode enqueues so the burst's prefill groups
-            # pipeline back-to-back on the device instead of each
-            # queueing behind hundreds of ms of scan work — under a
-            # 64-stream HTTP wave this is the difference between ~0.4 s
-            # and ~1.7 s p50 TTFT. Bounded from the hold's START so a
-            # steady trickle cannot starve decode.
-            if self._hold_start == 0.0:
-                self._hold_start = now
-            if now - self._hold_start < 0.5:
-                time.sleep(1e-3)
+        if self._mixed:
+            if any(f.kind == "mixed" for f in self._flights):
+                # a mixed step's sampled tokens are still in flight:
+                # decode rows' next input tokens are unknown host-side,
+                # and a scan enqueued now would replay stale tokens
                 return False
         else:
-            self._hold_start = 0.0
+            # LEGACY-ONLY burst hold (LOCALAI_MIXED_DISPATCH=off). The
+            # mixed dispatcher replaces this prefill/decode mutual
+            # exclusion with fusion: decode rows advance INSIDE the
+            # wave's dispatches, so there is nothing to hold against.
+            #
+            # A prefill flight serving MORE waiters than there are
+            # decoders counts as a burst even after the arrival window
+            # lapses: the flight's ~200ms round trip outlives the 0.15s
+            # freshness test, and a decode scan slipping into that gap
+            # queues ~450ms of device work between the flight and its
+            # harvest detection — measured r5: the 63-slot gathered
+            # group's observed latency went 497ms with scans trailing
+            # it vs 174ms clean. In steady state (decoders >> waiters)
+            # decode proceeds: holding every scan behind each lone
+            # arrival's prefill would halve throughput under
+            # continuous load.
+            gathering = (
+                waiting > len(decoding)
+                and any(f.kind == "prefill_final" for f in self._flights))
+            burst = bool(self._pending) or now - self._last_arrival < 0.15
+            if gathering or (burst and any(not s.active
+                                           or s.state is SlotState.PREFILL
+                                           for s in self.slots)):
+                # an admission burst is landing (free slots await
+                # requests, or assigned slots await their prefill — a
+                # gathered group held behind an in-flight prefill
+                # counts: r5 flight traces showed a 23-slot group
+                # queueing behind 900 ms of decode scans that slipped
+                # in the moment every slot was assigned): hold decode
+                # enqueues so the burst's prefill groups pipeline
+                # back-to-back on the device instead of each queueing
+                # behind hundreds of ms of scan work — under a
+                # 64-stream HTTP wave this is the difference between
+                # ~0.4 s and ~1.7 s p50 TTFT. Bounded from the hold's
+                # START so a steady trickle cannot starve decode.
+                if self._hold_start == 0.0:
+                    self._hold_start = now
+                if now - self._hold_start < 0.5:
+                    time.sleep(1e-3)
+                    return False
+            else:
+                self._hold_start = 0.0
         dflights = [f for f in self._flights if f.kind == "decodek"]
         in_flight = sum(f.meta["k"] for f in dflights)
         k, room, need_tokens = self._multi_step_k(decoding)
@@ -2700,6 +3139,9 @@ class LLMEngine:
             },
             t_enqueue=time.perf_counter(),
         ))
+        tm.ENGINE_MIXED_DISPATCH.labels(
+            model=self._mlabel, composition="decode_only").inc()
+        self._note_decode_advance(time.perf_counter())
         return True
 
     def _complete_decodek(self, fl: _Flight) -> None:
@@ -2751,7 +3193,7 @@ class LLMEngine:
                 self._flush_emit(s)  # one event per slot per harvest
         self._harvest_last = next_last
         if dt_ms > 0 and emitted:
-            self.metrics.tokens_per_second = emitted / (dt_ms / 1e3)
+            self._note_tokens_per_second(emitted, dt_ms / 1e3)
             tm.ENGINE_GENERATED_TOKENS.labels(model=self._mlabel).inc(
                 emitted)
             tm.ENGINE_INTER_TOKEN.labels(model=self._mlabel).observe(
@@ -2790,9 +3232,12 @@ class LLMEngine:
             self._emit_token(s, int(toks_host[s.idx]))
         self._epoch += 1  # device carry (if any) is now stale
         if dt_ms > 0 and emitted:
-            self.metrics.tokens_per_second = emitted / (dt_ms / 1e3)
+            self._note_tokens_per_second(emitted, dt_ms / 1e3)
             tm.ENGINE_GENERATED_TOKENS.labels(model=self._mlabel).inc(
                 emitted)
+        tm.ENGINE_MIXED_DISPATCH.labels(
+            model=self._mlabel, composition="decode_only").inc()
+        self._note_decode_advance(t0)
         self.metrics.slots_busy = sum(1 for s in self.slots if s.active)
 
     # ---------------------------------------------------- token → stream
@@ -2908,6 +3353,7 @@ class LLMEngine:
             timing_token_generation_ms=dt_decode,
             timing_queue_ms=queue_ms,
             timing_first_token_ms=ttft_ms,
+            timing_prefill_enqueue_ms=slot.t_prefill_enq_ms,
         )
         if slot.out is not None:
             slot.out.put(ev)

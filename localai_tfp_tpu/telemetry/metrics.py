@@ -142,6 +142,21 @@ ENGINE_KV_RESIDENT_PREFIX = REGISTRY.gauge(
     "active) — the cross-slot cache's working set",
     labels=("model",),
 )
+# stall-free mixed prefill+decode dispatch (engine._enqueue_mixed)
+ENGINE_MIXED_DISPATCH = REGISTRY.counter(
+    "engine_mixed_dispatch_total",
+    "Engine-advancing device dispatches by composition (mixed = one "
+    "fused step advanced prefill chunks AND decode rows; "
+    "prefill_only/decode_only = the dispatch advanced a single phase)",
+    labels=("model", "composition"),
+)
+ENGINE_DECODE_STALL = REGISTRY.histogram(
+    "engine_decode_stall_seconds",
+    "Gap between consecutive decode-advancing dispatches while at "
+    "least one slot was decoding — the scheduler stall the mixed "
+    "dispatcher bounds by its token budget",
+    labels=("model",), buckets=_STEP_BUCKETS,
+)
 
 # ---------------------------------------------------------------- loader
 

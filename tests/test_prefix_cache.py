@@ -62,6 +62,14 @@ class RunSpy:
                 if int(sid) < self.eng.n_slots))
         elif kind == "prefill":
             self.prefill_tokens += payload["toks"].shape[1]
+        elif kind == "mixed":
+            # prefill rows of a fused mixed step carry real prompt
+            # chunk tokens too (decode/parked rows are excluded by the
+            # prefill_sids sentinel)
+            self.prefill_tokens += int(sum(
+                int(c) for sid, c in zip(payload["prefill_sids"],
+                                         payload["n_chunk"])
+                if int(sid) < self.eng.n_slots))
         elif kind == "kvcopy":
             self.copies.append(dict(payload))
         return self._orig(kind, payload)

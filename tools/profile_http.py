@@ -25,6 +25,20 @@ cache ON and OFF, reporting client TTFT, prefill tokens actually
 dispatched (counted at the dispatch layer), kvcopy count, and the
 telemetry counters cross-checked against the dispatch-level ground
 truth. ``--small`` runs the tiny CPU config (smoke).
+
+Mixed-dispatch scenario (stall-free prefill+decode fusion):
+
+  python tools/profile_http.py --mixed [--small] \
+      [--streams N] [--bursts K] [--burst-size B]
+
+drives N sustained decode streams and injects K admission bursts of B
+requests mid-stream, with the fused mixed dispatcher ON and OFF
+(LOCALAI_MIXED_DISPATCH) — the headline numbers for the scheduler's
+prefill/decode de-serialization: per-stream ITL p50/p95, the **max
+inter-token gap** any live stream saw while a burst was admitting
+(the legacy hold loops spike it to the prefill-group round trip), and
+burst TTFT p50 (must hold — the fused path keeps wave coalescing at
+dispatch granularity).
 """
 
 from __future__ import annotations
@@ -235,6 +249,156 @@ def shared_prefix_scenario(small: bool, n_req: int,
     eng.close()
 
 
+def mixed_scenario(small: bool, n_streams: int, n_bursts: int,
+                   burst_size: int) -> None:
+    """Sustained decode streams + admission bursts injected mid-stream,
+    fused mixed dispatch ON vs OFF. Reports per-stream inter-token
+    gaps (client-observed SSE event spacing — exactly the stall the
+    legacy prefill/decode mutual exclusion produced) and burst TTFT."""
+    from aiohttp import ClientSession, ClientTimeout, TCPConnector, web
+
+    from localai_tfp_tpu.server.app import build_app
+    from localai_tfp_tpu.telemetry.registry import REGISTRY
+
+    from tools.profile_ttft import build_engine
+
+    eng, tok, _, _ = build_engine(small)
+    if small:
+        n_streams = min(n_streams, max(1, eng.n_slots // 2))
+        burst_size = max(1, min(burst_size, eng.n_slots - n_streams))
+    stream_tokens = 150 if small else 192
+    burst_prompt_chars = 110 if small else 600
+    burst_gap_s = 0.25 if small else 0.5
+    app = build_app(_mk_state(eng, tok))
+    eng._prefix_enabled = False  # isolate scheduling from prefix reuse
+
+    async def drive():
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        url = f"http://127.0.0.1:{port}/v1/chat/completions"
+        out: dict = {}
+        async with ClientSession(
+            connector=TCPConnector(limit=0),
+            timeout=ClientTimeout(total=3600),
+        ) as sess:
+
+            async def sse_events(body, on_content):
+                async with sess.post(url, json=body) as r:
+                    assert r.status == 200, await r.text()
+                    async for line in r.content:
+                        if not line.startswith(b"data: "):
+                            continue
+                        if line.strip() == b"data: [DONE]":
+                            break
+                        d = json.loads(line[6:])
+                        ch = d["choices"][0]
+                        if ch["delta"].get("content"):
+                            on_content()
+                        if ch.get("finish_reason"):
+                            break
+
+            async def stream_one(i, tag, times, started):
+                body = {
+                    "model": "bench",
+                    "messages": [{"role": "user",
+                                  "content": f"sustained stream {tag} "
+                                             f"{i:02d}"}],
+                    "max_tokens": stream_tokens, "stream": True,
+                    "temperature": 0.0, "ignore_eos": True,
+                }
+
+                def on_content():
+                    times[i].append(time.perf_counter())
+                    started[i].set()
+
+                await sse_events(body, on_content)
+
+            async def burst_one(tag, j, ttfts, t0):
+                body = {
+                    "model": "bench",
+                    "messages": [{"role": "user",
+                                  "content": "B" * burst_prompt_chars
+                                             + f" {tag} {j:02d}"}],
+                    "max_tokens": 8, "stream": True,
+                    "temperature": 0.0, "ignore_eos": True,
+                }
+                got = []
+
+                def on_content():
+                    if not got:
+                        got.append(time.perf_counter() - t0)
+                        ttfts.append(got[0] * 1e3)
+
+                await sse_events(body, on_content)
+
+            async def run_once(tag):
+                times = [[] for _ in range(n_streams)]
+                started = [asyncio.Event() for _ in range(n_streams)]
+                burst_ttfts: list[float] = []
+                streams = [asyncio.ensure_future(
+                    stream_one(i, tag, times, started))
+                    for i in range(n_streams)]
+                await asyncio.gather(*[e.wait() for e in started])
+                burst_tasks = []
+                for k in range(n_bursts):
+                    t0 = time.perf_counter()
+                    burst_tasks += [asyncio.ensure_future(
+                        burst_one(f"{tag}-{k}", j, burst_ttfts, t0))
+                        for j in range(burst_size)]
+                    await asyncio.sleep(burst_gap_s)
+                await asyncio.gather(*streams, *burst_tasks)
+                return times, burst_ttfts
+
+            for mode in ("off", "on"):
+                eng._mixed = (mode == "on")
+                await run_once(f"warm-{mode}")  # untimed: compiles
+                snap = REGISTRY.snapshot()
+                times, burst_ttfts = await run_once(f"run-{mode}")
+                delta = REGISTRY.delta(snap)
+                gaps, max_gaps = [], []
+                for ts in times:
+                    g = [(b - a) * 1e3 for a, b in zip(ts, ts[1:])]
+                    if g:
+                        gaps += g
+                        max_gaps.append(max(g))
+                out[mode] = {
+                    "itl_p50_ms": pct(gaps, .5),
+                    "itl_p95_ms": pct(gaps, .95),
+                    "max_gap_p50_ms": pct(max_gaps, .5),
+                    "max_gap_max_ms": pct(max_gaps, 1.0),
+                    "burst_ttft_p50_ms": pct(burst_ttfts, .5),
+                    "burst_ttft_p95_ms": pct(burst_ttfts, .95),
+                    "mixed_dispatches": int(sum(
+                        v for k, v in delta.items()
+                        if k.startswith("engine_mixed_dispatch_total")
+                        and 'composition="mixed"' in k)),
+                }
+        on, off = out["on"], out["off"]
+        out["summary"] = {
+            "streams": n_streams, "bursts": n_bursts,
+            "burst_size": burst_size,
+            "max_gap_reduction_ms": round(
+                off["max_gap_max_ms"] - on["max_gap_max_ms"], 1),
+            "itl_p95_reduction_ms": round(
+                off["itl_p95_ms"] - on["itl_p95_ms"], 1),
+            "burst_ttft_ratio_on_vs_off": round(
+                on["burst_ttft_p50_ms"] / off["burst_ttft_p50_ms"], 3)
+            if off["burst_ttft_p50_ms"] else None,
+        }
+        return out
+
+    loop = asyncio.new_event_loop()
+    try:
+        report = loop.run_until_complete(drive())
+    finally:
+        loop.close()
+    print(json.dumps(report, indent=1), flush=True)
+    eng.close()
+
+
 def main() -> None:
     from tools.profile_ttft import build_engine
 
@@ -379,10 +543,19 @@ if __name__ == "__main__":
     ap.add_argument("--shared-prefix", action="store_true",
                     help="shared-system-prompt burst scenario "
                          "(prefix cache on vs off)")
+    ap.add_argument("--mixed", action="store_true",
+                    help="sustained decode + admission bursts, fused "
+                         "mixed dispatch on vs off")
     ap.add_argument("--small", action="store_true",
                     help="tiny CPU config (smoke) instead of 8B")
     ap.add_argument("--requests", type=int, default=64)
     ap.add_argument("--prefix-tokens", type=int, default=512)
+    ap.add_argument("--streams", type=int, default=48,
+                    help="--mixed: sustained decode streams")
+    ap.add_argument("--bursts", type=int, default=3,
+                    help="--mixed: admission bursts injected mid-stream")
+    ap.add_argument("--burst-size", type=int, default=16,
+                    help="--mixed: requests per burst")
     args = ap.parse_args()
     jax.config.update("jax_compilation_cache_dir",
                       "/root/.cache/localai_xla")
@@ -390,5 +563,8 @@ if __name__ == "__main__":
     if args.shared_prefix:
         shared_prefix_scenario(args.small, args.requests,
                                args.prefix_tokens)
+    elif args.mixed:
+        mixed_scenario(args.small, args.streams, args.bursts,
+                       args.burst_size)
     else:
         main()
