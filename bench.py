@@ -126,6 +126,48 @@ def _prefix_cache_extra(eng) -> dict:
     }
 
 
+def _paged_kv_extra(eng) -> dict:
+    """Paged KV pool effectiveness (extra.paged_kv): arena occupancy,
+    zero-copy sharing, HBM-per-live-token, and the headline capacity
+    ratio — how many slots this pool's HBM would hold under the dense
+    worst-case-per-slot layout vs how many it actually serves. A
+    ``slot_capacity_multiple`` of 2.0 means the same HBM budget seats
+    2x the residents because pages track EXPECTED context."""
+    if not getattr(eng, "_paged", False):
+        return {"enabled": False}
+    st = eng._pool.stats()
+    c = eng.cache
+    tok_bytes = 2 * c.k.dtype.itemsize * c.k.shape[0] * c.k.shape[-1]
+    if c.quantized:
+        tok_bytes += 2 * 4 * c.k.shape[0]
+    live = sum(len(s.cache_tokens) for s in eng.slots)
+    dense_equiv = (st.total * eng._page) // eng.max_seq
+    return {
+        "enabled": True,
+        "page_tokens": eng._page,
+        "pool_pages": st.total,
+        "pages_in_use": st.in_use,
+        "pages_shared": st.shared,
+        "page_refs": st.refs,
+        "alloc": dict(eng._pool.allocs),
+        "live_tokens": live,
+        "hbm_bytes_per_live_token": round(
+            st.in_use * eng._page * tok_bytes / max(live, 1), 1),
+        "n_slots": eng.n_slots,
+        "slots_dense_equivalent": dense_equiv,
+        "slot_capacity_multiple": round(
+            eng.n_slots / max(dense_equiv, 1), 2),
+    }
+
+
+# extras that measure the LIVE serving engine: _bench_http's teardown
+# (runner.cleanup()) fires the app cleanup that CLOSES it, so these must
+# be recorded first. _bench_http enforces the order (it was a
+# comment-only gotcha through PR 4; measuring a closed engine reports
+# garbage silently).
+_LIVE_ENGINE_EXTRAS = ("mixed_itl", "paged_kv")
+
+
 def _mixed_itl_extra(eng, tok, n_tok=96) -> dict:
     """ITL under admission pressure (extra.mixed_itl): sustain decode
     streams on half the slots, inject an admission burst mid-stream,
@@ -222,14 +264,28 @@ def _mixed_itl_extra(eng, tok, n_tok=96) -> dict:
     }
 
 
-def _bench_http(state, model, n_req, n_tok, runs=2):
+def _bench_http(state, model, n_req, n_tok, runs=2, extra=None):
     """Endpoint-level benchmark: boot the REAL aiohttp server (routes,
     middleware, SSE writer) over the given Application (whose loader
     already serves ``model``) and drive ``n_req`` concurrent streaming
     /v1/chat/completions clients through localhost TCP. Returns (decode
     tok/s, ttft p50 ms, ttft p95 ms, steady p50 ms) as a stock OpenAI
     client would observe them (BASELINE.md: the north star is measured
-    "via stock /v1/chat/completions")."""
+    "via stock /v1/chat/completions").
+
+    Pass the bench's ``extra`` dict so the live-engine ordering guard
+    can verify every _LIVE_ENGINE_EXTRAS block was measured BEFORE this
+    call — teardown closes the serving engine, so anything measured
+    after it reads a dead engine."""
+    if extra is not None:
+        missing = [k for k in _LIVE_ENGINE_EXTRAS if k not in extra]
+        if missing:
+            raise RuntimeError(
+                f"bench ordering violated: extra[{missing!r}] must be "
+                "measured before _bench_http — its teardown "
+                "(runner.cleanup()) fires the app cleanup that closes "
+                "the serving engine, so live-engine extras measured "
+                "after this point would silently read a dead engine")
     import asyncio
     import json as _json
 
@@ -654,9 +710,17 @@ def main() -> None:
         n_slots, max_seq, gen_tokens = 64, 2048, 512
         extra["n_slots_1b"] = n_slots
         params = init_params(jax.random.PRNGKey(0), spec)
+        # paged KV pool at HALF the dense worst case: every bench slot
+        # peaks near prompt(~130) + 512 generated ~= 650 tokens (3 of 8
+        # logical 256-token pages), so a pool of n_slots*max_pages/2
+        # data pages seats the same 64 slots in the HBM a dense cache
+        # would spend on 32 — the >=2x slot_capacity_multiple
+        # extra.paged_kv reports, with zero admission failures
+        kv_pages = n_slots * (max_seq // 256) // 2 + 1
         eng = LLMEngine(
             spec, params, tok, n_slots=n_slots, max_seq=max_seq,
             decode_steps=64, cache_dtype=jnp.bfloat16, autostart=False,
+            kv_pages=kv_pages,
         )
         eng.start()
         eng.warmup()
@@ -679,6 +743,10 @@ def main() -> None:
         singles.sort()
         extra["ttft_ms_1b_single"] = round(singles[len(singles) // 2], 1)
         extra["prefix_cache_1b"] = _prefix_cache_extra(eng)
+        # the driver-tracked paged-KV capacity block: THIS leg runs the
+        # half-worst-case pool, so slot_capacity_multiple shows the 2x
+        # residency the paged arena buys at fixed HBM
+        extra["paged_kv"] = _paged_kv_extra(eng)
         eng.close()
         del params, eng
         # release the 1B leg's HBM (params + KV cache + jit executables
@@ -803,11 +871,16 @@ def main() -> None:
             extra["decode_tok_s_8b_engine"] = tok_s8
             extra["ttft_p50_ms_8b_engine"] = p50_8
             extra["ttft_p95_ms_8b_engine"] = p95_8
-            # live-engine measurement: must precede _bench_http (its
+            # live-engine measurements: _bench_http's guard enforces
+            # that every _LIVE_ENGINE_EXTRAS block precedes it (its
             # teardown closes the serving engine via app cleanup)
             extra["mixed_itl"] = _mixed_itl_extra(eng8, tok8)
+            # 8B pool is default-sized (worst case — the YAML config
+            # sets no kv_pages), so this block tracks occupancy and
+            # sharing; the capacity multiple lives in extra.paged_kv
+            extra["paged_kv_8b"] = _paged_kv_extra(eng8)
             tok_s, p50_h, p95_h, p50_steady = _bench_http(
-                state, "bench8b", 64, 512, runs=2)
+                state, "bench8b", 64, 512, runs=2, extra=extra)
             extra["ttft_p50_ms_8b_http"] = p50_h
             extra["ttft_p95_ms_8b_http"] = p95_h
             extra["ttft_p50_ms_8b_http_steady"] = p50_steady
@@ -833,9 +906,11 @@ def main() -> None:
         eng.start()
         tok_s_eng, p50, p95 = _bench_config(eng, tok, 4, 32, runs=1)
         extra["decode_tok_s_engine"] = tok_s_eng
-        # live-engine measurement: must precede _bench_http (its
-        # teardown closes the serving engine via app cleanup)
+        # live-engine measurements: _bench_http's guard enforces that
+        # every _LIVE_ENGINE_EXTRAS block precedes it (its teardown
+        # closes the serving engine via app cleanup)
         extra["mixed_itl"] = _mixed_itl_extra(eng, tok)
+        extra["paged_kv"] = _paged_kv_extra(eng)
         # smoke HTTP leg: a minimal Application with the in-memory
         # engine registered (the TPU leg exercises the full disk-loader
         # path; here the endpoint plumbing is what's smoke-tested)
@@ -873,7 +948,7 @@ def main() -> None:
             state.model_loader._models["bench"] = LoadedModel(
                 "bench", "jax-llm", backend)
             tok_s, p50_h, _, _ = _bench_http(state, "bench", 4, 32,
-                                             runs=1)
+                                             runs=1, extra=extra)
             extra["prefix_cache"] = _prefix_cache_extra(eng)
             eng.close()
         finally:

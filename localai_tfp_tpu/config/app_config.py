@@ -29,6 +29,8 @@ class ApplicationConfig:
     generated_content_dir: str = "generated_content"
     upload_dir: str = "uploads"
     config_dir: str = "configuration"
+    state_dir: str = "run"  # runtime state (server.pid) — NOT the CWD,
+    # which an unclean exit would litter with stray pid files
     address: str = "0.0.0.0"
     port: int = 8080
     api_keys: list[str] = field(default_factory=list)
@@ -65,6 +67,7 @@ class ApplicationConfig:
     def from_env(cls) -> "ApplicationConfig":
         cfg = cls()
         cfg.models_path = _env("MODELS_PATH", cfg.models_path)
+        cfg.state_dir = _env("STATE_DIR", cfg.state_dir)
         cfg.address = _env("ADDRESS", cfg.address)
         port = _env("PORT", None)
         if port is not None:
@@ -126,5 +129,6 @@ class ApplicationConfig:
             self.generated_content_dir,
             self.upload_dir,
             self.config_dir,
+            self.state_dir,
         ):
             Path(d).mkdir(parents=True, exist_ok=True)

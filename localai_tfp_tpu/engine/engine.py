@@ -57,12 +57,16 @@ import numpy as np
 from jax import lax
 
 from ..models.llm_spec import LLMSpec
-from ..models.transformer import KVCache, Params, forward, forward_hidden
+from ..models.transformer import (
+    KVCache, Params, forward, forward_hidden, gather_kv_pages,
+    scatter_kv_pages,
+)
 from ..ops.sampling import (
     SamplingState, observe_tokens, sample, seed_windows,
 )
 from ..telemetry import metrics as tm
 from ..telemetry.tracing import TRACER
+from .kv_pool import TRASH_PAGE, PagePool, PagePoolExhausted
 from .prefix_index import PrefixIndex, common_prefix_len
 from .tokenizer import StreamDecoder, Tokenizer
 
@@ -368,6 +372,12 @@ class LLMEngine:
         # None = balanced (scans stay long enough to cover the dispatch
         # RTT; see _latency_k)
         autostart: bool = True,
+        kv_pages: Optional[int] = None,  # paged KV pool size (data
+        # pages). None: LOCALAI_KV_PAGES env, else full worst-case
+        # capacity (n_slots * max_seq / page — no memory saving, no
+        # admission failure). Sizing it below worst case is the paged
+        # pool's point: HBM follows EXPECTED context, so n_slots can
+        # grow past what a dense cache of the same budget allows.
         channel: Any = None,  # multihost dispatch publisher (leader side);
         # every device dispatch is published as a (kind, payload) record
         # before executing so follower hosts replay the identical SPMD
@@ -399,11 +409,59 @@ class LLMEngine:
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= max_seq
         ) or (max_seq,)
-        self.cache = KVCache.create(spec, n_slots, max_seq, cache_dtype)
-        self.draft_cache = (
-            KVCache.create(draft[0], n_slots, max_seq, cache_dtype)
-            if draft is not None else None
-        )
+        import os as _os
+
+        # Paged KV pool (engine/kv_pool.py + models/transformer.py
+        # gather/scatter views): one [L, n_pages, page, F] arena backs
+        # every slot through host-owned page tables, so HBM scales with
+        # live tokens and prefix pages share by reference. Dispatches
+        # carry the tables as plain index arrays (multihost-replayable).
+        # LOCALAI_PAGED_KV=off restores the dense per-slot cache;
+        # meshed serving always takes the dense path (the arena cannot
+        # be GSPMD-sharded by slot).
+        self._paged = mesh is None and _os.environ.get(
+            "LOCALAI_PAGED_KV", "on").lower() not in ("0", "off", "false")
+        # page size: largest power of two <= min(256, max_seq) dividing
+        # max_seq, so every window bucket (powers of two >= 256, capped
+        # at max_seq) is page-aligned; LOCALAI_KV_PAGE overrides within
+        # the same constraints. 256 matches the fused decode kernel's
+        # native DMA granularity.
+        page_cap = min(256, max_seq)
+        pg = 1
+        while pg * 2 <= page_cap and max_seq % (pg * 2) == 0:
+            pg *= 2
+        want_pg = int(_os.environ.get("LOCALAI_KV_PAGE", "0") or 0)
+        if (want_pg >= 8 and want_pg <= page_cap
+                and max_seq % want_pg == 0
+                and want_pg & (want_pg - 1) == 0):
+            pg = want_pg
+        self._page = pg
+        if pg < 8:  # degenerate geometry (tiny/odd max_seq): dense
+            self._paged = False
+        if self._paged:
+            self._max_pages = max_seq // pg  # logical pages per slot
+            pages_default = n_slots * self._max_pages + 1  # + trash
+            self.kv_pages = max(2, int(
+                kv_pages or _os.environ.get("LOCALAI_KV_PAGES", 0)
+                or pages_default))
+            self._pool = PagePool(self.kv_pages, pg)
+            self.cache = KVCache.create(spec, self.kv_pages, pg,
+                                        cache_dtype)
+            self.draft_cache = (
+                KVCache.create(draft[0], self.kv_pages, pg, cache_dtype)
+                if draft is not None else None
+            )
+        else:
+            self.kv_pages = 0
+            self._pool = None
+            self.cache = KVCache.create(spec, n_slots, max_seq,
+                                        cache_dtype)
+            self.draft_cache = (
+                KVCache.create(draft[0], n_slots, max_seq, cache_dtype)
+                if draft is not None else None
+            )
+        self._alloc_sync: dict[str, int] = {}  # pool alloc counters
+        # already exported to engine_kv_page_alloc_total
         self.sampling = SamplingState.create(
             n_slots, spec.vocab_size, window=penalty_window
         )
@@ -467,19 +525,44 @@ class LLMEngine:
         self.metrics = EngineMetrics()
         self._all_slot_ids = jnp.arange(n_slots, dtype=jnp.int32)
 
-        @partial(jax.jit, donate_argnums=(2, 5))
-        def _decode(params, tokens, cache, pos0, slot_ids, sampling,
-                    active, masks):
-            # slot_ids=None: decode batches every cache row in order, so the
-            # KV write is a per-row DUS, not a cache-sized scatter
-            logits, cache = forward(
-                spec, params, tokens, pos0, cache, None, self._use_kernel,
-                mesh=self.mesh,
-            )
-            last = logits[:, -1, :]
-            toks, sampling = _sample_masked(sampling, slot_ids, last,
-                                            active, masks)
-            return toks, cache, sampling
+        if self._paged:
+            _page = self._page
+
+            @partial(jax.jit, donate_argnums=(2, 5))
+            def _decode(params, tokens, cache, pos0, slot_ids, sampling,
+                        active, masks, phys, wb):
+                if self._use_kernel:
+                    # arena + page table straight into the fused kernel
+                    # (the append routes through the table in-graph)
+                    logits, cache = forward(
+                        spec, params, tokens, pos0, cache, None, True,
+                        page_table=phys, kv_page=_page,
+                    )
+                else:
+                    win = gather_kv_pages(cache, phys, _page)
+                    logits, win = forward(
+                        spec, params, tokens, pos0, win, None, False,
+                    )
+                    cache = scatter_kv_pages(cache, win, wb, _page)
+                last = logits[:, -1, :]
+                toks, sampling = _sample_masked(sampling, slot_ids, last,
+                                                active, masks)
+                return toks, cache, sampling
+        else:
+            @partial(jax.jit, donate_argnums=(2, 5))
+            def _decode(params, tokens, cache, pos0, slot_ids, sampling,
+                        active, masks):
+                # slot_ids=None: decode batches every cache row in order,
+                # so the KV write is a per-row DUS, not a cache-sized
+                # scatter
+                logits, cache = forward(
+                    spec, params, tokens, pos0, cache, None,
+                    self._use_kernel, mesh=self.mesh,
+                )
+                last = logits[:, -1, :]
+                toks, sampling = _sample_masked(sampling, slot_ids, last,
+                                                active, masks)
+                return toks, cache, sampling
 
         @jax.jit
         def _sample_only(sampling, slot_ids, logits, masks):
@@ -568,7 +651,10 @@ class LLMEngine:
                 return False
         return (
             (forced or not _interpret())
-            and self.max_seq % PAGE == 0
+            # paged arenas DMA whole pool pages (page-table lookups), so
+            # the pool's own divisibility guarantee replaces the dense
+            # kernel's max_seq % PAGE requirement
+            and (self.max_seq % PAGE == 0 if not self._paged else True)
             and self.spec.kv_dim % 128 == 0
             and not self.spec.attn_logit_softcap
             # conditions forward_hidden ALSO gates on — if they disagree
@@ -577,6 +663,99 @@ class LLMEngine:
             # kernel reads int8 pages + per-row scales directly)
             and _layer_windows(self.spec) is None
         )
+
+    # ------------------------------------------- paged KV pool (host side)
+
+    def _phys_rows(self, slot_rows: list, window: int) -> np.ndarray:
+        """Per-batch-row physical page tables [B, window//page] for a
+        dispatch payload (plain int32 — multihost followers replay it
+        like any scalar). ``slot_rows`` maps batch row -> slot index;
+        None rows and entries beyond a slot's allocation point at the
+        trash page, whose garbage reads are causally masked."""
+        wp = window // self._page
+        out = np.full((len(slot_rows), wp), TRASH_PAGE, np.int32)
+        for r, si in enumerate(slot_rows):
+            if si is None:
+                continue
+            t = self._pool.table(si)
+            n = min(len(t), wp)
+            if n:
+                out[r, :n] = t[:n]
+        return out
+
+    def _wb_rows(self, entries: list, window: int) -> np.ndarray:
+        """Write-back page tables [B, window//page]: the physical page
+        for every window page intersecting the row's write span, trash
+        everywhere else — so a dispatch persists exactly its own writes
+        and can never touch a shared (refcount > 1) prefix page or a
+        parked row's resident pages. ``entries``: (slot index | None,
+        (start, end) token span | None) per batch row."""
+        wp = window // self._page
+        P = self._page
+        out = np.full((len(entries), wp), TRASH_PAGE, np.int32)
+        for r, (si, span) in enumerate(entries):
+            if si is None or span is None:
+                continue
+            start, end = span
+            if end <= start:
+                continue
+            t = self._pool.table(si)
+            for p in range(start // P, min(-(-end // P), wp)):
+                if p >= len(t) or not self._pool.writable(t[p]):
+                    raise RuntimeError(
+                        f"paged KV: slot {si} write span page {p} is not "
+                        "privately writable — allocator invariant broken")
+                out[r, p] = t[p]
+        return out
+
+    def _pool_ensure(self, slot: "_Slot", n_tokens: int) -> bool:
+        """Grow the slot's page table to cover ``n_tokens`` positions,
+        reclaiming free slots' resident prefixes (least valuable first,
+        prefix_index LRU x length) under pool pressure. False = the
+        arena is genuinely full of ACTIVE state; the caller ends or
+        requeues the work."""
+        try:
+            self._pool.ensure(slot.idx, n_tokens)
+            return True
+        except PagePoolExhausted:
+            pass
+        now = time.monotonic()
+        victims = sorted(
+            (s for s in self.slots
+             if not s.active and s is not slot
+             and self._pool.held(s.idx)),
+            key=lambda s: self._prefix_index.value(s.idx, now))
+        for v in victims:
+            self._pool.drop(v.idx)
+            v.cache_tokens = []
+            v.n_past = 0
+            self._prefix_index.remove(v.idx)
+            tm.ENGINE_KV_PAGE_ALLOC.labels(
+                model=self._mlabel, outcome="reclaimed").inc()
+            try:
+                self._pool.ensure(slot.idx, n_tokens)
+                return True
+            except PagePoolExhausted:
+                continue
+        tm.ENGINE_KV_PAGE_ALLOC.labels(
+            model=self._mlabel, outcome="exhausted").inc()
+        log.warning("KV page pool exhausted: slot %d needs %d tokens",
+                    slot.idx, n_tokens)
+        return False
+
+    def _page_headroom(self, req: GenRequest) -> bool:
+        """Admission gate: worst-case pages for the prompt must fit in
+        free + reclaimable (free slots' private pages) capacity, or the
+        request waits in the queue instead of thrashing an admit/finish
+        cycle. Soft check — dispatch-time _pool_ensure is the backstop."""
+        st = self._pool.stats()
+        need = self._pool.pages_for(len(req.prompt_ids) + 1)
+        if st.free >= need:
+            return True
+        reclaim = sum(
+            1 for s in self.slots if not s.active
+            for p in self._pool.table(s.idx) if self._pool.writable(p))
+        return st.free + reclaim >= need
 
     def _spec_decode_fn(self, kd: int, rounds: int):
         """Jitted speculative decoding: ``rounds`` iterations of
@@ -594,9 +773,21 @@ class LLMEngine:
             return fn
         spec = self.spec
         dspec = self.draft[0]  # static; draft params passed per call
+        paged = self._paged
+        page = self._page
 
         @partial(jax.jit, donate_argnums=(2, 3))
-        def _spec(params, dparams, cache, dcache, tokens, pos0, active):
+        def _spec(params, dparams, cache, dcache, tokens, pos0, active,
+                  *paged_tables):
+            if paged:
+                # full-width gathered views for both caches; the arena
+                # writeback at the end persists only the eligible rows'
+                # verify/draft spans (wb)
+                arena, darena = cache, dcache
+                phys, wb = paged_tables
+                cache = gather_kv_pages(arena, phys, page)
+                dcache = gather_kv_pages(darena, phys, page)
+
             def round_(carry, _):
                 tok, pos, cache, dcache = carry
 
@@ -628,6 +819,9 @@ class LLMEngine:
 
             (tok_f, pos_f, cache, dcache), (D, Mt, J) = lax.scan(
                 round_, (tokens, pos0, cache, dcache), None, length=rounds)
+            if paged:
+                cache = scatter_kv_pages(arena, cache, wb, page)
+                dcache = scatter_kv_pages(darena, dcache, wb, page)
             return D, Mt, J, tok_f, pos_f, cache, dcache
 
         self._decode_k_fns[key] = _spec
@@ -668,9 +862,17 @@ class LLMEngine:
             )(keys, logp)
             return jnp.argmax(logp + g, axis=-1)
 
+        paged = self._paged
+        page = self._page
+
         @partial(jax.jit, donate_argnums=(3, 4))
         def _spec_s(params, dparams, sampling, cache, dcache, tokens, pos0,
-                    active):
+                    active, *paged_tables):
+            if paged:
+                arena, darena = cache, dcache
+                phys, wb = paged_tables
+                cache = gather_kv_pages(arena, phys, page)
+                dcache = gather_kv_pages(darena, phys, page)
             all_slots = jnp.arange(S, dtype=jnp.int32)
             rep_slots = jnp.repeat(all_slots, kd)
 
@@ -742,6 +944,9 @@ class LLMEngine:
             (_, _, cache, dcache, rng), (D, Fin, J) = lax.scan(
                 round_, (tokens, pos0, cache, dcache, sampling.rng),
                 None, length=rounds)
+            if paged:
+                cache = scatter_kv_pages(arena, cache, wb, page)
+                dcache = scatter_kv_pages(darena, dcache, wb, page)
             return D, Fin, J, rng, cache, dcache
 
         self._decode_k_fns[key] = _spec_s
@@ -761,17 +966,35 @@ class LLMEngine:
         spec = self.spec
         mesh = self.mesh
 
-        @partial(jax.jit, donate_argnums=(2,))
-        def _prefill(params, tokens, cache, pos0, slot_ids, soft=None):
-            # non-final chunk: only the K/V writes matter — materializing
-            # [B, T, V] logits would waste bucket*V f32 of HBM per row
-            if soft is not None:
-                soft = _soft_expand(tokens, *soft)
-            win, restore = _window_cache(cache, window)
-            _, win = forward_hidden(spec, params, tokens, pos0, win,
-                                    slot_ids, soft=soft, mesh=mesh,
-                                    ring_prefill=ring)
-            return restore(win)
+        if self._paged:
+            page = self._page
+
+            @partial(jax.jit, donate_argnums=(2,))
+            def _prefill(params, tokens, cache, pos0, slot_ids, phys, wb,
+                         soft=None):
+                # paged: the gathered view holds only this dispatch's
+                # rows (identity layout), so the slot mapping lives in
+                # phys/wb instead of slot_ids
+                if soft is not None:
+                    soft = _soft_expand(tokens, *soft)
+                win = gather_kv_pages(cache, phys, page)
+                _, win = forward_hidden(spec, params, tokens, pos0, win,
+                                        None, soft=soft)
+                return scatter_kv_pages(cache, win, wb, page)
+        else:
+            @partial(jax.jit, donate_argnums=(2,))
+            def _prefill(params, tokens, cache, pos0, slot_ids,
+                         soft=None):
+                # non-final chunk: only the K/V writes matter —
+                # materializing [B, T, V] logits would waste bucket*V
+                # f32 of HBM per row
+                if soft is not None:
+                    soft = _soft_expand(tokens, *soft)
+                win, restore = _window_cache(cache, window)
+                _, win = forward_hidden(spec, params, tokens, pos0, win,
+                                        slot_ids, soft=soft, mesh=mesh,
+                                        ring_prefill=ring)
+                return restore(win)
 
         self._decode_k_fns[key] = _prefill
         return _prefill
@@ -801,23 +1024,35 @@ class LLMEngine:
             return fn
         spec = self.spec
         n_slots = self.n_slots
+        paged = self._paged
+        page = self._page
 
         @partial(jax.jit, donate_argnums=(2, 4))
         def _prefill_final(params, tokens, cache, pos0, sampling, slot_ids,
                            n_chunk, tails, tail_lens, masks, reset,
-                           soft=None):
+                           *paged_tables, soft=None):
             if soft is not None:
                 soft = _soft_expand(tokens, *soft)
-            win, restore = _window_cache(cache, window)
-            hidden, win = forward_hidden(
-                spec, params, tokens, pos0, win,
-                None if identity else slot_ids, soft=soft,
-                # identity parks non-members at pos 0 with a no-op
-                # write, so the window can track the MEMBERS' live
-                # context instead of max_seq
-                write_mask=(slot_ids < n_slots) if identity else None,
-            )
-            cache = restore(win)
+            if paged:
+                # paged: rows map to slots via phys/wb; parked and pad
+                # rows simply never write back (their wb pages are
+                # trash), so no write_mask is needed
+                phys, wb = paged_tables
+                win = gather_kv_pages(cache, phys, page)
+                hidden, win = forward_hidden(
+                    spec, params, tokens, pos0, win, None, soft=soft)
+                cache = scatter_kv_pages(cache, win, wb, page)
+            else:
+                win, restore = _window_cache(cache, window)
+                hidden, win = forward_hidden(
+                    spec, params, tokens, pos0, win,
+                    None if identity else slot_ids, soft=soft,
+                    # identity parks non-members at pos 0 with a no-op
+                    # write, so the window can track the MEMBERS' live
+                    # context instead of max_seq
+                    write_mask=(slot_ids < n_slots) if identity else None,
+                )
+                cache = restore(win)
             # sampler reset rides THIS dispatch (admission used to pay a
             # separate reset_batch round trip before the prefill — one
             # full tunnel RTT off TTFT for singles and waves alike)
@@ -879,19 +1114,31 @@ class LLMEngine:
         if fn is not None:
             return fn
         spec = self.spec
+        paged = self._paged
+        page = self._page
 
         @partial(jax.jit, donate_argnums=(2, 4))
         def _mixed(params, tokens, cache, pos0, sampling, write_mask,
                    n_chunk, sample_sids, reset_sids, tails, tail_lens,
-                   masks, reset, soft=None):
+                   masks, reset, *paged_tables, soft=None):
             if soft is not None:
                 soft = _soft_expand(tokens, *soft)
-            win, restore = _window_cache(cache, window)
-            hidden, win = forward_hidden(
-                spec, params, tokens, pos0, win, None, soft=soft,
-                write_mask=write_mask,
-            )
-            cache = restore(win)
+            if paged:
+                # paged: per-row write spans live in wb (parked rows and
+                # shared prefix pages are trash-redirected), so the
+                # write_mask no-op rewrite is unnecessary
+                phys, wb = paged_tables
+                win = gather_kv_pages(cache, phys, page)
+                hidden, win = forward_hidden(
+                    spec, params, tokens, pos0, win, None, soft=soft)
+                cache = scatter_kv_pages(cache, win, wb, page)
+            else:
+                win, restore = _window_cache(cache, window)
+                hidden, win = forward_hidden(
+                    spec, params, tokens, pos0, win, None, soft=soft,
+                    write_mask=write_mask,
+                )
+                cache = restore(win)
             from ..models.transformer import _lm_head
             from ..ops.sampling import reset_slots
 
@@ -939,11 +1186,23 @@ class LLMEngine:
             return fn
         dspec = self.draft[0]
 
-        @partial(jax.jit, donate_argnums=(2,))
-        def _dp(dparams, tokens, dcache, pos0, slot_ids):
-            _, dcache = forward(dspec, dparams, tokens, pos0, dcache,
-                                slot_ids)
-            return dcache
+        if self._paged:
+            page = self._page
+
+            @partial(jax.jit, donate_argnums=(2,))
+            def _dp(dparams, tokens, dcache, pos0, slot_ids, phys, wb):
+                # the draft arena shares the main pool's page geometry
+                # and tables; wb carries ONLY the rows whose draft K/V
+                # must land (prefill rows — decode rows never mirror)
+                win = gather_kv_pages(dcache, phys, page)
+                _, win = forward(dspec, dparams, tokens, pos0, win, None)
+                return scatter_kv_pages(dcache, win, wb, page)
+        else:
+            @partial(jax.jit, donate_argnums=(2,))
+            def _dp(dparams, tokens, dcache, pos0, slot_ids):
+                _, dcache = forward(dspec, dparams, tokens, pos0, dcache,
+                                    slot_ids)
+                return dcache
 
         self._decode_k_fns[("draft_prefill",)] = _dp
         return _dp
@@ -1045,6 +1304,13 @@ class LLMEngine:
                             max(room // kd, 1),
                             -(-need // kd)))  # no overshoot rounds
         span = rounds * kd
+        if self._paged:
+            for s in list(decoding):
+                if not self._pool_ensure(s, s.n_past + span):
+                    self._finish(s, "length")
+                    decoding.remove(s)
+            if not decoding:
+                return
         elig = {s.idx for s in decoding}
         tokens = np.zeros((S, 1), np.int32)
         pos0 = np.zeros((S,), np.int32)
@@ -1061,16 +1327,26 @@ class LLMEngine:
                 # NOT be trimmed — the span fit is guaranteed by `room`
                 pos0[s.idx] = s.n_past
             else:
-                # parked rows must not run off the row end mid-scan
+                # parked rows must not run off the row end mid-scan.
+                # Paged rows never write back (trash wb), so only the
+                # in-dispatch position clamps; the prefix survives.
                 limit = max(self.max_seq - 1 - span, 0)
-                if s.n_past > limit:
+                if s.n_past > limit and not self._paged:
                     s.n_past = limit
                     s.cache_tokens = s.cache_tokens[:limit]
-                pos0[s.idx] = s.n_past
-        D, Mt, J = self._run("spec_s" if mode == "sampled" else "spec", {
+                pos0[s.idx] = min(s.n_past, limit)
+        payload = {
             "kd": kd, "rounds": rounds, "tokens": tokens, "pos0": pos0,
             "active": active,
-        })
+        }
+        if self._paged:
+            payload["pt"] = self._phys_rows(list(range(S)), self.max_seq)
+            payload["wb"] = self._wb_rows(
+                [(s.idx, ((s.n_past, s.n_past + span)
+                          if s.idx in elig else None))
+                 for s in self.slots], self.max_seq)
+        D, Mt, J = self._run("spec_s" if mode == "sampled" else "spec",
+                             payload)
         D = np.asarray(D)  # [rounds, S, kd-1] draft candidates
         Mt = np.asarray(Mt)  # [rounds, S, kd] main tokens (greedy verify
         # choices, or rejection-resample/bonus tokens on the sampled path)
@@ -1130,30 +1406,77 @@ class LLMEngine:
             return fn
         spec = self.spec
 
-        @partial(jax.jit, donate_argnums=(2, 5))
-        def _decode_k(params, tokens, cache, pos0, slot_ids, sampling,
-                      active):
-            cache, restore = _window_cache(cache, window)
+        if self._paged:
+            page = self._page
+            use_kernel = self._use_kernel
 
-            def step(carry, _):
-                tokens, pos, cache, sampling = carry
-                logits, cache = forward(
-                    spec, params, tokens, pos, cache, None, self._use_kernel,
-                    mesh=self.mesh,
-                )
-                toks, sampling = _sample_masked(
-                    sampling, slot_ids, logits[:, -1, :], active, None
-                )
-                pos = jnp.where(active, pos + 1, pos)
-                return (toks[:, None], pos, cache, sampling), toks
+            @partial(jax.jit, donate_argnums=(2, 5))
+            def _decode_k(params, tokens, cache, pos0, slot_ids, sampling,
+                          active, phys, wb):
+                if use_kernel:
+                    # fused kernel addresses the arena through the page
+                    # table directly — no gather, the paged decode hot
+                    # path reads only live pages
+                    def step(carry, _):
+                        tokens, pos, cache, sampling = carry
+                        logits, cache = forward(
+                            spec, params, tokens, pos, cache, None, True,
+                            page_table=phys, kv_page=page,
+                        )
+                        toks, sampling = _sample_masked(
+                            sampling, slot_ids, logits[:, -1, :], active,
+                            None)
+                        pos = jnp.where(active, pos + 1, pos)
+                        return (toks[:, None], pos, cache, sampling), toks
 
-            (tok_next, pos_next, cache, sampling), toks_seq = lax.scan(
-                step, (tokens, pos0, cache, sampling), None, length=k
-            )
-            # tok_next/pos_next are returned so the next dispatch can chain
-            # on device state without a host round trip
-            return (toks_seq.T, tok_next, pos_next, restore(cache),
-                    sampling)  # [S, k]
+                    (tok_next, pos_next, cache, sampling), toks_seq = \
+                        lax.scan(step, (tokens, pos0, cache, sampling),
+                                 None, length=k)
+                    return (toks_seq.T, tok_next, pos_next, cache,
+                            sampling)
+                win = gather_kv_pages(cache, phys, page)
+
+                def step(carry, _):
+                    tokens, pos, win, sampling = carry
+                    logits, win = forward(
+                        spec, params, tokens, pos, win, None, False,
+                    )
+                    toks, sampling = _sample_masked(
+                        sampling, slot_ids, logits[:, -1, :], active,
+                        None)
+                    pos = jnp.where(active, pos + 1, pos)
+                    return (toks[:, None], pos, win, sampling), toks
+
+                (tok_next, pos_next, win, sampling), toks_seq = lax.scan(
+                    step, (tokens, pos0, win, sampling), None, length=k
+                )
+                return (toks_seq.T, tok_next, pos_next,
+                        scatter_kv_pages(cache, win, wb, page), sampling)
+        else:
+            @partial(jax.jit, donate_argnums=(2, 5))
+            def _decode_k(params, tokens, cache, pos0, slot_ids, sampling,
+                          active):
+                cache, restore = _window_cache(cache, window)
+
+                def step(carry, _):
+                    tokens, pos, cache, sampling = carry
+                    logits, cache = forward(
+                        spec, params, tokens, pos, cache, None,
+                        self._use_kernel, mesh=self.mesh,
+                    )
+                    toks, sampling = _sample_masked(
+                        sampling, slot_ids, logits[:, -1, :], active, None
+                    )
+                    pos = jnp.where(active, pos + 1, pos)
+                    return (toks[:, None], pos, cache, sampling), toks
+
+                (tok_next, pos_next, cache, sampling), toks_seq = lax.scan(
+                    step, (tokens, pos0, cache, sampling), None, length=k
+                )
+                # tok_next/pos_next are returned so the next dispatch can
+                # chain on device state without a host round trip
+                return (toks_seq.T, tok_next, pos_next, restore(cache),
+                        sampling)  # [S, k]
 
         self._decode_k_fns[("decode", k, window)] = _decode_k
         return _decode_k
@@ -1185,19 +1508,34 @@ class LLMEngine:
         """Device-only work for one dispatch record. MUST be fully
         determined by (kind, payload) + engine construction — no reads of
         leader-side scheduler state — so follower replay stays lockstep."""
+        # paged dispatches carry their page-table snapshots in the
+        # payload ("pt"/"wb" int32 index arrays), so follower replay
+        # needs no allocator state
+        def tabs():
+            return (jnp.asarray(p["pt"]), jnp.asarray(p["wb"]))
+
         if kind == "prefill":
             toks = jnp.asarray(p["toks"])
             pos0 = jnp.asarray(p["pos0"])
             sids = jnp.asarray(p["slot_ids"])
             soft = self._soft_dense(p.get("soft"), *p["toks"].shape)
-            self.cache = self._prefill_fn(
-                p.get("window", self.max_seq), p.get("ring", False))(
-                self.params, toks, self.cache, pos0, sids, soft
-            )
-            if self.draft is not None:
-                self.draft_cache = self._draft_prefill_fn()(
-                    self.draft[1], toks, self.draft_cache, pos0, sids
-                )
+            fn = self._prefill_fn(
+                p.get("window", self.max_seq), p.get("ring", False))
+            if self._paged:
+                pt, wb = tabs()
+                self.cache = fn(self.params, toks, self.cache, pos0,
+                                sids, pt, wb, soft=soft)
+                if self.draft is not None:
+                    self.draft_cache = self._draft_prefill_fn()(
+                        self.draft[1], toks, self.draft_cache, pos0,
+                        sids, pt, wb)
+            else:
+                self.cache = fn(self.params, toks, self.cache, pos0,
+                                sids, soft=soft)
+                if self.draft is not None:
+                    self.draft_cache = self._draft_prefill_fn()(
+                        self.draft[1], toks, self.draft_cache, pos0, sids
+                    )
             return None
         if kind == "prefill_final":
             toks = jnp.asarray(p["toks"])
@@ -1210,16 +1548,25 @@ class LLMEngine:
                 "repeat_penalty", "freq_penalty", "presence_penalty",
                 "repeat_last_n", "seeds", "has_seed",
                 "typical_p", "mirostat", "mirostat_tau", "mirostat_eta"))
-            toks_out, self.cache, self.sampling = self._prefill_final_fn(
-                p.get("window", self.max_seq), p.get("identity", False))(
-                self.params, toks, self.cache, pos0, self.sampling, sids,
-                jnp.asarray(p["n_chunk"]), jnp.asarray(p["tails"]),
-                jnp.asarray(p["tail_lens"]), masks, reset, soft,
-            )
+            fn = self._prefill_final_fn(
+                p.get("window", self.max_seq), p.get("identity", False))
+            args = [self.params, toks, self.cache, pos0, self.sampling,
+                    sids, jnp.asarray(p["n_chunk"]),
+                    jnp.asarray(p["tails"]), jnp.asarray(p["tail_lens"]),
+                    masks, reset]
+            if self._paged:
+                pt, wb = tabs()
+                args += [pt, wb]
+            toks_out, self.cache, self.sampling = fn(*args, soft=soft)
             if self.draft is not None:
-                self.draft_cache = self._draft_prefill_fn()(
-                    self.draft[1], toks, self.draft_cache, pos0, sids
-                )
+                if self._paged:
+                    self.draft_cache = self._draft_prefill_fn()(
+                        self.draft[1], toks, self.draft_cache, pos0,
+                        sids, pt, wb)
+                else:
+                    self.draft_cache = self._draft_prefill_fn()(
+                        self.draft[1], toks, self.draft_cache, pos0, sids
+                    )
             return toks_out
         if kind == "mixed":
             # fused mixed prefill+decode step: like prefill_final, a
@@ -1235,30 +1582,40 @@ class LLMEngine:
                 "repeat_penalty", "freq_penalty", "presence_penalty",
                 "repeat_last_n", "seeds", "has_seed",
                 "typical_p", "mirostat", "mirostat_tau", "mirostat_eta"))
+            args = [self.params, toks, self.cache, pos0, self.sampling,
+                    jnp.asarray(p["write_mask"]),
+                    jnp.asarray(p["n_chunk"]),
+                    jnp.asarray(p["sample_sids"]),
+                    jnp.asarray(p["reset_sids"]), jnp.asarray(p["tails"]),
+                    jnp.asarray(p["tail_lens"]), masks, reset]
+            if self._paged:
+                pt, wb = tabs()
+                args += [pt, wb]
             toks_out, self.cache, self.sampling = self._mixed_fn(
-                p.get("window", self.max_seq))(
-                self.params, toks, self.cache, pos0, self.sampling,
-                jnp.asarray(p["write_mask"]), jnp.asarray(p["n_chunk"]),
-                jnp.asarray(p["sample_sids"]),
-                jnp.asarray(p["reset_sids"]), jnp.asarray(p["tails"]),
-                jnp.asarray(p["tail_lens"]), masks, reset, soft,
-            )
+                p.get("window", self.max_seq))(*args, soft=soft)
             if self.draft is not None:
                 # mirror ONLY the prefill rows into the draft cache
                 # (decode rows advance without draft writes, exactly as
                 # on the decodek path)
-                self.draft_cache = self._draft_prefill_fn()(
-                    self.draft[1], toks, self.draft_cache, pos0,
-                    jnp.asarray(p["prefill_sids"]),
-                )
+                if self._paged:
+                    self.draft_cache = self._draft_prefill_fn()(
+                        self.draft[1], toks, self.draft_cache, pos0,
+                        jnp.asarray(p["prefill_sids"]), pt,
+                        jnp.asarray(p["wb_draft"]))
+                else:
+                    self.draft_cache = self._draft_prefill_fn()(
+                        self.draft[1], toks, self.draft_cache, pos0,
+                        jnp.asarray(p["prefill_sids"]),
+                    )
             return toks_out
         if kind == "decode1":
             masks = _unpack_masks(p["masks"])
-            toks, self.cache, self.sampling = self._decode_fn(
-                self.params, jnp.asarray(p["tokens"]), self.cache,
-                jnp.asarray(p["pos0"]), self._all_slot_ids, self.sampling,
-                jnp.asarray(p["active"]), masks,
-            )
+            args = [self.params, jnp.asarray(p["tokens"]), self.cache,
+                    jnp.asarray(p["pos0"]), self._all_slot_ids,
+                    self.sampling, jnp.asarray(p["active"]), masks]
+            if self._paged:
+                args += list(tabs())
+            toks, self.cache, self.sampling = self._decode_fn(*args)
             return toks
         if kind == "decodek":
             fn = self._decode_k_fn(p["k"], p["window"])
@@ -1270,11 +1627,12 @@ class LLMEngine:
                 tok_dev = jnp.asarray(p["tokens"])
                 pos_dev = jnp.asarray(p["pos0"])
                 act_dev = jnp.asarray(p["active"])
+            extra = list(tabs()) if self._paged else []
             batches = []
             for _ in range(p["depth"]):
                 toks, tok_dev, pos_dev, self.cache, self.sampling = fn(
                     self.params, tok_dev, self.cache, pos_dev,
-                    self._all_slot_ids, self.sampling, act_dev,
+                    self._all_slot_ids, self.sampling, act_dev, *extra,
                 )
                 batches.append(toks)
             self._dev_tokens, self._dev_pos, self._dev_active = (
@@ -1283,20 +1641,22 @@ class LLMEngine:
             return batches
         if kind == "spec":
             fn = self._spec_decode_fn(p["kd"], p["rounds"])
+            extra = list(tabs()) if self._paged else []
             D, Mt, J, _, _, self.cache, self.draft_cache = fn(
                 self.params, self.draft[1], self.cache, self.draft_cache,
                 jnp.asarray(p["tokens"]), jnp.asarray(p["pos0"]),
-                jnp.asarray(p["active"]),
+                jnp.asarray(p["active"]), *extra,
             )
             return D, Mt, J
         if kind == "spec_s":
             import dataclasses
 
             fn = self._spec_sampled_fn(p["kd"], p["rounds"])
+            extra = list(tabs()) if self._paged else []
             D, Fin, J, rng, self.cache, self.draft_cache = fn(
                 self.params, self.draft[1], self.sampling, self.cache,
                 self.draft_cache, jnp.asarray(p["tokens"]),
-                jnp.asarray(p["pos0"]), jnp.asarray(p["active"]),
+                jnp.asarray(p["pos0"]), jnp.asarray(p["active"]), *extra,
             )
             self.sampling = dataclasses.replace(self.sampling, rng=rng)
             return D, Fin, J
@@ -1346,6 +1706,9 @@ class LLMEngine:
         tm.ENGINE_QUEUE_DEPTH.labels(model=self._mlabel).set(0)
         tm.ENGINE_KV_UTIL.labels(model=self._mlabel).set(0.0)
         tm.ENGINE_KV_RESIDENT_PREFIX.labels(model=self._mlabel).set(0.0)
+        if self._paged:
+            tm.ENGINE_KV_PAGES_IN_USE.labels(model=self._mlabel).set(0)
+            tm.ENGINE_KV_PAGES_SHARED.labels(model=self._mlabel).set(0)
         if self.mesh is not None:
             # release the process-wide meshed gate so a later unmeshed
             # engine regains the fused int8 kernel (single-owner rule)
@@ -1370,6 +1733,8 @@ class LLMEngine:
             self._use_kernel, mesh_desc, jax.default_backend(),
             getattr(dev, "device_kind", ""), jax.__version__,
             self._mixed,  # the mixed dispatcher adds its own variants
+            # the paged pool changes every variant's cache geometry
+            self._paged, self._page, self.kv_pages,
         ))
         return hashlib.sha256(blob.encode()).hexdigest()[:20]
 
@@ -1455,7 +1820,7 @@ class LLMEngine:
             for B, win, identity in variants:
                 reset = {k: np.repeat(v, B, axis=0)
                          for k, v in pad_reset.items()}
-                self._run("prefill_final", {
+                payload = {
                     "toks": np.zeros((B, bucket), np.int32),
                     "pos0": np.zeros((B,), np.int32),
                     "slot_ids": np.full((B,), self.n_slots,
@@ -1466,7 +1831,14 @@ class LLMEngine:
                     "masks": None, "reset": reset, "soft": None,
                     "window": win,
                     "identity": identity,
-                })
+                }
+                if self._paged:
+                    # all-trash tables: garbage reads are masked,
+                    # writebacks drop — engine state stays untouched
+                    wp = win // self._page
+                    payload["pt"] = np.zeros((B, wp), np.int32)
+                    payload["wb"] = np.zeros((B, wp), np.int32)
+                self._run("prefill_final", payload)
         if self.max_seq > self.prefill_buckets[-1]:
             # long prompts chunk through the "prefill" fn at live-context
             # window buckets — compile those too, or the first long
@@ -1487,14 +1859,19 @@ class LLMEngine:
                 rings.add(True)  # the seq-sharded first-chunk variant
             for w in sorted(windows):
                 for ring in sorted(rings):
-                    self._run("prefill", {
+                    payload = {
                         "toks": np.zeros((1, self.prefill_buckets[-1]),
                                          np.int32),
                         "pos0": np.zeros((1,), np.int32),
                         "slot_ids": np.full((1,), self.n_slots,
                                             np.int32),
                         "soft": None, "window": w, "ring": ring,
-                    })
+                    }
+                    if self._paged:
+                        wp = w // self._page
+                        payload["pt"] = np.zeros((1, wp), np.int32)
+                        payload["wb"] = np.zeros((1, wp), np.int32)
+                    self._run("prefill", payload)
         if self._mixed:
             # mixed prefill+decode step variants: one per (bucket that
             # fits the identity budget, live-context window). All-pad
@@ -1505,7 +1882,7 @@ class LLMEngine:
                 reset = {k: np.repeat(v, S, axis=0)
                          for k, v in pad_reset.items()}
                 for w in win_ladder:
-                    self._run("mixed", {
+                    payload = {
                         "toks": np.zeros((S, bucket), np.int32),
                         "pos0": np.zeros((S,), np.int32),
                         "n_chunk": np.ones((S,), np.int32),
@@ -1517,13 +1894,23 @@ class LLMEngine:
                         "masks": None, "reset": reset, "soft": None,
                         "prefill_sids": np.full((S,), S, np.int32),
                         "window": w,
-                    })
+                    }
+                    if self._paged:
+                        wp = w // self._page
+                        payload["pt"] = np.zeros((S, wp), np.int32)
+                        payload["wb"] = np.zeros((S, wp), np.int32)
+                        payload["wb_draft"] = np.zeros((S, wp), np.int32)
+                    self._run("mixed", payload)
         if self._prefix_enabled:
             # cross-slot KV copy variants (cheap compiles — pure DUS,
             # no matmuls — but a mid-admission stall is still a stall);
             # src == dst == 0 is a self-copy no-op on device state
-            for w in win_ladder:
-                self._run("kvcopy", {"src": 0, "dst": 0, "n": w})
+            if self._paged:
+                # paged copies are always whole-page: ONE variant
+                self._run("kvcopy", {"src": 0, "dst": 0, "n": self._page})
+            else:
+                for w in win_ladder:
+                    self._run("kvcopy", {"src": 0, "dst": 0, "n": w})
         S = self.n_slots
         inactive = {
             "tokens": np.zeros((S, 1), np.int32),
@@ -1543,11 +1930,21 @@ class LLMEngine:
         for k in sorted(ks):
             if k > 1:
                 for w in sorted(windows_d):
-                    self._run("decodek", {
+                    payload = {
                         "k": k, "window": w, "depth": 1, "carry": False,
                         **inactive,
-                    })
-        self._run("decode1", {**inactive, "masks": None})
+                    }
+                    if self._paged:
+                        wp = w // self._page
+                        payload["pt"] = np.zeros((S, wp), np.int32)
+                        payload["wb"] = np.zeros((S, wp), np.int32)
+                    self._run("decodek", payload)
+        payload = {**inactive, "masks": None}
+        if self._paged:
+            wp = self.max_seq // self._page
+            payload["pt"] = np.zeros((S, wp), np.int32)
+            payload["wb"] = np.zeros((S, wp), np.int32)
+        self._run("decode1", payload)
         self._dev_epoch = -1  # warmup carries are not serving state
         # block until every warmup compile retires so the first real
         # request measures serving, not the compiler
@@ -1734,8 +2131,34 @@ class LLMEngine:
         # reusable-but-idle KV is real capacity the cross-slot cache can
         # serve: count resident prefix tokens across ALL slots (a free
         # slot's resident prefix is invisible to ENGINE_KV_UTIL)
+        live_tokens = sum(len(s.cache_tokens) for s in self.slots)
         tm.ENGINE_KV_RESIDENT_PREFIX.labels(model=m).set(
-            float(sum(len(s.cache_tokens) for s in self.slots)))
+            float(live_tokens))
+        if self._paged:
+            st = self._pool.stats()
+            tm.ENGINE_KV_PAGES_IN_USE.labels(model=m).set(st.in_use)
+            tm.ENGINE_KV_PAGES_SHARED.labels(model=m).set(st.shared)
+            # HBM actually allocated per live (resident) token — the
+            # series that shows paging tracking expected instead of
+            # worst-case context (dense equivalent: max_seq / mean ctx
+            # x this value)
+            c = self.cache
+            tok_bytes = 2 * c.k.dtype.itemsize * c.k.shape[0] \
+                * c.k.shape[-1]
+            if c.quantized:
+                tok_bytes += 2 * 4 * c.k.shape[0]  # f32 row scales
+            tm.ENGINE_KV_HBM_PER_TOKEN.labels(model=m).set(
+                float(st.in_use * self._page * tok_bytes)
+                / max(live_tokens, 1))
+            # allocator outcome counters (fresh/shared/cow) sync from
+            # the pool's host tallies; reclaimed/exhausted increment at
+            # their call sites
+            for outcome, v in self._pool.allocs.items():
+                prev = self._alloc_sync.get(outcome, 0)
+                if v > prev:
+                    tm.ENGINE_KV_PAGE_ALLOC.labels(
+                        model=m, outcome=outcome).inc(v - prev)
+                    self._alloc_sync[outcome] = v
         if not any(s.state is SlotState.DECODE for s in self.slots):
             # decode-stall gaps are only meaningful while a slot
             # decodes; reset the clock when the decode set drains
@@ -1931,6 +2354,10 @@ class LLMEngine:
             if slot is None:
                 requeue.append((req, out))  # no free slot
                 continue
+            if self._paged and not self._page_headroom(req):
+                requeue.append((req, out))  # pool full of ACTIVE state:
+                # wait for a release instead of admit-then-kill thrash
+                continue
             self._deferred.pop(req.id, None)
             self._assign(slot, req, out)
             if req.soft_embeds is None:
@@ -2085,15 +2512,39 @@ class LLMEngine:
         # cover >= best); prefer the most recently useful row
         donor = max(donors,
                     key=lambda i: self._prefix_index.value(i, now))
-        # static-shape length bucket: copying past `best` is harmless
-        # (dst positions beyond its valid prefix are rewritten by
-        # prefill or causally invisible) and keeps the jit set tiny
-        self._run("kvcopy", {"src": donor, "dst": slot.idx,
-                             "n": self._window_bucket(best)})
+        if self._paged:
+            # zero-copy share: the donor's FULL pages covering [0, best)
+            # transfer by reference (refcount bump — no device work);
+            # only the sub-page tail is row-copied into a fresh private
+            # page, so whole-page prefixes admit with ZERO copy
+            # dispatches — this supersedes most dense kvcopy traffic.
+            P = self._page
+            full = best // P
+            self._pool.share(slot.idx, donor, full)
+            tail = best - full * P
+            if tail > 0:
+                src_pg = self._pool.table(donor)[full]
+                if self._pool_ensure(slot, best):  # the tail page
+                    dst_pg = self._pool.table(slot.idx)[full]
+                    # whole-page copy (rows past `tail` are rewritten
+                    # by prefill or causally invisible): ONE jit variant
+                    self._run("kvcopy", {"src": src_pg, "dst": dst_pg,
+                                         "n": P})
+                    self.metrics.prefix_copies += 1
+                    tm.ENGINE_PREFIX_COPIES.labels(model=m).inc()
+                else:
+                    best = full * P  # no page for the tail: share-only
+        else:
+            # static-shape length bucket: copying past `best` is
+            # harmless (dst positions beyond its valid prefix are
+            # rewritten by prefill or causally invisible) and keeps the
+            # jit set tiny
+            self._run("kvcopy", {"src": donor, "dst": slot.idx,
+                                 "n": self._window_bucket(best)})
+            self.metrics.prefix_copies += 1
+            tm.ENGINE_PREFIX_COPIES.labels(model=m).inc()
         self._prefix_index.touch(donor, now)
-        gain = best - common
-        self.metrics.prefix_copies += 1
-        tm.ENGINE_PREFIX_COPIES.labels(model=m).inc()
+        gain = max(0, best - common)
         tm.ENGINE_PREFIX_EVENTS.labels(model=m, event="hit_copy").inc()
         slot.cache_tokens = list(req.prompt_ids[:best])
         slot.n_past = best
@@ -2150,16 +2601,53 @@ class LLMEngine:
                 return done("stale")
             n = min(common, len(cached_tokens), self.max_seq - 1,
                     k_all.shape[1])
-            ck = self.cache.k.at[:, slot.idx, :n].set(
-                jnp.asarray(k_all[:, :n]).astype(self.cache.k.dtype))
-            cv = self.cache.v.at[:, slot.idx, :n].set(
-                jnp.asarray(v_all[:, :n]).astype(self.cache.v.dtype))
-            ks, vs = self.cache.k_scale, self.cache.v_scale
-            if self.cache.quantized:
-                ks = ks.at[:, slot.idx, :n].set(
-                    jnp.asarray(data["k_scale"][:, :n]))
-                vs = vs.at[:, slot.idx, :n].set(
-                    jnp.asarray(data["v_scale"][:, :n]))
+            if self._paged:
+                # replace the slot's rows wholesale: fresh private
+                # pages, file rows scattered page by page (the on-disk
+                # format stays slot-contiguous [L, n, F], so caches are
+                # portable across paged and dense engines)
+                self._pool.drop(slot.idx)
+                slot.cache_tokens = []
+                slot.n_past = 0
+                if not self._pool_ensure(slot, n):
+                    return done("error")
+                P = self._page
+                table = self._pool.table(slot.idx)
+                npg = len(table)
+                pad = npg * P - n
+
+                def paged_rows(a):
+                    a = np.asarray(a[:, :n])
+                    if pad:
+                        a = np.concatenate([a, np.zeros(
+                            (a.shape[0], pad) + a.shape[2:], a.dtype)],
+                            axis=1)
+                    return a.reshape((a.shape[0], npg, P) + a.shape[2:])
+
+                tbl = jnp.asarray(np.asarray(table, np.int32))
+                ck = self.cache.k.at[:, tbl].set(
+                    jnp.asarray(paged_rows(k_all)).astype(
+                        self.cache.k.dtype))
+                cv = self.cache.v.at[:, tbl].set(
+                    jnp.asarray(paged_rows(v_all)).astype(
+                        self.cache.v.dtype))
+                ks, vs = self.cache.k_scale, self.cache.v_scale
+                if self.cache.quantized:
+                    ks = ks.at[:, tbl].set(
+                        jnp.asarray(paged_rows(data["k_scale"])))
+                    vs = vs.at[:, tbl].set(
+                        jnp.asarray(paged_rows(data["v_scale"])))
+            else:
+                ck = self.cache.k.at[:, slot.idx, :n].set(
+                    jnp.asarray(k_all[:, :n]).astype(self.cache.k.dtype))
+                cv = self.cache.v.at[:, slot.idx, :n].set(
+                    jnp.asarray(v_all[:, :n]).astype(self.cache.v.dtype))
+                ks, vs = self.cache.k_scale, self.cache.v_scale
+                if self.cache.quantized:
+                    ks = ks.at[:, slot.idx, :n].set(
+                        jnp.asarray(data["k_scale"][:, :n]))
+                    vs = vs.at[:, slot.idx, :n].set(
+                        jnp.asarray(data["v_scale"][:, :n]))
         except Exception as e:
             # unreadable/incompatible cache: prefill normally — but
             # say so, a corrupt file re-prefilling forever is a real
@@ -2193,11 +2681,25 @@ class LLMEngine:
         # snapshot the (immutable) device arrays now; the transfer +
         # write happens OFF the scheduler thread so a finishing request
         # never stalls other slots' decoding
-        k_rows = self.cache.k[:, slot.idx, :n]
-        v_rows = self.cache.v[:, slot.idx, :n]
-        scales = ((self.cache.k_scale[:, slot.idx, :n],
-                   self.cache.v_scale[:, slot.idx, :n])
-                  if self.cache.quantized else None)
+        if self._paged:
+            # gather the slot's page run into contiguous rows — the
+            # on-disk format stays [L, n, F] either way
+            P = self._page
+            tbl = jnp.asarray(np.asarray(
+                self._pool.table(slot.idx)[: -(-n // P)], np.int32))
+            L = self.cache.k.shape[0]
+            F = self.cache.k.shape[-1]
+            k_rows = self.cache.k[:, tbl].reshape(L, -1, F)[:, :n]
+            v_rows = self.cache.v[:, tbl].reshape(L, -1, F)[:, :n]
+            scales = ((self.cache.k_scale[:, tbl].reshape(L, -1)[:, :n],
+                       self.cache.v_scale[:, tbl].reshape(L, -1)[:, :n])
+                      if self.cache.quantized else None)
+        else:
+            k_rows = self.cache.k[:, slot.idx, :n]
+            v_rows = self.cache.v[:, slot.idx, :n]
+            scales = ((self.cache.k_scale[:, slot.idx, :n],
+                       self.cache.v_scale[:, slot.idx, :n])
+                      if self.cache.quantized else None)
         tokens = np.asarray(slot.cache_tokens[:n], np.int32)
         path = req.prompt_cache_path
 
@@ -2249,6 +2751,16 @@ class LLMEngine:
                 disk_gain = common - before_disk
             if common == len(req.prompt_ids):
                 common -= 1  # reprocess last token for logits (ref :1882-1890)
+        if self._paged:
+            # the write frontier (position `common`) must be privately
+            # writable: a SHARED boundary page (this slot donated its
+            # full pages, or the relogit -1 stepped back into a shared
+            # page) is copy-on-write swapped for a private copy before
+            # any prefill write can land in it
+            cow = self._pool.prepare_write(slot.idx, common)
+            if cow is not None:
+                self._run("kvcopy", {"src": cow[0], "dst": cow[1],
+                                     "n": self._page})
         slot.request = req
         slot.out = out
         slot.state = SlotState.PREFILL
@@ -2322,14 +2834,24 @@ class LLMEngine:
         # [n_past+len(chunk), n_past+bucket) — harmless: they're beyond the
         # valid prefix and get overwritten when real tokens arrive (causal
         # mask keeps them invisible to attention reads at these positions).
-        self._run("prefill", {
+        window = self._window_bucket(slot.n_past + bucket)
+        payload = {
             "toks": toks,
             "pos0": np.asarray([slot.n_past], np.int32),
             "slot_ids": np.asarray([slot.idx], np.int32),
             "soft": self._soft_payload([slot], [slot.n_past], bucket),
-            "window": self._window_bucket(slot.n_past + bucket),
+            "window": window,
             "ring": ring,
-        })
+        }
+        if self._paged:
+            if not self._pool_ensure(slot, slot.n_past + len(chunk)):
+                self._finish(slot, "length")
+                return
+            payload["pt"] = self._phys_rows([slot.idx], window)
+            payload["wb"] = self._wb_rows(
+                [(slot.idx, (slot.n_past, slot.n_past + len(chunk)))],
+                window)
+        self._run("prefill", payload)
         slot.n_past += len(chunk)
         slot.cache_tokens.extend(chunk)
         if slot.t_prefill_t0 == 0.0:
@@ -2460,6 +2982,19 @@ class LLMEngine:
         harvest."""
         cap = self._prefill_group_cap(bucket)
         group = group[:cap]
+        if self._paged:
+            # page capacity for each member's full prompt; a member the
+            # pool cannot serve even after reclaim ends here (the paged
+            # counterpart of the dense context wall)
+            kept = []
+            for s in group:
+                if self._pool_ensure(s, s.n_prompt):
+                    kept.append(s)
+                else:
+                    self._finish(s, "length")
+            group = kept
+            if not group:
+                return
         # identity full-batch pays the whole [n_slots, bucket] forward —
         # a huge win for burst groups (no cross-slot scatter, one jit
         # shape) but a ~75 ms steady-state TTFT tax on a LONE arrival,
@@ -2523,7 +3058,7 @@ class LLMEngine:
                 window = self.max_seq
         else:
             window = self.max_seq
-        toks_out = self._run("prefill_final", {
+        payload = {
             "toks": toks, "pos0": pos0, "slot_ids": slot_ids,
             "n_chunk": n_chunk, "tails": tails, "tail_lens": tail_lens,
             "masks": masks,
@@ -2531,7 +3066,20 @@ class LLMEngine:
             "soft": self._soft_payload(group, pos0, bucket, rows),
             "window": window,
             "identity": identity,
-        })
+        }
+        if self._paged:
+            # batch row -> slot mapping: identity rows ARE slot indices;
+            # legacy rows are the leading group members, pads get trash
+            row_slots: list = ([i for i in range(B)] if identity
+                               else [None] * B)
+            spans: list = [(si, None) for si in row_slots]
+            for r, s in zip(rows, group):
+                row_slots[r] = s.idx
+                spans[r] = (s.idx, (int(pos0[r]),
+                                    int(pos0[r]) + int(n_chunk[r])))
+            payload["pt"] = self._phys_rows(row_slots, window)
+            payload["wb"] = self._wb_rows(spans, window)
+        toks_out = self._run("prefill_final", payload)
         try:
             toks_out.copy_to_host_async()
         except Exception:
@@ -2615,6 +3163,21 @@ class LLMEngine:
         S = self.n_slots
         W = self.sampling.window
         buckets = self._mixed_buckets
+        if self._paged:
+            # page capacity up front: decode rows append one token,
+            # prefill rows at most one bucket-wide chunk
+            for s in list(decoding):
+                if not self._pool_ensure(s, s.n_past + 1):
+                    self._finish(s, "length")
+                    decoding.remove(s)
+            for s in list(prefilling):
+                rem = s.n_prompt - s.n_past
+                if not self._pool_ensure(
+                        s, s.n_past + min(rem, buckets[-1])):
+                    self._finish(s, "length")
+                    prefilling.remove(s)
+            if not prefilling or not decoding:
+                return  # composition changed: next iteration re-plans
         need = min(max(s.n_prompt - s.n_past for s in prefilling),
                    buckets[-1])
         bucket = next(b for b in buckets if b >= need)
@@ -2668,7 +3231,7 @@ class LLMEngine:
         compiled = [k[1] for k in self._decode_k_fns
                     if k[0] == "mixed" and window <= k[1]]
         window = min(compiled) if compiled else self.max_seq
-        toks_out = self._run("mixed", {
+        payload = {
             "toks": toks, "pos0": pos0, "n_chunk": n_chunk,
             "write_mask": write_mask, "sample_sids": sample_sids,
             "reset_sids": reset_sids, "tails": tails,
@@ -2679,7 +3242,21 @@ class LLMEngine:
                                        [s.idx for s in prefilling]),
             "prefill_sids": prefill_sids,
             "window": window,
-        })
+        }
+        if self._paged:
+            spans: list = [(i, None) for i in range(S)]
+            dspans: list = [(i, None) for i in range(S)]
+            for s in decoding:
+                spans[s.idx] = (s.idx, (s.n_past, s.n_past + 1))
+            for s in prefilling:
+                span = (s.n_past, s.n_past + int(n_chunk[s.idx]))
+                spans[s.idx] = (s.idx, span)
+                dspans[s.idx] = (s.idx, span)  # draft mirrors prefill
+                # rows only — decode rows keep trash in the draft wb
+            payload["pt"] = self._phys_rows(list(range(S)), window)
+            payload["wb"] = self._wb_rows(spans, window)
+            payload["wb_draft"] = self._wb_rows(dspans, window)
+        toks_out = self._run("mixed", payload)
         try:
             toks_out.copy_to_host_async()
         except Exception:
@@ -3067,6 +3644,16 @@ class LLMEngine:
             if compiled:
                 window = min(compiled)
 
+        if self._paged:
+            # page capacity for the scan's write span ([n_past +
+            # in_flight, + k) per advancing row) BEFORE the table
+            # snapshots below
+            for s in list(decoding):
+                if not self._pool_ensure(s, s.n_past + in_flight + k):
+                    self._finish(s, "length")
+                    decoding.remove(s)
+            if not decoding:
+                return True
         advancing = {s.idx for s in decoding}
         tokens = np.zeros((S, 1), np.int32)
         pos0 = np.zeros((S,), np.int32)
@@ -3088,11 +3675,13 @@ class LLMEngine:
                 # the valid prefix, preserving it for prefix reuse. In the
                 # windowed path, a row whose prefix out-sizes the window
                 # gets clamped: its reusable prefix is truncated to what
-                # the window keeps.
-                if s.n_past >= window:
+                # the window keeps. Paged rows never write back (their wb
+                # pages are trash), so the resident prefix survives at
+                # full length — only the in-dispatch position is clamped.
+                if s.n_past >= window and not self._paged:
                     s.n_past = window - 1
                     s.cache_tokens = s.cache_tokens[: window - 1]
-                pos0[s.idx] = min(s.n_past, self.max_seq - 1)
+                pos0[s.idx] = min(s.n_past, window - 1, self.max_seq - 1)
 
         akey = active.tobytes()
         carry_ok = (self._dev_epoch == self._epoch
@@ -3102,10 +3691,18 @@ class LLMEngine:
             # finished/joined at harvest): fresh host tokens would be
             # stale until those scans land — wait for them
             return False
-        batches = self._run("decodek", {
+        payload = {
             "k": k, "window": window, "depth": 1, "carry": carry_ok,
             "tokens": tokens, "pos0": pos0, "active": active,
-        })
+        }
+        if self._paged:
+            payload["pt"] = self._phys_rows(list(range(S)), window)
+            payload["wb"] = self._wb_rows(
+                [(i, ((self.slots[i].n_past + in_flight,
+                       self.slots[i].n_past + in_flight + k)
+                      if i in advancing else None)) for i in range(S)],
+                window)
+        batches = self._run("decodek", payload)
         toks = batches[0]
         try:
             toks.copy_to_host_async()
@@ -3205,6 +3802,13 @@ class LLMEngine:
         (grammar masks / logit_bias need fresh host work every token)."""
         t0 = time.perf_counter()
         S = self.n_slots
+        if self._paged:
+            for s in list(decoding):
+                if not self._pool_ensure(s, s.n_past + 1):
+                    self._finish(s, "length")
+                    decoding.remove(s)
+            if not decoding:
+                return
         tokens = np.zeros((S, 1), np.int32)
         pos0 = np.zeros((S,), np.int32)
         active = np.zeros((S,), bool)
@@ -3217,10 +3821,17 @@ class LLMEngine:
             else:
                 pos0[s.idx] = min(s.n_past, self.max_seq - 1)
         masks = self._constraint_mask_rows(self.slots)
-        toks = self._run("decode1", {
+        payload = {
             "tokens": tokens, "pos0": pos0, "active": active,
             "masks": masks,
-        })
+        }
+        if self._paged:
+            payload["pt"] = self._phys_rows(list(range(S)), self.max_seq)
+            payload["wb"] = self._wb_rows(
+                [(s.idx, ((s.n_past, s.n_past + 1)
+                          if s.state is SlotState.DECODE else None))
+                 for s in self.slots], self.max_seq)
+        toks = self._run("decode1", payload)
         toks_host = np.asarray(toks)
         dt_ms = (time.perf_counter() - t0) * 1e3
         emitted = 0
@@ -3373,6 +3984,8 @@ class LLMEngine:
         if slot.request is not None and slot.request.soft_embeds is not None:
             slot.cache_tokens = []
             slot.n_past = 0
+            if self._paged:
+                self._pool.drop(slot.idx)
         self._epoch += 1
         slot.state = SlotState.FREE
         slot.request = None

@@ -165,6 +165,18 @@ class PrefixIndex:
             i += cp
         return best_len, best_slots
 
+    def page_run(self, tokens, page_size: int,
+                 exclude: frozenset = frozenset()
+                 ) -> tuple[int, int, set[int]]:
+        """The longest registered prefix of ``tokens`` expressed as a
+        page run: (full_pages, tail_rows, donor slots). With the paged
+        KV pool, admission takes ``full_pages`` by zero-copy reference
+        share (refcount bump) and row-copies only the ``tail_rows``
+        sub-page remainder — the split engine._maybe_prefix_copy and
+        tools/profile_kv.py report."""
+        n, donors = self.match(tokens, exclude)
+        return n // page_size, n % page_size, donors
+
     def registered_len(self, slot: int) -> int:
         seq = self._seqs.get(slot)
         return 0 if seq is None else len(seq)

@@ -106,6 +106,62 @@ def _quantize_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
 
 
 # ---------------------------------------------------------------------------
+# paged KV pool views (engine/kv_pool.py owns the host-side allocator)
+# ---------------------------------------------------------------------------
+
+
+def gather_kv_pages(arena: KVCache, phys: jax.Array, page: int) -> KVCache:
+    """Materialize a contiguous per-slot window view [L, B, W, F] from a
+    paged arena [L, n_pages, page, F] through per-slot page tables
+    ``phys [B, W//page]`` (int32 physical page ids; unallocated entries
+    point at the trash page, whose garbage is causally masked). The view
+    is shape- and value-identical to the dense windowed cache, so the
+    forward math — and therefore the sampled token stream — is
+    byte-identical on both paths."""
+    L, F = arena.k.shape[0], arena.k.shape[-1]
+    B, wp = phys.shape
+
+    def g4(a):
+        return a[:, phys].reshape(L, B, wp * page, F)
+
+    def g3(a):
+        return a[:, phys].reshape(a.shape[0], B, wp * page)
+
+    return KVCache(
+        k=g4(arena.k), v=g4(arena.v),
+        k_scale=g3(arena.k_scale) if arena.quantized else None,
+        v_scale=g3(arena.v_scale) if arena.quantized else None,
+    )
+
+
+def scatter_kv_pages(arena: KVCache, win: KVCache, wb: jax.Array,
+                     page: int) -> KVCache:
+    """Write a window view back into the arena. ``wb [B, W//page]``
+    carries the physical destination per (slot, window-page); entries
+    whose page must NOT be written (shared prefix pages, parked rows,
+    pages outside the dispatch's write span) point at the trash page —
+    duplicate trash indices are fine, the losing garbage is never read.
+    The host guarantees every non-trash wb entry is privately owned, so
+    no two rows ever scatter to the same live page."""
+    L, F = arena.k.shape[0], arena.k.shape[-1]
+    B, wp = wb.shape
+
+    def s4(a, w):
+        return a.at[:, wb].set(w.reshape(L, B, wp, page, F))
+
+    def s3(a, w):
+        return a.at[:, wb].set(w.reshape(a.shape[0], B, wp, page))
+
+    return KVCache(
+        k=s4(arena.k, win.k), v=s4(arena.v, win.v),
+        k_scale=s3(arena.k_scale, win.k_scale) if arena.quantized
+        else None,
+        v_scale=s3(arena.v_scale, win.v_scale) if arena.quantized
+        else None,
+    )
+
+
+# ---------------------------------------------------------------------------
 # init
 # ---------------------------------------------------------------------------
 
@@ -559,6 +615,15 @@ def forward_hidden(
     # identity prefill park non-member rows at pos 0 without corrupting
     # their live prefixes, which in turn lets the dispatch window follow
     # the MEMBER rows' live context instead of max_seq.
+    page_table: Optional[jax.Array] = None,  # paged KV pool (kernel
+    # decode path only): ``cache`` is the [L, n_pages, page, F] arena
+    # and this [B, max_pages] int32 table maps each row's logical page
+    # index to its physical arena page. The current rows append through
+    # the table and the fused kernel DMAs pages by table lookup. The
+    # paged XLA path instead gathers a dense window OUTSIDE this
+    # function (gather_kv_pages/scatter_kv_pages), so it never sees the
+    # arena.
+    kv_page: int = 0,  # pool page size (tokens) when page_table is set
 ) -> tuple[jax.Array, KVCache]:
     """Run the stack up to (and including) the final norm; returns
     (hidden [B, T, D], updated cache). The LM head lives in ``forward``;
@@ -653,14 +718,24 @@ def forward_hidden(
                 )
                 return (res[0][:, None, :].astype(x.dtype),
                         tuple(res[1:]))
-            ck_new = ck_all.at[l, rows, pos0, :].set(
+            if page_table is not None:
+                # paged arena: route the append through the page table
+                # (physical page of each row's write position). The
+                # host guarantees the target page is privately owned —
+                # or the trash page for parked rows, whose garbage
+                # append is never read.
+                w_rows = page_table[rows, pos0 // kv_page]
+                w_offs = pos0 % kv_page
+            else:
+                w_rows, w_offs = rows, pos0
+            ck_new = ck_all.at[l, w_rows, w_offs, :].set(
                 kq_row.astype(ck_all.dtype), mode="promise_in_bounds")
-            cv_new = cv_all.at[l, rows, pos0, :].set(
+            cv_new = cv_all.at[l, w_rows, w_offs, :].set(
                 vq_row.astype(cv_all.dtype), mode="promise_in_bounds")
             if quant:
-                ks_new = ks_all.at[l, rows, pos0].set(
+                ks_new = ks_all.at[l, w_rows, w_offs].set(
                     ks_row, mode="promise_in_bounds")
-                vs_new = vs_all.at[l, rows, pos0].set(
+                vs_new = vs_all.at[l, w_rows, w_offs].set(
                     vs_row, mode="promise_in_bounds")
             else:
                 ks_new = vs_new = None
@@ -669,6 +744,8 @@ def forward_hidden(
                 spec.n_kv_heads, scale=scale,
                 sliding_window=spec.sliding_window,
                 cache_k_scale=ks_new, cache_v_scale=vs_new,
+                page_table=page_table,
+                page=(kv_page if page_table is not None else None),
             )
             if quant:
                 return (out[:, None, :].astype(x.dtype),
@@ -848,11 +925,13 @@ def forward(
     soft: Optional[tuple] = None,
     mesh: Any = None,
     ring_prefill: bool = False,
+    page_table: Optional[jax.Array] = None,
+    kv_page: int = 0,
 ) -> tuple[jax.Array, KVCache]:
     """forward_hidden + LM head; returns (logits [B, T, V] f32, cache)."""
     x, cache = forward_hidden(
         spec, params, tokens, pos0, cache, slot_ids, decode_kernel, soft,
-        mesh, ring_prefill,
+        mesh, ring_prefill, page_table=page_table, kv_page=kv_page,
     )
     return _lm_head(spec, params, x), cache
 

@@ -99,10 +99,19 @@ def extract_head_bands(out: jax.Array, n_kv_heads: int,
 # contribution is seeded from VMEM and its HBM copy masked out.
 
 
-def _fused_kernel(len_ref, layer_ref, wq_ref, newk_ref, newv_ref,
-                  ck_in, cv_in, *rest,
+def _fused_kernel(*refs,
                   scale: float, sliding_window: Optional[int], page: int,
-                  quantized: bool = False):
+                  quantized: bool = False, paged: bool = False):
+    if paged:
+        # paged arena: an extra scalar-prefetch ref carries the per-slot
+        # page table; DMA source pages are table lookups instead of
+        # contiguous row slices
+        len_ref, layer_ref, pt_ref, wq_ref, newk_ref, newv_ref, \
+            ck_in, cv_in, *rest = refs
+    else:
+        len_ref, layer_ref, wq_ref, newk_ref, newv_ref, \
+            ck_in, cv_in, *rest = refs
+        pt_ref = None
     if quantized:
         (ks_ref, vs_ref, out_ref, kbuf, vbuf, rsem) = rest
     else:
@@ -123,15 +132,18 @@ def _fused_kernel(len_ref, layer_ref, wq_ref, newk_ref, newv_ref,
     n_pages = lax.div(n_prev + page - 1, page)
 
     def get_dma(slot, p):
+        if paged:
+            # p is the slot's LOGICAL page index; the table maps it to
+            # the physical arena page (whole-page DMA)
+            phys = pt_ref[b, p]
+            src_k = ck_in.at[layer, phys, :, :]
+            src_v = cv_in.at[layer, phys, :, :]
+        else:
+            src_k = ck_in.at[layer, b, pl.ds(p * page, page), :]
+            src_v = cv_in.at[layer, b, pl.ds(p * page, page), :]
         return (
-            pltpu.make_async_copy(
-                ck_in.at[layer, b, pl.ds(p * page, page), :],
-                kbuf.at[slot], rsem.at[slot, 0],
-            ),
-            pltpu.make_async_copy(
-                cv_in.at[layer, b, pl.ds(p * page, page), :],
-                vbuf.at[slot], rsem.at[slot, 1],
-            ),
+            pltpu.make_async_copy(src_k, kbuf.at[slot], rsem.at[slot, 0]),
+            pltpu.make_async_copy(src_v, vbuf.at[slot], rsem.at[slot, 1]),
         )
 
     def scale_col(sref, p):
@@ -232,7 +244,8 @@ def fused_decode_attention(
     new_k: jax.Array,  # [S, F] post-rope current-token K rows
     new_v: jax.Array,  # [S, F]
     cache_k: jax.Array,  # [L, S, SEQ, F] FULL stacked cache, already
-    # containing the current rows at lengths-1 (caller scatter-appends)
+    # containing the current rows at lengths-1 (caller scatter-appends) —
+    # or, with ``page_table``, the [L, n_pages, page, F] paged arena
     cache_v: jax.Array,
     layer: jax.Array,  # [] i32 layer index
     lengths: jax.Array,  # [S] valid positions INCLUDING current token
@@ -240,47 +253,81 @@ def fused_decode_attention(
     *,
     scale: float,
     sliding_window: Optional[int] = None,
-    page: int = PAGE,
+    page: Optional[int] = None,
     cache_k_scale: Optional[jax.Array] = None,  # [L, S, SEQ] f32 when the
     # cache is int8 (per-row symmetric scales — models/transformer.py
-    # _quantize_rows; ref: llama.cpp cache_type_k/v q8_0)
+    # _quantize_rows; ref: llama.cpp cache_type_k/v q8_0) — paged:
+    # [L, n_pages, page] f32
     cache_v_scale: Optional[jax.Array] = None,
+    page_table: Optional[jax.Array] = None,  # [S, max_pages] i32: paged
+    # KV pool mode — each slot's logical pages resolve to physical arena
+    # pages through this table (scalar-prefetch operand, so DMA source
+    # addresses are computable before the body runs). Entries beyond a
+    # slot's allocation point at the trash page; its garbage is masked.
 ) -> jax.Array:
     """Ragged decode attention over ``[0, lengths)`` of layer ``layer``;
     the current token's K/V contribution is taken from ``new_k``/``new_v``
     in VMEM (its HBM copy is masked out). Returns attn [S, H*Dh]."""
-    L, S, SEQ, F = cache_k.shape
+    paged = page_table is not None
+    if page is None:
+        page = PAGE
+    if paged:
+        L, NP, PG, F = cache_k.shape
+        assert PG == page, (PG, page)
+        S, max_pages = page_table.shape
+    else:
+        L, S, SEQ, F = cache_k.shape
     H = q.shape[1]
     quantized = cache_k_scale is not None
     wq = build_block_diag_q(q, n_kv_heads)
     any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    nsp = 3 if paged else 2  # lengths, layer (+ page table)
+
+    def _bspec(shape):
+        if paged:
+            return pl.BlockSpec(shape, lambda b, lens, lay, pt: (b, 0, 0))
+        return pl.BlockSpec(shape, lambda b, lens, lay: (b, 0, 0))
+
     in_specs = [
-        pl.BlockSpec((1, F, H), lambda b, lens, lay: (b, 0, 0)),
-        pl.BlockSpec((1, 1, F), lambda b, lens, lay: (b, 0, 0)),
-        pl.BlockSpec((1, 1, F), lambda b, lens, lay: (b, 0, 0)),
+        _bspec((1, F, H)),
+        _bspec((1, 1, F)),
+        _bspec((1, 1, F)),
         any_spec,  # cache_k (HBM)
         any_spec,  # cache_v (HBM)
     ]
-    operands = [lengths, layer[None], wq, new_k[:, None, :],
-                new_v[:, None, :], cache_k, cache_v]
+    operands = [lengths, layer[None]]
+    if paged:
+        operands.append(page_table)
+    operands += [wq, new_k[:, None, :], new_v[:, None, :],
+                 cache_k, cache_v]
     if quantized:
-        # current layer's scale rows, paged [S, n_pages, page]: Pallas
-        # auto-pipelines each slot's block into VMEM (SEQ*4 bytes/slot)
-        npg = SEQ // page
-        ks_l = lax.dynamic_index_in_dim(
-            cache_k_scale, layer, 0, keepdims=False).reshape(S, npg, page)
-        vs_l = lax.dynamic_index_in_dim(
-            cache_v_scale, layer, 0, keepdims=False).reshape(S, npg, page)
-        in_specs += [
-            pl.BlockSpec((1, npg, page), lambda b, lens, lay: (b, 0, 0)),
-            pl.BlockSpec((1, npg, page), lambda b, lens, lay: (b, 0, 0)),
-        ]
+        if paged:
+            # per-slot scale pages gathered through the table ([S,
+            # max_pages, page] — logical page p of slot b lands at row
+            # p, matching the kernel's one-hot page selection)
+            npg = max_pages
+            ks_l = lax.dynamic_index_in_dim(
+                cache_k_scale, layer, 0, keepdims=False)[page_table]
+            vs_l = lax.dynamic_index_in_dim(
+                cache_v_scale, layer, 0, keepdims=False)[page_table]
+        else:
+            # current layer's scale rows, paged [S, n_pages, page]:
+            # Pallas auto-pipelines each slot's block into VMEM
+            # (SEQ*4 bytes/slot)
+            npg = SEQ // page
+            ks_l = lax.dynamic_index_in_dim(
+                cache_k_scale, layer, 0,
+                keepdims=False).reshape(S, npg, page)
+            vs_l = lax.dynamic_index_in_dim(
+                cache_v_scale, layer, 0,
+                keepdims=False).reshape(S, npg, page)
+        in_specs += [_bspec((1, npg, page)), _bspec((1, npg, page))]
         operands += [ks_l, vs_l]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=nsp,
         grid=(S,),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, H, F), lambda b, lens, lay: (b, 0, 0)),
+        out_specs=_bspec((1, H, F)),
         scratch_shapes=[
             pltpu.VMEM((2, page, F), cache_k.dtype),
             pltpu.VMEM((2, page, F), cache_v.dtype),
@@ -289,7 +336,7 @@ def fused_decode_attention(
     )
     kernel = functools.partial(
         _fused_kernel, scale=scale, sliding_window=sliding_window,
-        page=page, quantized=quantized,
+        page=page, quantized=quantized, paged=paged,
     )
     out = pl.pallas_call(
         kernel,

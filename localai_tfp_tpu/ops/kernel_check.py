@@ -97,6 +97,112 @@ def check_decode_attention(quantized: bool = False,
     return float(jnp.max(jnp.abs(got - want)))
 
 
+def check_paged_gather(quantized: bool = False, seed: int = 0) -> float:
+    """Paged-path parity: scatter a dense ragged cache into a paged
+    arena under a shuffled page table, then compare BOTH paged reads —
+    the XLA gather (models.transformer.gather_kv_pages, the fallback
+    serving path) and the page-table-indirect fused kernel — against
+    the dense reference. The gather must be EXACT (pure indexing); the
+    kernel must match the dense-kernel tolerance. Returns the max abs
+    error across both."""
+    import jax.numpy as jnp
+
+    from ..models.transformer import (
+        KVCache, _quantize_rows, gather_kv_pages,
+    )
+    from .decode_attention import fused_decode_attention
+
+    rng = np.random.default_rng(seed)
+    L, S, SEQ, n_kv, dh, H = 2, 8, 512, 8, 128, 32
+    page = 128
+    F = n_kv * dh
+    n_logical = SEQ // page
+    lengths = np.asarray(rng.integers(1, SEQ, S), np.int32)
+    cache_k = rng.standard_normal((L, S, SEQ, F)) * 0.5
+    cache_v = rng.standard_normal((L, S, SEQ, F)) * 0.5
+    for s in range(S):
+        cache_k[:, s, lengths[s]:] = 0
+        cache_v[:, s, lengths[s]:] = 0
+    # shuffled page table: page 0 reserved as trash, every (slot,
+    # logical page) maps to a distinct physical page in random order
+    n_pages = S * n_logical + 1
+    perm = rng.permutation(np.arange(1, n_pages))
+    pt = perm.reshape(S, n_logical).astype(np.int32)
+    arena_k = np.zeros((L, n_pages, page, F), cache_k.dtype)
+    arena_v = np.zeros((L, n_pages, page, F), cache_v.dtype)
+    for s in range(S):
+        for p in range(n_logical):
+            arena_k[:, pt[s, p]] = cache_k[:, s, p * page:(p + 1) * page]
+            arena_v[:, pt[s, p]] = cache_v[:, s, p * page:(p + 1) * page]
+    q = jnp.asarray(rng.standard_normal((S, H, dh)) * 0.5, jnp.float32)
+    layer = jnp.asarray(1, jnp.int32)
+    new_k = jnp.asarray(
+        np.stack([cache_k[1, s, lengths[s] - 1] for s in range(S)]),
+        jnp.float32)
+    new_v = jnp.asarray(
+        np.stack([cache_v[1, s, lengths[s] - 1] for s in range(S)]),
+        jnp.float32)
+    scale = 1.0 / np.sqrt(dh)
+    pt_j = jnp.asarray(pt)
+    if quantized:
+        kq, ks = _quantize_rows(jnp.asarray(cache_k, jnp.float32))
+        vq, vs = _quantize_rows(jnp.asarray(cache_v, jnp.float32))
+        aq_k = np.zeros((L, n_pages, page, F), np.int8)
+        aq_v = np.zeros((L, n_pages, page, F), np.int8)
+        as_k = np.zeros((L, n_pages, page), np.float32)
+        as_v = np.zeros((L, n_pages, page), np.float32)
+        kq_n, vq_n = np.asarray(kq), np.asarray(vq)
+        ks_n, vs_n = np.asarray(ks), np.asarray(vs)
+        for s in range(S):
+            for p in range(n_logical):
+                sl = slice(p * page, (p + 1) * page)
+                aq_k[:, pt[s, p]] = kq_n[:, s, sl]
+                aq_v[:, pt[s, p]] = vq_n[:, s, sl]
+                as_k[:, pt[s, p]] = ks_n[:, s, sl]
+                as_v[:, pt[s, p]] = vs_n[:, s, sl]
+        arena = KVCache(k=jnp.asarray(aq_k), v=jnp.asarray(aq_v),
+                        k_scale=jnp.asarray(as_k),
+                        v_scale=jnp.asarray(as_v))
+        win = gather_kv_pages(arena, pt_j, page)
+        gerr = max(
+            float(jnp.max(jnp.abs(win.k.astype(jnp.int32)
+                                  - kq.astype(jnp.int32)))),
+            float(jnp.max(jnp.abs(win.k_scale - ks))),
+        )
+        if gerr > 0:
+            return gerr  # indexing bug: report it, skip the kernel leg
+        got = fused_decode_attention(
+            q.astype(jnp.bfloat16), new_k.astype(jnp.bfloat16),
+            new_v.astype(jnp.bfloat16), arena.k, arena.v, layer,
+            jnp.asarray(lengths), n_kv, scale=scale, page=page,
+            cache_k_scale=arena.k_scale, cache_v_scale=arena.v_scale,
+            page_table=pt_j,
+        )
+        deq_k = kq.astype(jnp.float32) * ks[..., None]
+        deq_v = vq.astype(jnp.float32) * vs[..., None]
+        want = _ref_decode_attention(
+            q, deq_k, deq_v, 1, jnp.asarray(lengths), n_kv, scale)
+    else:
+        arena = KVCache(k=jnp.asarray(arena_k, jnp.bfloat16),
+                        v=jnp.asarray(arena_v, jnp.bfloat16))
+        dense_k = jnp.asarray(cache_k, jnp.bfloat16)
+        dense_v = jnp.asarray(cache_v, jnp.bfloat16)
+        win = gather_kv_pages(arena, pt_j, page)
+        gerr = float(jnp.max(jnp.abs(
+            win.k.astype(jnp.float32) - dense_k.astype(jnp.float32))))
+        if gerr > 0:
+            return gerr
+        got = fused_decode_attention(
+            q.astype(jnp.bfloat16), new_k.astype(jnp.bfloat16),
+            new_v.astype(jnp.bfloat16), arena.k, arena.v, layer,
+            jnp.asarray(lengths), n_kv, scale=scale, page=page,
+            page_table=pt_j,
+        )
+        want = _ref_decode_attention(
+            q, dense_k, dense_v, 1, jnp.asarray(lengths), n_kv, scale)
+    return float(jnp.max(jnp.abs(got - want)))
+
+
 def check_int8_matmul(seed: int = 0) -> float:
     """Max abs error of the fused Pallas dequant-matmul vs the XLA
     upcast path."""
@@ -125,10 +231,17 @@ def run_kernel_checks() -> dict[str, Any]:
             check_decode_attention(False), 5)
         out["decode_attention_int8_max_err"] = round(
             check_decode_attention(True), 5)
+        out["paged_gather_max_err"] = round(check_paged_gather(False), 5)
+        out["paged_gather_int8_max_err"] = round(
+            check_paged_gather(True), 5)
         out["int8_matmul_max_err"] = round(check_int8_matmul(), 5)
         out["ok"] = (
             out["decode_attention_max_err"] < 2e-2
             and out["decode_attention_int8_max_err"] < 5e-2
+            # paged kernel reads the same values through the table, so
+            # its tolerance matches the dense kernel's
+            and out["paged_gather_max_err"] < 2e-2
+            and out["paged_gather_int8_max_err"] < 5e-2
             and out["int8_matmul_max_err"] < 0.25
         )
     except Exception as e:  # a crash IS the finding — record it

@@ -236,6 +236,30 @@ def build_app(state: Application) -> web.Application:
     return app
 
 
+def pid_file_path(state: Application) -> "os.PathLike | str":
+    import os
+
+    return os.path.join(state.config.state_dir, "server.pid")
+
+
 def run(state: Application) -> None:
+    import os
+
     app = build_app(state)
-    web.run_app(app, host=state.config.address, port=state.config.port)
+    # pid file lives under the configurable state dir (default ./run),
+    # never the CWD, and is removed on ANY exit path — including the
+    # signal-driven ones web.run_app translates into a normal return —
+    # so an unclean shutdown cannot strand a stale server.pid where it
+    # would get committed or shadow a later instance
+    pidfile = pid_file_path(state)
+    os.makedirs(state.config.state_dir, exist_ok=True)
+    with open(pidfile, "w") as f:
+        f.write(str(os.getpid()))
+    try:
+        web.run_app(app, host=state.config.address,
+                    port=state.config.port)
+    finally:
+        try:
+            os.unlink(pidfile)
+        except OSError:
+            pass

@@ -142,6 +142,35 @@ ENGINE_KV_RESIDENT_PREFIX = REGISTRY.gauge(
     "active) — the cross-slot cache's working set",
     labels=("model",),
 )
+# paged KV pool (engine/kv_pool.py + the paged dispatch paths)
+ENGINE_KV_PAGES_IN_USE = REGISTRY.gauge(
+    "engine_kv_pages_in_use_count",
+    "Distinct KV pool pages currently allocated (arena occupancy; the "
+    "trash page is excluded)",
+    labels=("model",),
+)
+ENGINE_KV_PAGES_SHARED = REGISTRY.gauge(
+    "engine_kv_pages_shared_count",
+    "KV pool pages referenced by more than one slot's page table "
+    "(zero-copy prefix shares currently live)",
+    labels=("model",),
+)
+ENGINE_KV_PAGE_ALLOC = REGISTRY.counter(
+    "engine_kv_page_alloc_total",
+    "KV pool page-allocation events by outcome (fresh = new private "
+    "page, shared = table entry added by zero-copy prefix share, cow = "
+    "copy-on-write privatization of a shared boundary page, reclaimed "
+    "= a free slot's resident prefix dropped under pool pressure, "
+    "exhausted = allocation failed even after reclaim)",
+    labels=("model", "outcome"),
+)
+ENGINE_KV_HBM_PER_TOKEN = REGISTRY.gauge(
+    "engine_kv_hbm_per_live_token_bytes",
+    "KV HBM allocated per live (resident) token — pool pages in use x "
+    "page x per-token row bytes / resident tokens; the dense cache "
+    "pins this at max_seq/mean_context x the ideal",
+    labels=("model",),
+)
 # stall-free mixed prefill+decode dispatch (engine._enqueue_mixed)
 ENGINE_MIXED_DISPATCH = REGISTRY.counter(
     "engine_mixed_dispatch_total",

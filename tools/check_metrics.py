@@ -39,6 +39,20 @@ _SUFFIXES = {
     "gauge": ("_seconds", "_bytes", "_count", "_ratio", "_info"),
 }
 
+# rate/intensity gauges: a unit suffix followed by a `_per_<x>`
+# qualifier (Prometheus bytes_per_second convention) is also valid
+_PER_GAUGE = re.compile(r"_(seconds|bytes|count)_per_[a-z0-9_]+$")
+
+# families that MUST exist (removing one silently breaks dashboards
+# and the bench's extra blocks): the paged-KV pool series introduced
+# with the block-granular HBM allocator
+REQUIRED_FAMILIES = {
+    "engine_kv_pages_in_use_count",
+    "engine_kv_pages_shared_count",
+    "engine_kv_page_alloc_total",
+    "engine_kv_hbm_per_live_token_bytes",
+}
+
 
 def find_registrations() -> list[tuple[str, str, str]]:
     """(kind, name, file) for every literal registration in the
@@ -67,7 +81,8 @@ def main(argv=None) -> int:
         if not _SNAKE.match(name):
             problems.append(
                 f"{where}: metric '{name}' is not snake_case")
-        if not name.endswith(_SUFFIXES[kind]):
+        if not name.endswith(_SUFFIXES[kind]) and not (
+                kind == "gauge" and _PER_GAUGE.search(name)):
             problems.append(
                 f"{where}: {kind} '{name}' lacks a unit suffix "
                 f"(one of {', '.join(_SUFFIXES[kind])})")
@@ -75,6 +90,11 @@ def main(argv=None) -> int:
             problems.append(
                 f"{where}: metric '{name}' is not documented in the "
                 f"README.md Observability table (add a `{name}` row)")
+    missing = REQUIRED_FAMILIES - {name for _, name, _ in regs}
+    for name in sorted(missing):
+        problems.append(
+            f"required metric family '{name}' is not registered "
+            "anywhere under localai_tfp_tpu/")
     if problems:
         for p in problems:
             print(f"check_metrics: {p}", file=sys.stderr)

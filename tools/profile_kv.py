@@ -1,0 +1,258 @@
+"""Paged KV pool profiler: occupancy, sharing, HBM-per-live-token.
+
+The paged pool's whole point is that HBM follows LIVE tokens instead of
+worst-case context and that shared prefixes cost refcount bumps instead
+of row copies. This tool measures both claims under the two traffic
+shapes that stress them:
+
+  python tools/profile_kv.py --shared-prefix [--small] \
+      [--requests N] [--prefix-tokens P]
+
+drives a burst of N requests sharing a P-token prefix, then N fully
+distinct requests, straight through the engine scheduler. Reports, per
+burst: page-allocation outcomes (fresh / zero-copy shared / COW),
+kvcopy dispatches (whole-page shares must need ZERO for the aligned
+prefix body), peak pool occupancy, share ratio (refs vs distinct
+pages), and HBM bytes per live token.
+
+  python tools/profile_kv.py --mixed [--small] \
+      [--streams N] [--bursts K] [--burst-size B]
+
+sustains N decode streams while injecting K admission bursts of B
+requests, sampling the pool every 50 ms. Reports peak/mean occupancy
+and HBM-per-live-token across the run — the series that shows the
+arena tracking expected context while traffic churns.
+
+``--small`` runs the tiny CPU config (smoke) with a 16-token page so
+page-granular sharing is visible at toy prompt lengths.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue as _queue
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class _CopySpy:
+    """Count kvcopy dispatches at the engine._run layer — ground truth
+    for the zero-copy claim (telemetry is cross-checked against it)."""
+
+    def __init__(self, eng):
+        self.eng = eng
+        self.copies = 0
+        self._orig = eng._run
+        eng._run = self._run
+
+    def reset(self):
+        self.copies = 0
+
+    def _run(self, kind, payload):
+        if kind == "kvcopy":
+            self.copies += 1
+        return self._orig(kind, payload)
+
+
+def _pool_block(eng) -> dict:
+    from bench import _paged_kv_extra
+
+    return _paged_kv_extra(eng)
+
+
+def _drain_all(qs, timeout=300):
+    pending = list(qs)
+    while pending:
+        nxt = []
+        for q in pending:
+            done = False
+            while True:
+                try:
+                    ev = q.get_nowait()
+                except _queue.Empty:
+                    break
+                if ev.done:
+                    if ev.error:
+                        raise RuntimeError(ev.error)
+                    done = True
+                    break
+            if not done:
+                nxt.append(q)
+        pending = nxt
+        if pending:
+            time.sleep(0.002)
+
+
+def _build(small: bool):
+    if small:
+        # 16-token pages: page-run sharing becomes visible at toy
+        # prompt lengths (the default 256-token page needs a 256-token
+        # aligned prefix before the first zero-copy share)
+        os.environ.setdefault("LOCALAI_KV_PAGE", "16")
+    from tools.profile_ttft import build_engine
+
+    return build_engine(small)
+
+
+def shared_prefix_shape(small: bool, n_req: int,
+                        prefix_tokens: int) -> dict:
+    from localai_tfp_tpu.engine.engine import GenRequest
+    from localai_tfp_tpu.engine.prefix_index import PrefixIndex
+
+    eng, tok, _, _ = _build(small)
+    if small:
+        n_req = min(n_req, eng.n_slots)
+        prefix_tokens = min(prefix_tokens, eng.max_seq // 2)
+    n_tok = 8 if small else 32
+    spy = _CopySpy(eng)
+    out: dict = {"paged": getattr(eng, "_paged", False),
+                 "page_tokens": getattr(eng, "_page", None)}
+    shared = "S" * prefix_tokens
+    shapes = {
+        "shared": [shared + f" req {i:03d}" for i in range(n_req)],
+        "distinct": [f"{i:03d} " + os.urandom(8).hex() + " distinct"
+                     for i in range(n_req)],
+    }
+    try:
+        # warm pass compiles every dispatch variant the measured waves
+        # hit, so wave timing reflects the allocator, not the jit
+        _drain_all(eng.submit_many([
+            GenRequest(prompt_ids=tok.encode(c), max_tokens=n_tok,
+                       temperature=0.0, ignore_eos=True)
+            for c in shapes["shared"]]))
+        for name, contents in shapes.items():
+            # cold start per shape: drop residents so occupancy and
+            # sharing are attributable to THIS wave
+            for s in eng.slots:
+                s.cache_tokens = []
+                s.n_past = 0
+                if eng._paged:
+                    eng._pool.drop(s.idx)
+            eng._prefix_index = PrefixIndex()
+            spy.reset()
+            alloc0 = (dict(eng._pool.allocs) if eng._paged else {})
+            # donor first (its KV must be resident before sharers), then
+            # the sharer wave
+            _drain_all(eng.submit_many([GenRequest(
+                prompt_ids=tok.encode(contents[0]), max_tokens=n_tok,
+                temperature=0.0, ignore_eos=True)]))
+            _drain_all(eng.submit_many([
+                GenRequest(prompt_ids=tok.encode(c), max_tokens=n_tok,
+                           temperature=0.0, ignore_eos=True)
+                for c in contents[1:]]))
+            blk = _pool_block(eng)
+            if eng._paged:
+                blk["alloc"] = {k: v - alloc0.get(k, 0)
+                                for k, v in eng._pool.allocs.items()}
+            blk["kv_copies"] = spy.copies
+            out[name] = blk
+        if out["paged"]:
+            sh = out["shared"]
+            sh["share_ratio"] = round(
+                sh["page_refs"] / max(sh["pages_in_use"], 1), 3)
+    finally:
+        eng.close()
+    return out
+
+
+def mixed_shape(small: bool, n_streams: int, n_bursts: int,
+                burst_size: int) -> dict:
+    from localai_tfp_tpu.engine.engine import GenRequest
+
+    eng, tok, _, _ = _build(small)
+    n_streams = min(n_streams, max(1, eng.n_slots // 2))
+    burst_size = min(burst_size, max(1, eng.n_slots - n_streams))
+    n_tok = 48 if small else 128
+    bp = "burst " * max(1, min(eng.max_seq // 2, 256) // 6)
+    out: dict = {"paged": getattr(eng, "_paged", False),
+                 "page_tokens": getattr(eng, "_page", None),
+                 "streams": n_streams, "bursts": n_bursts,
+                 "burst_size": burst_size}
+    samples: list[tuple[int, float]] = []  # (pages_in_use, hbm/tok)
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.wait(0.05):
+            if not eng._paged:
+                continue
+            st = eng._pool.stats()
+            live = sum(len(s.cache_tokens) for s in eng.slots)
+            c = eng.cache
+            tb = 2 * c.k.dtype.itemsize * c.k.shape[0] * c.k.shape[-1]
+            if c.quantized:
+                tb += 2 * 4 * c.k.shape[0]
+            samples.append((st.in_use,
+                            st.in_use * eng._page * tb / max(live, 1)))
+
+    try:
+        # warm compile pass
+        _drain_all(eng.submit_many([GenRequest(
+            prompt_ids=tok.encode(bp + "w"), max_tokens=4,
+            temperature=0.0, ignore_eos=True)]))
+        t = threading.Thread(target=sampler, daemon=True)
+        t.start()
+        qs = eng.submit_many([
+            GenRequest(prompt_ids=tok.encode(f"stream {i:02d}"),
+                       max_tokens=n_tok, temperature=0.0,
+                       ignore_eos=True)
+            for i in range(n_streams)])
+        burst_qs = []
+        for j in range(n_bursts):
+            time.sleep(0.1)
+            burst_qs += eng.submit_many([
+                GenRequest(prompt_ids=tok.encode(bp + f"{j}-{b}"),
+                           max_tokens=8, temperature=0.0,
+                           ignore_eos=True)
+                for b in range(burst_size)])
+        _drain_all(qs + burst_qs)
+        stop.set()
+        t.join(timeout=2)
+        blk = _pool_block(eng)
+        if samples:
+            occ = [s[0] for s in samples]
+            hbm = [s[1] for s in samples]
+            blk["pages_in_use_peak"] = max(occ)
+            blk["pages_in_use_mean"] = round(sum(occ) / len(occ), 1)
+            blk["hbm_bytes_per_live_token_peak"] = round(max(hbm), 1)
+            blk["hbm_bytes_per_live_token_mean"] = round(
+                sum(hbm) / len(hbm), 1)
+        out["pool"] = blk
+    finally:
+        stop.set()
+        eng.close()
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--small", action="store_true",
+                    help="tiny CPU config (smoke)")
+    ap.add_argument("--shared-prefix", action="store_true",
+                    help="shared-prefix burst vs distinct burst")
+    ap.add_argument("--mixed", action="store_true",
+                    help="sustained streams + admission bursts")
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--prefix-tokens", type=int, default=96)
+    ap.add_argument("--streams", type=int, default=4)
+    ap.add_argument("--bursts", type=int, default=3)
+    ap.add_argument("--burst-size", type=int, default=4)
+    args = ap.parse_args()
+    if not (args.shared_prefix or args.mixed):
+        ap.error("pick a traffic shape: --shared-prefix and/or --mixed")
+    report: dict = {}
+    if args.shared_prefix:
+        report["shared_prefix"] = shared_prefix_shape(
+            args.small, args.requests, args.prefix_tokens)
+    if args.mixed:
+        report["mixed"] = mixed_shape(args.small, args.streams,
+                                      args.bursts, args.burst_size)
+    print(json.dumps(report, indent=1), flush=True)
+
+
+if __name__ == "__main__":
+    main()
