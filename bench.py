@@ -160,12 +160,73 @@ def _paged_kv_extra(eng) -> dict:
     }
 
 
+def _ragged_attn_extra(eng, mixed_itl_block, decode_tok_s) -> dict:
+    """Ragged paged attention effectiveness (extra.ragged_attn): the
+    serving engine's mode and warmup-precompiled jit-variant count next
+    to the decode throughput and mixed ITL p95 measured on the SAME
+    engine — the acceptance series for the one-kernel unification
+    (variant count collapses; decode tok/s and mixed ITL must not
+    regress vs the windowed ladder)."""
+    return {
+        "enabled": bool(getattr(eng, "_ragged", False)),
+        "warmup_variants": int(getattr(eng, "warmup_variants", 0)),
+        "decode_tok_s": decode_tok_s,
+        "mixed_itl_p95_ms": (mixed_itl_block or {}).get("itl_p95_ms"),
+    }
+
+
+def _ragged_warmup_compare(spec, params, tok) -> dict:
+    """Warmup wall time + compiled variant count, ragged on vs off, on
+    a dedicated small engine pair (max_seq above the 256 window floor
+    so the legacy ladder is real). CPU-smoke only — at 8B scale the
+    off-ladder warmup alone costs minutes of compiles, which is the
+    point this block documents."""
+    import time as _time
+
+    import jax.numpy as _jnp
+
+    from localai_tfp_tpu.engine.engine import LLMEngine
+
+    out = {}
+    for ragged in (True, False):
+        eng = LLMEngine(spec, params, tok, n_slots=2, max_seq=1024,
+                        prefill_buckets=(8,), decode_steps=2,
+                        cache_dtype=_jnp.float32, autostart=False)
+        eng._ragged = ragged and eng._paged
+        t0 = _time.perf_counter()
+        eng.warmup()
+        key = "on" if ragged else "off"
+        out[f"variants_{key}"] = eng.warmup_variants
+        out[f"warmup_s_{key}"] = round(_time.perf_counter() - t0, 2)
+        eng.close()
+    return out
+
+
+def ragged_variant_report() -> dict:
+    """Standalone variant-collapse report on a tiny model: warmup wall
+    time + compiled jit-variant count, ragged on vs off. Shared by
+    tools/profile_http.py --mixed and tools/profile_kv.py so the
+    compile-variant kill is observable without a full bench run."""
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    from localai_tfp_tpu.engine.tokenizer import ByteTokenizer
+    from localai_tfp_tpu.models.llm_spec import tiny_spec
+    from localai_tfp_tpu.models.transformer import init_params
+
+    tk = ByteTokenizer()
+    spec = tiny_spec(vocab_size=tk.vocab_size, max_position=1024)
+    params = init_params(_jax.random.PRNGKey(0), spec,
+                         dtype=_jnp.float32)
+    return _ragged_warmup_compare(spec, params, tk)
+
+
 # extras that measure the LIVE serving engine: _bench_http's teardown
 # (runner.cleanup()) fires the app cleanup that CLOSES it, so these must
 # be recorded first. _bench_http enforces the order (it was a
 # comment-only gotcha through PR 4; measuring a closed engine reports
 # garbage silently).
-_LIVE_ENGINE_EXTRAS = ("mixed_itl", "paged_kv")
+_LIVE_ENGINE_EXTRAS = ("mixed_itl", "paged_kv", "ragged_attn")
 
 
 def _mixed_itl_extra(eng, tok, n_tok=96) -> dict:
@@ -879,6 +940,12 @@ def main() -> None:
             # sets no kv_pages), so this block tracks occupancy and
             # sharing; the capacity multiple lives in extra.paged_kv
             extra["paged_kv_8b"] = _paged_kv_extra(eng8)
+            # ragged unification acceptance block: mode + variant count
+            # + the throughput/ITL numbers measured above on this
+            # engine (warmup_variants is 0 when the persistent-cache
+            # marker skipped the pass)
+            extra["ragged_attn"] = _ragged_attn_extra(
+                eng8, extra["mixed_itl"], tok_s8)
             tok_s, p50_h, p95_h, p50_steady = _bench_http(
                 state, "bench8b", 64, 512, runs=2, extra=extra)
             extra["ttft_p50_ms_8b_http"] = p50_h
@@ -911,6 +978,13 @@ def main() -> None:
         # closes the serving engine via app cleanup)
         extra["mixed_itl"] = _mixed_itl_extra(eng, tok)
         extra["paged_kv"] = _paged_kv_extra(eng)
+        extra["ragged_attn"] = _ragged_attn_extra(
+            eng, extra["mixed_itl"], tok_s_eng)
+        # the variant-collapse made visible on the smoke: warmup wall
+        # time + compiled variant count, ragged on vs off, on a
+        # dedicated small engine pair
+        extra["ragged_attn"]["warmup"] = _ragged_warmup_compare(
+            spec, params, tok)
         # smoke HTTP leg: a minimal Application with the in-memory
         # engine registered (the TPU leg exercises the full disk-loader
         # path; here the endpoint plumbing is what's smoke-tested)

@@ -36,6 +36,14 @@ TPU-first re-design rather than translation:
   admission wave never stalls active streams. Escape hatch:
   LOCALAI_MIXED_DISPATCH=off restores the legacy alternating scheduler
   (see the README "Scheduling" section).
+- Paged engines serve every row kind — decode rows, prefill chunks,
+  prefill finals, spec-decode verify rows — through ONE ragged paged
+  attention path (ops/ragged_paged_attention.py): page tables ride
+  dispatches at FULL width, so the jit cache holds one variant per
+  token-budget shape (no bucket x window ladder) and kernel-eligible
+  engines never materialize a gathered KV window on the prefill/mixed
+  hot path. LOCALAI_RAGGED_ATTN=off restores the legacy windowed
+  paths byte-identically (see the README "Kernels" section).
 """
 
 from __future__ import annotations
@@ -460,6 +468,24 @@ class LLMEngine:
                 KVCache.create(draft[0], n_slots, max_seq, cache_dtype)
                 if draft is not None else None
             )
+        # Ragged paged attention (ops/ragged_paged_attention.py): every
+        # dispatch kind — decode scans, prefill chunks, prefill finals,
+        # mixed steps, spec-decode verify — pins its page tables to
+        # FULL table width (max_seq // page entries), so the jit cache
+        # holds ONE variant per token-budget shape instead of the
+        # bucket x window ladder, and kernel-eligible engines route
+        # every row kind through the ONE ragged Pallas kernel (no
+        # materialized gather_kv_pages window on the prefill/mixed hot
+        # path). CPU/meshed/ineligible engines keep the XLA
+        # gather/scatter fallback at full width — same values, still
+        # one variant per shape. LOCALAI_RAGGED_ATTN=off restores the
+        # legacy windowed paths byte-identically.
+        self._ragged = self._paged and _os.environ.get(
+            "LOCALAI_RAGGED_ATTN", "on").lower() not in (
+            "0", "off", "false")
+        self.warmup_variants = 0  # dispatch variants precompiled by the
+        # last completed warmup() pass (engine_dispatch_compile_variants
+        # gauge; 0 until warmup runs or when it was marker-skipped)
         self._alloc_sync: dict[str, int] = {}  # pool alloc counters
         # already exported to engine_kv_page_alloc_total
         self.sampling = SamplingState.create(
@@ -531,7 +557,17 @@ class LLMEngine:
             @partial(jax.jit, donate_argnums=(2, 5))
             def _decode(params, tokens, cache, pos0, slot_ids, sampling,
                         active, masks, phys, wb):
-                if self._use_kernel:
+                if self._use_kernel and self._ragged:
+                    # unified ragged kernel: q_len 1 per row, writes
+                    # routed through wb (parked rows append to trash
+                    # instead of their own tail pages)
+                    logits, cache = forward(
+                        spec, params, tokens, pos0, cache, None,
+                        page_table=phys, kv_page=_page,
+                        q_lens=jnp.ones(tokens.shape[:1], jnp.int32),
+                        write_table=wb,
+                    )
+                elif self._use_kernel:
                     # arena + page table straight into the fused kernel
                     # (the append routes through the table in-graph)
                     logits, cache = forward(
@@ -775,11 +811,19 @@ class LLMEngine:
         dspec = self.draft[0]  # static; draft params passed per call
         paged = self._paged
         page = self._page
+        ragged_k = self._ragged and self._use_kernel
 
         @partial(jax.jit, donate_argnums=(2, 3))
         def _spec(params, dparams, cache, dcache, tokens, pos0, active,
                   *paged_tables):
-            if paged:
+            phys = wb = None
+            if paged and ragged_k:
+                # ragged kernel: verify rows are q_len == kd ragged rows
+                # through the SAME kernel as decode/prefill; draft steps
+                # are q_len == 1 rows. No gathered views — writes route
+                # through wb (ineligible rows' spans are trash).
+                phys, wb = paged_tables
+            elif paged:
                 # full-width gathered views for both caches; the arena
                 # writeback at the end persists only the eligible rows'
                 # verify/draft spans (wb)
@@ -787,13 +831,21 @@ class LLMEngine:
                 phys, wb = paged_tables
                 cache = gather_kv_pages(arena, phys, page)
                 dcache = gather_kv_pages(darena, phys, page)
+            ones = jnp.ones(tokens.shape[:1], jnp.int32)
+
+            def rag(n):
+                if not ragged_k:
+                    return {}
+                return {"page_table": phys, "kv_page": page,
+                        "q_lens": ones * n, "write_table": wb}
 
             def round_(carry, _):
                 tok, pos, cache, dcache = carry
 
                 def dstep(c, _):
                     t, p, dc = c
-                    lg, dc = forward(dspec, dparams, t, p, dc, None)
+                    lg, dc = forward(dspec, dparams, t, p, dc, None,
+                                     **rag(1))
                     nt = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
                     p2 = jnp.where(active, p + 1, p)
                     return (nt[:, None], p2, dc), nt
@@ -807,7 +859,8 @@ class LLMEngine:
                     dstep, (tok, pos, dcache), None, length=kd)
                 d_toks = dts[: kd - 1].T  # [S, kd-1]
                 xin = jnp.concatenate([tok, d_toks], axis=1)  # [S, kd]
-                lg, cache2 = forward(spec, params, xin, pos, cache, None)
+                lg, cache2 = forward(spec, params, xin, pos, cache, None,
+                                     **rag(kd))
                 m_toks = jnp.argmax(lg, -1).astype(jnp.int32)  # [S, kd]
                 ok = (m_toks[:, : kd - 1] == d_toks).astype(jnp.int32)
                 j = 1 + jnp.cumprod(ok, axis=1).sum(1)  # [S] in 1..kd
@@ -819,7 +872,7 @@ class LLMEngine:
 
             (tok_f, pos_f, cache, dcache), (D, Mt, J) = lax.scan(
                 round_, (tokens, pos0, cache, dcache), None, length=rounds)
-            if paged:
+            if paged and not ragged_k:
                 cache = scatter_kv_pages(arena, cache, wb, page)
                 dcache = scatter_kv_pages(darena, dcache, wb, page)
             return D, Mt, J, tok_f, pos_f, cache, dcache
@@ -864,24 +917,36 @@ class LLMEngine:
 
         paged = self._paged
         page = self._page
+        ragged_k = self._ragged and self._use_kernel
 
         @partial(jax.jit, donate_argnums=(3, 4))
         def _spec_s(params, dparams, sampling, cache, dcache, tokens, pos0,
                     active, *paged_tables):
-            if paged:
+            phys = wb = None
+            if paged and ragged_k:
+                phys, wb = paged_tables
+            elif paged:
                 arena, darena = cache, dcache
                 phys, wb = paged_tables
                 cache = gather_kv_pages(arena, phys, page)
                 dcache = gather_kv_pages(darena, phys, page)
             all_slots = jnp.arange(S, dtype=jnp.int32)
             rep_slots = jnp.repeat(all_slots, kd)
+            ones = jnp.ones(tokens.shape[:1], jnp.int32)
+
+            def rag(n):
+                if not ragged_k:
+                    return {}
+                return {"page_table": phys, "kv_page": page,
+                        "q_lens": ones * n, "write_table": wb}
 
             def round_(carry, _):
                 tok, pos, cache, dcache, rng = carry
 
                 def dstep(c, _):
                     t, p, dc, rng = c
-                    lg, dc = forward(dspec, dparams, t, p, dc, None)
+                    lg, dc = forward(dspec, dparams, t, p, dc, None,
+                                     **rag(1))
                     qp, qidx = filtered_candidates(
                         sampling, all_slots, lg[:, -1])
                     rng, k1 = split_rows(rng)
@@ -898,7 +963,8 @@ class LLMEngine:
                     dstep, (tok, pos, dcache, rng), None, length=kd)
                 d_toks = dts[: kd - 1].T  # [S, kd-1]
                 xin = jnp.concatenate([tok, d_toks], axis=1)  # [S, kd]
-                lg, cache2 = forward(spec, params, xin, pos, cache, None)
+                lg, cache2 = forward(spec, params, xin, pos, cache, None,
+                                     **rag(kd))
                 pp, pidx = filtered_candidates(
                     sampling, rep_slots, lg.reshape(S * kd, -1))
                 C = pp.shape[-1]
@@ -944,7 +1010,7 @@ class LLMEngine:
             (_, _, cache, dcache, rng), (D, Fin, J) = lax.scan(
                 round_, (tokens, pos0, cache, dcache, sampling.rng),
                 None, length=rounds)
-            if paged:
+            if paged and not ragged_k:
                 cache = scatter_kv_pages(arena, cache, wb, page)
                 dcache = scatter_kv_pages(darena, dcache, wb, page)
             return D, Fin, J, rng, cache, dcache
@@ -968,6 +1034,7 @@ class LLMEngine:
 
         if self._paged:
             page = self._page
+            ragged_k = self._ragged and self._use_kernel
 
             @partial(jax.jit, donate_argnums=(2,))
             def _prefill(params, tokens, cache, pos0, slot_ids, phys, wb,
@@ -977,6 +1044,18 @@ class LLMEngine:
                 # phys/wb instead of slot_ids
                 if soft is not None:
                     soft = _soft_expand(tokens, *soft)
+                if ragged_k:
+                    # ragged kernel: the chunk scatters through wb and
+                    # attention walks pages in-kernel — no gathered
+                    # window view (chunk dispatches are always
+                    # full-bucket wide, so q_lens is the bucket)
+                    qlens = jnp.full(tokens.shape[:1], tokens.shape[1],
+                                     jnp.int32)
+                    _, cache = forward_hidden(
+                        spec, params, tokens, pos0, cache, None,
+                        soft=soft, page_table=phys, kv_page=page,
+                        q_lens=qlens, write_table=wb)
+                    return cache
                 win = gather_kv_pages(cache, phys, page)
                 _, win = forward_hidden(spec, params, tokens, pos0, win,
                                         None, soft=soft)
@@ -1026,6 +1105,7 @@ class LLMEngine:
         n_slots = self.n_slots
         paged = self._paged
         page = self._page
+        ragged_k = self._ragged and self._use_kernel
 
         @partial(jax.jit, donate_argnums=(2, 4))
         def _prefill_final(params, tokens, cache, pos0, sampling, slot_ids,
@@ -1033,7 +1113,15 @@ class LLMEngine:
                            *paged_tables, soft=None):
             if soft is not None:
                 soft = _soft_expand(tokens, *soft)
-            if paged:
+            if paged and ragged_k:
+                # ragged kernel: n_chunk IS the per-row ragged query
+                # length (pad rows carry 1 and write to trash via wb)
+                phys, wb = paged_tables
+                hidden, cache = forward_hidden(
+                    spec, params, tokens, pos0, cache, None, soft=soft,
+                    page_table=phys, kv_page=page, q_lens=n_chunk,
+                    write_table=wb)
+            elif paged:
                 # paged: rows map to slots via phys/wb; parked and pad
                 # rows simply never write back (their wb pages are
                 # trash), so no write_mask is needed
@@ -1116,6 +1204,7 @@ class LLMEngine:
         spec = self.spec
         paged = self._paged
         page = self._page
+        ragged_k = self._ragged and self._use_kernel
 
         @partial(jax.jit, donate_argnums=(2, 4))
         def _mixed(params, tokens, cache, pos0, sampling, write_mask,
@@ -1123,7 +1212,17 @@ class LLMEngine:
                    masks, reset, *paged_tables, soft=None):
             if soft is not None:
                 soft = _soft_expand(tokens, *soft)
-            if paged:
+            if paged and ragged_k:
+                # the ragged batch in one kernel invocation: decode
+                # rows (n_chunk 1), prefill chunks, finals and parked
+                # rows (write to trash via wb) together — the unified
+                # dispatch RTP-LLM/Ragged-Paged-Attention converge on
+                phys, wb = paged_tables
+                hidden, cache = forward_hidden(
+                    spec, params, tokens, pos0, cache, None, soft=soft,
+                    page_table=phys, kv_page=page, q_lens=n_chunk,
+                    write_table=wb)
+            elif paged:
                 # paged: per-row write spans live in wb (parked rows and
                 # shared prefix pages are trash-redirected), so the
                 # write_mask no-op rewrite is unnecessary
@@ -1188,12 +1287,20 @@ class LLMEngine:
 
         if self._paged:
             page = self._page
+            ragged_k = self._ragged and self._use_kernel
 
             @partial(jax.jit, donate_argnums=(2,))
-            def _dp(dparams, tokens, dcache, pos0, slot_ids, phys, wb):
+            def _dp(dparams, tokens, dcache, pos0, slot_ids, phys, wb,
+                    qlens=None):
                 # the draft arena shares the main pool's page geometry
                 # and tables; wb carries ONLY the rows whose draft K/V
                 # must land (prefill rows — decode rows never mirror)
+                if ragged_k:
+                    _, dcache = forward(
+                        dspec, dparams, tokens, pos0, dcache, None,
+                        page_table=phys, kv_page=page, q_lens=qlens,
+                        write_table=wb)
+                    return dcache
                 win = gather_kv_pages(dcache, phys, page)
                 _, win = forward(dspec, dparams, tokens, pos0, win, None)
                 return scatter_kv_pages(dcache, win, wb, page)
@@ -1345,6 +1452,7 @@ class LLMEngine:
                 [(s.idx, ((s.n_past, s.n_past + span)
                           if s.idx in elig else None))
                  for s in self.slots], self.max_seq)
+        self._note_ragged_rows("verify", len(decoding))
         D, Mt, J = self._run("spec_s" if mode == "sampled" else "spec",
                              payload)
         D = np.asarray(D)  # [rounds, S, kd-1] draft candidates
@@ -1409,6 +1517,7 @@ class LLMEngine:
         if self._paged:
             page = self._page
             use_kernel = self._use_kernel
+            ragged_k = self._ragged and use_kernel
 
             @partial(jax.jit, donate_argnums=(2, 5))
             def _decode_k(params, tokens, cache, pos0, slot_ids, sampling,
@@ -1416,13 +1525,24 @@ class LLMEngine:
                 if use_kernel:
                     # fused kernel addresses the arena through the page
                     # table directly — no gather, the paged decode hot
-                    # path reads only live pages
+                    # path reads only live pages. Ragged mode routes the
+                    # append through wb (parked rows write to trash
+                    # instead of their own tail pages).
+                    ones = jnp.ones(tokens.shape[:1], jnp.int32)
+
                     def step(carry, _):
                         tokens, pos, cache, sampling = carry
-                        logits, cache = forward(
-                            spec, params, tokens, pos, cache, None, True,
-                            page_table=phys, kv_page=page,
-                        )
+                        if ragged_k:
+                            logits, cache = forward(
+                                spec, params, tokens, pos, cache, None,
+                                page_table=phys, kv_page=page,
+                                q_lens=ones, write_table=wb,
+                            )
+                        else:
+                            logits, cache = forward(
+                                spec, params, tokens, pos, cache, None,
+                                True, page_table=phys, kv_page=page,
+                            )
                         toks, sampling = _sample_masked(
                             sampling, slot_ids, logits[:, -1, :], active,
                             None)
@@ -1528,7 +1648,9 @@ class LLMEngine:
                 if self.draft is not None:
                     self.draft_cache = self._draft_prefill_fn()(
                         self.draft[1], toks, self.draft_cache, pos0,
-                        sids, pt, wb)
+                        sids, pt, wb,
+                        jnp.full(toks.shape[:1], toks.shape[1],
+                                 jnp.int32))
             else:
                 self.cache = fn(self.params, toks, self.cache, pos0,
                                 sids, soft=soft)
@@ -1562,7 +1684,7 @@ class LLMEngine:
                 if self._paged:
                     self.draft_cache = self._draft_prefill_fn()(
                         self.draft[1], toks, self.draft_cache, pos0,
-                        sids, pt, wb)
+                        sids, pt, wb, jnp.asarray(p["n_chunk"]))
                 else:
                     self.draft_cache = self._draft_prefill_fn()(
                         self.draft[1], toks, self.draft_cache, pos0, sids
@@ -1601,7 +1723,8 @@ class LLMEngine:
                     self.draft_cache = self._draft_prefill_fn()(
                         self.draft[1], toks, self.draft_cache, pos0,
                         jnp.asarray(p["prefill_sids"]), pt,
-                        jnp.asarray(p["wb_draft"]))
+                        jnp.asarray(p["wb_draft"]),
+                        jnp.asarray(p["n_chunk"]))
                 else:
                     self.draft_cache = self._draft_prefill_fn()(
                         self.draft[1], toks, self.draft_cache, pos0,
@@ -1735,6 +1858,9 @@ class LLMEngine:
             self._mixed,  # the mixed dispatcher adds its own variants
             # the paged pool changes every variant's cache geometry
             self._paged, self._page, self.kv_pages,
+            # ragged mode collapses the window ladder to one full-width
+            # variant per shape — a different compile set entirely
+            self._ragged,
         ))
         return hashlib.sha256(blob.encode()).hexdigest()[:20]
 
@@ -1788,14 +1914,29 @@ class LLMEngine:
             log.info("warmup skipped: variant set %s already in the "
                      "persistent compile cache", os.path.basename(marker))
             return
+        n_variants = 0
+
+        def _warm(kind, payload):
+            # every warmup dispatch compiles exactly one (fn, shape)
+            # jit variant; the count is the series the ragged unification
+            # collapses (engine_dispatch_compile_variants_count)
+            nonlocal n_variants
+            n_variants += 1
+            return self._run(kind, payload)
+
         W = self.sampling.window
         pad_reset = self._reset_columns([], 1)
-        win_ladder = []
-        w = self._window_bucket(1)
-        while w < self.max_seq:
-            win_ladder.append(w)
-            w *= 2
-        win_ladder.append(self.max_seq)
+        if self._ragged:
+            # ragged paged attention: tables are full-width, so there is
+            # NO window ladder — one variant per token-budget shape
+            win_ladder = [self.max_seq]
+        else:
+            win_ladder = []
+            w = self._window_bucket(1)
+            while w < self.max_seq:
+                win_ladder.append(w)
+                w *= 2
+            win_ladder.append(self.max_seq)
         for bucket in self.prefill_buckets:
             id_capable = (bucket * self.n_slots
                           <= self._PREFILL_GROUP_TOKENS)
@@ -1806,7 +1947,14 @@ class LLMEngine:
             # threshold at the pinned max_seq window
             variants: list[tuple[int, int, bool]] = []
             if id_capable:
-                variants += [(self.n_slots, w, True) for w in win_ladder]
+                # an identity final dispatch's window covers max(pos0)
+                # + bucket + 1, so ladder rungs below
+                # _window_bucket(bucket + 1) can never be dispatched —
+                # compiling them was pure dead warmup cost (at 8B,
+                # seconds per variant)
+                min_w = self._window_bucket(bucket + 1)
+                variants += [(self.n_slots, w, True) for w in win_ladder
+                             if w >= min_w]
             cap = self._prefill_group_cap(bucket)
             sizes = {cap}
             b = 1
@@ -1838,19 +1986,22 @@ class LLMEngine:
                     wp = win // self._page
                     payload["pt"] = np.zeros((B, wp), np.int32)
                     payload["wb"] = np.zeros((B, wp), np.int32)
-                self._run("prefill_final", payload)
+                _warm("prefill_final", payload)
         if self.max_seq > self.prefill_buckets[-1]:
             # long prompts chunk through the "prefill" fn at live-context
             # window buckets — compile those too, or the first long
             # prompt stalls on a mid-request jit. Chunk dispatches are
             # always full-bucket wide, so their windows start at the
             # bucket's own window bucket (window >= n_past + bucket).
-            w = self._window_bucket(self.prefill_buckets[-1])
-            windows = set()
-            while w < self.max_seq:
-                windows.add(w)
-                w *= 2
-            windows.add(self.max_seq)
+            if self._ragged:
+                windows = {self.max_seq}
+            else:
+                w = self._window_bucket(self.prefill_buckets[-1])
+                windows = set()
+                while w < self.max_seq:
+                    windows.add(w)
+                    w *= 2
+                windows.add(self.max_seq)
             seq_ax = (self.mesh.shape.get("seq", 1)
                       if self.mesh is not None else 1)
             rings = {False}
@@ -1871,17 +2022,26 @@ class LLMEngine:
                         wp = w // self._page
                         payload["pt"] = np.zeros((1, wp), np.int32)
                         payload["wb"] = np.zeros((1, wp), np.int32)
-                    self._run("prefill", payload)
+                    _warm("prefill", payload)
         if self._mixed:
             # mixed prefill+decode step variants: one per (bucket that
             # fits the identity budget, live-context window). All-pad
             # rows (write_mask False, sentinel sids) exercise the
             # identical jit shapes without touching engine state.
             S = self.n_slots
+            prev_bucket = 0
             for bucket in self._mixed_buckets:
                 reset = {k: np.repeat(v, S, axis=0)
                          for k, v in pad_reset.items()}
-                for w in win_ladder:
+                # a mixed dispatch only selects this bucket when some
+                # prefill row's remainder EXCEEDS the previous bucket,
+                # so its window covers at least prev_bucket + 2 —
+                # smaller ladder rungs can never be dispatched for this
+                # bucket (dead compile cost pruned; in ragged mode the
+                # ladder is already the single full-width rung)
+                min_w = self._window_bucket(prev_bucket + 2)
+                prev_bucket = bucket
+                for w in [w for w in win_ladder if w >= min_w]:
                     payload = {
                         "toks": np.zeros((S, bucket), np.int32),
                         "pos0": np.zeros((S,), np.int32),
@@ -1900,17 +2060,17 @@ class LLMEngine:
                         payload["pt"] = np.zeros((S, wp), np.int32)
                         payload["wb"] = np.zeros((S, wp), np.int32)
                         payload["wb_draft"] = np.zeros((S, wp), np.int32)
-                    self._run("mixed", payload)
+                    _warm("mixed", payload)
         if self._prefix_enabled:
             # cross-slot KV copy variants (cheap compiles — pure DUS,
             # no matmuls — but a mid-admission stall is still a stall);
             # src == dst == 0 is a self-copy no-op on device state
             if self._paged:
                 # paged copies are always whole-page: ONE variant
-                self._run("kvcopy", {"src": 0, "dst": 0, "n": self._page})
+                _warm("kvcopy", {"src": 0, "dst": 0, "n": self._page})
             else:
                 for w in win_ladder:
-                    self._run("kvcopy", {"src": 0, "dst": 0, "n": w})
+                    _warm("kvcopy", {"src": 0, "dst": 0, "n": w})
         S = self.n_slots
         inactive = {
             "tokens": np.zeros((S, 1), np.int32),
@@ -1918,8 +2078,8 @@ class LLMEngine:
             "active": np.zeros((S,), bool),
         }
         ks = self._warm_ks
-        if self._use_kernel:
-            windows_d = {self.max_seq}  # ragged kernel: one variant
+        if self._use_kernel or self._ragged:
+            windows_d = {self.max_seq}  # ragged: one variant
         else:
             windows_d = set()
             w = 256
@@ -1938,17 +2098,23 @@ class LLMEngine:
                         wp = w // self._page
                         payload["pt"] = np.zeros((S, wp), np.int32)
                         payload["wb"] = np.zeros((S, wp), np.int32)
-                    self._run("decodek", payload)
+                    _warm("decodek", payload)
         payload = {**inactive, "masks": None}
         if self._paged:
             wp = self.max_seq // self._page
             payload["pt"] = np.zeros((S, wp), np.int32)
             payload["wb"] = np.zeros((S, wp), np.int32)
-        self._run("decode1", payload)
+        _warm("decode1", payload)
         self._dev_epoch = -1  # warmup carries are not serving state
         # block until every warmup compile retires so the first real
         # request measures serving, not the compiler
         jax.block_until_ready(self.cache.k)
+        # the variant-explosion kill made visible: each warmup dispatch
+        # compiled exactly one (fn, shape) variant, so this count IS the
+        # jit-cache population the ragged unification collapses
+        self.warmup_variants = n_variants
+        tm.ENGINE_DISPATCH_VARIANTS.labels(model=self._mlabel).set(
+            n_variants)
         if marker is not None:
             # record the completed variant set so the next load of this
             # exact signature skips the whole pass (best effort: losing
@@ -2834,7 +3000,11 @@ class LLMEngine:
         # [n_past+len(chunk), n_past+bucket) — harmless: they're beyond the
         # valid prefix and get overwritten when real tokens arrive (causal
         # mask keeps them invisible to attention reads at these positions).
-        window = self._window_bucket(slot.n_past + bucket)
+        # Ragged mode pins the table width to max_seq: the kernel walks
+        # only the live pages anyway, and one jit variant serves every
+        # live-context size.
+        window = (self.max_seq if self._ragged
+                  else self._window_bucket(slot.n_past + bucket))
         payload = {
             "toks": toks,
             "pos0": np.asarray([slot.n_past], np.int32),
@@ -2864,6 +3034,7 @@ class LLMEngine:
         slot.t_prefill_enq_ms += (time.perf_counter() - t0) * 1e3
         tm.ENGINE_MIXED_DISPATCH.labels(
             model=self._mlabel, composition="prefill_only").inc()
+        self._note_ragged_rows("prefill", 1)
 
     @property
     def _group_cap(self) -> int:
@@ -3041,7 +3212,12 @@ class LLMEngine:
             for r, m in zip(rows, masks):
                 full[r] = m
             masks = full
-        if identity:
+        if self._ragged or not identity:
+            # ragged: ONE full-width variant per (B, bucket) shape —
+            # the kernel (or full-width gather fallback) is ragged over
+            # live context, so no window ladder exists to pick from
+            window = self.max_seq
+        else:
             # window follows the MEMBERS' live context (parked rows are
             # no-op writes at pos 0, so they place no demand on it):
             # 1024 -> 256 on a fresh wave cuts the dispatch's attention
@@ -3056,8 +3232,6 @@ class LLMEngine:
                 window = min(compiled)
             else:
                 window = self.max_seq
-        else:
-            window = self.max_seq
         payload = {
             "toks": toks, "pos0": pos0, "slot_ids": slot_ids,
             "n_chunk": n_chunk, "tails": tails, "tail_lens": tail_lens,
@@ -3098,6 +3272,7 @@ class LLMEngine:
             TRACER.event(req.id, "prefill_dispatch", t=t_disp)
         tm.ENGINE_MIXED_DISPATCH.labels(
             model=self._mlabel, composition="prefill_only").inc()
+        self._note_ragged_rows("final", len(group))
         self._flights.append(_Flight(
             kind="prefill_final", arrays=[toks_out],
             meta={"pairs": [(s, s.request) for s in group], "rows": rows},
@@ -3225,12 +3400,17 @@ class LLMEngine:
         # write_mask False is a pure no-op — their resident prefixes
         # survive untouched (no tail clamping, unlike the decode scan)
         masks = self._constraint_mask_rows(self.slots)
-        need_w = max(int(pos0[i]) + int(n_chunk[i])
-                     for i in range(S) if write_mask[i]) + 1
-        window = self._window_bucket(need_w)
-        compiled = [k[1] for k in self._decode_k_fns
-                    if k[0] == "mixed" and window <= k[1]]
-        window = min(compiled) if compiled else self.max_seq
+        if self._ragged:
+            # one full-width variant per bucket; the kernel's page walk
+            # (or the fallback's full-width gather) is ragged already
+            window = self.max_seq
+        else:
+            need_w = max(int(pos0[i]) + int(n_chunk[i])
+                         for i in range(S) if write_mask[i]) + 1
+            window = self._window_bucket(need_w)
+            compiled = [k[1] for k in self._decode_k_fns
+                        if k[0] == "mixed" and window <= k[1]]
+            window = min(compiled) if compiled else self.max_seq
         payload = {
             "toks": toks, "pos0": pos0, "n_chunk": n_chunk,
             "write_mask": write_mask, "sample_sids": sample_sids,
@@ -3277,6 +3457,9 @@ class LLMEngine:
         tm.ENGINE_MIXED_DISPATCH.labels(
             model=self._mlabel,
             composition="mixed" if decoding else "prefill_only").inc()
+        self._note_ragged_rows("decode", len(decoding))
+        self._note_ragged_rows("final", len(finals))
+        self._note_ragged_rows("prefill", len(prefilling) - len(finals))
         if decoding:
             self._note_decode_advance(t_disp)
         self._flights.append(_Flight(
@@ -3344,6 +3527,15 @@ class LLMEngine:
             tm.ENGINE_DECODE_STALL.labels(model=self._mlabel).observe(
                 max(0.0, now - self._last_decode_adv))
         self._last_decode_adv = now
+
+    def _note_ragged_rows(self, kind: str, n: int) -> None:
+        """Rows advanced through the unified ragged path by kind
+        (decode / prefill chunk / prefill final / spec verify) —
+        engine_ragged_rows_total, the series proving every row kind
+        actually flows through the one-kernel dispatch discipline."""
+        if self._ragged and n > 0:
+            tm.ENGINE_RAGGED_ROWS.labels(
+                model=self._mlabel, kind=kind).inc(n)
 
     _TPS_ALPHA = 0.3
 
@@ -3622,9 +3814,10 @@ class LLMEngine:
             k = min(k, self._latency_k(lat_mode))
 
         S = self.n_slots
-        if self._use_kernel:
-            # the fused Pallas kernel is ragged (reads only valid pages),
-            # so no window slicing: one compiled variant for all contexts
+        if self._use_kernel or self._ragged:
+            # the fused Pallas kernel is ragged (reads only valid
+            # pages) and ragged mode pins tables to full width even on
+            # the XLA fallback: one compiled variant for all contexts
             window = self.max_seq
         else:
             # live-context window bucket for this dispatch (_decode_k_fn)
@@ -3702,6 +3895,7 @@ class LLMEngine:
                        self.slots[i].n_past + in_flight + k)
                       if i in advancing else None)) for i in range(S)],
                 window)
+        self._note_ragged_rows("decode", len(decoding))
         batches = self._run("decodek", payload)
         toks = batches[0]
         try:
@@ -3848,6 +4042,7 @@ class LLMEngine:
                 emitted)
         tm.ENGINE_MIXED_DISPATCH.labels(
             model=self._mlabel, composition="decode_only").inc()
+        self._note_ragged_rows("decode", len(decoding))
         self._note_decode_advance(t0)
         self.metrics.slots_busy = sum(1 for s in self.slots if s.active)
 

@@ -624,6 +624,19 @@ def forward_hidden(
     # function (gather_kv_pages/scatter_kv_pages), so it never sees the
     # arena.
     kv_page: int = 0,  # pool page size (tokens) when page_table is set
+    q_lens: Optional[jax.Array] = None,  # RAGGED kernel mode (with
+    # page_table + write_table): per-row valid token counts — 1 for
+    # decode rows, the chunk length for prefill rows, k+1 for
+    # spec-decode verify rows. Every row kind flows through ONE
+    # ragged-paged-attention kernel invocation per layer
+    # (ops/ragged_paged_attention.py): the chunk's K/V rows scatter
+    # into the arena through ``write_table`` (no gathered window view)
+    # and attention walks each row's pages raggedly.
+    write_table: Optional[jax.Array] = None,  # [B, max_pages] i32
+    # physical WRITE pages per logical page (ragged mode): entries the
+    # host did not grant (shared prefix pages, parked rows, pages
+    # outside the dispatch's span) point at the trash page, so a
+    # dispatch persists exactly its own writes.
 ) -> tuple[jax.Array, KVCache]:
     """Run the stack up to (and including) the final norm; returns
     (hidden [B, T, D], updated cache). The LM head lives in ``forward``;
@@ -664,8 +677,12 @@ def forward_hidden(
         # serving shapes — measured 3-4x the decode roofline on v5e).
         x, ck_all, cv_all, ks_all, vs_all = carry
         l, lp = scanned
-        use_kernel = (decode_kernel and identity and x.shape[1] == 1
+        use_ragged = (q_lens is not None and write_table is not None
+                      and page_table is not None and identity
                       and win is None)  # uniform windows only
+        use_kernel = use_ragged or (
+            decode_kernel and identity and x.shape[1] == 1
+            and win is None)
         if use_kernel:
             ck = cv = ks = vs = None  # kernel addresses the full cache
         else:
@@ -676,6 +693,66 @@ def forward_hidden(
                 vs = lax.dynamic_index_in_dim(vs_all, l, 0, keepdims=False)
             else:
                 ks = vs = None
+
+        def ragged_attn(q, k, v):
+            # Ragged unified path (ops/ragged_paged_attention.py): the
+            # chunk's K/V rows scatter into the arena through the WRITE
+            # table (positions beyond a row's q_len redirect to the
+            # trash page, as do pages the host did not grant), then ONE
+            # kernel invocation attends every row kind — decode rows,
+            # prefill chunks, spec-verify rows — walking pages through
+            # the READ table. No gathered window view is ever
+            # materialized. T == 1 keeps the decode kernel's
+            # VMEM-seeded current-row contract (an int8 cache attends
+            # the EXACT current row, not its quantized HBM copy).
+            from ..ops.ragged_paged_attention import (
+                ragged_paged_attention,
+            )
+
+            T = k.shape[1]
+            kf = k.reshape(B, T, spec.kv_dim)
+            vf = v.reshape(B, T, spec.kv_dim)
+            rows = jnp.arange(B, dtype=jnp.int32)
+            if quant:
+                kq, ksc = _quantize_rows(kf)  # int8 [B,T,F], f32 [B,T]
+                vq, vsc = _quantize_rows(vf)
+            else:
+                kq, vq, ksc, vsc = kf, vf, None, None
+            scale = (
+                1.0 / math.sqrt(spec.query_pre_attn_scalar)
+                if spec.query_pre_attn_scalar
+                else 1.0 / math.sqrt(spec.d_head)
+            )
+            tpos = pos0[:, None] + jnp.arange(T, dtype=jnp.int32)[None]
+            wpg = write_table[rows[:, None], tpos // kv_page]
+            # pad positions beyond the row's ragged length write trash
+            wpg = jnp.where(
+                jnp.arange(T, dtype=jnp.int32)[None] < q_lens[:, None],
+                wpg, 0)
+            woff = tpos % kv_page
+            ck_new = ck_all.at[l, wpg, woff, :].set(
+                kq.astype(ck_all.dtype), mode="promise_in_bounds")
+            cv_new = cv_all.at[l, wpg, woff, :].set(
+                vq.astype(cv_all.dtype), mode="promise_in_bounds")
+            if quant:
+                ks_new = ks_all.at[l, wpg, woff].set(
+                    ksc, mode="promise_in_bounds")
+                vs_new = vs_all.at[l, wpg, woff].set(
+                    vsc, mode="promise_in_bounds")
+            else:
+                ks_new = vs_new = None
+            seed = ((kf[:, 0], vf[:, 0]) if T == 1 else None)
+            out = ragged_paged_attention(
+                q, ck_new, cv_new, l, page_table, pos0, q_lens,
+                spec.n_kv_heads, scale=scale, page=kv_page,
+                sliding_window=spec.sliding_window,
+                cache_k_scale=ks_new, cache_v_scale=vs_new,
+                seed_kv=seed,
+            )  # [B, T, H*Dh]
+            if quant:
+                return (out.astype(x.dtype),
+                        (ck_new, cv_new, ks_new, vs_new))
+            return out.astype(x.dtype), (ck_new, cv_new)
 
         def kernel_attn(q, k, v):
             # Fused Pallas path: the current K/V rows are appended via an
@@ -875,7 +952,8 @@ def forward_hidden(
 
         x, out = _layer_body(
             spec, x, lp, positions, inv_freq, rope_scale,
-            kernel_attn if use_kernel else xla_attn,
+            ragged_attn if use_ragged
+            else (kernel_attn if use_kernel else xla_attn),
         )
         if use_kernel:
             # the fused kernel updated the FULL stacked cache in place
@@ -927,11 +1005,14 @@ def forward(
     ring_prefill: bool = False,
     page_table: Optional[jax.Array] = None,
     kv_page: int = 0,
+    q_lens: Optional[jax.Array] = None,
+    write_table: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, KVCache]:
     """forward_hidden + LM head; returns (logits [B, T, V] f32, cache)."""
     x, cache = forward_hidden(
         spec, params, tokens, pos0, cache, slot_ids, decode_kernel, soft,
         mesh, ring_prefill, page_table=page_table, kv_page=kv_page,
+        q_lens=q_lens, write_table=write_table,
     )
     return _lm_head(spec, params, x), cache
 

@@ -1,41 +1,37 @@
-"""Pallas TPU kernel for the batched-decode attention hot path.
+"""Batched-decode attention entry points (thin wrappers since PR 6).
 
 The XLA decode path reads every KV-cache position (max_seq) for every slot
 on every step — the measured throughput ceiling on v5e once dispatch RTT
-is amortized. This kernel makes the cache access *ragged*: only the pages
-covering each slot's valid prefix are DMA'd (TPU counterpart of the
+is amortized. The ragged kernel makes the cache access *ragged*: only the
+pages covering each slot's valid prefix are DMA'd (TPU counterpart of the
 reference's per-slot `cache_tokens` raggedness, backend/cpp/llama/
 grpc-server.cpp:188-385 — and of its paged llama.cpp KV cache).
 
-Design notes (see /opt/skills/guides/pallas_guide.md):
-- cache layout stays head-FLAT [L, n_slots, max_seq, kv_dim]: full
-  128-lane rows (kv_dim >= 512), no (H, 64) register padding, no
-  relayouts. The kernel addresses the FULL stacked cache with a layer
-  scalar, so the caller's layer loop never slices or copies buffers.
-- ONE grid step per slot; an inner double-buffered manual-DMA loop walks
-  only that slot's valid pages (a grid=(S, n_pages) formulation pays
-  ~5us of fixed cost per page of max_seq, valid or not — measured
-  dominant on v5e). Flash-style (m, l, acc) accumulation across pages.
-- attention uses a block-diagonal q matrix ``wq [kv_dim, n_q_heads]``
-  (column h carries q-head h's vector in the 64-lane band of its GQA kv
-  head), so logits are ONE full-lane MXU matmul ``k_page @ wq`` — the 8x
-  FLOP overhead is irrelevant at decode (bandwidth-bound).
-- the kernel is READ-ONLY on the cache: the caller appends the current
-  K/V rows with an in-place scatter on the scan-carried cache (single
-  bf16 rows cannot be DMA'd into the (8,128)-tiled HBM buffer); their
-  attention contribution is seeded from VMEM and the HBM copy masked.
+Since the ragged-paged-attention unification
+(ops/ragged_paged_attention.py) there is exactly ONE Pallas attention
+kernel; this module keeps the decode-shaped entry points as thin
+wrappers over it:
+
+- ``fused_decode_attention`` (T == 1, current rows seeded from VMEM so
+  an int8 cache attends the EXACT current row): the paged arena mode
+  passes straight through; the dense ``[L, S, SEQ, F]`` mode VIEWS the
+  cache as a page arena (free reshape) under an identity page table —
+  the paged/dense split this file used to implement twice is now one
+  kernel behind two table constructions.
+- ``sharded_append_attend``: the shard_map wrapper for meshed serving
+  (append + per-shard kernel call), unchanged in contract.
+
+The block-diagonal q helpers below remain exported: they are the
+measured-fastest logits formulation for T == 1 on v5e and are kept for
+kernels/tests that still want the one-matmul trick.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 PAGE = 256
 NEG_INF = -1e30
@@ -82,161 +78,8 @@ def extract_head_bands(out: jax.Array, n_kv_heads: int,
 
 
 # ---------------------------------------------------------------------------
-# fused ragged attend: one grid step per slot, manual DMA over valid pages
+# decode wrapper: T == 1 ragged attention, paged or dense-viewed-as-paged
 # ---------------------------------------------------------------------------
-#
-# The grid=(S, n_pages) kernel above pays a fixed per-grid-step cost for
-# every page of max_seq whether valid or not (~5us/step measured on v5e:
-# at 32 slots x 8 pages x 16 layers that alone is ~20ms per decode step).
-# This kernel runs ONE grid step per slot and walks only the slot's VALID
-# pages with double-buffered explicit DMA, so cost scales with the live
-# context, not max_seq. It addresses the FULL stacked [L, S, SEQ, F]
-# cache with a layer scalar, so the caller's layer loop never slices or
-# copies cache buffers. The kernel is READ-ONLY on the cache: the
-# current token's K/V row is appended by the caller (an in-place scatter
-# on the scan-carried cache — single bf16 rows cannot be DMA'd into the
-# (8,128)-tiled HBM buffer from inside the kernel); its attention
-# contribution is seeded from VMEM and its HBM copy masked out.
-
-
-def _fused_kernel(*refs,
-                  scale: float, sliding_window: Optional[int], page: int,
-                  quantized: bool = False, paged: bool = False):
-    if paged:
-        # paged arena: an extra scalar-prefetch ref carries the per-slot
-        # page table; DMA source pages are table lookups instead of
-        # contiguous row slices
-        len_ref, layer_ref, pt_ref, wq_ref, newk_ref, newv_ref, \
-            ck_in, cv_in, *rest = refs
-    else:
-        len_ref, layer_ref, wq_ref, newk_ref, newv_ref, \
-            ck_in, cv_in, *rest = refs
-        pt_ref = None
-    if quantized:
-        (ks_ref, vs_ref, out_ref, kbuf, vbuf, rsem) = rest
-    else:
-        out_ref, kbuf, vbuf, rsem = rest
-        ks_ref = vs_ref = None
-    b = pl.program_id(0)
-    layer = layer_ref[0]
-    n = len_ref[b]  # valid length INCLUDING the current token
-    pos = jnp.maximum(n - 1, 0)  # current token's position
-
-    n_prev = pos  # tokens attended from HBM (current token rides in VMEM)
-    if sliding_window is not None:
-        lo = jnp.maximum(n - sliding_window, 0)  # first attended position
-        first_page = lax.div(lo, page)
-    else:
-        lo = 0
-        first_page = 0
-    n_pages = lax.div(n_prev + page - 1, page)
-
-    def get_dma(slot, p):
-        if paged:
-            # p is the slot's LOGICAL page index; the table maps it to
-            # the physical arena page (whole-page DMA)
-            phys = pt_ref[b, p]
-            src_k = ck_in.at[layer, phys, :, :]
-            src_v = cv_in.at[layer, phys, :, :]
-        else:
-            src_k = ck_in.at[layer, b, pl.ds(p * page, page), :]
-            src_v = cv_in.at[layer, b, pl.ds(p * page, page), :]
-        return (
-            pltpu.make_async_copy(src_k, kbuf.at[slot], rsem.at[slot, 0]),
-            pltpu.make_async_copy(src_v, vbuf.at[slot], rsem.at[slot, 1]),
-        )
-
-    def scale_col(sref, p):
-        """Page p's per-row scales as a (page, 1) column. The slot's
-        scale rows ride in VMEM as an auto-pipelined (n_pages, page)
-        block (DMA-slicing a single [L, S, SEQ] row trips second-minor
-        tiling alignment); the MXU contraction against a one-hot both
-        selects the page and transposes lanes -> sublanes, so no vector
-        relayout is ever emitted."""
-        mat = sref[0]  # [n_pages_total, page] f32
-        onehot = (jax.lax.broadcasted_iota(
-            jnp.int32, (mat.shape[0], 1), 0) == p).astype(jnp.float32)
-        return jax.lax.dot_general(
-            mat, onehot, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [page, 1]
-
-    @pl.when(first_page < n_pages)
-    def _():
-        k0, v0 = get_dma(0, first_page)
-        k0.start()
-        v0.start()
-
-    wq = wq_ref[0]  # [F, H]
-    # current token's contribution seeds the flash accumulator (it is
-    # always valid and needs no HBM read)
-    new_k_row = newk_ref[:].reshape(1, newk_ref.shape[-1])
-    new_v_row = newv_ref[:].reshape(1, newv_ref.shape[-1])
-    logit_c = jax.lax.dot_general(
-        new_k_row, wq, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale  # [1, H]
-    m0 = logit_c  # [1, H]
-    l0 = jnp.ones_like(logit_c)
-    # seed accumulator: every head's row is exp(0)=1 times the current v
-    acc0 = jnp.tile(new_v_row.astype(jnp.float32), (wq.shape[1], 1))
-
-    def body(p, carry):
-        acc, m, l = carry
-        slot = lax.rem(p - first_page, 2)
-        nxt = lax.rem(p - first_page + 1, 2)
-
-        @pl.when(p + 1 < n_pages)
-        def _():
-            kn, vn = get_dma(nxt, p + 1)
-            kn.start()
-            vn.start()
-
-        kp, vp = get_dma(slot, p)
-        kp.wait()
-        vp.wait()
-        if quantized:
-            # int8 rows dequantize by a PER-ROW scale, which commutes
-            # through the row-wise contractions: the k scale multiplies
-            # logits on the row axis, and the v scale folds into pexp
-            # before the pv matmul — the MXU never reads a dequantized
-            # page from HBM.
-            k = kbuf[slot].astype(wq.dtype)  # [page, F]
-        else:
-            k = kbuf[slot]  # [page, F]
-        logits = jax.lax.dot_general(
-            k, wq, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [page, H]
-        if quantized:
-            logits = logits * scale_col(ks_ref, p)
-        row = p * page + jax.lax.broadcasted_iota(
-            jnp.int32, logits.shape, 0
-        )
-        valid = row < n_prev
-        if sliding_window is not None:
-            valid &= row >= lo
-        logits = jnp.where(valid, logits, NEG_INF)
-        m_page = jnp.max(logits, axis=0, keepdims=True)  # [1, H]
-        m_new = jnp.maximum(m, m_page)
-        alpha = jnp.exp(m - m_new)  # [1, H]
-        pexp = jnp.exp(logits - m_new)  # [page, H]
-        pexp = jnp.where(valid, pexp, 0.0)
-        l = l * alpha + jnp.sum(pexp, 0, keepdims=True)
-        if quantized:
-            pexp_v = pexp * scale_col(vs_ref, p)
-            vpage = vbuf[slot].astype(jnp.float32)
-        else:
-            pexp_v, vpage = pexp, vbuf[slot]
-        pv = jax.lax.dot_general(
-            pexp_v, vpage, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [H, F]
-        acc = acc * alpha.T + pv
-        return acc, m_new, l
-
-    acc, m, l = lax.fori_loop(first_page, n_pages, body, (acc0, m0, l0))
-    out_ref[0] = (acc / jnp.maximum(l.T, 1e-30)).astype(out_ref.dtype)
 
 
 def fused_decode_attention(
@@ -267,86 +110,39 @@ def fused_decode_attention(
 ) -> jax.Array:
     """Ragged decode attention over ``[0, lengths)`` of layer ``layer``;
     the current token's K/V contribution is taken from ``new_k``/``new_v``
-    in VMEM (its HBM copy is masked out). Returns attn [S, H*Dh]."""
-    paged = page_table is not None
+    in VMEM (its HBM copy is masked out). Returns attn [S, H*Dh].
+
+    Thin wrapper over ``ragged_paged_attention`` with T == 1 seeded
+    queries: the dense cache mode is the SAME kernel behind an identity
+    page table over a reshaped ``[L, S*(SEQ//page), page, F]`` view of
+    the stacked cache (a free relayout-less reshape — pages are
+    contiguous row runs)."""
+    from .ragged_paged_attention import ragged_paged_attention
+
     if page is None:
         page = PAGE
-    if paged:
-        L, NP, PG, F = cache_k.shape
-        assert PG == page, (PG, page)
-        S, max_pages = page_table.shape
-    else:
+    if page_table is None:
         L, S, SEQ, F = cache_k.shape
-    H = q.shape[1]
-    quantized = cache_k_scale is not None
-    wq = build_block_diag_q(q, n_kv_heads)
-    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
-    nsp = 3 if paged else 2  # lengths, layer (+ page table)
-
-    def _bspec(shape):
-        if paged:
-            return pl.BlockSpec(shape, lambda b, lens, lay, pt: (b, 0, 0))
-        return pl.BlockSpec(shape, lambda b, lens, lay: (b, 0, 0))
-
-    in_specs = [
-        _bspec((1, F, H)),
-        _bspec((1, 1, F)),
-        _bspec((1, 1, F)),
-        any_spec,  # cache_k (HBM)
-        any_spec,  # cache_v (HBM)
-    ]
-    operands = [lengths, layer[None]]
-    if paged:
-        operands.append(page_table)
-    operands += [wq, new_k[:, None, :], new_v[:, None, :],
-                 cache_k, cache_v]
-    if quantized:
-        if paged:
-            # per-slot scale pages gathered through the table ([S,
-            # max_pages, page] — logical page p of slot b lands at row
-            # p, matching the kernel's one-hot page selection)
-            npg = max_pages
-            ks_l = lax.dynamic_index_in_dim(
-                cache_k_scale, layer, 0, keepdims=False)[page_table]
-            vs_l = lax.dynamic_index_in_dim(
-                cache_v_scale, layer, 0, keepdims=False)[page_table]
-        else:
-            # current layer's scale rows, paged [S, n_pages, page]:
-            # Pallas auto-pipelines each slot's block into VMEM
-            # (SEQ*4 bytes/slot)
-            npg = SEQ // page
-            ks_l = lax.dynamic_index_in_dim(
-                cache_k_scale, layer, 0,
-                keepdims=False).reshape(S, npg, page)
-            vs_l = lax.dynamic_index_in_dim(
-                cache_v_scale, layer, 0,
-                keepdims=False).reshape(S, npg, page)
-        in_specs += [_bspec((1, npg, page)), _bspec((1, npg, page))]
-        operands += [ks_l, vs_l]
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=nsp,
-        grid=(S,),
-        in_specs=in_specs,
-        out_specs=_bspec((1, H, F)),
-        scratch_shapes=[
-            pltpu.VMEM((2, page, F), cache_k.dtype),
-            pltpu.VMEM((2, page, F), cache_v.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        assert SEQ % page == 0, (SEQ, page)
+        npg = SEQ // page
+        cache_k = cache_k.reshape(L, S * npg, page, F)
+        cache_v = cache_v.reshape(L, S * npg, page, F)
+        if cache_k_scale is not None:
+            cache_k_scale = cache_k_scale.reshape(L, S * npg, page)
+            cache_v_scale = cache_v_scale.reshape(L, S * npg, page)
+        page_table = (
+            jnp.arange(S, dtype=jnp.int32)[:, None] * npg
+            + jnp.arange(npg, dtype=jnp.int32)[None, :]
+        )
+    out = ragged_paged_attention(
+        q[:, None, :, :], cache_k, cache_v, layer, page_table,
+        jnp.maximum(lengths - 1, 0), jnp.ones_like(lengths),
+        n_kv_heads, scale=scale, page=page,
+        sliding_window=sliding_window,
+        cache_k_scale=cache_k_scale, cache_v_scale=cache_v_scale,
+        seed_kv=(new_k, new_v),
     )
-    kernel = functools.partial(
-        _fused_kernel, scale=scale, sliding_window=sliding_window,
-        page=page, quantized=quantized, paged=paged,
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S, H, F), jnp.float32),
-        interpret=_interpret(),
-    )(*operands)
-    return extract_head_bands(out, n_kv_heads, q.shape[2]).reshape(
-        S, H * q.shape[2]
-    )
+    return out[:, 0, :]
 
 
 def mesh_kernel_eligible(mesh, n_kv_heads: int, n_heads: int,

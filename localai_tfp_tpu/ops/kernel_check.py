@@ -203,6 +203,81 @@ def check_paged_gather(quantized: bool = False, seed: int = 0) -> float:
     return float(jnp.max(jnp.abs(got - want)))
 
 
+_RAGGED_MIXES = ("decode", "prefill", "mixed", "verify")
+
+
+def check_ragged_attention(quantized: bool = False, seed: int = 0,
+                           mix: str = "mixed") -> float:
+    """Ragged-paged-attention parity: one kernel invocation over a
+    shuffled-page-table arena serving a ROW MIX — decode rows
+    (q_len 1), prefill chunk rows (q_len = chunk), spec-decode verify
+    rows (q_len = k+1) — against the dense XLA oracle. ``mix`` selects
+    the composition: decode-only, prefill-only, mixed, or
+    verify-heavy; fp and int8 legs share the tolerance budget of the
+    decode kernel (same accumulation discipline)."""
+    from ..models.transformer import _quantize_rows
+    from .ragged_paged_attention import (
+        ragged_attention_reference, ragged_paged_attention,
+    )
+
+    rng = np.random.default_rng(seed)
+    L, n_kv, dh, H, page = 2, 8, 128, 32, 128
+    F = n_kv * dh
+    B, max_pages = 6, 4
+    kd = 4
+    if mix == "decode":
+        q_lens = np.ones(B, np.int32)
+    elif mix == "prefill":
+        q_lens = rng.integers(2, 33, B).astype(np.int32)
+    elif mix == "verify":
+        q_lens = np.full(B, kd, np.int32)
+    else:  # mixed: decode rows + chunks + one verify row together
+        q_lens = np.asarray([1, 1, 7, 32, kd, 16], np.int32)[:B]
+    T = int(q_lens.max())
+    cap = max_pages * page
+    pos0 = np.asarray(
+        [int(rng.integers(0, cap - int(n))) for n in q_lens], np.int32)
+    n_pages = B * max_pages + 1
+    pt = rng.permutation(np.arange(1, n_pages)).reshape(
+        B, max_pages).astype(np.int32)
+    arena_k = rng.standard_normal((L, n_pages, page, F)) * 0.5
+    arena_v = rng.standard_normal((L, n_pages, page, F)) * 0.5
+    q = jnp.asarray(rng.standard_normal((B, T, H, dh)) * 0.3,
+                    jnp.float32)
+    layer = jnp.asarray(1, jnp.int32)
+    scale = 1.0 / np.sqrt(dh)
+    pt_j = jnp.asarray(pt)
+    pos_j = jnp.asarray(pos0)
+    len_j = jnp.asarray(q_lens)
+    if quantized:
+        kq, ks = _quantize_rows(jnp.asarray(arena_k, jnp.float32))
+        vq, vs = _quantize_rows(jnp.asarray(arena_v, jnp.float32))
+        got = ragged_paged_attention(
+            q.astype(jnp.bfloat16), kq, vq, layer, pt_j, pos_j, len_j,
+            n_kv, scale=scale, page=page, cache_k_scale=ks,
+            cache_v_scale=vs)
+        want = ragged_attention_reference(
+            q, kq, vq, 1, pt_j, pos_j, len_j, n_kv, scale=scale,
+            page=page, cache_k_scale=ks, cache_v_scale=vs)
+    else:
+        ak = jnp.asarray(arena_k, jnp.bfloat16)
+        av = jnp.asarray(arena_v, jnp.bfloat16)
+        got = ragged_paged_attention(
+            q.astype(jnp.bfloat16), ak, av, layer, pt_j, pos_j, len_j,
+            n_kv, scale=scale, page=page)
+        want = ragged_attention_reference(
+            q, ak, av, 1, pt_j, pos_j, len_j, n_kv, scale=scale,
+            page=page)
+    # pad queries beyond each row's ragged length are garbage by
+    # contract — compare the valid rows only
+    err = 0.0
+    for b in range(B):
+        n = int(q_lens[b])
+        err = max(err, float(jnp.max(jnp.abs(
+            got[b, :n] - want[b, :n]))))
+    return err
+
+
 def check_int8_matmul(seed: int = 0) -> float:
     """Max abs error of the fused Pallas dequant-matmul vs the XLA
     upcast path."""
@@ -234,6 +309,15 @@ def run_kernel_checks() -> dict[str, Any]:
         out["paged_gather_max_err"] = round(check_paged_gather(False), 5)
         out["paged_gather_int8_max_err"] = round(
             check_paged_gather(True), 5)
+        # ragged unification: every row-kind composition through the
+        # ONE kernel (decode rows, prefill chunks, verify rows,
+        # shuffled page tables) vs the dense oracle
+        out["ragged_attention_max_err"] = round(max(
+            check_ragged_attention(False, mix=m)
+            for m in _RAGGED_MIXES), 5)
+        out["ragged_attention_int8_max_err"] = round(max(
+            check_ragged_attention(True, mix=m)
+            for m in _RAGGED_MIXES), 5)
         out["int8_matmul_max_err"] = round(check_int8_matmul(), 5)
         out["ok"] = (
             out["decode_attention_max_err"] < 2e-2
@@ -242,6 +326,8 @@ def run_kernel_checks() -> dict[str, Any]:
             # its tolerance matches the dense kernel's
             and out["paged_gather_max_err"] < 2e-2
             and out["paged_gather_int8_max_err"] < 5e-2
+            and out["ragged_attention_max_err"] < 2e-2
+            and out["ragged_attention_int8_max_err"] < 5e-2
             and out["int8_matmul_max_err"] < 0.25
         )
     except Exception as e:  # a crash IS the finding — record it

@@ -1,0 +1,362 @@
+"""Ragged paged attention: ONE Pallas TPU kernel for every row kind.
+
+PR 5's paged KV pool still fed three device paths — the fused decode
+kernel (ops/decode_attention.py), the XLA gather/scatter window view
+(models/transformer.py gather_kv_pages), and the mixed dispatch's
+bucket x window variant ladder. This kernel unifies them following
+"Ragged Paged Attention" (PAPERS.md, arxiv 2604.15464): the batch is
+RAGGED in both axes — every row carries its own query length (1 for decode
+rows, the chunk length for prefill rows, k+1 for spec-decode verify
+rows) and its own context length — and one kernel invocation walks each
+row's page table, DMA-ing only the pages covering its live context.
+
+Shapes:
+- the paged arena ``[L, n_pages, page, F]`` (F = n_kv_heads * d_head,
+  head-FLAT like the dense cache — full 128-lane rows, no relayouts),
+  addressed with a layer scalar so the caller's layer scan never slices
+  arena buffers;
+- per-row int32 page tables ``[B, max_pages]`` (scalar-prefetch operand:
+  DMA source addresses are computable before the body runs; entries
+  beyond a row's allocation point at the trash page, whose garbage is
+  causally masked);
+- queries ``[B, T, H, Dh]`` with per-row valid lengths ``q_lens`` and
+  start positions ``pos0`` — query t of row b sits at absolute position
+  pos0[b] + t and attends positions [max(0, pos+1-window), pos].
+
+Design notes (see /opt/skills/guides/pallas_guide.md):
+- ONE grid step per row; an inner double-buffered manual-DMA loop walks
+  only that row's valid pages (a grid=(B, n_pages) formulation pays a
+  fixed ~5us cost per page of max_seq, valid or not — the measured
+  decode dominator on v5e, ops/decode_attention.py history).
+- logits are per-kv-head MXU contractions ``q_h [G, Dh] @ k_page_h.T``
+  with G = group * T query rows laid out [Hkv*G, Dh] — the multi-query
+  generalization of the decode kernel's one-matmul trick (whose
+  block-diagonal wq would cost F x T*H VMEM at prefill chunk sizes).
+- int8 k/v pages dequantize by PER-ROW scales that commute through the
+  row-wise contractions: the k scale multiplies logits on the kv axis
+  and the v scale folds into pexp before the pv matmul — the MXU never
+  reads a dequantized page from HBM.
+- ``seed_kv`` (decode wrappers, T == 1): the current token's exact
+  K/V rows ride in VMEM and seed the flash accumulator while their HBM
+  copy is masked — preserving the fused decode kernel's numerics
+  (an int8 cache attends the EXACT current row, not its quantized HBM
+  copy).
+
+The XLA fallback (CPU tests / meshed engines / ineligible shapes) is
+the existing gather-a-window-view path: engine dispatch functions keep
+gathering ``gather_kv_pages`` at FULL table width, which is value-
+identical to the kernel's ragged reads (``ragged_attention_reference``
+below is the dense-math oracle kernel_check compares against).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .decode_attention import _interpret
+
+NEG_INF = -1e30
+
+
+def _ragged_kernel(*refs, scale: float, sliding_window: Optional[int],
+                   page: int, T: int, n_kv_heads: int, d_head: int,
+                   quantized: bool, seeded: bool):
+    qlen_ref, pos_ref, layer_ref, pt_ref, q_ref, *rest = refs
+    if seeded:
+        newk_ref, newv_ref, *rest = rest
+    ck_in, cv_in, *rest = rest
+    if quantized:
+        ks_ref, vs_ref, out_ref, kbuf, vbuf, rsem = rest
+    else:
+        out_ref, kbuf, vbuf, rsem = rest
+        ks_ref = vs_ref = None
+    b = pl.program_id(0)
+    layer = layer_ref[0]
+    qlen = qlen_ref[b]
+    p0 = pos_ref[b]
+    ctx = p0 + qlen  # valid context INCLUDING this dispatch's tokens
+    # rows read from HBM: seeded mode keeps the current token in VMEM
+    # and masks its HBM copy (the decode kernel's contract)
+    n_hbm = ctx - 1 if seeded else ctx
+    n_pages = lax.div(n_hbm + page - 1, page)
+    if sliding_window is not None:
+        # pages wholly below the EARLIEST query's window are never read;
+        # the per-query mask below handles the ragged boundary exactly
+        first_page = lax.div(jnp.maximum(p0 + 1 - sliding_window, 0),
+                             page)
+    else:
+        first_page = 0
+
+    q2 = q_ref[0]  # [Hkv*G, Dh], G = group*T, row = (h*group+g)*T + t
+    HG = q2.shape[0]
+    G = HG // n_kv_heads
+    # absolute position of each query row (t = row % T)
+    row_i = jax.lax.broadcasted_iota(jnp.int32, (HG, 1), 0)
+    t_i = lax.rem(row_i, T)
+    qpos = p0 + t_i  # [HG, 1]
+    q_valid = t_i < qlen  # pad queries beyond the row's ragged length
+    hi = qpos - (1 if seeded else 0)  # last HBM row each query attends
+
+    def get_dma(slot, p):
+        phys = pt_ref[b, p]
+        return (
+            pltpu.make_async_copy(ck_in.at[layer, phys, :, :],
+                                  kbuf.at[slot], rsem.at[slot, 0]),
+            pltpu.make_async_copy(cv_in.at[layer, phys, :, :],
+                                  vbuf.at[slot], rsem.at[slot, 1]),
+        )
+
+    def scale_row(sref, p):
+        """Page p's per-row scales as a (1, page) row: the MXU
+        contraction against a one-hot both selects the page and keeps
+        lanes as lanes, so no vector relayout is emitted (same trick as
+        the decode kernel, transposed)."""
+        mat = sref[0]  # [max_pages, page] f32
+        onehot = (jax.lax.broadcasted_iota(
+            jnp.int32, (mat.shape[0], 1), 0) == p).astype(jnp.float32)
+        return jax.lax.dot_general(
+            onehot, mat, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [1, page]
+
+    def head_logits(k):
+        """Per-kv-head q @ k_band.T, stacked to [HG, page]."""
+        cols = []
+        for h in range(n_kv_heads):
+            qh = q2[h * G:(h + 1) * G, :]  # [G, Dh]
+            kh = k[:, h * d_head:(h + 1) * d_head]  # [page, Dh]
+            cols.append(jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ))  # [G, page]
+        return jnp.concatenate(cols, axis=0)
+
+    def head_pv(pexp_v, v):
+        """Per-kv-head pexp @ v_band, stacked to [HG, Dh]."""
+        outs = []
+        for h in range(n_kv_heads):
+            ph = pexp_v[h * G:(h + 1) * G, :]  # [G, page]
+            vh = v[:, h * d_head:(h + 1) * d_head]  # [page, Dh]
+            outs.append(jax.lax.dot_general(
+                ph, vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ))
+        return jnp.concatenate(outs, axis=0)
+
+    if seeded:
+        # current token's contribution seeds the flash accumulator from
+        # VMEM (it is always valid and needs no HBM read)
+        new_k = newk_ref[0]  # [1, F]
+        new_v = newv_ref[0]
+        logit_c = head_logits(new_k.astype(q2.dtype)).reshape(
+            HG, 1) * scale
+        m0 = logit_c
+        l0 = jnp.ones_like(logit_c)
+        accs = []
+        for h in range(n_kv_heads):
+            band = new_v[:, h * d_head:(h + 1) * d_head].astype(
+                jnp.float32)
+            accs.append(jnp.tile(band, (G, 1)))
+        acc0 = jnp.concatenate(accs, axis=0)  # [HG, Dh]
+    else:
+        m0 = jnp.full((HG, 1), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((HG, 1), jnp.float32)
+        acc0 = jnp.zeros((HG, d_head), jnp.float32)
+
+    @pl.when(first_page < n_pages)
+    def _():
+        k0, v0 = get_dma(0, first_page)
+        k0.start()
+        v0.start()
+
+    def body(p, carry):
+        acc, m, l = carry
+        slot = lax.rem(p - first_page, 2)
+        nxt = lax.rem(p - first_page + 1, 2)
+
+        @pl.when(p + 1 < n_pages)
+        def _():
+            kn, vn = get_dma(nxt, p + 1)
+            kn.start()
+            vn.start()
+
+        kp, vp = get_dma(slot, p)
+        kp.wait()
+        vp.wait()
+        k = kbuf[slot]
+        if quantized:
+            k = k.astype(q2.dtype)
+        logits = head_logits(k) * scale  # [HG, page]
+        if quantized:
+            logits = logits * scale_row(ks_ref, p)
+        kvrow = p * page + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 1)
+        valid = (kvrow <= hi) & q_valid
+        if sliding_window is not None:
+            valid &= kvrow > qpos - sliding_window
+        logits = jnp.where(valid, logits, NEG_INF)
+        m_page = jnp.max(logits, axis=1, keepdims=True)  # [HG, 1]
+        m_new = jnp.maximum(m, m_page)
+        alpha = jnp.exp(m - m_new)
+        pexp = jnp.exp(logits - m_new)
+        pexp = jnp.where(valid, pexp, 0.0)
+        l = l * alpha + jnp.sum(pexp, 1, keepdims=True)
+        if quantized:
+            pexp_v = pexp * scale_row(vs_ref, p)
+            vpage = vbuf[slot].astype(jnp.float32)
+        else:
+            pexp_v, vpage = pexp, vbuf[slot]
+        acc = acc * alpha + head_pv(pexp_v, vpage)
+        return acc, m_new, l
+
+    acc, m, l = lax.fori_loop(first_page, n_pages, body, (acc0, m0, l0))
+    out_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(out_ref.dtype)
+
+
+def ragged_paged_attention(
+    q: jax.Array,  # [B, T, H, Dh] post-rope queries (T static; rows pad
+    # their tail queries beyond q_lens — outputs there are garbage the
+    # caller discards)
+    cache_k: jax.Array,  # [L, n_pages, page, F] paged arena, already
+    # holding this dispatch's K rows at [pos0, pos0 + q_lens) (the
+    # caller scatter-appends through its write table)
+    cache_v: jax.Array,
+    layer: jax.Array,  # [] i32 layer index
+    page_table: jax.Array,  # [B, max_pages] i32 physical pages
+    pos0: jax.Array,  # [B] i32 absolute position of q[:, 0]
+    q_lens: jax.Array,  # [B] i32 valid query tokens per row
+    n_kv_heads: int,
+    *,
+    scale: float,
+    page: int,
+    sliding_window: Optional[int] = None,
+    cache_k_scale: Optional[jax.Array] = None,  # [L, n_pages, page] f32
+    cache_v_scale: Optional[jax.Array] = None,
+    seed_kv: Optional[tuple] = None,  # (new_k [B, F], new_v [B, F]):
+    # T==1 decode mode — the current rows' EXACT values ride in VMEM and
+    # their HBM copies are masked (ops/decode_attention.py contract)
+) -> jax.Array:
+    """Ragged attention for the whole batch in ONE kernel invocation;
+    returns [B, T, H * Dh] f32."""
+    B, T, H, Dh = q.shape
+    L, NP, PG, F = cache_k.shape
+    assert PG == page, (PG, page)
+    _, max_pages = page_table.shape
+    group = H // n_kv_heads
+    G = group * T
+    HG = n_kv_heads * G
+    quantized = cache_k_scale is not None
+    seeded = seed_kv is not None
+    if seeded:
+        assert T == 1, "seed_kv is the decode (T == 1) contract"
+    # [B, T, H, Dh] -> [B, Hkv*G, Dh] with row (h*group+g)*T + t, so the
+    # kernel recovers t as row % T
+    q2 = q.reshape(B, T, n_kv_heads, group, Dh).transpose(
+        0, 2, 3, 1, 4).reshape(B, HG, Dh)
+    nsp = 4  # q_lens, pos0, layer, page_table
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+
+    def _bspec(shape):
+        return pl.BlockSpec(
+            shape, lambda b, qls, p0s, lay, pt: (b,) + (0,) * (
+                len(shape) - 1))
+
+    operands = [q_lens, pos0, layer[None], page_table, q2]
+    in_specs = [_bspec((1, HG, Dh))]
+    if seeded:
+        new_k, new_v = seed_kv
+        operands += [new_k[:, None, :], new_v[:, None, :]]
+        in_specs += [_bspec((1, 1, F)), _bspec((1, 1, F))]
+    operands += [cache_k, cache_v]
+    in_specs += [any_spec, any_spec]
+    if quantized:
+        # per-row scale pages gathered through the table ([B, max_pages,
+        # page] — logical page p of row b lands at row p, matching the
+        # kernel's one-hot page selection)
+        ks_g = lax.dynamic_index_in_dim(
+            cache_k_scale, layer, 0, keepdims=False)[page_table]
+        vs_g = lax.dynamic_index_in_dim(
+            cache_v_scale, layer, 0, keepdims=False)[page_table]
+        operands += [ks_g, vs_g]
+        in_specs += [_bspec((1, max_pages, page)),
+                     _bspec((1, max_pages, page))]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=nsp,
+        grid=(B,),
+        in_specs=in_specs,
+        out_specs=_bspec((1, HG, Dh)),
+        scratch_shapes=[
+            pltpu.VMEM((2, page, F), cache_k.dtype),
+            pltpu.VMEM((2, page, F), cache_v.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = functools.partial(
+        _ragged_kernel, scale=scale, sliding_window=sliding_window,
+        page=page, T=T, n_kv_heads=n_kv_heads, d_head=Dh,
+        quantized=quantized, seeded=seeded,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, HG, Dh), jnp.float32),
+        interpret=_interpret(),
+    )(*operands)
+    # [B, Hkv*G, Dh] -> [B, T, H*Dh]
+    return out.reshape(B, n_kv_heads, group, T, Dh).transpose(
+        0, 3, 1, 2, 4).reshape(B, T, H * Dh)
+
+
+def ragged_attention_reference(
+    q, cache_k, cache_v, layer, page_table, pos0, q_lens, n_kv_heads,
+    *, scale, page, sliding_window=None, cache_k_scale=None,
+    cache_v_scale=None, seed_kv=None,
+) -> jax.Array:
+    """Dense XLA oracle: gather each row's pages into a contiguous
+    window, dequantize, and run masked softmax attention. Used by
+    ops/kernel_check.py (and tests) to validate the kernel; the engine's
+    own XLA fallback is the gather_kv_pages serving path, which computes
+    the same values through models.transformer._attend."""
+    B, T, H, Dh = q.shape
+    W = page_table.shape[1] * page
+    k = cache_k[layer][page_table].reshape(B, W, -1)
+    v = cache_v[layer][page_table].reshape(B, W, -1)
+    k = k.astype(jnp.float32)
+    v = v.astype(jnp.float32)
+    if cache_k_scale is not None:
+        ks = cache_k_scale[layer][page_table].reshape(B, W)
+        vs = cache_v_scale[layer][page_table].reshape(B, W)
+        k = k * ks[..., None]
+        v = v * vs[..., None]
+    if seed_kv is not None:
+        assert T == 1
+        rows = jnp.arange(B)
+        k = k.at[rows, jnp.maximum(pos0, 0)].set(
+            seed_kv[0].astype(jnp.float32))
+        v = v.at[rows, jnp.maximum(pos0, 0)].set(
+            seed_kv[1].astype(jnp.float32))
+    group = H // n_kv_heads
+    kh = k.reshape(B, W, n_kv_heads, Dh)[:, :, jnp.arange(H) // group, :]
+    vh = v.reshape(B, W, n_kv_heads, Dh)[:, :, jnp.arange(H) // group, :]
+    logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32), kh,
+                        precision=lax.Precision.HIGHEST) * scale
+    kv_pos = jnp.arange(W)[None, None, None, :]
+    qpos = (pos0[:, None] + jnp.arange(T)[None, :])[:, None, :, None]
+    mask = (kv_pos <= qpos) & (
+        jnp.arange(T)[None, None, :, None] < q_lens[:, None, None, None])
+    if sliding_window is not None:
+        mask &= kv_pos > qpos - sliding_window
+    logits = jnp.where(mask, logits, NEG_INF)
+    # fully-masked pad queries: keep softmax finite, zero the output
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = jnp.where(mask.any(-1, keepdims=True), probs, 0.0)
+    out = jnp.einsum("bhts,bshd->bthd", probs, vh,
+                     precision=lax.Precision.HIGHEST)
+    return out.reshape(B, T, H * Dh)

@@ -186,6 +186,25 @@ ENGINE_DECODE_STALL = REGISTRY.histogram(
     "dispatcher bounds by its token budget",
     labels=("model",), buckets=_STEP_BUCKETS,
 )
+# ragged paged attention (ops/ragged_paged_attention.py + the
+# full-width dispatch discipline in engine.py)
+ENGINE_DISPATCH_VARIANTS = REGISTRY.gauge(
+    "engine_dispatch_compile_variants_count",
+    "Jit dispatch variants precompiled by the last completed engine "
+    "warmup pass (one per (fn, shape) pair) — the compile-variant "
+    "explosion the ragged paged-attention unification collapses to one "
+    "variant per token-budget shape; 0 until warmup runs or when it "
+    "was skipped via the persistent-cache marker",
+    labels=("model",),
+)
+ENGINE_RAGGED_ROWS = REGISTRY.counter(
+    "engine_ragged_rows_total",
+    "Rows advanced through the unified ragged-attention dispatch path "
+    "by kind (decode = decode rows, prefill = non-final prompt chunk "
+    "rows, final = final prompt chunk rows, verify = spec-decode "
+    "verify rows)",
+    labels=("model", "kind"),
+)
 
 # ---------------------------------------------------------------- loader
 

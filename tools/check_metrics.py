@@ -51,6 +51,10 @@ REQUIRED_FAMILIES = {
     "engine_kv_pages_shared_count",
     "engine_kv_page_alloc_total",
     "engine_kv_hbm_per_live_token_bytes",
+    # ragged paged attention: the variant-explosion kill must stay
+    # visible and regression-guarded
+    "engine_dispatch_compile_variants_count",
+    "engine_ragged_rows_total",
 }
 
 

@@ -395,6 +395,12 @@ def mixed_scenario(small: bool, n_streams: int, n_bursts: int,
         report = loop.run_until_complete(drive())
     finally:
         loop.close()
+    # ragged paged attention: jit-cache variant counts + warmup wall
+    # time, on vs off (the compile-variant collapse riding the same
+    # mixed-traffic scheduler this scenario stresses)
+    from bench import ragged_variant_report
+
+    report["ragged_attn"] = ragged_variant_report()
     print(json.dumps(report, indent=1), flush=True)
     eng.close()
 

@@ -251,6 +251,12 @@ def main() -> None:
     if args.mixed:
         report["mixed"] = mixed_shape(args.small, args.streams,
                                       args.bursts, args.burst_size)
+    # ragged paged attention: jit-cache variant counts + warmup wall
+    # time, on vs off — the compile-variant collapse next to the pool
+    # numbers it rides on
+    from bench import ragged_variant_report
+
+    report["ragged_attn"] = ragged_variant_report()
     print(json.dumps(report, indent=1), flush=True)
 
 
