@@ -325,6 +325,24 @@ def _mixed_itl_extra(eng, tok, n_tok=96) -> dict:
     }
 
 
+def _lint_extra():
+    """graftlint trajectory per release: rule count, findings, baseline
+    size. New findings here mean tier-1 (tests/test_lint.py) is already
+    red; the bench records the numbers so the baseline's
+    shrink-over-releases is visible in the BENCH history."""
+    from tools.lint import ALL_RULES, lint_repo
+
+    findings, res = lint_repo()
+    return {
+        "rules": len(ALL_RULES),
+        "findings": len(findings),
+        "new": len(res.new),
+        "grandfathered": len(res.grandfathered),
+        "stale_baseline": len(res.stale),
+        "clean": res.ok,
+    }
+
+
 def _bench_http(state, model, n_req, n_tok, runs=2, extra=None):
     """Endpoint-level benchmark: boot the REAL aiohttp server (routes,
     middleware, SSE writer) over the given Application (whose loader
@@ -1030,6 +1048,7 @@ def main() -> None:
         extra["ttft_p50_ms"] = p50
         extra["ttft_p50_ms_http"] = p50_h
 
+    extra["lint"] = _lint_extra()
     extra["telemetry"] = REGISTRY.delta(tel_snap)
     print(json.dumps({
         "metric": "decode_throughput",

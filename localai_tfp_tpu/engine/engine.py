@@ -543,8 +543,8 @@ class LLMEngine:
         # for admissions deferred one scheduler iteration so a
         # wave-mate's prefill commits the shared prefix they copy from
         self._deferred: dict[str, tuple[float, int]] = {}
-        self._pending: list[tuple[GenRequest, queue.SimpleQueue]] = []
-        self._cancelled: dict[str, float] = {}  # id -> cancel time
+        self._pending: list[tuple[GenRequest, queue.SimpleQueue]] = []  # lint: guarded-by self._lock
+        self._cancelled: dict[str, float] = {}  # lint: guarded-by self._lock
         self._lock = threading.Condition()
         self._stop = False
         self._thread: Optional[threading.Thread] = None
@@ -639,7 +639,7 @@ class LLMEngine:
         self._step_ms = 0.0  # EWMA of device ms per decode step,
         # measured at scan harvest; _latency_k sizes open-capacity
         # scans from it
-        self._arrivals: deque[float] = deque(maxlen=8)  # submit-call
+        self._arrivals: deque[float] = deque(maxlen=8)  # lint: guarded-by self._lock  # submit-call
         # timestamps (one per submit/submit_many); _prefill_hold reads
         # their spread to tell a still-landing burst from a lone
         # arrival or a single batched wave
@@ -1392,6 +1392,7 @@ class LLMEngine:
         sampled = any(s.request.temperature > 0 for s in elig)
         return ("sampled" if sampled else "greedy"), elig
 
+    # lint: region hot_path
     def _spec_decode_step(self, decoding: list[_Slot],
                           mode: str = "greedy") -> None:
         """One speculative dispatch (see _spec_decode_fn /
@@ -1455,9 +1456,12 @@ class LLMEngine:
         self._note_ragged_rows("verify", len(decoding))
         D, Mt, J = self._run("spec_s" if mode == "sampled" else "spec",
                              payload)
+        # lint: ignore[hot-path-sync] spec verify is a deliberately blocking dispatch: emission needs J/D/Mt on host before the next spec round is sized
         D = np.asarray(D)  # [rounds, S, kd-1] draft candidates
+        # lint: ignore[hot-path-sync] same blocking spec harvest (see D above)
         Mt = np.asarray(Mt)  # [rounds, S, kd] main tokens (greedy verify
         # choices, or rejection-resample/bonus tokens on the sampled path)
+        # lint: ignore[hot-path-sync] same blocking spec harvest (see D above)
         J = np.asarray(J)  # [rounds, S] emitted counts
         dt_ms = (time.perf_counter() - t0) * 1e3
         emitted_total = 0
@@ -1496,6 +1500,7 @@ class LLMEngine:
             model=self._mlabel, composition="decode_only").inc()
         self._note_decode_advance(t0)
         self.metrics.slots_busy = sum(1 for s in self.slots if s.active)
+    # lint: endregion hot_path
 
     def _decode_k_fn(self, k: int, window: int):
         """Jitted k-step decode: ``lax.scan`` over k forward+sample steps so
@@ -2260,6 +2265,7 @@ class LLMEngine:
                     tm.ENGINE_PREEMPTIONS.labels(model=self._mlabel).inc()
                 self._release(s)
 
+    # lint: region hot_path
     def step(self) -> None:
         """One scheduler iteration (ref: update_slots, grpc-server.cpp:1639).
 
@@ -2479,6 +2485,8 @@ class LLMEngine:
                 self._complete_decodek(fl)
             did = True
         return did
+
+    # lint: endregion hot_path
 
     # admission + prefix reuse (ref: grpc-server.cpp:1749-1900; extended
     # to a GLOBAL prefix cache: radix index over every slot's resident
@@ -3117,6 +3125,7 @@ class LLMEngine:
         return max(1, min(self._group_cap,
                           self._PREFILL_GROUP_TOKENS // max(bucket, 1)))
 
+    # lint: region hot_path
     def _enqueue_prefill_final(self, group: list[_Slot],
                                bucket: int) -> None:
         """Enqueue a batch of same-bucket final prompt chunks: one fused
@@ -3256,7 +3265,7 @@ class LLMEngine:
         toks_out = self._run("prefill_final", payload)
         try:
             toks_out.copy_to_host_async()
-        except Exception:
+        except AttributeError:
             pass  # not all backends expose it; harvest still works
         t_disp = time.perf_counter()
         enq_ms = (t_disp - t0) * 1e3
@@ -3282,6 +3291,7 @@ class LLMEngine:
     def _complete_prefill_final(self, fl: _Flight) -> None:
         """Harvest a prefill flight: emit each slot's first token and
         move it into the decode set."""
+        # lint: ignore[hot-path-sync] _harvest only hands over flights whose ready() is true — this host read is transfer-complete, not a sync
         toks_host = np.asarray(fl.arrays[0])
         now = time.perf_counter()
         rows = fl.meta.get("rows") or range(len(fl.meta["pairs"]))
@@ -3439,7 +3449,7 @@ class LLMEngine:
         toks_out = self._run("mixed", payload)
         try:
             toks_out.copy_to_host_async()
-        except Exception:
+        except AttributeError:
             pass  # not all backends expose it; harvest still works
         t_disp = time.perf_counter()
         enq_ms = (t_disp - t0) * 1e3
@@ -3474,6 +3484,7 @@ class LLMEngine:
         final-chunk rows emit their first token and join the decode
         set, non-final chunk rows only collect prefill-time
         attribution."""
+        # lint: ignore[hot-path-sync] flight ready() verified by _harvest; the transfer already landed
         toks_host = np.asarray(fl.arrays[0])  # [S]
         now = time.perf_counter()
         dt_ms = (now - fl.t_enqueue) * 1e3
@@ -3516,6 +3527,7 @@ class LLMEngine:
             tm.ENGINE_INTER_TOKEN.labels(model=m).observe(dt_ms / 1e3)
             self._note_tokens_per_second(decode_emitted, dt_ms / 1e3)
         self.metrics.slots_busy = sum(1 for s in self.slots if s.active)
+    # lint: endregion hot_path
 
     def _note_decode_advance(self, now: float) -> None:
         """Stall accounting: observe the gap between consecutive
@@ -3676,6 +3688,7 @@ class LLMEngine:
             k = min(compiled)
         return k, room, need
 
+    # lint: region hot_path
     def _dispatch_decode(self, decoding: list[_Slot]) -> bool:
         """Enqueue (or, for the host-interactive paths, run) decode work
         (ref: grpc-server.cpp:1688-1726 batching ongoing tokens). The
@@ -3900,8 +3913,8 @@ class LLMEngine:
         toks = batches[0]
         try:
             toks.copy_to_host_async()
-        except Exception:
-            pass
+        except AttributeError:
+            pass  # not all backends expose it; harvest still works
         self._dev_epoch = self._epoch
         self._dev_akey = akey
         self._flights.append(_Flight(
@@ -3939,6 +3952,7 @@ class LLMEngine:
         """Harvest one k-step scan: emit tokens per slot, discarding
         overshoot past a finish (EOS/stop/limit)."""
         k = fl.meta["k"]
+        # lint: ignore[hot-path-sync] flight ready() verified by _harvest; the transfer already landed
         toks_host = np.asarray(fl.arrays[0])  # [S, k]
         now = time.perf_counter()
         dt_ms = (now - max(fl.t_enqueue, self._last_harvest_t)) * 1e3
@@ -4026,6 +4040,7 @@ class LLMEngine:
                           if s.state is SlotState.DECODE else None))
                  for s in self.slots], self.max_seq)
         toks = self._run("decode1", payload)
+        # lint: ignore[hot-path-sync] decode1 IS the blocking path: grammar masks / logit bias need every token on host before the next dispatch
         toks_host = np.asarray(toks)
         dt_ms = (time.perf_counter() - t0) * 1e3
         emitted = 0
@@ -4045,6 +4060,8 @@ class LLMEngine:
         self._note_ragged_rows("decode", len(decoding))
         self._note_decode_advance(t0)
         self.metrics.slots_busy = sum(1 for s in self.slots if s.active)
+
+    # lint: endregion hot_path
 
     # ---------------------------------------------------- token → stream
 

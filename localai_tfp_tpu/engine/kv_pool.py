@@ -28,6 +28,7 @@ Invariants the engine relies on (asserted by ``leak_check``):
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 
 __all__ = ["PagePool", "PagePoolExhausted", "TRASH_PAGE"]
@@ -59,45 +60,61 @@ class PagePool:
             raise ValueError(f"page_size must be >= 1; got {page_size}")
         self.page = page_size
         self.n_pages = n_pages
+        # the scheduler thread owns all allocation, but stats()/tables
+        # are read from server threads (/backend/monitor, profilers), so
+        # bookkeeping mutations take a lock — sub-microsecond host work
+        # at admission granularity, invisible next to a device dispatch
+        self._lock = threading.Lock()
         # pop() allocates ascending (1, 2, ...): keeps fresh arenas dense
-        self._free: list[int] = list(range(n_pages - 1, 0, -1))
-        self._ref = [0] * n_pages
+        self._free: list[int] = list(range(n_pages - 1, 0, -1))  # lint: guarded-by self._lock
+        self._ref = [0] * n_pages  # lint: guarded-by self._lock
         self._ref[TRASH_PAGE] = 1  # permanently reserved
-        self._tables: dict[int, list[int]] = {}
+        self._tables: dict[int, list[int]] = {}  # lint: guarded-by self._lock
         # allocation outcomes, exported as
         # engine_kv_page_alloc_total{outcome=...} by the engine
-        self.allocs = {"fresh": 0, "shared": 0, "cow": 0}
+        self.allocs = {"fresh": 0, "shared": 0, "cow": 0}  # lint: guarded-by self._lock
 
     # ----------------------------------------------------------- queries
 
     def table(self, slot: int) -> list[int]:
         """The slot's physical page run (page i covers token positions
-        [i*page, (i+1)*page))."""
-        return self._tables.get(slot, [])
+        [i*page, (i+1)*page)). Returns a snapshot copy: concurrent
+        monitor reads must not alias a list the scheduler mutates."""
+        with self._lock:
+            return list(self._tables.get(slot, ()))
 
     def held(self, slot: int) -> int:
         """Pages currently referenced by the slot's table."""
-        return len(self._tables.get(slot, ()))
+        with self._lock:
+            return len(self._tables.get(slot, ()))
 
     def writable(self, pg: int) -> bool:
         """Whether a dispatch may write this page (exactly one owner;
         never the trash page)."""
+        with self._lock:
+            return self._writable(pg)
+
+    def _writable(self, pg: int) -> bool:
+        # lint: holds self._lock
         return pg != TRASH_PAGE and self._ref[pg] == 1
 
     def pages_for(self, n_tokens: int) -> int:
         return -(-max(n_tokens, 0) // self.page)
 
     def stats(self) -> PoolStats:
-        in_use = (self.n_pages - 1) - len(self._free)
-        shared = sum(1 for pg in range(1, self.n_pages)
-                     if self._ref[pg] > 1)
-        refs = sum(len(t) for t in self._tables.values())
-        return PoolStats(total=self.n_pages - 1, free=len(self._free),
-                         in_use=in_use, shared=shared, refs=refs)
+        with self._lock:
+            in_use = (self.n_pages - 1) - len(self._free)
+            shared = sum(1 for pg in range(1, self.n_pages)
+                         if self._ref[pg] > 1)
+            refs = sum(len(t) for t in self._tables.values())
+            return PoolStats(total=self.n_pages - 1,
+                             free=len(self._free),
+                             in_use=in_use, shared=shared, refs=refs)
 
     # -------------------------------------------------------- allocation
 
     def _alloc(self) -> int:
+        # lint: holds self._lock
         if not self._free:
             raise PagePoolExhausted(
                 f"KV page pool exhausted ({self.n_pages - 1} pages of "
@@ -108,6 +125,7 @@ class PagePool:
         return pg
 
     def _unref(self, pg: int) -> None:
+        # lint: holds self._lock
         if pg == TRASH_PAGE:
             return
         self._ref[pg] -= 1
@@ -121,34 +139,38 @@ class PagePool:
         returns the number of fresh pages appended. Raises
         PagePoolExhausted when the arena runs dry (the engine reclaims
         free-slot residents and retries)."""
-        t = self._tables.setdefault(slot, [])
-        need = self.pages_for(n_tokens)
-        added = 0
-        while len(t) < need:
-            t.append(self._alloc())
-            added += 1
-        return added
+        with self._lock:
+            t = self._tables.setdefault(slot, [])
+            need = self.pages_for(n_tokens)
+            added = 0
+            while len(t) < need:
+                t.append(self._alloc())
+                added += 1
+            return added
 
     def append_fresh(self, slot: int) -> int:
         """Append one fresh private page; returns its physical id."""
-        pg = self._alloc()
-        self._tables.setdefault(slot, []).append(pg)
-        return pg
+        with self._lock:
+            pg = self._alloc()
+            self._tables.setdefault(slot, []).append(pg)
+            return pg
 
     def truncate(self, slot: int, n_tokens: int) -> None:
         """Drop table entries wholly beyond ``n_tokens`` positions."""
-        t = self._tables.get(slot)
-        if t is None:
-            return
-        keep = self.pages_for(n_tokens)
-        while len(t) > keep:
-            self._unref(t.pop())
+        with self._lock:
+            t = self._tables.get(slot)
+            if t is None:
+                return
+            keep = self.pages_for(n_tokens)
+            while len(t) > keep:
+                self._unref(t.pop())
 
     def drop(self, slot: int) -> None:
         """Release every page the slot references (shared pages survive
         while other tables still reference them)."""
-        for pg in self._tables.pop(slot, []):
-            self._unref(pg)
+        with self._lock:
+            for pg in self._tables.pop(slot, []):
+                self._unref(pg)
 
     # ----------------------------------------------------------- sharing
 
@@ -158,12 +180,13 @@ class PagePool:
         device work). dst's previous pages are released first. Returns
         the number of pages shared."""
         self.drop(dst)
-        run = self._tables.get(src, [])[:n_full_pages]
-        for pg in run:
-            self._ref[pg] += 1
-        self._tables[dst] = list(run)
-        self.allocs["shared"] += len(run)
-        return len(run)
+        with self._lock:
+            run = self._tables.get(src, [])[:n_full_pages]
+            for pg in run:
+                self._ref[pg] += 1
+            self._tables[dst] = list(run)
+            self.allocs["shared"] += len(run)
+            return len(run)
 
     def prepare_write(self, slot: int, pos: int):
         """Make position ``pos`` (the slot's write frontier) privately
@@ -172,25 +195,27 @@ class PagePool:
         copy-on-write swapped for a fresh private page. Returns the
         (src_page, dst_page) pair the engine must row-copy on device, or
         None when no copy is needed."""
-        t = self._tables.setdefault(slot, [])
-        b = pos // self.page
-        while len(t) > b + 1:
-            self._unref(t.pop())
-        if len(t) <= b:
-            return None  # frontier page not allocated yet: ensure() will
-        if pos % self.page == 0:
-            # the boundary page carries no committed rows — a shared one
-            # is simply released (content lives on in the donor's table)
-            if not self.writable(t[b]):
+        with self._lock:
+            t = self._tables.setdefault(slot, [])
+            b = pos // self.page
+            while len(t) > b + 1:
                 self._unref(t.pop())
-            return None
-        if self.writable(t[b]):
-            return None
-        old = t[b]
-        fresh = self._alloc()
-        t[b] = fresh
-        self._unref(old)
-        self.allocs["cow"] += 1
+            if len(t) <= b:
+                return None  # frontier page not allocated yet: ensure()
+            if pos % self.page == 0:
+                # the boundary page carries no committed rows — a shared
+                # one is simply released (content lives on in the
+                # donor's table)
+                if not self._writable(t[b]):
+                    self._unref(t.pop())
+                return None
+            if self._writable(t[b]):
+                return None
+            old = t[b]
+            fresh = self._alloc()
+            t[b] = fresh
+            self._unref(old)
+            self.allocs["cow"] += 1
         # the device copy the caller dispatches is enqueued before any
         # later write can recycle ``old``, so device-order serialization
         # keeps the read coherent even if old just hit the free list
@@ -202,6 +227,11 @@ class PagePool:
         """Assert the structural invariants; raises AssertionError on a
         leak or double-owner (used by the churn fuzz test and callable
         from debug endpoints)."""
+        with self._lock:
+            return self._leak_check()
+
+    def _leak_check(self) -> None:
+        # lint: holds self._lock
         counts = [0] * self.n_pages
         for t in self._tables.values():
             for pg in t:

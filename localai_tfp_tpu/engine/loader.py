@@ -196,9 +196,9 @@ class ModelLoader:
     ) -> None:
         self.models_path = models_path
         self.single_active = single_active_backend
-        self._models: dict[str, LoadedModel] = {}
         self._lock = threading.Lock()  # registry map mutations only
-        self._loads: dict[str, _InFlightLoad] = {}  # per-model loads
+        self._models: dict[str, LoadedModel] = {}  # lint: guarded-by self._lock
+        self._loads: dict[str, _InFlightLoad] = {}  # lint: guarded-by self._lock
         # single-active mode needs whole-load serialization: two
         # concurrent leaders would each evict the other, then both
         # publish — two live backends in a mode whose point is one

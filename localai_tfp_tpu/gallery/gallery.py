@@ -9,6 +9,7 @@ gallery.url; pkg/startup/model_preload.go resolves CLI model args
 
 from __future__ import annotations
 
+import logging
 import os
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
@@ -143,7 +144,11 @@ def delete_model(name: str, models_path: str) -> bool:
     try:
         with open(cfg_path) as f:
             cfg = yaml.safe_load(f) or {}
-    except Exception:
+    except (OSError, yaml.YAMLError) as e:
+        # still delete what we can reach; the referenced model file
+        # just becomes unremovable by name
+        log.warning("unreadable config %s on delete (%r); removing "
+                    "the yaml only", cfg_path, e)
         cfg = {}
     os.unlink(cfg_path)
     model_file = (cfg.get("parameters") or {}).get("model") or cfg.get("model")

@@ -5,14 +5,18 @@ UpdateStatus/GetStatus/GetAllStatus).
 
 from __future__ import annotations
 
+import logging
 import threading
 import uuid
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..telemetry import metrics as tm
 from .gallery import (
     GalleryModel, delete_model, install_model, load_gallery_index,
 )
+
+log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -59,8 +63,13 @@ class GalleryService:
             try:
                 models.extend(load_gallery_index(
                     g.get("url", ""), g.get("name", "")))
-            except Exception:
-                continue  # unreachable gallery must not break the list
+            except Exception as e:
+                # an unreachable gallery must not break the list, but
+                # the operator should see WHICH one is down and why
+                log.warning("gallery %r index unavailable: %r",
+                            g.get("name") or g.get("url", ""), e)
+                tm.RECOVERED_ERRORS.labels(site="gallery_index").inc()
+                continue
         import os
 
         installed = set()

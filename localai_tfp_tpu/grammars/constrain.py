@@ -65,8 +65,9 @@ class GrammarConstraint:
         for tid in range(self.vocab_size):
             try:
                 s = tokenizer.decode([tid])
-            except Exception:
-                s = None
+            except (KeyError, IndexError, ValueError,
+                    UnicodeDecodeError):
+                s = None  # special/control token: not grammar text
             # control/special tokens (decode to empty or replacement char)
             # are never part of grammar text
             if s and "�" not in s:
@@ -152,8 +153,9 @@ class LazyGrammarConstraint:
             for tid in range(self.vocab_size):
                 try:
                     s = tokenizer.decode([tid])
-                except Exception:
-                    continue
+                except (KeyError, IndexError, ValueError,
+                        UnicodeDecodeError):
+                    continue  # special/control token: not grammar text
                 if s and "�" not in s:
                     self._token_strs[tid] = s
 

@@ -64,8 +64,9 @@ class NativeGrammarConstraint:
         for tid in range(self.vocab_size):
             try:
                 s = tokenizer.decode([tid])
-            except Exception:
-                continue
+            except (KeyError, IndexError, ValueError,
+                    UnicodeDecodeError):
+                continue  # special/control token: not grammar text
             if s and "�" not in s:
                 b = s.encode("utf-8")
                 self._lib.gbnf_add_token(self._h, tid, b, len(b))

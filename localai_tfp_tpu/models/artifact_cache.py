@@ -269,8 +269,10 @@ def save_async(path: str, params: dict[str, Any],
                 raise _Aborted
             try:
                 ok = bool(idle())
-            except Exception:
-                ok = True  # a dead engine can't contend
+            except Exception as e:
+                log.debug("idle probe raised %r; treating engine as "
+                          "idle (a dead engine can't contend)", e)
+                ok = True
             streak = streak + 1 if ok else 0
             if streak < consecutive:
                 time.sleep(0.5)

@@ -642,8 +642,8 @@ class GGUFTokenizer:
             return ""
         try:
             return self._tk.id_to_token(int(tid)) or ""
-        except Exception:
-            return ""
+        except (KeyError, IndexError, ValueError, TypeError):
+            return ""  # out-of-vocab / non-integral id: no text form
 
 
 def tokenizer_from_gguf(gf: "GGUFFile") -> GGUFTokenizer:

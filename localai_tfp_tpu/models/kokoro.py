@@ -704,8 +704,8 @@ def is_kokoro_dir(model_dir: str) -> bool:
     try:
         with open(cfg_path) as f:
             cfg = json.load(f)
-    except Exception:
-        return False
+    except (OSError, ValueError):
+        return False  # unreadable/non-JSON config: not a kokoro dir
     if (cfg.get("model_type") or "").lower() in ("kokoro", "styletts2"):
         return True
     return ("istftnet" in cfg or "plbert" in cfg) and "style_dim" in cfg

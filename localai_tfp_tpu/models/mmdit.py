@@ -751,5 +751,5 @@ def pipeline_class_name(model_dir: str) -> str:
     try:
         with open(mi) as f:
             return json.load(f).get("_class_name", "") or ""
-    except Exception:
-        return ""
+    except (OSError, ValueError, AttributeError):
+        return ""  # unreadable/non-dict model_index: class unknown

@@ -39,6 +39,7 @@ on-device); convolutions run channels-last via
 from __future__ import annotations
 
 import json
+import logging
 import math
 import os
 from dataclasses import dataclass
@@ -48,6 +49,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+log = logging.getLogger(__name__)
 
 Params = dict[str, Any]
 
@@ -417,7 +420,8 @@ def load_voices(model_dir: str) -> dict[str, tuple]:
             try:
                 lat = np.asarray(d["gpt_cond_latent"].float())
                 emb = np.asarray(d["speaker_embedding"].float())
-            except Exception:
+            except (KeyError, AttributeError, TypeError, ValueError) as e:
+                log.warning("skipping malformed voice %r: %r", name, e)
                 continue
             out[name] = (jnp.asarray(lat.reshape(lat.shape[-2],
                                                  lat.shape[-1])),
@@ -543,7 +547,9 @@ def load_xtts(model_dir: str, dtype=jnp.float32):
             from tokenizers import Tokenizer
 
             tok = Tokenizer.from_file(vocab)
-        except Exception:
+        except Exception as e:
+            log.warning("xtts vocab.json unusable (%r); falling back "
+                        "to byte-level text encoding", e)
             tok = None
     voices = load_voices(model_dir)
     return spec, p, tok, voices

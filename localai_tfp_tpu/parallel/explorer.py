@@ -10,12 +10,15 @@ join token); liveness = the balancer's /federation/nodes answering.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
 import urllib.request
 from dataclasses import asdict, dataclass, field
 from typing import Optional
+
+log = logging.getLogger(__name__)
 
 FAILURE_THRESHOLD = 3  # ref: explorer deletes after N failed dials
 
@@ -106,7 +109,9 @@ class DiscoveryServer:
                 online = self.check_network(e)
                 self.db.update(e.name, failures=0, nodes_online=online,
                                last_checked=time.time())
-            except Exception:
+            except Exception as exc:
+                log.debug("discovery probe of %r failed: %r",
+                          e.name, exc)
                 failures = e.failures + 1
                 if failures >= FAILURE_THRESHOLD:
                     self.db.remove(e.name)

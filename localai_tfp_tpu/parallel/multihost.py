@@ -46,6 +46,66 @@ log = logging.getLogger(__name__)
 
 Record = Tuple[str, Any]
 
+# ------------------------------------------------------------ codec contract
+#
+# The replay codec whitelist: every engine dispatch record kind and the
+# exact payload fields its followers know how to replay. Dispatch
+# payloads must stay SCALAR-ONLY — python ints/floats/bools/strs and
+# small index/token ndarrays (plus the bit-packed mask dict and the
+# reset column dict) — so records pickle small, broadcast in bounded
+# shapes, and replay with zero leader-side state.
+#
+# Adding a field HERE is the reviewed act that acknowledges the replay
+# contract; ``tools.lint``'s scalar-payload rule statically checks every
+# ``LLMEngine._run`` site against this table, so a new dispatch kind or
+# field that skips this table fails tier-1 instead of diverging SPMD
+# programs at runtime. (Plain literal on purpose: the linter reads it
+# from the AST without importing jax.)
+PAYLOAD_FIELDS = {
+    "prefill": ("toks", "pos0", "slot_ids", "soft", "window", "ring",
+                "pt", "wb"),
+    "prefill_final": ("toks", "pos0", "slot_ids", "n_chunk", "tails",
+                      "tail_lens", "masks", "reset", "soft", "window",
+                      "identity", "pt", "wb"),
+    "mixed": ("toks", "pos0", "n_chunk", "write_mask", "sample_sids",
+              "reset_sids", "tails", "tail_lens", "masks", "reset",
+              "soft", "prefill_sids", "window", "pt", "wb", "wb_draft"),
+    "decode1": ("tokens", "pos0", "active", "masks", "pt", "wb"),
+    "decodek": ("k", "window", "depth", "carry", "tokens", "pos0",
+                "active", "pt", "wb"),
+    "spec": ("kd", "rounds", "tokens", "pos0", "active", "pt", "wb"),
+    "spec_s": ("kd", "rounds", "tokens", "pos0", "active", "pt", "wb"),
+    "kvcopy": ("src", "dst", "n"),
+    "embed": ("toks", "bucket"),
+}
+
+
+def validate_payload(kind: str, payload: Any) -> None:
+    """Raise on a dispatch record the follower codec cannot replay.
+
+    Called by the test transport (``LocalChannel``) on every publish so
+    codec drift fails loudly in the suite; the broadcast path skips the
+    check (the static scalar-payload lint rule already gates merges).
+    """
+    if kind in ("load", "unload", "stop"):
+        return  # lifecycle records carry their own option objects
+    allowed = PAYLOAD_FIELDS.get(kind)
+    if allowed is None:
+        raise ValueError(
+            f"dispatch kind {kind!r} is not in the multihost codec "
+            "whitelist (PAYLOAD_FIELDS) — followers cannot replay it")
+    data = payload.get("data") if isinstance(payload, dict) else None
+    if not isinstance(data, dict):
+        raise ValueError(
+            f"record for {kind!r} must be {{'model', 'data'}} with a "
+            f"dict payload; got {type(data).__name__}")
+    extra = set(data) - set(allowed)
+    if extra:
+        raise ValueError(
+            f"payload field(s) {sorted(extra)} for kind {kind!r} are "
+            "not in the multihost codec whitelist (PAYLOAD_FIELDS)")
+
+
 # ---------------------------------------------------------------- encoding
 
 
@@ -72,18 +132,26 @@ class LocalChannel:
     is_leader = True
 
     def __init__(self) -> None:
-        self._ends: list["LocalFollowerEnd"] = []
         # publishers hold order_lock across publish+device-enqueue so the
         # follower's replay order equals the leader's XLA dispatch order
         # (RLock: publish() re-acquires under _run's critical section)
         self.order_lock = threading.RLock()
+        # fan-out ends join while engines publish (a test attaching a
+        # follower mid-stream), so membership shares the order lock
+        self._ends: list["LocalFollowerEnd"] = []  # lint: guarded-by self.order_lock
 
     def follower_end(self) -> "LocalFollowerEnd":
         end = LocalFollowerEnd()
-        self._ends.append(end)
+        with self.order_lock:
+            self._ends.append(end)
         return end
 
     def publish(self, kind: str, payload: Any) -> None:
+        # the test transport enforces the codec whitelist on every
+        # record, so a payload field the follower codec doesn't know
+        # fails the suite at publish time (the broadcast transport
+        # skips this; the static scalar-payload rule gates merges)
+        validate_payload(kind, payload)
         # pickle round trip: followers must see a snapshot, not objects
         # the leader's scheduler thread keeps mutating
         with self.order_lock:
@@ -260,20 +328,28 @@ class FollowerRouter:
 
                 return JaxLLMBackend(role="follower")
         self._make_backend = make_backend
-        self.backends: dict[str, Any] = {}
-        self.failed: set[str] = set()
-        self._loading: dict[str, threading.Thread] = {}
+        # the router's maps are shared between the follower loop thread
+        # and the async load threads (run() publishes its backend from
+        # the load thread), so mutations take the lock; the loop's
+        # hot-path reads stay lock-free by design (worst case they see
+        # a load as still-pending and join it)
+        self._lock = threading.Lock()
+        self.backends: dict[str, Any] = {}  # lint: guarded-by self._lock
+        self.failed: set[str] = set()  # lint: guarded-by self._lock
+        self._loading: dict[str, threading.Thread] = {}  # lint: guarded-by self._lock
         self._rp = Replayer()
 
     def _join_load(self, tag: str) -> None:
-        th = self._loading.pop(tag, None)
-        if th is not None:
+        with self._lock:
+            th = self._loading.pop(tag, None)
+        if th is not None:  # join OUTSIDE the lock: loads take minutes
             th.join()
 
     def _load_async(self, rec: Any) -> None:
         tag = rec.model
         self._join_load(tag)  # a reload chains behind the previous load
-        old = self.backends.pop(tag, None)
+        with self._lock:
+            old = self.backends.pop(tag, None)
         if old is not None:  # leader reloaded the same model
             old.shutdown()
 
@@ -282,8 +358,9 @@ class FollowerRouter:
             with _follower_load_scope():  # pins "no collectives in load"
                 res = backend.load_model(rec)
             if res.success:
-                self.failed.discard(tag)
-                self.backends[tag] = backend
+                with self._lock:
+                    self.failed.discard(tag)
+                    self.backends[tag] = backend
             else:
                 # symmetric failures (bad checkpoint on every host) are
                 # recoverable: the leader's own load fails too and it
@@ -292,11 +369,13 @@ class FollowerRouter:
                 # host could not load — is fatal (handle()).
                 log.error("follower load of %r failed: %s", tag,
                           res.message)
-                self.failed.add(tag)
+                with self._lock:
+                    self.failed.add(tag)
 
         th = threading.Thread(target=run, name=f"follower-load-{tag}",
                               daemon=True)
-        self._loading[tag] = th
+        with self._lock:
+            self._loading[tag] = th
         th.start()
 
     def handle(self, kind: str, rec: Any) -> bool:
@@ -309,8 +388,9 @@ class FollowerRouter:
         if kind == "unload":
             tag = rec["model"]
             self._join_load(tag)
-            self.failed.discard(tag)
-            backend = self.backends.pop(tag, None)
+            with self._lock:
+                self.failed.discard(tag)
+                backend = self.backends.pop(tag, None)
             if backend is not None:
                 backend.shutdown()
             return True
@@ -338,12 +418,16 @@ class FollowerRouter:
         return True
 
     def shutdown(self) -> None:
-        for th in list(self._loading.values()):
+        with self._lock:
+            loading = list(self._loading.values())
+            self._loading.clear()
+        for th in loading:
             th.join()
-        self._loading.clear()
-        for backend in self.backends.values():
+        with self._lock:
+            backends = list(self.backends.values())
+            self.backends.clear()
+        for backend in backends:
             backend.shutdown()
-        self.backends.clear()
 
 
 def follower_main() -> None:

@@ -18,6 +18,7 @@ one-thread-per-stream producer — they are single-digit concurrency.
 from __future__ import annotations
 
 import asyncio
+import queue
 import threading
 from typing import Any, Optional
 
@@ -100,8 +101,8 @@ class StreamBridge:
                 while True:
                     try:
                         ev = st.sq.get_nowait()
-                    except Exception:
-                        break
+                    except queue.Empty:
+                        break  # drained for this sweep
                     rep, final = _to_replies(ev)
                     if rep is not None:
                         items.append(rep)

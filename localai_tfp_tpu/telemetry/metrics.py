@@ -239,3 +239,16 @@ WATCHDOG_KILLS = REGISTRY.counter(
     "Models killed by the busy/idle watchdog",
     labels=("kind",),
 )
+
+# ------------------------------------------------------------- error hygiene
+
+RECOVERED_ERRORS = REGISTRY.counter(
+    "recovered_errors_total",
+    "Recoverable failures that were caught and absorbed on a degraded "
+    "path (labelled by site). Before graftlint's except-swallow rule "
+    "these were silent `except Exception` swallows; now every recovery "
+    "is at least counted, so a spike is visible on /metrics instead of "
+    "surfacing as mystery behavior",
+    labels=("site",),
+    max_label_sets=64,
+)

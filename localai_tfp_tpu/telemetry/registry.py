@@ -70,8 +70,8 @@ class _Family:
         self.labelnames = tuple(labelnames)
         self.max_label_sets = max(1, max_label_sets)
         self._overflow = dict(overflow or {})
-        self._children: dict[tuple, object] = {}
         self._lock = threading.Lock()
+        self._children: dict[tuple, object] = {}  # lint: guarded-by self._lock
 
     def _new_child(self):
         raise NotImplementedError
@@ -128,8 +128,8 @@ class _CounterChild:
     __slots__ = ("_lock", "value")
 
     def __init__(self, lock: threading.Lock) -> None:
-        self._lock = lock
-        self.value = 0.0
+        self._lock = lock  # the family's lock, shared by all children
+        self.value = 0.0  # lint: guarded-by self._lock
 
     def inc(self, n: float = 1.0) -> None:
         if n < 0:
@@ -156,7 +156,7 @@ class _GaugeChild:
 
     def __init__(self, lock: threading.Lock) -> None:
         self._lock = lock
-        self.value = 0.0
+        self.value = 0.0  # lint: guarded-by self._lock
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -190,8 +190,9 @@ class _HistogramChild:
                  buckets: tuple[float, ...]) -> None:
         self._lock = lock
         self.buckets = buckets
-        self.counts = [0] * (len(buckets) + 1)  # raw per-bucket + overflow
-        self.sum = 0.0
+        # raw per-bucket + overflow
+        self.counts = [0] * (len(buckets) + 1)  # lint: guarded-by self._lock
+        self.sum = 0.0  # lint: guarded-by self._lock
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -244,7 +245,7 @@ class Registry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._families: dict[str, _Family] = {}
+        self._families: dict[str, _Family] = {}  # lint: guarded-by self._lock
 
     def _register(self, fam: _Family) -> _Family:
         with self._lock:

@@ -6,9 +6,12 @@ checkpoint fits before committing to a load."""
 from __future__ import annotations
 
 import json
+import logging
 import os
 import struct
 from typing import Any, Optional
+
+log = logging.getLogger(__name__)
 
 _DTYPE_BYTES = {
     "F64": 8, "F32": 4, "F16": 2, "BF16": 2,
@@ -33,8 +36,10 @@ def device_memory() -> list[dict[str, Any]]:
             stats = d.memory_stats() or {}
             row["bytes_limit"] = int(stats.get("bytes_limit", 0))
             row["bytes_in_use"] = int(stats.get("bytes_in_use", 0))
-        except Exception:
-            pass
+        except Exception as e:
+            # backends without memory_stats (CPU) land here; surface
+            # the reason in the row instead of a silent gap
+            row["memory_stats_error"] = repr(e)
         out.append(row)
     return out
 
@@ -134,7 +139,8 @@ def fits_in_memory(model_dir: str, dtype: str = "bfloat16",
         if est is None:
             est = estimate_model_bytes(model_dir, dtype, context_size,
                                        batch_slots)
-    except Exception:
+    except Exception as e:
+        log.debug("model size estimate failed for %s: %r", model_dir, e)
         return None
     limits = [d.get("bytes_limit", 0) for d in device_memory()]
     usable = sum(x for x in limits if x)

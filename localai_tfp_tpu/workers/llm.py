@@ -471,8 +471,10 @@ class JaxLLMBackend(Backend):
                     int(p.size) * p.dtype.itemsize
                     for p in jax.tree_util.tree_leaves(self.engine.params)
                 ))
-            except Exception:
-                pass
+            except Exception as e:
+                # status must never fail, but a half-built engine
+                # should say so rather than report empty memory
+                mem["error"] = repr(e)
         return StatusResponse(state=self._state, memory=mem)
 
     def busy(self) -> bool:

@@ -13,12 +13,15 @@ worker later.
 
 from __future__ import annotations
 
+import logging
 import os
 import wave
 
 import numpy as np
 
 from .base import Backend, ModelLoadOptions, Result, StatusResponse
+
+log = logging.getLogger(__name__)
 
 SR = 16000
 
@@ -110,8 +113,10 @@ def _try_tokenizer(model_dir: str):
         from transformers import AutoTokenizer
 
         return AutoTokenizer.from_pretrained(model_dir)
-    except Exception:
-        return None  # byte fallback at call sites
+    except Exception as e:
+        log.debug("no usable HF tokenizer in %s (%r); using byte "
+                  "fallback", model_dir, e)
+        return None
 
 
 VOICES = {  # voice id -> (pitch_hz, speed)

@@ -11,6 +11,7 @@ speech segments out, same JSON shape) is identical.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 import jax
@@ -21,6 +22,8 @@ from .base import (
     Backend, ModelLoadOptions, Result, StatusResponse, VADResponse,
     VADSegment,
 )
+
+log = logging.getLogger(__name__)
 
 SAMPLE_RATE = 16000
 FRAME = 512  # 32 ms
@@ -78,7 +81,10 @@ class JaxVADBackend(Backend):
                 if model.endswith((".jit", ".pt", ".pth", ".ts")):
                     try:  # torchscript archive (the silero download)
                         self._net = vad_net.load_torchscript(model)
-                    except Exception:
+                    except Exception as e:
+                        log.warning("torchscript parse of %s failed "
+                                    "(%r); retrying as a state_dict "
+                                    "checkpoint", model, e)
                         import torch
 
                         self._net = vad_net.load_state_dict(

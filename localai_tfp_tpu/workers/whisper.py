@@ -9,6 +9,7 @@ Audio intake mirrors the reference's ffmpeg conversion path
 
 from __future__ import annotations
 
+import logging
 import os
 import subprocess
 import threading
@@ -27,6 +28,8 @@ from .base import (
     Backend, ModelLoadOptions, Result, StatusResponse, TranscriptResult,
     TranscriptSegment,
 )
+
+log = logging.getLogger(__name__)
 
 
 def load_pcm(path: str) -> np.ndarray:
@@ -90,8 +93,11 @@ class JaxWhisperBackend(Backend):
                 try:
                     from transformers import AutoTokenizer
 
-                    self.tokenizer = AutoTokenizer.from_pretrained(model_dir)
-                except Exception:
+                    self.tokenizer = AutoTokenizer.from_pretrained(
+                        model_dir)
+                except Exception as e:
+                    log.warning("whisper tokenizer unavailable (%r); "
+                                "token ids will be byte-decoded", e)
                     self.tokenizer = None
                 self._state = "READY"
                 return Result(True, "whisper model loaded")

@@ -1,0 +1,631 @@
+"""Tier-1 gate for graftlint (tools/lint): per-rule fixtures, pragma +
+baseline semantics, and the whole-repo clean run.
+
+Each rule is proven BOTH ways — it fires on a violating snippet and
+stays silent on a clean one — through the lint engine in-memory
+(``lint_sources``), so the rules are tested without touching the repo.
+The whole-repo tests then pin the real tree at zero non-baselined
+findings, which is what makes seeding a violation into
+``engine/engine.py`` fail tier-1.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from tools.lint import (  # noqa: E402
+    ALL_RULES, apply_baseline, lint_repo, lint_sources, load_context,
+    run_rules,
+)
+from tools.lint.rules import rules_by_id  # noqa: E402
+
+ENGINE_REL = "localai_tfp_tpu/engine/engine.py"
+MULTIHOST_REL = "localai_tfp_tpu/parallel/multihost.py"
+
+
+@pytest.fixture(scope="module")
+def repo_ctx():
+    """One parse of the package shared by the whole-repo tests (the
+    seeding tests copy the module list before mutating it)."""
+    return load_context(ROOT)
+
+
+def _ids(findings):
+    return [f.rule for f in findings]
+
+
+def _lint(src, rule, rel="pkg/mod.py", extra=None, readme=""):
+    sources = {rel: textwrap.dedent(src)}
+    if extra:
+        sources.update(extra)
+    return lint_sources(sources, rules=rules_by_id([rule]),
+                        readme_text=readme)
+
+
+# --------------------------------------------------------- hot-path-sync
+
+
+def _hot(body, cold="pass"):
+    src = (
+        "import numpy as np\n\n"
+        "class Eng:\n"
+        "    # lint: region hot_path\n"
+        "    def step(self):\n"
+        + textwrap.indent(textwrap.dedent(body), "        ")
+        + "    # lint: endregion hot_path\n\n"
+        "    def cold(self):\n"
+        + textwrap.indent(textwrap.dedent(cold), "        ") + "\n")
+    return lint_sources({"pkg/mod.py": src},
+                        rules=rules_by_id(["hot-path-sync"]))
+
+
+def test_hot_path_item_fires():
+    fs = _hot("x = self.cache.k.item()\n")
+    assert _ids(fs) == ["hot-path-sync"]
+
+
+def test_hot_path_block_until_ready_fires():
+    fs = _hot("import jax\njax.block_until_ready(self.cache.k)\n")
+    assert _ids(fs) == ["hot-path-sync"]
+
+
+def test_hot_path_tainted_asarray_fires():
+    fs = _hot("toks = self._run('decode1', {})\nh = np.asarray(toks)\n")
+    assert _ids(fs) == ["hot-path-sync"]
+
+
+def test_hot_path_int_of_device_value_fires():
+    fs = _hot("toks = self._run('decode1', {})\nv = int(toks[0])\n")
+    assert _ids(fs) == ["hot-path-sync"]
+
+
+def test_hot_path_host_conversions_clean():
+    # np.asarray on host-built data, metadata access, len() — all fine
+    fs = _hot("""\
+        pos0 = np.asarray([1, 2], np.int32)
+        n = self.cache.k.shape[0] * self.cache.k.dtype.itemsize
+        m = int(len(pos0)) + int(n)
+        """)
+    assert fs == []
+
+
+def test_hot_path_outside_region_silent():
+    fs = _hot("pass\n", cold="y = self.cache.k.item()")
+    assert fs == []
+
+
+def test_hot_path_conversion_result_untaints():
+    # once harvested to host (the flagged+suppressed asarray), further
+    # int() coercions are free — only ONE finding, at the sync point
+    fs = _hot("""\
+        toks = self._run('decode1', {})
+        h = np.asarray(toks)
+        v = int(h[0])
+        """)
+    assert len(fs) == 1 and fs[0].message.startswith("np.asarray")
+
+
+# --------------------------------------------------------- scalar-payload
+
+
+WHITELIST_FIXTURE = {
+    "pkg/codec.py": "PAYLOAD_FIELDS = {'kvcopy': ('src', 'dst', 'n')}\n"
+}
+
+
+def _payload(src):
+    return _lint(src, "scalar-payload", extra=WHITELIST_FIXTURE)
+
+
+def test_scalar_payload_clean():
+    fs = _payload("""\
+        class Eng:
+            def go(self):
+                self._run("kvcopy", {"src": 1, "dst": 2, "n": 4})
+        """)
+    assert fs == []
+
+
+def test_scalar_payload_unknown_field_fires():
+    fs = _payload("""\
+        class Eng:
+            def go(self):
+                self._run("kvcopy", {"src": 1, "dst": 2, "evil": object()})
+        """)
+    assert _ids(fs) == ["scalar-payload"] and "'evil'" in fs[0].message
+
+
+def test_scalar_payload_unknown_kind_fires():
+    fs = _payload("""\
+        class Eng:
+            def go(self):
+                self._run("teleport", {"src": 1})
+        """)
+    assert _ids(fs) == ["scalar-payload"] and "teleport" in fs[0].message
+
+
+def test_scalar_payload_resolves_name_and_stores():
+    fs = _payload("""\
+        class Eng:
+            def go(self, paged):
+                payload = {"src": 1, "dst": 2}
+                if paged:
+                    payload["n"] = 8
+                    payload["oops"] = 9
+                self._run("kvcopy", payload)
+        """)
+    assert _ids(fs) == ["scalar-payload"] and "'oops'" in fs[0].message
+
+
+def test_scalar_payload_spread_and_branch_rebuild():
+    # **spread of a local dict literal resolves; per-branch rebuilds
+    # resolve to the nearest assignment before each call
+    fs = _payload("""\
+        class Eng:
+            def go(self, b):
+                base = {"src": 1, "dst": 2}
+                payload = {**base, "n": 4}
+                self._run("kvcopy", payload)
+                payload = {**base, "bad": 0}
+                self._run("kvcopy", payload)
+        """)
+    assert _ids(fs) == ["scalar-payload"] and "'bad'" in fs[0].message
+
+
+def test_scalar_payload_nonliteral_kind_fires():
+    fs = _payload("""\
+        class Eng:
+            def go(self, kind):
+                self._run(kind, {"src": 1})
+        """)
+    assert _ids(fs) == ["scalar-payload"]
+
+
+def test_scalar_payload_unresolvable_payload_fires():
+    fs = _payload("""\
+        class Eng:
+            def go(self):
+                self._run("kvcopy", self.mk())
+        """)
+    assert _ids(fs) == ["scalar-payload"]
+
+
+def test_scalar_payload_forwarding_wrapper_exempt():
+    fs = _payload("""\
+        class Eng:
+            def warm(self):
+                def _warm(kind, payload):
+                    return self._run(kind, payload)
+                _warm("kvcopy", {"src": 0, "dst": 0, "n": 1})
+        """)
+    assert fs == []
+
+
+# ------------------------------------------------------------- guarded-by
+
+
+def test_guarded_by_fires_and_clean():
+    src = """\
+        import threading
+
+        class Reg:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._models = {}  # lint: guarded-by self._lock
+
+            def good(self, k, v):
+                with self._lock:
+                    self._models[k] = v
+
+            def bad(self, k):
+                self._models.pop(k, None)
+        """
+    fs = _lint(src, "guarded-by")
+    assert _ids(fs) == ["guarded-by"]
+    assert fs[0].scope == "Reg.bad"
+
+
+def test_guarded_by_holds_pragma_and_init_exempt():
+    src = """\
+        import threading
+
+        class Reg:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._models = {}  # lint: guarded-by self._lock
+                self._models["init"] = 1  # constructor: exempt
+
+            def helper(self, k):
+                # lint: holds self._lock
+                del self._models[k]
+
+            def outer(self, k):
+                with self._lock:
+                    self.helper(k)
+        """
+    assert _lint(src, "guarded-by") == []
+
+
+def test_guarded_by_mutating_method_calls():
+    src = """\
+        import threading
+
+        class Reg:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []  # lint: guarded-by self._lock
+
+            def bad(self, x):
+                self._q.append(x)
+
+            def read_ok(self):
+                return len(self._q)
+        """
+    fs = _lint(src, "guarded-by")
+    assert _ids(fs) == ["guarded-by"] and "append" not in fs[0].scope
+
+
+def test_guarded_by_unattached_pragma_is_error():
+    src = """\
+        class Reg:
+            def nothing(self):
+                pass  # lint: guarded-by self._lock
+        """
+    fs = _lint(src, "guarded-by")
+    assert _ids(fs) == ["lint-pragma"]
+
+
+# ------------------------------------------------------- donate-after-use
+
+
+def test_donation_use_after_fires():
+    src = """\
+        import jax
+        from functools import partial
+
+        class Eng:
+            def _fn_factory(self):
+                @partial(jax.jit, donate_argnums=(0,))
+                def _step(cache, toks):
+                    return cache
+                return _step
+
+            def bad(self):
+                fn = self._fn_factory()
+                out = fn(self.cache, 1)
+                return self.cache.k
+        """
+    fs = _lint(src, "donate-after-use")
+    assert _ids(fs) == ["donate-after-use"]
+    assert "'self.cache'" in fs[0].message
+
+
+def test_donation_rebind_clean():
+    src = """\
+        import jax
+        from functools import partial
+
+        class Eng:
+            def _fn_factory(self):
+                @partial(jax.jit, donate_argnums=(0,))
+                def _step(cache, toks):
+                    return cache
+                return _step
+
+            def good(self):
+                fn = self._fn_factory()
+                self.cache = fn(self.cache, 1)
+                return self.cache.k
+        """
+    assert _lint(src, "donate-after-use") == []
+
+
+def test_donation_star_args_resolution():
+    src = """\
+        import jax
+        from functools import partial
+
+        class Eng:
+            def _fn_factory(self):
+                @partial(jax.jit, donate_argnums=(2,))
+                def _step(params, toks, cache):
+                    return cache
+                return _step
+
+            def bad(self, paged):
+                fn = self._fn_factory()
+                args = [self.params, 1]
+                args += [self.cache]
+                out = fn(*args)
+                return self.cache
+        """
+    fs = _lint(src, "donate-after-use")
+    assert _ids(fs) == ["donate-after-use"]
+
+
+def test_donation_jitted_attr_binding():
+    src = """\
+        import jax
+        from functools import partial
+
+        class Eng:
+            def __init__(self):
+                @partial(jax.jit, donate_argnums=(0,))
+                def _decode(cache):
+                    return cache
+                self._decode_fn = _decode
+
+            def good(self):
+                self.cache = self._decode_fn(self.cache)
+
+            def bad(self):
+                out = self._decode_fn(self.cache)
+                return self.cache
+        """
+    fs = _lint(src, "donate-after-use")
+    assert len(fs) == 1 and fs[0].scope == "Eng.bad"
+
+
+# --------------------------------------------------------- except-swallow
+
+
+def test_except_swallow_fires():
+    src = """\
+        def f():
+            try:
+                work()
+            except Exception:
+                pass
+        """
+    assert _ids(_lint(src, "except-swallow")) == ["except-swallow"]
+
+
+def test_bare_except_fires():
+    src = """\
+        def f():
+            try:
+                work()
+            except:
+                return None
+        """
+    assert _ids(_lint(src, "except-swallow")) == ["except-swallow"]
+
+
+@pytest.mark.parametrize("body", [
+    "raise ValueError('no')",
+    "log.warning('failed: %r', e)",
+    "tm.RECOVERED_ERRORS.labels(site='x').inc()",
+    "out = str(e)",
+])
+def test_except_handled_clean(body):
+    src = f"""\
+        def f():
+            try:
+                work()
+            except Exception as e:
+                {body}
+        """
+    assert _lint(src, "except-swallow") == []
+
+
+def test_narrow_except_clean():
+    src = """\
+        def f():
+            try:
+                work()
+            except (KeyError, ValueError):
+                pass
+        """
+    assert _lint(src, "except-swallow") == []
+
+
+# ------------------------------------------------------- metrics-contract
+
+
+def test_metrics_contract_suffix_and_case():
+    src = """\
+        M = REGISTRY.counter("badName", "help")
+        N = REGISTRY.gauge("thing_seconds", "help")
+        O = REGISTRY.histogram("lat_parsecs", "help")
+        """
+    fs = _lint(src, "metrics-contract",
+               readme="`badName` `thing_seconds` `lat_parsecs`")
+    msgs = " | ".join(f.message for f in fs)
+    assert "not snake_case" in msgs
+    assert "lacks a unit suffix" in msgs and "badName" in msgs
+
+
+def test_metrics_contract_readme_and_computed():
+    src = """\
+        name = compute()
+        M = REGISTRY.counter(name, "help")
+        N = REGISTRY.counter("good_total", "help")
+        """
+    fs = _lint(src, "metrics-contract", readme="no row here")
+    msgs = " | ".join(f.message for f in fs)
+    assert "computed name" in msgs
+    assert "not documented" in msgs
+
+
+def test_metrics_contract_clean():
+    src = 'M = REGISTRY.counter("good_total", "help")\n'
+    assert _lint(src, "metrics-contract", readme="| `good_total` |") == []
+
+
+# ------------------------------------------- suppressions, regions, pragmas
+
+
+def test_ignore_pragma_suppresses_same_and_next_line():
+    src = """\
+        def f():
+            try:
+                work()
+            # lint: ignore[except-swallow] probe may fail on CPU backends
+            except Exception:
+                pass
+        """
+    assert _lint(src, "except-swallow") == []
+
+
+def test_ignore_without_reason_is_error_and_does_not_suppress():
+    src = """\
+        def f():
+            try:
+                work()
+            # lint: ignore[except-swallow]
+            except Exception:
+                pass
+        """
+    fs = _lint(src, "except-swallow")
+    assert sorted(_ids(fs)) == ["except-swallow", "lint-pragma"]
+
+
+def test_ignore_unknown_rule_is_error():
+    fs = _lint("x = 1  # lint: ignore[no-such-rule] because\n",
+               "except-swallow")
+    assert _ids(fs) == ["lint-pragma"]
+
+
+def test_unclosed_region_is_error():
+    fs = _lint("# lint: region hot_path\nx = 1\n", "hot-path-sync")
+    assert _ids(fs) == ["lint-pragma"]
+    assert "never closed" in fs[0].message
+
+
+# ----------------------------------------------------- baseline semantics
+
+
+def test_baseline_grandfathers_shrinks_and_rejects_new():
+    fs = _lint("""\
+        def f():
+            try:
+                work()
+            except Exception:
+                pass
+        """, "except-swallow")
+    assert len(fs) == 1
+    fp = fs[0].fingerprint
+    # exact budget: grandfathered, nothing new, nothing stale
+    res = apply_baseline(fs, {fp: 1})
+    assert res.ok and len(res.grandfathered) == 1 and not res.new
+    # no budget: the finding is new
+    res = apply_baseline(fs, {})
+    assert not res.ok and len(res.new) == 1
+    # over-budget entry: the unmatched remainder is stale — the
+    # baseline must SHRINK when findings are fixed
+    res = apply_baseline(fs, {fp: 2})
+    assert not res.ok and res.stale == [fp]
+    res = apply_baseline([], {fp: 1})
+    assert not res.ok and res.stale == [fp]
+
+
+# ------------------------------------------------------- whole-repo gates
+
+
+def test_repo_lints_clean(repo_ctx):
+    """THE gate: zero non-baselined findings across the package with
+    all six rules active. Seeding any violation into the tree (e.g. a
+    device sync in engine.py's hot path, a non-codec payload field)
+    fails here."""
+    from tools.lint import DEFAULT_BASELINE, load_baseline
+
+    findings = run_rules(repo_ctx, ALL_RULES)
+    res = apply_baseline(findings, load_baseline(DEFAULT_BASELINE))
+    assert res.ok, (
+        "graftlint found new findings (fix them or, for a reasoned "
+        "exception, add a `# lint: ignore[rule] why` pragma):\n"
+        + "\n".join(f.render() for f in res.new)
+        + "\n".join(f"stale baseline entry: {s}" for s in res.stale))
+
+
+def test_repo_has_annotations_and_regions(repo_ctx):
+    """The contract annotations this PR introduced must stay present —
+    deleting a pragma would silently disable its rule's coverage."""
+    ctx = repo_ctx
+    eng = ctx.module(ENGINE_REL)
+    assert len(eng.pragmas.regions.get("hot_path", [])) >= 4
+    mh = ctx.module(MULTIHOST_REL)
+    assert mh.pragmas.guarded, "multihost guarded-by annotations gone"
+    for rel in ("localai_tfp_tpu/engine/loader.py",
+                "localai_tfp_tpu/engine/kv_pool.py",
+                "localai_tfp_tpu/telemetry/registry.py"):
+        assert ctx.module(rel).pragmas.guarded, f"{rel}: no guarded-by"
+
+
+def test_seeded_hot_path_violation_fires(repo_ctx):
+    """Acceptance: seeding a device sync into engine.py's scheduler
+    loop makes the lint gate fail."""
+    from tools.lint.core import Context
+    eng = repo_ctx.module(ENGINE_REL)
+    anchor = "        self._apply_cancellations()"
+    assert eng.source.count(anchor) == 1
+    seeded = eng.source.replace(
+        anchor, "        self.cache.k.item()\n" + anchor)
+    from tools.lint.core import Module
+    mods = list(repo_ctx.modules)
+    mods[mods.index(eng)] = Module(ENGINE_REL, seeded)
+    ctx = Context(root=ROOT, modules=mods,
+                  readme_text=repo_ctx.readme_text)
+    findings = run_rules(ctx, rules_by_id(["hot-path-sync"]))
+    assert any(f.rule == "hot-path-sync" and "item" in f.message
+               for f in findings)
+
+
+def test_seeded_scalar_payload_violation_fires(repo_ctx):
+    """Acceptance: a dispatch field outside the codec whitelist in
+    engine.py fails the lint gate."""
+    from tools.lint.core import Context
+    eng = repo_ctx.module(ENGINE_REL)
+    seeded = eng.source + textwrap.dedent("""\
+
+
+        def _seeded_dispatch(self):
+            self._run("kvcopy", {"src": 0, "dst": 0, "n": 1,
+                                 "rogue_field": object()})
+        """)
+    from tools.lint.core import Module
+    mods = list(repo_ctx.modules)
+    mods[mods.index(eng)] = Module(ENGINE_REL, seeded)
+    ctx = Context(root=ROOT, modules=mods,
+                  readme_text=repo_ctx.readme_text)
+    findings = run_rules(ctx, rules_by_id(["scalar-payload"]))
+    assert any(f.rule == "scalar-payload"
+               and "rogue_field" in f.message for f in findings)
+
+
+def test_cli_json_clean():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--json"],
+        capture_output=True, text=True, cwd=ROOT)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rep = json.loads(out.stdout)
+    assert rep["ok"] is True
+    assert len(rep["rules"]) == 6  # lint-pragma rides along implicitly
+    assert rep["findings"] == [] and rep["stale_baseline"] == []
+
+
+def test_runtime_codec_validation():
+    """The LocalChannel transport enforces PAYLOAD_FIELDS at publish
+    time (the dynamic half of the scalar-payload contract)."""
+    from localai_tfp_tpu.parallel import multihost
+
+    ch = multihost.LocalChannel()
+    end = ch.follower_end()
+    ch.publish("kvcopy", {"model": "m",
+                          "data": {"src": 0, "dst": 1, "n": 4}})
+    kind, rec = end.recv(timeout=1)
+    assert kind == "kvcopy" and rec["data"]["n"] == 4
+    with pytest.raises(ValueError, match="rogue"):
+        ch.publish("kvcopy", {"model": "m", "data": {"rogue": 1}})
+    with pytest.raises(ValueError, match="whitelist"):
+        ch.publish("warp", {"model": "m", "data": {}})
+    ch.publish("stop", None)  # lifecycle records bypass the codec

@@ -1,107 +1,37 @@
 #!/usr/bin/env python3
 """Static telemetry lint: metric-name contract + README coverage.
 
-Scans ``localai_tfp_tpu/`` for registry registrations
-(``REGISTRY.counter("...")`` / ``.gauge`` / ``.histogram``) and fails
-when any registered name
+Thin compatibility wrapper: the check itself now lives in the graftlint
+framework as the ``metrics-contract`` rule
+(tools/lint/rules/metrics_contract.py) so it shares the suppression/
+baseline machinery and runs in the tier-1 ``python -m tools.lint`` gate.
+This CLI keeps the historical entry point (bench scripts, CI
+invocations, tests/test_telemetry.py) working unchanged:
 
-- is not snake_case,
-- is missing a unit suffix — counters MUST end in ``_total``;
-  histograms in ``_seconds``/``_bytes``; gauges in one of
-  ``_seconds``/``_bytes``/``_count``/``_ratio``/``_info`` — or
-- does not appear in the README.md "Observability" table.
-
-Run from the repo root:  python tools/check_metrics.py
-Wired into the test suite (tests/test_telemetry.py) so metric drift
-fails tier-1 instead of silently rotting dashboards and this table.
+    python tools/check_metrics.py      # exit 0 iff the contract holds
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
-PKG = ROOT / "localai_tfp_tpu"
-README = ROOT / "README.md"
+sys.path.insert(0, str(ROOT))
 
-_SNAKE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)*$")
-# one registration: `<registry>.counter(\n?  "name"` — literal names
-# only; a computed name cannot be linted or documented and is a finding
-_REG = re.compile(
-    r"\.\s*(counter|gauge|histogram)\(\s*\n?\s*['\"]([A-Za-z0-9_]+)['\"]"
+from tools.lint import load_context, run_rules  # noqa: E402
+from tools.lint.rules.metrics_contract import (  # noqa: E402,F401
+    REQUIRED_FAMILIES, SUFFIXES, MetricsContract, find_registrations,
 )
-
-_SUFFIXES = {
-    "counter": ("_total",),
-    "histogram": ("_seconds", "_bytes"),
-    "gauge": ("_seconds", "_bytes", "_count", "_ratio", "_info"),
-}
-
-# rate/intensity gauges: a unit suffix followed by a `_per_<x>`
-# qualifier (Prometheus bytes_per_second convention) is also valid
-_PER_GAUGE = re.compile(r"_(seconds|bytes|count)_per_[a-z0-9_]+$")
-
-# families that MUST exist (removing one silently breaks dashboards
-# and the bench's extra blocks): the paged-KV pool series introduced
-# with the block-granular HBM allocator
-REQUIRED_FAMILIES = {
-    "engine_kv_pages_in_use_count",
-    "engine_kv_pages_shared_count",
-    "engine_kv_page_alloc_total",
-    "engine_kv_hbm_per_live_token_bytes",
-    # ragged paged attention: the variant-explosion kill must stay
-    # visible and regression-guarded
-    "engine_dispatch_compile_variants_count",
-    "engine_ragged_rows_total",
-}
-
-
-def find_registrations() -> list[tuple[str, str, str]]:
-    """(kind, name, file) for every literal registration in the
-    package."""
-    out = []
-    for path in sorted(PKG.rglob("*.py")):
-        text = path.read_text(encoding="utf-8")
-        for m in _REG.finditer(text):
-            out.append((m.group(1), m.group(2),
-                        str(path.relative_to(ROOT))))
-    return out
 
 
 def main(argv=None) -> int:
-    regs = find_registrations()
-    problems: list[str] = []
-    if not regs:
-        problems.append("no metric registrations found under "
-                        f"{PKG} — scanner or layout broke")
-    try:
-        readme = README.read_text(encoding="utf-8")
-    except OSError:
-        readme = ""
-        problems.append(f"cannot read {README}")
-    for kind, name, where in regs:
-        if not _SNAKE.match(name):
-            problems.append(
-                f"{where}: metric '{name}' is not snake_case")
-        if not name.endswith(_SUFFIXES[kind]) and not (
-                kind == "gauge" and _PER_GAUGE.search(name)):
-            problems.append(
-                f"{where}: {kind} '{name}' lacks a unit suffix "
-                f"(one of {', '.join(_SUFFIXES[kind])})")
-        if readme and f"`{name}`" not in readme:
-            problems.append(
-                f"{where}: metric '{name}' is not documented in the "
-                f"README.md Observability table (add a `{name}` row)")
-    missing = REQUIRED_FAMILIES - {name for _, name, _ in regs}
-    for name in sorted(missing):
-        problems.append(
-            f"required metric family '{name}' is not registered "
-            "anywhere under localai_tfp_tpu/")
+    ctx = load_context(ROOT)
+    problems = run_rules(ctx, [MetricsContract()])
+    regs, _ = find_registrations(ctx)
     if problems:
         for p in problems:
-            print(f"check_metrics: {p}", file=sys.stderr)
+            print(f"check_metrics: {p.render()}", file=sys.stderr)
         print(f"check_metrics: {len(problems)} problem(s) in "
               f"{len(regs)} registration(s)", file=sys.stderr)
         return 1

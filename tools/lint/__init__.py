@@ -1,0 +1,57 @@
+"""graftlint — project-specific static analysis for the engine's
+device/concurrency contracts.
+
+Usage:
+    python -m tools.lint              # human output, baseline applied
+    python -m tools.lint --json      # machine output
+    python -m tools.lint --update-baseline   # re-grandfather (shrink!)
+
+Rules (see tools/lint/rules/ and the README "Static analysis" table):
+    hot-path-sync     device syncs inside `# lint: region hot_path`
+    scalar-payload    dispatch payload fields vs the multihost codec
+    guarded-by        `# lint: guarded-by <lock>` mutation discipline
+    donate-after-use  donated jit buffers referenced after the call
+    except-swallow    silent broad-exception swallows
+    metrics-contract  metric naming / README / required families
+    lint-pragma       malformed lint pragmas (always on)
+
+Programmatic entry points: ``lint_repo`` for the tier-1 gate and
+bench, ``lint_sources`` for in-memory fixture runs.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+from .core import (DEFAULT_BASELINE, REPO_ROOT, BaselineResult, Context,
+                   Finding, Module, apply_baseline, load_baseline,
+                   load_context, run_rules, save_baseline)
+from .rules import ALL_RULES, rules_by_id
+
+__all__ = [
+    "ALL_RULES", "BaselineResult", "Context", "Finding", "Module",
+    "DEFAULT_BASELINE", "REPO_ROOT", "apply_baseline", "lint_repo",
+    "lint_sources", "load_baseline", "load_context", "rules_by_id",
+    "run_rules", "save_baseline",
+]
+
+
+def lint_sources(sources: dict[str, str], *, readme_text: str = "",
+                 rules=None) -> list[Finding]:
+    """Lint in-memory ``{relpath: source}`` modules (fixture tests)."""
+    ctx = Context(root=REPO_ROOT,
+                  modules=[Module(rel, src)
+                           for rel, src in sorted(sources.items())],
+                  readme_text=readme_text)
+    return run_rules(ctx, rules if rules is not None else ALL_RULES)
+
+
+def lint_repo(root: Path = REPO_ROOT, *, rules=None,
+              baseline_path: Optional[Path] = DEFAULT_BASELINE,
+              ) -> tuple[list[Finding], BaselineResult]:
+    """Full-package run: (all findings, baseline split)."""
+    ctx = load_context(root)
+    findings = run_rules(ctx, rules if rules is not None else ALL_RULES)
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+    return findings, apply_baseline(findings, baseline)

@@ -1,0 +1,84 @@
+"""CLI for graftlint: ``python -m tools.lint``.
+
+Exit status 0 means zero non-baselined findings AND zero stale baseline
+entries (the baseline may only shrink). ``--update-baseline`` rewrites
+the committed baseline from the current findings — for removing fixed
+entries, never for burying new ones (bench tracks the baseline size per
+release, so growth is visible).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter
+from pathlib import Path
+
+from . import (ALL_RULES, DEFAULT_BASELINE, REPO_ROOT, apply_baseline,
+               load_baseline, load_context, rules_by_id, run_rules,
+               save_baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="graftlint: engine contract static analysis")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--root", type=Path, default=REPO_ROOT)
+    ap.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE)
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, grandfathered or not")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings")
+    ap.add_argument("--rules", type=str, default="",
+                    help="comma-separated rule ids (default: all)")
+    args = ap.parse_args(argv)
+
+    rules = rules_by_id([r for r in args.rules.split(",") if r]) \
+        if args.rules else list(ALL_RULES)
+    ctx = load_context(args.root)
+    findings = run_rules(ctx, rules)
+
+    if args.update_baseline:
+        entries = Counter(f.fingerprint for f in findings)
+        save_baseline(dict(entries), args.baseline)
+        print(f"graftlint: baseline rewritten with {len(findings)} "
+              f"finding(s) in {len(entries)} entr(ies) -> "
+              f"{args.baseline}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    res = apply_baseline(findings, baseline)
+
+    if args.json:
+        print(json.dumps({
+            "rules": sorted(r.id for r in rules),
+            "files_scanned": len(ctx.modules),
+            "findings": [f.to_json() for f in res.new],
+            "grandfathered": len(res.grandfathered),
+            "stale_baseline": res.stale,
+            "baseline_size": sum(baseline.values()),
+            "ok": res.ok,
+        }, indent=2))
+    else:
+        for f in res.new:
+            print(f.render(), file=sys.stderr)
+        for fp in res.stale:
+            print(f"stale baseline entry (fixed? delete it — the "
+                  f"baseline only shrinks): {fp}", file=sys.stderr)
+        n_files = len(ctx.modules)
+        if res.ok:
+            print(f"graftlint: OK ({n_files} files, "
+                  f"{len(rules)} rules, "
+                  f"{len(res.grandfathered)} grandfathered)")
+        else:
+            print(f"graftlint: {len(res.new)} finding(s), "
+                  f"{len(res.stale)} stale baseline entr(ies) in "
+                  f"{n_files} files", file=sys.stderr)
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,282 @@
+"""graftlint core: pragmas, module model, finding/baseline machinery.
+
+The engine's correctness contracts (scalar-only dispatch payloads,
+no device syncs in the scheduler loop, lock-guarded registries,
+donation-after-use) live in source comments and code review memory.
+``tools.lint`` turns them into AST checks the tier-1 suite enforces —
+the project-local analogue of the reference's golangci-lint +
+``go test -race`` gates.
+
+Pure stdlib (``ast`` + ``re`` + ``json``): the linter must run in any
+environment the tests run in, including ones without jax.
+
+Pragma syntax (all live in ``#`` comments so they are invisible at
+runtime):
+
+- ``# lint: region <name>`` / ``# lint: endregion <name>``
+  Mark a contiguous source region. Region-scoped rules (hot-path-sync)
+  only fire inside their region.
+- ``# lint: ignore[rule-id] <reason>``
+  Suppress ``rule-id`` findings on this line and the next. Multiple ids:
+  ``ignore[a,b]``. A missing reason is itself a finding (``lint-pragma``).
+- ``# lint: guarded-by <lock-expr>``
+  On an attribute assignment inside a class: every later MUTATION of
+  that ``self.<attr>`` must sit inside ``with <lock-expr>:`` (or in a
+  function carrying a ``holds`` pragma, or in ``__init__``).
+- ``# lint: holds <lock-expr>``
+  On or inside a ``def``: the function body runs with ``<lock-expr>``
+  held by its caller (lock-discipline treats it as guarded).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+# rule ids a pragma may reference; rules register themselves on import
+KNOWN_RULES: set[str] = {"lint-pragma"}
+
+_PRAGMA = re.compile(r"#\s*lint:\s*(.*)$")
+_IGNORE = re.compile(r"ignore\[([A-Za-z0-9_,\- ]+)\]\s*(.*)$")
+_REGION = re.compile(r"(endregion|region)\s+([A-Za-z0-9_\-]+)\s*$")
+_GUARDED = re.compile(r"guarded-by\s+(.+)$")
+_HOLDS = re.compile(r"holds\s+(.+)$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, posix
+    line: int
+    message: str
+    scope: str = ""  # dotted Class.func enclosing the finding
+    # stable identity for the baseline: everything except the line
+    # number, which drifts with unrelated edits
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}|{self.path}|{self.scope}|{self.message}"
+
+    def render(self) -> str:
+        where = f" ({self.scope})" if self.scope else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{where}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "scope": self.scope,
+                "fingerprint": self.fingerprint}
+
+
+@dataclass
+class Pragmas:
+    """Per-file pragma index (1-based line numbers)."""
+
+    regions: dict[str, list[tuple[int, int]]] = field(default_factory=dict)
+    # line -> [(rule-or-*, reason)]; an entry suppresses its own line
+    # and the following one (pragma-above-the-statement style)
+    ignores: dict[int, list[tuple[str, str]]] = field(default_factory=dict)
+    guarded: list[tuple[int, str]] = field(default_factory=list)
+    holds: list[tuple[int, str]] = field(default_factory=list)
+    errors: list[tuple[int, str]] = field(default_factory=list)
+
+    def in_region(self, name: str, line: int) -> bool:
+        return any(a <= line <= b for a, b in self.regions.get(name, ()))
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        for at in (line, line - 1):
+            for rid, reason in self.ignores.get(at, ()):
+                if reason and rid in ("*", rule):
+                    return True
+        return False
+
+
+def parse_pragmas(source: str) -> Pragmas:
+    pr = Pragmas()
+    open_regions: dict[str, int] = {}
+    for i, raw in enumerate(source.splitlines(), start=1):
+        m = _PRAGMA.search(raw)
+        if not m:
+            continue
+        body = m.group(1).strip()
+        if (mm := _REGION.match(body)) is not None:
+            kw, name = mm.group(1), mm.group(2)
+            if kw == "region":
+                if name in open_regions:
+                    pr.errors.append((i, f"region {name!r} reopened "
+                                         "while already open"))
+                else:
+                    open_regions[name] = i
+            else:
+                start = open_regions.pop(name, None)
+                if start is None:
+                    pr.errors.append((i, f"endregion {name!r} without "
+                                         "a matching region"))
+                else:
+                    pr.regions.setdefault(name, []).append((start, i))
+        elif (mm := _IGNORE.match(body)) is not None:
+            rules = [r.strip() for r in mm.group(1).split(",") if r.strip()]
+            reason = mm.group(2).strip()
+            if not reason:
+                pr.errors.append((i, "ignore pragma without a reason "
+                                     "(write: # lint: ignore[rule] why)"))
+            for rid in rules:
+                if rid != "*" and rid not in KNOWN_RULES:
+                    pr.errors.append((i, f"ignore names unknown rule "
+                                         f"{rid!r}"))
+                pr.ignores.setdefault(i, []).append((rid, reason))
+        elif (mm := _GUARDED.match(body)) is not None:
+            # a further `#` starts an ordinary trailing comment
+            pr.guarded.append((i, mm.group(1).split("#")[0].strip()))
+        elif (mm := _HOLDS.match(body)) is not None:
+            pr.holds.append((i, mm.group(1).split("#")[0].strip()))
+        else:
+            pr.errors.append((i, f"unrecognized lint pragma: {body!r}"))
+    for name, start in open_regions.items():
+        pr.errors.append((start, f"region {name!r} never closed"))
+    return pr
+
+
+class Module:
+    """One parsed source file plus its pragma index and scope map."""
+
+    def __init__(self, rel: str, source: str) -> None:
+        self.rel = rel
+        self.source = source
+        self.tree = ast.parse(source)
+        self.pragmas = parse_pragmas(source)
+        self._scopes: list[tuple[int, int, str]] = []
+        self._index_scopes(self.tree, "")
+
+    def _index_scopes(self, node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                name = f"{prefix}.{child.name}" if prefix else child.name
+                self._scopes.append(
+                    (child.lineno, child.end_lineno or child.lineno, name))
+                self._index_scopes(child, name)
+            else:
+                self._index_scopes(child, prefix)
+
+    def scope_at(self, line: int) -> str:
+        best = ""
+        best_span = None
+        for a, b, name in self._scopes:
+            if a <= line <= b and (best_span is None or b - a < best_span):
+                best, best_span = name, b - a
+        return best
+
+    def finding(self, rule: str, node_or_line, message: str) -> Finding:
+        line = getattr(node_or_line, "lineno", node_or_line)
+        return Finding(rule=rule, path=self.rel, line=line,
+                       message=message, scope=self.scope_at(line))
+
+
+@dataclass
+class Context:
+    """Repo-level lint context shared by all rules."""
+
+    root: Path
+    modules: list[Module]
+    readme_text: str = ""
+
+    def module(self, rel: str) -> Optional[Module]:
+        for m in self.modules:
+            if m.rel == rel:
+                return m
+        return None
+
+
+def load_context(root: Path = REPO_ROOT,
+                 paths: Optional[Iterable[Path]] = None) -> Context:
+    """Parse the lintable file set. Default: the ``localai_tfp_tpu``
+    package (tools/ and tests/ are dev-side and out of contract
+    scope)."""
+    root = Path(root)
+    if paths is None:
+        paths = sorted((root / "localai_tfp_tpu").rglob("*.py"))
+    modules = []
+    for p in paths:
+        p = Path(p)
+        rel = p.relative_to(root).as_posix()
+        modules.append(Module(rel, p.read_text(encoding="utf-8")))
+    readme = root / "README.md"
+    text = readme.read_text(encoding="utf-8") if readme.exists() else ""
+    return Context(root=root, modules=modules, readme_text=text)
+
+
+def run_rules(ctx: Context, rules) -> list[Finding]:
+    """All findings (suppressions applied, pragma errors included)."""
+    findings: list[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(ctx))
+    for m in ctx.modules:
+        for line, msg in m.pragmas.errors:
+            findings.append(m.finding("lint-pragma", line, msg))
+    out = []
+    for f in findings:
+        m = ctx.module(f.path)
+        if (f.rule != "lint-pragma" and m is not None
+                and m.pragmas.suppressed(f.rule, f.line)):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+# ------------------------------------------------------------------ baseline
+
+
+def load_baseline(path: Path = DEFAULT_BASELINE) -> dict[str, int]:
+    if not Path(path).exists():
+        return {}
+    data = json.loads(Path(path).read_text(encoding="utf-8"))
+    return {str(k): int(v) for k, v in data.get("entries", {}).items()}
+
+
+def save_baseline(entries: dict[str, int],
+                  path: Path = DEFAULT_BASELINE) -> None:
+    payload = {
+        "comment": ("grandfathered graftlint findings. This file may "
+                    "only SHRINK: fixing a finding requires deleting "
+                    "its entry (a stale entry fails the lint gate), and "
+                    "new findings must be fixed, not added here."),
+        "entries": dict(sorted(entries.items())),
+    }
+    Path(path).write_text(json.dumps(payload, indent=2) + "\n",
+                          encoding="utf-8")
+
+
+@dataclass
+class BaselineResult:
+    new: list[Finding]  # findings with no baseline budget -> errors
+    grandfathered: list[Finding]  # matched a baseline entry
+    stale: list[str]  # baseline entries no finding matched -> errors
+
+    @property
+    def ok(self) -> bool:
+        return not self.new and not self.stale
+
+
+def apply_baseline(findings: list[Finding],
+                   baseline: dict[str, int]) -> BaselineResult:
+    """Findings beyond an entry's count are new; an entry with no
+    matching finding is stale (the baseline must only shrink, so a
+    fixed finding must also be deleted from the file)."""
+    budget = dict(baseline)
+    new, old = [], []
+    for f in findings:
+        if budget.get(f.fingerprint, 0) > 0:
+            budget[f.fingerprint] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    stale = sorted(fp for fp, n in budget.items() if n > 0)
+    return BaselineResult(new=new, grandfathered=old, stale=stale)

@@ -1,0 +1,34 @@
+"""graftlint rule registry."""
+
+from __future__ import annotations
+
+from ..core import KNOWN_RULES
+from .donation import DonationAfterUse
+from .exception_hygiene import ExceptionHygiene
+from .hot_path_sync import HotPathSync
+from .lock_discipline import LockDiscipline
+from .metrics_contract import MetricsContract
+from .scalar_payload import ScalarPayload
+
+ALL_RULES = (
+    HotPathSync(),
+    ScalarPayload(),
+    LockDiscipline(),
+    DonationAfterUse(),
+    ExceptionHygiene(),
+    MetricsContract(),
+)
+
+for _r in ALL_RULES:
+    KNOWN_RULES.add(_r.id)
+
+
+def rules_by_id(ids=None):
+    if not ids:
+        return list(ALL_RULES)
+    known = {r.id: r for r in ALL_RULES}
+    missing = [i for i in ids if i not in known]
+    if missing:
+        raise KeyError(f"unknown rule id(s): {missing} "
+                       f"(known: {sorted(known)})")
+    return [known[i] for i in ids]

@@ -1,0 +1,288 @@
+"""donate-after-use: donated buffers must not be read after the call.
+
+``donate_argnums`` hands a buffer's HBM to XLA: after the jitted call
+the donated array is deleted, and touching it raises (on TPU) or — far
+worse — silently reads stale memory through a leftover numpy view. The
+engine's convention is that every donating call REBINDS the donated
+state in the same statement (``self.cache = fn(..., self.cache, ...)``);
+this rule checks the convention statically.
+
+Same-module analysis: jitted functions declared with
+``@partial(jax.jit, donate_argnums=...)`` (or ``jax.jit(f,
+donate_argnums=...)``) are mapped to the factory method that defines
+them and to any ``self.<attr>`` they are bound to; call sites through
+those names have their positional args resolved (including ``*args``
+where ``args`` is a locally-built list literal, optionally grown with
+``args += [...]``). For each donated position holding a plain name or
+``self.<attr>``, a LOAD of the same expression after the call — before
+any rebinding — is a finding. Cross-module calls of jitted functions
+are out of scope (the engine keeps all donating dispatches in
+``engine/engine.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import Context, Finding, Module
+from .scalar_payload import walk_shallow
+
+
+def _donate_spec(call: ast.Call) -> Optional[set[int]]:
+    """Donated argnums from a ``jax.jit``/``partial(jax.jit, ...)``
+    call node, if it declares any."""
+    fname = ast.unparse(call.func)
+    if fname not in ("jax.jit", "partial", "functools.partial", "jit"):
+        return None
+    if fname in ("partial", "functools.partial"):
+        if not call.args or ast.unparse(call.args[0]) not in (
+                "jax.jit", "jit"):
+            return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            try:
+                v = ast.literal_eval(kw.value)
+            except ValueError:
+                return None
+            return {int(x) for x in (v if isinstance(v, (tuple, list))
+                                     else (v,))}
+    return None
+
+
+class DonationAfterUse:
+    id = "donate-after-use"
+    doc = ("argument donated via donate_argnums referenced after the "
+           "jitted call")
+
+    def check(self, ctx: Context) -> Iterator[Finding]:
+        for m in ctx.modules:
+            yield from self._check_module(m)
+
+    def _check_module(self, m: Module) -> Iterator[Finding]:
+        # ---- pass 1: donating defs, factories, and bound attributes
+        donating: dict[str, set[int]] = {}  # def name -> argnums
+        factories: dict[str, set[int]] = {}  # enclosing fn -> union
+        attrs: dict[str, set[int]] = {}  # self.<attr> -> argnums
+        parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(m.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(m.tree):
+            spec: Optional[set[int]] = None
+            name: Optional[str] = None
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        spec = _donate_spec(dec)
+                        if spec is not None:
+                            name = node.name
+                            break
+            elif (isinstance(node, ast.Assign)
+                  and isinstance(node.value, ast.Call)):
+                spec = _donate_spec(node.value)
+                if spec is not None and len(node.targets) == 1:
+                    t = node.targets[0]
+                    if isinstance(t, ast.Name):
+                        name = t.id
+                    elif (isinstance(t, ast.Attribute)
+                          and isinstance(t.value, ast.Name)
+                          and t.value.id == "self"):
+                        attrs.setdefault(t.attr, set()).update(spec)
+            if spec is None or name is None:
+                continue
+            donating[name] = donating.get(name, set()) | spec
+            cur = parents.get(node)
+            while cur is not None and not isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cur = parents.get(cur)
+            if cur is not None:
+                factories.setdefault(cur.name, set()).update(spec)
+        # `self._decode_fn = _decode` binds a donating def to an attr
+        for node in ast.walk(m.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in donating):
+                t = node.targets[0]
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    attrs.setdefault(t.attr, set()).update(
+                        donating[node.value.id])
+        if not (donating or factories or attrs):
+            return
+
+        # ---- pass 2: per-function call-site analysis
+        for fn in ast.walk(m.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(m, fn, donating, factories,
+                                          attrs)
+
+    def _callee_spec(self, fn, call: ast.Call, donating, factories,
+                     attrs, aliases) -> Optional[set[int]]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in aliases:
+                return aliases[f.id]
+            if f.id in donating:
+                return donating[f.id]
+            return None
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            if f.value.id == "self" and f.attr in attrs:
+                return attrs[f.attr]
+        if isinstance(f, ast.Call):  # self._factory(...)(args)
+            ff = f.func
+            if (isinstance(ff, ast.Attribute)
+                    and isinstance(ff.value, ast.Name)
+                    and ff.value.id == "self"
+                    and ff.attr in factories):
+                return factories[ff.attr]
+            if isinstance(ff, ast.Name) and ff.id in factories:
+                return factories[ff.id]
+        return None
+
+    def _check_fn(self, m: Module, fn, donating, factories,
+                  attrs) -> Iterator[Finding]:
+        # ONE chronological pass: alias (`fn = self._factory(...)`) and
+        # arg-list (`args = [...]` / `args += [...]`) state is replayed
+        # in source order, so per-branch rebindings resolve to the state
+        # live at each call site, not to the function's last assignment
+        aliases: dict[str, set[int]] = {}
+        lists: dict[str, list[ast.AST]] = {}
+        nodes = sorted(walk_shallow(fn),
+                       key=lambda n: (getattr(n, "lineno", 0),
+                                      getattr(n, "col_offset", 0)))
+        calls: list[tuple[ast.Call, set[int],
+                          list[Optional[ast.AST]]]] = []
+        for st in nodes:
+            if isinstance(st, ast.Call):
+                spec = self._callee_spec(fn, st, donating, factories,
+                                         attrs, aliases)
+                if spec:
+                    calls.append((st, spec, self._positional(st, lists)))
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                tname = st.targets[0].id
+                v = st.value
+                aliases.pop(tname, None)
+                lists.pop(tname, None)
+                if isinstance(v, ast.Call):
+                    # `x = self._factory(...)`: the factory CALL yields
+                    # the jitted fn (a donating call's result is data)
+                    ff = v.func
+                    if (isinstance(ff, ast.Attribute)
+                            and isinstance(ff.value, ast.Name)
+                            and ff.value.id == "self"
+                            and ff.attr in factories):
+                        aliases[tname] = factories[ff.attr]
+                    elif (isinstance(ff, ast.Name)
+                          and ff.id in factories):
+                        aliases[tname] = factories[ff.id]
+                elif isinstance(v, ast.Name) and v.id in donating:
+                    aliases[tname] = donating[v.id]
+                elif isinstance(v, ast.List):
+                    lists[tname] = list(v.elts)
+                elif (isinstance(v, ast.BinOp)
+                      and isinstance(v.op, ast.Add)
+                      and isinstance(v.left, ast.Name)
+                      and v.left.id in lists
+                      and isinstance(v.right, ast.List)):
+                    lists[tname] = lists[v.left.id] + list(v.right.elts)
+            elif (isinstance(st, ast.AugAssign)
+                  and isinstance(st.op, ast.Add)
+                  and isinstance(st.target, ast.Name)
+                  and st.target.id in lists
+                  and isinstance(st.value, ast.List)):
+                lists[st.target.id] = (lists[st.target.id]
+                                       + list(st.value.elts))
+        for node, spec, args in calls:
+            stmt = self._enclosing_stmt(fn, node)
+            for i in sorted(spec):
+                if i >= len(args) or args[i] is None:
+                    continue
+                expr = args[i]
+                if not self._trackable(expr):
+                    continue
+                key = ast.unparse(expr)
+                if stmt is not None and self._stmt_rebinds(stmt, key):
+                    continue
+                bad = self._used_after(fn, stmt, node, key)
+                if bad is not None:
+                    yield m.finding(
+                        self.id, bad,
+                        f"'{key}' was donated to the jitted call at "
+                        f"line {node.lineno} (donate_argnums={i}) and "
+                        "is referenced afterwards — its buffer belongs "
+                        "to XLA now; rebind the result instead")
+
+    @staticmethod
+    def _positional(call: ast.Call, lists) -> list[Optional[ast.AST]]:
+        out: list[Optional[ast.AST]] = []
+        for a in call.args:
+            if isinstance(a, ast.Starred):
+                if (isinstance(a.value, ast.Name)
+                        and a.value.id in lists):
+                    out.extend(lists[a.value.id])
+                else:
+                    out.append(None)  # unknown tail: stop resolving
+                    break
+            else:
+                out.append(a)
+        return out
+
+    @staticmethod
+    def _trackable(expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return True
+        return (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name))
+
+    @staticmethod
+    def _enclosing_stmt(fn, node: ast.AST) -> Optional[ast.stmt]:
+        best = None
+        for st in walk_shallow(fn):
+            if isinstance(st, ast.stmt) and st.lineno <= node.lineno \
+                    and (st.end_lineno or st.lineno) >= (
+                        node.end_lineno or node.lineno):
+                if best is None or st.lineno >= best.lineno:
+                    best = st
+        return best
+
+    @staticmethod
+    def _stmt_rebinds(stmt: ast.stmt, key: str) -> bool:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                    if ast.unparse(el) == key:
+                        return True
+        return False
+
+    @staticmethod
+    def _used_after(fn, stmt: Optional[ast.stmt], call: ast.Call,
+                    key: str) -> Optional[ast.AST]:
+        """First LOAD of ``key`` after the call statement, unless a
+        rebind comes first (line-ordered approximation)."""
+        after = (stmt.end_lineno or stmt.lineno) if stmt is not None \
+            else (call.end_lineno or call.lineno)
+        first_load: Optional[ast.AST] = None
+        first_rebind: Optional[int] = None
+        for node in walk_shallow(fn):
+            ln = getattr(node, "lineno", None)
+            if ln is None or ln <= after:
+                continue
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    els = t.elts if isinstance(t, ast.Tuple) else [t]
+                    if any(ast.unparse(el) == key for el in els):
+                        if first_rebind is None or ln < first_rebind:
+                            first_rebind = ln
+            if isinstance(node, (ast.Name, ast.Attribute)) \
+                    and isinstance(getattr(node, "ctx", None), ast.Load) \
+                    and ast.unparse(node) == key:
+                if first_load is None or ln < first_load.lineno:
+                    first_load = node
+        if first_load is None:
+            return None
+        if first_rebind is not None and first_rebind <= first_load.lineno:
+            return None
+        return first_load

@@ -1,0 +1,77 @@
+"""except-swallow: no silent broad-exception swallows.
+
+A bare ``except:`` / ``except Exception:`` whose handler neither
+re-raises, logs, records telemetry, nor uses the caught exception value
+is a *silent swallow* — the failure class PR 3 had to dig out of the
+prompt-cache restore path by hand. Recovery is fine; invisible recovery
+is not: add a narrow exception type, or log/count what was swallowed
+(the ``engine_prompt_cache_restores_total{result}`` pattern), or
+suppress with a reasoned ``# lint: ignore[except-swallow] ...``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Context, Finding
+
+BROAD = {"Exception", "BaseException"}
+
+# a call to any of these attribute names counts as "the failure was
+# made visible": loggers, telemetry counters/gauges/histograms, tracers
+_EVIDENCE_ATTRS = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "inc", "observe", "set", "labels", "event", "finish", "add_note",
+}
+
+
+def _broad_names(h: ast.ExceptHandler) -> bool:
+    t = h.type
+    if t is None:
+        return True
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in elts:
+        name = n.id if isinstance(n, ast.Name) else (
+            n.attr if isinstance(n, ast.Attribute) else "")
+        if name in BROAD:
+            return True
+    return False
+
+
+def _handled(h: ast.ExceptHandler) -> bool:
+    for node in ast.walk(ast.Module(body=list(h.body), type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _EVIDENCE_ATTRS:
+                return True
+            if isinstance(f, ast.Name) and f.id == "print":
+                return True
+        # the exception VALUE flowing anywhere (an error field, a
+        # result message) means the failure is surfaced, not swallowed
+        if (h.name and isinstance(node, ast.Name) and node.id == h.name
+                and isinstance(node.ctx, ast.Load)):
+            return True
+    return False
+
+
+class ExceptionHygiene:
+    id = "except-swallow"
+    doc = ("broad except handler swallows the failure silently — narrow "
+           "the exception, or log/count it")
+
+    def check(self, ctx: Context) -> Iterator[Finding]:
+        for m in ctx.modules:
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if _broad_names(node) and not _handled(node):
+                    caught = ("bare except" if node.type is None else
+                              f"except {ast.unparse(node.type)}")
+                    yield m.finding(
+                        self.id, node,
+                        f"{caught} swallows the failure silently "
+                        "(no raise/log/telemetry, exception value "
+                        "unused)")

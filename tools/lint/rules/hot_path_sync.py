@@ -1,0 +1,227 @@
+"""hot-path-sync: no device syncs inside scheduler/dispatch regions.
+
+The scheduler's async pipeline shape (enqueue everything, harvest on
+readiness) is why decode ITL survives admission waves. ONE stray
+``.item()`` / ``np.asarray(device_value)`` / ``block_until_ready`` in
+the loop serializes host against device and collapses the pipeline —
+a bug class that profiles as "mysteriously slow", never as an error.
+
+Scope: code inside ``# lint: region hot_path`` .. ``# lint: endregion
+hot_path`` spans (the scheduler loop and dispatch/harvest paths in
+``engine/engine.py``). Inside a region the rule flags:
+
+- ``.item()``, ``block_until_ready``, ``jax.device_get`` — always;
+- ``np.asarray`` / ``np.array`` / ``np.frombuffer``, ``int()`` /
+  ``float()`` / ``bool()``, ``.tolist()`` / ``.tobytes()`` applied to a
+  DEVICE-TAINTED expression.
+
+Taint is a per-function forward pass: results of ``self._run`` /
+``self._dev_exec``, the engine's device state attributes
+(``self.cache``, ``self.sampling``, ...) and flight ``.arrays`` are
+device values; names assigned from tainted expressions inherit the
+taint. Shape/dtype metadata access (``.shape``, ``.dtype``, ...) and a
+flagged conversion's own result (it IS the host copy) drop it.
+
+Intentionally-blocking paths (``_decode1_step``'s per-token grammar
+harvest, flight completion after ``ready()``) carry reasoned
+``# lint: ignore[hot-path-sync]`` suppressions — the point is that a
+sync in the hot path needs a written justification, not that one can
+never exist.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Context, Finding, Module
+from .scalar_payload import walk_shallow
+
+REGION = "hot_path"
+
+# engine attributes that hold live device arrays
+DEVICE_ATTRS = {
+    "cache", "draft_cache", "sampling", "params", "draft",
+    "_dev_tokens", "_dev_pos", "_dev_active",
+}
+# attribute reads that yield host metadata, not device data
+_META_ATTRS = {"shape", "dtype", "ndim", "size", "itemsize", "nbytes",
+               "sharding", "quantized"}
+# attribute names whose access marks the object as device-held
+_DEVICE_BEARING_ATTRS = {"arrays"}
+
+_ALWAYS_FLAG_ATTRS = {"item", "block_until_ready", "device_get"}
+_TAINT_FLAG_ATTRS = {"tolist", "tobytes"}
+_CONVERTERS = {"int", "float", "bool"}
+_NP_CONVERTERS = {"asarray", "array", "frombuffer"}
+_NP_NAMES = {"np", "numpy"}
+_HOST_CALLS = {"len", "range", "enumerate", "zip", "sorted", "min",
+               "max", "sum", "any", "all"}
+
+
+class _FnTaint:
+    """One forward taint pass over a function body (statement order;
+    loops are not fix-pointed — good enough for lexical hot paths)."""
+
+    def __init__(self) -> None:
+        self.names: set[str] = set()
+
+    def expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if node.attr in _META_ATTRS:
+                return False
+            if node.attr in _DEVICE_BEARING_ATTRS:
+                return True
+            if (isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                return node.attr in DEVICE_ATTRS
+            return self.expr(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value)
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in _HOST_CALLS:
+                return False
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "self"
+                    and f.attr in ("_run", "_dev_exec")):
+                return True
+            if self._is_flagged_conversion(f):
+                return False  # the conversion result IS the host copy
+            parts = list(node.args) + [kw.value for kw in node.keywords]
+            if isinstance(f, ast.Attribute):
+                parts.append(f.value)
+            return any(self.expr(p) for p in parts)
+        if isinstance(node, (ast.BinOp,)):
+            return self.expr(node.left) or self.expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.expr(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return False  # comparisons yield host bools... on device
+            # arrays they yield arrays, but comparing device values in
+            # the hot path surfaces at the int()/bool() conversion site
+        if isinstance(node, ast.IfExp):
+            return self.expr(node.body) or self.expr(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        return False
+
+    @staticmethod
+    def _is_flagged_conversion(f: ast.AST) -> bool:
+        if isinstance(f, ast.Name) and f.id in _CONVERTERS:
+            return True
+        return (isinstance(f, ast.Attribute)
+                and f.attr in _NP_CONVERTERS
+                and isinstance(f.value, ast.Name)
+                and f.value.id in _NP_NAMES)
+
+    def assign(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Assign):
+            t = self.expr(node.value)
+            for target in node.targets:
+                self._bind(target, t)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            self._bind(node.target, self.expr(node.value))
+        elif isinstance(node, ast.AugAssign):
+            if self.expr(node.value) and isinstance(node.target, ast.Name):
+                self.names.add(node.target.id)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            self._bind(node.target, self.expr(node.iter))
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            self._bind(node.optional_vars, self.expr(node.context_expr))
+
+    def _bind(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            (self.names.add if tainted
+             else self.names.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._bind(el, tainted)
+        # stores into attributes/subscripts don't rebind a local name
+
+
+class HotPathSync:
+    id = "hot-path-sync"
+    doc = ("device sync (.item()/np.asarray/block_until_ready/...) "
+           "inside a '# lint: region hot_path' region")
+
+    def check(self, ctx: Context) -> Iterator[Finding]:
+        for m in ctx.modules:
+            if REGION not in m.pragmas.regions:
+                continue
+            for fn in ast.walk(m.tree):
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                # run taint over any function that overlaps a region
+                end = fn.end_lineno or fn.lineno
+                if any(a <= end and b >= fn.lineno
+                       for a, b in m.pragmas.regions[REGION]):
+                    yield from self._check_fn(m, fn)
+
+    def _check_fn(self, m: Module, fn) -> Iterator[Finding]:
+        taint = _FnTaint()
+        # statement-ordered shallow traversal: check calls with the
+        # taint state BEFORE their enclosing assignment binds (in
+        # `D = np.asarray(D)` the call must see the old, tainted D), so
+        # assignment bindings are deferred to the statement's end
+        nodes = sorted(walk_shallow(fn),
+                       key=lambda n: (getattr(n, "lineno", 0),
+                                      getattr(n, "col_offset", 0)))
+        pending: list[tuple[tuple[int, int], ast.AST]] = []
+        for node in nodes:
+            pos = (getattr(node, "lineno", 0),
+                   getattr(node, "col_offset", 0))
+            while pending and pos > pending[0][0]:
+                taint.assign(pending.pop(0)[1])
+            if isinstance(node, ast.Call):
+                yield from self._check_call(m, taint, node)
+            if isinstance(node, (ast.Assign, ast.AnnAssign,
+                                 ast.AugAssign)):
+                end = (node.end_lineno or node.lineno,
+                       node.end_col_offset or 0)
+                pending.append((end, node))
+                pending.sort(key=lambda e: e[0])
+            else:
+                taint.assign(node)  # loop/with targets bind up front
+
+    def _check_call(self, m: Module, taint: _FnTaint,
+                    call: ast.Call) -> Iterator[Finding]:
+        if not m.pragmas.in_region(REGION, call.lineno):
+            return
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _ALWAYS_FLAG_ATTRS:
+                yield m.finding(
+                    self.id, call,
+                    f"'.{f.attr}()' forces a device sync in the hot "
+                    "path — harvest via flight readiness instead")
+                return
+            if f.attr in _TAINT_FLAG_ATTRS and taint.expr(f.value):
+                yield m.finding(
+                    self.id, call,
+                    f"'.{f.attr}()' on a device value blocks the "
+                    "scheduler on device completion")
+                return
+            if (f.attr in _NP_CONVERTERS
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in _NP_NAMES
+                    and any(taint.expr(a) for a in call.args)):
+                yield m.finding(
+                    self.id, call,
+                    f"np.{f.attr}() on a device value is a blocking "
+                    "device->host transfer in the hot path")
+                return
+        if (isinstance(f, ast.Name) and f.id in _CONVERTERS
+                and any(taint.expr(a) for a in call.args)):
+            yield m.finding(
+                self.id, call,
+                f"{f.id}() coerces a device value on the host — an "
+                "implicit device sync in the hot path")

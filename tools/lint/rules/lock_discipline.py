@@ -1,0 +1,178 @@
+"""guarded-by: annotated attributes mutate only under their lock.
+
+``self._models = {}  # lint: guarded-by self._lock`` turns the comment
+"registry map mutations only" into a checked contract: every statement
+in the class that MUTATES ``self._models`` (assignment, ``del``,
+subscript stores, ``.pop()``/``.append()``/... calls) must sit lexically
+inside ``with self._lock:``. Reads are not checked (lock-free reads are
+a deliberate, per-site judgement call). Exemptions:
+
+- ``__init__`` bodies (single-threaded construction), and
+- functions marked ``# lint: holds <lock>`` (caller holds the lock).
+
+The analysis is lexical and per-class: helper methods called with the
+lock held must carry the ``holds`` pragma rather than relying on call-
+graph reasoning.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import Context, Finding, Module
+
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popleft",
+    "appendleft", "clear", "update", "setdefault", "add", "discard",
+    "sort", "reverse",
+}
+
+
+def _norm(expr: str) -> str:
+    return "".join(expr.split())
+
+
+def _root_self_attr(node: ast.AST) -> Optional[str]:
+    """X for an expression rooted at ``self.X`` (through any chain of
+    subscripts/attributes), else None."""
+    while isinstance(node, (ast.Subscript, ast.Slice)):
+        node = node.value  # type: ignore[union-attr]
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class LockDiscipline:
+    id = "guarded-by"
+    doc = ("attribute annotated '# lint: guarded-by <lock>' mutated "
+           "outside 'with <lock>:'")
+
+    def check(self, ctx: Context) -> Iterator[Finding]:
+        for m in ctx.modules:
+            yield from self._check_module(m)
+
+    def _check_module(self, m: Module) -> Iterator[Finding]:
+        if not m.pragmas.guarded:
+            return
+        parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(m.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        def enclosing(node: ast.AST, kinds) -> Optional[ast.AST]:
+            cur = parents.get(node)
+            while cur is not None and not isinstance(cur, kinds):
+                cur = parents.get(cur)
+            return cur
+
+        # map each guarded pragma to (class node, attr, lock expr):
+        # the pragma is a trailing comment on (or the line above) the
+        # attribute's assignment
+        guarded: dict[ast.ClassDef, dict[str, str]] = {}
+        for line, lock in m.pragmas.guarded:
+            # trailing comment on the assignment, or a pragma line
+            # directly above it — exact lines only (a +-1 window would
+            # grab an ADJACENT attribute's assignment)
+            hit = None
+            for node in ast.walk(m.tree):
+                if (isinstance(node, (ast.Assign, ast.AnnAssign))
+                        and node.lineno <= line + 1
+                        and (node.end_lineno or node.lineno) >= line):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        attr = _root_self_attr(t)
+                        if attr is not None:
+                            hit = (node, attr)
+                            break
+                if hit:
+                    break
+            if hit is None:
+                yield m.finding(
+                    "lint-pragma", line,
+                    "guarded-by pragma is not attached to a self.<attr> "
+                    "assignment")
+                continue
+            cls = enclosing(hit[0], ast.ClassDef)
+            if cls is None:
+                yield m.finding(
+                    "lint-pragma", line,
+                    "guarded-by pragma outside a class body")
+                continue
+            guarded.setdefault(cls, {})[hit[1]] = _norm(lock)
+
+        # functions whose callers hold a lock
+        holds: dict[ast.AST, set[str]] = {}
+        for line, lock in m.pragmas.holds:
+            fn = None
+            for node in ast.walk(m.tree):
+                if (isinstance(node, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                        and node.lineno <= line + 1
+                        and (node.end_lineno or node.lineno) >= line):
+                    if fn is None or node.lineno > fn.lineno:
+                        fn = node  # innermost
+            if fn is None:
+                yield m.finding(
+                    "lint-pragma", line,
+                    "holds pragma is not attached to a function")
+                continue
+            holds.setdefault(fn, set()).add(_norm(lock))
+
+        for cls, attrs in guarded.items():
+            for node in ast.walk(cls):
+                attr = self._mutated_attr(node)
+                if attr is None or attr not in attrs:
+                    continue
+                lock = attrs[attr]
+                # exempt: inside `with <lock>:`
+                cur = parents.get(node)
+                ok = False
+                fn_chain = []
+                while cur is not None and cur is not cls:
+                    if isinstance(cur, ast.With) and any(
+                            _norm(ast.unparse(item.context_expr)) == lock
+                            for item in cur.items):
+                        ok = True
+                        break
+                    if isinstance(cur, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        fn_chain.append(cur)
+                    cur = parents.get(cur)
+                if ok:
+                    continue
+                if fn_chain and fn_chain[-1].name == "__init__":
+                    continue  # construction is single-threaded
+                if any(lock in holds.get(fn, ()) for fn in fn_chain):
+                    continue
+                yield m.finding(
+                    self.id, node,
+                    f"self.{attr} is guarded by '{lock}' but is mutated "
+                    f"outside 'with {lock}:' (add the lock, or mark the "
+                    f"function '# lint: holds {lock}')")
+
+    @staticmethod
+    def _mutated_attr(node: ast.AST) -> Optional[str]:
+        """The guarded-candidate attribute a statement mutates, if any."""
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                    attr = _root_self_attr(el)
+                    if attr is not None:
+                        return attr
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                attr = _root_self_attr(t)
+                if attr is not None:
+                    return attr
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                return _root_self_attr(f.value)
+        return None

@@ -1,0 +1,120 @@
+"""metrics-contract: metric naming + README coverage + required set.
+
+The lint-framework port of ``tools/check_metrics.py`` (whose CLI now
+wraps this rule). Every literal registry registration
+(``REGISTRY.counter("...")`` / ``.gauge`` / ``.histogram``) must
+
+- be snake_case,
+- carry a unit suffix (counters ``_total``; histograms ``_seconds`` /
+  ``_bytes``; gauges ``_seconds``/``_bytes``/``_count``/``_ratio``/
+  ``_info``, or a ``<unit>_per_<x>`` rate),
+- appear as `` `name` `` in the README Observability table, and
+- a computed (non-literal) name is itself a finding: it can be neither
+  linted nor documented.
+
+``REQUIRED_FAMILIES`` must all stay registered — deleting one silently
+breaks dashboards and the bench's extra blocks. Repo-wide checks
+(required set, empty-scan guard, README coverage without an explicit
+readme) only run on full-package scans so fixture tests stay hermetic.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..core import Context, Finding
+
+_SNAKE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)*$")
+
+SUFFIXES = {
+    "counter": ("_total",),
+    "histogram": ("_seconds", "_bytes"),
+    "gauge": ("_seconds", "_bytes", "_count", "_ratio", "_info"),
+}
+
+# rate/intensity gauges: unit suffix + `_per_<x>` qualifier
+# (Prometheus bytes_per_second convention) is also valid
+_PER_GAUGE = re.compile(r"_(seconds|bytes|count)_per_[a-z0-9_]+$")
+
+# families that MUST exist (removing one silently breaks dashboards
+# and the bench's extra blocks)
+REQUIRED_FAMILIES = {
+    "engine_kv_pages_in_use_count",
+    "engine_kv_pages_shared_count",
+    "engine_kv_page_alloc_total",
+    "engine_kv_hbm_per_live_token_bytes",
+    "engine_dispatch_compile_variants_count",
+    "engine_ragged_rows_total",
+}
+
+_METRICS_MODULE = "localai_tfp_tpu/telemetry/metrics.py"
+
+
+def find_registrations(ctx: Context):
+    """(kind, name, module, line) for every literal registration, plus
+    (module, line) for computed names."""
+    regs, computed = [], []
+    for m in ctx.modules:
+        for node in ast.walk(m.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in SUFFIXES):
+                continue
+            # skip unrelated attr calls with no args (e.g. obj.gauge())
+            if not node.args:
+                continue
+            name = node.args[0]
+            if isinstance(name, ast.Constant) \
+                    and isinstance(name.value, str):
+                regs.append((node.func.attr, name.value, m, node.lineno))
+            else:
+                computed.append((node.func.attr, m, node.lineno))
+    return regs, computed
+
+
+class MetricsContract:
+    id = "metrics-contract"
+    doc = ("metric registration violates the naming/README contract "
+           "(snake_case, unit suffix, Observability table row)")
+
+    def check(self, ctx: Context) -> Iterator[Finding]:
+        regs, computed = find_registrations(ctx)
+        full = ctx.module(_METRICS_MODULE) is not None
+        for kind, m, line in computed:
+            yield m.finding(
+                self.id, line,
+                f".{kind}() registration with a computed name — literal "
+                "names only (a computed name cannot be linted or "
+                "documented)")
+        readme = ctx.readme_text
+        for kind, name, m, line in regs:
+            if not _SNAKE.match(name):
+                yield m.finding(self.id, line,
+                                f"metric '{name}' is not snake_case")
+            if not name.endswith(SUFFIXES[kind]) and not (
+                    kind == "gauge" and _PER_GAUGE.search(name)):
+                yield m.finding(
+                    self.id, line,
+                    f"{kind} '{name}' lacks a unit suffix (one of "
+                    f"{', '.join(SUFFIXES[kind])})")
+            if (readme or full) and f"`{name}`" not in readme:
+                yield m.finding(
+                    self.id, line,
+                    f"metric '{name}' is not documented in the "
+                    f"README.md Observability table (add a `{name}` "
+                    "row)")
+        if full:
+            main = ctx.module(_METRICS_MODULE)
+            if not regs:
+                yield main.finding(
+                    self.id, 1,
+                    "no metric registrations found under "
+                    "localai_tfp_tpu/ — scanner or layout broke")
+            missing = REQUIRED_FAMILIES - {n for _, n, _, _ in regs}
+            for name in sorted(missing):
+                yield main.finding(
+                    self.id, 1,
+                    f"required metric family '{name}' is not "
+                    "registered anywhere under localai_tfp_tpu/")

@@ -1,0 +1,216 @@
+"""scalar-payload: dispatch records carry only codec-whitelisted fields.
+
+Every device dispatch is published as a ``(kind, payload)`` record that
+multihost followers replay byte-for-byte (parallel/multihost.py). A
+payload field the codec whitelist does not know about is how a new
+dispatch kind silently breaks follower replay: the leader pickles it,
+followers feed it to ``_dev_exec``, and the SPMD programs diverge.
+
+This rule finds every dispatch site — ``self._run(kind, payload)`` and
+the warmup's ``_warm(kind, payload)`` wrapper — and checks that
+
+- the kind is a string literal (a computed kind cannot be audited), and
+- every payload key is listed for that kind in
+  ``parallel/multihost.py::PAYLOAD_FIELDS`` (the codec whitelist; adding
+  a field there is the reviewed act that acknowledges the replay
+  contract).
+
+Payloads are resolved statically: a dict literal argument, or a local
+name assigned a dict literal (following ``**spread`` of other local
+dict literals and later ``payload["key"] = ...`` stores). Anything the
+resolver cannot see is itself a finding — dispatch payloads must stay
+simple enough to audit.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import Context, Finding, Module
+
+WHITELIST_MODULE = "localai_tfp_tpu/parallel/multihost.py"
+WHITELIST_NAME = "PAYLOAD_FIELDS"
+
+_DISPATCH_FUNCS = {"_run", "_warm"}
+
+
+def walk_shallow(fn: ast.AST):
+    """Walk a function body without descending into nested defs (each
+    function is analyzed exactly once, with its own locals)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _load_whitelist(ctx: Context) -> Optional[dict[str, tuple[str, ...]]]:
+    """PAYLOAD_FIELDS parsed from the codec module's AST (the linter
+    never imports engine code). Fixture contexts may define the constant
+    in any module."""
+    mods = [m for m in ctx.modules if m.rel == WHITELIST_MODULE]
+    mods += [m for m in ctx.modules if m.rel != WHITELIST_MODULE]
+    for m in mods:
+        for node in ast.walk(m.tree):
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == WHITELIST_NAME
+                            for t in node.targets)):
+                try:
+                    raw = ast.literal_eval(node.value)
+                except ValueError:
+                    return None
+                return {str(k): tuple(v) for k, v in raw.items()}
+    return None
+
+
+class ScalarPayload:
+    id = "scalar-payload"
+    doc = ("dispatch payload field not in the multihost codec whitelist "
+           "(PAYLOAD_FIELDS)")
+
+    def check(self, ctx: Context) -> Iterator[Finding]:
+        wl = _load_whitelist(ctx)
+        for m in ctx.modules:
+            funcs = [n for n in ast.walk(m.tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+            for fn in funcs:
+                # only direct statements of this function (nested defs
+                # are visited on their own)
+                for node in walk_shallow(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if not self._is_dispatch(node):
+                        continue
+                    if len(node.args) < 2:
+                        continue
+                    if self._is_forwarding_wrapper(fn, node):
+                        continue
+                    yield from self._check_site(m, fn, node, wl)
+
+    @staticmethod
+    def _is_forwarding_wrapper(fn, call: ast.Call) -> bool:
+        """``def _warm(kind, payload): ... self._run(kind, payload)`` is
+        a dispatch WRAPPER, not a site — both args are the enclosing
+        function's own parameters, so each caller is checked instead."""
+        params = {a.arg for a in fn.args.args + fn.args.posonlyargs
+                  + fn.args.kwonlyargs}
+        return all(isinstance(a, ast.Name) and a.id in params
+                   for a in call.args[:2])
+
+    @staticmethod
+    def _is_dispatch(call: ast.Call) -> bool:
+        f = call.func
+        if (isinstance(f, ast.Attribute) and f.attr in _DISPATCH_FUNCS
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "self"):
+            return True
+        return isinstance(f, ast.Name) and f.id in _DISPATCH_FUNCS
+
+    def _check_site(self, m: Module, fn, call: ast.Call,
+                    wl) -> Iterator[Finding]:
+        kinds = self._literal_kinds(call.args[0])
+        if kinds is None:
+            yield m.finding(
+                self.id, call,
+                "dispatch kind is not a string literal — the replay "
+                "contract cannot be audited statically")
+            return
+        keys = self._resolve_keys(fn, call.args[1], call.lineno)
+        if keys is None:
+            yield m.finding(
+                self.id, call,
+                "dispatch payload does not resolve to a dict literal — "
+                "build it as one (plus payload[...] stores) so the "
+                "codec whitelist can be checked")
+            return
+        if wl is None:
+            yield m.finding(
+                self.id, call,
+                f"codec whitelist {WHITELIST_NAME} not found in "
+                f"{WHITELIST_MODULE}")
+            return
+        for kind in kinds:
+            if kind in ("load", "unload", "stop"):
+                continue  # lifecycle records, not engine dispatches
+            if kind not in wl:
+                yield m.finding(
+                    self.id, call,
+                    f"dispatch kind '{kind}' is not in the multihost "
+                    f"codec whitelist ({WHITELIST_MODULE} "
+                    f"{WHITELIST_NAME}) — followers cannot replay it")
+                continue
+            for key in sorted(set(keys) - set(wl[kind])):
+                yield m.finding(
+                    self.id, call,
+                    f"payload field '{key}' for kind '{kind}' is not "
+                    f"in the multihost codec whitelist — add it to "
+                    f"{WHITELIST_NAME} (and the follower codec) or "
+                    f"drop it")
+
+    @staticmethod
+    def _literal_kinds(node: ast.AST) -> Optional[list[str]]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [node.value]
+        if isinstance(node, ast.IfExp):
+            a = ScalarPayload._literal_kinds(node.body)
+            b = ScalarPayload._literal_kinds(node.orelse)
+            if a is not None and b is not None:
+                return a + b
+        return None
+
+    def _resolve_keys(self, fn, payload: ast.AST,
+                      call_line: int) -> Optional[list[str]]:
+        if isinstance(payload, ast.Dict):
+            return self._dict_keys(fn, payload, call_line)
+        if not isinstance(payload, ast.Name):
+            return None
+        # latest `name = {...}` before the call, plus `name[k] = v`
+        # stores between that assignment and the call
+        assign = None
+        for node in walk_shallow(fn):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == payload.id
+                    and node.lineno < call_line
+                    and (assign is None or node.lineno > assign.lineno)):
+                assign = node
+        if assign is None or not isinstance(assign.value, ast.Dict):
+            return None
+        keys = self._dict_keys(fn, assign.value, assign.lineno)
+        if keys is None:
+            return None
+        for node in walk_shallow(fn):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Subscript)
+                    and isinstance(node.targets[0].value, ast.Name)
+                    and node.targets[0].value.id == payload.id
+                    and assign.lineno < node.lineno < call_line):
+                sl = node.targets[0].slice
+                if (isinstance(sl, ast.Constant)
+                        and isinstance(sl.value, str)):
+                    keys.append(sl.value)
+                else:
+                    return None  # computed key: unauditable
+        return keys
+
+    def _dict_keys(self, fn, d: ast.Dict,
+                   at_line: int) -> Optional[list[str]]:
+        keys: list[str] = []
+        for k, v in zip(d.keys, d.values):
+            if k is None:  # **spread: follow locally-assigned literals
+                if not isinstance(v, ast.Name):
+                    return None
+                inner = self._resolve_keys(fn, v, at_line)
+                if inner is None:
+                    return None
+                keys.extend(inner)
+            elif isinstance(k, ast.Constant) and isinstance(k.value, str):
+                keys.append(k.value)
+            else:
+                return None
+        return keys
