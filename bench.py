@@ -325,6 +325,23 @@ def _mixed_itl_extra(eng, tok, n_tok=96) -> dict:
     }
 
 
+def _chaos_extra() -> dict:
+    """Serving-survival acceptance block (extra.chaos): bounded-admission
+    shed rate + Retry-After hint, both deadline stages, a deterministic
+    device-step fault storm (terminal-event completeness + KV-pool leak
+    check), and the federation breaker's failover latency under active
+    probing. Runs on its OWN tiny engine and a localhost balancer pair,
+    so it is independent of the serving engine's lifecycle (not subject
+    to the _LIVE_ENGINE_EXTRAS ordering guard)."""
+    import asyncio as _asyncio
+
+    from tools.profile_chaos import engine_leg, federation_leg
+
+    out = engine_leg(flood=12)
+    out["federation"] = _asyncio.run(federation_leg(0.1))
+    return out
+
+
 def _lint_extra():
     """graftlint trajectory per release: rule count, findings, baseline
     size. New findings here mean tier-1 (tests/test_lint.py) is already
@@ -1048,6 +1065,7 @@ def main() -> None:
         extra["ttft_p50_ms"] = p50
         extra["ttft_p50_ms_http"] = p50_h
 
+    extra["chaos"] = _chaos_extra()
     extra["lint"] = _lint_extra()
     extra["telemetry"] = REGISTRY.delta(tel_snap)
     print(json.dumps({

@@ -74,6 +74,7 @@ from ..ops.sampling import (
 )
 from ..telemetry import metrics as tm
 from ..telemetry.tracing import TRACER
+from ..utils import faultinject
 from .kv_pool import TRASH_PAGE, PagePool, PagePoolExhausted
 from .prefix_index import PrefixIndex, common_prefix_len
 from .tokenizer import StreamDecoder, Tokenizer
@@ -129,6 +130,13 @@ class GenRequest:
     id: str = field(default_factory=lambda: uuid.uuid4().hex)
     t_submit: float = 0.0  # perf_counter at submit (queue-wait/TTFT
     # attribution; set by submit_many, 0 for directly-assigned tests)
+    # request deadline: client-supplied budget in seconds (0 = use the
+    # engine's LOCALAI_REQUEST_DEADLINE_S default, which may itself be
+    # 0 = no deadline). submit_many converts it to the absolute
+    # `deadline` (perf_counter clock); _apply_deadlines enforces it
+    # while queued AND while decoding
+    timeout_s: float = 0.0
+    deadline: float = 0.0
 
 
 class _PadReq:
@@ -174,6 +182,10 @@ class StreamEvent:
     # which used to be miscounted as prompt processing for chunked
     # prompts
     timing_prefill_enqueue_ms: float = 0.0
+    # load-shed hint: suggested client backoff in seconds, set only on
+    # finish_reason="shed" events (the HTTP layer maps it to a 429
+    # Retry-After header)
+    retry_after_s: float = 0.0
 
 
 class SlotState(Enum):
@@ -545,6 +557,23 @@ class LLMEngine:
         self._deferred: dict[str, tuple[float, int]] = {}
         self._pending: list[tuple[GenRequest, queue.SimpleQueue]] = []  # lint: guarded-by self._lock
         self._cancelled: dict[str, float] = {}  # lint: guarded-by self._lock
+        # request lifecycle guards. Both knobs default OFF so the
+        # unset path is byte-identical to the unguarded engine:
+        # - LOCALAI_REQUEST_DEADLINE_S: default per-request deadline
+        #   (seconds; a request's own timeout_s overrides)
+        # - LOCALAI_MAX_QUEUE: admission queue cap — submit_many sheds
+        #   beyond it with an immediate terminal "shed" event instead
+        #   of queueing unbounded latency
+        self._default_deadline_s = max(0.0, float(_os.environ.get(
+            "LOCALAI_REQUEST_DEADLINE_S", "0") or 0))
+        self.max_queue = max(0, int(_os.environ.get(
+            "LOCALAI_MAX_QUEUE", "0") or 0))
+        # sticky arm: flips on the first request that carries any
+        # deadline, so deadline-free serving never pays the sweep
+        self._deadlines_armed = self._default_deadline_s > 0
+        # recent admission queue waits (seconds) — the live sample the
+        # shed path turns into a Retry-After hint
+        self._queue_waits: deque[float] = deque(maxlen=64)  # lint: guarded-by self._lock
         self._lock = threading.Condition()
         self._stop = False
         self._thread: Optional[threading.Thread] = None
@@ -1613,6 +1642,11 @@ class LLMEngine:
         so a multihost leader's followers can replay the identical XLA
         program (parallel/multihost.py). Payloads carry only small host
         inputs; device state advances in place on every host."""
+        if faultinject.ACTIVE:
+            # chaos surface: a fault here behaves exactly like a device
+            # dispatch blowing up — _loop's catch fails active slots
+            # with one terminal error event each, scheduler survives
+            faultinject.fire("engine.device_step")
         ch = self.channel
         if ch is not None and not self.follower:
             # dense masks are bit-packed for the wire only; the local exec
@@ -2164,12 +2198,41 @@ class LLMEngine:
             now = time.perf_counter()
             for req, _ in ok:
                 req.t_submit = now
+                budget = req.timeout_s or self._default_deadline_s
+                if budget > 0:
+                    req.deadline = now + budget
+                    self._deadlines_armed = True
+            shed: list[tuple[GenRequest, queue.SimpleQueue]] = []
             with self._lock:
+                if self.max_queue > 0:
+                    # bounded admission: refuse the overflow NOW with a
+                    # terminal shed event + backoff hint, instead of
+                    # letting queue latency grow without bound. Newest
+                    # arrivals shed first — earlier ones were promised
+                    # a place the moment they fit
+                    room = self.max_queue - len(self._pending)
+                    if room < len(ok):
+                        ok, shed = ok[:max(0, room)], ok[max(0, room):]
+                    if shed:
+                        retry_s = self._retry_after_s()
                 self._pending.extend(ok)
-                self._last_arrival = now
-                self._arrivals.append(self._last_arrival)
+                if ok:
+                    self._last_arrival = now
+                    self._arrivals.append(self._last_arrival)
                 depth = len(self._pending)
                 self._lock.notify_all()
+            for req, out in shed:
+                out.put(StreamEvent(
+                    done=True, finish_reason="shed",
+                    error=f"admission queue full "
+                          f"({self.max_queue} queued); retry later",
+                    retry_after_s=retry_s))
+                TRACER.event(req.id, "shed", t=now, model=self._mlabel)
+                TRACER.finish(req.id, status="shed")
+                tm.ENGINE_REQUESTS.labels(model=self._mlabel,
+                                          reason="shed").inc()
+                tm.ENGINE_REQUESTS_SHED.labels(
+                    model=self._mlabel, reason="queue_full").inc()
             for req, _ in ok:
                 TRACER.event(req.id, "queue", t=now, model=self._mlabel)
             tm.ENGINE_QUEUE_DEPTH.labels(model=self._mlabel).set(depth)
@@ -2197,14 +2260,35 @@ class LLMEngine:
 
     _CANCEL_TTL_S = 300.0  # unmatched cancel ids expire (leak bound)
 
+    def _retry_after_s(self) -> float:
+        """Suggested client backoff for a shed request: roughly the p90
+        of recently observed admission queue waits, clamped to a sane
+        window. Caller holds self._lock."""
+        ws = sorted(self._queue_waits)
+        if not ws:
+            return 1.0
+        p90 = ws[min(len(ws) - 1, int(0.9 * len(ws)))]
+        return min(30.0, max(0.5, p90))
+
+    def _purge_expired_cancels(self, now: float) -> int:
+        """Drop race-ahead cancel ids older than _CANCEL_TTL_S; returns
+        how many expired. Caller holds self._lock. Called from BOTH the
+        cancellation sweep and the idle wait in _loop — an idle engine
+        never runs step(), so without the idle-path purge a burst of
+        unmatched cancels would sit for the engine's lifetime."""
+        # lint: holds self._lock
+        expired = [r for r, t in self._cancelled.items()
+                   if now - t > self._CANCEL_TTL_S]
+        for rid in expired:
+            del self._cancelled[rid]
+        return len(expired)
+
     def _apply_cancellations(self) -> None:
         with self._lock:
             if not self._cancelled:
                 return
             now = time.perf_counter()
-            for rid in [r for r, t in self._cancelled.items()
-                        if now - t > self._CANCEL_TTL_S]:
-                del self._cancelled[rid]
+            n_expired = self._purge_expired_cancels(now)
             cancelled = self._cancelled
             # queued requests: drop before admission
             still = []
@@ -2219,12 +2303,16 @@ class LLMEngine:
                 else:
                     still.append((req, out))
             self._pending = still
+        if n_expired:
+            tm.ENGINE_CANCELLATIONS.labels(
+                model=self._mlabel, reason="expired").inc(n_expired)
         for rid in dropped:
             TRACER.event(rid, "done")
             TRACER.finish(rid, status="cancelled")
             tm.ENGINE_REQUESTS.labels(model=self._mlabel,
                                       reason="cancelled").inc()
-            tm.ENGINE_CANCELLATIONS.labels(model=self._mlabel).inc()
+            tm.ENGINE_CANCELLATIONS.labels(model=self._mlabel,
+                                           reason="client").inc()
         hit = [s for s in self.slots
                if s.active and s.request is not None
                and s.request.id in cancelled]
@@ -2233,6 +2321,43 @@ class LLMEngine:
                 cancelled.pop(s.request.id, None)
             self._finish(s, "cancelled")
 
+    def _apply_deadlines(self) -> None:
+        """Terminate requests whose deadline has passed: queued ones get
+        an immediate terminal event (no slot was ever held), decoding
+        ones finish through the normal slot path with whatever partial
+        text they produced. Gated on the sticky _deadlines_armed flag so
+        deadline-free serving skips the sweep entirely."""
+        if not self._deadlines_armed:
+            return
+        now = time.perf_counter()
+        expired: list[str] = []
+        with self._lock:
+            still = []
+            for req, out in self._pending:
+                if req.deadline and now >= req.deadline:
+                    self._deferred.pop(req.id, None)
+                    out.put(StreamEvent(
+                        done=True, finish_reason="deadline_exceeded",
+                        error="deadline exceeded while queued"))
+                    expired.append(req.id)
+                else:
+                    still.append((req, out))
+            self._pending = still
+        for rid in expired:
+            TRACER.event(rid, "done")
+            TRACER.finish(rid, status="deadline_exceeded")
+            tm.ENGINE_REQUESTS.labels(model=self._mlabel,
+                                      reason="deadline_exceeded").inc()
+            tm.ENGINE_DEADLINE_EXCEEDED.labels(
+                model=self._mlabel, stage="queued").inc()
+        hit = [s for s in self.slots
+               if s.active and s.request is not None
+               and s.request.deadline and now >= s.request.deadline]
+        for s in hit:
+            tm.ENGINE_DEADLINE_EXCEEDED.labels(
+                model=self._mlabel, stage="decode").inc()
+            self._finish(s, "deadline_exceeded")
+
     # ------------------------------------------------------------- scheduler
 
     def _loop(self) -> None:
@@ -2240,6 +2365,15 @@ class LLMEngine:
             with self._lock:
                 while not self._stop and not self._has_work():
                     self._lock.wait(timeout=0.5)
+                    if self._cancelled:
+                        # idle-path purge: step() never runs while idle,
+                        # so race-ahead cancels must age out here
+                        n = self._purge_expired_cancels(
+                            time.perf_counter())
+                        if n:
+                            tm.ENGINE_CANCELLATIONS.labels(
+                                model=self._mlabel,
+                                reason="expired").inc(n)
                 if self._stop:
                     return
             try:
@@ -2282,6 +2416,7 @@ class LLMEngine:
         QUEUE time, and keeping the queue clean around latency-critical
         dispatches matters more than wire round trips.)"""
         self._apply_cancellations()
+        self._apply_deadlines()
         self._admit()
         harvested = self._harvest()
         dispatched = self._dispatch()
@@ -2907,8 +3042,10 @@ class LLMEngine:
         now = time.perf_counter()
         TRACER.event(req.id, "admit", t=now, model=self._mlabel)
         if req.t_submit:
-            tm.ENGINE_QUEUE_WAIT.labels(model=self._mlabel).observe(
-                max(0.0, now - req.t_submit))
+            wait = max(0.0, now - req.t_submit)
+            tm.ENGINE_QUEUE_WAIT.labels(model=self._mlabel).observe(wait)
+            with self._lock:
+                self._queue_waits.append(wait)
         slot.cache_loaded = None
         copy_gain = disk_gain = 0
         if req.soft_embeds is not None:
@@ -4183,7 +4320,8 @@ class LLMEngine:
         self.metrics.requests_completed += 1
         tm.ENGINE_REQUESTS.labels(model=self._mlabel, reason=reason).inc()
         if reason == "cancelled":
-            tm.ENGINE_CANCELLATIONS.labels(model=self._mlabel).inc()
+            tm.ENGINE_CANCELLATIONS.labels(model=self._mlabel,
+                                           reason="client").inc()
         if req is not None:
             TRACER.event(req.id, "done", t=now)
             TRACER.finish(req.id, status=reason)

@@ -21,6 +21,7 @@ from typing import Callable, Optional
 
 from ..config.model_config import ModelConfig
 from ..telemetry import metrics as tm
+from ..utils import faultinject
 from ..workers.base import Backend, ModelLoadOptions, Result
 
 log = logging.getLogger(__name__)
@@ -264,6 +265,11 @@ class ModelLoader:
     def _load_as_leader(self, cfg: ModelConfig) -> Backend:
         """The actual load, run WITHOUT the registry lock held (only
         brief map mutations take it)."""
+        if faultinject.ACTIVE:
+            # chaos surface: an injected load failure takes the same
+            # path as a backend that failed to build — the in-flight
+            # load record propagates it to every coalesced waiter
+            faultinject.fire("loader.load")
         if self.single_active:
             self._single_gate.acquire()
         try:

@@ -11,12 +11,34 @@ a shared-token registry with heartbeats and an HTTP reverse proxy give
 the same operator surface (token join, /api/p2p introspection,
 least-used/random balancing).
 
+Failure handling (the part the reference delegates to edgevpn's
+LastSeen gossip): routing decisions cannot wait out the STALE_S=60
+heartbeat window, so the proxy layers three faster signals on top —
+
+- a per-node circuit breaker: LOCALAI_FED_BREAKER_FAILS consecutive
+  proxy/probe failures open the breaker for an exponentially growing
+  backoff (LOCALAI_FED_BREAKER_BASE_S doubling up to
+  LOCALAI_FED_BREAKER_CAP_S); after it elapses the node is half-open
+  and the active prober re-admits it on the first healthy answer;
+- connect-failure retry: an upstream that cannot be reached (or dies
+  before the response is prepared — no bytes streamed yet) is marked
+  failed and the request is re-proxied to the next eligible node;
+- active /healthz probing every LOCALAI_FED_PROBE_S seconds (0
+  disables) layered on the passive heartbeat, so a killed node is
+  marked down in seconds, not at the staleness horizon.
+
+An upstream that dies MID-stream cannot be retried (bytes are gone);
+the client instead gets a clean terminal frame (an SSE ``data:
+{"error": ...}`` event on event streams) and the node is marked down
+for subsequent requests.
+
 Token UX kept from the reference: one opaque base64 string carries
 network id + shared secret (ref: p2p.go:33-66 GenerateToken).
 """
 
 from __future__ import annotations
 
+import asyncio
 import base64
 import hmac
 import json
@@ -26,7 +48,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from aiohttp import ClientSession, ClientTimeout, web
+from aiohttp import ClientError, ClientSession, ClientTimeout, web
+
+from ..telemetry import metrics as tm
+from ..utils import faultinject
 
 HEARTBEAT_S = 20.0  # ref: announce every 20s (p2p.go:350-362)
 STALE_S = 60.0  # ref: FailureThreshold on LastSeen
@@ -51,25 +76,39 @@ def parse_token(token: str) -> dict:
 
 @dataclass
 class Node:
-    """ref: p2p.NodeData {Name, ID, TunnelAddress, LastSeen}."""
+    """ref: p2p.NodeData {Name, ID, TunnelAddress, LastSeen} + the
+    circuit-breaker record the registry drives."""
 
     id: str
     name: str
     address: str  # http(s)://host:port of the member instance
     last_seen: float = field(default_factory=time.monotonic)
     in_flight: int = 0
-    requests_served: int = 0
+    requests_served: int = 0  # SUCCESSFUL proxies only
+    # breaker record: consecutive failures, the open-until horizon and
+    # the backoff that produced it (doubles per re-trip), last error
+    consec_failures: int = 0
+    open_until: float = 0.0
+    backoff_s: float = 0.0
+    last_error: str = ""
 
     def online(self, now: Optional[float] = None) -> bool:
         return (now or time.monotonic()) - self.last_seen < STALE_S
 
 
 class NodeRegistry:
-    """Token-guarded membership table (the gossip-ledger equivalent)."""
+    """Token-guarded membership table (the gossip-ledger equivalent)
+    plus the per-node circuit breakers."""
 
     def __init__(self, token: str) -> None:
         self.token_payload = parse_token(token)
         self._nodes: dict[str, Node] = {}
+        self.breaker_fails = max(1, int(os.environ.get(
+            "LOCALAI_FED_BREAKER_FAILS", "3")))
+        self.breaker_base_s = float(os.environ.get(
+            "LOCALAI_FED_BREAKER_BASE_S", "1.0"))
+        self.breaker_cap_s = float(os.environ.get(
+            "LOCALAI_FED_BREAKER_CAP_S", "30.0"))
 
     def _authorized(self, token: str) -> bool:
         try:
@@ -83,13 +122,21 @@ class NodeRegistry:
                  address: str) -> bool:
         if not self._authorized(token):
             return False
+        now = time.monotonic()
         n = self._nodes.get(node_id)
         if n is None:
             self._nodes[node_id] = Node(id=node_id, name=name,
-                                        address=address)
+                                        address=address, last_seen=now)
         else:
+            # every successful announce is a full refresh: name and
+            # address may both have changed across a node restart, and
+            # last_seen must advance on the FIRST announce too (the
+            # old code split these between the dataclass default and
+            # the re-registration branch)
+            n.name = name
             n.address = address
-            n.last_seen = time.monotonic()
+            n.last_seen = now
+        self.update_state_gauge()
         return True
 
     def nodes(self, online_only: bool = False) -> list[Node]:
@@ -97,32 +144,84 @@ class NodeRegistry:
         out = sorted(self._nodes.values(), key=lambda n: n.id)
         return [n for n in out if n.online(now)] if online_only else out
 
+    # ---- circuit breaker ----
+
+    def state(self, n: Node, now: Optional[float] = None) -> str:
+        """closed (healthy) | open (tripped, backoff running) |
+        half_open (backoff elapsed; one healthy answer re-closes)."""
+        if n.consec_failures < self.breaker_fails:
+            return "closed"
+        if (now or time.monotonic()) < n.open_until:
+            return "open"
+        return "half_open"
+
+    def record_failure(self, n: Node, error: str = "") -> None:
+        n.consec_failures += 1
+        n.last_error = error
+        if n.consec_failures >= self.breaker_fails:
+            # trip (or re-trip from half-open): exponential backoff
+            n.backoff_s = min(self.breaker_cap_s,
+                              n.backoff_s * 2 if n.backoff_s
+                              else self.breaker_base_s)
+            n.open_until = time.monotonic() + n.backoff_s
+        self.update_state_gauge()
+
+    def record_success(self, n: Node) -> None:
+        n.consec_failures = 0
+        n.backoff_s = 0.0
+        n.open_until = 0.0
+        n.last_error = ""
+        self.update_state_gauge()
+
+    def update_state_gauge(self) -> None:
+        now = time.monotonic()
+        counts = {"closed": 0, "open": 0, "half_open": 0}
+        for n in self._nodes.values():
+            counts[self.state(n, now)] += 1
+        for st, c in counts.items():
+            tm.FEDERATION_NODE_STATE.labels(state=st).set(c)
+
     # ---- selection (ref: federated.go SelectLeastUsedServer :78,
     #      RandomServer :39) ----
 
-    def pick(self, strategy: str = "least-used") -> Optional[Node]:
-        online = self.nodes(online_only=True)
-        if not online:
+    def pick(self, strategy: str = "least-used",
+             exclude: frozenset = frozenset()) -> Optional[Node]:
+        """Route-eligible node, or None. Open-breaker nodes are never
+        picked; half-open nodes only when no closed node remains (the
+        active prober is the designated half-open probe — proxy traffic
+        prefers known-good nodes). `exclude` carries the ids already
+        tried by the current request's retry loop."""
+        now = time.monotonic()
+        online = [n for n in self.nodes(online_only=True)
+                  if n.id not in exclude]
+        closed = [n for n in online if self.state(n, now) == "closed"]
+        pool = closed or [n for n in online
+                          if self.state(n, now) == "half_open"]
+        if not pool:
             return None
         if strategy == "random":
             import random
 
-            return random.choice(online)
-        return min(online, key=lambda n: (n.in_flight, n.requests_served))
+            return random.choice(pool)
+        return min(pool, key=lambda n: (n.in_flight, n.requests_served))
 
 
 class FederatedServer:
     """HTTP front door balancing whole requests across member instances
     (ref: federated_server.go proxy loop — whole-connection forwarding,
-    least-used default)."""
+    least-used default), with connect-failure retry and per-node
+    circuit breaking (see module docstring)."""
 
     HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding",
                    "upgrade", "proxy-authorization", "te", "trailer"}
 
-    def __init__(self, token: str, *, strategy: str = "least-used") -> None:
+    def __init__(self, token: str, *, strategy: str = "least-used",
+                 probe_s: Optional[float] = None) -> None:
         self.registry = NodeRegistry(token)
         self.token = token
         self.strategy = strategy
+        self.probe_s = (float(os.environ.get("LOCALAI_FED_PROBE_S", "5"))
+                        if probe_s is None else probe_s)
 
     def build_app(self) -> web.Application:
         app = web.Application()
@@ -134,8 +233,40 @@ class FederatedServer:
 
     async def _client_ctx(self, app):
         self._client = ClientSession(timeout=ClientTimeout(total=600))
+        self._probe_task = (asyncio.get_event_loop().create_task(
+            self._probe_loop()) if self.probe_s > 0 else None)
         yield
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+            try:
+                await self._probe_task
+            except asyncio.CancelledError:
+                pass
         await self._client.close()
+
+    async def _probe_loop(self) -> None:
+        """Active health probing layered on the passive heartbeat: GET
+        each member's /healthz every probe_s seconds. Success counts as
+        liveness (refreshes last_seen AND closes a half-open breaker);
+        failure feeds the breaker, so a killed node is routed around in
+        seconds instead of the STALE_S heartbeat horizon."""
+        while True:
+            await asyncio.sleep(self.probe_s)
+            for node in self.registry.nodes():
+                try:
+                    async with self._client.get(
+                        node.address.rstrip("/") + "/healthz",
+                        timeout=ClientTimeout(total=2),
+                    ) as resp:
+                        if resp.status < 500:
+                            node.last_seen = time.monotonic()
+                            self.registry.record_success(node)
+                        else:
+                            self.registry.record_failure(
+                                node, f"healthz HTTP {resp.status}")
+                except (ClientError, asyncio.TimeoutError, OSError) as e:
+                    self.registry.record_failure(
+                        node, f"healthz probe: {e!r}")
 
     async def handle_register(self, request: web.Request) -> web.Response:
         body = await request.json()
@@ -148,19 +279,49 @@ class FederatedServer:
                                   "heartbeat_s": HEARTBEAT_S})
 
     async def handle_nodes(self, request: web.Request) -> web.Response:
+        now = time.monotonic()
         return web.json_response([
             {"id": n.id, "name": n.name, "address": n.address,
-             "online": n.online(), "in_flight": n.in_flight,
-             "requests_served": n.requests_served}
+             "online": n.online(now), "in_flight": n.in_flight,
+             "requests_served": n.requests_served,
+             "state": self.registry.state(n, now),
+             "consec_failures": n.consec_failures,
+             "breaker_open_for_s": round(max(0.0, n.open_until - now), 3),
+             "last_error": n.last_error}
             for n in self.registry.nodes()
         ])
 
     async def handle_proxy(self, request: web.Request) -> web.StreamResponse:
-        node = self.registry.pick(self.strategy)
-        if node is None:
-            raise web.HTTPServiceUnavailable(
-                reason="no federation nodes online")
+        # the body is buffered up front so a connect-failure retry can
+        # replay it against the next node
+        data = await request.read()
+        tried: set[str] = set()
+        while True:
+            node = self.registry.pick(self.strategy, exclude=tried)
+            if node is None:
+                if tried:
+                    tm.FEDERATION_RETRIES.labels(
+                        outcome="exhausted").inc()
+                    raise web.HTTPBadGateway(
+                        reason=f"all {len(tried)} eligible federation "
+                               "nodes failed")
+                raise web.HTTPServiceUnavailable(
+                    reason="no federation nodes online")
+            tried.add(node.id)
+            resp = await self._proxy_once(request, node, data,
+                                          rerouted=len(tried) > 1)
+            if resp is not None:
+                return resp
+            # connect failure before any bytes streamed: next node
+
+    async def _proxy_once(self, request: web.Request, node: Node,
+                          data: bytes,
+                          rerouted: bool) -> Optional[web.StreamResponse]:
+        """Proxy one attempt to `node`. Returns the (completed)
+        response, or None when the upstream failed before the response
+        was prepared — the only case a retry is safe."""
         node.in_flight += 1
+        resp: Optional[web.StreamResponse] = None
         try:
             url = node.address.rstrip("/") + "/" + request.match_info["tail"]
             if request.query_string:
@@ -168,7 +329,9 @@ class FederatedServer:
             headers = {k: v for k, v in request.headers.items()
                        if k.lower() not in self.HOP_HEADERS
                        and k.lower() != "host"}
-            data = await request.read()
+            if faultinject.ACTIVE:
+                # chaos surface: connect-failure path (no bytes sent)
+                faultinject.fire("federated.upstream")
             async with self._client.request(
                 request.method, url, headers=headers,
                 data=data or None, allow_redirects=False,
@@ -179,18 +342,49 @@ class FederatedServer:
                         resp.headers[k] = v
                 await resp.prepare(request)
                 async for chunk in upstream.content.iter_chunked(1 << 16):
+                    if faultinject.ACTIVE:
+                        # chaos surface: upstream dies mid-stream
+                        faultinject.fire("federated.midstream")
                     await resp.write(chunk)
                 await resp.write_eof()
+                node.requests_served += 1
+                self.registry.record_success(node)
+                if rerouted:
+                    tm.FEDERATION_RETRIES.labels(outcome="rerouted").inc()
                 return resp
+        except (ClientError, asyncio.TimeoutError,
+                faultinject.InjectedFault) as e:
+            self.registry.record_failure(node, repr(e))
+            if resp is None or not resp.prepared:
+                return None  # no bytes streamed; caller retries
+            # bytes already went out: the stream cannot move to another
+            # node, so end it CLEANLY — SSE clients get a terminal
+            # error event instead of a silent truncation
+            tm.FEDERATION_RETRIES.labels(outcome="midstream").inc()
+            ctype = resp.headers.get("Content-Type", "")
+            try:
+                if "text/event-stream" in ctype:
+                    frame = json.dumps({"error": {
+                        "message": f"upstream node '{node.name}' failed "
+                                   f"mid-stream: {e!r}",
+                        "type": "upstream_error"}})
+                    await resp.write(f"data: {frame}\n\n".encode())
+                    await resp.write_eof()
+                else:
+                    await resp.write_eof()
+            except (ConnectionResetError, ClientError, OSError):
+                # client went away while we delivered the obituary —
+                # nothing left to notify
+                tm.RECOVERED_ERRORS.labels(
+                    site="federated.midstream_notify").inc()
+            return resp
         finally:
             node.in_flight -= 1
-            node.requests_served += 1
 
 
 async def announce_forever(balancer_url: str, token: str, node_id: str,
                            name: str, address: str) -> None:
     """Worker-side heartbeat loop (ref: ExposeService announce ticker)."""
-    import asyncio
     import logging
 
     log = logging.getLogger(__name__)

@@ -42,6 +42,8 @@ from typing import Any, Optional, Tuple
 
 import numpy as np
 
+from ..utils import faultinject
+
 log = logging.getLogger(__name__)
 
 Record = Tuple[str, Any]
@@ -147,6 +149,11 @@ class LocalChannel:
         return end
 
     def publish(self, kind: str, payload: Any) -> None:
+        if faultinject.ACTIVE:
+            # chaos surface: a fault here models the cross-host
+            # broadcast dying mid-dispatch; it surfaces inside _run's
+            # critical section exactly like a transport error
+            faultinject.fire("multihost.publish")
         # the test transport enforces the codec whitelist on every
         # record, so a payload field the follower codec doesn't know
         # fails the suite at publish time (the broadcast transport
@@ -194,6 +201,8 @@ class JaxBroadcastChannel:
                 "collective publish from inside an async follower load — "
                 "loads must stay collective-free (FollowerRouter "
                 "invariant)")
+        if faultinject.ACTIVE:
+            faultinject.fire("multihost.publish")
         hdr, buf = encode_record(kind, payload)
         with self.order_lock:
             self._mh.broadcast_one_to_all(hdr)

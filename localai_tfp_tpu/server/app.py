@@ -228,7 +228,16 @@ def build_app(state: Application) -> web.Application:
     async def on_cleanup(app_):
         task = app_.get("announce_task")
         if task is not None:
+            import asyncio
+
             task.cancel()
+            try:
+                # await the cancellation so shutdown cannot race an
+                # in-flight announce (a "Task was destroyed but it is
+                # pending" warning at every federated-node exit)
+                await task
+            except asyncio.CancelledError:
+                pass
         state.shutdown()
 
     app.on_startup.append(on_startup)

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import queue as _queue
 import time
 import uuid
 from typing import Any, Optional
@@ -30,7 +31,7 @@ from ..workers.base import Backend, PredictOptions, Reply
 from . import schema
 from .common import WORKER_POOL, run_blocking
 from .state import Application
-from .stream_bridge import BRIDGE
+from .stream_bridge import BRIDGE, _to_replies
 
 
 def register(app: web.Application) -> None:
@@ -60,6 +61,15 @@ async def _body(request: web.Request) -> dict:
         raise web.HTTPBadRequest(reason="invalid JSON body")
     if not isinstance(data, dict):
         raise web.HTTPBadRequest(reason="body must be a JSON object")
+    # X-Request-Timeout header: the no-body-change way to set a
+    # per-request deadline budget; the body's `timeout` field wins
+    hdr = request.headers.get("X-Request-Timeout")
+    if hdr and data.get("timeout") is None:
+        try:
+            data["timeout"] = float(hdr)
+        except ValueError:
+            raise web.HTTPBadRequest(
+                reason="X-Request-Timeout must be a number of seconds")
     return data
 
 
@@ -206,7 +216,55 @@ def _predict_options(cfg: ModelConfig, body: dict, prompt: str,
         grammar=body.get("grammar", "") or cfg.grammar or "",
         logit_bias=logit_bias,
         correlation_id=correlation_id,
+        timeout_s=max(0.0, float(pick("timeout", 0.0) or 0.0)),
     )
+
+
+def _raise_if_refused(reply: Reply) -> None:
+    """Engine refusal terminals carry their own HTTP shape, checked
+    BEFORE the generic error->500 mapping: a shed request is
+    backpressure, not breakage (429 + Retry-After from the engine's
+    live queue-wait sample); a request whose deadline expired before it
+    produced anything is a 504. A decode-stage deadline with partial
+    text falls through — the partial completion returns 200 with
+    finish_reason "deadline_exceeded"."""
+    if reply.finish_reason == "shed":
+        raise web.HTTPTooManyRequests(
+            reason=reply.error or "server overloaded",
+            headers={"Retry-After":
+                     str(max(1, round(reply.retry_after_s or 1.0)))})
+    if reply.finish_reason == "deadline_exceeded" and not reply.message:
+        raise web.HTTPGatewayTimeout(
+            reason=reply.error or "request deadline exceeded")
+
+
+def _bounded_admission(backend: Backend) -> bool:
+    """True when the backend's engine runs a bounded admission queue
+    (LOCALAI_MAX_QUEUE) — the gate for the eager-submit streaming path
+    that turns a shed into a real pre-stream 429."""
+    eng = getattr(backend, "engine", None)
+    return eng is not None and getattr(eng, "max_queue", 0) > 0
+
+
+def _probe_refusal(sq) -> tuple[Optional[Reply], list]:
+    """Non-blocking peek at an engine queue right after submit: a
+    bounded-queue shed lands its terminal event synchronously inside
+    submit, so it is already here. Returns (refusal_reply, prefetched
+    replies to forward in order — None marks stream end)."""
+    try:
+        ev = sq.get_nowait()
+    except _queue.Empty:
+        return None, []
+    rep, final = _to_replies(ev)
+    if (final and rep is not None and not rep.message
+            and rep.finish_reason in ("shed", "deadline_exceeded")):
+        return rep, []
+    items: list = []
+    if rep is not None:
+        items.append(rep)
+    if final:
+        items.append(None)
+    return None, items
 
 
 def _usage(reply: Reply, extra_usage: bool) -> dict:
@@ -431,6 +489,7 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         total = Reply()
         ft_kw = _finetune_kw(cfg, opts.prompt)
         for i, reply in enumerate(replies):
+            _raise_if_refused(reply)
             if reply.error:
                 raise web.HTTPInternalServerError(reason=reply.error)
             if ft_kw is not None:  # before function parsing, like
@@ -489,6 +548,44 @@ async def _stream_chat(
     producer thread then does the template/merge work off the event
     loop (a template failure surfaces as a stream error event — headers
     are already sent by then)."""
+    loop = asyncio.get_running_loop()
+    q: asyncio.Queue = asyncio.Queue()
+    rid = uuid.uuid4().hex
+    # open the request's lifecycle trace before the producer can submit:
+    # receive/auth milestones from the middlewares, engine milestones
+    # (queue/admit/.../done) appended by the scheduler under this id
+    TRACER.start(rid, model=cfg.name,
+                 correlation_id=request.get("correlation_id", ""),
+                 events=_trace_seed(request))
+    prompt_box: dict[str, str] = {}  # templated prompt, set by the
+    # producer BEFORE submit — stream events (and thus any finetune echo
+    # use of it) can only arrive after
+
+    submitted = False
+    if _bounded_admission(backend):
+        # bounded admission: submit BEFORE the SSE headers go out, so a
+        # shed (or raced queued-deadline expiry) surfaces as a real
+        # 429/504 instead of a 200 + error frame. Only the
+        # LOCALAI_MAX_QUEUE-armed path pays the await here — unbounded
+        # serving keeps the fire-and-forget producer below
+
+        def eager_submit():
+            opts = opts_src() if callable(opts_src) else opts_src
+            opts.request_id = opts.request_id or rid
+            prompt_box["prompt"] = opts.prompt
+            return backend.stream_queue(opts)
+
+        sq = await loop.run_in_executor(WORKER_POOL, eager_submit)
+        if sq is not None:
+            refusal, pre = _probe_refusal(sq)
+            if refusal is not None:
+                _raise_if_refused(refusal)
+            for it in pre:
+                q.put_nowait(it)
+            if not pre or pre[-1] is not None:
+                BRIDGE.register(sq, loop, q, rid)
+            submitted = True
+
     resp = web.StreamResponse(headers={
         "Content-Type": "text/event-stream",
         "Cache-Control": "no-cache",
@@ -513,19 +610,6 @@ async def _stream_chat(
 
     await resp.write(chunk({"role": "assistant", "content": ""}))
 
-    loop = asyncio.get_running_loop()
-    q: asyncio.Queue = asyncio.Queue()
-    rid = uuid.uuid4().hex
-    # open the request's lifecycle trace before the producer can submit:
-    # receive/auth milestones from the middlewares, engine milestones
-    # (queue/admit/.../done) appended by the scheduler under this id
-    TRACER.start(rid, model=cfg.name,
-                 correlation_id=request.get("correlation_id", ""),
-                 events=_trace_seed(request))
-    prompt_box: dict[str, str] = {}  # templated prompt, set by the
-    # producer BEFORE submit — stream events (and thus any finetune echo
-    # use of it) can only arrive after
-
     def producer() -> None:
         try:
             opts = opts_src() if callable(opts_src) else opts_src
@@ -546,7 +630,8 @@ async def _stream_chat(
             )
         loop.call_soon_threadsafe(q.put_nowait, None)
 
-    loop.run_in_executor(WORKER_POOL, producer)
+    if not submitted:
+        loop.run_in_executor(WORKER_POOL, producer)
 
     buffered = ""
     final: Optional[Reply] = None
@@ -680,6 +765,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
         choices = []
         total = Reply()
         for i, ((prompt, o), reply) in enumerate(zip(jobs, replies)):
+            _raise_if_refused(reply)
             if reply.error:
                 raise web.HTTPInternalServerError(reason=reply.error)
             text = reply.message
@@ -712,17 +798,34 @@ async def completions(request: web.Request) -> web.StreamResponse:
 
 async def _stream_completion(request, backend, opts, cfg, cid, created,
                              extra_usage) -> web.StreamResponse:
-    resp = web.StreamResponse(headers={
-        "Content-Type": "text/event-stream",
-        "Cache-Control": "no-cache",
-    })
-    await resp.prepare(request)
     loop = asyncio.get_running_loop()
     q: asyncio.Queue = asyncio.Queue()
     opts.request_id = opts.request_id or uuid.uuid4().hex
     TRACER.start(opts.request_id, model=cfg.name,
                  correlation_id=request.get("correlation_id", ""),
                  events=_trace_seed(request))
+
+    submitted = False
+    if _bounded_admission(backend):
+        # bounded admission: submit pre-headers so a shed is a real
+        # 429 + Retry-After (see _stream_chat)
+        sq = await loop.run_in_executor(
+            WORKER_POOL, backend.stream_queue, opts)
+        if sq is not None:
+            refusal, pre = _probe_refusal(sq)
+            if refusal is not None:
+                _raise_if_refused(refusal)
+            for it in pre:
+                q.put_nowait(it)
+            if not pre or pre[-1] is not None:
+                BRIDGE.register(sq, loop, q, opts.request_id)
+            submitted = True
+
+    resp = web.StreamResponse(headers={
+        "Content-Type": "text/event-stream",
+        "Cache-Control": "no-cache",
+    })
+    await resp.prepare(request)
 
     def producer() -> None:
         try:
@@ -738,7 +841,8 @@ async def _stream_completion(request, backend, opts, cfg, cid, created,
             )
         loop.call_soon_threadsafe(q.put_nowait, None)
 
-    loop.run_in_executor(WORKER_POOL, producer)
+    if not submitted:
+        loop.run_in_executor(WORKER_POOL, producer)
     final = None
     done = False
     ft_kw = _finetune_kw(cfg, opts.prompt)
@@ -819,6 +923,7 @@ async def edits(request: web.Request) -> web.Response:
         opts = _predict_options(cfg, body, prompt,
                                 request.get("correlation_id", ""))
         reply = await _run_predict(backend, opts)
+        _raise_if_refused(reply)
         if reply.error:
             raise web.HTTPInternalServerError(reason=reply.error)
         text = reply.message

@@ -70,6 +70,11 @@ def _check_sampling(body: dict) -> None:
         _num(body, name)
     for name in _SAMPLING_INT:
         _int(body, name)
+    # per-request deadline budget in seconds (LocalAI body field; the
+    # X-Request-Timeout header is the no-body-change alternative)
+    t = _num(body, "timeout")
+    if t is not None and t < 0:
+        _bad("timeout", "a non-negative number of seconds")
     stop = body.get("stop")
     if stop is not None and not isinstance(stop, (str, list)):
         _bad("stop", "a string or list of strings")

@@ -51,6 +51,7 @@ def _to_replies(ev) -> tuple[Optional[Reply], bool]:
             timing_first_token=ev.timing_first_token_ms,
             finish_reason=ev.finish_reason,
             error=ev.error,
+            retry_after_s=ev.retry_after_s,
         ), True
     if ev.text:
         return Reply(message=ev.text, token_id=ev.token_id), False

@@ -91,8 +91,10 @@ ENGINE_REQUESTS = REGISTRY.counter(
 )
 ENGINE_CANCELLATIONS = REGISTRY.counter(
     "engine_cancellations_total",
-    "Requests cancelled while queued or in flight",
-    labels=("model",),
+    "Cancellation records by outcome (client = a request was cancelled "
+    "while queued or in flight, expired = a race-ahead cancel id aged "
+    "out of the pending-cancel set without ever matching a request)",
+    labels=("model", "reason"),
 )
 ENGINE_PREEMPTIONS = REGISTRY.counter(
     "engine_preemptions_total",
@@ -204,6 +206,42 @@ ENGINE_RAGGED_ROWS = REGISTRY.counter(
     "rows, final = final prompt chunk rows, verify = spec-decode "
     "verify rows)",
     labels=("model", "kind"),
+)
+
+# ------------------------------------------------------------ resilience
+
+ENGINE_REQUESTS_SHED = REGISTRY.counter(
+    "engine_requests_shed_total",
+    "Requests refused at admission by the bounded queue "
+    "(queue_full = LOCALAI_MAX_QUEUE exceeded at submit)",
+    labels=("model", "reason"),
+)
+ENGINE_DEADLINE_EXCEEDED = REGISTRY.counter(
+    "engine_deadline_exceeded_total",
+    "Requests terminated by their deadline, by the stage they were in "
+    "when it expired (queued = still in _pending, decode = already "
+    "holding a slot)",
+    labels=("model", "stage"),
+)
+FEDERATION_NODE_STATE = REGISTRY.gauge(
+    "federation_node_state_count",
+    "Registered federation nodes by circuit-breaker state "
+    "(closed/open/half_open)",
+    labels=("state",),
+)
+FEDERATION_RETRIES = REGISTRY.counter(
+    "federation_retries_total",
+    "Federated proxy connect-failure retries by outcome (rerouted = a "
+    "later node accepted the request, exhausted = every eligible node "
+    "failed before any bytes streamed, midstream = upstream died after "
+    "bytes streamed so no retry was possible)",
+    labels=("outcome",),
+)
+FAULTS_INJECTED = REGISTRY.counter(
+    "faults_injected_total",
+    "Faults actually delivered by armed LOCALAI_FAULTS injection points "
+    "(utils/faultinject.py) — zero outside chaos runs",
+    labels=("point",),
 )
 
 # ---------------------------------------------------------------- loader

@@ -63,6 +63,10 @@ class PredictOptions:
     request_id: str = ""  # caller-chosen id enabling cancel() on
     # client disconnect (ref: llama.cpp task cancel)
     use_tokenizer_template: bool = False
+    # per-request deadline budget in seconds (0 = engine default,
+    # LOCALAI_REQUEST_DEADLINE_S; the engine enforces it while queued
+    # and while decoding)
+    timeout_s: float = 0.0
 
 
 @dataclass
@@ -82,6 +86,9 @@ class Reply:
     timing_first_token: float = 0.0
     finish_reason: str = ""
     error: str = ""
+    # load-shed backoff hint (seconds); >0 only on finish_reason=
+    # "shed" replies — the HTTP layer turns it into 429 + Retry-After
+    retry_after_s: float = 0.0
 
 
 @dataclass

@@ -585,6 +585,7 @@ class JaxLLMBackend(Backend):
             prompt_cache_all=opts.prompt_cache_all,
             prompt_cache_ro=opts.prompt_cache_ro,
             correlation_id=opts.correlation_id,
+            timeout_s=max(0.0, opts.timeout_s),
             soft_embeds=soft_embeds,
             soft_positions=soft_positions,
             **({"id": opts.request_id} if opts.request_id else {}),
@@ -779,4 +780,5 @@ def _final_reply(ev: StreamEvent) -> Reply:
         timing_first_token=ev.timing_first_token_ms,
         finish_reason=ev.finish_reason,
         error=ev.error,
+        retry_after_s=ev.retry_after_s,
     )

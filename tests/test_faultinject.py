@@ -1,0 +1,113 @@
+"""Fault-injection subsystem unit tests (utils/faultinject.py): spec
+grammar, deterministic decisions, arm/disarm lifecycle, and the
+disarmed fast gate the hot paths rely on."""
+
+import time
+
+import pytest
+
+from localai_tfp_tpu.utils import faultinject as fi
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    fi.disarm()
+    yield
+    fi.disarm()
+
+
+def test_disarmed_is_default_and_free():
+    assert fi.ACTIVE is False
+    # fire() on a disarmed registry is a no-op, not an error
+    fi.fire("engine.device_step")
+
+
+def test_fail_spec_fails_every_arrival():
+    fi.arm("p:fail")
+    assert fi.ACTIVE is True
+    for _ in range(3):
+        with pytest.raises(fi.InjectedFault):
+            fi.fire("p")
+    assert fi.counts()["p"] == (3, 3)
+    # other points stay clean
+    fi.fire("unarmed.point")
+
+
+def test_fail_nth_fires_exactly_once():
+    fi.arm("p:fail@3")
+    fi.fire("p")
+    fi.fire("p")
+    with pytest.raises(fi.InjectedFault):
+        fi.fire("p")
+    fi.fire("p")  # past the Nth: clean again
+    assert fi.counts()["p"] == (4, 1)
+
+
+def test_failafter_fires_from_n_plus_one():
+    fi.arm("p:failafter@2")
+    fi.fire("p")
+    fi.fire("p")
+    for _ in range(3):
+        with pytest.raises(fi.InjectedFault):
+            fi.fire("p")
+    assert fi.counts()["p"] == (5, 3)
+
+
+def test_rate_is_deterministic_and_seeded():
+    def decisions(spec, n=64):
+        fi.arm(f"p:{spec}")
+        out = []
+        for _ in range(n):
+            try:
+                fi.fire("p")
+                out.append(False)
+            except fi.InjectedFault:
+                out.append(True)
+        return out
+
+    a = decisions("rate@0.5")
+    b = decisions("rate@0.5")
+    assert a == b  # same (point, seed, arrival#) -> same decision
+    assert any(a) and not all(a)  # roughly half, definitely mixed
+    c = decisions("rate@0.5@7")
+    assert c != a  # a different seed reshuffles the pattern
+    assert decisions("rate@0.0") == [False] * 64
+    assert decisions("rate@1.0") == [True] * 64
+
+
+def test_rate_out_of_range_rejected():
+    with pytest.raises(ValueError):
+        fi.arm("p:rate@1.5")
+
+
+def test_delay_sleeps_without_raising():
+    fi.arm("p:delay@30")
+    t0 = time.perf_counter()
+    fi.fire("p")
+    assert time.perf_counter() - t0 >= 0.025
+    assert fi.counts()["p"] == (1, 1)
+
+
+def test_bad_specs_rejected():
+    for bad in ("p:explode", "p:fail@x", "no-colon", "p:rate"):
+        with pytest.raises(ValueError):
+            fi.arm(bad)
+
+
+def test_arm_replaces_wholesale_and_disarm_clears():
+    fi.arm("a:fail,b:delay@1")
+    assert set(fi.counts()) == {"a", "b"}
+    fi.arm("c:fail")
+    assert set(fi.counts()) == {"c"}  # a/b gone, counters restarted
+    fi.disarm()
+    assert fi.ACTIVE is False and fi.counts() == {}
+
+
+def test_injected_faults_counted_in_metrics():
+    from localai_tfp_tpu.telemetry.metrics import FAULTS_INJECTED
+
+    before = FAULTS_INJECTED.labels(point="metric.probe").value
+    fi.arm("metric.probe:fail")
+    with pytest.raises(fi.InjectedFault):
+        fi.fire("metric.probe")
+    assert FAULTS_INJECTED.labels(point="metric.probe").value == before + 1

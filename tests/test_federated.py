@@ -144,3 +144,266 @@ def test_app_config_from_cli_args():
     assert cfg.mesh_shape == {"data": 2, "model": 4}
     assert cfg.single_active_backend
     assert cfg.galleries[0]["name"] == "g"
+
+
+# ---------------------------------------------------------------------------
+# resilience: circuit breaker, announce refresh, retry + failover, chaos
+
+
+from localai_tfp_tpu.telemetry import metrics as tm
+from localai_tfp_tpu.utils import faultinject as fi
+
+
+@pytest.fixture(autouse=True)
+def _faults_disarmed():
+    fi.disarm()
+    yield
+    fi.disarm()
+
+
+def test_announce_refreshes_name_address_and_liveness():
+    """Satellite fix: every announce is a full refresh — a node that
+    restarts with a new address (and name) must not keep serving stale
+    routing data, and last_seen must advance every heartbeat."""
+    tok = generate_token()
+    reg = NodeRegistry(tok)
+    reg.announce(tok, "n1", "old-name", "http://old:1")
+    reg._nodes["n1"].last_seen -= 50
+    stale = reg._nodes["n1"].last_seen
+    assert reg.announce(tok, "n1", "new-name", "http://new:2")
+    n = reg._nodes["n1"]
+    assert n.name == "new-name"
+    assert n.address == "http://new:2"
+    assert n.last_seen > stale + 40
+
+
+def test_breaker_trips_backs_off_and_recovers():
+    tok = generate_token()
+    reg = NodeRegistry(tok)
+    reg.breaker_fails, reg.breaker_base_s, reg.breaker_cap_s = 3, 1.0, 4.0
+    reg.announce(tok, "n1", "n1", "http://a")
+    n = reg._nodes["n1"]
+    reg.record_failure(n, "boom 1")
+    reg.record_failure(n, "boom 2")
+    assert reg.state(n) == "closed"  # under the threshold
+    reg.record_failure(n, "boom 3")
+    assert reg.state(n) == "open"
+    assert n.backoff_s == 1.0 and n.last_error == "boom 3"
+    # backoff elapsed -> half-open; further failures double up to cap
+    n.open_until = time.monotonic() - 0.01
+    assert reg.state(n) == "half_open"
+    for want in (2.0, 4.0, 4.0):
+        reg.record_failure(n, "again")
+        assert n.backoff_s == want  # doubles, then clamps at the cap
+        assert reg.state(n) == "open"
+    # one healthy answer fully resets the breaker record
+    reg.record_success(n)
+    assert reg.state(n) == "closed"
+    assert n.consec_failures == 0 and n.backoff_s == 0.0
+    assert n.open_until == 0.0 and n.last_error == ""
+
+
+def test_pick_skips_open_breakers_prefers_closed():
+    tok = generate_token()
+    reg = NodeRegistry(tok)
+    reg.breaker_fails = 1
+    reg.announce(tok, "a", "a", "http://a")
+    reg.announce(tok, "b", "b", "http://b")
+    reg.record_failure(reg._nodes["a"], "down")
+    for _ in range(8):
+        assert reg.pick("least-used").id == "b"  # open node never picked
+        assert reg.pick("random").id == "b"
+    # exclude (the retry loop's tried-set) removes the last candidate
+    assert reg.pick("least-used", exclude=frozenset({"b"})) is None
+    # every breaker open -> only a half-open node is route-eligible
+    reg.record_failure(reg._nodes["b"], "down")
+    assert reg.pick() is None
+    reg._nodes["b"].open_until = time.monotonic() - 0.01
+    assert reg.pick().id == "b"
+
+
+def _counter(family, **labels):
+    return family.labels(**labels).value
+
+
+def test_connect_failure_retries_next_node_and_exhausts():
+    """A dead upstream (connect refused — no bytes streamed) is retried
+    onto the next eligible node transparently; when every node fails
+    the client gets one clean 502."""
+    loop = asyncio.new_event_loop()
+
+    async def go():
+        hits = {"n": 0}
+
+        async def handler(request):
+            hits["n"] += 1
+            return web.json_response({"ok": True})
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", handler)
+        live = TestServer(app)
+        await live.start_server()
+
+        tok = generate_token()
+        fed = FederatedServer(tok, probe_s=0)
+        client = TestClient(TestServer(fed.build_app()))
+        await client.start_server()
+
+        # id "a-dead" sorts first: least-used tries the dead node first
+        for nid, addr in (("a-dead", "http://127.0.0.1:9"),
+                          ("b-live", f"http://127.0.0.1:{live.port}")):
+            r = await client.post("/federation/register", json={
+                "token": tok, "id": nid, "name": nid, "address": addr})
+            assert r.status == 200
+
+        rerouted0 = _counter(tm.FEDERATION_RETRIES, outcome="rerouted")
+        r = await client.post("/v1/models", data=b"x")
+        assert r.status == 200 and hits["n"] == 1
+        assert _counter(tm.FEDERATION_RETRIES,
+                        outcome="rerouted") == rerouted0 + 1
+
+        dead = fed.registry._nodes["a-dead"]
+        livn = fed.registry._nodes["b-live"]
+        # satellite: failed proxies are NOT counted as served
+        assert dead.requests_served == 0 and dead.consec_failures == 1
+        assert livn.requests_served == 1 and livn.consec_failures == 0
+        r = await client.get("/federation/nodes")
+        entries = {e["id"]: e for e in await r.json()}
+        assert entries["a-dead"]["last_error"]
+        assert entries["b-live"]["state"] == "closed"
+
+        # kill the live node too: retries exhaust into a single 502
+        await live.close()
+        exhausted0 = _counter(tm.FEDERATION_RETRIES, outcome="exhausted")
+        r = await client.post("/v1/models", data=b"x")
+        assert r.status == 502
+        assert _counter(tm.FEDERATION_RETRIES,
+                        outcome="exhausted") == exhausted0 + 1
+
+        await client.close()
+
+    loop.run_until_complete(go())
+    loop.close()
+
+
+def test_midstream_death_sends_sse_obituary_and_marks_node_down():
+    """Satellite: an upstream dying MID-stream cannot be retried — the
+    client must get a well-formed terminal SSE error frame, the node is
+    marked down, and the NEXT request routes to the healthy node."""
+    loop = asyncio.new_event_loop()
+
+    async def go():
+        served_by = []
+
+        def member(name):
+            async def handler(request):
+                served_by.append(name)
+                resp = web.StreamResponse()
+                resp.headers["Content-Type"] = "text/event-stream"
+                await resp.prepare(request)
+                for i in range(4):
+                    await resp.write(
+                        f"data: {{\"tok\": {i}}}\n\n".encode())
+                    await asyncio.sleep(0.02)
+                await resp.write_eof()
+                return resp
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", handler)
+            return app
+
+        m1 = TestServer(member("m-a"))
+        m2 = TestServer(member("m-b"))
+        await m1.start_server()
+        await m2.start_server()
+
+        tok = generate_token()
+        fed = FederatedServer(tok, probe_s=0)
+        fed.registry.breaker_fails = 1  # one mid-stream death trips
+        client = TestClient(TestServer(fed.build_app()))
+        await client.start_server()
+        for nid, m in (("m-a", m1), ("m-b", m2)):
+            r = await client.post("/federation/register", json={
+                "token": tok, "id": nid, "name": nid,
+                "address": f"http://127.0.0.1:{m.port}"})
+            assert r.status == 200
+
+        # first chunk streams clean, the second dies inside the proxy
+        fi.arm("federated.midstream:fail@2")
+        mid0 = _counter(tm.FEDERATION_RETRIES, outcome="midstream")
+        r = await client.post("/v1/chat/completions", data=b"x")
+        assert r.status == 200  # headers were already out
+        body = (await r.read()).decode()
+        frames = [f for f in body.split("\n\n") if f.strip()]
+        # stream ends with ONE well-formed terminal error event
+        last = json.loads(frames[-1].removeprefix("data: "))
+        assert last["error"]["type"] == "upstream_error"
+        assert "mid-stream" in last["error"]["message"]
+        assert _counter(tm.FEDERATION_RETRIES,
+                        outcome="midstream") == mid0 + 1
+        fi.disarm()
+
+        # the dead node is tripped; the next request routes around it
+        assert served_by == ["m-a"]
+        assert fed.registry.state(fed.registry._nodes["m-a"]) == "open"
+        r = await client.post("/v1/chat/completions", data=b"x")
+        assert r.status == 200
+        assert (await r.read()).count(b"data:") == 4  # full clean stream
+        assert served_by == ["m-a", "m-b"]
+
+        await client.close()
+        await m1.close()
+        await m2.close()
+
+    loop.run_until_complete(go())
+    loop.close()
+
+
+def test_active_probe_marks_killed_node_down_within_2s():
+    """Failover-latency contract: with active probing a killed member is
+    routed around well inside 2 s — not at the STALE_S=60 horizon."""
+    loop = asyncio.new_event_loop()
+
+    async def go():
+        async def handler(request):
+            return web.json_response({"ok": True})
+
+        def app_():
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", handler)
+            return app
+
+        doomed = TestServer(app_())
+        healthy = TestServer(app_())
+        await doomed.start_server()
+        await healthy.start_server()
+
+        tok = generate_token()
+        fed = FederatedServer(tok, probe_s=0.1)
+        client = TestClient(TestServer(fed.build_app()))
+        await client.start_server()
+        for nid, m in (("a-doomed", doomed), ("b-healthy", healthy)):
+            r = await client.post("/federation/register", json={
+                "token": tok, "id": nid, "name": nid,
+                "address": f"http://127.0.0.1:{m.port}"})
+            assert r.status == 200
+
+        t0 = time.monotonic()
+        await doomed.close()  # kill the node; no heartbeat will notice
+        node = fed.registry._nodes["a-doomed"]
+        while (fed.registry.state(node) != "open"
+               and time.monotonic() - t0 < 2.0):
+            await asyncio.sleep(0.05)
+        took = time.monotonic() - t0
+        assert fed.registry.state(node) == "open", (
+            f"node not marked down after {took:.2f}s")
+        assert took < 2.0
+        # proxy traffic flows around the corpse without retry latency
+        r = await client.post("/v1/models", data=b"x")
+        assert r.status == 200
+        assert fed.registry._nodes["b-healthy"].requests_served == 1
+
+        await client.close()
+        await healthy.close()
+
+    loop.run_until_complete(go())
+    loop.close()

@@ -592,3 +592,142 @@ def test_cookie_percent_decoded_before_compare(workdir):
     finally:
         loop.run_until_complete(tc.close())
         loop.close()
+
+
+# ---------------------------------------------------------------------------
+# robustness: deadlines (timeout field / header) + bounded-admission 429
+
+
+def _tiny_engine(client):
+    state = client._client.app["state"]
+    return state.model_loader.get("tiny").backend.engine
+
+
+def test_timeout_field_validation(client):
+    r = client.post("/v1/completions", json={
+        "model": "tiny", "prompt": "x", "max_tokens": 2, "timeout": -1})
+    assert r.status == 400 and "timeout" in r.text
+    r = client.post("/v1/completions", json={
+        "model": "tiny", "prompt": "x", "max_tokens": 2, "timeout": "5"})
+    assert r.status == 400
+    # bad header parse is a clean 400, not a 500
+    r = client.post("/v1/completions",
+                    json={"model": "tiny", "prompt": "x", "max_tokens": 2},
+                    headers={"X-Request-Timeout": "soon"})
+    assert r.status == 400
+
+
+def test_expired_deadline_maps_to_504(client):
+    """A request whose budget expires while QUEUED produced no tokens:
+    the client gets 504, not a 200 with an empty choice."""
+    # ensure the model is loaded so the engine path (not the loader)
+    # consumes the budget
+    r = client.post("/v1/completions", json={
+        "model": "tiny", "prompt": "warm", "max_tokens": 1,
+        "ignore_eos": True})
+    assert r.status == 200
+    r = client.post("/v1/completions", json={
+        "model": "tiny", "prompt": "late", "max_tokens": 4,
+        "ignore_eos": True, "timeout": 1e-6})
+    assert r.status == 504
+    # the header spelling arms the same budget (body field wins if both)
+    r = client.post("/v1/chat/completions",
+                    json={"model": "tiny", "max_tokens": 4,
+                          "ignore_eos": True,
+                          "messages": [{"role": "user", "content": "hi"}]},
+                    headers={"X-Request-Timeout": "0.000001"})
+    assert r.status == 504
+    # a sane budget serves normally
+    r = client.post("/v1/completions", json={
+        "model": "tiny", "prompt": "fine", "max_tokens": 2,
+        "ignore_eos": True, "timeout": 30})
+    assert r.status == 200
+    assert r.json["choices"][0]["finish_reason"] == "length"
+
+
+def test_queue_flood_sheds_429_with_retry_after(client):
+    """Bounded admission through the stock endpoint: a burst beyond
+    LOCALAI_MAX_QUEUE gets immediate 429s carrying Retry-After while
+    admitted requests complete; knob reset restores full admission."""
+    from localai_tfp_tpu.utils import faultinject as fi
+
+    # warm/load first so the engine exists
+    r = client.post("/v1/completions", json={
+        "model": "tiny", "prompt": "warm", "max_tokens": 1,
+        "ignore_eos": True})
+    assert r.status == 200
+    eng = _tiny_engine(client)
+    tc = client._client
+
+    async def burst(n):
+        async def one(i):
+            r = await tc.post("/v1/completions", json={
+                "model": "tiny", "prompt": f"burst {i}", "max_tokens": 3,
+                "ignore_eos": True})
+            body = await r.read()
+            return r.status, r.headers, body
+
+        return await asyncio.gather(*[one(i) for i in range(n)])
+
+    eng.max_queue = 1
+    fi.arm("engine.device_step:delay@150")  # hold dispatches so the
+    # burst lands while the queue is occupied
+    try:
+        results = client._loop.run_until_complete(burst(8))
+    finally:
+        fi.disarm()
+        eng.max_queue = 0
+    statuses = [s for s, _, _ in results]
+    assert set(statuses) <= {200, 429}
+    assert statuses.count(429) >= 1, statuses
+    for status, headers, body in results:
+        if status == 429:
+            assert float(headers["Retry-After"]) >= 1
+            assert b"queue full" in body
+        else:
+            out = json.loads(body)
+            assert out["choices"][0]["finish_reason"] == "length"
+    # knob restored: the same burst is fully admitted
+    statuses = [s for s, _, _ in
+                client._loop.run_until_complete(burst(8))]
+    assert statuses == [200] * 8
+
+
+def test_streaming_shed_is_429_before_headers(client):
+    """The eager-submit probe turns a shed into a real 429 BEFORE the
+    SSE headers go out — not a 200 that dies mid-stream."""
+    from localai_tfp_tpu.utils import faultinject as fi
+
+    r = client.post("/v1/completions", json={
+        "model": "tiny", "prompt": "warm", "max_tokens": 1,
+        "ignore_eos": True})
+    assert r.status == 200
+    eng = _tiny_engine(client)
+    tc = client._client
+
+    async def burst(n):
+        async def one(i):
+            r = await tc.post("/v1/chat/completions", json={
+                "model": "tiny", "stream": True, "max_tokens": 3,
+                "ignore_eos": True,
+                "messages": [{"role": "user", "content": f"s{i}"}]})
+            body = await r.read()
+            return r.status, r.headers.get("Content-Type", ""), body
+
+        return await asyncio.gather(*[one(i) for i in range(8)])
+
+    eng.max_queue = 1
+    fi.arm("engine.device_step:delay@150")
+    try:
+        results = client._loop.run_until_complete(burst(8))
+    finally:
+        fi.disarm()
+        eng.max_queue = 0
+    shed = [r for r in results if r[0] == 429]
+    ok = [r for r in results if r[0] == 200]
+    assert shed and len(shed) + len(ok) == 8
+    for status, ctype, body in shed:
+        assert "text/event-stream" not in ctype  # refused pre-headers
+    for status, ctype, body in ok:
+        assert "text/event-stream" in ctype
+        assert body.rstrip().endswith(b"data: [DONE]")

@@ -47,6 +47,11 @@ REQUIRED_FAMILIES = {
     "engine_kv_hbm_per_live_token_bytes",
     "engine_dispatch_compile_variants_count",
     "engine_ragged_rows_total",
+    "engine_requests_shed_total",
+    "engine_deadline_exceeded_total",
+    "federation_node_state_count",
+    "federation_retries_total",
+    "faults_injected_total",
 }
 
 _METRICS_MODULE = "localai_tfp_tpu/telemetry/metrics.py"

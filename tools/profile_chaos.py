@@ -1,0 +1,228 @@
+"""Chaos profile: serving-survival numbers under injected faults.
+
+Drives the robustness work end to end on a small CPU config and prints
+one JSON report with the acceptance numbers the robustness PR tracks:
+
+  engine leg (in-process LLMEngine):
+    shed_rate              — fraction of a 4x-overcommit flood refused
+                             at admission (bounded queue)
+    retry_after_s          — backoff hint stamped on shed terminals
+    deadline_queued/decode — both deadline stages observed terminally
+    device_fault           — InjectedFault storm at engine.device_step:
+                             terminal completeness + survived followup
+    terminal_completeness  — EVERY submitted stream ended in exactly
+                             one terminal event (the core contract)
+
+  federation leg (balancer + 2 member instances over localhost HTTP):
+    failover_latency_s     — kill a member; time until the breaker
+                             opens via the active /healthz probe
+                             (contract: < 2 s, vs STALE_S=60 passive)
+    rerouted_ok            — connect-failure retry served the request
+                             from the surviving node
+
+Run:  python tools/profile_chaos.py [--flood N] [--probe-s S]
+
+CPU smoke (tiny model, fast settings — what CI can afford):
+
+  python tools/profile_chaos.py --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def _build_engine(n_slots=4, max_seq=128):
+    from localai_tfp_tpu.engine.engine import LLMEngine
+    from localai_tfp_tpu.engine.tokenizer import ByteTokenizer
+    from localai_tfp_tpu.models.llm_spec import tiny_spec
+    from localai_tfp_tpu.models.transformer import init_params
+
+    tk = ByteTokenizer()
+    spec = tiny_spec(vocab_size=tk.vocab_size, max_position=512)
+    params = init_params(jax.random.PRNGKey(0), spec, dtype=jnp.float32)
+    eng = LLMEngine(spec, params, tk, n_slots=n_slots, max_seq=max_seq,
+                    prefill_buckets=(8, 32, 128), cache_dtype=jnp.float32)
+    return eng, tk
+
+
+def _drain(q, timeout=120):
+    """(n_terminal_events, final). n_terminal MUST come out 1."""
+    n_term, final = 0, None
+    while final is None:
+        ev = q.get(timeout=timeout)
+        if ev.done:
+            n_term, final = n_term + 1, ev
+    # anything after the terminal breaks the exactly-once contract
+    time.sleep(0.02)
+    try:
+        while True:
+            if q.get_nowait().done:
+                n_term += 1
+    except Exception:
+        pass
+    return n_term, final
+
+
+def engine_leg(flood: int) -> dict:
+    from localai_tfp_tpu.engine.engine import GenRequest
+    from localai_tfp_tpu.utils import faultinject as fi
+
+    eng, tk = _build_engine()
+    out: dict = {}
+    complete = True
+    try:
+        # warm the jit paths so timings below measure policy, not compile
+        eng.generate(GenRequest(prompt_ids=tk.encode("warm"), max_tokens=4,
+                                ignore_eos=True))
+
+        # ---- bounded-admission flood: 4x overcommit ----
+        eng.max_queue = max(1, flood // 4)
+        reqs = [GenRequest(prompt_ids=tk.encode(f"flood {i}"), max_tokens=4,
+                           ignore_eos=True) for i in range(flood)]
+        t0 = time.perf_counter()
+        qs = eng.submit_many(reqs)
+        finals = []
+        for q in qs:
+            n, ev = _drain(q)
+            complete &= n == 1
+            finals.append(ev)
+        shed = [f for f in finals if f.finish_reason == "shed"]
+        out["flood_requests"] = flood
+        out["max_queue"] = eng.max_queue
+        out["shed_rate"] = round(len(shed) / flood, 3)
+        out["retry_after_s"] = (round(shed[0].retry_after_s, 2)
+                                if shed else None)
+        out["flood_wall_s"] = round(time.perf_counter() - t0, 3)
+        eng.max_queue = 0
+
+        # ---- deadlines: queued + mid-decode stage ----
+        n, ev = _drain(eng.submit(GenRequest(
+            prompt_ids=tk.encode("late"), max_tokens=4, ignore_eos=True,
+            timeout_s=1e-6)))
+        complete &= n == 1
+        out["deadline_queued"] = ev.finish_reason == "deadline_exceeded"
+        fi.arm("engine.device_step:delay@80")
+        n, ev = _drain(eng.submit(GenRequest(
+            prompt_ids=tk.encode("slow"), max_tokens=120, ignore_eos=True,
+            timeout_s=0.5)))
+        fi.disarm()
+        complete &= n == 1
+        out["deadline_decode"] = (ev.finish_reason == "deadline_exceeded"
+                                  and 0 < ev.completion_tokens < 120)
+
+        # ---- device-step fault storm, then a clean followup ----
+        fi.arm("engine.device_step:rate@0.3@11")
+        reasons: list[str] = []
+        for i in range(8):
+            n, ev = _drain(eng.submit(GenRequest(
+                prompt_ids=tk.encode(f"storm {i}"), max_tokens=6,
+                ignore_eos=True)))
+            complete &= n == 1
+            reasons.append(ev.finish_reason)
+        injected = fi.counts()["engine.device_step"][1]
+        fi.disarm()
+        ev = eng.generate(GenRequest(prompt_ids=tk.encode("calm"),
+                                     max_tokens=4, ignore_eos=True))
+        out["device_fault"] = {
+            "injected": injected,
+            "errored": reasons.count("error"),
+            "served": reasons.count("length"),
+            "survived_followup": ev.finish_reason == "length",
+        }
+        out["terminal_completeness"] = complete
+        if eng._pool is not None:
+            eng._pool.leak_check()
+            out["kv_pool_leak_check"] = "clean"
+    finally:
+        eng.close()
+    return out
+
+
+async def federation_leg(probe_s: float) -> dict:
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from localai_tfp_tpu.parallel.federated import (
+        FederatedServer, generate_token,
+    )
+
+    async def handler(request):
+        return web.json_response({"ok": True})
+
+    def member():
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", handler)
+        return TestServer(app)
+
+    doomed, healthy = member(), member()
+    await doomed.start_server()
+    await healthy.start_server()
+    tok = generate_token()
+    fed = FederatedServer(tok, probe_s=probe_s)
+    client = TestClient(TestServer(fed.build_app()))
+    await client.start_server()
+    out: dict = {"probe_s": probe_s}
+    try:
+        for nid, m in (("a-doomed", doomed), ("b-healthy", healthy)):
+            r = await client.post("/federation/register", json={
+                "token": tok, "id": nid, "name": nid,
+                "address": f"http://127.0.0.1:{m.port}"})
+            assert r.status == 200
+
+        # kill a member: how long until the breaker routes around it?
+        t0 = time.monotonic()
+        await doomed.close()
+        node = fed.registry._nodes["a-doomed"]
+        while (fed.registry.state(node) != "open"
+               and time.monotonic() - t0 < 10.0):
+            await asyncio.sleep(0.02)
+        opened = fed.registry.state(node) == "open"
+        out["failover_latency_s"] = (round(time.monotonic() - t0, 2)
+                                     if opened else None)
+        out["failover_under_2s"] = opened and out["failover_latency_s"] < 2
+
+        # connect-failure retry: the request lands on the survivor even
+        # if the balancer tries the corpse first
+        r = await client.post("/v1/models", data=b"x")
+        out["rerouted_ok"] = (r.status == 200
+                              and fed.registry._nodes[
+                                  "b-healthy"].requests_served >= 1)
+    finally:
+        await client.close()
+        await healthy.close()
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--flood", type=int, default=32,
+                    help="flood size for the bounded-admission leg")
+    ap.add_argument("--probe-s", type=float, default=0.1,
+                    help="active /healthz probe interval for the "
+                         "failover-latency leg")
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast CPU smoke settings (flood=12)")
+    args = ap.parse_args()
+    if args.smoke:
+        args.flood = 12
+
+    report = {
+        "engine": engine_leg(args.flood),
+        "federation": asyncio.run(federation_leg(args.probe_s)),
+    }
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()
