@@ -73,7 +73,8 @@ from ..ops.sampling import (
     SamplingState, observe_tokens, sample, seed_windows,
 )
 from ..telemetry import metrics as tm
-from ..telemetry.tracing import TRACER
+from ..telemetry.flightrec import FLIGHT
+from ..telemetry.tracing import TRACER, fault_scope
 from ..utils import faultinject
 from .kv_pool import TRASH_PAGE, PagePool, PagePoolExhausted
 from .prefix_index import PrefixIndex, common_prefix_len
@@ -128,6 +129,10 @@ class GenRequest:
     soft_embeds: Optional[np.ndarray] = None
     soft_positions: Optional[np.ndarray] = None
     id: str = field(default_factory=lambda: uuid.uuid4().hex)
+    # distributed trace id (32 hex, telemetry/tracing.py): adopted from
+    # the request's trace at submit so dispatch records can carry it to
+    # multihost followers without a recorder lookup per dispatch
+    trace_id: str = ""
     t_submit: float = 0.0  # perf_counter at submit (queue-wait/TTFT
     # attribution; set by submit_many, 0 for directly-assigned tests)
     # request deadline: client-supplied budget in seconds (0 = use the
@@ -1645,8 +1650,12 @@ class LLMEngine:
         if faultinject.ACTIVE:
             # chaos surface: a fault here behaves exactly like a device
             # dispatch blowing up — _loop's catch fails active slots
-            # with one terminal error event each, scheduler survives
-            faultinject.fire("engine.device_step")
+            # with one terminal error event each, scheduler survives.
+            # The scope binds the wave's request ids so a delivered
+            # fault lands as a span event on each affected trace
+            with fault_scope(s.request.id for s in self.slots
+                             if s.request is not None):
+                faultinject.fire("engine.device_step")
         ch = self.channel
         if ch is not None and not self.follower:
             # dense masks are bit-packed for the wire only; the local exec
@@ -1657,9 +1666,17 @@ class LLMEngine:
             # publish + device-enqueue under ONE critical section: the
             # follower replays records in published order, so the leader's
             # own XLA dispatch order must match it exactly or the
-            # cross-host collectives inside the programs deadlock
+            # cross-host collectives inside the programs deadlock.
+            # The envelope carries the wave's distributed trace ids
+            # (OUTSIDE "data" — the codec whitelist governs replayed
+            # payload fields only) so follower replays emit entries
+            # joined to the leader's traces
+            trace = sorted({s.request.trace_id for s in self.slots
+                            if s.request is not None
+                            and s.request.trace_id})
             with ch.order_lock:
-                ch.publish(kind, {"model": self.tag, "data": wire})
+                ch.publish(kind, {"model": self.tag, "data": wire,
+                                  "trace": trace})
                 return self._dev_exec(kind, payload)
         return self._dev_exec(kind, payload)
 
@@ -2184,9 +2201,19 @@ class LLMEngine:
                     done=True, finish_reason="error",
                     error=f"prompt ({len(req.prompt_ids)} tokens) exceeds "
                           f"context size {self.max_seq}"))
+                # terminal-at-submit requests still get a complete trace
+                # entry: the HTTP layer may have opened one at receive
+                TRACER.event(req.id, "done", model=self._mlabel)
+                TRACER.annotate(req.id, "terminal", outcome="error",
+                                detail="prompt exceeds context")
+                TRACER.finish(req.id, status="error")
             elif not req.prompt_ids:
                 out.put(StreamEvent(done=True, finish_reason="error",
                                     error="empty prompt"))
+                TRACER.event(req.id, "done", model=self._mlabel)
+                TRACER.annotate(req.id, "terminal", outcome="error",
+                                detail="empty prompt")
+                TRACER.finish(req.id, status="error")
             else:
                 ok.append((req, out))
         if ok:
@@ -2228,6 +2255,8 @@ class LLMEngine:
                           f"({self.max_queue} queued); retry later",
                     retry_after_s=retry_s))
                 TRACER.event(req.id, "shed", t=now, model=self._mlabel)
+                TRACER.annotate(req.id, "terminal", t=now, outcome="shed",
+                                retry_after_s=round(retry_s, 3))
                 TRACER.finish(req.id, status="shed")
                 tm.ENGINE_REQUESTS.labels(model=self._mlabel,
                                           reason="shed").inc()
@@ -2235,6 +2264,11 @@ class LLMEngine:
                     model=self._mlabel, reason="queue_full").inc()
             for req, _ in ok:
                 TRACER.event(req.id, "queue", t=now, model=self._mlabel)
+                # adopt the trace's distributed id (minted at the HTTP
+                # edge, or just now by the auto-opened trace): dispatch
+                # records and follower replays carry it from here on
+                if not req.trace_id:
+                    req.trace_id = TRACER.trace_id_of(req.id)
             tm.ENGINE_QUEUE_DEPTH.labels(model=self._mlabel).set(depth)
             if self._autostart:
                 self.start()
@@ -2308,6 +2342,8 @@ class LLMEngine:
                 model=self._mlabel, reason="expired").inc(n_expired)
         for rid in dropped:
             TRACER.event(rid, "done")
+            TRACER.annotate(rid, "terminal", outcome="cancelled",
+                            stage="queued")
             TRACER.finish(rid, status="cancelled")
             tm.ENGINE_REQUESTS.labels(model=self._mlabel,
                                       reason="cancelled").inc()
@@ -2345,6 +2381,8 @@ class LLMEngine:
             self._pending = still
         for rid in expired:
             TRACER.event(rid, "done")
+            TRACER.annotate(rid, "terminal", outcome="deadline_exceeded",
+                            stage="queued")
             TRACER.finish(rid, status="deadline_exceeded")
             tm.ENGINE_REQUESTS.labels(model=self._mlabel,
                                       reason="deadline_exceeded").inc()
@@ -2393,6 +2431,11 @@ class LLMEngine:
                                       error=msg))
                 if s.request is not None:
                     TRACER.event(s.request.id, "done")
+                    # the step error (a real device failure or an
+                    # injected fault — the message says which) becomes
+                    # a span event on every trace it terminated
+                    TRACER.annotate(s.request.id, "terminal",
+                                    outcome="error", detail=msg)
                     TRACER.finish(s.request.id, status="error")
                     tm.ENGINE_REQUESTS.labels(model=self._mlabel,
                                               reason="error").inc()
@@ -2432,6 +2475,11 @@ class LLMEngine:
         busy = sum(1 for s in self.slots if s.active)
         tm.ENGINE_SLOTS_BUSY.labels(model=m).set(busy)
         tm.ENGINE_QUEUE_DEPTH.labels(model=m).set(len(self._pending))
+        # timeline counter samples: same host scalars, per-iteration
+        # cadence (one ring slot each — never per event/per request)
+        FLIGHT.sample("queue_depth", "scheduler", len(self._pending))
+        FLIGHT.sample("slots_busy", "scheduler", busy)
+        FLIGHT.update_gauge()
         used = sum(s.n_past for s in self.slots if s.active)
         tm.ENGINE_KV_UTIL.labels(model=m).set(
             used / float(self.n_slots * self.max_seq))
@@ -2445,6 +2493,7 @@ class LLMEngine:
             st = self._pool.stats()
             tm.ENGINE_KV_PAGES_IN_USE.labels(model=m).set(st.in_use)
             tm.ENGINE_KV_PAGES_SHARED.labels(model=m).set(st.shared)
+            FLIGHT.sample("kv_pages_in_use", "scheduler", st.in_use)
             # HBM actually allocated per live (resident) token — the
             # series that shows paging tracking expected instead of
             # worst-case context (dense equivalent: max_seq / mean ctx
@@ -2612,6 +2661,14 @@ class LLMEngine:
         did = False
         while self._flights and self._flights[0].ready():
             fl = self._flights.popleft()
+            # flight-recorder sample: enqueue→ready wall time, stamped
+            # from host clocks AFTER ready() returned true — the sample
+            # never blocks on the device (hot-path-sync stays clean)
+            dur = time.perf_counter() - fl.t_enqueue
+            tm.ENGINE_DEVICE_STEP.labels(
+                model=self._mlabel, kind=fl.kind).observe(dur)
+            FLIGHT.span("step:" + fl.kind, "device", fl.t_enqueue, dur,
+                        fl.meta.get("rec"))
             if fl.kind == "prefill_final":
                 self._complete_prefill_final(fl)
             elif fl.kind == "mixed":
@@ -2655,6 +2712,18 @@ class LLMEngine:
                     out.put(StreamEvent(done=True,
                                         finish_reason="cancelled"))
             if cancelled:
+                # this terminal previously bypassed the trace recorder
+                # entirely, stranding the request's trace in the active
+                # table until cap eviction — every terminal must land a
+                # complete entry in the ring
+                TRACER.event(req.id, "done")
+                TRACER.annotate(req.id, "terminal", outcome="cancelled",
+                                stage="admit")
+                TRACER.finish(req.id, status="cancelled")
+                tm.ENGINE_REQUESTS.labels(model=self._mlabel,
+                                          reason="cancelled").inc()
+                tm.ENGINE_CANCELLATIONS.labels(model=self._mlabel,
+                                               reason="client").inc()
                 continue
             if self._defer_for_prefix(req, forming, now):
                 requeue.append((req, out))
@@ -3421,7 +3490,10 @@ class LLMEngine:
         self._note_ragged_rows("final", len(group))
         self._flights.append(_Flight(
             kind="prefill_final", arrays=[toks_out],
-            meta={"pairs": [(s, s.request) for s in group], "rows": rows},
+            meta={"pairs": [(s, s.request) for s in group], "rows": rows,
+                  # timeline args for the flight recorder's harvest span
+                  "rec": {"rows": len(group), "bucket": bucket,
+                          "window": window}},
             t_enqueue=t0,
         ))
 
@@ -3611,7 +3683,12 @@ class LLMEngine:
             self._note_decode_advance(t_disp)
         self._flights.append(_Flight(
             kind="mixed", arrays=[toks_out],
-            meta={"rows": rows, "chunk_tokens": chunk_tokens},
+            meta={"rows": rows, "chunk_tokens": chunk_tokens,
+                  # timeline args for the flight recorder's harvest span
+                  "rec": {"decode": len(decoding),
+                          "prefill": len(prefilling) - len(finals),
+                          "finals": len(finals),
+                          "chunk_tokens": chunk_tokens}},
             t_enqueue=t0,
         ))
 
@@ -4077,6 +4154,8 @@ class LLMEngine:
                 # through and mis-sized the k clamps)
                 "saturated": bool(dflights) and not any(
                     f.kind == "prefill_final" for f in self._flights),
+                # timeline args for the flight recorder's harvest span
+                "rec": {"rows": len(decoding), "k": k, "window": window},
             },
             t_enqueue=time.perf_counter(),
         ))
@@ -4324,6 +4403,7 @@ class LLMEngine:
                                            reason="client").inc()
         if req is not None:
             TRACER.event(req.id, "done", t=now)
+            TRACER.annotate(req.id, "terminal", t=now, outcome=reason)
             TRACER.finish(req.id, status=reason)
         self._release(slot)
 

@@ -51,6 +51,11 @@ from typing import Optional
 from aiohttp import ClientError, ClientSession, ClientTimeout, web
 
 from ..telemetry import metrics as tm
+from ..telemetry.flightrec import FLIGHT
+from ..telemetry.tracing import (
+    TRACER, fault_scope, make_traceparent, mint_trace_id, new_span_id,
+    parse_traceparent,
+)
 from ..utils import faultinject
 
 HEARTBEAT_S = 20.0  # ref: announce every 20s (p2p.go:350-362)
@@ -295,33 +300,69 @@ class FederatedServer:
         # the body is buffered up front so a connect-failure retry can
         # replay it against the next node
         data = await request.read()
+        # distributed trace: join the caller's traceparent (or mint one
+        # at this edge) so the balancer hop and every member it touches
+        # share ONE trace id; the proxy's own entry records routing —
+        # node picks, breaker states, retries — as span events
+        parsed = parse_traceparent(request.headers.get("traceparent", ""))
+        tid, pspan = parsed if parsed else (mint_trace_id(), "")
+        rid = "proxy:" + new_span_id()
+        TRACER.start(
+            rid, model="federated",
+            correlation_id=request.headers.get("X-Correlation-ID", ""),
+            events=[("receive", time.perf_counter())],
+            trace_id=tid, parent_span=pspan)
+        status = "error"
         tried: set[str] = set()
-        while True:
-            node = self.registry.pick(self.strategy, exclude=tried)
-            if node is None:
-                if tried:
-                    tm.FEDERATION_RETRIES.labels(
-                        outcome="exhausted").inc()
-                    raise web.HTTPBadGateway(
-                        reason=f"all {len(tried)} eligible federation "
-                               "nodes failed")
-                raise web.HTTPServiceUnavailable(
-                    reason="no federation nodes online")
-            tried.add(node.id)
-            resp = await self._proxy_once(request, node, data,
-                                          rerouted=len(tried) > 1)
-            if resp is not None:
-                return resp
-            # connect failure before any bytes streamed: next node
+        try:
+            while True:
+                node = self.registry.pick(self.strategy, exclude=tried)
+                if node is None:
+                    if tried:
+                        tm.FEDERATION_RETRIES.labels(
+                            outcome="exhausted").inc()
+                        status = "exhausted"
+                        TRACER.annotate(rid, "terminal",
+                                        outcome="exhausted",
+                                        tried=len(tried))
+                        raise web.HTTPBadGateway(
+                            reason=f"all {len(tried)} eligible federation "
+                                   "nodes failed")
+                    status = "no_nodes"
+                    TRACER.annotate(rid, "terminal", outcome="no_nodes")
+                    raise web.HTTPServiceUnavailable(
+                        reason="no federation nodes online")
+                tried.add(node.id)
+                TRACER.annotate(rid, "pick", node=node.name,
+                                breaker=self.registry.state(node),
+                                attempt=len(tried))
+                resp = await self._proxy_once(request, node, data,
+                                              rerouted=len(tried) > 1,
+                                              rid=rid, trace_id=tid)
+                if resp is not None:
+                    status = "proxied"
+                    TRACER.annotate(rid, "terminal", outcome="proxied",
+                                    node=node.name)
+                    return resp
+                # connect failure before any bytes streamed: next node
+                TRACER.annotate(rid, "retry", node=node.name,
+                                error=node.last_error)
+        finally:
+            # every exit — proxied, exhausted, no_nodes, cancelled —
+            # completes the trace entry (satellite-1 contract)
+            TRACER.event(rid, "done")
+            TRACER.finish(rid, status=status)
 
     async def _proxy_once(self, request: web.Request, node: Node,
-                          data: bytes,
-                          rerouted: bool) -> Optional[web.StreamResponse]:
+                          data: bytes, rerouted: bool, rid: str = "",
+                          trace_id: str = "",
+                          ) -> Optional[web.StreamResponse]:
         """Proxy one attempt to `node`. Returns the (completed)
         response, or None when the upstream failed before the response
         was prepared — the only case a retry is safe."""
         node.in_flight += 1
         resp: Optional[web.StreamResponse] = None
+        span = TRACER.begin_span(rid, "upstream")
         try:
             url = node.address.rstrip("/") + "/" + request.match_info["tail"]
             if request.query_string:
@@ -329,9 +370,16 @@ class FederatedServer:
             headers = {k: v for k, v in request.headers.items()
                        if k.lower() not in self.HOP_HEADERS
                        and k.lower() != "host"}
+            if trace_id:
+                # forward the SHARED trace id with a fresh span id per
+                # attempt — the member's edge middleware adopts it, so
+                # its /debug/traces entry joins this balancer's
+                headers["traceparent"] = make_traceparent(trace_id)
             if faultinject.ACTIVE:
-                # chaos surface: connect-failure path (no bytes sent)
-                faultinject.fire("federated.upstream")
+                # chaos surface: connect-failure path (no bytes sent);
+                # fault_scope binds the delivery to this proxy trace
+                with fault_scope((rid,)):
+                    faultinject.fire("federated.upstream")
             async with self._client.request(
                 request.method, url, headers=headers,
                 data=data or None, allow_redirects=False,
@@ -344,7 +392,8 @@ class FederatedServer:
                 async for chunk in upstream.content.iter_chunked(1 << 16):
                     if faultinject.ACTIVE:
                         # chaos surface: upstream dies mid-stream
-                        faultinject.fire("federated.midstream")
+                        with fault_scope((rid,)):
+                            faultinject.fire("federated.midstream")
                     await resp.write(chunk)
                 await resp.write_eof()
                 node.requests_served += 1
@@ -379,6 +428,11 @@ class FederatedServer:
                     site="federated.midstream_notify").inc()
             return resp
         finally:
+            TRACER.end_span(span, node=node.name)
+            # timeline: one attempt span on the federated track (token
+            # carries the begin timestamp at index 2)
+            FLIGHT.span("proxy:" + node.name, "federated", span[2],
+                        time.perf_counter() - span[2])
             node.in_flight -= 1
 
 

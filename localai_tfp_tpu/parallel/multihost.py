@@ -38,10 +38,13 @@ import logging
 import pickle
 import queue
 import threading
+import time
 from typing import Any, Optional, Tuple
 
 import numpy as np
 
+from ..telemetry.flightrec import FLIGHT
+from ..telemetry.tracing import TRACER
 from ..utils import faultinject
 
 log = logging.getLogger(__name__)
@@ -276,15 +279,48 @@ def role() -> str:
 class Replayer:
     """Shared engine-record executor for follower loops: runs _dev_exec
     and drains the device queue every DRAIN records so replay can't race
-    unboundedly ahead of execution."""
+    unboundedly ahead of execution.
+
+    Distributed tracing: leader records carry the trace ids of the
+    requests occupying the dispatch's slots (the ``trace`` envelope
+    field, stamped by ``LLMEngine._run``). The replayer opens ONE local
+    TRACER entry per leader trace id (``replay:<tid16>``, joined by the
+    shared trace id) and annotates it with the kinds replayed, so a
+    ``/debug/traces?id=<trace id>`` on the follower shows the leader's
+    request flowing through this host. Entries close when their trace
+    id leaves the live set of a later record."""
 
     DRAIN = 64
 
     def __init__(self) -> None:
         self._n = 0
+        self._open: set = set()  # leader trace ids with a live entry
 
-    def exec(self, engine: Any, kind: str, payload: Any) -> None:
+    def _note_trace(self, kind: str, trace: tuple) -> None:
+        live = set(trace)
+        for tid in tuple(self._open - live):
+            rid = "replay:" + tid[:16]
+            TRACER.event(rid, "done")
+            TRACER.finish(rid, status="replayed")
+            self._open.discard(tid)
+        for tid in trace:
+            rid = "replay:" + tid[:16]
+            if tid not in self._open:
+                self._open.add(tid)
+                TRACER.start(rid, model="follower",
+                             events=[("receive", time.perf_counter())],
+                             trace_id=tid)
+            TRACER.annotate(rid, "replay", kind=kind)
+
+    def exec(self, engine: Any, kind: str, payload: Any,
+             trace: tuple = ()) -> None:
+        self._note_trace(kind, trace)
+        t0 = time.perf_counter()
         engine._dev_exec(kind, payload)
+        # host-side enqueue span only — _dev_exec returns as soon as the
+        # dispatch is queued, so no sync is implied by timing it
+        FLIGHT.span("replay:" + kind, "follower", t0,
+                    time.perf_counter() - t0)
         self._n += 1
         if self._n % self.DRAIN == 0:
             import jax
@@ -311,7 +347,8 @@ def run_follower_engine(engine: Any, end: Any,
             return
         if kind in ("load", "unload"):
             continue
-        rp.exec(engine, kind, rec["data"])
+        rp.exec(engine, kind, rec["data"],
+                trace=tuple(rec.get("trace") or ()))
 
 
 class FollowerRouter:
@@ -410,7 +447,8 @@ class FollowerRouter:
             self._join_load(tag)
         backend = self.backends.get(tag)
         if backend is not None and backend.engine is not None:
-            self._rp.exec(backend.engine, kind, rec["data"])
+            self._rp.exec(backend.engine, kind, rec["data"],
+                          trace=tuple(rec.get("trace") or ()))
         elif tag in self.failed:
             # the leader IS serving this model but this host has no
             # engine for it: the SPMD programs have already diverged.

@@ -19,6 +19,8 @@ from urllib.parse import unquote
 from aiohttp import web
 
 from ..telemetry.metrics import API_CALL
+from ..telemetry.tracing import TRACER, make_traceparent, mint_trace_id, \
+    new_span_id, parse_traceparent
 from .state import Application
 from . import (
     assistants_routes, media_routes, openai_routes, localai_routes,
@@ -123,9 +125,33 @@ async def telemetry_middleware(request: web.Request, handler):
     request["correlation_id"] = (
         request.headers.get("X-Correlation-ID") or uuid.uuid4().hex
     )
+    # W3C trace context: adopt the caller's traceparent (a federated
+    # balancer hop, or any tracing client) or mint a fresh trace id at
+    # this edge — request handlers seed TRACER entries from these
+    parsed = parse_traceparent(request.headers.get("traceparent", ""))
+    edge = ""
+    if parsed:
+        request["trace_id"], request["parent_span"] = parsed
+        # a DISTRIBUTED caller announced itself: record an edge entry
+        # under the shared trace id so this hop is joinable via
+        # /debug/traces?id=... even when the handler opens no deeper
+        # trace (non-stream endpoints). Local clients (no header) pay
+        # nothing.
+        edge = "edge:" + new_span_id()
+        TRACER.start(edge, model="edge",
+                     correlation_id=request["correlation_id"],
+                     events=[("receive", t0)],
+                     trace_id=parsed[0], parent_span=parsed[1])
+        TRACER.annotate(edge, "http", method=request.method,
+                        path=request.path)
+    else:
+        request["trace_id"], request["parent_span"] = mint_trace_id(), ""
     try:
         return await handler(request)
     finally:
+        if edge:
+            TRACER.event(edge, "done")
+            TRACER.finish(edge)
         if not app.config.disable_metrics:
             API_CALL.labels(
                 method=request.method, path=_route_template(request)
@@ -143,6 +169,11 @@ async def _prepare_headers(request: web.Request, response) -> None:
     corr = request.get("correlation_id")
     if corr:
         response.headers["X-Correlation-ID"] = corr
+    tid = request.get("trace_id")
+    if tid:
+        # echo the resolved trace id so callers can join their request
+        # to /debug/traces?id=... on this node (span id is this hop's)
+        response.headers["traceparent"] = make_traceparent(tid)
     if app.config.cors:
         allowed = [o.strip() for o in
                    (app.config.cors_allow_origins or "*").split(",")]

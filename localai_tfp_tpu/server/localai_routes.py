@@ -29,6 +29,7 @@ def register(app: web.Application) -> None:
     r.add_get("/version", version)
     r.add_get("/metrics", metrics)
     r.add_get("/debug/traces", debug_traces)
+    r.add_get("/debug/timeline", debug_timeline)
     r.add_get("/system", system)
     r.add_get("/backend/monitor", backend_monitor)
     r.add_post("/backend/shutdown", backend_shutdown)
@@ -93,18 +94,34 @@ async def metrics(request: web.Request) -> web.Response:
 
 async def debug_traces(request: web.Request) -> web.Response:
     """Request-lifecycle timelines (telemetry/tracing.py): newest-first
-    JSON, ``?model=`` filter, ``?limit=`` cap (default 50). Pretty-
-    printer: tools/trace_report.py."""
+    JSON, ``?model=`` filter, ``?limit=`` cap (default 50), ``?id=``
+    point lookup by trace id / request id / correlation id / full
+    traceparent header value. Pretty-printer: tools/trace_report.py."""
     from ..telemetry.tracing import TRACER
 
     try:
         limit = int(request.query.get("limit") or 50)
     except ValueError:
         raise web.HTTPBadRequest(reason="'limit' must be an integer")
+    ident = request.query.get("id")
+    if ident:
+        return web.json_response({
+            "traces": TRACER.lookup(ident, limit=limit),
+        })
     return web.json_response({
         "traces": TRACER.traces(model=request.query.get("model") or None,
                                 limit=limit),
     })
+
+
+async def debug_timeline(request: web.Request) -> web.Response:
+    """The scheduler/device flight recorder as Chrome-trace JSON
+    (telemetry/flightrec.py) — save the body and open it in Perfetto
+    (https://ui.perfetto.dev) or chrome://tracing; offline renderer:
+    tools/trace_viewer.py."""
+    from ..telemetry.flightrec import FLIGHT
+
+    return web.json_response(FLIGHT.export_chrome_trace())
 
 
 async def system(request: web.Request) -> web.Response:

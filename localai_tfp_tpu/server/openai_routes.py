@@ -556,7 +556,9 @@ async def _stream_chat(
     # (queue/admit/.../done) appended by the scheduler under this id
     TRACER.start(rid, model=cfg.name,
                  correlation_id=request.get("correlation_id", ""),
-                 events=_trace_seed(request))
+                 events=_trace_seed(request),
+                 trace_id=request.get("trace_id", ""),
+                 parent_span=request.get("parent_span", ""))
     prompt_box: dict[str, str] = {}  # templated prompt, set by the
     # producer BEFORE submit — stream events (and thus any finetune echo
     # use of it) can only arrive after
@@ -803,7 +805,9 @@ async def _stream_completion(request, backend, opts, cfg, cid, created,
     opts.request_id = opts.request_id or uuid.uuid4().hex
     TRACER.start(opts.request_id, model=cfg.name,
                  correlation_id=request.get("correlation_id", ""),
-                 events=_trace_seed(request))
+                 events=_trace_seed(request),
+                 trace_id=request.get("trace_id", ""),
+                 parent_span=request.get("parent_span", ""))
 
     submitted = False
     if _bounded_admission(backend):

@@ -13,11 +13,17 @@ GET /metrics), grown into what a TPU serving engine actually needs:
   table — tools/check_metrics.py enforces the naming contract.
 - ``tracing``: a request-lifecycle span recorder keyed by request id
   (receive → auth → queue → admit → prefill → first-token → decode →
-  stream-done), bounded ring buffer, exported via GET /debug/traces.
+  stream-done), bounded ring buffer, exported via GET /debug/traces —
+  carrying a W3C-style trace id that joins federated proxy hops and
+  multihost follower replays across processes.
+- ``flightrec``: the scheduler/device flight recorder — a bounded
+  timeline ring of dispatch spans and scheduler-state counters,
+  exported as Chrome-trace/Perfetto JSON via GET /debug/timeline.
 
 All samples are host-held scalars the scheduler already owns — nothing
 in this package touches a device array or calls block_until_ready.
 """
 
+from .flightrec import FLIGHT, FlightRecorder  # noqa: F401
 from .registry import CONTENT_TYPE, REGISTRY, Registry  # noqa: F401
 from .tracing import TRACER, TraceRecorder  # noqa: F401

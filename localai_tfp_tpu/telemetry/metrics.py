@@ -244,6 +244,31 @@ FAULTS_INJECTED = REGISTRY.counter(
     labels=("point",),
 )
 
+# -------------------------------------------------------- observability
+
+ENGINE_DEVICE_STEP = REGISTRY.histogram(
+    "engine_device_step_seconds",
+    "Enqueue-to-ready wall time per harvested device flight by dispatch "
+    "kind (prefill_final/mixed/decodek) — host-timed at harvest, when "
+    "the flight's arrays are already ready, so the sample costs no "
+    "device sync",
+    labels=("model", "kind"), buckets=_STEP_BUCKETS,
+)
+TRACE_SPANS_DROPPED = REGISTRY.counter(
+    "trace_spans_dropped_total",
+    "Trace entries or span events dropped by the bounded recorder "
+    "(active_overflow = still-active trace evicted at active_cap, "
+    "ring_evict = finished trace pushed out of the ring, note_cap = "
+    "span event past the per-trace annotation cap)",
+    labels=("reason",),
+)
+TIMELINE_RING_EVENTS = REGISTRY.gauge(
+    "timeline_ring_events_count",
+    "Events currently held by the flight-recorder timeline ring "
+    "(telemetry/flightrec.py; exported as Chrome-trace JSON via "
+    "GET /debug/timeline)",
+)
+
 # ---------------------------------------------------------------- loader
 
 MODEL_LOADS = REGISTRY.counter(

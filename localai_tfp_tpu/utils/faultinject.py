@@ -36,7 +36,8 @@ import time
 import zlib
 from typing import Optional
 
-__all__ = ["InjectedFault", "arm", "disarm", "fire", "counts", "ACTIVE"]
+__all__ = ["InjectedFault", "arm", "disarm", "fire", "counts", "observe",
+           "ACTIVE"]
 
 
 class InjectedFault(RuntimeError):
@@ -79,6 +80,17 @@ class _Point:
 
 _lock = threading.Lock()
 _points: dict[str, _Point] = {}  # every access under _lock
+
+# delivery observers: called OUTSIDE _lock with (point, action) for
+# every fault actually delivered. telemetry/tracing.py registers one to
+# annotate in-scope request traces; only armed runs ever reach them
+_observers: list = []
+
+
+def observe(cb) -> None:
+    """Register a delivery observer (idempotent per callback)."""
+    if cb not in _observers:
+        _observers.append(cb)
 
 # module-level fast gate: instrumented sites check this BEFORE calling
 # fire(), so the disarmed hot path pays one attribute read only
@@ -150,6 +162,8 @@ def fire(point: str) -> None:
     from ..telemetry.metrics import FAULTS_INJECTED
 
     FAULTS_INJECTED.labels(point=point).inc()
+    for cb in _observers:
+        cb(point, action)
     if action == "delay":
         time.sleep(delay_s)
         return

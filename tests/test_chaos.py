@@ -128,6 +128,31 @@ def test_device_step_fault_fails_slots_engine_survives(eng):
     _settle_and_leak_check(eng)
 
 
+def test_fault_delivery_lands_on_trace_as_span_event(eng):
+    """A DELIVERED fault is attributed to the request trace it killed:
+    the faultinject observer annotates every trace bound by the
+    engine's fault_scope with a "fault" span event naming the point,
+    and the terminal outcome rides along — visible via /debug/traces."""
+    from localai_tfp_tpu.telemetry.tracing import TRACER
+
+    fi.arm("engine.device_step:fail@1")
+    req = GenRequest(prompt_ids=eng.tokenize("traced boom"),
+                     max_tokens=8, ignore_eos=True)
+    q = eng.submit(req)
+    evs, final = _drain(q)
+    assert final.finish_reason == "error"
+    rows = TRACER.lookup(req.id, limit=5)
+    assert rows, "fault-terminated request left no trace entry"
+    tr = rows[0]
+    assert tr["status"] == "error"
+    names = {n["name"]: n for n in tr["span_events"]}
+    assert "fault" in names, tr["span_events"]
+    assert names["fault"]["point"] == "engine.device_step"
+    assert names["fault"]["action"].startswith("fail")
+    assert names["terminal"]["outcome"] == "error"
+    _settle_and_leak_check(eng)
+
+
 def test_device_step_fault_storm_every_request_terminates(eng):
     """Probabilistic fault storm: whatever mix of waves dies, every
     stream ends in exactly one terminal event and the pool is clean."""

@@ -461,6 +461,85 @@ def test_metrics_contract_clean():
     assert _lint(src, "metrics-contract", readme="| `good_total` |") == []
 
 
+# ----------------------------------------------------------- span-balance
+
+
+def test_span_balance_clean_try_finally():
+    src = """\
+        def f(rid):
+            tok = TRACER.begin_span(rid, "upstream")
+            try:
+                work()
+            finally:
+                TRACER.end_span(tok, node="n1")
+        """
+    assert _lint(src, "span-balance") == []
+
+
+def test_span_balance_context_manager_clean():
+    src = """\
+        def f(rid):
+            with TRACER.span(rid, "decode"):
+                work()
+        """
+    assert _lint(src, "span-balance") == []
+
+
+def test_span_balance_missing_try():
+    src = """\
+        def f(rid):
+            tok = TRACER.begin_span(rid, "upstream")
+            work()
+            TRACER.end_span(tok)
+        """
+    fs = _lint(src, "span-balance")
+    assert _ids(fs) == ["span-balance"]
+    assert "not protected" in fs[0].message
+
+
+def test_span_balance_end_span_not_in_finally():
+    src = """\
+        def f(rid):
+            tok = TRACER.begin_span(rid, "upstream")
+            try:
+                work()
+                TRACER.end_span(tok)
+            except Exception:
+                pass
+        """
+    fs = _lint(src, "span-balance")
+    assert _ids(fs) == ["span-balance"]
+    assert "not protected" in fs[0].message
+
+
+def test_span_balance_discarded_token():
+    src = """\
+        def f(rid):
+            TRACER.begin_span(rid, "upstream")
+            try:
+                work()
+            finally:
+                TRACER.end_span(None)
+        """
+    fs = _lint(src, "span-balance")
+    assert _ids(fs) == ["span-balance"]
+    assert "discarded or buried" in fs[0].message
+
+
+def test_span_balance_buried_in_expression():
+    src = """\
+        def f(rid):
+            toks = [TRACER.begin_span(rid, "a"), TRACER.begin_span(rid, "b")]
+            try:
+                work()
+            finally:
+                for t in toks:
+                    TRACER.end_span(t)
+        """
+    fs = _lint(src, "span-balance")
+    assert _ids(fs) == ["span-balance", "span-balance"]
+
+
 # ------------------------------------------- suppressions, regions, pragmas
 
 
@@ -609,7 +688,7 @@ def test_cli_json_clean():
     assert out.returncode == 0, out.stdout + out.stderr
     rep = json.loads(out.stdout)
     assert rep["ok"] is True
-    assert len(rep["rules"]) == 6  # lint-pragma rides along implicitly
+    assert len(rep["rules"]) == 7  # lint-pragma rides along implicitly
     assert rep["findings"] == [] and rep["stale_baseline"] == []
 
 

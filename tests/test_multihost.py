@@ -86,6 +86,27 @@ def test_follower_replays_identical_state(model):
     t.join(timeout=60)
     assert not t.is_alive()
 
+    # distributed-trace join: submit auto-opened a trace and stamped
+    # its id on the request; the dispatch envelopes carried it, so the
+    # follower's Replayer emitted a ``replay:<tid16>`` entry joined by
+    # the leader's trace id (what /debug/traces?id= resolves on a
+    # follower host)
+    from localai_tfp_tpu.telemetry.tracing import TRACER
+
+    tid = reqs[0].trace_id
+    assert len(tid) == 32
+    rows = TRACER.lookup(tid, limit=10)
+    replays = [r for r in rows if r["request_id"].startswith("replay:")]
+    assert replays, "follower emitted no replay entry for the trace"
+    assert replays[0]["trace_id"] == tid
+    assert replays[0]["model"] == "follower"
+    kinds = {n.get("kind") for n in replays[0]["span_events"]
+             if n["name"] == "replay"}
+    assert kinds & {"prefill", "prefill_final", "mixed", "decode1",
+                    "decodek"}, kinds
+    # the leader-side request entry joins under the same id
+    assert any(r["request_id"] == reqs[0].id for r in rows)
+
     np.testing.assert_array_equal(
         np.asarray(leader.cache.k), np.asarray(follower.cache.k)
     )

@@ -9,6 +9,7 @@ from .hot_path_sync import HotPathSync
 from .lock_discipline import LockDiscipline
 from .metrics_contract import MetricsContract
 from .scalar_payload import ScalarPayload
+from .span_balance import SpanBalance
 
 ALL_RULES = (
     HotPathSync(),
@@ -17,6 +18,7 @@ ALL_RULES = (
     DonationAfterUse(),
     ExceptionHygiene(),
     MetricsContract(),
+    SpanBalance(),
 )
 
 for _r in ALL_RULES:

@@ -52,6 +52,9 @@ REQUIRED_FAMILIES = {
     "federation_node_state_count",
     "federation_retries_total",
     "faults_injected_total",
+    "engine_device_step_seconds",
+    "trace_spans_dropped_total",
+    "timeline_ring_events_count",
 }
 
 _METRICS_MODULE = "localai_tfp_tpu/telemetry/metrics.py"

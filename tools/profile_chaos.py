@@ -20,6 +20,13 @@ one JSON report with the acceptance numbers the robustness PR tracks:
     rerouted_ok            — connect-failure retry served the request
                              from the surviving node
 
+  tracing leg (in-process balancer + ONE REAL server subprocess):
+    an injected federated.upstream fault forces a reroute while a
+    client-minted traceparent rides the request; the report joins the
+    balancer's proxy trace (fault delivery + retry + terminal as span
+    events) with the member process's /debug/traces?id= entry — one
+    trace id spanning both processes.
+
 Run:  python tools/profile_chaos.py [--flood N] [--probe-s S]
 
 CPU smoke (tiny model, fast settings — what CI can afford):
@@ -149,6 +156,129 @@ def engine_leg(flood: int) -> dict:
     return out
 
 
+def _spawn_member(models_dir: str, cwd: str, port: int):
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("LOCALAI_FAULTS", None)  # faults stay balancer-side here
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                  if p])
+    return subprocess.Popen(
+        [sys.executable, "-m", "localai_tfp_tpu.cli", "run",
+         "--models-path", models_dir, "--address", "127.0.0.1",
+         "--port", str(port)],
+        cwd=cwd, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT)
+
+
+async def tracing_leg() -> dict:
+    """One trace id across two processes: an in-process balancer (with
+    an injected upstream fault forcing a failover) proxying to a REAL
+    server subprocess, joined by ``/debug/traces?id=``."""
+    import socket
+    import tempfile
+    import urllib.request
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from localai_tfp_tpu.parallel.federated import (
+        FederatedServer, generate_token,
+    )
+    from localai_tfp_tpu.telemetry.tracing import (
+        TRACER, make_traceparent, mint_trace_id,
+    )
+    from localai_tfp_tpu.utils import faultinject as fi
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    out: dict = {}
+    member = None
+    with tempfile.TemporaryDirectory() as tmp:
+        models = os.path.join(tmp, "models")
+        cwd = os.path.join(tmp, "member")
+        os.makedirs(models)
+        os.makedirs(cwd)
+        # zero-checkpoint config: the tts backend serves /v1/models
+        # with no model files, so the member boots in seconds
+        with open(os.path.join(models, "voice.yaml"), "w") as f:
+            f.write("name: voice\nbackend: jax-tts\n")
+        member = _spawn_member(models, cwd, port)
+        try:
+            base = f"http://127.0.0.1:{port}"
+            t0 = time.time()
+            while time.time() - t0 < 120:
+                try:
+                    urllib.request.urlopen(base + "/readyz", timeout=2)
+                    break
+                except Exception:
+                    time.sleep(0.3)
+            else:
+                raise TimeoutError("member server never became ready")
+
+            tok = generate_token()
+            fed = FederatedServer(tok, probe_s=0.0)
+            client = TestClient(TestServer(fed.build_app()))
+            await client.start_server()
+            try:
+                # the SAME member registered under two node ids: the
+                # injected first-attempt fault reroutes to "the other
+                # node" and still lands — a failover that needs only
+                # one real process
+                for nid in ("m1", "m2"):
+                    r = await client.post("/federation/register", json={
+                        "token": tok, "id": nid, "name": nid,
+                        "address": base})
+                    assert r.status == 200
+
+                fi.arm("federated.upstream:fail@1")
+                tid = mint_trace_id()
+                r = await client.get(
+                    "/v1/models",
+                    headers={"traceparent": make_traceparent(tid)})
+                out["proxied_status"] = r.status
+                out["echoed_traceparent"] = tid in r.headers.get(
+                    "traceparent", "")
+                fi.disarm()
+
+                balancer = TRACER.lookup(tid)
+                names = [n["name"] for tr in balancer
+                         for n in tr.get("span_events", [])]
+                points = [n.get("point") for tr in balancer
+                          for n in tr.get("span_events", [])]
+                with urllib.request.urlopen(
+                        f"{base}/debug/traces?id={tid}",
+                        timeout=10) as resp:
+                    remote = json.loads(resp.read()).get("traces", [])
+                out["trace_id"] = tid
+                out["balancer_entries"] = len(balancer)
+                out["fault_on_trace"] = "federated.upstream" in points
+                out["failover_on_trace"] = "retry" in names
+                out["member_entries"] = len(remote)
+                out["member_joined_by_trace_id"] = all(
+                    tr.get("trace_id") == tid for tr in remote) and bool(
+                    remote)
+                out["one_trace_id_both_processes"] = (
+                    out["fault_on_trace"] and out["failover_on_trace"]
+                    and out["member_joined_by_trace_id"])
+            finally:
+                fi.disarm()
+                await client.close()
+        finally:
+            if member is not None:
+                member.terminate()
+                try:
+                    member.wait(timeout=10)
+                except Exception:
+                    member.kill()
+    return out
+
+
 async def federation_leg(probe_s: float) -> dict:
     from aiohttp import web
     from aiohttp.test_utils import TestClient, TestServer
@@ -220,6 +350,7 @@ def main() -> None:
     report = {
         "engine": engine_leg(args.flood),
         "federation": asyncio.run(federation_leg(args.probe_s)),
+        "tracing": asyncio.run(tracing_leg()),
     }
     print(json.dumps(report, indent=2))
 

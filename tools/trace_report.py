@@ -12,8 +12,11 @@ request as a span timeline:
       decode       160.5 ms ▕███████████████▏ 252.2 ms
 
 Options: --model filters server-side, --limit caps the count,
---api-key sends a Bearer token, --json reads a saved payload instead
-of a URL (offline triage of a pasted /debug/traces body).
+--id looks up one distributed trace (trace id / request id /
+correlation id / full traceparent header — joins every hop's entry on
+this node), --api-key sends a Bearer token, --json emits the raw JSON
+payload instead of span bars, --from-file reads a saved payload
+instead of a URL (offline triage of a pasted /debug/traces body).
 """
 
 from __future__ import annotations
@@ -27,9 +30,12 @@ import urllib.request
 BAR_COLS = 34
 
 
-def fetch(url: str, model: str, limit: int, api_key: str) -> dict:
+def fetch(url: str, model: str, limit: int, api_key: str,
+          ident: str = "") -> dict:
     q = {"limit": str(limit)}
-    if model:
+    if ident:
+        q["id"] = ident
+    elif model:
         q["model"] = model
     full = f"{url.rstrip('/')}/debug/traces?{urllib.parse.urlencode(q)}"
     req = urllib.request.Request(full)
@@ -46,6 +52,9 @@ def render(trace: dict, out) -> None:
             f"{trace.get('status')}  total {trace.get('total_ms')} ms")
     if corr:
         head += f"  (corr {corr[:12]})"
+    tid = trace.get("trace_id", "")
+    if tid:
+        head += f"  trace {tid[:16]}"
     print(head, file=out)
     spans = trace.get("spans") or []
     total = max(float(trace.get("total_ms") or 0.0), 1e-9)
@@ -59,6 +68,11 @@ def render(trace: dict, out) -> None:
         events = trace.get("events") or []
         for e in events:
             print(f"  {e['phase']:<16} {e['t_ms']:>9.1f} ms", file=out)
+    for n in trace.get("span_events") or []:
+        attrs = {k: v for k, v in n.items() if k not in ("name", "t_ms")}
+        kv = " ".join(f"{k}={v}" for k, v in attrs.items())
+        print(f"  * {n['name']:<14} {n['t_ms']:>9.1f} ms  {kv}",
+              file=out)
     print(file=out)
 
 
@@ -68,23 +82,39 @@ def main(argv=None) -> int:
     ap.add_argument("--url", default="http://localhost:8080",
                     help="server base URL")
     ap.add_argument("--model", default="", help="filter by model name")
+    ap.add_argument("--id", default="", dest="ident",
+                    help="look up one distributed trace: trace id, "
+                         "request id, correlation id, or a full "
+                         "traceparent header value")
     ap.add_argument("--limit", type=int, default=10)
     ap.add_argument("--api-key", default="", help="Bearer token")
-    ap.add_argument("--json", default="",
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw JSON payload instead of bars")
+    ap.add_argument("--from-file", default="",
                     help="read a saved /debug/traces JSON file instead")
     args = ap.parse_args(argv)
 
-    if args.json:
-        with open(args.json, encoding="utf-8") as f:
+    if args.from_file:
+        with open(args.from_file, encoding="utf-8") as f:
             payload = json.load(f)
+        if args.ident:  # offline --id: client-side join
+            traces = [t for t in payload.get("traces") or []
+                      if args.ident in (t.get("trace_id"),
+                                        t.get("request_id"),
+                                        t.get("correlation_id"))]
+            payload = {"traces": traces}
     else:
         try:
             payload = fetch(args.url, args.model, args.limit,
-                            args.api_key)
+                            args.api_key, ident=args.ident)
         except OSError as e:
             print(f"trace_report: cannot reach {args.url}: {e}",
                   file=sys.stderr)
             return 1
+    if args.json:
+        json.dump(payload, sys.stdout, indent=2)
+        print()
+        return 0
     traces = payload.get("traces") or []
     if not traces:
         print("no traces recorded (is the server serving requests?)")

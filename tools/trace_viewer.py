@@ -1,0 +1,147 @@
+#!/usr/bin/env python3
+"""Offline renderer for /debug/timeline Chrome-trace JSON.
+
+The flight recorder (telemetry/flightrec.py) exports a Perfetto-loadable
+timeline; this tool renders the same file in a terminal for hosts with
+no browser at hand — one ASCII lane per track plus per-name duration
+stats:
+
+    $ curl -s localhost:8080/debug/timeline > timeline.json
+    $ python tools/trace_viewer.py timeline.json
+    timeline: 1832 events over 2417.3 ms (ring 8192, dropped 0)
+
+    track device           128 spans
+      step:decodek      ▏   ██ █ ████ ██████  ... ▕
+    ...
+    span durations (ms):                 n      p50      p95      max
+      step:decodek                     96     1.84     2.91     4.40
+
+Accepts a file path or an http(s) URL (fetched with stdlib urllib).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from collections import defaultdict
+
+LANE_COLS = 72
+
+
+def load(src: str) -> dict:
+    if src.startswith(("http://", "https://")):
+        url = src.rstrip("/")
+        if not url.endswith("/debug/timeline"):
+            url += "/debug/timeline"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    with open(src, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _percentile(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def render(doc: dict, out) -> int:
+    events = doc.get("traceEvents") or []
+    tracks: dict[int, str] = {}
+    spans = []  # (tid, name, ts_us, dur_us)
+    instants = []  # (tid, name, ts_us)
+    counters: dict[str, list] = defaultdict(list)  # name -> (ts, value)
+    for ev in events:
+        ph = ev.get("ph")
+        if ph == "M":
+            if ev.get("name") == "thread_name":
+                tracks[ev.get("tid", 0)] = ev["args"]["name"]
+            continue
+        if ph == "X":
+            spans.append((ev.get("tid", 0), ev["name"], ev["ts"],
+                          ev.get("dur", 0.0)))
+        elif ph == "i":
+            instants.append((ev.get("tid", 0), ev["name"], ev["ts"]))
+        elif ph == "C":
+            counters[ev["name"]].append(
+                (ev["ts"], ev.get("args", {}).get("value", 0)))
+    timed = ([ts for _, _, ts, _ in spans]
+             + [ts for _, _, ts in instants]
+             + [ts for series in counters.values() for ts, _ in series])
+    if not timed:
+        print("timeline: empty (no events recorded yet)", file=out)
+        return 0
+    t_lo = min(timed)
+    t_hi = max([ts + dur for _, _, ts, dur in spans] + timed)
+    width_us = max(t_hi - t_lo, 1.0)
+    other = doc.get("otherData") or {}
+    print(f"timeline: {len(spans) + len(instants)} events over "
+          f"{width_us / 1e3:.1f} ms (ring {other.get('ring_capacity')}, "
+          f"dropped {other.get('dropped')})", file=out)
+
+    def col(ts_us: float) -> int:
+        return min(LANE_COLS - 1,
+                   int((ts_us - t_lo) / width_us * LANE_COLS))
+
+    for tid in sorted(tracks):
+        tname = tracks[tid]
+        tr_spans = [s for s in spans if s[0] == tid]
+        tr_inst = [i for i in instants if i[0] == tid]
+        if not tr_spans and not tr_inst:
+            continue
+        print(f"\ntrack {tname:<16} {len(tr_spans)} spans, "
+              f"{len(tr_inst)} instants", file=out)
+        by_name: dict[str, list] = defaultdict(list)
+        for _, name, ts, dur in tr_spans:
+            by_name[name].append((ts, dur))
+        for _, name, ts in tr_inst:
+            by_name[name].append((ts, 0.0))
+        for name in sorted(by_name):
+            lane = [" "] * LANE_COLS
+            for ts, dur in by_name[name]:
+                a, b = col(ts), col(ts + dur)
+                for c in range(a, b + 1):
+                    lane[c] = "█"
+            print(f"  {name:<18} ▏{''.join(lane)}▕", file=out)
+
+    by_span: dict[str, list] = defaultdict(list)
+    for _, name, _, dur in spans:
+        by_span[name].append(dur / 1e3)
+    if by_span:
+        print(f"\nspan durations (ms): {'':>14} {'n':>6} {'p50':>8} "
+              f"{'p95':>8} {'max':>8}", file=out)
+        for name in sorted(by_span):
+            ds = sorted(by_span[name])
+            print(f"  {name:<30} {len(ds):>6} "
+                  f"{_percentile(ds, 0.50):>8.2f} "
+                  f"{_percentile(ds, 0.95):>8.2f} {ds[-1]:>8.2f}",
+                  file=out)
+    for name in sorted(counters):
+        vals = [v for _, v in counters[name]]
+        print(f"counter {name:<22} samples {len(vals):>5}  "
+              f"min {min(vals):g}  max {max(vals):g}  "
+              f"last {vals[-1]:g}", file=out)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render /debug/timeline Chrome-trace JSON as ASCII")
+    ap.add_argument("source",
+                    help="path to a saved timeline.json, or a server "
+                         "base URL / /debug/timeline URL")
+    args = ap.parse_args(argv)
+    try:
+        doc = load(args.source)
+    except OSError as e:
+        print(f"trace_viewer: cannot load {args.source}: {e}",
+              file=sys.stderr)
+        return 1
+    return render(doc, sys.stdout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
