@@ -221,12 +221,58 @@ def ragged_variant_report() -> dict:
     return _ragged_warmup_compare(spec, params, tk)
 
 
+def _kv_tiering_extra(eng, tok) -> dict:
+    """KV tiering acceptance block (extra.kv_tiering): the live
+    engine's decode throughput with the tier armed vs disarmed,
+    interleaved best-of like _tracing_extra (contract: overhead <= 1%
+    — the tick piggybacks on admission and every transfer is async),
+    plus the live tier's counters. The capacity story — resident
+    sessions vs HBM-only and the returning-user prefetch hit rate —
+    runs the tools/profile_kv returning-users workload on a dedicated
+    small engine pair, because the bench engine's pool is sized so its
+    own traffic never churns slots (a vacuous multiple)."""
+    out: dict = {"enabled": eng._tier is not None}
+    if eng._tier is not None:
+        tier = eng._tier
+        tok_s_on = tok_s_off = 0.0
+        for _ in range(2):
+            on, _, _ = _bench_config(eng, tok, 4, 32, runs=1)
+            eng._tier = None  # disarm: every engine hook is a None test
+            try:
+                off, _, _ = _bench_config(eng, tok, 4, 32, runs=1)
+            finally:
+                eng._tier = tier
+            tok_s_on = max(tok_s_on, on)
+            tok_s_off = max(tok_s_off, off)
+        overhead = max(0.0, 1.0 - tok_s_on / max(tok_s_off, 1e-9))
+        out.update({
+            "decode_tok_s_tier_on": tok_s_on,
+            "decode_tok_s_tier_off": tok_s_off,
+            "tier_overhead_frac": round(overhead, 4),
+            "tier_overhead_within_1pct": overhead <= 0.01,
+            "host_budget_mb": tier.host_budget >> 20,
+            "live_stats": tier.stats(),
+        })
+    from tools.profile_kv import returning_users_shape
+
+    # 16 users on the 4-slot small engine: enough churn depth for the
+    # >=4x resident-capacity headline (8 would cap the multiple at 2x)
+    ru = returning_users_shape(True, 16)
+    out["capacity_multiple"] = ru["capacity_multiple"]
+    out["prefetch_hit_rate"] = ru["on"]["prefetch_hit_rate"]
+    out["reprefill_tokens_on_hits"] = \
+        ru["on"]["reprefill_tokens_on_hits"]
+    out["returning_users"] = ru
+    return out
+
+
 # extras that measure the LIVE serving engine: _bench_http's teardown
 # (runner.cleanup()) fires the app cleanup that CLOSES it, so these must
 # be recorded first. _bench_http enforces the order (it was a
 # comment-only gotcha through PR 4; measuring a closed engine reports
 # garbage silently).
-_LIVE_ENGINE_EXTRAS = ("mixed_itl", "paged_kv", "ragged_attn")
+_LIVE_ENGINE_EXTRAS = ("mixed_itl", "paged_kv", "ragged_attn",
+                       "kv_tiering")
 
 
 def _mixed_itl_extra(eng, tok, n_tok=96) -> dict:
@@ -1032,6 +1078,9 @@ def main() -> None:
             # marker skipped the pass)
             extra["ragged_attn"] = _ragged_attn_extra(
                 eng8, extra["mixed_itl"], tok_s8)
+            # tiered KV acceptance: decode overhead on THIS live
+            # engine, capacity multiple on a dedicated pair
+            extra["kv_tiering"] = _kv_tiering_extra(eng8, tok8)
             tok_s, p50_h, p95_h, p50_steady = _bench_http(
                 state, "bench8b", 64, 512, runs=2, extra=extra)
             extra["ttft_p50_ms_8b_http"] = p50_h
@@ -1071,6 +1120,7 @@ def main() -> None:
         # dedicated small engine pair
         extra["ragged_attn"]["warmup"] = _ragged_warmup_compare(
             spec, params, tok)
+        extra["kv_tiering"] = _kv_tiering_extra(eng, tok)
         # smoke HTTP leg: a minimal Application with the in-memory
         # engine registered (the TPU leg exercises the full disk-loader
         # path; here the endpoint plumbing is what's smoke-tested)

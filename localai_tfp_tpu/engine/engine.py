@@ -584,6 +584,22 @@ class LLMEngine:
         self._thread: Optional[threading.Thread] = None
         self.metrics = EngineMetrics()
         self._all_slot_ids = jnp.arange(n_slots, dtype=jnp.int32)
+        # Tiered KV memory (engine/kv_tier.py): hot HBM pages, warm
+        # host-RAM pages (async spill + prefetch), cold on-disk
+        # sessions in the prompt-cache format — resident sessions
+        # become bound by host RAM instead of the arena. Single-chip
+        # paged engines only: multihost/follower engines and
+        # draft-model pairs force it off (spilled main-model pages
+        # would strand the draft cache), and LOCALAI_KV_TIER=off
+        # restores today's behavior byte-identically everywhere.
+        self._tier = None
+        if (self._paged and channel is None and not follower
+                and draft is None
+                and _os.environ.get("LOCALAI_KV_TIER", "on").lower()
+                not in ("0", "off", "false")):
+            from .kv_tier import KVTierManager
+
+            self._tier = KVTierManager(self)
 
         if self._paged:
             _page = self._page
@@ -796,6 +812,13 @@ class LLMEngine:
              and self._pool.held(s.idx)),
             key=lambda s: self._prefix_index.value(s.idx, now))
         for v in victims:
+            if self._tier is not None:
+                # enqueue an async D2H spill FIRST: the reclaim then
+                # DEMOTES the resident prefix to host RAM instead of
+                # discarding it — device-order serialization keeps the
+                # copy coherent across the drop below, and an injected
+                # spill fault simply falls back to today's plain drop
+                self._tier.demote_urgent(v)
             self._pool.drop(v.idx)
             v.cache_tokens = []
             v.n_past = 0
@@ -824,7 +847,8 @@ class LLMEngine:
             return True
         reclaim = sum(
             1 for s in self.slots if not s.active
-            for p in self._pool.table(s.idx) if self._pool.writable(p))
+            for p in self._pool.table(s.idx)
+            if self._pool.writable(p) and not self._pool.pinned(p))
         return st.free + reclaim >= need
 
     def _spec_decode_fn(self, kd: int, rounds: int):
@@ -1888,6 +1912,13 @@ class LLMEngine:
         if self._paged:
             tm.ENGINE_KV_PAGES_IN_USE.labels(model=self._mlabel).set(0)
             tm.ENGINE_KV_PAGES_SHARED.labels(model=self._mlabel).set(0)
+        if self._tier is not None:
+            # land every in-flight tier transfer (pins release, staged
+            # fetches abandon) so pool/tier leak checks stay clean
+            self._tier.close()
+            for tname in ("hbm", "host", "disk"):
+                tm.ENGINE_KV_TIER_PAGES.labels(
+                    model=self._mlabel, tier=tname).set(0)
         if self.mesh is not None:
             # release the process-wide meshed gate so a later unmeshed
             # engine regains the fused int8 kernel (single-owner rule)
@@ -2515,6 +2546,14 @@ class LLMEngine:
                     tm.ENGINE_KV_PAGE_ALLOC.labels(
                         model=m, outcome=outcome).inc(v - prev)
                     self._alloc_sync[outcome] = v
+            if self._tier is not None:
+                # tier residency gauges: host scalars the tier already
+                # tallies (no device syncs, one store per tier)
+                tp = self._tier.tier_pages(st.in_use)
+                for tname, v in tp.items():
+                    tm.ENGINE_KV_TIER_PAGES.labels(
+                        model=m, tier=tname).set(v)
+                FLIGHT.sample("kv_host_pages", "scheduler", tp["host"])
         if not any(s.state is SlotState.DECODE for s in self.slots):
             # decode-stall gaps are only meaningful while a slot
             # decodes; reset the clock when the decode set drains
@@ -2684,6 +2723,12 @@ class LLMEngine:
     # to a GLOBAL prefix cache: radix index over every slot's resident
     # prefix + on-device cross-slot row copies)
     def _admit(self) -> None:
+        if self._tier is not None:
+            # tier policy tick rides the admission pass: harvest landed
+            # spill/fetch DMAs, apply background IO results, expire
+            # stale stages, run the watermark demotion scan. Entirely
+            # non-blocking (TransferWindow.reap + is_ready polling).
+            self._tier.tick()
         with self._lock:
             pending, self._pending = self._pending, []
         if not pending:
@@ -2728,6 +2773,13 @@ class LLMEngine:
             if self._defer_for_prefix(req, forming, now):
                 requeue.append((req, out))
                 continue
+            if (self._tier is not None and req.soft_embeds is None
+                    and self._tier.plan(req, now)):
+                # the session's KV is in the cold tier and its disk
+                # load is inside the deadline window: hold admission
+                # (overlapped with queue wait) instead of re-prefilling
+                requeue.append((req, out))
+                continue
             slot = self._pick_slot(req)
             if slot is None:
                 requeue.append((req, out))  # no free slot
@@ -2737,6 +2789,16 @@ class LLMEngine:
                 # wait for a release instead of admit-then-kill thrash
                 continue
             self._deferred.pop(req.id, None)
+            if self._tier is not None and req.soft_embeds is None:
+                # demote-on-reuse: spill the resident prefix this
+                # assignment is about to discard (gather enqueued
+                # before any overwrite — device-order keeps it
+                # coherent), THEN adopt a staged promotion: the slot's
+                # resident prefix becomes the fetched session (share by
+                # reference), so _assign's ordinary prefix-reuse path
+                # skips those tokens — a prefetch hit re-prefills zero
+                self._tier.capture(slot, req)
+                self._tier.adopt(slot, req)
             self._assign(slot, req, out)
             if req.soft_embeds is None:
                 forming.append(req.prompt_ids)
@@ -2961,7 +3023,9 @@ class LLMEngine:
         if not os.path.exists(path):
             return done("no_file")
         try:
-            data = np.load(path)
+            from .kv_tier import read_cache_file
+
+            data = read_cache_file(path)
             cached_tokens = [int(t) for t in data["tokens"]]
             L, _, _, F = self.cache.k.shape
             k_all, v_all = data["k"], data["v"]
@@ -3044,8 +3108,6 @@ class LLMEngine:
     def _maybe_save_prompt_cache(self, slot: _Slot) -> None:
         """Persist the slot's prefix rows (ref: llama.cpp prompt cache
         save; PromptCacheAll includes the generation)."""
-        import os
-
         req = slot.request
         if req is None or not req.prompt_cache_path or req.prompt_cache_ro \
                 or self.channel is not None:
@@ -3082,24 +3144,14 @@ class LLMEngine:
         path = req.prompt_cache_path
 
         def persist():
-            def host(arr):  # bf16 has no portable numpy encoding
-                out = np.asarray(arr)
-                return out if out.dtype in (np.int8, np.float32) \
-                    else out.astype(np.float32)
+            # the writer is the cold tier's format code (kv_tier.py):
+            # np.asarray here blocks on the gathered rows OFF the
+            # scheduler thread, then the same atomic savez the tier's
+            # background demotion uses
+            from .kv_tier import write_cache_file
 
-            payload = {"tokens": tokens, "k": host(k_rows),
-                       "v": host(v_rows)}
-            if scales is not None:
-                payload["k_scale"] = np.asarray(scales[0])
-                payload["v_scale"] = np.asarray(scales[1])
-            # unique temp name: concurrent saves to one path must not
-            # truncate each other's half-written file before os.replace
-            tmp = f"{path}.tmp.{uuid.uuid4().hex[:8]}"
             try:
-                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-                with open(tmp, "wb") as f:
-                    np.savez(f, **payload)
-                os.replace(tmp, path)
+                write_cache_file(path, tokens, k_rows, v_rows, scales)
             except OSError:
                 pass  # cache persistence is best-effort
 

@@ -24,6 +24,12 @@ Invariants the engine relies on (asserted by ``leak_check``):
 - a page is WRITABLE only while exactly one table references it
   (``writable``); shared pages are full, immutable prefix pages.
 - every free-list page has refcount 0 and appears in no table.
+- a PINNED page (tier transfer in flight — engine/kv_tier.py) never
+  enters the free list: dropping its last table reference parks it in
+  limbo until ``unpin`` releases it, so an in-flight device->host DMA's
+  source pages cannot be reallocated and rewritten under the copy's
+  bookkeeping (device-order already protects the *content*; the pin
+  protects the *accounting*).
 """
 
 from __future__ import annotations
@@ -47,6 +53,7 @@ class PoolStats:
     in_use: int  # distinct allocated pages
     shared: int  # pages referenced by >1 table (zero-copy prefix shares)
     refs: int  # total table entries (>= in_use; the gap is sharing)
+    pinned: int = 0  # pages held by an in-flight tier transfer
 
 
 class PagePool:
@@ -73,6 +80,10 @@ class PagePool:
         # allocation outcomes, exported as
         # engine_kv_page_alloc_total{outcome=...} by the engine
         self.allocs = {"fresh": 0, "shared": 0, "cow": 0}  # lint: guarded-by self._lock
+        # pin counts per page (engine/kv_tier.py spill-in-flight holds):
+        # a pinned page whose refcount drops to 0 parks in limbo instead
+        # of re-entering the free list, until its last unpin
+        self._pins: dict[int, int] = {}  # lint: guarded-by self._lock
 
     # ----------------------------------------------------------- queries
 
@@ -101,6 +112,20 @@ class PagePool:
     def pages_for(self, n_tokens: int) -> int:
         return -(-max(n_tokens, 0) // self.page)
 
+    def pinned(self, pg: int) -> bool:
+        """Whether the page is held by an in-flight tier transfer (a
+        drop would park it in limbo, not free it — reclaim estimates
+        must not count it)."""
+        with self._lock:
+            return pg in self._pins
+
+    def pinned_in(self, slot: int) -> bool:
+        """Whether any page in the slot's table is pinned (a tier spill
+        of this slot is in flight — reclaim must not race its drop)."""
+        with self._lock:
+            return any(pg in self._pins
+                       for pg in self._tables.get(slot, ()))
+
     def stats(self) -> PoolStats:
         with self._lock:
             in_use = (self.n_pages - 1) - len(self._free)
@@ -109,7 +134,8 @@ class PagePool:
             refs = sum(len(t) for t in self._tables.values())
             return PoolStats(total=self.n_pages - 1,
                              free=len(self._free),
-                             in_use=in_use, shared=shared, refs=refs)
+                             in_use=in_use, shared=shared, refs=refs,
+                             pinned=len(self._pins))
 
     # -------------------------------------------------------- allocation
 
@@ -131,8 +157,42 @@ class PagePool:
         self._ref[pg] -= 1
         if self._ref[pg] < 0:
             raise AssertionError(f"page {pg} refcount went negative")
-        if self._ref[pg] == 0:
+        if self._ref[pg] == 0 and pg not in self._pins:
             self._free.append(pg)
+
+    # ----------------------------------------------------------- pinning
+
+    def pin(self, pages) -> None:
+        """Hold ``pages`` out of the free list while a tier transfer is
+        in flight: an unreferenced pinned page parks in limbo instead of
+        becoming allocatable, so the transfer's completion bookkeeping
+        (engine/kv_tier.py) runs against stable page identities."""
+        with self._lock:
+            for pg in pages:
+                if pg == TRASH_PAGE:
+                    continue
+                if self._ref[pg] == 0 and pg not in self._pins:
+                    raise AssertionError(
+                        f"pin of free page {pg}: pin while referenced")
+                self._pins[pg] = self._pins.get(pg, 0) + 1
+
+    def unpin(self, pages) -> None:
+        """Release pins; a page whose last pin drops with refcount 0
+        (its tables were dropped mid-transfer) re-enters the free
+        list here."""
+        with self._lock:
+            for pg in pages:
+                if pg == TRASH_PAGE:
+                    continue
+                n = self._pins.get(pg, 0) - 1
+                if n < 0:
+                    raise AssertionError(f"unpin of unpinned page {pg}")
+                if n:
+                    self._pins[pg] = n
+                else:
+                    del self._pins[pg]
+                    if self._ref[pg] == 0:
+                        self._free.append(pg)
 
     def ensure(self, slot: int, n_tokens: int) -> int:
         """Grow the slot's table to cover positions [0, n_tokens);
@@ -253,6 +313,20 @@ class PagePool:
         live = {pg for t in self._tables.values() for pg in t}
         if live & free:
             raise AssertionError("page both free and table-referenced")
-        if len(live) + len(free) != self.n_pages - 1:
-            raise AssertionError("orphaned pages: neither free nor "
-                                 "referenced")
+        # cross-tier accounting: pins are positive, never on the trash
+        # page, and a pinned-but-unreferenced page sits in limbo —
+        # excluded from the free list until unpin returns it
+        limbo = set()
+        for pg, n in self._pins.items():
+            if n <= 0:
+                raise AssertionError(f"page {pg} has pin count {n}")
+            if pg == TRASH_PAGE:
+                raise AssertionError("trash page pinned")
+            if self._ref[pg] == 0:
+                limbo.add(pg)
+        if limbo & free:
+            raise AssertionError("pinned unreferenced page on the free "
+                                 "list")
+        if len(live) + len(free) + len(limbo) != self.n_pages - 1:
+            raise AssertionError("orphaned pages: neither free, "
+                                 "referenced, nor pinned in limbo")

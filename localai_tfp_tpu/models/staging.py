@@ -47,6 +47,75 @@ def _per_layer(fn, x: jax.Array):
     return fn(x)
 
 
+class TransferWindow:
+    """Bounded-byte window of in-flight host<->device transfers.
+
+    The double-buffer discipline both transfer directions share: enqueue
+    without waiting, track (tag, nbytes, handles) in FIFO order, and
+    bound the bytes in flight so small items stream back-to-back while a
+    budget-sized item keeps the old one-at-a-time memory peak.
+
+    Two completion modes, one per direction:
+
+    - ``drain(need)`` — BLOCKING, host->device (checkpoint commit): pop
+      from the head with ``jax.block_until_ready`` until ``need`` more
+      bytes fit under the budget. The loader thread owns the wait.
+    - ``reap()`` — NON-BLOCKING, device->host (KV tier spill): pop every
+      head entry whose handles are already ready (``is_ready()``) and
+      return them. The engine scheduler polls this between steps, so a
+      spill DMA never blocks a device dispatch.
+    """
+
+    def __init__(self, budget_bytes: int) -> None:
+        self.budget = max(1, budget_bytes)
+        self._q: deque[tuple[Any, int, tuple]] = deque()
+        self.flying = 0  # bytes in flight
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def add(self, tag: Any, nbytes: int, handles: tuple) -> None:
+        """Track an already-enqueued transfer."""
+        self._q.append((tag, nbytes, handles))
+        self.flying += nbytes
+
+    def over(self, need: int) -> bool:
+        """Would ``need`` more in-flight bytes exceed the budget?"""
+        return self.flying + need > self.budget
+
+    def drain(self, need: int) -> None:
+        """Blocking head-pop until ``need`` more bytes fit (an
+        over-budget item waits for an empty pipe)."""
+        while self._q and (self.flying + need > self.budget
+                           or (need > self.budget and self.flying)):
+            _, b, handles = self._q.popleft()
+            for h in handles:
+                jax.block_until_ready(h)
+            self.flying -= b
+
+    def reap(self) -> list:
+        """Non-blocking: pop head entries whose handles are all ready
+        and return their tags (FIFO readiness is monotone per stream,
+        so a not-ready head ends the sweep)."""
+        done = []
+        while self._q:
+            tag, b, handles = self._q[0]
+            if not all(h.is_ready() for h in handles):
+                break
+            self._q.popleft()
+            self.flying -= b
+            done.append(tag)
+        return done
+
+    def flush(self) -> None:
+        """Blocking: complete every tracked transfer."""
+        while self._q:
+            _, b, handles = self._q.popleft()
+            for h in handles:
+                jax.block_until_ready(h)
+            self.flying -= b
+
+
 _PRECISION_BITS = {"bfloat16": (8, 7), "float16": (5, 10)}
 
 
@@ -135,17 +204,12 @@ def commit_deferred(
     names = sorted(params, key=lambda n: _leaf_bytes(params[n]))
     budget = int(os.environ.get(
         "LOCALAI_COMMIT_INFLIGHT_MB", "1024")) * (1 << 20)
-    in_flight: deque[tuple[str, int]] = deque()
-    flying = 0
+    window = TransferWindow(budget)
 
     def drain(need: int) -> None:
-        nonlocal flying
-        while in_flight and (flying + need > budget
-                             or (need > budget and flying)):
-            n, b = in_flight.popleft()
+        if len(window) and window.over(need):
             with timed("transfer_s"):
-                jax.block_until_ready(out[n])
-            flying -= b
+                window.drain(need)
 
     pool = ThreadPoolExecutor(
         max_workers=max(1, readers), thread_name_prefix="ckpt-reader")
@@ -153,7 +217,7 @@ def commit_deferred(
         # prefetch window: materialize the next few lazy leaves while
         # the current one transfers. One leaf per future; window kept
         # small so host RAM holds a few raw stacks, not the whole tree.
-        window = max(1, readers)
+        ahead = max(1, readers)
         futures: dict[str, Any] = {}
         lazy = [n for n in names
                 if isinstance(params[n], DeferredT)
@@ -167,7 +231,7 @@ def commit_deferred(
 
         def top_up() -> None:
             for n in lazy:
-                if len(futures) >= window:
+                if len(futures) >= ahead:
                     break
                 if n not in futures and n in params:
                     futures[n] = pool.submit(_materialize, params[n])
@@ -200,8 +264,7 @@ def commit_deferred(
                         out[name] = jq(x)
                     else:
                         out[name] = jswap(x)
-                in_flight.append((name, nbytes))
-                flying += nbytes
+                window.add(name, nbytes, (out[name],))
                 continue
             nbytes = int(getattr(leaf, "nbytes", 0))
             drain(nbytes)
@@ -221,13 +284,9 @@ def commit_deferred(
                     out[name] = jcast(x) if x.dtype != dtype else x
                 else:
                     out[name] = x
-            in_flight.append((name, nbytes))
-            flying += nbytes
-        while in_flight:
-            n, b = in_flight.popleft()
-            with timed("transfer_s"):
-                jax.block_until_ready(out[n])
-            flying -= b
+            window.add(name, nbytes, (out[name],))
+        with timed("transfer_s"):
+            window.flush()
     finally:
         pool.shutdown(wait=True)
     return out

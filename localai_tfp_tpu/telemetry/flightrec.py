@@ -36,6 +36,11 @@ from .metrics import TIMELINE_RING_EVENTS
 # shared clock origin: every event's ts is perf_counter relative to this
 _T0 = time.perf_counter()
 
+# dedicated timeline thread for KV tier DMA lanes (spill/fetch spans
+# interleave against the "device" track's step spans in Perfetto — the
+# visual proof that a spill never blocks a device step)
+KV_TIER_TRACK = "kv_tier"
+
 
 def _env_capacity() -> int:
     try:
@@ -88,6 +93,20 @@ class FlightRecorder:
         stacked area charts above the track."""
         self.record("C", name, track, time.perf_counter(), 0.0,
                     {"value": value})
+
+    def transfer(self, direction: str, t0: float, dur_s: float,
+                 pages: int, nbytes: int, blocking: bool = False,
+                 track: str = KV_TIER_TRACK) -> None:
+        """A tier DMA lane span (KV spill/fetch/save/load,
+        engine/kv_tier.py): enqueue-to-observed-ready window stamped at
+        harvest like device flights — recording one never forces a
+        sync. ``blocking`` marks a transfer the scheduler WAITED on;
+        the tier's contract (tests/test_kv_tier.py) is that no
+        device-step span ever overlaps a blocking=True transfer,
+        because the tier never records one."""
+        self.record("X", "kv:" + direction, track, t0, dur_s,
+                    {"pages": pages, "bytes": nbytes,
+                     "blocking": blocking})
 
     # ------------------------------------------------------ inspection
 

@@ -173,6 +173,38 @@ ENGINE_KV_HBM_PER_TOKEN = REGISTRY.gauge(
     "pins this at max_seq/mean_context x the ideal",
     labels=("model",),
 )
+# tiered KV memory (engine/kv_tier.py): hot HBM pages, warm host-RAM
+# pages, cold on-disk sessions
+ENGINE_KV_TIER_PAGES = REGISTRY.gauge(
+    "engine_kv_tier_pages_count",
+    "KV pages resident per tier (hbm = pool pages allocated, host = "
+    "spilled pages held in host RAM, disk = pages of cold sessions in "
+    "the on-disk prompt-cache format)",
+    labels=("model", "tier"),
+)
+ENGINE_KV_TIER_MOVES = REGISTRY.counter(
+    "engine_kv_tier_moves_total",
+    "Tier transitions by direction (spill = HBM->host, fetch = "
+    "host->HBM, save = host->disk, load = disk->host) and outcome "
+    "(ok, dedup = shared page already spilled once, fault = injected/"
+    "real DMA failure, aborted = session state changed mid-transfer)",
+    labels=("model", "direction", "outcome"),
+)
+ENGINE_KV_TIER_PREFETCH = REGISTRY.counter(
+    "engine_kv_tier_prefetch_total",
+    "Returning-session promotion attempts at admission (hit = pages "
+    "back in HBM before the prefill slot opened — zero re-prefill, "
+    "late = the transfer missed its admission deadline and the request "
+    "re-prefilled, miss = no tier entry covered the prompt, expired = "
+    "a staged fetch was abandoned before adoption)",
+    labels=("model", "result"),
+)
+ENGINE_KV_TIER_BYTES = REGISTRY.counter(
+    "engine_kv_tier_bytes_moved_total",
+    "Bytes moved between KV tiers by direction (spill/fetch/save/load; "
+    "scale planes included for int8 caches)",
+    labels=("model", "direction"),
+)
 # stall-free mixed prefill+decode dispatch (engine._enqueue_mixed)
 ENGINE_MIXED_DISPATCH = REGISTRY.counter(
     "engine_mixed_dispatch_total",

@@ -13,6 +13,12 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # quantized-load artifacts would leak between runs via ~/.cache and flip
 # which load path a test exercises; the dedicated tests opt back in
 os.environ.setdefault("LOCALAI_QUANT_ARTIFACTS", "off")
+# worker loads precompile the full dispatch-variant ladder by default —
+# a TTFT guarantee tests don't need (each test touches 1-2 variants,
+# which jit on first use). Warmup itself is covered by test_engine
+# calling engine.warmup() directly; the opt-out keeps every
+# worker-backed module (server/loader/quant/staging) minutes cheaper.
+os.environ.setdefault("LOCALAI_WARMUP", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -25,6 +31,13 @@ import pytest  # noqa: E402
 # fp32 numerics-parity tests must not be silently truncated to bf16 by the
 # backend's default matmul precision (oneDNN on CPU does exactly that).
 jax.config.update("jax_default_matmul_precision", "highest")
+
+# NOTE: do NOT enable jax_compilation_cache_dir here. On this jax/XLA
+# CPU build, executables with donated buffers reload from the persistent
+# cache with broken input/output aliasing — engine decode outputs then
+# diverge numerically (test_greedy_tracks_reference_argmax catches it).
+# Verified by bisection: cache off passes, warm cache fails, at any
+# min_compile_time threshold.
 
 # A TPU plugin may be registered ahead of CPU (e.g. the axon platform in
 # the dev image) and would otherwise claim every un-annotated computation.

@@ -11,6 +11,7 @@ survival contract says faults in one test must leave the engine fit
 for the next, so sharing IS part of the assertion (and keeps the
 module's tier-1 wall time down on 1-core CI hosts)."""
 
+import os
 import queue
 import time
 
@@ -328,6 +329,110 @@ def test_multihost_publish_fault_raises():
     with pytest.raises(fi.InjectedFault):
         ch.publish("stop", {"model": "m"})
     ch.publish("stop", {"model": "m"})  # channel survives
+
+
+# ---------------------------------------------------------------------------
+# kv_tier.spill / kv_tier.fetch (engine/kv_tier.py)
+
+
+@pytest.fixture(scope="module")
+def tier_eng(model):
+    """A tiered engine: 16-token pages make every ~50-char session
+    spill-worthy, so slot churn exercises the DMA fault points."""
+    spec, params, tk = model
+    saved = {k: os.environ.get(k)
+             for k in ("LOCALAI_KV_PAGE", "LOCALAI_KV_TIER",
+                       "LOCALAI_KV_TIER_IDLE_S")}
+    os.environ["LOCALAI_KV_PAGE"] = "16"
+    os.environ["LOCALAI_KV_TIER"] = "on"
+    os.environ["LOCALAI_KV_TIER_IDLE_S"] = "0"
+    try:
+        e = LLMEngine(spec, params, tk, n_slots=4, max_seq=128,
+                      prefill_buckets=(8, 32, 128),
+                      cache_dtype=jnp.float32)
+        assert e._tier is not None
+        yield e
+        e.close()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _tier_wave(eng, prompts):
+    reqs = [GenRequest(prompt_ids=eng.tokenize(p), max_tokens=4,
+                       ignore_eos=True) for p in prompts]
+    finals = []
+    for q in eng.submit_many(reqs):
+        evs, final = _drain(q)
+        finals.append(final)
+        _assert_single_terminal(q, final)
+    return finals
+
+
+def _tier_settle(eng):
+    _settle_and_leak_check(eng)
+    eng._tier.settle()
+    eng._tier.leak_check()
+    eng._pool.leak_check()
+
+
+def test_kv_tier_spill_fault_is_invisible_to_requests(tier_eng):
+    """An injected DMA failure on the spill path abandons the demotion
+    BEFORE any bookkeeping: the evicting request is served normally
+    (the session simply re-prefills when it returns), and both the
+    pool and the tier stay leak_check-clean."""
+    eng = tier_eng
+    faults0 = eng._tier.counters["spill_faults"]
+    for f in _tier_wave(eng, [f"sf seed {i} " + "a " * 16
+                              for i in range(4)]):
+        assert f.finish_reason == "length"
+    fi.arm("kv_tier.spill:fail@1")
+    # the churn wave reassigns every slot: the first capture-spill eats
+    # the fault, the rest proceed — no request sees any of it
+    for f in _tier_wave(eng, [f"sf churn {i} " + "b " * 16
+                              for i in range(4)]):
+        assert f.finish_reason == "length"
+    fi.disarm()
+    _tier_settle(eng)
+    assert eng._tier.counters["spill_faults"] == faults0 + 1
+    assert eng._tier.stats()["spills"] >= 3
+
+
+def test_kv_tier_fetch_fault_falls_back_to_reprefill(tier_eng):
+    """An injected failure on the promotion path must degrade to
+    today's behavior: the request admits normally, re-prefills, and
+    finishes with exactly one terminal event; the warm entry survives
+    for the next attempt and nothing leaks."""
+    eng = tier_eng
+    session = "ff returning user " + "c " * 16 + "end"
+    for f in _tier_wave(eng, [session]):
+        assert f.finish_reason == "length"
+    # churn the session out of every slot so a return NEEDS the tier
+    _tier_wave(eng, [f"ff churn {i} " + "d " * 16 for i in range(4)])
+    _tier_settle(eng)
+    warm0 = eng._tier.stats()["entries_warm"]
+    assert warm0 >= 1
+    faults0 = eng._tier.counters["fetch_faults"]
+    late0 = eng._tier.counters["prefetch_late"]
+    fi.arm("kv_tier.fetch:fail@1")
+    (final,) = _tier_wave(eng, [session])
+    assert final.finish_reason == "length"
+    assert final.completion_tokens == 4
+    fi.disarm()
+    _tier_settle(eng)
+    assert eng._tier.counters["fetch_faults"] == faults0 + 1
+    assert eng._tier.counters["prefetch_late"] == late0 + 1
+    # the entry is still warm: the NEXT return prefetches cleanly
+    assert eng._tier.stats()["entries_warm"] >= warm0
+    hits0 = eng._tier.counters["prefetch_hit"]
+    _tier_wave(eng, [f"ff churn2 {i} " + "e " * 16 for i in range(4)])
+    (final,) = _tier_wave(eng, [session])
+    assert final.finish_reason == "length"
+    _tier_settle(eng)
+    assert eng._tier.counters["prefetch_hit"] == hits0 + 1
 
 
 def test_multihost_publish_fault_fails_wave_engine_survives(model):

@@ -45,6 +45,10 @@ REQUIRED_FAMILIES = {
     "engine_kv_pages_shared_count",
     "engine_kv_page_alloc_total",
     "engine_kv_hbm_per_live_token_bytes",
+    "engine_kv_tier_pages_count",
+    "engine_kv_tier_moves_total",
+    "engine_kv_tier_prefetch_total",
+    "engine_kv_tier_bytes_moved_total",
     "engine_dispatch_compile_variants_count",
     "engine_ragged_rows_total",
     "engine_requests_shed_total",

@@ -23,6 +23,17 @@ requests, sampling the pool every 50 ms. Reports peak/mean occupancy
 and HBM-per-live-token across the run — the series that shows the
 arena tracking expected context while traffic churns.
 
+  python tools/profile_kv.py --returning-users [--small] [--users N]
+
+measures the tiered KV memory claim (engine/kv_tier.py): N distinct
+sessions (N > n_slots) are served through slot churn, then every user
+RETURNS. With LOCALAI_KV_TIER=off a returning session re-prefills
+unless it still sits in a slot; with the tier on, demoted sessions are
+prefetched back from host RAM. Reports resident-session capacity
+(off vs on, and the multiple), prefetch hit rate, re-prefill tokens
+avoided, and re-prefill tokens paid on hits (must be ZERO — a hit
+promotes the full covered prefix by reference).
+
 ``--small`` runs the tiny CPU config (smoke) with a 16-token page so
 page-granular sharing is visible at toy prompt lengths.
 """
@@ -228,6 +239,105 @@ def mixed_shape(small: bool, n_streams: int, n_bursts: int,
     return out
 
 
+def _resident_sessions(eng, ids) -> int:
+    """Sessions whose full prompt KV is still reachable without a
+    re-prefill: resident in a slot, or promotable from the tier."""
+
+    def covered(pid) -> bool:
+        need = len(pid) - 1  # the relogit token always reprocesses
+        if any(_common(s.cache_tokens, pid) >= need for s in eng.slots):
+            return True
+        tier = getattr(eng, "_tier", None)
+        if tier is not None:
+            _, n = tier._lookup(pid)
+            return n >= need
+        return False
+
+    return sum(1 for pid in ids if covered(pid))
+
+
+def _common(a, b) -> int:
+    n = min(len(a), len(b))
+    i = 0
+    while i < n and a[i] == b[i]:
+        i += 1
+    return i
+
+
+def returning_users_shape(small: bool, n_users: int) -> dict:
+    """Churn n_users distinct sessions through the slots, then have
+    every user return — tier off vs on, same traffic."""
+    from localai_tfp_tpu.engine.engine import GenRequest
+
+    out: dict = {"users": n_users}
+    saved = os.environ.get("LOCALAI_KV_TIER")
+    try:
+        for mode in ("off", "on"):
+            os.environ["LOCALAI_KV_TIER"] = mode
+            eng, tok, _, _ = _build(small)
+            tier = getattr(eng, "_tier", None)
+            ids = [tok.encode(f"user {i:03d} " + "ctx " * 12
+                              + f"tail {i}")
+                   for i in range(n_users)]
+            total_prompt = sum(len(i) for i in ids)
+
+            def serve(round_ids):
+                for lo in range(0, len(round_ids), eng.n_slots):
+                    _drain_all(eng.submit_many([
+                        GenRequest(prompt_ids=pid, max_tokens=4,
+                                   temperature=0.0, ignore_eos=True)
+                        for pid in round_ids[lo:lo + eng.n_slots]]))
+                if tier is not None:
+                    tier.settle()
+
+            try:
+                serve(ids)  # round 1: every session served once
+                blk: dict = {
+                    "resident_sessions": _resident_sessions(eng, ids),
+                }
+                reused0 = eng.metrics.prefix_reused_tokens
+                t0 = (dict(tier.counters) if tier is not None else {})
+                wall = time.perf_counter()
+                serve(ids)  # round 2: every user returns
+                wall = time.perf_counter() - wall
+                reused = eng.metrics.prefix_reused_tokens - reused0
+                blk["return_wall_s"] = round(wall, 3)
+                blk["reprefill_tokens"] = total_prompt - reused
+                blk["reused_tokens"] = reused
+                if tier is not None:
+                    tc = {k: tier.counters[k] - t0.get(k, 0)
+                          for k in tier.counters}
+                    ret = tc["prefetch_hit"] + tc["prefetch_late"] \
+                        + tc["prefetch_miss"]
+                    blk["prefetch_hits"] = tc["prefetch_hit"]
+                    blk["prefetch_hit_rate"] = round(
+                        tc["prefetch_hit"] / max(ret, 1), 3)
+                    blk["tier_reused_tokens"] = tc["reused_tokens"]
+                    # a hit promotes the full covered prompt (less the
+                    # relogit token): re-prefill paid on hits must be 0
+                    blk["reprefill_tokens_on_hits"] = (
+                        tc["prefetch_hit"] * (len(ids[0]) - 1)
+                        - min(tc["reused_tokens"],
+                              tc["prefetch_hit"] * (len(ids[0]) - 1)))
+                    blk["tier"] = {k: v for k, v in
+                                   tier.stats().items() if v}
+                    tier.leak_check()
+                if eng._paged:
+                    eng._pool.leak_check()
+                out[mode] = blk
+            finally:
+                eng.close()
+    finally:
+        if saved is None:
+            os.environ.pop("LOCALAI_KV_TIER", None)
+        else:
+            os.environ["LOCALAI_KV_TIER"] = saved
+    out["capacity_multiple"] = round(
+        out["on"]["resident_sessions"]
+        / max(out["off"]["resident_sessions"], 1), 2)
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--small", action="store_true",
@@ -236,14 +346,18 @@ def main() -> None:
                     help="shared-prefix burst vs distinct burst")
     ap.add_argument("--mixed", action="store_true",
                     help="sustained streams + admission bursts")
+    ap.add_argument("--returning-users", action="store_true",
+                    help="session churn + return: KV tiering on vs off")
+    ap.add_argument("--users", type=int, default=16)
     ap.add_argument("--requests", type=int, default=8)
     ap.add_argument("--prefix-tokens", type=int, default=96)
     ap.add_argument("--streams", type=int, default=4)
     ap.add_argument("--bursts", type=int, default=3)
     ap.add_argument("--burst-size", type=int, default=4)
     args = ap.parse_args()
-    if not (args.shared_prefix or args.mixed):
-        ap.error("pick a traffic shape: --shared-prefix and/or --mixed")
+    if not (args.shared_prefix or args.mixed or args.returning_users):
+        ap.error("pick a traffic shape: --shared-prefix, --mixed "
+                 "and/or --returning-users")
     report: dict = {}
     if args.shared_prefix:
         report["shared_prefix"] = shared_prefix_shape(
@@ -251,6 +365,9 @@ def main() -> None:
     if args.mixed:
         report["mixed"] = mixed_shape(args.small, args.streams,
                                       args.bursts, args.burst_size)
+    if args.returning_users:
+        report["returning_users"] = returning_users_shape(
+            args.small, args.users)
     # ragged paged attention: jit-cache variant counts + warmup wall
     # time, on vs off — the compile-variant collapse next to the pool
     # numbers it rides on
