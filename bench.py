@@ -221,6 +221,124 @@ def ragged_variant_report() -> dict:
     return _ragged_warmup_compare(spec, params, tk)
 
 
+def meshed_paged_report() -> dict:
+    """Pod-scale paged serving block on THIS process's visible devices:
+    a dedicated tiny engine pair on a data x model mesh — sharded page
+    arena + ragged dispatch shapes ON vs the dense meshed path OFF —
+    reporting decode tok/s, warmup wall time + compiled variant count
+    (the collapsed ladder must reach meshed engines too), and the mesh
+    fan-out. Standalone so the TPU leg and a forced-host-device
+    subprocess (CPU smoke) share one code path."""
+    import os as _os
+    import time as _time
+
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    from localai_tfp_tpu.engine.engine import LLMEngine
+    from localai_tfp_tpu.engine.tokenizer import ByteTokenizer
+    from localai_tfp_tpu.models.llm_spec import tiny_spec
+    from localai_tfp_tpu.models.transformer import init_params
+    from localai_tfp_tpu.parallel.mesh import make_mesh
+
+    devs = _jax.devices()
+    tk = ByteTokenizer()
+    spec = tiny_spec(vocab_size=tk.vocab_size, max_position=512)
+    n = len(devs)
+    model_ax = next((m for m in (4, 2)
+                     if n % m == 0 and spec.kv_dim % m == 0), 1)
+    if model_ax == 1:
+        return {"enabled": False,
+                "reason": f"no tensor-parallel factor of kv_dim="
+                          f"{spec.kv_dim} fits {n} device(s)"}
+    # tp-heavy factoring: the 2-slot batch must divide the data axis
+    data_ax = 2 if (n // model_ax) % 2 == 0 else 1
+    mesh = make_mesh({"data": data_ax, "seq": 1, "model": model_ax},
+                     devices=devs[:data_ax * model_ax])
+    params = init_params(_jax.random.PRNGKey(0), spec,
+                         dtype=_jnp.float32)
+    out: dict = {"enabled": True, "mesh_devices": data_ax * model_ax,
+                 "mesh_data": data_ax, "mesh_model": model_ax}
+    prev = _os.environ.get("LOCALAI_PAGED_KV")
+    try:
+        for paged in (True, False):
+            _os.environ["LOCALAI_PAGED_KV"] = "on" if paged else "off"
+            # max_seq above the 256 window floor so the dense meshed
+            # ladder is real and the ragged variant collapse is visible
+            eng = LLMEngine(spec, params, tk, n_slots=2, max_seq=1024,
+                            prefill_buckets=(8, 32), decode_steps=4,
+                            cache_dtype=_jnp.float32, mesh=mesh,
+                            autostart=False)
+            try:
+                if eng._paged != paged:
+                    return {"enabled": False,
+                            "reason": "engine ignored LOCALAI_PAGED_KV="
+                                      f"{'on' if paged else 'off'} on "
+                                      "this mesh"}
+                t0 = _time.perf_counter()
+                eng.warmup()
+                wall = round(_time.perf_counter() - t0, 2)
+                eng.start()
+                tok_s, _, _ = _bench_config(eng, tk, 4, 32, runs=1)
+                if paged:
+                    eng._pool.leak_check()
+                out["paged_on" if paged else "paged_off"] = {
+                    "decode_tok_s": tok_s,
+                    "warmup_s": wall,
+                    "warmup_variants": int(eng.warmup_variants),
+                }
+            finally:
+                eng.close()
+    finally:
+        if prev is None:
+            _os.environ.pop("LOCALAI_PAGED_KV", None)
+        else:
+            _os.environ["LOCALAI_PAGED_KV"] = prev
+    return out
+
+
+def _meshed_paged_extra() -> dict:
+    """Pod-scale acceptance block (extra.meshed_paged): run
+    meshed_paged_report in-process when this process already sees >=2
+    devices (the TPU leg), else re-enter bench.py in a child with 8
+    forced host devices — the backend here is initialized by the time
+    extras run, so the device-count force cannot be applied in-process
+    (same constraint __graft_entry__._pin_cpu documents)."""
+    import jax as _jax
+
+    if len(_jax.devices()) >= 2:
+        out = meshed_paged_report()
+        out["subprocess"] = False
+        return out
+    import json as _json
+    import os as _os
+    import subprocess as _sp
+    import sys as _sys
+
+    from __graft_entry__ import _force_host_devices
+
+    env = dict(_os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = _force_host_devices(env.get("XLA_FLAGS", ""), 8)
+    code = ("import json, bench; print('MESHED_PAGED ' "
+            "+ json.dumps(bench.meshed_paged_report()))")
+    try:
+        proc = _sp.run(
+            [_sys.executable, "-c", code], env=env,
+            cwd=_os.path.dirname(_os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=900)
+        for line in proc.stdout.splitlines():
+            if line.startswith("MESHED_PAGED "):
+                out = _json.loads(line[len("MESHED_PAGED "):])
+                out["subprocess"] = True
+                return out
+        return {"enabled": False,
+                "reason": f"subprocess leg gave no report (rc="
+                          f"{proc.returncode}): {proc.stderr[-400:]}"}
+    except Exception as e:  # noqa: BLE001 - bench must emit its line
+        return {"enabled": False, "reason": f"subprocess leg died: {e}"}
+
+
 def _kv_tiering_extra(eng, tok) -> dict:
     """KV tiering acceptance block (extra.kv_tiering): the live
     engine's decode throughput with the tier armed vs disarmed,
@@ -1166,6 +1284,10 @@ def main() -> None:
         extra["ttft_p50_ms"] = p50
         extra["ttft_p50_ms_http"] = p50_h
 
+    # pod-scale paged serving: builds its own meshed engine pair (or a
+    # forced-host-device child on single-device smokes), so it is not
+    # subject to the _LIVE_ENGINE_EXTRAS ordering guard
+    extra["meshed_paged"] = _meshed_paged_extra()
     extra["chaos"] = _chaos_extra()
     extra["tracing"] = _tracing_extra()
     extra["lint"] = _lint_extra()

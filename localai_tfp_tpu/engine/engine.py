@@ -362,6 +362,40 @@ def _window_cache(cache: KVCache, window: int):
     return win, restore
 
 
+def _pin_win_sharding(win: KVCache, mesh, batch: bool) -> KVCache:
+    """Constrain a gathered window view [L, B, W, F] on a mesh. With
+    ``batch`` True the slot dim rides "data" and F rides "model" — the
+    DENSE cache's exact layout, which is the only window placement
+    whose jitted forward is numerically correct on a data x model mesh:
+    with the slot dim replicated (F-sharded or fully replicated alike),
+    GSPMD picks a partitioning for the fused gather -> forward ->
+    scatter program that computes O(1)-wrong hidden states and KV
+    writes (jit vs eager diverges on the written pages). With ``batch``
+    False the window is pinned back to the ARENA's layout (slot dim
+    replicated, F over "model") so the writeback scatter sees updates
+    shaped like its data-replicated operand. Scale planes are global
+    per-row amax, replicated either way."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as _P
+
+    from ..parallel.sharding import _divisible_spec
+
+    row_sp = _P(None, "data", None, "model") if batch \
+        else _P(None, None, None, "model")
+    plane_sp = _P()
+
+    def pin(a, sp):
+        sp = _divisible_spec(a.shape, sp, mesh)
+        return jax.lax.with_sharding_constraint(
+            a, NamedSharding(mesh, sp))
+
+    return KVCache(
+        k=pin(win.k, row_sp), v=pin(win.v, row_sp),
+        k_scale=pin(win.k_scale, plane_sp) if win.quantized else None,
+        v_scale=pin(win.v_scale, plane_sp) if win.quantized else None,
+    )
+
+
 def _sample_masked(sampling, slot_ids, logits, active, masks):
     toks, new_sampling = sample(sampling, slot_ids, logits, mask=masks)
     merged = jax.tree_util.tree_map(
@@ -441,11 +475,22 @@ class LLMEngine:
         # every slot through host-owned page tables, so HBM scales with
         # live tokens and prefix pages share by reference. Dispatches
         # carry the tables as plain index arrays (multihost-replayable).
-        # LOCALAI_PAGED_KV=off restores the dense per-slot cache;
-        # meshed serving always takes the dense path (the arena cannot
-        # be GSPMD-sharded by slot).
-        self._paged = mesh is None and _os.environ.get(
-            "LOCALAI_PAGED_KV", "on").lower() not in ("0", "off", "false")
+        # LOCALAI_PAGED_KV=off restores the dense per-slot cache.
+        # Meshed serving pages too: the arena has no slot dim, so it
+        # shards its head-flat F dim over "model"
+        # (parallel/sharding.PAGED_KV_SPEC — each device holds its
+        # kv-head slice of EVERY page) while the host-owned page tables
+        # stay global. Two meshed carve-outs stay dense: seq-sharded
+        # meshes (the paged prefill path has no ring-attention branch)
+        # and kv_dim not dividing the tp axis (shard_engine_state would
+        # reject the silent-replication fallback).
+        mesh_seq = 1 if mesh is None else mesh.shape.get("seq", 1)
+        mesh_tp = 1 if mesh is None else mesh.shape.get("model", 1)
+        self._paged = (
+            (mesh is None
+             or (mesh_seq == 1 and spec.kv_dim % mesh_tp == 0))
+            and _os.environ.get("LOCALAI_PAGED_KV", "on").lower()
+            not in ("0", "off", "false"))
         # page size: largest power of two <= min(256, max_seq) dividing
         # max_seq, so every window bucket (powers of two >= 256, capped
         # at max_seq) is page-aligned; LOCALAI_KV_PAGE overrides within
@@ -517,10 +562,37 @@ class LLMEngine:
             quant.set_meshed_serving(True)
             self.params = shard_params(self.params, mesh)
             self.cache, self.sampling = shard_engine_state(
-                self.cache, self.sampling, mesh
+                self.cache, self.sampling, mesh, paged=self._paged
             )
+            if (self._paged and self.draft_cache is not None
+                    and draft[0].kv_dim % mesh_tp == 0):
+                # the draft arena shares the pool's geometry/tables, so
+                # it shards the same way; a non-divisible draft kv_dim
+                # stays replicated (the spec paths then run the GSPMD
+                # gather fallback — _kernel_eligible gates the shard_map
+                # route on draft eligibility)
+                from ..parallel.sharding import PAGED_KV_SPEC
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as _P
+
+                def _put_arena(arr, sp):
+                    return jax.device_put(arr, NamedSharding(mesh, sp))
+
+                dc = self.draft_cache
+                self.draft_cache = type(dc)(
+                    k=_put_arena(dc.k, PAGED_KV_SPEC),
+                    v=_put_arena(dc.v, PAGED_KV_SPEC),
+                    k_scale=(_put_arena(dc.k_scale, _P())
+                             if dc.quantized else None),
+                    v_scale=(_put_arena(dc.v_scale, _P())
+                             if dc.quantized else None),
+                )
         self.slots = [_Slot(i) for i in range(n_slots)]
         self._use_kernel = self._kernel_eligible()
+        # the replica's tensor-parallel footprint on /metrics: how many
+        # devices this engine's dispatches fan out over (1 unsharded)
+        tm.ENGINE_MESH_DEVICES.labels(model=self._mlabel).set(
+            1 if mesh is None else int(mesh.devices.size))
         # cross-slot prefix cache: radix index over every slot's
         # resident cache_tokens + on-device row-to-row KV copies
         # (engine/prefix_index.py). LOCALAI_PREFIX_CACHE=off restores
@@ -593,8 +665,11 @@ class LLMEngine:
         # would strand the draft cache), and LOCALAI_KV_TIER=off
         # restores today's behavior byte-identically everywhere.
         self._tier = None
+        # meshed engines force tiering off until spill learns to gather
+        # the model-sharded arena (a host copy of a PAGED_KV_SPEC page
+        # would be an implicit cross-shard all-gather per spill)
         if (self._paged and channel is None and not follower
-                and draft is None
+                and draft is None and mesh is None
                 and _os.environ.get("LOCALAI_KV_TIER", "on").lower()
                 not in ("0", "off", "false")):
             from .kv_tier import KVTierManager
@@ -613,6 +688,7 @@ class LLMEngine:
                     # instead of their own tail pages)
                     logits, cache = forward(
                         spec, params, tokens, pos0, cache, None,
+                        mesh=self.mesh,
                         page_table=phys, kv_page=_page,
                         q_lens=jnp.ones(tokens.shape[:1], jnp.int32),
                         write_table=wb,
@@ -626,9 +702,18 @@ class LLMEngine:
                     )
                 else:
                     win = gather_kv_pages(cache, phys, _page)
+                    if self.mesh is not None:
+                        # forward on the dense window layout, scatter on
+                        # the arena's (_pin_win_sharding: GSPMD
+                        # miscompiles any replicated-slot-dim window)
+                        win = _pin_win_sharding(win, self.mesh,
+                                                batch=True)
                     logits, win = forward(
                         spec, params, tokens, pos0, win, None, False,
                     )
+                    if self.mesh is not None:
+                        win = _pin_win_sharding(win, self.mesh,
+                                                batch=False)
                     cache = scatter_kv_pages(cache, win, wb, _page)
                 last = logits[:, -1, :]
                 toks, sampling = _sample_masked(sampling, slot_ids, last,
@@ -725,16 +810,42 @@ class LLMEngine:
 
         forced = env in ("1", "true", "on")
         if self.mesh is not None:
-            # meshed serving runs the kernel per-shard under shard_map
-            # (ops.decode_attention.sharded_append_attend); shapes must
-            # split evenly over the mesh axes
-            from ..ops.decode_attention import mesh_kernel_eligible
+            # meshed serving runs the kernel per-shard under shard_map;
+            # shapes must split evenly over the mesh axes
+            if self._paged:
+                # paged meshed engines have exactly ONE kernel route:
+                # the ragged kernel over the model-sharded arena
+                # (ops.ragged_paged_attention.sharded_ragged_append_
+                # attend). The fused decode kernel's meshed wrapper
+                # addresses the DENSE [L, S, SEQ, F] layout, so with
+                # ragged off the engine takes the GSPMD gather
+                # fallback instead.
+                from ..ops.ragged_paged_attention import (
+                    mesh_ragged_eligible,
+                )
 
-            if not mesh_kernel_eligible(
-                self.mesh, self.spec.n_kv_heads, self.spec.n_heads,
-                self.spec.kv_dim, self.n_slots,
-            ):
-                return False
+                if not self._ragged or not mesh_ragged_eligible(
+                    self.mesh, self.spec.n_kv_heads, self.spec.n_heads,
+                    self.spec.kv_dim,
+                ):
+                    return False
+                if self.draft is not None and not mesh_ragged_eligible(
+                    self.mesh, self.draft[0].n_kv_heads,
+                    self.draft[0].n_heads, self.draft[0].kv_dim,
+                ):
+                    # spec-decode rounds run the draft through the same
+                    # shard_map route; an ineligible draft keeps the
+                    # whole engine on the GSPMD gather fallback
+                    return False
+            else:
+                # dense meshed: ops.decode_attention.sharded_append_attend
+                from ..ops.decode_attention import mesh_kernel_eligible
+
+                if not mesh_kernel_eligible(
+                    self.mesh, self.spec.n_kv_heads, self.spec.n_heads,
+                    self.spec.kv_dim, self.n_slots,
+                ):
+                    return False
         return (
             (forced or not _interpret())
             # paged arenas DMA whole pool pages (page-table lookups), so
@@ -869,6 +980,7 @@ class LLMEngine:
         dspec = self.draft[0]  # static; draft params passed per call
         paged = self._paged
         page = self._page
+        mesh = self.mesh
         ragged_k = self._ragged and self._use_kernel
 
         @partial(jax.jit, donate_argnums=(2, 3))
@@ -889,13 +1001,17 @@ class LLMEngine:
                 phys, wb = paged_tables
                 cache = gather_kv_pages(arena, phys, page)
                 dcache = gather_kv_pages(darena, phys, page)
+                if mesh is not None:
+                    cache = _pin_win_sharding(cache, mesh, batch=True)
+                    dcache = _pin_win_sharding(dcache, mesh, batch=True)
             ones = jnp.ones(tokens.shape[:1], jnp.int32)
 
             def rag(n):
                 if not ragged_k:
                     return {}
-                return {"page_table": phys, "kv_page": page,
-                        "q_lens": ones * n, "write_table": wb}
+                return {"mesh": mesh, "page_table": phys,
+                        "kv_page": page, "q_lens": ones * n,
+                        "write_table": wb}
 
             def round_(carry, _):
                 tok, pos, cache, dcache = carry
@@ -931,6 +1047,9 @@ class LLMEngine:
             (tok_f, pos_f, cache, dcache), (D, Mt, J) = lax.scan(
                 round_, (tokens, pos0, cache, dcache), None, length=rounds)
             if paged and not ragged_k:
+                if mesh is not None:
+                    cache = _pin_win_sharding(cache, mesh, batch=False)
+                    dcache = _pin_win_sharding(dcache, mesh, batch=False)
                 cache = scatter_kv_pages(arena, cache, wb, page)
                 dcache = scatter_kv_pages(darena, dcache, wb, page)
             return D, Mt, J, tok_f, pos_f, cache, dcache
@@ -975,6 +1094,7 @@ class LLMEngine:
 
         paged = self._paged
         page = self._page
+        mesh = self.mesh
         ragged_k = self._ragged and self._use_kernel
 
         @partial(jax.jit, donate_argnums=(3, 4))
@@ -988,6 +1108,9 @@ class LLMEngine:
                 phys, wb = paged_tables
                 cache = gather_kv_pages(arena, phys, page)
                 dcache = gather_kv_pages(darena, phys, page)
+                if mesh is not None:
+                    cache = _pin_win_sharding(cache, mesh, batch=True)
+                    dcache = _pin_win_sharding(dcache, mesh, batch=True)
             all_slots = jnp.arange(S, dtype=jnp.int32)
             rep_slots = jnp.repeat(all_slots, kd)
             ones = jnp.ones(tokens.shape[:1], jnp.int32)
@@ -995,8 +1118,9 @@ class LLMEngine:
             def rag(n):
                 if not ragged_k:
                     return {}
-                return {"page_table": phys, "kv_page": page,
-                        "q_lens": ones * n, "write_table": wb}
+                return {"mesh": mesh, "page_table": phys,
+                        "kv_page": page, "q_lens": ones * n,
+                        "write_table": wb}
 
             def round_(carry, _):
                 tok, pos, cache, dcache, rng = carry
@@ -1069,6 +1193,9 @@ class LLMEngine:
                 round_, (tokens, pos0, cache, dcache, sampling.rng),
                 None, length=rounds)
             if paged and not ragged_k:
+                if mesh is not None:
+                    cache = _pin_win_sharding(cache, mesh, batch=False)
+                    dcache = _pin_win_sharding(dcache, mesh, batch=False)
                 cache = scatter_kv_pages(arena, cache, wb, page)
                 dcache = scatter_kv_pages(darena, dcache, wb, page)
             return D, Fin, J, rng, cache, dcache
@@ -1111,12 +1238,16 @@ class LLMEngine:
                                      jnp.int32)
                     _, cache = forward_hidden(
                         spec, params, tokens, pos0, cache, None,
-                        soft=soft, page_table=phys, kv_page=page,
-                        q_lens=qlens, write_table=wb)
+                        soft=soft, mesh=mesh, page_table=phys,
+                        kv_page=page, q_lens=qlens, write_table=wb)
                     return cache
                 win = gather_kv_pages(cache, phys, page)
+                if mesh is not None:
+                    win = _pin_win_sharding(win, mesh, batch=True)
                 _, win = forward_hidden(spec, params, tokens, pos0, win,
                                         None, soft=soft)
+                if mesh is not None:
+                    win = _pin_win_sharding(win, mesh, batch=False)
                 return scatter_kv_pages(cache, win, wb, page)
         else:
             @partial(jax.jit, donate_argnums=(2,))
@@ -1163,6 +1294,7 @@ class LLMEngine:
         n_slots = self.n_slots
         paged = self._paged
         page = self._page
+        mesh = self.mesh
         ragged_k = self._ragged and self._use_kernel
 
         @partial(jax.jit, donate_argnums=(2, 4))
@@ -1177,16 +1309,20 @@ class LLMEngine:
                 phys, wb = paged_tables
                 hidden, cache = forward_hidden(
                     spec, params, tokens, pos0, cache, None, soft=soft,
-                    page_table=phys, kv_page=page, q_lens=n_chunk,
-                    write_table=wb)
+                    mesh=mesh, page_table=phys, kv_page=page,
+                    q_lens=n_chunk, write_table=wb)
             elif paged:
                 # paged: rows map to slots via phys/wb; parked and pad
                 # rows simply never write back (their wb pages are
                 # trash), so no write_mask is needed
                 phys, wb = paged_tables
                 win = gather_kv_pages(cache, phys, page)
+                if mesh is not None:
+                    win = _pin_win_sharding(win, mesh, batch=True)
                 hidden, win = forward_hidden(
                     spec, params, tokens, pos0, win, None, soft=soft)
+                if mesh is not None:
+                    win = _pin_win_sharding(win, mesh, batch=False)
                 cache = scatter_kv_pages(cache, win, wb, page)
             else:
                 win, restore = _window_cache(cache, window)
@@ -1262,6 +1398,7 @@ class LLMEngine:
         spec = self.spec
         paged = self._paged
         page = self._page
+        mesh = self.mesh
         ragged_k = self._ragged and self._use_kernel
 
         @partial(jax.jit, donate_argnums=(2, 4))
@@ -1278,16 +1415,24 @@ class LLMEngine:
                 phys, wb = paged_tables
                 hidden, cache = forward_hidden(
                     spec, params, tokens, pos0, cache, None, soft=soft,
-                    page_table=phys, kv_page=page, q_lens=n_chunk,
-                    write_table=wb)
+                    mesh=mesh, page_table=phys, kv_page=page,
+                    q_lens=n_chunk, write_table=wb)
             elif paged:
                 # paged: per-row write spans live in wb (parked rows and
                 # shared prefix pages are trash-redirected), so the
                 # write_mask no-op rewrite is unnecessary
                 phys, wb = paged_tables
                 win = gather_kv_pages(cache, phys, page)
+                if mesh is not None:
+                    # run the forward on the DENSE cache's window layout
+                    # and scatter on the arena's (_pin_win_sharding: any
+                    # replicated-slot-dim window is miscompiled by GSPMD
+                    # on a data x model mesh)
+                    win = _pin_win_sharding(win, mesh, batch=True)
                 hidden, win = forward_hidden(
                     spec, params, tokens, pos0, win, None, soft=soft)
+                if mesh is not None:
+                    win = _pin_win_sharding(win, mesh, batch=False)
                 cache = scatter_kv_pages(cache, win, wb, page)
             else:
                 win, restore = _window_cache(cache, window)
@@ -1345,6 +1490,7 @@ class LLMEngine:
 
         if self._paged:
             page = self._page
+            mesh = self.mesh
             ragged_k = self._ragged and self._use_kernel
 
             @partial(jax.jit, donate_argnums=(2,))
@@ -1356,11 +1502,15 @@ class LLMEngine:
                 if ragged_k:
                     _, dcache = forward(
                         dspec, dparams, tokens, pos0, dcache, None,
-                        page_table=phys, kv_page=page, q_lens=qlens,
-                        write_table=wb)
+                        mesh=mesh, page_table=phys, kv_page=page,
+                        q_lens=qlens, write_table=wb)
                     return dcache
                 win = gather_kv_pages(dcache, phys, page)
+                if mesh is not None:
+                    win = _pin_win_sharding(win, mesh, batch=True)
                 _, win = forward(dspec, dparams, tokens, pos0, win, None)
+                if mesh is not None:
+                    win = _pin_win_sharding(win, mesh, batch=False)
                 return scatter_kv_pages(dcache, win, wb, page)
         else:
             @partial(jax.jit, donate_argnums=(2,))
@@ -1598,8 +1748,8 @@ class LLMEngine:
                         if ragged_k:
                             logits, cache = forward(
                                 spec, params, tokens, pos, cache, None,
-                                page_table=phys, kv_page=page,
-                                q_lens=ones, write_table=wb,
+                                mesh=self.mesh, page_table=phys,
+                                kv_page=page, q_lens=ones, write_table=wb,
                             )
                         else:
                             logits, cache = forward(
@@ -1618,6 +1768,8 @@ class LLMEngine:
                     return (toks_seq.T, tok_next, pos_next, cache,
                             sampling)
                 win = gather_kv_pages(cache, phys, page)
+                if self.mesh is not None:
+                    win = _pin_win_sharding(win, self.mesh, batch=True)
 
                 def step(carry, _):
                     tokens, pos, win, sampling = carry
@@ -1633,6 +1785,8 @@ class LLMEngine:
                 (tok_next, pos_next, win, sampling), toks_seq = lax.scan(
                     step, (tokens, pos0, win, sampling), None, length=k
                 )
+                if self.mesh is not None:
+                    win = _pin_win_sharding(win, self.mesh, batch=False)
                 return (toks_seq.T, tok_next, pos_next,
                         scatter_kv_pages(cache, win, wb, page), sampling)
         else:
@@ -1909,6 +2063,7 @@ class LLMEngine:
         tm.ENGINE_QUEUE_DEPTH.labels(model=self._mlabel).set(0)
         tm.ENGINE_KV_UTIL.labels(model=self._mlabel).set(0.0)
         tm.ENGINE_KV_RESIDENT_PREFIX.labels(model=self._mlabel).set(0.0)
+        tm.ENGINE_MESH_DEVICES.labels(model=self._mlabel).set(0)
         if self._paged:
             tm.ENGINE_KV_PAGES_IN_USE.labels(model=self._mlabel).set(0)
             tm.ENGINE_KV_PAGES_SHARED.labels(model=self._mlabel).set(0)
@@ -1993,11 +2148,15 @@ class LLMEngine:
         dir without removing the warmup markers)."""
         import os
 
+        t0 = time.perf_counter()
         marker = self._warmup_marker_path()
         reuse_ok = os.environ.get("LOCALAI_WARMUP_REUSE", "1") not in (
             "0", "false", "off")
         if marker is not None and reuse_ok and os.path.exists(marker):
             self.warmup_reused = True
+            tm.ENGINE_WARMUP_SECONDS.labels(
+                model=self._mlabel, mode="reuse").set(
+                time.perf_counter() - t0)
             log.info("warmup skipped: variant set %s already in the "
                      "persistent compile cache", os.path.basename(marker))
             return
@@ -2202,6 +2361,8 @@ class LLMEngine:
         self.warmup_variants = n_variants
         tm.ENGINE_DISPATCH_VARIANTS.labels(model=self._mlabel).set(
             n_variants)
+        tm.ENGINE_WARMUP_SECONDS.labels(
+            model=self._mlabel, mode="cold").set(time.perf_counter() - t0)
         if marker is not None:
             # record the completed variant set so the next load of this
             # exact signature skips the whole pass (best effort: losing

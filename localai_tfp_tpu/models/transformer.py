@@ -723,6 +723,28 @@ def forward_hidden(
                 if spec.query_pre_attn_scalar
                 else 1.0 / math.sqrt(spec.d_head)
             )
+            if mesh is not None:
+                # meshed serving: table-scatter append + ragged attend
+                # per-shard under shard_map — the arena's head-flat F
+                # dim is sharded over "model" (PAGED_KV_SPEC) and the
+                # quantization above already ran OUTSIDE (global
+                # per-row amax), so every model shard scatters
+                # identical scale values (sharded_append_attend's
+                # contract, extended to the paged arena)
+                from ..ops.ragged_paged_attention import (
+                    sharded_ragged_append_attend,
+                )
+
+                res = sharded_ragged_append_attend(
+                    mesh, q, kf, vf, kq, vq, ksc, vsc,
+                    ck_all, cv_all,
+                    ks_all if quant else None,
+                    vs_all if quant else None,
+                    l, page_table, write_table, pos0, q_lens,
+                    spec.n_kv_heads, scale=scale, page=kv_page,
+                    sliding_window=spec.sliding_window,
+                )
+                return res[0].astype(x.dtype), tuple(res[1:])
             tpos = pos0[:, None] + jnp.arange(T, dtype=jnp.int32)[None]
             wpg = write_table[rows[:, None], tpos // kv_page]
             # pad positions beyond the row's ragged length write trash

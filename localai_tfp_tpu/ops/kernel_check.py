@@ -278,6 +278,195 @@ def check_ragged_attention(quantized: bool = False, seed: int = 0,
     return err
 
 
+def _tp_mesh(n_kv_heads: int):
+    """Largest pure-TP serving mesh buildable from the visible devices:
+    tp = biggest power of two that both fits the device count and
+    divides the kv-head count (each shard attends whole kv-head bands).
+    None on single-device hosts — the meshed legs then skip."""
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    tp = 1
+    while tp * 2 <= len(devs) and n_kv_heads % (tp * 2) == 0:
+        tp *= 2
+    if tp < 2:
+        return None
+    return Mesh(np.asarray(devs[:tp]).reshape(tp), ("model",))
+
+
+def check_meshed_ragged_attention(quantized: bool = False,
+                                  seed: int = 0,
+                                  mix: str = "mixed") -> "float | None":
+    """Pod-scale parity: the shard_map'd append+attend wrapper
+    (``sharded_ragged_append_attend`` — arena head dim over "model",
+    host-global page tables) vs the dense single-device oracle on the
+    SAME post-scatter arena. Covers the decode seed-row path (T == 1)
+    and mixed ragged rows; fp and int8 legs share the dense kernel's
+    tolerance. None when fewer than 2 devices are visible."""
+    from ..models.transformer import _quantize_rows
+    from .ragged_paged_attention import (
+        ragged_attention_reference, sharded_ragged_append_attend,
+    )
+
+    L, n_kv, dh, H, page = 2, 8, 128, 32, 128
+    mesh = _tp_mesh(n_kv)
+    if mesh is None:
+        return None
+    rng = np.random.default_rng(seed)
+    F = n_kv * dh
+    B, max_pages = 6, 4
+    if mix == "decode":
+        q_lens = np.ones(B, np.int32)
+    else:  # decode rows + prefill chunks + a verify row together
+        q_lens = np.asarray([1, 1, 7, 32, 4, 16], np.int32)[:B]
+    T = int(q_lens.max())
+    cap = max_pages * page
+    pos0 = np.asarray(
+        [int(rng.integers(0, cap - int(n))) for n in q_lens], np.int32)
+    n_pages = B * max_pages + 1
+    pt = rng.permutation(np.arange(1, n_pages)).reshape(
+        B, max_pages).astype(np.int32)
+    wb = pt  # rows own their pages: appends land in the read window
+    arena_k = rng.standard_normal((L, n_pages, page, F)) * 0.5
+    arena_v = rng.standard_normal((L, n_pages, page, F)) * 0.5
+    q = jnp.asarray(rng.standard_normal((B, T, H, dh)) * 0.3,
+                    jnp.float32)
+    new_k = jnp.asarray(rng.standard_normal((B, T, F)) * 0.5,
+                        jnp.float32)
+    new_v = jnp.asarray(rng.standard_normal((B, T, F)) * 0.5,
+                        jnp.float32)
+    scale = 1.0 / np.sqrt(dh)
+    layer = jnp.asarray(1, jnp.int32)
+    pt_j, pos_j = jnp.asarray(pt), jnp.asarray(pos0)
+    len_j = jnp.asarray(q_lens)
+    wb_j = jnp.asarray(wb)
+    if quantized:
+        ak, ks = _quantize_rows(jnp.asarray(arena_k, jnp.float32))
+        av, vs = _quantize_rows(jnp.asarray(arena_v, jnp.float32))
+        kq, ksc = _quantize_rows(new_k)
+        vq, vsc = _quantize_rows(new_v)
+    else:
+        ak = jnp.asarray(arena_k, jnp.bfloat16)
+        av = jnp.asarray(arena_v, jnp.bfloat16)
+        ks = vs = ksc = vsc = None
+        kq, vq = new_k, new_v
+    # dense oracle arena: the IDENTICAL scatter the wrapper body runs
+    # (pads write the trash page), on unsharded arrays
+    rows_i = jnp.arange(B, dtype=jnp.int32)
+    tpos = pos_j[:, None] + jnp.arange(T, dtype=jnp.int32)[None]
+    wpg = wb_j[rows_i[:, None], tpos // page]
+    wpg = jnp.where(
+        jnp.arange(T, dtype=jnp.int32)[None] < len_j[:, None], wpg, 0)
+    woff = tpos % page
+    ck_ref = ak.at[1, wpg, woff, :].set(
+        kq.astype(ak.dtype), mode="promise_in_bounds")
+    cv_ref = av.at[1, wpg, woff, :].set(
+        vq.astype(av.dtype), mode="promise_in_bounds")
+    if quantized:
+        ks_ref = ks.at[1, wpg, woff].set(ksc, mode="promise_in_bounds")
+        vs_ref = vs.at[1, wpg, woff].set(vsc, mode="promise_in_bounds")
+    seed_kv = (new_k[:, 0], new_v[:, 0]) if T == 1 else None
+    want = ragged_attention_reference(
+        q, ck_ref, cv_ref, 1, pt_j, pos_j, len_j, n_kv, scale=scale,
+        page=page, cache_k_scale=ks_ref if quantized else None,
+        cache_v_scale=vs_ref if quantized else None, seed_kv=seed_kv)
+    with mesh:
+        res = sharded_ragged_append_attend(
+            mesh, q.astype(jnp.bfloat16), new_k, new_v, kq, vq,
+            ksc, vsc, ak, av, ks, vs, layer, pt_j, wb_j, pos_j, len_j,
+            n_kv, scale=scale, page=page)
+    got = res[0].reshape(B, T, H, dh)
+    want = want.reshape(B, T, H, dh)
+    # pad rows beyond each ragged length are garbage by contract; the
+    # scatter itself must be EXACT (pure indexing + identical casts)
+    err = float(jnp.max(jnp.abs(
+        res[1].astype(jnp.float32) - ck_ref.astype(jnp.float32))))
+    err = max(err, float(jnp.max(jnp.abs(
+        res[2].astype(jnp.float32) - cv_ref.astype(jnp.float32)))))
+    if quantized:
+        err = max(err, float(jnp.max(jnp.abs(res[3] - ks_ref))))
+        err = max(err, float(jnp.max(jnp.abs(res[4] - vs_ref))))
+    if err > 0:
+        return err  # scatter bug: report it, skip the attention leg
+    for b in range(B):
+        n = int(q_lens[b])
+        err = max(err, float(jnp.max(jnp.abs(
+            got[b, :n] - want[b, :n]))))
+    return err
+
+
+def check_meshed_paged_gather(quantized: bool = False,
+                              seed: int = 0) -> "float | None":
+    """GSPMD fallback-path parity on a mesh: ``gather_kv_pages`` over a
+    PAGED_KV_SPEC-sharded arena (head dim over "model", scale planes
+    replicated) must reproduce the dense cache EXACTLY — it is pure
+    indexing, so any nonzero error is a resharding bug. None when fewer
+    than 2 devices are visible."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models.transformer import (
+        KVCache, _quantize_rows, gather_kv_pages,
+    )
+    from ..parallel.sharding import PAGED_KV_SPEC
+
+    L, S, SEQ, n_kv, dh = 2, 4, 512, 8, 128
+    mesh = _tp_mesh(n_kv)
+    if mesh is None:
+        return None
+    rng = np.random.default_rng(seed)
+    page = 128
+    F = n_kv * dh
+    n_logical = SEQ // page
+    cache_k = rng.standard_normal((L, S, SEQ, F)) * 0.5
+    cache_v = rng.standard_normal((L, S, SEQ, F)) * 0.5
+    n_pages = S * n_logical + 1
+    perm = rng.permutation(np.arange(1, n_pages))
+    pt = perm.reshape(S, n_logical).astype(np.int32)
+
+    def scatter(dense):
+        arena = np.zeros((L, n_pages, page) + dense.shape[3:],
+                         dense.dtype)
+        for s in range(S):
+            for p in range(n_logical):
+                arena[:, pt[s, p]] = dense[:, s, p * page:(p + 1) * page]
+        return arena
+
+    def put(arr, spec):
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    if quantized:
+        kq, ks = _quantize_rows(jnp.asarray(cache_k, jnp.float32))
+        vq, vs = _quantize_rows(jnp.asarray(cache_v, jnp.float32))
+        arena = KVCache(
+            k=put(jnp.asarray(scatter(np.asarray(kq))), PAGED_KV_SPEC),
+            v=put(jnp.asarray(scatter(np.asarray(vq))), PAGED_KV_SPEC),
+            k_scale=put(jnp.asarray(scatter(np.asarray(ks))), P()),
+            v_scale=put(jnp.asarray(scatter(np.asarray(vs))), P()),
+        )
+        win = gather_kv_pages(arena, jnp.asarray(pt), page)
+        return max(
+            float(jnp.max(jnp.abs(win.k.astype(jnp.int32)
+                                  - kq.astype(jnp.int32)))),
+            float(jnp.max(jnp.abs(win.v.astype(jnp.int32)
+                                  - vq.astype(jnp.int32)))),
+            float(jnp.max(jnp.abs(win.k_scale - ks))),
+            float(jnp.max(jnp.abs(win.v_scale - vs))),
+        )
+    dense_k = jnp.asarray(cache_k, jnp.bfloat16)
+    dense_v = jnp.asarray(cache_v, jnp.bfloat16)
+    arena = KVCache(
+        k=put(jnp.asarray(scatter(np.asarray(dense_k))), PAGED_KV_SPEC),
+        v=put(jnp.asarray(scatter(np.asarray(dense_v))), PAGED_KV_SPEC),
+    )
+    win = gather_kv_pages(arena, jnp.asarray(pt), page)
+    return max(
+        float(jnp.max(jnp.abs(
+            win.k.astype(jnp.float32) - dense_k.astype(jnp.float32)))),
+        float(jnp.max(jnp.abs(
+            win.v.astype(jnp.float32) - dense_v.astype(jnp.float32)))),
+    )
+
+
 def check_int8_matmul(seed: int = 0) -> float:
     """Max abs error of the fused Pallas dequant-matmul vs the XLA
     upcast path."""
@@ -318,6 +507,21 @@ def run_kernel_checks() -> dict[str, Any]:
         out["ragged_attention_int8_max_err"] = round(max(
             check_ragged_attention(True, mix=m)
             for m in _RAGGED_MIXES), 5)
+        # pod-scale legs: the shard_map'd append+attend wrapper and the
+        # GSPMD gather fallback over a "model"-sharded arena vs the same
+        # dense single-device oracles (skipped on 1-device hosts)
+        mm = check_meshed_ragged_attention(False, mix="mixed")
+        if mm is not None:
+            out["meshed_ragged_max_err"] = round(max(
+                mm, check_meshed_ragged_attention(False, mix="decode")),
+                5)
+            out["meshed_ragged_int8_max_err"] = round(max(
+                check_meshed_ragged_attention(True, mix=m)
+                for m in ("mixed", "decode")), 5)
+            out["meshed_paged_gather_max_err"] = round(
+                check_meshed_paged_gather(False), 5)
+            out["meshed_paged_gather_int8_max_err"] = round(
+                check_meshed_paged_gather(True), 5)
         out["int8_matmul_max_err"] = round(check_int8_matmul(), 5)
         out["ok"] = (
             out["decode_attention_max_err"] < 2e-2
@@ -328,6 +532,13 @@ def run_kernel_checks() -> dict[str, Any]:
             and out["paged_gather_int8_max_err"] < 5e-2
             and out["ragged_attention_max_err"] < 2e-2
             and out["ragged_attention_int8_max_err"] < 5e-2
+            # sharded legs read the same values through the same tables,
+            # so their tolerances match the dense legs'; the GSPMD
+            # gather is pure indexing — anything nonzero is a bug
+            and out.get("meshed_ragged_max_err", 0.0) < 2e-2
+            and out.get("meshed_ragged_int8_max_err", 0.0) < 5e-2
+            and out.get("meshed_paged_gather_max_err", 0.0) == 0.0
+            and out.get("meshed_paged_gather_int8_max_err", 0.0) == 0.0
             and out["int8_matmul_max_err"] < 0.25
         )
     except Exception as e:  # a crash IS the finding — record it

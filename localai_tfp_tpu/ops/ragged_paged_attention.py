@@ -314,6 +314,135 @@ def ragged_paged_attention(
         0, 3, 1, 2, 4).reshape(B, T, H * Dh)
 
 
+def mesh_ragged_eligible(mesh, n_kv_heads: int, n_heads: int,
+                         kv_dim: int) -> bool:
+    """Whether the ragged kernel can run under ``shard_map`` on this
+    serving mesh: kv heads split evenly over "model" (the kernel's
+    per-kv-head contractions are GQA-head-local, so each shard attends
+    its own whole kv-head band with full 128-lane rows).
+
+    Unlike ``decode_attention.mesh_kernel_eligible`` there is NO
+    slots-divide-"data" requirement: the page arena has no slot dim, so
+    batch rows and the arena replicate over "data"/"seq" shards —
+    redundant compute per step, never incorrect (ADVICE r3 #4)."""
+    tp = mesh.shape.get("model", 1)
+    return (
+        n_kv_heads % tp == 0
+        and n_heads % tp == 0
+        and (kv_dim // tp) % 128 == 0
+    )
+
+
+def sharded_ragged_append_attend(
+    mesh,
+    q: jax.Array,  # [B, T, H, Dh] post-rope queries
+    new_k: jax.Array,  # [B, T, F] post-rope K rows (bf16/f32; T == 1
+    new_v: jax.Array,  # rows also seed the kernel accumulator)
+    kq: jax.Array,  # [B, T, F] rows to SCATTER (int8 when quantized,
+    vq: jax.Array,  # else the rows themselves)
+    ksc: Optional[jax.Array],  # [B, T] f32 per-row scales (GLOBAL amax —
+    vsc: Optional[jax.Array],  # see note below), None when unquantized
+    cache_k: jax.Array,  # [L, n_pages, page, F] paged arena
+    cache_v: jax.Array,
+    cache_k_scale: Optional[jax.Array],  # [L, n_pages, page] f32 | None
+    cache_v_scale: Optional[jax.Array],
+    layer: jax.Array,  # [] i32
+    page_table: jax.Array,  # [B, max_pages] i32 READ pages
+    write_table: jax.Array,  # [B, max_pages] i32 WRITE pages (non-owned
+    # entries point at the trash page)
+    pos0: jax.Array,  # [B] i32
+    q_lens: jax.Array,  # [B] i32 ragged valid-token counts
+    n_kv_heads: int,
+    *,
+    scale: float,
+    page: int,
+    sliding_window: Optional[int] = None,
+) -> tuple:
+    """Table-scatter append + ragged attend under ``shard_map`` on a
+    serving mesh — the meshed counterpart of the caller-side scatter +
+    ``ragged_paged_attention`` pair in models/transformer.ragged_attn.
+    The arena shards its head-flat F dim over "model"
+    (parallel/sharding.PAGED_KV_SPEC): each device holds its kv-head
+    slice of EVERY page, the host-owned int32 page tables stay global,
+    and each model shard runs the kernel over its own kv-head band with
+    ZERO collectives inside the body. Batch rows and the arena replicate
+    over "data"/"seq" (the arena has no slot dim to shard).
+
+    The caller must quantize rows with the GLOBAL per-row amax (computed
+    outside, where GSPMD reduces across model shards): every model shard
+    then scatters identical values into the model-replicated scale
+    planes, keeping them consistent — same contract as
+    ``decode_attention.sharded_append_attend``.
+
+    Returns (out [B, T, H*Dh] sharded over "model", ck, cv[, ks, vs]).
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape.get("model", 1)
+    quant = cache_k_scale is not None
+    n_kv_local = n_kv_heads // tp
+
+    row_spec = P(None, None, "model")  # [B, T, F] rows
+    arena_spec = P(None, None, None, "model")  # PAGED_KV_SPEC
+    rep = P()  # tables, scalars, per-row + per-plane scales
+
+    in_specs = [
+        P(None, None, "model", None),  # q: heads over "model"
+        row_spec, row_spec,  # new_k, new_v
+        row_spec, row_spec,  # kq, vq
+        arena_spec, arena_spec,  # cache_k, cache_v
+        rep, rep, rep, rep, rep,  # layer, pt, wt, pos0, q_lens
+    ]
+    operands = [q, new_k, new_v, kq, vq, cache_k, cache_v,
+                layer, page_table, write_table, pos0, q_lens]
+    if quant:
+        in_specs += [rep, rep, rep, rep]
+        operands += [ksc, vsc, cache_k_scale, cache_v_scale]
+        out_specs = (row_spec, arena_spec, arena_spec, rep, rep)
+    else:
+        out_specs = (row_spec, arena_spec, arena_spec)
+
+    def body(q_l, nk_l, nv_l, kq_l, vq_l, ck, cv, lay, pt, wt, p0, qls,
+             ksr=None, vsr=None, ksp=None, vsp=None):
+        B, T = kq_l.shape[:2]
+        rows = jnp.arange(B, dtype=jnp.int32)
+        tpos = p0[:, None] + jnp.arange(T, dtype=jnp.int32)[None]
+        wpg = wt[rows[:, None], tpos // page]
+        # pad positions beyond the row's ragged length write trash
+        wpg = jnp.where(
+            jnp.arange(T, dtype=jnp.int32)[None] < qls[:, None], wpg, 0)
+        woff = tpos % page
+        ck = ck.at[lay, wpg, woff, :].set(
+            kq_l.astype(ck.dtype), mode="promise_in_bounds")
+        cv = cv.at[lay, wpg, woff, :].set(
+            vq_l.astype(cv.dtype), mode="promise_in_bounds")
+        if quant:
+            ksp = ksp.at[lay, wpg, woff].set(
+                ksr, mode="promise_in_bounds")
+            vsp = vsp.at[lay, wpg, woff].set(
+                vsr, mode="promise_in_bounds")
+        seed = (nk_l[:, 0], nv_l[:, 0]) if T == 1 else None
+        out = ragged_paged_attention(
+            q_l, ck, cv, lay, pt, p0, qls, n_kv_local,
+            scale=scale, page=page, sliding_window=sliding_window,
+            cache_k_scale=ksp if quant else None,
+            cache_v_scale=vsp if quant else None,
+            seed_kv=seed,
+        )
+        if quant:
+            return out, ck, cv, ksp, vsp
+        return out, ck, cv
+
+    # check_rep=False: the model-replicated scale planes are updated with
+    # identical values on every model shard (global-amax quantization), a
+    # replication invariant shard_map cannot verify itself
+    return shard_map(
+        body, mesh=mesh, in_specs=tuple(in_specs), out_specs=out_specs,
+        check_rep=False,
+    )(*operands)
+
+
 def ragged_attention_reference(
     q, cache_k, cache_v, layer, page_table, pos0, q_lens, n_kv_heads,
     *, scale, page, sliding_window=None, cache_k_scale=None,

@@ -68,6 +68,13 @@ PARAM_RULES: dict[str, P] = {
 # KV cache is [L, n_slots, max_seq, kv_dim] (head-flat — models/transformer
 # KVCache): slots ride "data", the flat head dim rides "model"
 KV_CACHE_SPEC = P(None, "data", None, "model")
+# Paged arena is [L, n_pages, page, kv_dim] (engine/kv_pool.py): pages have
+# no slot identity so nothing rides "data" — every device holds its head
+# slice of EVERY page and the host-owned int32 page tables stay global.
+# int8 scale planes [L, n_pages, page] are per-ROW global-amax (no head
+# axis), so they replicate; every model shard writes identical values
+# (same contract as ops/decode_attention.sharded_append_attend).
+PAGED_KV_SPEC = P(None, None, None, "model")
 TOKENS_SPEC = P("data", "seq")
 BATCH_SPEC = P("data")
 
@@ -92,19 +99,40 @@ def _assert_load_collective_free(mesh: Mesh) -> None:
             "(multihost.FollowerRouter)")
 
 
-def shard_engine_state(cache, sampling, mesh: Mesh):
+def shard_engine_state(cache, sampling, mesh: Mesh, paged: bool = False):
     """Place the serving engine's device state on the mesh: KV cache rows
-    over "data"/"model", per-slot sampler state over "data" (scalars and
-    vocab-width rows follow their leading slot dim)."""
+    over "data"/"model" (dense) or the page arena's head dim over "model"
+    (paged), per-slot sampler state over "data" (scalars and vocab-width
+    rows follow their leading slot dim).
+
+    The KV head dim MUST divide the tp axis: falling back to
+    ``_divisible_spec`` replication here would silently multiply KV HBM
+    by the tp size — a capacity bug, not a fallback — so it errors.
+    """
     _assert_load_collective_free(mesh)
+
+    tp = mesh.shape.get("model", 1)
+    kv_dim = cache.k.shape[-1]
+    if kv_dim % tp != 0:
+        raise ValueError(
+            f"KV cache head dim kv_dim={kv_dim} is not divisible by the "
+            f"mesh 'model' axis (tp={tp}); refusing to silently replicate "
+            "the KV cache across tensor-parallel shards (each shard would "
+            f"hold the FULL cache — a {tp}x HBM capacity regression). Pick "
+            "a tp size dividing n_kv_heads*d_head or serve unsharded.")
 
     def put(arr, spec):
         fixed = _divisible_spec(arr.shape, spec, mesh)
         return jax.device_put(arr, NamedSharding(mesh, fixed))
 
-    scale_spec = P(None, "data", None)  # [L, slots, seq] row scales
+    if paged:
+        kv_spec = PAGED_KV_SPEC
+        scale_spec = P()  # [L, n_pages, page] per-row scales: replicated
+    else:
+        kv_spec = KV_CACHE_SPEC
+        scale_spec = P(None, "data", None)  # [L, slots, seq] row scales
     cache = type(cache)(
-        k=put(cache.k, KV_CACHE_SPEC), v=put(cache.v, KV_CACHE_SPEC),
+        k=put(cache.k, kv_spec), v=put(cache.v, kv_spec),
         k_scale=(put(cache.k_scale, scale_spec)
                  if cache.quantized else None),
         v_scale=(put(cache.v_scale, scale_spec)
@@ -113,6 +141,12 @@ def shard_engine_state(cache, sampling, mesh: Mesh):
     leaves, treedef = jax.tree_util.tree_flatten(sampling)
     out = []
     for leaf in leaves:
+        # slot-dim state rides "data" in BOTH modes: the paged arena
+        # itself is data-replicated, but the per-slot batch of every
+        # dispatch must stay data-sharded — it anchors GSPMD to the
+        # dense path's (correct) partitioning of the forward. The paged
+        # dispatches additionally pin their gathered windows to the
+        # same layout (engine._pin_win_sharding).
         spec = P(*(("data",) + (None,) * (leaf.ndim - 1))) if leaf.ndim \
             else P()
         out.append(put(leaf, spec))

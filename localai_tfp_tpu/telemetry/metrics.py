@@ -240,6 +240,25 @@ ENGINE_RAGGED_ROWS = REGISTRY.counter(
     labels=("model", "kind"),
 )
 
+# --------------------------------------------------- pod-scale serving
+
+ENGINE_MESH_DEVICES = REGISTRY.gauge(
+    "engine_mesh_devices_count",
+    "Devices in the engine's serving mesh (1 for unsharded engines; "
+    "data x seq x model axis product otherwise) — the replica's "
+    "tensor-parallel footprint, reset to 0 on close",
+    labels=("model",),
+)
+ENGINE_WARMUP_SECONDS = REGISTRY.gauge(
+    "engine_warmup_seconds",
+    "Wall seconds of the last engine warmup pass by mode (cold = the "
+    "dispatch-variant set was compiled, reuse = an identical variant "
+    "set was already in the persistent compile cache and the pass was "
+    "marker-skipped) — the replica-boot cost tools/profile_boot.py "
+    "measures",
+    labels=("model", "mode"),
+)
+
 # ------------------------------------------------------------ resilience
 
 ENGINE_REQUESTS_SHED = REGISTRY.counter(

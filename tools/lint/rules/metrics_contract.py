@@ -51,6 +51,8 @@ REQUIRED_FAMILIES = {
     "engine_kv_tier_bytes_moved_total",
     "engine_dispatch_compile_variants_count",
     "engine_ragged_rows_total",
+    "engine_mesh_devices_count",
+    "engine_warmup_seconds",
     "engine_requests_shed_total",
     "engine_deadline_exceeded_total",
     "federation_node_state_count",
