@@ -480,15 +480,16 @@ class LLMEngine:
         # shards its head-flat F dim over "model"
         # (parallel/sharding.PAGED_KV_SPEC — each device holds its
         # kv-head slice of EVERY page) while the host-owned page tables
-        # stay global. Two meshed carve-outs stay dense: seq-sharded
-        # meshes (the paged prefill path has no ring-attention branch)
-        # and kv_dim not dividing the tp axis (shard_engine_state would
-        # reject the silent-replication fallback).
+        # stay global. One meshed carve-out stays dense: seq-sharded
+        # meshes (the paged prefill path has no ring-attention branch).
+        # kv_dim not dividing the tp axis is a CONFIG ERROR, not a
+        # fallback: shard_engine_state raises for dense and paged alike
+        # (silent replication is a tp-times HBM regression), so such a
+        # mesh fails engine construction with the actionable message.
         mesh_seq = 1 if mesh is None else mesh.shape.get("seq", 1)
         mesh_tp = 1 if mesh is None else mesh.shape.get("model", 1)
         self._paged = (
-            (mesh is None
-             or (mesh_seq == 1 and spec.kv_dim % mesh_tp == 0))
+            (mesh is None or mesh_seq == 1)
             and _os.environ.get("LOCALAI_PAGED_KV", "on").lower()
             not in ("0", "off", "false"))
         # page size: largest power of two <= min(256, max_seq) dividing
@@ -564,24 +565,29 @@ class LLMEngine:
             self.cache, self.sampling = shard_engine_state(
                 self.cache, self.sampling, mesh, paged=self._paged
             )
-            if (self._paged and self.draft_cache is not None
-                    and draft[0].kv_dim % mesh_tp == 0):
+            if self._paged and self.draft_cache is not None:
                 # the draft arena shares the pool's geometry/tables, so
                 # it shards the same way; a non-divisible draft kv_dim
-                # stays replicated (the spec paths then run the GSPMD
-                # gather fallback — _kernel_eligible gates the shard_map
-                # route on draft eligibility)
+                # is device_put REPLICATED on the mesh — explicitly, so
+                # a multi-GB operand never reaches the first dispatch
+                # with an uncommitted single-device placement for GSPMD
+                # to guess at (the spec paths then run the GSPMD gather
+                # fallback — _kernel_eligible gates the shard_map route
+                # on draft eligibility)
                 from ..parallel.sharding import PAGED_KV_SPEC
                 from jax.sharding import NamedSharding
                 from jax.sharding import PartitionSpec as _P
+
+                arena_sp = (PAGED_KV_SPEC
+                            if draft[0].kv_dim % mesh_tp == 0 else _P())
 
                 def _put_arena(arr, sp):
                     return jax.device_put(arr, NamedSharding(mesh, sp))
 
                 dc = self.draft_cache
                 self.draft_cache = type(dc)(
-                    k=_put_arena(dc.k, PAGED_KV_SPEC),
-                    v=_put_arena(dc.v, PAGED_KV_SPEC),
+                    k=_put_arena(dc.k, arena_sp),
+                    v=_put_arena(dc.v, arena_sp),
                     k_scale=(_put_arena(dc.k_scale, _P())
                              if dc.quantized else None),
                     v_scale=(_put_arena(dc.v_scale, _P())

@@ -105,9 +105,13 @@ def shard_engine_state(cache, sampling, mesh: Mesh, paged: bool = False):
     (paged), per-slot sampler state over "data" (scalars and vocab-width
     rows follow their leading slot dim).
 
-    The KV head dim MUST divide the tp axis: falling back to
-    ``_divisible_spec`` replication here would silently multiply KV HBM
-    by the tp size — a capacity bug, not a fallback — so it errors.
+    The KV head dim MUST divide the tp axis — in BOTH modes (the dense
+    [L, slots, seq, kv_dim] cache and the paged arena share the trailing
+    kv_dim): falling back to ``_divisible_spec`` replication here would
+    silently multiply KV HBM by the tp size — a capacity bug, not a
+    fallback — so it errors, and the engine deliberately offers no
+    dense carve-out: an indivisible meshed LLMEngine fails construction
+    with this message.
     """
     _assert_load_collective_free(mesh)
 

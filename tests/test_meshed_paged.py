@@ -18,8 +18,13 @@ host-owned and global. Covered here:
 - KV tiering stays FORCE-OFF on meshed engines even with
   LOCALAI_KV_TIER=on (a host spill of a model-sharded page would be an
   implicit cross-shard all-gather)
+- an int8 arena meshes too: quantized pages shard with their heads,
+  the replicated per-row scale planes survive the _pin_win_sharding
+  round-trip, and paged-vs-dense byte-identity still holds
 - shard_engine_state refuses a kv_dim that does not divide the tp axis
-  instead of silently replicating the cache (a tp-times HBM regression)
+  instead of silently replicating the cache (a tp-times HBM
+  regression) — dense and paged alike, so a meshed LLMEngine with an
+  indivisible kv_dim fails construction (no dense carve-out)
 - the shard_map'd append+attend wrapper matches the dense oracle on
   this host's virtual mesh (fp + int8), via ops/kernel_check
 """
@@ -128,6 +133,40 @@ def test_meshed_paged_on_off_byte_identity(model, monkeypatch):
             eng.close()
     assert outs[("on", "on")] == outs[("off", "on")]
     assert outs[("on", "off")] == outs[("off", "on")]
+
+
+def test_meshed_paged_int8_byte_identity(model, monkeypatch):
+    """The quantized arena on a mesh: int8 pages shard with their
+    heads while the [L, B, W] per-row scale planes stay replicated —
+    including across the _pin_win_sharding round-trip, where the
+    gathered window's slot dim is replicated (the very condition GSPMD
+    miscompiles for the K/V rows). Paged+ragged meshed serving with an
+    int8 cache must stream the same bytes as the dense meshed int8
+    engine, greedy and seeded."""
+    monkeypatch.setenv("LOCALAI_KV_PAGE", "16")
+    monkeypatch.setenv("LOCALAI_RAGGED_ATTN", "on")
+    prompts = [list(range(1, 20)), [9, 8, 7, 6, 5],
+               list(range(1, 20)), [3, 1, 4, 1, 5]]
+    mesh = _mesh()
+    outs = {}
+    for paged in ("on", "off"):
+        monkeypatch.setenv("LOCALAI_PAGED_KV", paged)
+        eng = _engine(model, mesh=mesh, cache_dtype="int8")
+        assert eng._paged == (paged == "on")
+        assert eng.cache.quantized
+        try:
+            if eng._paged:
+                # quantized rows shard like fp rows; scales replicate
+                from localai_tfp_tpu.parallel.sharding import (
+                    PAGED_KV_SPEC,
+                )
+
+                assert eng.cache.k.sharding.spec == PAGED_KV_SPEC
+                assert eng.cache.k_scale.sharding.is_fully_replicated
+            outs[paged] = _serve(eng, prompts)
+        finally:
+            eng.close()
+    assert outs["on"] == outs["off"]
 
 
 def test_meshed_page_share_cow_leak_check(model, monkeypatch):
@@ -240,24 +279,39 @@ def test_meshed_engine_forces_kv_tier_off(model, monkeypatch):
         plain.close()
 
 
-def test_shard_engine_state_rejects_indivisible_kv_dim(model):
-    """kv_dim % tp != 0 must error early and loudly — the old
-    ``_divisible_spec`` fallback replicated the WHOLE cache per shard
-    (a tp-times HBM capacity regression masquerading as working)."""
+def test_shard_engine_state_rejects_indivisible_kv_dim(model, monkeypatch):
+    """kv_dim % tp != 0 must error early and loudly — in BOTH modes
+    (the dense cache and the paged arena share the trailing kv_dim) —
+    the old ``_divisible_spec`` fallback replicated the WHOLE cache per
+    shard (a tp-times HBM capacity regression masquerading as
+    working)."""
     from localai_tfp_tpu.models.transformer import KVCache
     from localai_tfp_tpu.ops.sampling import SamplingState
     from localai_tfp_tpu.parallel.sharding import shard_engine_state
 
-    spec, _, _ = model
-    bad = tiny_spec(n_kv_heads=1, d_head=20)  # kv_dim 20, tp 8
+    _, _, tk = model
+    bad = tiny_spec(vocab_size=tk.vocab_size, max_position=512,
+                    n_kv_heads=1, d_head=20)  # kv_dim 20, tp 8
     mesh = make_mesh({"data": 1, "seq": 1, "model": 8},
                      devices=jax.devices("cpu"))
-    cache = KVCache.create(bad, 2, 32, jnp.float32)
     sampling = SamplingState.create(2, bad.vocab_size)
+    dense = KVCache.create(bad, 2, 32, jnp.float32)
     with pytest.raises(ValueError, match="not divisible"):
-        shard_engine_state(cache, sampling, mesh)
-    # and the engine routes such a spec to the DENSE path up front
-    # rather than tripping the error (paged gate checks divisibility)
+        shard_engine_state(dense, sampling, mesh)
+    arena = KVCache.create(bad, 8, 16, jnp.float32)  # paged geometry
+    with pytest.raises(ValueError, match="not divisible"):
+        shard_engine_state(arena, sampling, mesh, paged=True)
+    # and there is deliberately NO dense engine carve-out: a meshed
+    # LLMEngine with an indivisible kv_dim fails construction with the
+    # same actionable message whether paging is on or off, instead of
+    # silently serving a tp-times-replicated cache
+    params = init_params(jax.random.PRNGKey(1), bad, dtype=jnp.float32)
+    for paged in ("on", "off"):
+        monkeypatch.setenv("LOCALAI_PAGED_KV", paged)
+        with pytest.raises(ValueError, match="not divisible"):
+            LLMEngine(bad, params, tk, n_slots=2, max_seq=128,
+                      prefill_buckets=(8, 32), cache_dtype=jnp.float32,
+                      mesh=mesh, autostart=False)
 
 
 def test_meshed_ragged_kernel_parity_fp_and_int8():
