@@ -559,12 +559,56 @@ def _tracing_extra() -> dict:
 
 def _lint_extra():
     """graftlint trajectory per release: rule count, findings, baseline
-    size. New findings here mean tier-1 (tests/test_lint.py) is already
-    red; the bench records the numbers so the baseline's
-    shrink-over-releases is visible in the BENCH history."""
+    size, interprocedural call-graph size, and graftsan (runtime
+    sanitizer) micro-costs — armed vs disarmed per lock round-trip and
+    per guarded attribute rebind. New findings here mean tier-1
+    (tests/test_lint.py) is already red; the bench records the numbers
+    so the baseline's shrink-over-releases is visible in BENCH."""
+    import threading
+
     from tools.lint import ALL_RULES, lint_repo
+    from tools.lint import sanitizer as san
+    from tools.lint.core import callgraph_edges, load_context
 
     findings, res = lint_repo()
+    edges = callgraph_edges(load_context())
+
+    def _time_ns(fn, n=2000):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        return (time.perf_counter() - t0) / n * 1e9
+
+    from localai_tfp_tpu.telemetry.registry import Counter
+
+    san.reset()
+    san.arm(include=lambda f: True)
+    lock = threading.Lock()  # wrapped: feeds the lock-order graph
+    child = Counter("bench_graftsan_probe_total",
+                    "graftsan bench probe").labels()
+
+    def _locked():
+        with lock:
+            pass
+
+    def _guarded_inc():
+        with child._lock:
+            child.value += 1.0
+
+    armed_lock_ns = _time_ns(_locked)
+    armed_set_ns = _time_ns(_guarded_inc)
+    graph = san.stats()
+    san.disarm()
+    raw = threading.Lock()
+
+    def _raw_locked():
+        with raw:
+            pass
+
+    disarmed_lock_ns = _time_ns(_raw_locked)
+    disarmed_set_ns = _time_ns(_guarded_inc)
+    san.reset()
+
     return {
         "rules": len(ALL_RULES),
         "findings": len(findings),
@@ -572,6 +616,18 @@ def _lint_extra():
         "grandfathered": len(res.grandfathered),
         "stale_baseline": len(res.stale),
         "clean": res.ok,
+        "callgraph_edges": edges,
+        "san": {
+            "lock_sites": graph["sites"],
+            "lock_edges": graph["edges"],
+            "guarded_classes": graph["guarded_classes"],
+            "cycles": graph["cycles"],
+            "violations": graph["violations"],
+            "lock_ns_armed": round(armed_lock_ns, 1),
+            "lock_ns_disarmed": round(disarmed_lock_ns, 1),
+            "guarded_set_ns_armed": round(armed_set_ns, 1),
+            "guarded_set_ns_disarmed": round(disarmed_set_ns, 1),
+        },
     }
 
 

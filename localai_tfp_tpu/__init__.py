@@ -23,4 +23,10 @@ TPU-first):
 
 from localai_tfp_tpu.version import __version__
 
+# LOCALAI_SAN=1 arms graftsan (lockdep-style lock-order + guarded-by
+# sanitizer) before any engine module creates its locks
+from localai_tfp_tpu.utils.san import maybe_arm as _maybe_arm_sanitizer
+
+_maybe_arm_sanitizer()
+
 __all__ = ["__version__"]

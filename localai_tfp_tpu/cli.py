@@ -14,6 +14,8 @@ import os
 import sys
 from typing import Optional
 
+from .config import knobs
+
 
 def _load_dotenv() -> None:
     """.env autoload from cwd / $HOME / /etc/localai.env
@@ -188,7 +190,7 @@ def _app_config(args) -> "ApplicationConfig":
 def _galleries(args) -> list[dict]:
     if getattr(args, "galleries", None):
         return json.loads(args.galleries)
-    env = os.environ.get("LOCALAI_GALLERIES") or os.environ.get("GALLERIES")
+    env = knobs.str_("LOCALAI_GALLERIES") or os.environ.get("GALLERIES")
     return json.loads(env) if env else []
 
 
@@ -273,7 +275,7 @@ def main(argv: Optional[list[str]] = None) -> None:
 
         from .parallel.federated import FederatedServer, generate_token
 
-        token = args.p2p_token or os.environ.get("LOCALAI_P2P_TOKEN") \
+        token = args.p2p_token or knobs.str_("LOCALAI_P2P_TOKEN") \
             or os.environ.get("TOKEN")
         if not token:
             token = generate_token()

@@ -1,0 +1,238 @@
+"""Declarative registry for every ``LOCALAI_*`` environment knob.
+
+Every env knob the framework reads is declared HERE — name, default,
+parser kind and a one-line doc — and read through the typed accessors
+(:func:`flag` / :func:`int_` / :func:`float_` / :func:`str_` /
+:func:`raw` / :func:`present`). The graftlint ``env-knob-registry``
+rule forbids raw ``os.environ["LOCALAI_..."]`` access anywhere else in
+the package and cross-checks this registry against the README
+"Configuration knobs" table, so a knob cannot ship undocumented and a
+typo'd knob name cannot silently read its default forever.
+
+Accessors read ``os.environ`` at CALL time (no import-time caching):
+tests and operators mutate the environment between engine constructions
+and every layer must observe the current value.
+
+The ``ApplicationConfig`` layer (``config/app_config.py``) is the one
+deliberate exception: it maps computed CLI-flag names onto
+``LOCALAI_<FLAG>`` aliases generically and stays outside this registry
+(and outside the lint rule's scope, which exempts ``config/``).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+__all__ = [
+    "Knob", "REGISTRY", "flag", "int_", "float_", "str_", "raw",
+    "present", "markdown_rows",
+]
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    default: str  # raw env-string default, shown verbatim in the README
+    kind: str  # "flag" | "int" | "float" | "str"
+    doc: str
+
+
+REGISTRY: dict[str, Knob] = {}
+
+
+def _knob(name: str, default: str, kind: str, doc: str) -> None:
+    if name in REGISTRY:
+        raise ValueError(f"duplicate knob registration: {name}")
+    REGISTRY[name] = Knob(name, default, kind, doc)
+
+
+# --------------------------------------------------------------- engine
+_knob("LOCALAI_PAGED_KV", "on", "flag",
+      "Paged KV arena (vs the dense per-slot cache).")
+_knob("LOCALAI_KV_PAGE", "0", "int",
+      "KV page-size override: power of two >= 8 dividing max_seq "
+      "(0 = auto, largest <= 256).")
+_knob("LOCALAI_KV_PAGES", "0", "int",
+      "Physical page-count override (0 = n_slots * pages_per_slot + 1).")
+_knob("LOCALAI_RAGGED_ATTN", "on", "flag",
+      "Ragged paged attention; off restores the legacy windowed "
+      "gather/scatter paths.")
+_knob("LOCALAI_PREFIX_CACHE", "on", "flag",
+      "Cross-request prefix KV reuse (copy a resident shared prefix "
+      "instead of re-prefilling).")
+_knob("LOCALAI_PREFIX_CACHE_MIN", "8", "int",
+      "Minimum token GAIN over the destination's own resident prefix "
+      "before a prefix copy dispatches.")
+_knob("LOCALAI_PREFIX_CACHE_DEFER_MIN", "64", "int",
+      "Minimum shared-prefix length before a same-wave request defers "
+      "behind a wave-mate's prefill.")
+_knob("LOCALAI_MIXED_DISPATCH", "on", "flag",
+      "Fused prefill+decode identity-batch dispatch; off restores the "
+      "alternating-phase scheduler.")
+_knob("LOCALAI_REQUEST_DEADLINE_S", "0", "float",
+      "Default per-request deadline in seconds (0 = off; a request's "
+      "own timeout_s overrides).")
+_knob("LOCALAI_MAX_QUEUE", "0", "int",
+      "Admission queue cap — submit_many sheds beyond it with a "
+      "terminal \"shed\" event (0 = unbounded).")
+_knob("LOCALAI_KV_TIER", "on", "flag",
+      "Tiered KV memory: async host-RAM spill + prefetch for resident "
+      "sessions (single-host paged engines).")
+_knob("LOCALAI_DECODE_KERNEL", "auto", "str",
+      "Fused Pallas decode kernel: auto (on where mosaic compiles), "
+      "0/off to force XLA, 1/on to force the kernel.")
+_knob("LOCALAI_WARMUP_REUSE", "on", "flag",
+      "Skip the warmup pass when the persistent compile-cache marker "
+      "for the variant set exists.")
+
+# -------------------------------------------------------------- kv tier
+_knob("LOCALAI_KV_TIER_HOST_MB", "256", "float",
+      "Host-RAM budget for spilled KV pages, in MiB.")
+_knob("LOCALAI_KV_TIER_WATERMARK", "0.85", "float",
+      "Host-tier fill fraction that triggers cold-tier demotion "
+      "(clamped to [0.05, 1.0]).")
+_knob("LOCALAI_KV_TIER_IDLE_S", "1", "float",
+      "Session idle seconds before its pages become spill candidates.")
+_knob("LOCALAI_KV_TIER_COLD_S", "30", "float",
+      "Host-tier residency seconds before a spilled page may demote "
+      "to the cold dir.")
+_knob("LOCALAI_KV_TIER_FETCH_DEADLINE_S", "2", "float",
+      "Deadline for a staged prefetch before the request falls back "
+      "to re-prefill.")
+_knob("LOCALAI_KV_TIER_DIR", "", "str",
+      "Cold-tier spill directory ('' disables the disk tier).")
+_knob("LOCALAI_KV_TIER_INFLIGHT_MB", "64", "float",
+      "In-flight spill transfer window, in MiB.")
+
+# ------------------------------------------------------------ dispatch
+_knob("LOCALAI_WARMUP", "on", "flag",
+      "Precompile the dispatch-variant set at model load (leader/"
+      "single-host roles only).")
+_knob("LOCALAI_NATIVE", "on", "flag",
+      "Build the native hot-path libraries (grammar/store) at startup.")
+_knob("LOCALAI_NATIVE_GBNF", "on", "flag",
+      "Use the native GBNF grammar library when built.")
+_knob("LOCALAI_NATIVE_STORE", "on", "flag",
+      "Use the native vector store when built.")
+
+# ---------------------------------------------------------------- quant
+_knob("LOCALAI_INT8_KERNEL", "off", "flag",
+      "Fused Pallas dequant-matmul inside the decode scan "
+      "(experimental; off = XLA upcast).")
+_knob("LOCALAI_QUANT_ARTIFACTS", "on", "flag",
+      "Persist/reuse int8 quantization artifacts on disk.")
+_knob("LOCALAI_QUANT_CACHE_DIR", "", "str",
+      "Quant-artifact cache root ('' = $XDG_CACHE_HOME/localai_tpu/"
+      "quant).")
+_knob("LOCALAI_QUANT_CACHE_MAX_GB", "50", "float",
+      "Quant-artifact cache size budget in GB (LRU-pruned).")
+_knob("LOCALAI_COMMIT_INFLIGHT_MB", "1024", "int",
+      "In-flight host->device transfer window during weight commit, "
+      "in MiB.")
+
+# ------------------------------------------------------------ telemetry
+_knob("LOCALAI_TIMELINE", "on", "flag",
+      "Flight-recorder timeline event capture.")
+_knob("LOCALAI_TIMELINE_EVENTS", "8192", "int",
+      "Flight-recorder ring capacity in events (min 64).")
+
+# ------------------------------------------------------- multihost/fleet
+_knob("LOCALAI_COORDINATOR", "", "str",
+      "jax.distributed coordinator address (alias of "
+      "JAX_COORDINATOR_ADDRESS).")
+_knob("LOCALAI_NUM_HOSTS", "", "int",
+      "jax.distributed process count (presence-gated: unset/empty "
+      "defers to JAX).")
+_knob("LOCALAI_HOST_ID", "", "int",
+      "jax.distributed process id (presence-gated: unset/empty defers "
+      "to JAX; 0 is meaningful).")
+_knob("LOCALAI_FED_BREAKER_FAILS", "3", "int",
+      "Consecutive upstream failures that open a federation circuit "
+      "breaker.")
+_knob("LOCALAI_FED_BREAKER_BASE_S", "1", "float",
+      "Federation breaker backoff base seconds.")
+_knob("LOCALAI_FED_BREAKER_CAP_S", "30", "float",
+      "Federation breaker backoff cap seconds.")
+_knob("LOCALAI_FED_PROBE_S", "5", "float",
+      "Federation half-open probe interval seconds.")
+_knob("LOCALAI_P2P_TOKEN", "", "str",
+      "Federation join token (falls back to TOKEN).")
+_knob("LOCALAI_GALLERIES", "", "str",
+      "JSON gallery list (falls back to GALLERIES).")
+
+# -------------------------------------------------------------- workers
+_knob("LOCALAI_TINY_DIFFUSION", "off", "flag",
+      "Force the tiny random-init diffusion pipeline (tests/smoke).")
+_knob("LOCALAI_KEEP_FRAMES", "off", "flag",
+      "Keep intermediate PNG frames after ffmpeg video assembly.")
+
+# ------------------------------------------------------------ debugging
+_knob("LOCALAI_FAULTS", "", "str",
+      "Deterministic fault-injection spec, e.g. "
+      "\"engine.device_step:fail@3\" (utils/faultinject.py).")
+_knob("LOCALAI_SAN", "off", "flag",
+      "Arm graftsan, the lockdep-style runtime sanitizer "
+      "(tools/lint/sanitizer.py).")
+
+
+_TRUE = frozenset({"1", "true", "on", "yes"})
+_FALSE = frozenset({"", "0", "false", "off", "no"})
+
+
+def raw(name: str) -> str:
+    """The raw env string, or the registered default when unset."""
+    return os.environ.get(name, REGISTRY[name].default)
+
+
+def present(name: str) -> bool:
+    """True when the knob is set to a non-empty string (for knobs where
+    an explicit 0 differs from unset, e.g. LOCALAI_HOST_ID)."""
+    REGISTRY[name]  # typo guard
+    return bool(os.environ.get(name))
+
+
+def flag(name: str) -> bool:
+    v = raw(name).strip().lower()
+    if v in _TRUE:
+        return True
+    if v in _FALSE:
+        return False
+    return REGISTRY[name].default.strip().lower() in _TRUE
+
+
+def int_(name: str) -> int:
+    k = REGISTRY[name]
+    try:
+        return int(raw(name) or k.default or 0)
+    except ValueError:
+        try:
+            return int(k.default or 0)
+        except ValueError:
+            return 0
+
+
+def float_(name: str) -> float:
+    k = REGISTRY[name]
+    try:
+        return float(raw(name) or k.default or 0.0)
+    except ValueError:
+        try:
+            return float(k.default or 0.0)
+        except ValueError:
+            return 0.0
+
+
+def str_(name: str) -> str:
+    return raw(name)
+
+
+def markdown_rows() -> list[str]:
+    """One README table row per knob (the env-knob-registry lint rule
+    checks each knob appears in the README; tests regenerate the table
+    from here)."""
+    out = []
+    for k in sorted(REGISTRY.values(), key=lambda k: k.name):
+        default = k.default if k.default != "" else "*(unset)*"
+        out.append(f"| `{k.name}` | {k.kind} | `{default}` | {k.doc} |")
+    return out

@@ -64,6 +64,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..config import knobs
 from ..models.llm_spec import LLMSpec
 from ..models.transformer import (
     KVCache, Params, forward, forward_hidden, gather_kv_pages,
@@ -376,13 +377,13 @@ def _pin_win_sharding(win: KVCache, mesh, batch: bool) -> KVCache:
     shaped like its data-replicated operand. Scale planes are global
     per-row amax, replicated either way."""
     from jax.sharding import NamedSharding
-    from jax.sharding import PartitionSpec as _P
 
-    from ..parallel.sharding import _divisible_spec
+    from ..parallel.sharding import (
+        KV_CACHE_SPEC, PAGED_KV_SPEC, REPLICATED, _divisible_spec,
+    )
 
-    row_sp = _P(None, "data", None, "model") if batch \
-        else _P(None, None, None, "model")
-    plane_sp = _P()
+    row_sp = KV_CACHE_SPEC if batch else PAGED_KV_SPEC
+    plane_sp = REPLICATED
 
     def pin(a, sp):
         sp = _divisible_spec(a.shape, sp, mesh)
@@ -468,7 +469,6 @@ class LLMEngine:
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= max_seq
         ) or (max_seq,)
-        import os as _os
 
         # Paged KV pool (engine/kv_pool.py + models/transformer.py
         # gather/scatter views): one [L, n_pages, page, F] arena backs
@@ -490,8 +490,7 @@ class LLMEngine:
         mesh_tp = 1 if mesh is None else mesh.shape.get("model", 1)
         self._paged = (
             (mesh is None or mesh_seq == 1)
-            and _os.environ.get("LOCALAI_PAGED_KV", "on").lower()
-            not in ("0", "off", "false"))
+            and knobs.flag("LOCALAI_PAGED_KV"))
         # page size: largest power of two <= min(256, max_seq) dividing
         # max_seq, so every window bucket (powers of two >= 256, capped
         # at max_seq) is page-aligned; LOCALAI_KV_PAGE overrides within
@@ -501,7 +500,7 @@ class LLMEngine:
         pg = 1
         while pg * 2 <= page_cap and max_seq % (pg * 2) == 0:
             pg *= 2
-        want_pg = int(_os.environ.get("LOCALAI_KV_PAGE", "0") or 0)
+        want_pg = knobs.int_("LOCALAI_KV_PAGE")
         if (want_pg >= 8 and want_pg <= page_cap
                 and max_seq % want_pg == 0
                 and want_pg & (want_pg - 1) == 0):
@@ -513,7 +512,7 @@ class LLMEngine:
             self._max_pages = max_seq // pg  # logical pages per slot
             pages_default = n_slots * self._max_pages + 1  # + trash
             self.kv_pages = max(2, int(
-                kv_pages or _os.environ.get("LOCALAI_KV_PAGES", 0)
+                kv_pages or knobs.int_("LOCALAI_KV_PAGES")
                 or pages_default))
             self._pool = PagePool(self.kv_pages, pg)
             self.cache = KVCache.create(spec, self.kv_pages, pg,
@@ -543,9 +542,8 @@ class LLMEngine:
         # gather/scatter fallback at full width — same values, still
         # one variant per shape. LOCALAI_RAGGED_ATTN=off restores the
         # legacy windowed paths byte-identically.
-        self._ragged = self._paged and _os.environ.get(
-            "LOCALAI_RAGGED_ATTN", "on").lower() not in (
-            "0", "off", "false")
+        self._ragged = self._paged and knobs.flag(
+            "LOCALAI_RAGGED_ATTN")
         self.warmup_variants = 0  # dispatch variants precompiled by the
         # last completed warmup() pass (engine_dispatch_compile_variants
         # gauge; 0 until warmup runs or when it was marker-skipped)
@@ -574,12 +572,12 @@ class LLMEngine:
                 # to guess at (the spec paths then run the GSPMD gather
                 # fallback — _kernel_eligible gates the shard_map route
                 # on draft eligibility)
-                from ..parallel.sharding import PAGED_KV_SPEC
+                from ..parallel.sharding import PAGED_KV_SPEC, REPLICATED
                 from jax.sharding import NamedSharding
-                from jax.sharding import PartitionSpec as _P
 
                 arena_sp = (PAGED_KV_SPEC
-                            if draft[0].kv_dim % mesh_tp == 0 else _P())
+                            if draft[0].kv_dim % mesh_tp == 0
+                            else REPLICATED)
 
                 def _put_arena(arr, sp):
                     return jax.device_put(arr, NamedSharding(mesh, sp))
@@ -588,9 +586,9 @@ class LLMEngine:
                 self.draft_cache = type(dc)(
                     k=_put_arena(dc.k, arena_sp),
                     v=_put_arena(dc.v, arena_sp),
-                    k_scale=(_put_arena(dc.k_scale, _P())
+                    k_scale=(_put_arena(dc.k_scale, REPLICATED)
                              if dc.quantized else None),
-                    v_scale=(_put_arena(dc.v_scale, _P())
+                    v_scale=(_put_arena(dc.v_scale, REPLICATED)
                              if dc.quantized else None),
                 )
         self.slots = [_Slot(i) for i in range(n_slots)]
@@ -603,23 +601,20 @@ class LLMEngine:
         # resident cache_tokens + on-device row-to-row KV copies
         # (engine/prefix_index.py). LOCALAI_PREFIX_CACHE=off restores
         # the old own-slot-only reuse.
-        import os as _os
-
-        self._prefix_enabled = _os.environ.get(
-            "LOCALAI_PREFIX_CACHE", "on").lower() not in (
-            "0", "off", "false")
+        self._prefix_enabled = knobs.flag("LOCALAI_PREFIX_CACHE")
         # minimum token GAIN over the destination's own resident prefix
         # before a copy is worth dispatching (a copy is a sub-ms HBM
         # move, so the floor is low)
-        self._prefix_min_copy = max(1, int(_os.environ.get(
-            "LOCALAI_PREFIX_CACHE_MIN", "8")))
+        self._prefix_min_copy = max(
+            1, knobs.int_("LOCALAI_PREFIX_CACHE_MIN"))
         # minimum SHARED-prefix length before a same-wave request
         # defers behind a wave-mate's prefill: deferral delays the
         # sharer's TTFT by a scheduler iteration and splits the wave's
         # prefill group, so it must buy substantially more than the
         # ~6-token chat-template prefix every request shares
-        self._prefix_defer_min = max(self._prefix_min_copy, int(
-            _os.environ.get("LOCALAI_PREFIX_CACHE_DEFER_MIN", "64")))
+        self._prefix_defer_min = max(
+            self._prefix_min_copy,
+            knobs.int_("LOCALAI_PREFIX_CACHE_DEFER_MIN"))
         # stall-free mixed prefill+decode dispatch: ONE fused identity-
         # batch device step advances prefill chunks AND decode rows, so
         # an admission wave never serializes against active streams
@@ -627,9 +622,7 @@ class LLMEngine:
         # holds). LOCALAI_MIXED_DISPATCH=off restores the legacy
         # alternating-phase scheduler (the escape hatch). Forced off
         # when no prefill bucket fits the identity-batch token budget.
-        self._mixed = _os.environ.get(
-            "LOCALAI_MIXED_DISPATCH", "on").lower() not in (
-            "0", "off", "false")
+        self._mixed = knobs.flag("LOCALAI_MIXED_DISPATCH")
         if not any(b * n_slots <= self._PREFILL_GROUP_TOKENS
                    for b in self.prefill_buckets):
             self._mixed = False
@@ -647,10 +640,9 @@ class LLMEngine:
         # - LOCALAI_MAX_QUEUE: admission queue cap — submit_many sheds
         #   beyond it with an immediate terminal "shed" event instead
         #   of queueing unbounded latency
-        self._default_deadline_s = max(0.0, float(_os.environ.get(
-            "LOCALAI_REQUEST_DEADLINE_S", "0") or 0))
-        self.max_queue = max(0, int(_os.environ.get(
-            "LOCALAI_MAX_QUEUE", "0") or 0))
+        self._default_deadline_s = max(
+            0.0, knobs.float_("LOCALAI_REQUEST_DEADLINE_S"))
+        self.max_queue = max(0, knobs.int_("LOCALAI_MAX_QUEUE"))
         # sticky arm: flips on the first request that carries any
         # deadline, so deadline-free serving never pays the sweep
         self._deadlines_armed = self._default_deadline_s > 0
@@ -676,8 +668,7 @@ class LLMEngine:
         # would be an implicit cross-shard all-gather per spill)
         if (self._paged and channel is None and not follower
                 and draft is None and mesh is None
-                and _os.environ.get("LOCALAI_KV_TIER", "on").lower()
-                not in ("0", "off", "false")):
+                and knobs.flag("LOCALAI_KV_TIER")):
             from .kv_tier import KVTierManager
 
             self._tier = KVTierManager(self)
@@ -801,11 +792,9 @@ class LLMEngine:
         """Use the Pallas ragged decode kernels when the mosaic path is
         available and shapes qualify (ops/decode_attention.py). Env
         override: LOCALAI_DECODE_KERNEL=0/1."""
-        import os
-
         from ..ops.decode_attention import PAGE, _interpret
 
-        env = os.environ.get("LOCALAI_DECODE_KERNEL", "auto")
+        env = knobs.str_("LOCALAI_DECODE_KERNEL")
         if env in ("0", "false", "off"):
             return False
         # default ON where mosaic compiles: the fused per-slot kernel
@@ -2156,8 +2145,7 @@ class LLMEngine:
 
         t0 = time.perf_counter()
         marker = self._warmup_marker_path()
-        reuse_ok = os.environ.get("LOCALAI_WARMUP_REUSE", "1") not in (
-            "0", "false", "off")
+        reuse_ok = knobs.flag("LOCALAI_WARMUP_REUSE")
         if marker is not None and reuse_ok and os.path.exists(marker):
             self.warmup_reused = True
             tm.ENGINE_WARMUP_SECONDS.labels(
@@ -2625,19 +2613,21 @@ class LLMEngine:
     def _fail_all(self, msg: str) -> None:
         for s in self.slots:
             if s.active and s.out is not None:
-                s.out.put(StreamEvent(done=True, finish_reason="error",
-                                      error=msg))
                 if s.request is not None:
                     TRACER.event(s.request.id, "done")
                     # the step error (a real device failure or an
                     # injected fault — the message says which) becomes
-                    # a span event on every trace it terminated
+                    # a span event on every trace it terminated; the
+                    # trace commits BEFORE the terminal stream event so
+                    # a consumer woken by it observes the final status
                     TRACER.annotate(s.request.id, "terminal",
                                     outcome="error", detail=msg)
                     TRACER.finish(s.request.id, status="error")
                     tm.ENGINE_REQUESTS.labels(model=self._mlabel,
                                               reason="error").inc()
                     tm.ENGINE_PREEMPTIONS.labels(model=self._mlabel).inc()
+                s.out.put(StreamEvent(done=True, finish_reason="error",
+                                      error=msg))
                 self._release(s)
 
     # lint: region hot_path

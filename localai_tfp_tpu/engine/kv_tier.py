@@ -61,6 +61,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from ..config import knobs
 import numpy as np
 
 from ..models.staging import TransferWindow
@@ -144,13 +146,6 @@ def _common_prefix(a, b) -> int:
     return i
 
 
-def _env_f(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
 # ------------------------------------------------------------ host store
 
 
@@ -222,14 +217,14 @@ class KVTierManager:
         self.P = eng._page
         self._mlabel = eng._mlabel
         self.host_budget = int(
-            _env_f("LOCALAI_KV_TIER_HOST_MB", 256.0) * (1 << 20))
-        self.watermark = min(1.0, max(0.05, _env_f(
-            "LOCALAI_KV_TIER_WATERMARK", 0.85)))
-        self.idle_s = max(0.0, _env_f("LOCALAI_KV_TIER_IDLE_S", 1.0))
-        self.cold_s = max(0.0, _env_f("LOCALAI_KV_TIER_COLD_S", 30.0))
-        self.fetch_deadline_s = max(0.05, _env_f(
-            "LOCALAI_KV_TIER_FETCH_DEADLINE_S", 2.0))
-        self.cold_dir = os.environ.get("LOCALAI_KV_TIER_DIR", "")
+            knobs.float_("LOCALAI_KV_TIER_HOST_MB") * (1 << 20))
+        self.watermark = min(1.0, max(0.05, knobs.float_(
+            "LOCALAI_KV_TIER_WATERMARK")))
+        self.idle_s = max(0.0, knobs.float_("LOCALAI_KV_TIER_IDLE_S"))
+        self.cold_s = max(0.0, knobs.float_("LOCALAI_KV_TIER_COLD_S"))
+        self.fetch_deadline_s = max(0.05, knobs.float_(
+            "LOCALAI_KV_TIER_FETCH_DEADLINE_S"))
+        self.cold_dir = knobs.str_("LOCALAI_KV_TIER_DIR")
         self._lock = threading.Lock()
         self._host: dict[int, _HostPage] = {}  # lint: guarded-by self._lock
         self._dedup: dict[bytes, int] = {}  # lint: guarded-by self._lock
@@ -239,7 +234,7 @@ class KVTierManager:
         self._next_id = 1
         # in-flight transfers (scheduler-thread-owned)
         self._swin = TransferWindow(int(
-            _env_f("LOCALAI_KV_TIER_INFLIGHT_MB", 64.0) * (1 << 20)))
+            knobs.float_("LOCALAI_KV_TIER_INFLIGHT_MB") * (1 << 20)))
         self._fwin = TransferWindow(1 << 62)  # tracking only, no cap
         self._spilling: set[int] = set()  # slot idxs with a spill aloft
         self._fetches: dict[str, _Fetch] = {}  # req.id -> staged fetch

@@ -105,9 +105,9 @@ registry = _Registry()
 def register_default_backends() -> None:
     """Register the built-in worker factories (lazy imports so optional
     deps never block startup)."""
-    import os
+    from ..config import knobs
 
-    if os.environ.get("LOCALAI_NATIVE", "1") not in ("0", "false", "off"):
+    if knobs.flag("LOCALAI_NATIVE"):
         # compile the native hot-path libraries once at startup so the
         # first grammar/store request never blocks on g++
         from ..native import build

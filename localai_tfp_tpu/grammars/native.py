@@ -14,6 +14,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..config import knobs
 from ..native import load_library
 
 
@@ -42,7 +43,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
 
 
 def available() -> bool:
-    if os.environ.get("LOCALAI_NATIVE_GBNF", "1") in ("0", "false", "off"):
+    if not knobs.flag("LOCALAI_NATIVE_GBNF"):
         return False
     return load_library("gbnf") is not None
 

@@ -30,6 +30,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from ..config import knobs
 from .quant import QTensor
 
 log = logging.getLogger(__name__)
@@ -38,12 +39,11 @@ FORMAT_VERSION = "int8-artifact-v1"
 
 
 def enabled() -> bool:
-    return os.environ.get("LOCALAI_QUANT_ARTIFACTS", "on").lower() not in (
-        "off", "0", "false", "no")
+    return knobs.flag("LOCALAI_QUANT_ARTIFACTS")
 
 
 def cache_dir() -> str:
-    root = os.environ.get("LOCALAI_QUANT_CACHE_DIR")
+    root = knobs.str_("LOCALAI_QUANT_CACHE_DIR")
     if not root:
         xdg = os.environ.get("XDG_CACHE_HOME",
                              os.path.expanduser("~/.cache"))
@@ -191,8 +191,7 @@ def _evict_over_budget(root: str, keep: str) -> None:
     checkpoint, changed quant config) is otherwise a multi-GB orphan
     nothing ever deletes."""
     try:
-        budget = float(os.environ.get(
-            "LOCALAI_QUANT_CACHE_MAX_GB", "50")) * 1e9
+        budget = knobs.float_("LOCALAI_QUANT_CACHE_MAX_GB") * 1e9
         files = []
         now = time.time()
         for f in os.listdir(root):

@@ -17,6 +17,8 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..config import knobs
+
 
 class QTensor(NamedTuple):
     """int8 weight + per-output-channel scale. A NamedTuple, so it is a
@@ -109,8 +111,7 @@ def _kernel_enabled() -> bool:
     # 20%, but INSIDE the per-layer decode scan its per-grid-step
     # overhead compounds (measured 8B serving: 588 vs 703 tok/s) — the
     # next iteration is a whole-layer fusion; opt in to experiment
-    return os.environ.get("LOCALAI_INT8_KERNEL", "0") in (
-        "1", "true", "on")
+    return knobs.flag("LOCALAI_INT8_KERNEL")
 
 
 def mm(x: jax.Array, w: Any):

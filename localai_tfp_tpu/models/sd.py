@@ -1028,10 +1028,13 @@ def _load_clip_tokenizer(tok_dir: str):
 
 
 def consumed_keys_check(pipe: SDPipeline, prompt: str = "x") -> dict:
-    """Run one tiny un-jitted forward of every component with
-    leaf-access recording; returns {component: [unconsumed keys]} —
-    tests assert these are empty (an imported tensor the forward never
-    reads is a wiring bug)."""
+    """Trace one tiny forward of every component with leaf-access
+    recording; returns {component: [unconsumed keys]} — tests assert
+    these are empty (an imported tensor the forward never reads is a
+    wiring bug). Key READS happen at trace time, so each component runs
+    under ``jax.eval_shape`` — the access set is identical to a real
+    forward but no compute is compiled or dispatched; stage outputs
+    thread through as ShapeDtypeStructs."""
     report = {}
     snap = pipe.vae_scale * (2 ** (len(
         pipe.unet_spec.block_out_channels) - 1))
@@ -1041,9 +1044,9 @@ def consumed_keys_check(pipe: SDPipeline, prompt: str = "x") -> dict:
         prompt, padding="max_length",
         max_length=pipe.clip_spec.max_position, truncation=True,
         return_tensors="np")["input_ids"].astype(np.int32)
-    cond = clip_text_encode(pipe.clip_spec,
-                            _RecDict(pipe.text_tree, "", seen),
-                            jnp.asarray(ids))
+    cond = jax.eval_shape(lambda: clip_text_encode(
+        pipe.clip_spec, _RecDict(pipe.text_tree, "", seen),
+        jnp.asarray(ids)))
     report["text_encoder"] = [k for k in tree_keys(pipe.text_tree)
                               if k not in seen]
 
@@ -1054,41 +1057,51 @@ def consumed_keys_check(pipe: SDPipeline, prompt: str = "x") -> dict:
             prompt, padding="max_length",
             max_length=pipe.clip2_spec.max_position, truncation=True,
             return_tensors="np")["input_ids"].astype(np.int32)
-        h1, _, _ = clip_text_states(pipe.clip_spec, pipe.text_tree,
-                                    jnp.asarray(ids))
-        h2, _, pooled = clip_text_states(
-            pipe.clip2_spec, _RecDict(pipe.text2_tree, "", seen),
-            jnp.asarray(ids2))
+
+        def _xl_cond():
+            h1, _, _ = clip_text_states(pipe.clip_spec, pipe.text_tree,
+                                        jnp.asarray(ids))
+            h2, _, pooled = clip_text_states(
+                pipe.clip2_spec, _RecDict(pipe.text2_tree, "", seen),
+                jnp.asarray(ids2))
+            return jnp.concatenate([h1, h2], axis=-1), pooled
+
+        cond, pooled = jax.eval_shape(_xl_cond)
         report["text_encoder_2"] = [k for k in tree_keys(pipe.text2_tree)
                                     if k not in seen]
-        cond = jnp.concatenate([h1, h2], axis=-1)
         added = (pooled,
-                 jnp.asarray([[snap, snap, 0, 0, snap, snap]],
-                             jnp.float32))
+                 jax.ShapeDtypeStruct((1, 6), jnp.float32))
 
     seen = set()
     lat = jnp.zeros((1, snap // pipe.vae_scale, snap // pipe.vae_scale,
                      int(pipe.unet_spec.in_channels)), jnp.float32)
-    unet_forward(pipe.unet_spec, _RecDict(pipe.unet_tree, "", seen), lat,
-                 jnp.zeros((1,), jnp.int32), cond, added)
+    jax.eval_shape(
+        lambda c, a: unet_forward(
+            pipe.unet_spec, _RecDict(pipe.unet_tree, "", seen), lat,
+            jnp.zeros((1,), jnp.int32), c, a),
+        cond, added)
     report["unet"] = [k for k in tree_keys(pipe.unet_tree)
                       if k not in seen]
 
     if pipe.control_spec is not None:
         seen = set()
-        controlnet_forward(
-            pipe.control_spec, _RecDict(pipe.control_tree, "", seen),
-            lat, jnp.zeros((1,), jnp.int32), cond,
-            jnp.zeros((1, snap, snap, 3), jnp.float32),
-            jnp.float32(1.0), added)
+        jax.eval_shape(
+            lambda c, a: controlnet_forward(
+                pipe.control_spec, _RecDict(pipe.control_tree, "", seen),
+                lat, jnp.zeros((1,), jnp.int32), c,
+                jnp.zeros((1, snap, snap, 3), jnp.float32),
+                jnp.float32(1.0), a),
+            cond, added)
         report["controlnet"] = [k for k in tree_keys(pipe.control_tree)
                                 if k not in seen]
 
     seen = set()
-    vae_decode(_RecDict(pipe.vae_tree, "", seen), pipe.vae_cfg, lat)
+    jax.eval_shape(lambda: vae_decode(
+        _RecDict(pipe.vae_tree, "", seen), pipe.vae_cfg, lat))
     if "encoder" in pipe.vae_tree:  # img2img/video reads the encoder too
-        vae_encode(_RecDict(pipe.vae_tree, "", seen), pipe.vae_cfg,
-                   jnp.zeros((1, snap, snap, 3), jnp.float32))
+        jax.eval_shape(lambda: vae_encode(
+            _RecDict(pipe.vae_tree, "", seen), pipe.vae_cfg,
+            jnp.zeros((1, snap, snap, 3), jnp.float32)))
     report["vae"] = [k for k in tree_keys(pipe.vae_tree) if k not in seen]
     return report
 

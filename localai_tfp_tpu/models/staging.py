@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..config import knobs
 from .hf_loader import DeferredT
 from .quant import QTensor, QUANTIZABLE, quantize_raw_tensor
 
@@ -202,8 +203,7 @@ def commit_deferred(
     # so they sort last as a class; order within them is immaterial for
     # the peak (each is ~the same size and commits one at a time).
     names = sorted(params, key=lambda n: _leaf_bytes(params[n]))
-    budget = int(os.environ.get(
-        "LOCALAI_COMMIT_INFLIGHT_MB", "1024")) * (1 << 20)
+    budget = knobs.int_("LOCALAI_COMMIT_INFLIGHT_MB") * (1 << 20)
     window = TransferWindow(budget)
 
     def drain(need: int) -> None:

@@ -584,17 +584,21 @@ def svd_consumed_keys(pipe: SVDPipeline) -> dict:
     lat = jnp.zeros((T, hw, hw, pipe.unet_spec.in_channels), jnp.float32)
     ctx = jnp.zeros((T, 1, pipe.unet_spec.cross_attention_dim),
                     jnp.float32)
-    svd_unet_forward(pipe.unet_spec, _RecDict(pipe.unet_tree, "", seen),
-                     lat, jnp.zeros((1,), jnp.float32), ctx,
-                     jnp.zeros((1, 3), jnp.float32), T)
+    # key READS happen at trace time, so abstract evaluation records the
+    # same access set as a real forward without dispatching any compute
+    jax.eval_shape(lambda: svd_unet_forward(
+        pipe.unet_spec, _RecDict(pipe.unet_tree, "", seen),
+        lat, jnp.zeros((1,), jnp.float32), ctx,
+        jnp.zeros((1, 3), jnp.float32), T))
     report["unet"] = [k for k in tree_keys(pipe.unet_tree)
                       if k not in seen]
     seen = set()
     z = jnp.zeros((T, hw, hw, pipe.unet_spec.out_channels), jnp.float32)
-    temporal_vae_decode(_RecDict(pipe.vae_tree, "", seen), pipe.vae_cfg,
-                        z, T)
-    vae_encode(_RecDict(pipe.vae_tree, "", seen), pipe.vae_cfg,
-               jnp.zeros((1, snap, snap, 3), jnp.float32))
+    jax.eval_shape(lambda: temporal_vae_decode(
+        _RecDict(pipe.vae_tree, "", seen), pipe.vae_cfg, z, T))
+    jax.eval_shape(lambda: vae_encode(
+        _RecDict(pipe.vae_tree, "", seen), pipe.vae_cfg,
+        jnp.zeros((1, snap, snap, 3), jnp.float32)))
     report["vae"] = [k for k in tree_keys(pipe.vae_tree) if k not in seen]
     seen = set()
     rec = SVDPipeline(

@@ -204,23 +204,27 @@ def sharded_append_attend(
     Returns (out [S, H*Dh] sharded ("data", "model"), ck, cv, ks, vs).
     """
     from jax.experimental.shard_map import shard_map
-    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharding import (
+        BATCH_SPEC, DENSE_Q_SPEC, DENSE_ROW_SPEC, DENSE_SCALE_SPEC,
+        KV_CACHE_SPEC, REPLICATED,
+    )
 
     tp = mesh.shape.get("model", 1)
     quant = cache_k_scale is not None
     n_kv_local = n_kv_heads // tp
 
-    row_spec = P("data", "model")  # [S, F] rows
-    cache_spec = P(None, "data", None, "model")
-    scale_row_spec = P("data")
-    scale_cache_spec = P(None, "data", None)
+    row_spec = DENSE_ROW_SPEC  # [S, F] rows
+    cache_spec = KV_CACHE_SPEC
+    scale_row_spec = BATCH_SPEC
+    scale_cache_spec = DENSE_SCALE_SPEC
 
     in_specs = [
-        P("data", "model", None),  # q
+        DENSE_Q_SPEC,  # q
         row_spec, row_spec,  # new_k, new_v
         row_spec, row_spec,  # kq_row, vq_row
         cache_spec, cache_spec,  # cache_k, cache_v
-        P(), P("data"),  # layer, pos0
+        REPLICATED, BATCH_SPEC,  # layer, pos0
     ]
     operands = [q, new_k, new_v, kq_row, vq_row, cache_k, cache_v,
                 layer, pos0]

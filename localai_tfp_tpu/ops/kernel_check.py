@@ -402,12 +402,12 @@ def check_meshed_paged_gather(quantized: bool = False,
     replicated) must reproduce the dense cache EXACTLY — it is pure
     indexing, so any nonzero error is a resharding bug. None when fewer
     than 2 devices are visible."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding
 
     from ..models.transformer import (
         KVCache, _quantize_rows, gather_kv_pages,
     )
-    from ..parallel.sharding import PAGED_KV_SPEC
+    from ..parallel.sharding import PAGED_KV_SPEC, REPLICATED
 
     L, S, SEQ, n_kv, dh = 2, 4, 512, 8, 128
     mesh = _tp_mesh(n_kv)
@@ -440,8 +440,8 @@ def check_meshed_paged_gather(quantized: bool = False,
         arena = KVCache(
             k=put(jnp.asarray(scatter(np.asarray(kq))), PAGED_KV_SPEC),
             v=put(jnp.asarray(scatter(np.asarray(vq))), PAGED_KV_SPEC),
-            k_scale=put(jnp.asarray(scatter(np.asarray(ks))), P()),
-            v_scale=put(jnp.asarray(scatter(np.asarray(vs))), P()),
+            k_scale=put(jnp.asarray(scatter(np.asarray(ks))), REPLICATED),
+            v_scale=put(jnp.asarray(scatter(np.asarray(vs))), REPLICATED),
         )
         win = gather_kv_pages(arena, jnp.asarray(pt), page)
         return max(

@@ -377,18 +377,21 @@ def sharded_ragged_append_attend(
     Returns (out [B, T, H*Dh] sharded over "model", ck, cv[, ks, vs]).
     """
     from jax.experimental.shard_map import shard_map
-    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharding import (
+        PAGED_KV_SPEC, RAGGED_Q_SPEC, RAGGED_ROW_SPEC, REPLICATED,
+    )
 
     tp = mesh.shape.get("model", 1)
     quant = cache_k_scale is not None
     n_kv_local = n_kv_heads // tp
 
-    row_spec = P(None, None, "model")  # [B, T, F] rows
-    arena_spec = P(None, None, None, "model")  # PAGED_KV_SPEC
-    rep = P()  # tables, scalars, per-row + per-plane scales
+    row_spec = RAGGED_ROW_SPEC  # [B, T, F] rows
+    arena_spec = PAGED_KV_SPEC
+    rep = REPLICATED  # tables, scalars, per-row + per-plane scales
 
     in_specs = [
-        P(None, None, "model", None),  # q: heads over "model"
+        RAGGED_Q_SPEC,  # q: heads over "model"
         row_spec, row_spec,  # new_k, new_v
         row_spec, row_spec,  # kq, vq
         arena_spec, arena_spec,  # cache_k, cache_v

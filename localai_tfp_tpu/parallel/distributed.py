@@ -22,6 +22,7 @@ from typing import Optional
 
 import jax
 
+from ..config import knobs
 from .mesh import make_mesh
 
 log = logging.getLogger(__name__)
@@ -35,15 +36,16 @@ def initialize(
     """Initialize jax.distributed from args or the standard env vars
     (LOCALAI_COORDINATOR / JAX_COORDINATOR_ADDRESS etc.). Returns True if
     a multi-process runtime was set up, False for single-host."""
-    coordinator_address = coordinator_address or os.environ.get(
-        "LOCALAI_COORDINATOR") or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    coordinator_address = (coordinator_address
+                           or knobs.str_("LOCALAI_COORDINATOR")
+                           or os.environ.get("JAX_COORDINATOR_ADDRESS"))
     if not coordinator_address:
         return False
     kwargs = {}
-    if num_processes is None and os.environ.get("LOCALAI_NUM_HOSTS"):
-        num_processes = int(os.environ["LOCALAI_NUM_HOSTS"])
-    if process_id is None and os.environ.get("LOCALAI_HOST_ID"):
-        process_id = int(os.environ["LOCALAI_HOST_ID"])
+    if num_processes is None and knobs.present("LOCALAI_NUM_HOSTS"):
+        num_processes = knobs.int_("LOCALAI_NUM_HOSTS")
+    if process_id is None and knobs.present("LOCALAI_HOST_ID"):
+        process_id = knobs.int_("LOCALAI_HOST_ID")
     if num_processes is not None:
         kwargs["num_processes"] = num_processes
     if process_id is not None:

@@ -50,6 +50,7 @@ from typing import Optional
 
 from aiohttp import ClientError, ClientSession, ClientTimeout, web
 
+from ..config import knobs
 from ..telemetry import metrics as tm
 from ..telemetry.flightrec import FLIGHT
 from ..telemetry.tracing import (
@@ -108,12 +109,10 @@ class NodeRegistry:
     def __init__(self, token: str) -> None:
         self.token_payload = parse_token(token)
         self._nodes: dict[str, Node] = {}
-        self.breaker_fails = max(1, int(os.environ.get(
-            "LOCALAI_FED_BREAKER_FAILS", "3")))
-        self.breaker_base_s = float(os.environ.get(
-            "LOCALAI_FED_BREAKER_BASE_S", "1.0"))
-        self.breaker_cap_s = float(os.environ.get(
-            "LOCALAI_FED_BREAKER_CAP_S", "30.0"))
+        self.breaker_fails = max(
+            1, knobs.int_("LOCALAI_FED_BREAKER_FAILS"))
+        self.breaker_base_s = knobs.float_("LOCALAI_FED_BREAKER_BASE_S")
+        self.breaker_cap_s = knobs.float_("LOCALAI_FED_BREAKER_CAP_S")
 
     def _authorized(self, token: str) -> bool:
         try:
@@ -225,7 +224,7 @@ class FederatedServer:
         self.registry = NodeRegistry(token)
         self.token = token
         self.strategy = strategy
-        self.probe_s = (float(os.environ.get("LOCALAI_FED_PROBE_S", "5"))
+        self.probe_s = (knobs.float_("LOCALAI_FED_PROBE_S")
                         if probe_s is None else probe_s)
 
     def build_app(self) -> web.Application:

@@ -77,6 +77,28 @@ KV_CACHE_SPEC = P(None, "data", None, "model")
 PAGED_KV_SPEC = P(None, None, None, "model")
 TOKENS_SPEC = P("data", "seq")
 BATCH_SPEC = P("data")
+# Replicated operands: global per-row-amax scale planes, scalars and
+# the host-owned int32 page tables when passed as shard_map inputs.
+# The page tables themselves must NEVER be device_put/constrained onto
+# a mesh axis (sharding-contract lint rule): they are host-owned
+# scheduler state and every device reads the full table.
+REPLICATED = P()
+# dense per-slot scale cache [L, slots, seq]: rows over "data"
+DENSE_SCALE_SPEC = P(None, "data", None)
+# dense decode rows [S, F]: slots over "data", head-flat F over "model"
+DENSE_ROW_SPEC = P("data", "model")
+# dense decode q [S, H, Dh]: heads over "model"
+DENSE_Q_SPEC = P("data", "model", None)
+# ragged batch rows [B, T, F]: F over "model" (pages carry no slot
+# identity, so nothing rides "data" — matches PAGED_KV_SPEC)
+RAGGED_ROW_SPEC = P(None, None, "model")
+# ragged q [B, T, H, Dh]: heads over "model"
+RAGGED_Q_SPEC = P(None, None, "model", None)
+
+# Every shard_map in/out spec and every paged-fallback window pin in
+# engine/ and ops/ must be built from the named constants above — the
+# sharding-contract rule bans inline P(...) literals there, so a spec
+# cannot silently drift from the arena/cache layout it must match.
 
 
 def _mesh_is_multiprocess(mesh: Mesh) -> bool:
@@ -131,10 +153,10 @@ def shard_engine_state(cache, sampling, mesh: Mesh, paged: bool = False):
 
     if paged:
         kv_spec = PAGED_KV_SPEC
-        scale_spec = P()  # [L, n_pages, page] per-row scales: replicated
+        scale_spec = REPLICATED  # [L, n_pages, page] per-row scales
     else:
         kv_spec = KV_CACHE_SPEC
-        scale_spec = P(None, "data", None)  # [L, slots, seq] row scales
+        scale_spec = DENSE_SCALE_SPEC  # [L, slots, seq] row scales
     cache = type(cache)(
         k=put(cache.k, kv_spec), v=put(cache.v, kv_spec),
         k_scale=(put(cache.k_scale, scale_spec)

@@ -20,6 +20,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..config import knobs
 from ..workers.base import Backend, ModelLoadOptions, Result
 
 _DEVICE_THRESHOLD = 50_000  # rows; above this the matvec moves to jnp
@@ -136,8 +137,7 @@ class NativeVectorStore:
 
 def make_store():
     """Native store when built (unless LOCALAI_NATIVE_STORE=0)."""
-    if os.environ.get("LOCALAI_NATIVE_STORE", "1") not in ("0", "false",
-                                                           "off"):
+    if knobs.flag("LOCALAI_NATIVE_STORE"):
         try:
             return NativeVectorStore()
         except RuntimeError:

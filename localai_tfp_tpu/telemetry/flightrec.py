@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Optional
 
+from ..config import knobs
 from .metrics import TIMELINE_RING_EVENTS
 
 # shared clock origin: every event's ts is perf_counter relative to this
@@ -43,11 +44,7 @@ KV_TIER_TRACK = "kv_tier"
 
 
 def _env_capacity() -> int:
-    try:
-        return max(64, int(os.environ.get("LOCALAI_TIMELINE_EVENTS",
-                                          "8192")))
-    except ValueError:
-        return 8192
+    return max(64, knobs.int_("LOCALAI_TIMELINE_EVENTS"))
 
 
 class FlightRecorder:
@@ -60,8 +57,7 @@ class FlightRecorder:
 
     def __init__(self, capacity: Optional[int] = None) -> None:
         self.capacity = capacity or _env_capacity()
-        self.enabled = os.environ.get(
-            "LOCALAI_TIMELINE", "on").lower() not in ("off", "0", "false")
+        self.enabled = knobs.flag("LOCALAI_TIMELINE")
         self._lock = threading.Lock()
         self._buf: list = [None] * self.capacity
         self._n = 0  # events ever recorded (ring head = _n % capacity)

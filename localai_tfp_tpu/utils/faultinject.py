@@ -36,6 +36,8 @@ import time
 import zlib
 from typing import Optional
 
+from ..config import knobs
+
 __all__ = ["InjectedFault", "arm", "disarm", "fire", "counts", "observe",
            "ACTIVE"]
 
@@ -178,6 +180,6 @@ def counts() -> dict[str, tuple[int, int]]:
 
 # env arming: one parse at import so every layer sees the same set the
 # moment the process starts (profile_chaos drives subprocesses this way)
-_env = os.environ.get("LOCALAI_FAULTS", "")
+_env = knobs.str_("LOCALAI_FAULTS")
 if _env:
     arm(_env)

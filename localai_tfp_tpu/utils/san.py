@@ -1,0 +1,41 @@
+"""Runtime-sanitizer hook: arm graftsan when ``LOCALAI_SAN=1``.
+
+graftsan (``tools/lint/sanitizer.py``) is dev tooling — it lives next
+to the linter, outside the package, so production installs never pay
+for it. This module is the one sanctioned bridge: the package's
+``__init__`` calls :func:`maybe_arm`, which reads the ``LOCALAI_SAN``
+knob and, only when it is on, locates the repo-local ``tools`` tree
+and arms the sanitizer (lock-order graph + dynamic guarded-by checks).
+
+Disarmed cost is the knob read at import; armed cost is per-acquire
+bookkeeping, which is why the knob defaults off and the tier-1
+chaos/stress suites opt in explicitly.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+from ..config import knobs
+
+
+def maybe_arm() -> bool:
+    """Arm graftsan iff ``LOCALAI_SAN`` is truthy. Returns whether the
+    sanitizer is armed. Missing tools/ (an installed wheel, not a repo
+    checkout) downgrades to a no-op rather than an import error."""
+    if not knobs.flag("LOCALAI_SAN"):
+        return False
+    try:
+        from tools.lint import sanitizer
+    except ImportError:
+        root = Path(__file__).resolve().parents[2]
+        if not (root / "tools" / "lint" / "sanitizer.py").exists():
+            return False
+        sys.path.insert(0, str(root))
+        try:
+            from tools.lint import sanitizer
+        except ImportError:
+            return False
+    sanitizer.arm()
+    return True

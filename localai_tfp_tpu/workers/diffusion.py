@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..config import knobs
 from ..models.diffusion import (
     DiffusionSpec, ddim_sample, init_diffusion_params, tiny_diffusion_spec,
 )
@@ -186,7 +187,7 @@ class JaxDiffusionBackend(Backend):
                 # explicit test fixture: random-init toy pipeline
                 from ..ops.decode_attention import _interpret
 
-                tiny = bool(os.environ.get("LOCALAI_TINY_DIFFUSION")) or \
+                tiny = knobs.flag("LOCALAI_TINY_DIFFUSION") or \
                     _interpret()  # CPU: tiny pipeline (tests/smoke)
                 self.spec = (tiny_diffusion_spec() if tiny
                              else DiffusionSpec())
@@ -356,7 +357,7 @@ class JaxDiffusionBackend(Backend):
                 p = os.path.join(frames_dir, f"f{i:04d}.png")
                 write_png(p, img)
                 paths.append(p)
-        keep = os.environ.get("LOCALAI_KEEP_FRAMES", "") not in ("", "0")
+        keep = knobs.flag("LOCALAI_KEEP_FRAMES")
         try:
             subprocess.run(
                 ["ffmpeg", "-y", "-framerate", str(fps or 8), "-i",

@@ -15,6 +15,7 @@ from typing import Iterator, Optional
 import jax
 import jax.numpy as jnp
 
+from ..config import knobs
 from ..engine.engine import GenRequest, LLMEngine, StreamEvent
 from ..engine.tokenizer import Tokenizer, load_tokenizer
 from ..grammars.native import make_constraint
@@ -396,8 +397,7 @@ class JaxLLMBackend(Backend):
                     )
                     self.engine.start()
                 if (role != "follower"
-                        and os.environ.get("LOCALAI_WARMUP", "1")
-                        not in ("0", "false", "off")):
+                        and knobs.flag("LOCALAI_WARMUP")):
                     # precompile the dispatch-variant set: a cold jit
                     # landing mid-request is a ~13s TTFT outlier at 8B
                     # scale (engine.warmup docstring); an identical

@@ -74,6 +74,10 @@ _SLOW_MODULES = {
     "test_e2e_surface", "test_oci", "test_train", "test_lora",
     "test_spec_decode", "test_sharded_engine", "test_workers",
     "test_vision", "test_model", "test_prompt_cache",
+    # the rest of the TTS family (torch-parity legs + worker-serving
+    # audio, same class as kokoro/vits/bark/musicgen above) and the
+    # remaining diffusion module (sd + mmdit are already here)
+    "test_outetts", "test_piper", "test_xtts", "test_svd",
 }
 
 

@@ -49,6 +49,20 @@ def eng(model):
     e.close()
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _graftsan_armed():
+    """The chaos scenarios run with graftsan armed: any lock-order
+    cycle or dynamic guarded-by violation they provoke fails the
+    module with both stacks in the report."""
+    from tools.lint import sanitizer as san
+    san.reset()
+    san.arm()
+    yield
+    reps = san.reports()
+    san.disarm()
+    assert not reps, f"graftsan reports under chaos: {reps}"
+
+
 @pytest.fixture(autouse=True)
 def _disarmed(eng):
     fi.disarm()

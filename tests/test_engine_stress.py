@@ -17,6 +17,20 @@ from localai_tfp_tpu.models.llm_spec import tiny_spec
 from localai_tfp_tpu.models.transformer import init_params
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _graftsan_armed():
+    """The stress storm runs with graftsan armed: a lock-order cycle or
+    guarded-by violation under the submit/cancel storm fails the
+    module with both stacks in the report."""
+    from tools.lint import sanitizer as san
+    san.reset()
+    san.arm()
+    yield
+    reps = san.reports()
+    san.disarm()
+    assert not reps, f"graftsan reports under stress: {reps}"
+
+
 @pytest.fixture(scope="module")
 def engine():
     tk = ByteTokenizer()

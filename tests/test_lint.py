@@ -114,6 +114,49 @@ def test_hot_path_conversion_result_untaints():
     assert len(fs) == 1 and fs[0].message.startswith("np.asarray")
 
 
+def test_hot_path_sync_through_helper_chain_fires():
+    """Interprocedural: a .item() buried two helper calls below the
+    region fires, and the finding names the call chain."""
+    src = """\
+        class Eng:
+            # lint: region hot_path
+            def step(self):
+                self._emit()
+            # lint: endregion hot_path
+
+            def _emit(self):
+                self._deep()
+
+            def _deep(self):
+                v = self.cache.k.item()
+        """
+    fs = _lint(src, "hot-path-sync")
+    assert len(fs) == 1, fs
+    assert "via step -> _emit -> _deep" in fs[0].message
+
+
+def test_hot_path_helper_return_taint_fires_and_len_clean():
+    """A helper RETURNING a device value taints its callers; a helper
+    returning host data (len) does not."""
+    src = """\
+        class Eng:
+            # lint: region hot_path
+            def step(self):
+                v = int(self._grab())
+                n = int(self._count())
+            # lint: endregion hot_path
+
+            def _grab(self):
+                return self.cache.k
+
+            def _count(self):
+                return len(self.slots)
+        """
+    fs = _lint(src, "hot-path-sync")
+    assert len(fs) == 1, fs
+    assert "int(" in fs[0].message
+
+
 # --------------------------------------------------------- scalar-payload
 
 
@@ -540,6 +583,141 @@ def test_span_balance_buried_in_expression():
     assert _ids(fs) == ["span-balance", "span-balance"]
 
 
+# ------------------------------------------------------ sharding-contract
+
+ENG_REL = "localai_tfp_tpu/engine/mod.py"
+
+
+def test_sharding_unpinned_gather_and_scatter_fire():
+    src = """\
+        def fallback(self, cache, phys, page):
+            win = gather_kv_pages(cache, phys, page)
+            win = self.fwd(win)
+            scatter_kv_pages(cache, win, wb, page)
+        """
+    fs = _lint(src, "sharding-contract", rel=ENG_REL)
+    msgs = " | ".join(f.message for f in fs)
+    assert len(fs) == 2, fs
+    assert "batch=True" in msgs and "batch=False" in msgs
+
+
+def test_sharding_pinned_round_trip_clean():
+    src = """\
+        def fallback(self, cache, phys, page, mesh):
+            win = gather_kv_pages(cache, phys, page)
+            win = _pin_win_sharding(win, mesh, batch=True)
+            win = self.fwd(win)
+            win = _pin_win_sharding(win, mesh, batch=False)
+            scatter_kv_pages(cache, win, wb, page)
+        """
+    assert _lint(src, "sharding-contract", rel=ENG_REL) == []
+
+
+def test_sharding_inline_spec_literal_fires_in_scope_only():
+    src = """\
+        from jax.sharding import PartitionSpec as P
+
+        def f(x):
+            return P("data", None)
+        """
+    fs = _lint(src, "sharding-contract", rel=ENG_REL)
+    assert len(fs) == 1 and "inline PartitionSpec" in fs[0].message
+    # same source outside engine//ops/ is out of scope
+    assert _lint(src, "sharding-contract",
+                 rel="localai_tfp_tpu/models/mod.py") == []
+
+
+def test_sharding_constrained_page_table_fires():
+    src = """\
+        def f(phys, mesh, spec):
+            phys = with_sharding_constraint(phys, spec)
+            return phys
+        """
+    fs = _lint(src, "sharding-contract", rel=ENG_REL)
+    assert len(fs) == 1 and "host-owned page table" in fs[0].message
+
+
+# ------------------------------------------------------ env-knob-registry
+
+_KNOBS_FIXTURE = {
+    "localai_tfp_tpu/config/knobs.py": (
+        'def _knob(n, d, k, doc):\n    pass\n\n'
+        '_knob("LOCALAI_FOO", "on", "flag", "a documented knob")\n'
+    ),
+}
+
+
+def test_env_knob_raw_access_fires():
+    src = """\
+        import os
+
+        def f():
+            a = os.environ.get("LOCALAI_FOO")
+            b = os.environ["LOCALAI_FOO"]
+            c = os.getenv(f"LOCALAI_{name}")
+            d = os.environ.get("PATH")
+        """
+    fs = _lint(src, "env-knob-registry", extra=dict(_KNOBS_FIXTURE),
+               readme="`LOCALAI_FOO`")
+    assert len(fs) == 3, fs  # PATH is not a knob
+    assert any("computed" in f.message for f in fs)
+
+
+def test_env_knob_unregistered_accessor_fires_registered_clean():
+    src = """\
+        from localai_tfp_tpu.config import knobs
+
+        def f():
+            good = knobs.flag("LOCALAI_FOO")
+            typo = knobs.flag("LOCALAI_FO0")
+            dyn = knobs.str_(name)
+        """
+    fs = _lint(src, "env-knob-registry", extra=dict(_KNOBS_FIXTURE),
+               readme="`LOCALAI_FOO`")
+    assert len(fs) == 2, fs
+    assert any("UNREGISTERED" in f.message for f in fs)
+    assert any("non-literal" in f.message for f in fs)
+
+
+def test_env_knob_config_dir_exempt():
+    src = 'import os\nV = os.environ.get("LOCALAI_FOO")\n'
+    assert _lint(src, "env-knob-registry",
+                 rel="localai_tfp_tpu/config/app_config.py",
+                 extra=dict(_KNOBS_FIXTURE),
+                 readme="`LOCALAI_FOO`") == []
+
+
+def test_env_knob_registry_semantics():
+    """The registry accessors read the environment at CALL time with
+    forgiving parsers (the rule's promise that one parser serves every
+    site)."""
+    import os
+
+    from localai_tfp_tpu.config import knobs
+
+    assert "LOCALAI_PAGED_KV" in knobs.REGISTRY
+    key = "LOCALAI_PAGED_KV"
+    old = os.environ.pop(key, None)
+    try:
+        assert knobs.flag(key) is True          # default on
+        os.environ[key] = "off"
+        assert knobs.flag(key) is False         # no caching
+        os.environ[key] = "garbage"
+        assert knobs.flag(key) is True          # unknown -> default
+        os.environ["LOCALAI_KV_PAGE"] = "not-an-int"
+        assert knobs.int_("LOCALAI_KV_PAGE") == 0
+    finally:
+        os.environ.pop(key, None)
+        os.environ.pop("LOCALAI_KV_PAGE", None)
+        if old is not None:
+            os.environ[key] = old
+    with pytest.raises(KeyError):
+        knobs.flag("LOCALAI_NOT_A_KNOB")
+    rows = knobs.markdown_rows()
+    assert len(rows) == len(knobs.REGISTRY) and all(
+        r.startswith("| `LOCALAI_") for r in rows)
+
+
 # ------------------------------------------- suppressions, regions, pragmas
 
 
@@ -612,9 +790,10 @@ def test_baseline_grandfathers_shrinks_and_rejects_new():
 
 def test_repo_lints_clean(repo_ctx):
     """THE gate: zero non-baselined findings across the package with
-    all six rules active. Seeding any violation into the tree (e.g. a
-    device sync in engine.py's hot path, a non-codec payload field)
-    fails here."""
+    all ten rules active. Seeding any violation into the tree (e.g. a
+    device sync in engine.py's hot path, a non-codec payload field, an
+    unpinned paged-fallback window, a raw LOCALAI_* env read) fails
+    here."""
     from tools.lint import DEFAULT_BASELINE, load_baseline
 
     findings = run_rules(repo_ctx, ALL_RULES)
@@ -681,15 +860,54 @@ def test_seeded_scalar_payload_violation_fires(repo_ctx):
                and "rogue_field" in f.message for f in findings)
 
 
-def test_cli_json_clean():
+def test_seeded_unpinned_paged_fallback_fires(repo_ctx):
+    """Acceptance: a paged fallback seeded into engine.py that gathers
+    and scatters a window without the _pin_win_sharding round trip
+    fails the lint gate."""
+    from tools.lint.core import Context, Module
+    eng = repo_ctx.module(ENGINE_REL)
+    seeded = eng.source + textwrap.dedent("""\
+
+
+        def _seeded_fallback(cache, phys, wb, page, fwd):
+            win = gather_kv_pages(cache, phys, page)
+            win = fwd(win)
+            scatter_kv_pages(cache, win, wb, page)
+        """)
+    mods = list(repo_ctx.modules)
+    mods[mods.index(eng)] = Module(ENGINE_REL, seeded)
+    ctx = Context(root=ROOT, modules=mods,
+                  readme_text=repo_ctx.readme_text)
+    findings = run_rules(ctx, rules_by_id(["sharding-contract"]))
+    assert any("batch=True" in f.message for f in findings)
+    assert any("batch=False" in f.message for f in findings)
+
+
+def test_metrics_families_shared_by_import():
+    """tools/check_metrics.py and the metrics-contract rule must share
+    ONE required-family list — by import identity, not by copy (a fork
+    would let the two gates drift apart)."""
+    from tools import check_metrics
+    from tools.lint.rules import metrics_contract
+
+    assert check_metrics.REQUIRED_FAMILIES is \
+        metrics_contract.REQUIRED_FAMILIES
+    assert check_metrics.SUFFIXES is metrics_contract.SUFFIXES
+
+
+def test_cli_json_clean_with_changed_filter():
+    """One CLI round trip covers both gates: `--json` report shape AND
+    the `--changed` incremental filter (a subset of a clean run is
+    still clean, so the combination must also exit 0)."""
     out = subprocess.run(
-        [sys.executable, "-m", "tools.lint", "--json"],
+        [sys.executable, "-m", "tools.lint", "--json", "--changed"],
         capture_output=True, text=True, cwd=ROOT)
     assert out.returncode == 0, out.stdout + out.stderr
     rep = json.loads(out.stdout)
     assert rep["ok"] is True
-    assert len(rep["rules"]) == 7  # lint-pragma rides along implicitly
+    assert len(rep["rules"]) == 10
     assert rep["findings"] == [] and rep["stale_baseline"] == []
+    assert rep["callgraph_edges"] > 500  # interprocedural graph is live
 
 
 def test_runtime_codec_validation():

@@ -89,16 +89,19 @@ def _serve(eng, prompts):
         return orig(slot, reason)
 
     eng._finish = spy
+    # 6 decode steps: enough to exercise append/attend/sample on every
+    # step (the 19-token prompts already span two 16-token pages after
+    # prefill; decode stays inside page 2 at any depth <= 12)
     reqs = (
-        [GenRequest(prompt_ids=ids, max_tokens=10, ignore_eos=True)
+        [GenRequest(prompt_ids=ids, max_tokens=6, ignore_eos=True)
          for ids in prompts[:2]]
-        + [GenRequest(prompt_ids=ids, max_tokens=10, temperature=0.8,
+        + [GenRequest(prompt_ids=ids, max_tokens=6, temperature=0.8,
                       top_k=40, seed=7, ignore_eos=True)
            for ids in prompts[2:]])
     for q in eng.submit_many(reqs):
         _, ev = _drain(q)
         assert ev.finish_reason == "length", ev.error
-        assert ev.completion_tokens == 10
+        assert ev.completion_tokens == 6
     return [gen[r.id] for r in reqs]
 
 

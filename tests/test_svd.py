@@ -48,11 +48,13 @@ def test_svd_frames_vary_in_time(pipe):
     """An image-to-VIDEO model must produce temporally-varying frames —
     not T copies of one still (the capability VERDICT r4 missing #2
     demanded over frame-chained img2img)."""
-    frames = pipe.generate(_cond_image(), num_frames=4, height=16,
-                           width=16, steps=3, seed=7)
+    # same (frames, hw, steps) signature as test_svd_generates_frames,
+    # so the two tests share one jit compile of the denoise loop
+    frames = pipe.generate(_cond_image(), num_frames=3, height=16,
+                           width=16, steps=2, seed=7)
     diffs = [float(np.mean((frames[i + 1].astype(np.float32)
                             - frames[i].astype(np.float32)) ** 2))
-             for i in range(3)]
+             for i in range(2)]
     assert max(diffs) > 0.5, diffs  # frames genuinely differ
 
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from collections import Counter
 from pathlib import Path
@@ -18,6 +19,22 @@ from pathlib import Path
 from . import (ALL_RULES, DEFAULT_BASELINE, REPO_ROOT, apply_baseline,
                load_baseline, load_context, rules_by_id, run_rules,
                save_baseline)
+from .core import callgraph_edges
+
+
+def _changed_files(root: Path) -> set[str]:
+    """Repo-relative paths with uncommitted changes (vs HEAD) plus
+    untracked files — the `--changed` filter set."""
+    paths: set[str] = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            out = subprocess.run(cmd, cwd=root, capture_output=True,
+                                 text=True, timeout=30, check=True).stdout
+        except (OSError, subprocess.SubprocessError):
+            continue
+        paths.update(p.strip() for p in out.splitlines() if p.strip())
+    return paths
 
 
 def main(argv=None) -> int:
@@ -34,12 +51,19 @@ def main(argv=None) -> int:
                     help="rewrite the baseline from current findings")
     ap.add_argument("--rules", type=str, default="",
                     help="comma-separated rule ids (default: all)")
+    ap.add_argument("--changed", action="store_true",
+                    help="report only findings in files with "
+                         "uncommitted changes (analysis still loads "
+                         "the whole package for cross-module context)")
     args = ap.parse_args(argv)
 
     rules = rules_by_id([r for r in args.rules.split(",") if r]) \
         if args.rules else list(ALL_RULES)
     ctx = load_context(args.root)
     findings = run_rules(ctx, rules)
+    if args.changed:
+        changed = _changed_files(args.root)
+        findings = [f for f in findings if f.path in changed]
 
     if args.update_baseline:
         entries = Counter(f.fingerprint for f in findings)
@@ -56,6 +80,7 @@ def main(argv=None) -> int:
         print(json.dumps({
             "rules": sorted(r.id for r in rules),
             "files_scanned": len(ctx.modules),
+            "callgraph_edges": callgraph_edges(ctx),
             "findings": [f.to_json() for f in res.new],
             "grandfathered": len(res.grandfathered),
             "stale_baseline": res.stale,

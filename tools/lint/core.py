@@ -152,6 +152,10 @@ class Module:
         self.tree = ast.parse(source)
         self.pragmas = parse_pragmas(source)
         self._scopes: list[tuple[int, int, str]] = []
+        # module-level call graph support: dotted qualname -> def node,
+        # plus the set of class qualnames (to resolve `self.X(...)`)
+        self.functions: dict[str, ast.AST] = {}
+        self.classes: set[str] = set()
         self._index_scopes(self.tree, "")
 
     def _index_scopes(self, node: ast.AST, prefix: str) -> None:
@@ -161,9 +165,45 @@ class Module:
                 name = f"{prefix}.{child.name}" if prefix else child.name
                 self._scopes.append(
                     (child.lineno, child.end_lineno or child.lineno, name))
+                if isinstance(child, ast.ClassDef):
+                    self.classes.add(name)
+                else:
+                    self.functions.setdefault(name, child)
                 self._index_scopes(child, name)
             else:
                 self._index_scopes(child, prefix)
+
+    def resolve_call(self, caller_scope: str,
+                     call: ast.Call) -> Optional[tuple[str, ast.AST]]:
+        """Resolve a call to a function defined in THIS module:
+        ``self.X(...)`` -> a method of the caller's enclosing class,
+        ``name(...)`` -> a sibling nested def, an enclosing scope's
+        def, or a module-level function. Anything else (other objects'
+        methods, imports, jitted closures reached through instance
+        attributes) is outside the module call graph."""
+        f = call.func
+        parts = caller_scope.split(".") if caller_scope else []
+        if isinstance(f, ast.Name):
+            for i in range(len(parts), -1, -1):
+                qual = ".".join(parts[:i] + [f.id])
+                fn = self.functions.get(qual)
+                if fn is not None:
+                    return qual, fn
+            return None
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id == "self"):
+            # the innermost enclosing class: `self` in a closure nested
+            # under a method still refers to that class's instance
+            for i in range(len(parts), 0, -1):
+                cls = ".".join(parts[:i])
+                if cls in self.classes:
+                    qual = f"{cls}.{f.attr}"
+                    fn = self.functions.get(qual)
+                    if fn is not None:
+                        return qual, fn
+                    return None
+            return None
+        return None
 
     def scope_at(self, line: int) -> str:
         best = ""
@@ -210,6 +250,24 @@ def load_context(root: Path = REPO_ROOT,
     readme = root / "README.md"
     text = readme.read_text(encoding="utf-8") if readme.exists() else ""
     return Context(root=root, modules=modules, readme_text=text)
+
+
+def callgraph_edges(ctx: Context) -> int:
+    """Resolved module-local call edges across the context — the size
+    of the graph the interprocedural rules walk (bench/--json metric)."""
+    from .rules.scalar_payload import walk_shallow
+
+    n = 0
+    for m in ctx.modules:
+        for qual, fn in m.functions.items():
+            seen: set[str] = set()
+            for node in walk_shallow(fn):
+                if isinstance(node, ast.Call):
+                    hit = m.resolve_call(qual, node)
+                    if hit is not None:
+                        seen.add(hit[0])
+            n += len(seen)
+    return n
 
 
 def run_rules(ctx: Context, rules) -> list[Finding]:

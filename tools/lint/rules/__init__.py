@@ -4,12 +4,29 @@ from __future__ import annotations
 
 from ..core import KNOWN_RULES
 from .donation import DonationAfterUse
+from .env_knobs import EnvKnobRegistry
 from .exception_hygiene import ExceptionHygiene
 from .hot_path_sync import HotPathSync
 from .lock_discipline import LockDiscipline
 from .metrics_contract import MetricsContract
 from .scalar_payload import ScalarPayload
+from .sharding_contract import ShardingContract
 from .span_balance import SpanBalance
+
+
+class LintPragma:
+    """Malformed / unreasoned lint pragmas. The findings themselves are
+    emitted by core.run_rules (pragma parsing is part of loading a
+    module); this rule object gives the id a row in the registry,
+    ``--rules`` selection and the README table."""
+
+    id = "lint-pragma"
+    doc = ("malformed lint pragma: unknown rule id, missing ignore "
+           "reason, unbalanced region (always on)")
+
+    def check(self, ctx):
+        return iter(())
+
 
 ALL_RULES = (
     HotPathSync(),
@@ -19,6 +36,9 @@ ALL_RULES = (
     ExceptionHygiene(),
     MetricsContract(),
     SpanBalance(),
+    ShardingContract(),
+    EnvKnobRegistry(),
+    LintPragma(),
 )
 
 for _r in ALL_RULES:

@@ -1,0 +1,170 @@
+"""env-knob-registry: every LOCALAI_* knob reads through config/knobs.py.
+
+~45 ``LOCALAI_*`` environment knobs steer the engine. Before the
+registry each call site hand-rolled its own default and truthiness
+parsing (``not in ("0", "off", "false")`` vs ``in ("1", "true")`` —
+subtly different at every site), and a typo'd knob name read its
+default forever with no error anywhere. The registry
+(``localai_tfp_tpu/config/knobs.py``) makes each knob a declared
+(name, default, parser, doc) row; this rule enforces that it stays the
+single point of truth:
+
+- raw ``os.environ["LOCALAI_..."]`` / ``os.environ.get`` /
+  ``os.getenv`` access outside ``config/`` is a finding (migrate to a
+  ``knobs.flag/int_/float_/str_/raw/present`` accessor);
+- an f-string/computed ``LOCALAI_`` env key outside ``config/`` is a
+  finding (unauditable: the registry cannot cross-check it);
+- a knobs accessor naming an UNREGISTERED knob (or a non-literal name)
+  is a finding — the typo now fails the lint gate;
+- every registered knob needs a `` `LOCALAI_X` `` row in the README
+  "Configuration knobs" table (metrics-contract style).
+
+``config/`` is exempt: the registry lives there, and
+``app_config.py`` maps computed CLI-flag names onto ``LOCALAI_<FLAG>``
+aliases generically (a deliberate, documented carve-out). Repo-wide
+checks (README coverage) only run when the real registry module is in
+the context, so fixture runs stay hermetic.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import Context, Finding, Module
+
+KNOBS_MODULE = "localai_tfp_tpu/config/knobs.py"
+_EXEMPT_PREFIX = "localai_tfp_tpu/config/"
+_ACCESSORS = {"flag", "int_", "float_", "str_", "raw", "present"}
+_ENV_FUNCS = {"get", "getenv", "setdefault", "pop"}
+
+
+def registered_knobs(ctx: Context) -> Optional[set[str]]:
+    """Knob names parsed from the registry module's AST (`_knob("X",
+    ...)` calls) — the linter never imports package code."""
+    mods = [m for m in ctx.modules if m.rel == KNOBS_MODULE]
+    mods += [m for m in ctx.modules if m.rel != KNOBS_MODULE]
+    for m in mods:
+        names: set[str] = set()
+        for node in ast.walk(m.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "_knob"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                names.add(node.args[0].value)
+        if names:
+            return names
+    return None
+
+
+def _is_environ(node: ast.AST) -> bool:
+    """`os.environ` / `environ` / `_os.environ`."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return True
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def _knob_literal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value.startswith("LOCALAI_"):
+        return node.value
+    return None
+
+
+def _computed_knob(node: ast.AST) -> bool:
+    """An f-string env key starting with LOCALAI_ (computed name)."""
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        return (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+                and first.value.startswith("LOCALAI_"))
+    return False
+
+
+class EnvKnobRegistry:
+    id = "env-knob-registry"
+    doc = ("raw os.environ access to LOCALAI_* knobs outside "
+           "config/knobs.py, unregistered knob names, missing README "
+           "knob-table rows")
+
+    def check(self, ctx: Context) -> Iterator[Finding]:
+        registry = registered_knobs(ctx)
+        for m in ctx.modules:
+            if m.rel.startswith(_EXEMPT_PREFIX):
+                continue
+            yield from self._check_module(m, registry)
+        # repo-wide checks need the real registry in context
+        if ctx.module(KNOBS_MODULE) is not None and registry:
+            yield from self._check_readme(ctx, registry)
+
+    def _check_module(self, m: Module,
+                      registry: Optional[set[str]]) -> Iterator[Finding]:
+        for node in ast.walk(m.tree):
+            # os.environ["LOCALAI_X"] / del os.environ[...]
+            if isinstance(node, ast.Subscript) and \
+                    _is_environ(node.value):
+                key = node.slice
+                name = _knob_literal(key)
+                if name is not None or _computed_knob(key):
+                    shown = name or "LOCALAI_<computed>"
+                    yield m.finding(
+                        self.id, node,
+                        f"raw os.environ[{shown!r}] — read knobs "
+                        "through config/knobs.py accessors (flag/int_/"
+                        "float_/str_/raw/present)")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            # os.environ.get(...) / os.getenv(...)
+            if isinstance(f, ast.Attribute) and f.attr in _ENV_FUNCS \
+                    and node.args:
+                is_env_call = _is_environ(f.value) or (
+                    f.attr == "getenv"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in ("os", "_os"))
+                if is_env_call:
+                    name = _knob_literal(node.args[0])
+                    if name is not None:
+                        yield m.finding(
+                            self.id, node,
+                            f"raw os.environ access to {name!r} — "
+                            "read it through config/knobs.py (the "
+                            "registry owns the default and parser)")
+                    elif _computed_knob(node.args[0]):
+                        yield m.finding(
+                            self.id, node,
+                            "computed LOCALAI_* env key — the knob "
+                            "registry cannot audit an f-string name; "
+                            "register each knob in config/knobs.py")
+                continue
+            # knobs.flag("LOCALAI_X") — accessor name validation
+            if isinstance(f, ast.Attribute) and f.attr in _ACCESSORS \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == "knobs" and node.args:
+                name = _knob_literal(node.args[0])
+                if name is None:
+                    yield m.finding(
+                        self.id, node,
+                        f"knobs.{f.attr}() with a non-literal or "
+                        "non-LOCALAI_ name — knob reads must name a "
+                        "registered LOCALAI_* literal")
+                elif registry is not None and name not in registry:
+                    yield m.finding(
+                        self.id, node,
+                        f"knobs.{f.attr}({name!r}) names an "
+                        "UNREGISTERED knob — declare it in "
+                        "config/knobs.py (name, default, parser, doc)")
+
+    def _check_readme(self, ctx: Context,
+                      registry: set[str]) -> Iterator[Finding]:
+        m = ctx.module(KNOBS_MODULE)
+        for name in sorted(registry):
+            if f"`{name}`" not in ctx.readme_text:
+                yield m.finding(
+                    self.id, 1,
+                    f"knob {name} has no row in the README "
+                    "\"Configuration knobs\" table — every registered "
+                    "knob ships documented")

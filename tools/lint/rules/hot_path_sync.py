@@ -8,7 +8,13 @@ a bug class that profiles as "mysteriously slow", never as an error.
 
 Scope: code inside ``# lint: region hot_path`` .. ``# lint: endregion
 hot_path`` spans (the scheduler loop and dispatch/harvest paths in
-``engine/engine.py``). Inside a region the rule flags:
+``engine/engine.py``), plus — interprocedurally — every module-local
+helper those spans call: a region call site that resolves to a method
+of the same class or a module-level function pulls the callee's body
+into the hot path (to a bounded depth), so a ``.item()`` buried two
+helpers below the region fires, with the call chain in the finding.
+
+Inside hot-path code the rule flags:
 
 - ``.item()``, ``block_until_ready``, ``jax.device_get`` — always;
 - ``np.asarray`` / ``np.array`` / ``np.frombuffer``, ``int()`` /
@@ -19,25 +25,31 @@ Taint is a per-function forward pass: results of ``self._run`` /
 ``self._dev_exec``, the engine's device state attributes
 (``self.cache``, ``self.sampling``, ...) and flight ``.arrays`` are
 device values; names assigned from tainted expressions inherit the
-taint. Shape/dtype metadata access (``.shape``, ``.dtype``, ...) and a
-flagged conversion's own result (it IS the host copy) drop it.
+taint. Interprocedural calls seed the callee's parameters with the
+caller's argument taint, and a callee whose return value is tainted
+taints the call expression back at the caller. Shape/dtype metadata
+access (``.shape``, ``.dtype``, ...) and a flagged conversion's own
+result (it IS the host copy) drop it.
 
 Intentionally-blocking paths (``_decode1_step``'s per-token grammar
 harvest, flight completion after ``ready()``) carry reasoned
-``# lint: ignore[hot-path-sync]`` suppressions — the point is that a
-sync in the hot path needs a written justification, not that one can
-never exist.
+``# lint: ignore[hot-path-sync]`` suppressions at the sync line — a
+suppression in a helper keeps covering it no matter which region
+reaches it.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterator
+from typing import Iterator, Optional
 
 from ..core import Context, Finding, Module
 from .scalar_payload import walk_shallow
 
 REGION = "hot_path"
+
+# how many helper hops below a region a sync can hide and still fire
+MAX_DEPTH = 4
 
 # engine attributes that hold live device arrays
 DEVICE_ATTRS = {
@@ -65,6 +77,9 @@ class _FnTaint:
 
     def __init__(self) -> None:
         self.names: set[str] = set()
+        # optional interprocedural hook: Call -> tainted / clean / None
+        # (None = unresolvable, fall back to the argument heuristic)
+        self.call_taint = None
 
     def expr(self, node: ast.AST) -> bool:
         if isinstance(node, ast.Name):
@@ -91,6 +106,10 @@ class _FnTaint:
                 return True
             if self._is_flagged_conversion(f):
                 return False  # the conversion result IS the host copy
+            if self.call_taint is not None:
+                known = self.call_taint(node, self)
+                if known is not None:
+                    return known
             parts = list(node.args) + [kw.value for kw in node.keywords]
             if isinstance(f, ast.Attribute):
                 parts.append(f.value)
@@ -149,13 +168,19 @@ class _FnTaint:
 
 class HotPathSync:
     id = "hot-path-sync"
-    doc = ("device sync (.item()/np.asarray/block_until_ready/...) "
-           "inside a '# lint: region hot_path' region")
+    doc = ("device sync (.item()/np.asarray/block_until_ready/...) on "
+           "a hot path: inside a '# lint: region hot_path' region or "
+           "any module-local helper it calls")
 
     def check(self, ctx: Context) -> Iterator[Finding]:
         for m in ctx.modules:
             if REGION not in m.pragmas.regions:
                 continue
+            # per-module interprocedural state: return-taint memo and
+            # the set of (callee, seed) bodies already reported (a
+            # helper reached from several regions reports once)
+            self._ret_memo: dict[tuple, Optional[bool]] = {}
+            self._reported: set[tuple] = set()
             for fn in ast.walk(m.tree):
                 if not isinstance(fn, (ast.FunctionDef,
                                        ast.AsyncFunctionDef)):
@@ -164,10 +189,29 @@ class HotPathSync:
                 end = fn.end_lineno or fn.lineno
                 if any(a <= end and b >= fn.lineno
                        for a, b in m.pragmas.regions[REGION]):
-                    yield from self._check_fn(m, fn)
+                    qual = m.scope_at(fn.lineno)
+                    _, findings = self._scan_fn(
+                        m, fn, qual, seed=frozenset(),
+                        chain=(fn.name,), depth=0,
+                        region_gated=True, emit=True)
+                    yield from findings
 
-    def _check_fn(self, m: Module, fn) -> Iterator[Finding]:
+    # ------------------------------------------------------- traversal
+
+    def _scan_fn(self, m: Module, fn, qual: str, seed: frozenset,
+                 chain: tuple, depth: int, region_gated: bool,
+                 emit: bool) -> tuple[bool, list[Finding]]:
+        """Ordered taint walk of one function. Returns (return value is
+        tainted, findings). ``region_gated`` limits checking/descent to
+        region lines (the root functions); callee bodies are hot
+        throughout. ``seed`` holds parameter names tainted by the call
+        site's arguments."""
+        findings: list[Finding] = []
         taint = _FnTaint()
+        taint.names |= seed
+        taint.call_taint = (
+            lambda call, t: self._ret_taint(m, qual, call, t, depth))
+        ret_tainted = False
         # statement-ordered shallow traversal: check calls with the
         # taint state BEFORE their enclosing assignment binds (in
         # `D = np.asarray(D)` the call must see the old, tainted D), so
@@ -182,7 +226,17 @@ class HotPathSync:
             while pending and pos > pending[0][0]:
                 taint.assign(pending.pop(0)[1])
             if isinstance(node, ast.Call):
-                yield from self._check_call(m, taint, node)
+                hot = (not region_gated
+                       or m.pragmas.in_region(REGION, node.lineno))
+                if hot:
+                    findings.extend(
+                        self._check_call(m, taint, node, chain, emit))
+                    findings.extend(
+                        self._descend(m, qual, taint, node, chain,
+                                      depth, emit))
+            if isinstance(node, ast.Return) and node.value is not None:
+                if taint.expr(node.value):
+                    ret_tainted = True
             if isinstance(node, (ast.Assign, ast.AnnAssign,
                                  ast.AugAssign)):
                 end = (node.end_lineno or node.lineno,
@@ -191,24 +245,94 @@ class HotPathSync:
                 pending.sort(key=lambda e: e[0])
             else:
                 taint.assign(node)  # loop/with targets bind up front
+        return ret_tainted, findings
 
-    def _check_call(self, m: Module, taint: _FnTaint,
-                    call: ast.Call) -> Iterator[Finding]:
-        if not m.pragmas.in_region(REGION, call.lineno):
+    def _seed_params(self, fn, call: ast.Call,
+                     taint: _FnTaint) -> frozenset:
+        """Callee parameter names bound to tainted caller arguments."""
+        params = [a.arg for a in fn.args.args]
+        if params and params[0] == "self":
+            params = params[1:]
+        seeded = set()
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break
+            if i < len(params) and taint.expr(arg):
+                seeded.add(params[i])
+        for kw in call.keywords:
+            if kw.arg is not None and taint.expr(kw.value):
+                seeded.add(kw.arg)
+        return frozenset(seeded)
+
+    def _descend(self, m: Module, caller_qual: str, taint: _FnTaint,
+                 call: ast.Call, chain: tuple, depth: int,
+                 emit: bool) -> list[Finding]:
+        """A hot call site resolving to a module-local function pulls
+        the callee body into the hot path."""
+        if depth >= MAX_DEPTH:
+            return []
+        hit = m.resolve_call(caller_qual, call)
+        if hit is None:
+            return []
+        callee_qual, fn = hit
+        leaf = callee_qual.rsplit(".", 1)[-1]
+        if leaf in chain:  # recursion guard
+            return []
+        seed = self._seed_params(fn, call, taint)
+        key = (callee_qual, seed)
+        do_emit = emit and key not in self._reported
+        if do_emit:
+            self._reported.add(key)
+        elif (callee_qual, seed) in self._ret_memo:
+            return []  # fully analyzed already, nothing new to report
+        _, findings = self._scan_fn(
+            m, fn, callee_qual, seed, chain + (leaf,), depth + 1,
+            region_gated=False, emit=do_emit)
+        return findings
+
+    def _ret_taint(self, m: Module, caller_qual: str, call: ast.Call,
+                   taint: _FnTaint, depth: int) -> Optional[bool]:
+        """Interprocedural return-value taint for the _FnTaint hook
+        (no finding emission — emission is _descend's job)."""
+        if depth >= MAX_DEPTH:
+            return None
+        hit = m.resolve_call(caller_qual, call)
+        if hit is None:
+            return None
+        callee_qual, fn = hit
+        seed = self._seed_params(fn, call, taint)
+        key = (callee_qual, seed)
+        if key in self._ret_memo:
+            memo = self._ret_memo[key]
+            return False if memo is None else memo  # None: in progress
+        self._ret_memo[key] = None
+        ret, _ = self._scan_fn(m, fn, callee_qual, seed,
+                               (callee_qual.rsplit(".", 1)[-1],),
+                               depth + 1, region_gated=False, emit=False)
+        self._ret_memo[key] = ret
+        return ret
+
+    # --------------------------------------------------------- checks
+
+    def _check_call(self, m: Module, taint: _FnTaint, call: ast.Call,
+                    chain: tuple, emit: bool) -> Iterator[Finding]:
+        if not emit:
             return
+        via = ("" if len(chain) <= 1
+               else " (hot path via " + " -> ".join(chain) + ")")
         f = call.func
         if isinstance(f, ast.Attribute):
             if f.attr in _ALWAYS_FLAG_ATTRS:
                 yield m.finding(
                     self.id, call,
                     f"'.{f.attr}()' forces a device sync in the hot "
-                    "path — harvest via flight readiness instead")
+                    "path — harvest via flight readiness instead" + via)
                 return
             if f.attr in _TAINT_FLAG_ATTRS and taint.expr(f.value):
                 yield m.finding(
                     self.id, call,
                     f"'.{f.attr}()' on a device value blocks the "
-                    "scheduler on device completion")
+                    "scheduler on device completion" + via)
                 return
             if (f.attr in _NP_CONVERTERS
                     and isinstance(f.value, ast.Name)
@@ -217,11 +341,11 @@ class HotPathSync:
                 yield m.finding(
                     self.id, call,
                     f"np.{f.attr}() on a device value is a blocking "
-                    "device->host transfer in the hot path")
+                    "device->host transfer in the hot path" + via)
                 return
         if (isinstance(f, ast.Name) and f.id in _CONVERTERS
                 and any(taint.expr(a) for a in call.args)):
             yield m.finding(
                 self.id, call,
                 f"{f.id}() coerces a device value on the host — an "
-                "implicit device sync in the hot path")
+                "implicit device sync in the hot path" + via)

@@ -1,0 +1,552 @@
+"""graftsan: a lockdep-style runtime sanitizer for the engine.
+
+graftlint proves lexical contracts; graftsan proves the DYNAMIC ones
+the linter cannot see. Armed (``LOCALAI_SAN=1`` or ``arm()``), it:
+
+1. **Lock-order graph.** Wraps ``threading.Lock`` / ``RLock`` /
+   ``Condition`` factories so every lock created from package code
+   carries its creation site (``file:line``). Each acquire records
+   "held-site -> acquired-site" edges in a global graph; the first
+   edge that closes a cycle produces a report carrying BOTH stacks —
+   where the held lock was acquired and where the inverting acquire
+   happened — exactly the information a post-mortem of a real deadlock
+   never has. Like kernel lockdep, a cycle is reported even if the
+   interleaving that deadlocks never ran.
+
+2. **Dynamic guarded-by.** The ``# lint: guarded-by <lock>`` pragmas
+   (parsed from source by graftlint's loader — the sanitizer never
+   trusts runtime state for the contract) become checked at every
+   attribute REBIND: patched ``__setattr__`` on annotated classes
+   verifies the named lock is held by the current thread. Object
+   construction is exempt (any ``__init__`` of the object on the
+   stack), matching the static rule. Container method mutations
+   (``.append``/``.update``) stay the static rule's territory — the
+   dynamic check covers the rebind/augassign class the linter cannot
+   follow through helper calls.
+
+Disarmed cost: patched ``__setattr__`` reads ONE attribute
+(``_STATE.armed``) before delegating; lock factories are fully
+restored, so locks created while disarmed are raw stdlib objects.
+
+API: ``arm(include=None)`` / ``disarm()`` / ``reports()`` /
+``reset()`` / ``stats()``. ``include`` is a predicate over the
+creating frame's filename (default: package files only); tests pass
+``lambda f: True`` to sanitize fixture locks.
+
+Pure stdlib. Lives in tools/ (dev tooling), imported by
+``localai_tfp_tpu.utils.san`` behind the ``LOCALAI_SAN`` knob.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import sys
+import threading
+import traceback
+from pathlib import Path
+from typing import Callable, Optional
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_PKG = "localai_tfp_tpu"
+_STACK_LIMIT = 8
+
+
+def _default_include(filename: str) -> bool:
+    return _PKG in filename
+
+
+class _State:
+    def __init__(self) -> None:
+        self.armed = False
+        self.include: Callable[[str], bool] = _default_include
+        # graph: creation-site -> set of sites acquired WHILE holding it
+        self.edges: dict[str, set[str]] = {}
+        self.edge_stacks: dict[tuple[str, str], tuple[str, str]] = {}
+        self.sites: set[str] = set()
+        self.reports: list[dict] = []
+        self.guarded: dict = {}          # (modname, clsqual) -> {attr: lock}
+        self.patched: list[tuple] = []   # (cls, orig __setattr__)
+        self.orig_factories: Optional[tuple] = None
+        self.finder = None
+        self.cycles = 0
+        self.guarded_checks = 0
+        self.violations = 0
+        self.lock = threading.Lock()     # leaf lock guarding all of the above
+        self.tls = threading.local()
+
+
+_STATE = _State()
+
+
+def _held() -> list:
+    """Current thread's held-lock stack: (site, lock id, acquire stack)."""
+    st = getattr(_STATE.tls, "held", None)
+    if st is None:
+        st = _STATE.tls.held = []
+    return st
+
+
+def _capture_stack(skip: int):
+    """Cheap stack capture for the common (no-report) path: source
+    lines are NOT resolved here — only when a report formats it."""
+    return traceback.StackSummary.extract(
+        traceback.walk_stack(sys._getframe(skip)),
+        limit=_STACK_LIMIT, lookup_lines=False)
+
+
+def _fmt_stack(summary) -> str:
+    if not summary:
+        return ""
+    return "".join(summary.format())
+
+
+# --------------------------------------------------------- lock wrapper
+
+def _has_path(src: str, dst: str) -> bool:
+    """DFS: does a held-after path src ->* dst exist in the edge graph?"""
+    seen = {src}
+    stack = [src]
+    while stack:
+        cur = stack.pop()
+        if cur == dst:
+            return True
+        for nxt in _STATE.edges.get(cur, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append(nxt)
+    return False
+
+
+class _SanLock:
+    """Proxy around a stdlib lock that feeds the lock-order graph and
+    the per-thread held stack. Exposes ``_release_save`` /
+    ``_acquire_restore`` / ``_is_owned`` so ``threading.Condition``
+    built on it keeps the held stack consistent across ``wait()``."""
+
+    def __init__(self, inner, site: str) -> None:
+        self._inner = inner
+        self._site = site
+        self.last_acquire_stack = None  # StackSummary
+
+    # -- acquire / release -------------------------------------------
+    def acquire(self, *args, **kwargs):
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            self._note_acquire()
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._note_release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        locked = getattr(self._inner, "locked", None)
+        if locked is not None:
+            return locked()
+        return self._held_count() > 0
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<_SanLock site={self._site} inner={self._inner!r}>"
+
+    # -- Condition protocol ------------------------------------------
+    def _release_save(self):
+        save = getattr(self._inner, "_release_save", None)
+        if save is not None:
+            state = save()
+        else:
+            self._inner.release()
+            state = None
+        count = self._pop_all()
+        return (state, count)
+
+    def _acquire_restore(self, saved) -> None:
+        state, count = saved
+        restore = getattr(self._inner, "_acquire_restore", None)
+        if restore is not None:
+            restore(state)
+        else:
+            self._inner.acquire()
+        for _ in range(max(1, count)):
+            self._note_acquire()
+
+    def _is_owned(self) -> bool:
+        owned = getattr(self._inner, "_is_owned", None)
+        if owned is not None:
+            return owned()
+        return self._held_count() > 0
+
+    # -- graph bookkeeping -------------------------------------------
+    def _held_count(self) -> int:
+        me = id(self)
+        return sum(1 for _, lid, _ in _held() if lid == me)
+
+    def _pop_all(self) -> int:
+        me = id(self)
+        held = _held()
+        n = len(held)
+        held[:] = [e for e in held if e[1] != me]
+        return n - len(held)
+
+    def _note_acquire(self) -> None:
+        held = _held()
+        if not _STATE.armed:
+            held.append((self._site, id(self), None))
+            return
+        acq_stack = _capture_stack(3)
+        self.last_acquire_stack = acq_stack
+        me = id(self)
+        with _STATE.lock:
+            _STATE.sites.add(self._site)
+            for hsite, hid, hstack in held:
+                if hid == me:
+                    continue  # re-entrant acquire: no self edge
+                if hsite == self._site:
+                    # two locks born at the same site (one constructor
+                    # line -> every instance) nest under per-instance
+                    # discipline the site graph cannot order; kernel
+                    # lockdep needs explicit nesting annotations here
+                    # too, so same-site edges are not recorded
+                    continue
+                dests = _STATE.edges.setdefault(hsite, set())
+                if self._site in dests:
+                    continue  # known-good (or already-reported) edge
+                # adding hsite -> site closes a cycle iff a path
+                # site ->* hsite already exists
+                if _has_path(self._site, hsite):
+                    _STATE.cycles += 1
+                    # the opposing direction was recorded when some
+                    # earlier thread acquired these sites in the other
+                    # order: surface ITS two stacks alongside ours
+                    prior = _STATE.edge_stacks.get(
+                        (self._site, hsite), (None, None))
+                    _STATE.reports.append({
+                        "kind": "lock-order-cycle",
+                        "edge": (hsite, self._site),
+                        "held_site": hsite,
+                        "acquired_site": self._site,
+                        "held_stack": _fmt_stack(hstack),
+                        "acquire_stack": _fmt_stack(acq_stack),
+                        "prior_held_stack": _fmt_stack(prior[0]),
+                        "prior_acquire_stack": _fmt_stack(prior[1]),
+                        "thread": threading.current_thread().name,
+                    })
+                dests.add(self._site)
+                _STATE.edge_stacks[(hsite, self._site)] = (
+                    hstack, acq_stack)
+        held.append((self._site, me, acq_stack))
+
+    def _note_release(self) -> None:
+        me = id(self)
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][1] == me:
+                del held[i]
+                return
+
+
+def _creation_site(depth: int) -> Optional[str]:
+    """file:line of the frame creating a lock, if include() admits it."""
+    frame = sys._getframe(depth)
+    fname = frame.f_code.co_filename
+    if not _STATE.armed or not _STATE.include(fname):
+        return None
+    return f"{fname}:{frame.f_lineno}"
+
+
+# ------------------------------------------------------ guarded-by map
+
+def _build_guarded_map() -> dict:
+    """(module dotted name, class qualname) -> {attr: lock attr}, parsed
+    from the package SOURCES via graftlint's loader (the contract is
+    the pragma text, never runtime state). Only ``self.<attr>`` lock
+    expressions are dynamically checkable."""
+    from .core import load_context
+
+    gmap: dict = {}
+    ctx = load_context(_REPO_ROOT)
+    for m in ctx.modules:
+        if not m.pragmas.guarded:
+            continue
+        modname = m.rel[:-3].replace("/", ".")
+        parents: dict = {}
+        for node in ast.walk(m.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for line, lock in m.pragmas.guarded:
+            if not lock.startswith("self."):
+                continue
+            lock_attr = lock.split("self.", 1)[1].strip()
+            if not lock_attr.isidentifier():
+                continue
+            hit = None
+            for node in ast.walk(m.tree):
+                if (isinstance(node, (ast.Assign, ast.AnnAssign))
+                        and node.lineno <= line + 1
+                        and (node.end_lineno or node.lineno) >= line):
+                    targets = (node.targets if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        while isinstance(t, (ast.Subscript, ast.Slice)):
+                            t = t.value
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            hit = (node, t.attr)
+                            break
+                if hit:
+                    break
+            if hit is None:
+                continue
+            cls_parts = []
+            cur = parents.get(hit[0])
+            while cur is not None:
+                if isinstance(cur, ast.ClassDef):
+                    cls_parts.append(cur.name)
+                cur = parents.get(cur)
+            if not cls_parts:
+                continue
+            clsqual = ".".join(reversed(cls_parts))
+            gmap.setdefault((modname, clsqual), {})[hit[1]] = lock_attr
+    return gmap
+
+
+def _lock_held_by_current_thread(lock) -> bool:
+    if isinstance(lock, _SanLock):
+        return lock._held_count() > 0
+    # threading.Condition: recurse into its underlying lock when we can
+    # see it precisely; its own _is_owned is a coarse anyone-holds probe
+    inner = getattr(lock, "_lock", None)
+    if inner is not None and hasattr(lock, "notify_all"):
+        return _lock_held_by_current_thread(inner)
+    owned = getattr(lock, "_is_owned", None)
+    if owned is not None:
+        try:
+            return bool(owned())
+        except Exception:
+            return True
+    locked = getattr(lock, "locked", None)
+    if locked is not None:
+        return bool(locked())
+    return True  # unknown lock object: never flag
+
+
+def _check_guarded(obj, attr: str, lock_attr: str) -> None:
+    # construction is single-threaded: any __init__ OF THIS OBJECT on
+    # the stack exempts the write (matches the static rule, plus the
+    # helpers __init__ delegates to)
+    frame = sys._getframe(2)
+    depth = 0
+    while frame is not None and depth < _STACK_LIMIT:
+        if (frame.f_code.co_name == "__init__"
+                and frame.f_locals.get("self") is obj):
+            return
+        frame = frame.f_back
+        depth += 1
+    try:
+        lock = getattr(obj, lock_attr)
+    except AttributeError:
+        return
+    if lock is None:
+        return
+    with _STATE.lock:
+        _STATE.guarded_checks += 1
+    if _lock_held_by_current_thread(lock):
+        return
+    holder = getattr(lock, "last_acquire_stack", None)
+    with _STATE.lock:
+        _STATE.violations += 1
+        _STATE.reports.append({
+            "kind": "guarded-by",
+            "class": type(obj).__name__,
+            "attr": attr,
+            "lock": f"self.{lock_attr}",
+            "thread": threading.current_thread().name,
+            "mutation_stack": _fmt_stack(_capture_stack(3)),
+            "holder_stack": _fmt_stack(holder),
+        })
+
+
+def _patch_class(cls, attrs: dict) -> None:
+    existing = getattr(cls, "_graftsan_guarded", None)
+    if existing is not None and "_graftsan_guarded" in cls.__dict__:
+        existing.update(attrs)
+        return
+    guarded = dict(attrs)
+    orig = cls.__setattr__
+
+    def __setattr__(self, name, value, _orig=orig, _g=guarded):
+        if _STATE.armed:  # disarmed cost: this one attribute read
+            lock_attr = _g.get(name)
+            if lock_attr is not None:
+                _check_guarded(self, name, lock_attr)
+        _orig(self, name, value)
+
+    cls.__setattr__ = __setattr__
+    cls._graftsan_guarded = guarded
+    with _STATE.lock:
+        _STATE.patched.append((cls, orig))
+
+
+def _patch_module(module) -> None:
+    modname = getattr(module, "__name__", "")
+    for (mod, clsqual), attrs in _STATE.guarded.items():
+        if mod != modname:
+            continue
+        obj = module
+        for part in clsqual.split("."):
+            obj = getattr(obj, part, None)
+            if obj is None:
+                break
+        if isinstance(obj, type):
+            _patch_class(obj, attrs)
+
+
+# --------------------------------------------------------- import hook
+
+class _LoaderProxy:
+    def __init__(self, inner) -> None:
+        self._inner = inner
+
+    def create_module(self, spec):
+        return self._inner.create_module(spec)
+
+    def exec_module(self, module) -> None:
+        self._inner.exec_module(module)
+        if _STATE.armed:
+            _patch_module(module)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class _SanFinder:
+    """meta_path hook: package modules imported AFTER arm() still get
+    their guarded classes patched."""
+
+    def __init__(self) -> None:
+        self._busy: set[str] = set()
+
+    def find_spec(self, name, path=None, target=None):
+        if not _STATE.armed:
+            return None
+        if name != _PKG and not name.startswith(_PKG + "."):
+            return None
+        if name in self._busy:
+            return None
+        self._busy.add(name)
+        try:
+            spec = importlib.util.find_spec(name)
+        finally:
+            self._busy.discard(name)
+        if spec is None or spec.loader is None:
+            return None
+        spec.loader = _LoaderProxy(spec.loader)
+        return spec
+
+
+# ----------------------------------------------------------- factories
+
+def _lock_factory():
+    site = _creation_site(2)
+    inner = _STATE.orig_factories[0]()
+    if site is None:
+        return inner
+    return _SanLock(inner, site)
+
+
+def _rlock_factory():
+    site = _creation_site(2)
+    inner = _STATE.orig_factories[1]()
+    if site is None:
+        return inner
+    return _SanLock(inner, site)
+
+
+def _condition_factory(lock=None):
+    orig_condition = _STATE.orig_factories[2]
+    if lock is None:
+        site = _creation_site(2)
+        if site is not None:
+            lock = _SanLock(_STATE.orig_factories[1](), site)
+    return orig_condition(lock)
+
+
+# --------------------------------------------------------- control API
+
+def arm(include: Optional[Callable[[str], bool]] = None) -> None:
+    """Patch lock factories, patch guarded classes, install the import
+    hook, start recording. Idempotent (re-arm updates ``include``)."""
+    _STATE.include = include or _default_include
+    if _STATE.armed:
+        return
+    if not _STATE.guarded:
+        _STATE.guarded = _build_guarded_map()
+    if _STATE.orig_factories is None:
+        _STATE.orig_factories = (threading.Lock, threading.RLock,
+                                 threading.Condition)
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    threading.Condition = _condition_factory
+    _STATE.armed = True
+    for module in list(sys.modules.values()):
+        name = getattr(module, "__name__", "") or ""
+        if name == _PKG or name.startswith(_PKG + "."):
+            _patch_module(module)
+    if _STATE.finder is None:
+        _STATE.finder = _SanFinder()
+    if _STATE.finder not in sys.meta_path:
+        sys.meta_path.insert(0, _STATE.finder)
+
+
+def disarm() -> None:
+    """Restore factories and stop recording. Patched ``__setattr__``
+    stays installed (its disarmed cost is one attribute read) because
+    instances created while armed may outlive the arming window.
+    Reports survive until ``reset()``."""
+    if not _STATE.armed:
+        return
+    _STATE.armed = False
+    if _STATE.orig_factories is not None:
+        (threading.Lock, threading.RLock,
+         threading.Condition) = _STATE.orig_factories
+    if _STATE.finder is not None and _STATE.finder in sys.meta_path:
+        sys.meta_path.remove(_STATE.finder)
+
+
+def reports() -> list[dict]:
+    with _STATE.lock:
+        return list(_STATE.reports)
+
+
+def reset() -> None:
+    """Clear the graph, the reports and the counters (keeps the guarded
+    map and any class patches — they are contract, not state)."""
+    with _STATE.lock:
+        _STATE.edges.clear()
+        _STATE.edge_stacks.clear()
+        _STATE.sites.clear()
+        _STATE.reports.clear()
+        _STATE.cycles = 0
+        _STATE.guarded_checks = 0
+        _STATE.violations = 0
+
+
+def stats() -> dict:
+    with _STATE.lock:
+        return {
+            "armed": _STATE.armed,
+            "sites": len(_STATE.sites),
+            "edges": sum(len(v) for v in _STATE.edges.values()),
+            "cycles": _STATE.cycles,
+            "guarded_checks": _STATE.guarded_checks,
+            "violations": _STATE.violations,
+            "guarded_classes": len(_STATE.guarded),
+        }
