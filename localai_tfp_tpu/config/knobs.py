@@ -136,6 +136,24 @@ _knob("LOCALAI_TIMELINE", "on", "flag",
       "Flight-recorder timeline event capture.")
 _knob("LOCALAI_TIMELINE_EVENTS", "8192", "int",
       "Flight-recorder ring capacity in events (min 64).")
+_knob("LOCALAI_COSTMODEL", "on", "flag",
+      "Warmup-captured XLA cost model: per-dispatch FLOPs/bytes "
+      "accounting and the MFU gauge (telemetry/costmodel.py).")
+_knob("LOCALAI_HBM_LEDGER", "on", "flag",
+      "Component-level HBM byte ledger with memory_stats "
+      "reconciliation and OOM post-mortems (telemetry/hbm_ledger.py).")
+_knob("LOCALAI_PROFILER", "off", "flag",
+      "Enable the on-demand GET /debug/profile jax.profiler capture "
+      "endpoint.")
+_knob("LOCALAI_PROFILER_MAX_S", "30", "float",
+      "Upper bound on a single /debug/profile capture duration, in "
+      "seconds.")
+_knob("LOCALAI_PEAK_FLOPS", "0", "float",
+      "Per-device peak FLOP/s for MFU/roofline accounting (0 = "
+      "built-in per-platform table).")
+_knob("LOCALAI_PEAK_HBM_GBS", "0", "float",
+      "Per-device peak memory bandwidth in GB/s for roofline "
+      "classification (0 = built-in per-platform table).")
 
 # ------------------------------------------------------- multihost/fleet
 _knob("LOCALAI_COORDINATOR", "", "str",

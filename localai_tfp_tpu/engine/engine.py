@@ -73,6 +73,7 @@ from ..models.transformer import (
 from ..ops.sampling import (
     SamplingState, observe_tokens, sample, seed_windows,
 )
+from ..telemetry import costmodel, hbm_ledger
 from ..telemetry import metrics as tm
 from ..telemetry.flightrec import FLIGHT
 from ..telemetry.tracing import TRACER, fault_scope
@@ -446,6 +447,8 @@ class LLMEngine:
         # device ops arrive via _dev_exec from the follower loop
         tag: str = "",  # model tag routing this engine's records when
         # several models publish on one channel
+        state_dir: Optional[str] = None,  # where OOM post-mortems and
+        # profiler captures land (None: $STATE_DIR, else ./run)
     ) -> None:
         self.channel = channel
         self.follower = follower
@@ -787,6 +790,43 @@ class LLMEngine:
         # because an identical variant set is already in the persistent
         # compile cache (see warmup docstring); surfaced in the load
         # phase breakdown
+        self.state_dir = state_dir or hbm_ledger.default_state_dir()
+        # warmup-captured XLA cost model: per-dispatch FLOPs/bytes
+        # accounting + the MFU gauge (telemetry/costmodel.py). Host-held
+        # counters only — the hot path never syncs for accounting.
+        self._costmodel: Optional[costmodel.CostModel] = None
+        if knobs.flag("LOCALAI_COSTMODEL"):
+            try:
+                plat = jax.devices()[0].platform
+            except RuntimeError:  # backend not initialized
+                plat = "cpu"
+            self._costmodel = costmodel.CostModel(
+                self._mlabel, plat,
+                1 if mesh is None else int(mesh.devices.size))
+        # component-level HBM ledger (telemetry/hbm_ledger.py):
+        # long-lived device allocations registered here, reconciled
+        # against device.memory_stats() each gauge sweep
+        self._ledger: Optional[hbm_ledger.HBMLedger] = None
+        self._ledger_t = 0.0  # last reconcile (rate-limited ~1s)
+        if knobs.flag("LOCALAI_HBM_LEDGER"):
+            led = hbm_ledger.HBMLedger(self._mlabel)
+            led.register("weights", self.params)
+            led.register("kv_arena",
+                         (self.cache.k, self.cache.v))
+            if getattr(self.cache, "k_scale", None) is not None:
+                led.register("kv_scales",
+                             (self.cache.k_scale, self.cache.v_scale))
+            if self.draft_cache is not None:
+                led.register("draft_cache", self.draft_cache)
+            led.register("sampler", self.sampling)
+            if self._tier is not None:
+                # in-flight tier spill/fetch DMA buffers (callable
+                # source: the windows' byte counts move every sweep)
+                tier = self._tier
+                led.register(
+                    "staging",
+                    lambda: tier._swin.flying + tier._fwin.flying)
+            self._ledger = led
 
     def _kernel_eligible(self) -> bool:
         """Use the Pallas ragged decode kernels when the mosaic path is
@@ -906,6 +946,12 @@ class LLMEngine:
         prefix_index LRU x length) under pool pressure. False = the
         arena is genuinely full of ACTIVE state; the caller ends or
         requeues the work."""
+        if faultinject.ACTIVE:
+            # chaos surface for the OOM-forensics path: a fault here is
+            # the deterministic stand-in for a device RESOURCE_EXHAUSTED
+            # during KV growth — _loop's catch writes the HBM
+            # post-mortem before failing the active slots
+            faultinject.fire("engine.hbm_alloc")
         try:
             self._pool.ensure(slot.idx, n_tokens)
             return True
@@ -1829,6 +1875,13 @@ class LLMEngine:
             with fault_scope(s.request.id for s in self.slots
                              if s.request is not None):
                 faultinject.fire("engine.device_step")
+        # cost-model accounting key: non-flight kinds account here, right
+        # after the dispatch enqueues (flight kinds account at harvest,
+        # where the span is known). Host-side dict math only — no syncs.
+        cm = self._costmodel
+        ckey = (costmodel.dispatch_key(kind, payload)
+                if cm is not None and kind not in costmodel.FLIGHT_KINDS
+                else None)
         ch = self.channel
         if ch is not None and not self.follower:
             # dense masks are bit-packed for the wire only; the local exec
@@ -1850,8 +1903,14 @@ class LLMEngine:
             with ch.order_lock:
                 ch.publish(kind, {"model": self.tag, "data": wire,
                                   "trace": trace})
-                return self._dev_exec(kind, payload)
-        return self._dev_exec(kind, payload)
+                out = self._dev_exec(kind, payload)
+            if ckey is not None:
+                cm.on_dispatch(kind, ckey)
+            return out
+        out = self._dev_exec(kind, payload)
+        if ckey is not None:
+            cm.on_dispatch(kind, ckey)
+        return out
 
     def _dev_exec(self, kind: str, p: dict) -> Any:
         """Device-only work for one dispatch record. MUST be fully
@@ -1863,6 +1922,15 @@ class LLMEngine:
         def tabs():
             return (jnp.asarray(p["pt"]), jnp.asarray(p["wb"]))
 
+        def cap(fn, *args, **kw):
+            # warmup capture hook: AOT-compile this exact variant and
+            # record its XLA cost row (no-op outside capture mode —
+            # the serving hot path pays one attribute check)
+            cm = self._costmodel
+            if cm is not None and cm.capturing:
+                cm.capture(kind, costmodel.dispatch_key(kind, p),
+                           fn, args, kw)
+
         if kind == "prefill":
             toks = jnp.asarray(p["toks"])
             pos0 = jnp.asarray(p["pos0"])
@@ -1872,6 +1940,8 @@ class LLMEngine:
                 p.get("window", self.max_seq), p.get("ring", False))
             if self._paged:
                 pt, wb = tabs()
+                cap(fn, self.params, toks, self.cache, pos0, sids,
+                    pt, wb, soft=soft)
                 self.cache = fn(self.params, toks, self.cache, pos0,
                                 sids, pt, wb, soft=soft)
                 if self.draft is not None:
@@ -1881,6 +1951,8 @@ class LLMEngine:
                         jnp.full(toks.shape[:1], toks.shape[1],
                                  jnp.int32))
             else:
+                cap(fn, self.params, toks, self.cache, pos0, sids,
+                    soft=soft)
                 self.cache = fn(self.params, toks, self.cache, pos0,
                                 sids, soft=soft)
                 if self.draft is not None:
@@ -1908,6 +1980,7 @@ class LLMEngine:
             if self._paged:
                 pt, wb = tabs()
                 args += [pt, wb]
+            cap(fn, *args, soft=soft)
             toks_out, self.cache, self.sampling = fn(*args, soft=soft)
             if self.draft is not None:
                 if self._paged:
@@ -1942,8 +2015,9 @@ class LLMEngine:
             if self._paged:
                 pt, wb = tabs()
                 args += [pt, wb]
-            toks_out, self.cache, self.sampling = self._mixed_fn(
-                p.get("window", self.max_seq))(*args, soft=soft)
+            fn = self._mixed_fn(p.get("window", self.max_seq))
+            cap(fn, *args, soft=soft)
+            toks_out, self.cache, self.sampling = fn(*args, soft=soft)
             if self.draft is not None:
                 # mirror ONLY the prefill rows into the draft cache
                 # (decode rows advance without draft writes, exactly as
@@ -1967,6 +2041,7 @@ class LLMEngine:
                     self.sampling, jnp.asarray(p["active"]), masks]
             if self._paged:
                 args += list(tabs())
+            cap(self._decode_fn, *args)
             toks, self.cache, self.sampling = self._decode_fn(*args)
             return toks
         if kind == "decodek":
@@ -1980,6 +2055,8 @@ class LLMEngine:
                 pos_dev = jnp.asarray(p["pos0"])
                 act_dev = jnp.asarray(p["active"])
             extra = list(tabs()) if self._paged else []
+            cap(fn, self.params, tok_dev, self.cache, pos_dev,
+                self._all_slot_ids, self.sampling, act_dev, *extra)
             batches = []
             for _ in range(p["depth"]):
                 toks, tok_dev, pos_dev, self.cache, self.sampling = fn(
@@ -2020,9 +2097,11 @@ class LLMEngine:
             dst = jnp.asarray(p["dst"], jnp.int32)
             fn = self._kv_copy_fn(p["n"], self.draft is not None)
             if self.draft is not None:
+                cap(fn, self.cache, self.draft_cache, src, dst)
                 self.cache, self.draft_cache = fn(
                     self.cache, self.draft_cache, src, dst)
             else:
+                cap(fn, self.cache, src, dst)
                 self.cache = fn(self.cache, src, dst)
             return None
         if kind == "embed":
@@ -2069,12 +2148,37 @@ class LLMEngine:
             for tname in ("hbm", "host", "disk"):
                 tm.ENGINE_KV_TIER_PAGES.labels(
                     model=self._mlabel, tier=tname).set(0)
+        tm.ENGINE_MFU.labels(model=self._mlabel).set(0.0)
+        if self._ledger is not None:
+            self._ledger.reset_gauges()
         if self.mesh is not None:
             # release the process-wide meshed gate so a later unmeshed
             # engine regains the fused int8 kernel (single-owner rule)
             from ..models import quant
 
             quant.set_meshed_serving(False)
+
+    def _active_exemplar(self) -> Optional[dict]:
+        """Exemplar labels for a batch-level latency sample: the first
+        active slot's trace id (a batch observation has no single
+        owner; one representative trace is what OM exemplars carry)."""
+        for s in self.slots:
+            if (s.active and s.request is not None
+                    and s.request.trace_id):
+                return {"trace_id": s.request.trace_id}
+        return None
+
+    def cost_stats(self) -> Optional[dict]:
+        """Cost-model summary (MFU, per-kind roofline) for
+        /backend/monitor; None when LOCALAI_COSTMODEL=off."""
+        return (self._costmodel.stats()
+                if self._costmodel is not None else None)
+
+    def hbm_stats(self) -> Optional[dict]:
+        """HBM-ledger snapshot for /backend/monitor; None when
+        LOCALAI_HBM_LEDGER=off."""
+        return (self._ledger.snapshot()
+                if self._ledger is not None else None)
 
     def _warmup_signature(self) -> str:
         """Fingerprint of everything the warmup variant set depends on:
@@ -2159,10 +2263,20 @@ class LLMEngine:
         def _warm(kind, payload):
             # every warmup dispatch compiles exactly one (fn, shape)
             # jit variant; the count is the series the ragged unification
-            # collapses (engine_dispatch_compile_variants_count)
+            # collapses (engine_dispatch_compile_variants_count).
+            # Capture mode rides the pass: _dev_exec records each
+            # variant's XLA cost row (telemetry/costmodel.py) while the
+            # pad dispatch itself stays unaccounted (it is not traffic)
             nonlocal n_variants
             n_variants += 1
-            return self._run(kind, payload)
+            cm = self._costmodel
+            if cm is None:
+                return self._run(kind, payload)
+            cm.capturing = True
+            try:
+                return self._run(kind, payload)
+            finally:
+                cm.capturing = False
 
         W = self.sampling.window
         pad_reset = self._reset_columns([], 1)
@@ -2604,6 +2718,18 @@ class LLMEngine:
                 self.step()
             except Exception as e:  # engine must survive; fail active slots
                 self._flights.clear()
+                if hbm_ledger.looks_like_oom(e):
+                    # device allocation failure: write the forensics
+                    # file BEFORE failing the slots, so the autopsy
+                    # captures the state that OOMed (best-effort — dump
+                    # never raises)
+                    hbm_ledger.dump_post_mortem(
+                        self.state_dir, self._mlabel, e,
+                        ledger=self._ledger,
+                        pool_stats=(self._pool.stats()
+                                    if self._pool is not None else None),
+                        tier_stats=(self._tier.stats()
+                                    if self._tier is not None else None))
                 self._fail_all(f"engine step error: {e!r}")
 
     def _has_work(self) -> bool:
@@ -2715,6 +2841,17 @@ class LLMEngine:
             # decode-stall gaps are only meaningful while a slot
             # decodes; reset the clock when the decode set drains
             self._last_decode_adv = 0.0
+        if self._ledger is not None:
+            # ledger reconcile + device/host memory gauges: host dict
+            # math and a memory_stats() host call, rate-limited to ~1/s
+            # so a ms-scale scheduler iteration never pays it
+            now = time.monotonic()
+            if now - self._ledger_t >= 1.0:
+                self._ledger_t = now
+                self._ledger.reconcile()
+                from ..utils import sysinfo
+
+                sysinfo.update_memory_gauges()
 
     def _dispatch(self) -> bool:
         """Enqueue device work for the current slot states. Returns
@@ -2865,6 +3002,11 @@ class LLMEngine:
                 model=self._mlabel, kind=fl.kind).observe(dur)
             FLIGHT.span("step:" + fl.kind, "device", fl.t_enqueue, dur,
                         fl.meta.get("rec"))
+            if self._costmodel is not None:
+                # cost accounting + MFU sample against the flight's
+                # span — host dict math on already-harvested scalars
+                self._costmodel.on_harvest(
+                    fl.kind, fl.meta.get("cost"), dur)
             if fl.kind == "prefill_final":
                 self._complete_prefill_final(fl)
             elif fl.kind == "mixed":
@@ -3700,6 +3842,9 @@ class LLMEngine:
         self._flights.append(_Flight(
             kind="prefill_final", arrays=[toks_out],
             meta={"pairs": [(s, s.request) for s in group], "rows": rows,
+                  # cost-model variant key: accounted at harvest, where
+                  # the flight's span is known
+                  "cost": costmodel.dispatch_key("prefill_final", payload),
                   # timeline args for the flight recorder's harvest span
                   "rec": {"rows": len(group), "bucket": bucket,
                           "window": window}},
@@ -3893,6 +4038,7 @@ class LLMEngine:
         self._flights.append(_Flight(
             kind="mixed", arrays=[toks_out],
             meta={"rows": rows, "chunk_tokens": chunk_tokens,
+                  "cost": costmodel.dispatch_key("mixed", payload),
                   # timeline args for the flight recorder's harvest span
                   "rec": {"decode": len(decoding),
                           "prefill": len(prefilling) - len(finals),
@@ -3911,6 +4057,9 @@ class LLMEngine:
         toks_host = np.asarray(fl.arrays[0])  # [S]
         now = time.perf_counter()
         dt_ms = (now - fl.t_enqueue) * 1e3
+        # exemplar BEFORE the emit loop: a finishing slot deactivates
+        # below, and its trace id is exactly the one worth linking
+        exemplar = self._active_exemplar()
         decode_emitted = first_toks = prompt_toks = 0
         for role, s, req, aux in fl.meta["rows"]:
             if s.request is not req:  # cancelled mid-flight
@@ -3947,7 +4096,8 @@ class LLMEngine:
             tm.ENGINE_GENERATED_TOKENS.labels(model=m).inc(
                 decode_emitted + first_toks)
         if decode_emitted:
-            tm.ENGINE_INTER_TOKEN.labels(model=m).observe(dt_ms / 1e3)
+            tm.ENGINE_INTER_TOKEN.labels(model=m).observe(
+                dt_ms / 1e3, exemplar=exemplar)
             self._note_tokens_per_second(decode_emitted, dt_ms / 1e3)
         self.metrics.slots_busy = sum(1 for s in self.slots if s.active)
     # lint: endregion hot_path
@@ -4344,6 +4494,7 @@ class LLMEngine:
             kind="decodek", arrays=[toks],
             meta={
                 "k": k,
+                "cost": costmodel.dispatch_key("decodek", payload),
                 "pairs": [(s, s.request) for s in decoding],
                 # None for a chained scan: its predecessor's last tokens
                 # are unknown until that flight harvests (_harvest_last)
@@ -4401,6 +4552,9 @@ class LLMEngine:
         prev_last = fl.meta["prev_last"]
         if prev_last is None:
             prev_last = self._harvest_last
+        # exemplar BEFORE the emit loop: a finishing slot deactivates
+        # below, and its trace id is exactly the one worth linking
+        exemplar = self._active_exemplar()
         emitted = 0
         next_last: dict[int, int] = {}
         for s, req in fl.meta["pairs"]:
@@ -4427,7 +4581,7 @@ class LLMEngine:
             tm.ENGINE_GENERATED_TOKENS.labels(model=self._mlabel).inc(
                 emitted)
             tm.ENGINE_INTER_TOKEN.labels(model=self._mlabel).observe(
-                dt_ms / 1e3 / k)
+                dt_ms / 1e3 / k, exemplar=exemplar)
         self.metrics.slots_busy = sum(1 for s in self.slots if s.active)
 
     def _decode1_step(self, decoding: list[_Slot]) -> None:
@@ -4514,8 +4668,12 @@ class LLMEngine:
             slot.t_first = time.perf_counter()
             TRACER.event(req.id, "first_token", t=slot.t_first)
             if req.t_submit:
+                # OpenMetrics exemplar: the trace id links this bucket
+                # sample to its /debug/traces entry
                 tm.ENGINE_TTFT.labels(model=self._mlabel).observe(
-                    slot.t_first - req.t_submit)
+                    slot.t_first - req.t_submit,
+                    exemplar=({"trace_id": req.trace_id}
+                              if req.trace_id else None))
             tm.ENGINE_PREFILL.labels(model=self._mlabel).observe(
                 slot.t_prefill_ms / 1e3)
         slot.generated.append(token_id)

@@ -67,10 +67,16 @@ class TransferWindow:
       spill DMA never blocks a device dispatch.
     """
 
-    def __init__(self, budget_bytes: int) -> None:
+    def __init__(self, budget_bytes: int,
+                 ledger: Optional[Any] = None) -> None:
         self.budget = max(1, budget_bytes)
         self._q: deque[tuple[Any, int, tuple]] = deque()
         self.flying = 0  # bytes in flight
+        if ledger is not None:
+            # HBM ledger hookup: the "staging" component reads the live
+            # in-flight byte count (telemetry/hbm_ledger.py callable
+            # source), so commit/spill transfer buffers are attributed
+            ledger.register("staging", lambda: self.flying)
 
     def __len__(self) -> int:
         return len(self._q)
@@ -168,6 +174,8 @@ def commit_deferred(
     phases: Optional[Any] = None,  # LoadPhases: read_s = main-thread
     # wait on leaf materialization, transfer_s = device placement+commit
     readers: int = 2,
+    ledger: Optional[Any] = None,  # HBMLedger: attributes the in-flight
+    # transfer window to the "staging" component during the commit
 ) -> dict[str, Any]:
     """Stream a ``defer_transpose`` parameter tree onto ``device``.
 
@@ -204,7 +212,7 @@ def commit_deferred(
     # the peak (each is ~the same size and commits one at a time).
     names = sorted(params, key=lambda n: _leaf_bytes(params[n]))
     budget = knobs.int_("LOCALAI_COMMIT_INFLIGHT_MB") * (1 << 20)
-    window = TransferWindow(budget)
+    window = TransferWindow(budget, ledger=ledger)
 
     def drain(need: int) -> None:
         if len(window) and window.over(need):

@@ -255,10 +255,31 @@ def build_app(state: Application) -> web.Application:
                 _uuid.uuid4().hex[:12], cfg.node_name or "localai-node",
                 addr,
             ))
+        if not cfg.disable_metrics:
+            import asyncio
+
+            from ..utils import sysinfo
+
+            async def memory_gauge_loop():
+                # keep device_hbm_used_bytes / process_rss_bytes fresh
+                # even when no engine is loaded (engines also sync them
+                # on their own gauge sweep)
+                while True:
+                    try:
+                        sysinfo.update_memory_gauges()
+                    except Exception:
+                        log.debug("memory gauge sync failed",
+                                  exc_info=True)
+                    await asyncio.sleep(10.0)
+
+            app_["memory_gauge_task"] = asyncio.create_task(
+                memory_gauge_loop())
 
     async def on_cleanup(app_):
-        task = app_.get("announce_task")
-        if task is not None:
+        for key in ("announce_task", "memory_gauge_task"):
+            task = app_.get(key)
+            if task is None:
+                continue
             import asyncio
 
             task.cancel()

@@ -30,6 +30,7 @@ def register(app: web.Application) -> None:
     r.add_get("/metrics", metrics)
     r.add_get("/debug/traces", debug_traces)
     r.add_get("/debug/timeline", debug_timeline)
+    r.add_get("/debug/profile", debug_profile)
     r.add_get("/system", system)
     r.add_get("/backend/monitor", backend_monitor)
     r.add_post("/backend/shutdown", backend_shutdown)
@@ -84,12 +85,17 @@ async def metrics(request: web.Request) -> web.Response:
     st = _state(request)
     if st.config.disable_metrics:
         raise web.HTTPNotFound()
-    from ..telemetry.registry import CONTENT_TYPE
+    from ..telemetry.registry import CONTENT_TYPE, OPENMETRICS_CONTENT_TYPE
 
-    # the full exposition header (version + charset) — some scrapers
-    # refuse bare text/plain
-    return web.Response(body=st.metrics.render().encode("utf-8"),
-                        headers={"Content-Type": CONTENT_TYPE})
+    # content negotiation: OpenMetrics (exemplars, # EOF) only when the
+    # scraper asks for it; the default stays the 0.0.4 text format
+    # byte-identical to what it always rendered
+    om = "application/openmetrics-text" in request.headers.get(
+        "Accept", "")
+    return web.Response(
+        body=st.metrics.render(openmetrics=om).encode("utf-8"),
+        headers={"Content-Type": (OPENMETRICS_CONTENT_TYPE if om
+                                  else CONTENT_TYPE)})
 
 
 async def debug_traces(request: web.Request) -> web.Response:
@@ -104,24 +110,110 @@ async def debug_traces(request: web.Request) -> web.Response:
     except ValueError:
         raise web.HTTPBadRequest(reason="'limit' must be an integer")
     ident = request.query.get("id")
+    # live debug state: a cached poll response shows a stale engine
+    hdrs = {"Cache-Control": "no-store"}
     if ident:
         return web.json_response({
             "traces": TRACER.lookup(ident, limit=limit),
-        })
+        }, headers=hdrs)
     return web.json_response({
         "traces": TRACER.traces(model=request.query.get("model") or None,
                                 limit=limit),
-    })
+    }, headers=hdrs)
 
 
 async def debug_timeline(request: web.Request) -> web.Response:
     """The scheduler/device flight recorder as Chrome-trace JSON
     (telemetry/flightrec.py) — save the body and open it in Perfetto
     (https://ui.perfetto.dev) or chrome://tracing; offline renderer:
-    tools/trace_viewer.py."""
+    tools/trace_viewer.py. ``?limit=`` bounds the serialized event
+    count (newest last — the ring is bounded, but a monitoring poll
+    should not re-serialize all 8k events every few seconds)."""
     from ..telemetry.flightrec import FLIGHT
 
-    return web.json_response(FLIGHT.export_chrome_trace())
+    trace = FLIGHT.export_chrome_trace()
+    limit_q = request.query.get("limit")
+    if limit_q:
+        try:
+            limit = max(0, int(limit_q))
+        except ValueError:
+            raise web.HTTPBadRequest(reason="'limit' must be an integer")
+        ev = trace.get("traceEvents", [])
+        if len(ev) > limit:
+            trace = {**trace, "traceEvents": ev[-limit:] if limit else []}
+    return web.json_response(trace,
+                             headers={"Cache-Control": "no-store"})
+
+
+# the single-capture gate for /debug/profile: jax.profiler supports one
+# active trace per process, so concurrent captures get 409, not a crash
+_PROFILE_LOCK = None  # created lazily (threading.Lock is importable at
+# module scope, but keeping the gate with its handler reads clearer)
+
+
+async def debug_profile(request: web.Request) -> web.Response:
+    """On-demand, duration-bounded ``jax.profiler`` capture. Gated by
+    LOCALAI_PROFILER (off by default: a capture costs real device/host
+    overhead and writes to disk). ``?duration=`` seconds (clamped to
+    LOCALAI_PROFILER_MAX_S), ``?download=1`` streams the capture dir
+    back as a zip; otherwise the response names the path under
+    ``state_dir`` for tensorboard/xprof."""
+    import io
+    import os
+    import threading
+    import zipfile
+
+    from ..config import knobs
+
+    global _PROFILE_LOCK
+    if not knobs.flag("LOCALAI_PROFILER"):
+        raise web.HTTPForbidden(
+            reason="profiler disabled (set LOCALAI_PROFILER=on)")
+    try:
+        duration = float(request.query.get("duration") or 2.0)
+    except ValueError:
+        raise web.HTTPBadRequest(reason="'duration' must be a number")
+    max_s = max(0.1, knobs.float_("LOCALAI_PROFILER_MAX_S"))
+    duration = min(max(0.1, duration), max_s)
+    if _PROFILE_LOCK is None:
+        _PROFILE_LOCK = threading.Lock()
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        raise web.HTTPConflict(reason="a profile capture is already "
+                                      "running")
+    st = _state(request)
+    logdir = os.path.join(st.config.state_dir, "profiles",
+                          time.strftime("%Y%m%d-%H%M%S"))
+    try:
+        import jax
+
+        os.makedirs(logdir, exist_ok=True)
+        jax.profiler.start_trace(logdir)
+        try:
+            await asyncio.sleep(duration)
+        finally:
+            jax.profiler.stop_trace()
+    except Exception as e:
+        raise web.HTTPInternalServerError(
+            reason=f"profiler capture failed: {e!r}")
+    finally:
+        _PROFILE_LOCK.release()
+    if request.query.get("download"):
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+            for root, _dirs, files in os.walk(logdir):
+                for fname in files:
+                    full = os.path.join(root, fname)
+                    zf.write(full, os.path.relpath(full, logdir))
+        return web.Response(
+            body=buf.getvalue(),
+            headers={
+                "Content-Type": "application/zip",
+                "Content-Disposition": 'attachment; filename="%s.zip"'
+                % os.path.basename(logdir),
+                "Cache-Control": "no-store",
+            })
+    return web.json_response({"path": logdir, "duration_s": duration},
+                             headers={"Cache-Control": "no-store"})
 
 
 async def system(request: web.Request) -> web.Response:

@@ -1,0 +1,283 @@
+"""Warmup-captured XLA cost model: per-dispatch FLOPs/bytes accounting.
+
+The engine's warmup pass compiles every dispatch variant it will ever
+run (that is warmup's whole point). This module rides that pass: while
+capture mode is on, each variant's ``lower().compile()`` is repeated
+AOT-style purely to read ``compiled.cost_analysis()`` — the XLA cost
+model's flops and bytes-accessed estimates — and the result is stored
+under the same (kind, shape-signature) key the engine's jit cache uses.
+On TPU the persistent compile cache dedupes the second compile; on the
+tiny CPU test models it is milliseconds.
+
+From then on the hot path never touches the device for accounting:
+every dispatch adds the captured flops/bytes of its variant to
+host-held totals (the flightrec contract — zero syncs, zero device
+work), exported as ``engine_device_flops_total{kind}`` and
+``engine_device_bytes_total{kind}``. Flight-shaped kinds (prefill_final
+/ mixed / decodek) account at HARVEST, where the flight's wall span is
+known, and each harvest also feeds an EWMA MFU estimate:
+
+    mfu = captured_flops / (span_seconds * peak_flops * n_devices)
+
+``roofline()`` classifies each kind compute- vs bandwidth-bound by
+comparing its arithmetic intensity (flops / bytes accessed) against the
+machine balance point ``peak_flops / peak_bw``; peaks come from a
+built-in per-platform table overridable via ``LOCALAI_PEAK_FLOPS`` /
+``LOCALAI_PEAK_HBM_GBS``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Optional
+
+from ..config import knobs
+
+log = logging.getLogger("localai.costmodel")
+
+__all__ = ["CostModel", "dispatch_key", "peak_rates",
+           "analytic_flops_per_token", "FLIGHT_KINDS"]
+
+# kinds whose device work completes asynchronously as a _Flight; these
+# account at harvest (span known), everything else at dispatch
+FLIGHT_KINDS = frozenset({"prefill_final", "mixed", "decodek"})
+
+# (peak FLOP/s, peak HBM bytes/s) per device, by jax platform. The TPU
+# row is a v5e-class part (matches the paper's serving baselines); the
+# CPU row is a laptop-class core (ridge = 50e9/50e9 = 1 flop/byte),
+# which puts the tiny f32 test models on both sides of the ridge: XLA
+# measures their decode at ~0.2 flops/byte (weights re-read per token)
+# and their batched prefill at ~2.3 (weights amortized per bucket).
+_PEAK_TABLE: dict[str, tuple[float, float]] = {
+    "tpu": (197e12, 819e9),
+    "gpu": (60e12, 1000e9),
+    "cpu": (50e9, 50e9),
+}
+
+_EWMA_ALPHA = 0.2
+
+
+def peak_rates(platform: str) -> tuple[float, float]:
+    """(peak FLOP/s, peak bytes/s) per device — knob overrides first,
+    then the platform table, then the CPU row."""
+    flops = knobs.float_("LOCALAI_PEAK_FLOPS")
+    bw = knobs.float_("LOCALAI_PEAK_HBM_GBS") * 1e9
+    table = _PEAK_TABLE.get(platform.lower(), _PEAK_TABLE["cpu"])
+    return (flops if flops > 0 else table[0],
+            bw if bw > 0 else table[1])
+
+
+def dispatch_key(kind: str, payload: dict) -> tuple:
+    """The shape signature that selects a compiled variant — must vary
+    exactly when the engine's jit-cache key varies, so each captured
+    cost row matches the executable the dispatch actually runs."""
+    p = payload
+    if kind == "prefill_final":
+        toks = p["toks"]
+        return (kind, toks.shape[0], toks.shape[1],
+                p.get("window"), bool(p.get("identity")))
+    if kind == "mixed":
+        toks = p["toks"]
+        return (kind, tuple(toks.shape), p.get("window"))
+    if kind == "decodek":
+        return (kind, p["k"], p.get("window"), p.get("depth", 1))
+    if kind == "prefill":
+        toks = p["toks"]
+        return (kind, toks.shape[-1], p.get("window"),
+                bool(p.get("ring")))
+    if kind in ("spec", "spec_s"):
+        return (kind, p.get("kd"), p.get("rounds"))
+    if kind == "kvcopy":
+        return (kind, p.get("n"))
+    if kind == "embed":
+        return (kind, p.get("bucket"))
+    return (kind,)
+
+
+def _extract_costs(analysis: Any) -> tuple[float, float]:
+    """(flops, bytes accessed) from a cost_analysis() result, which is
+    a dict or a per-device list of dicts depending on jax version."""
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    if not isinstance(analysis, dict):
+        return 0.0, 0.0
+    flops = float(analysis.get("flops", 0.0) or 0.0)
+    by = analysis.get("bytes accessed")
+    if by is None:
+        # some versions only expose per-operand rows
+        by = sum(float(v) for k, v in analysis.items()
+                 if isinstance(k, str) and k.startswith("bytes accessed"))
+    return flops, float(by or 0.0)
+
+
+def analytic_flops_per_token(params: Any) -> float:
+    """First-principles decode FLOPs/token: 2 x matrix params (every
+    ndim>=2 leaf — one multiply-accumulate per weight per token). The
+    tests cross-check the captured cost model against this to a
+    generous tolerance; the XLA estimate additionally counts attention
+    and norm flops, so captured >= analytic is the expected shape."""
+    import jax
+
+    sizes = [int(x.size) for x in jax.tree_util.tree_leaves(params)
+             if hasattr(x, "ndim") and x.ndim >= 2]
+    return 2.0 * float(sum(sizes))
+
+
+class CostModel:
+    """Per-engine dispatch cost table + host-held accounting.
+
+    Thread contract: ``capture`` runs on the engine thread during
+    warmup; ``on_dispatch`` / ``on_harvest`` run on the engine thread
+    only; ``stats`` / ``roofline`` may be called from any thread (the
+    single lock covers the shared tables).
+    """
+
+    def __init__(self, model: str, platform: str,
+                 n_devices: int = 1) -> None:
+        self.model = model
+        self.platform = platform
+        self.n_devices = max(1, int(n_devices))
+        self.capturing = False
+        self._lock = threading.Lock()
+        # (kind, sig) -> (flops, bytes)
+        self._table: dict[tuple, tuple[float, float]] = {}
+        # kind -> [flops, bytes, dispatches]
+        self._totals: dict[str, list[float]] = {}
+        self._mfu: Optional[float] = None  # EWMA, None until 1st sample
+        self._mfu_samples = 0
+
+    # ------------------------------------------------------- capture
+
+    def capture(self, kind: str, key: tuple, fn, args: tuple,
+                kwargs: Optional[dict] = None) -> None:
+        """AOT-compile one dispatch variant and record its cost row.
+        Failures degrade to a missing row (dispatch accounting skips
+        it) — the cost model must never break serving."""
+        with self._lock:
+            if key in self._table:
+                return
+        try:
+            compiled = fn.lower(*args, **(kwargs or {})).compile()
+            flops, by = _extract_costs(compiled.cost_analysis())
+        except Exception as e:  # pragma: no cover - backend-specific
+            log.debug("cost capture failed for %s: %r", key, e)
+            from . import metrics as tm
+
+            tm.RECOVERED_ERRORS.labels(site="costmodel.capture").inc()
+            return
+        with self._lock:
+            self._table[key] = (flops, by)
+
+    def captured(self) -> dict[tuple, tuple[float, float]]:
+        with self._lock:
+            return dict(self._table)
+
+    # ---------------------------------------------------- accounting
+
+    def _account(self, kind: str, key: Optional[tuple]) -> float:
+        """Add one dispatch of ``key`` to the totals; returns its
+        flops (0 when the variant was never captured)."""
+        if key is None:
+            return 0.0
+        with self._lock:
+            row = self._table.get(key)
+            if row is None:
+                return 0.0
+            t = self._totals.setdefault(kind, [0.0, 0.0, 0.0])
+            t[0] += row[0]
+            t[1] += row[1]
+            t[2] += 1.0
+            flops = row[0]
+        from . import metrics as tm
+
+        tm.ENGINE_DEVICE_FLOPS.labels(model=self.model,
+                                      kind=kind).inc(row[0])
+        tm.ENGINE_DEVICE_BYTES.labels(model=self.model,
+                                      kind=kind).inc(row[1])
+        return flops
+
+    def on_dispatch(self, kind: str, key: Optional[tuple]) -> None:
+        """Account a synchronously-completing dispatch (non-flight
+        kinds). No-op in capture mode: warmup pads are not traffic."""
+        if self.capturing:
+            return
+        self._account(kind, key)
+
+    def on_harvest(self, kind: str, key: Optional[tuple],
+                   span_s: float) -> None:
+        """Account a harvested flight and fold an MFU sample into the
+        EWMA (the flight's enqueue-to-ready span is the denominator)."""
+        flops = self._account(kind, key)
+        if flops <= 0.0 or span_s <= 0.0:
+            return
+        peak_flops, _ = peak_rates(self.platform)
+        sample = min(1.0, flops / (span_s * peak_flops * self.n_devices))
+        with self._lock:
+            if self._mfu is None:
+                self._mfu = sample
+            else:
+                self._mfu += _EWMA_ALPHA * (sample - self._mfu)
+            self._mfu_samples += 1
+            mfu = self._mfu
+        from . import metrics as tm
+
+        tm.ENGINE_MFU.labels(model=self.model).set(mfu)
+
+    # ------------------------------------------------------ summaries
+
+    @property
+    def mfu(self) -> Optional[float]:
+        with self._lock:
+            return self._mfu
+
+    def roofline(self) -> dict[str, dict]:
+        """Per-kind roofline summary: accounted totals, arithmetic
+        intensity, and compute- vs bandwidth-bound classification
+        against the machine balance point. Kinds with dispatch traffic
+        use accounted totals; kinds only ever captured fall back to
+        their captured rows so the classification exists pre-traffic."""
+        peak_flops, peak_bw = peak_rates(self.platform)
+        ridge = peak_flops / max(peak_bw, 1.0)
+        with self._lock:
+            per_kind: dict[str, list[float]] = {
+                k: list(v) for k, v in self._totals.items()}
+            with_traffic = set(per_kind)
+            for (kind, *_), (fl, by) in self._table.items():
+                if kind in with_traffic:
+                    continue
+                t = per_kind.setdefault(kind, [0.0, 0.0, 0.0])
+                t[0] += fl
+                t[1] += by
+        out: dict[str, dict] = {}
+        for kind, (fl, by, n) in sorted(per_kind.items()):
+            intensity = fl / by if by > 0 else 0.0
+            out[kind] = {
+                "flops": fl,
+                "bytes": by,
+                "dispatches": int(n),
+                "intensity_flops_per_byte": round(intensity, 3),
+                "bound": ("compute" if intensity >= ridge
+                          else "bandwidth"),
+            }
+        return out
+
+    def stats(self) -> dict:
+        """Host-held summary for /backend/monitor and bench."""
+        peak_flops, peak_bw = peak_rates(self.platform)
+        with self._lock:
+            mfu = self._mfu
+            samples = self._mfu_samples
+            variants = len(self._table)
+        return {
+            "platform": self.platform,
+            "n_devices": self.n_devices,
+            "peak_flops_per_device": peak_flops,
+            "peak_hbm_bytes_s_per_device": peak_bw,
+            "ridge_flops_per_byte": round(
+                peak_flops / max(peak_bw, 1.0), 3),
+            "mfu_ewma": round(mfu, 6) if mfu is not None else None,
+            "mfu_samples": samples,
+            "variants_captured": variants,
+            "kinds": self.roofline(),
+        }

@@ -319,6 +319,44 @@ TIMELINE_RING_EVENTS = REGISTRY.gauge(
     "(telemetry/flightrec.py; exported as Chrome-trace JSON via "
     "GET /debug/timeline)",
 )
+ENGINE_DEVICE_FLOPS = REGISTRY.counter(
+    "engine_device_flops_total",
+    "Device FLOPs accounted per dispatch kind from the warmup-captured "
+    "XLA cost model (telemetry/costmodel.py) — accumulated host-side at "
+    "dispatch/harvest, zero hot-path syncs",
+    labels=("model", "kind"),
+)
+ENGINE_DEVICE_BYTES = REGISTRY.counter(
+    "engine_device_bytes_total",
+    "Device bytes accessed (HBM traffic) accounted per dispatch kind "
+    "from the warmup-captured XLA cost model",
+    labels=("model", "kind"),
+)
+ENGINE_MFU = REGISTRY.gauge(
+    "engine_mfu_ratio",
+    "EWMA model-FLOPs-utilization: cost-model FLOPs per harvested "
+    "flight divided by (device-step span x peak FLOPs across the mesh)",
+    labels=("model",),
+)
+ENGINE_HBM_BYTES = REGISTRY.gauge(
+    "engine_hbm_bytes",
+    "Component-level HBM ledger (telemetry/hbm_ledger.py): bytes "
+    "attributed to weights / kv_arena / kv_scales / draft_cache / "
+    "staging / sampler, plus an 'unattributed' drift row reconciled "
+    "against device.memory_stats()",
+    labels=("model", "component"),
+)
+DEVICE_HBM_USED = REGISTRY.gauge(
+    "device_hbm_used_bytes",
+    "Per-device bytes_in_use from device.memory_stats(), synced "
+    "periodically by utils/sysinfo.update_memory_gauges()",
+    labels=("device",), max_label_sets=256,
+)
+PROCESS_RSS = REGISTRY.gauge(
+    "process_rss_bytes",
+    "Resident set size of the serving process (host RAM pressure; "
+    "includes the KV host-spill tier)",
+)
 
 # ---------------------------------------------------------------- loader
 

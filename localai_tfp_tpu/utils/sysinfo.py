@@ -44,6 +44,33 @@ def device_memory() -> list[dict[str, Any]]:
     return out
 
 
+def process_rss_bytes() -> int:
+    """Resident set size of this process from /proc (0 where /proc is
+    unavailable)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def update_memory_gauges() -> None:
+    """Sync the scrapeable memory gauges — per-device
+    ``device_hbm_used_bytes`` and host ``process_rss_bytes`` — from the
+    same sources GET /backend/monitor polls. Called periodically by the
+    server and each engine gauge sweep; cheap enough for both."""
+    from ..telemetry import metrics as tm
+
+    for row in device_memory():
+        if "bytes_in_use" in row:
+            tm.DEVICE_HBM_USED.labels(device=str(row["id"])).set(
+                row["bytes_in_use"])
+    rss = process_rss_bytes()
+    if rss:
+        tm.PROCESS_RSS.set(rss)
+
+
 def _safetensors_param_count(path: str) -> int:
     """Count ELEMENTS from a safetensors header WITHOUT reading the
     payload (the header is a length-prefixed JSON index; per-tensor dtype

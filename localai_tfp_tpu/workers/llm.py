@@ -181,6 +181,8 @@ class JaxLLMBackend(Backend):
                 artifact_file = None
                 pending_artifact = None  # written after warmup
                 params = None
+                load_ledger = None  # load-time HBM attribution (the
+                # engine builds its own serving ledger at construction)
                 if is_gguf:
                     # GGUF: dequantize-on-load (ref: the reference's
                     # primary format — initializers.go:498-559); the
@@ -330,12 +332,15 @@ class JaxLLMBackend(Backend):
                     # the 7.5 GB device->host drain must not contend
                     # with warmup or first requests
                     from ..models.staging import commit_deferred
+                    from ..telemetry import hbm_ledger
 
+                    if knobs.flag("LOCALAI_HBM_LEDGER"):
+                        load_ledger = hbm_ledger.HBMLedger(opts.model)
                     params = commit_deferred(
                         params, dtype, jax.devices()[0],
                         quantize=True,
                         quantize_embeddings=quant == "int8_full",
-                        phases=phases)
+                        phases=phases, ledger=load_ledger)
                     pending_artifact = artifact_file
                 elif self._quantized and not artifact_hit:
                     # AFTER LoRA merge: adapters fold into full-precision
@@ -433,6 +438,19 @@ class JaxLLMBackend(Backend):
                 return Result(True, "model loaded")
             except Exception as e:
                 self._state = "ERROR"
+                from ..telemetry import hbm_ledger
+
+                if hbm_ledger.looks_like_oom(e):
+                    # loader-path OOM forensics: ledger attribution of
+                    # whatever was committed before the allocation
+                    # failed, plus device stats (best-effort dump)
+                    eng = self.engine
+                    hbm_ledger.dump_post_mortem(
+                        getattr(eng, "state_dir", None)
+                        or hbm_ledger.default_state_dir(),
+                        opts.model, e,
+                        ledger=(getattr(eng, "_ledger", None)
+                                or load_ledger))
                 if channel is not None and role == "leader":
                     # release the followers' (possibly successful) copy;
                     # leader and followers must agree the model is absent
@@ -766,6 +784,11 @@ class JaxLLMBackend(Backend):
                 "copies": m.prefix_copies,
                 "hit_rate": round(reused / max(reused + filled, 1), 4),
             },
+            # device observability: cost-model MFU/roofline summary and
+            # HBM ledger snapshot (None when the knobs are off) — still
+            # host-held values only
+            "costmodel": eng.cost_stats(),
+            "hbm": eng.hbm_stats(),
         }
 
 

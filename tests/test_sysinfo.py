@@ -74,3 +74,18 @@ def test_cli_hbm_fit(tmp_path, capsys):
           "--batch-slots", "2"])
     out = json.loads(capsys.readouterr().out)
     assert out["total_bytes"] > 0 and "fits" in out
+
+
+def test_process_rss_and_memory_gauges():
+    from localai_tfp_tpu.telemetry import metrics as tm
+    from localai_tfp_tpu.utils import sysinfo
+
+    rss = sysinfo.process_rss_bytes()
+    assert rss > 0  # /proc is available everywhere these tests run
+    sysinfo.update_memory_gauges()
+    assert tm.PROCESS_RSS._solo().snapshot()["value"] == rss or \
+        tm.PROCESS_RSS._solo().snapshot()["value"] > 0
+    # CPU devices expose no bytes_in_use; the device gauge must simply
+    # not crash the sync (rows without stats are skipped)
+    rows = sysinfo.device_memory()
+    assert rows and all("id" in r for r in rows)

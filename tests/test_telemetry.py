@@ -5,6 +5,7 @@ ordering, and the static metric-name contract (tools/check_metrics.py).
 """
 
 import asyncio
+import json
 import re
 import subprocess
 import sys
@@ -16,7 +17,8 @@ import pytest
 
 from localai_tfp_tpu.telemetry import metrics as tm
 from localai_tfp_tpu.telemetry.registry import (
-    CONTENT_TYPE, REGISTRY, Registry, escape_label_value,
+    CONTENT_TYPE, OPENMETRICS_CONTENT_TYPE, REGISTRY, Registry,
+    escape_label_value,
 )
 from localai_tfp_tpu.telemetry.tracing import TRACER, TraceRecorder
 
@@ -412,6 +414,142 @@ def test_extra_usage_gate_includes_lifecycle_timings():
     full = _usage(r, True)
     assert full["timing_queue"] == 1.5
     assert full["timing_first_token"] == 42.0
+
+
+# ------------------------------------------------- openmetrics exposition
+
+
+def test_openmetrics_render_exemplars_and_eof():
+    reg = Registry()
+    reg.counter("om_requests_total", "h").inc(2)
+    h = reg.histogram("om_lat_seconds", "h", ("model",),
+                      buckets=(0.1, 1.0))
+    h.labels(model="m").observe(0.05, exemplar={"trace_id": "abc"})
+    h.labels(model="m").observe(5.0, exemplar={"trace_id": "tail"})
+    h.labels(model="m").observe(0.06)  # no exemplar: keeps the newest
+    default = reg.render()
+    om = reg.render(openmetrics=True)
+    # the default 0.0.4 render is untouched: no exemplars, no EOF,
+    # counter HELP/TYPE keep the _total suffix, and it still validates
+    assert "# EOF" not in default and " # {" not in default
+    assert "# TYPE om_requests_total counter" in default
+    validate_families(parse_prom(default))
+    # OM: counter family name drops _total on HELP/TYPE, samples keep it
+    assert "# TYPE om_requests counter" in om
+    assert "# HELP om_requests h" in om
+    assert "om_requests_total 2" in om
+    assert om.rstrip().endswith("# EOF")
+    # newest exemplar per bucket rides the bucket line (incl. +Inf)
+    assert 'le="0.1"} 2 # {trace_id="abc"} 0.05' in om
+    assert 'le="+Inf"} 3 # {trace_id="tail"} 5' in om
+
+
+def test_engine_ttft_exemplar_joins_trace(model):
+    from localai_tfp_tpu.engine.engine import GenRequest
+
+    eng = _engine(model, tag="exemplar-test")
+    try:
+        final = _drain(eng.submit(GenRequest(
+            prompt_ids=eng.tokenize("hello exemplar"),
+            max_tokens=4, ignore_eos=True)))
+        assert final.finish_reason == "length"
+    finally:
+        eng.close()
+    om = REGISTRY.render(openmetrics=True)
+    m = re.search(
+        r'engine_ttft_seconds_bucket\{model="exemplar-test",le="[^"]+"\}'
+        r' \d+ # \{trace_id="([0-9a-f]+)"\}', om)
+    assert m, "no exemplar on the TTFT histogram"
+    # the exemplar's trace id resolves in the trace recorder — the whole
+    # point: a latency bucket links to /debug/traces?id=...
+    assert any(tr["trace_id"] == m.group(1)
+               for tr in TRACER.traces(limit=500))
+    assert re.search(
+        r'engine_inter_token_seconds_bucket\{model="exemplar-test",'
+        r'le="[^"]+"\} \d+ # \{trace_id="[0-9a-f]+"\}', om)
+
+
+def test_metrics_openmetrics_negotiation(app_client):
+    status, headers, text = app_client.get(
+        "/metrics",
+        headers={"Accept": OPENMETRICS_CONTENT_TYPE})
+    assert status == 200
+    assert headers["Content-Type"] == OPENMETRICS_CONTENT_TYPE
+    assert text.rstrip().endswith("# EOF")
+    # a plain scrape is unchanged (the 0.0.4 contract pinned above)
+    status, headers, text = app_client.get("/metrics")
+    assert status == 200
+    assert headers["Content-Type"] == CONTENT_TYPE
+    assert "# EOF" not in text
+
+
+# ------------------------------------------------------ debug endpoints
+
+
+def test_debug_endpoints_no_store_and_bounded(app_client):
+    status, headers, _ = app_client.get("/debug/traces")
+    assert status == 200
+    assert headers["Cache-Control"] == "no-store"
+    status, headers, body = app_client.get("/debug/timeline?limit=3")
+    assert status == 200
+    assert headers["Cache-Control"] == "no-store"
+    assert len(json.loads(body).get("traceEvents", [])) <= 3
+    status, _, _ = app_client.get("/debug/timeline?limit=bogus")
+    assert status == 400
+
+
+def test_debug_profile_gated_off_by_default(app_client, monkeypatch):
+    monkeypatch.delenv("LOCALAI_PROFILER", raising=False)
+    status, _, _ = app_client.get("/debug/profile")
+    assert status == 403
+
+
+def test_debug_profile_capture_clamp_and_download(tmp_path, monkeypatch):
+    import io
+    import zipfile
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from localai_tfp_tpu.config.app_config import ApplicationConfig
+    from localai_tfp_tpu.server.app import build_app
+    from localai_tfp_tpu.server.state import Application
+
+    monkeypatch.setenv("LOCALAI_PROFILER", "on")
+    monkeypatch.setenv("LOCALAI_PROFILER_MAX_S", "0.2")
+    (tmp_path / "models").mkdir()
+    cfg = ApplicationConfig(
+        models_path=str(tmp_path / "models"),
+        generated_content_dir=str(tmp_path / "generated"),
+        upload_dir=str(tmp_path / "uploads"),
+        config_dir=str(tmp_path / "configuration"),
+        state_dir=str(tmp_path / "state"),
+    )
+    loop = asyncio.new_event_loop()
+    tc = TestClient(TestServer(build_app(Application(cfg))), loop=loop)
+    loop.run_until_complete(tc.start_server())
+    try:
+        client = _SyncClient(loop, tc)
+        status, _, body = client.get("/debug/profile?duration=5")
+        assert status == 200
+        info = json.loads(body)
+        assert info["duration_s"] <= 0.2  # clamped to the knob ceiling
+        assert info["path"].startswith(str(tmp_path / "state"))
+        assert any(Path(info["path"]).rglob("*")), "capture wrote nothing"
+
+        async def download():
+            r = await tc.request(
+                "GET", "/debug/profile",
+                params={"duration": "0.05", "download": "1"})
+            return r.status, r.headers, await r.read()
+
+        status, headers, raw = loop.run_until_complete(download())
+        assert status == 200
+        assert headers["Content-Type"] == "application/zip"
+        assert headers["Cache-Control"] == "no-store"
+        assert zipfile.ZipFile(io.BytesIO(raw)).namelist()
+    finally:
+        loop.run_until_complete(tc.close())
+        loop.close()
 
 
 # -------------------------------------------------- static naming contract

@@ -61,6 +61,12 @@ REQUIRED_FAMILIES = {
     "engine_device_step_seconds",
     "trace_spans_dropped_total",
     "timeline_ring_events_count",
+    "engine_device_flops_total",
+    "engine_device_bytes_total",
+    "engine_mfu_ratio",
+    "engine_hbm_bytes",
+    "device_hbm_used_bytes",
+    "process_rss_bytes",
 }
 
 _METRICS_MODULE = "localai_tfp_tpu/telemetry/metrics.py"

@@ -1,0 +1,106 @@
+"""Roofline profile: warmup-captured cost model on a live engine.
+
+Builds a tiny engine, runs the warmup pass (which AOT-captures every
+dispatch variant's XLA flops / bytes-accessed — telemetry/costmodel.py),
+drives a little real traffic, and prints one JSON report:
+
+  per-kind rows      — accounted FLOPs, bytes accessed, dispatch count,
+                       arithmetic intensity, compute- vs bandwidth-bound
+                       against the platform ridge point
+  mfu_ewma           — EWMA model-flops-utilization from flight spans
+  verdicts           — decode_bandwidth_bound / prefill_compute_bound:
+                       the physical shape the cost model must recover
+                       (decode re-reads the weights per token; batched
+                       prefill amortizes them over the bucket)
+
+Run:  python tools/profile_roofline.py [--requests N] [--max-tokens N]
+
+CPU smoke (what CI can afford):
+
+  python tools/profile_roofline.py --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_engine(n_slots=4, max_seq=128):
+    import jax
+    import jax.numpy as jnp
+
+    from localai_tfp_tpu.engine.engine import LLMEngine
+    from localai_tfp_tpu.engine.tokenizer import ByteTokenizer
+    from localai_tfp_tpu.models.llm_spec import tiny_spec
+    from localai_tfp_tpu.models.transformer import init_params
+
+    tk = ByteTokenizer()
+    spec = tiny_spec(vocab_size=tk.vocab_size, max_position=512)
+    params = init_params(jax.random.PRNGKey(0), spec, dtype=jnp.float32)
+    eng = LLMEngine(spec, params, tk, n_slots=n_slots, max_seq=max_seq,
+                    prefill_buckets=(8, 32, 128), cache_dtype=jnp.float32)
+    return eng, tk
+
+
+def run(requests: int, max_tokens: int) -> dict:
+    from localai_tfp_tpu.engine.engine import GenRequest
+
+    eng, tk = _build_engine()
+    try:
+        # warmup is where capture happens: every compiled variant's
+        # cost_analysis() lands in the table keyed by dispatch signature
+        eng.warmup()
+        # real traffic so the totals and the MFU EWMA have samples; a
+        # long prompt exercises the big prefill bucket, the decode tail
+        # exercises the per-token path
+        for i in range(requests):
+            ev = eng.generate(GenRequest(
+                prompt_ids=tk.encode(f"roofline probe {i} " * 4),
+                max_tokens=max_tokens, ignore_eos=True))
+            if ev.finish_reason not in ("length", "stop"):
+                raise SystemExit(
+                    f"probe request ended {ev.finish_reason!r} — the "
+                    "report below would have no dispatch traffic")
+        stats = eng.cost_stats()
+    finally:
+        eng.close()
+    if stats is None:
+        raise SystemExit("cost model disabled — set LOCALAI_COSTMODEL=on")
+
+    kinds = stats["kinds"]
+    decode = {k: v for k, v in kinds.items() if k.startswith("decode")}
+    prefill = {k: v for k, v in kinds.items()
+               if k.startswith("prefill") or k == "mixed"}
+    stats["verdicts"] = {
+        # decode must sit under the ridge (weights re-read per token)...
+        "decode_bandwidth_bound": bool(decode) and all(
+            v["bound"] == "bandwidth" for v in decode.values()),
+        # ...and batched prefill above it (weights amortized per bucket)
+        "prefill_compute_bound": bool(prefill) and any(
+            v["bound"] == "compute" for v in prefill.values()),
+    }
+    return stats
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=4,
+                    help="generate() calls after warmup")
+    ap.add_argument("--max-tokens", type=int, default=16,
+                    help="decode length per request")
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast CPU smoke settings (2 requests)")
+    args = ap.parse_args()
+    if args.smoke:
+        args.requests, args.max_tokens = 2, 8
+
+    print(json.dumps(run(args.requests, args.max_tokens), indent=2))
+
+
+if __name__ == "__main__":
+    main()
