@@ -621,6 +621,19 @@ def _costmodel_extra() -> dict:
     }
 
 
+def _cost_sched_extra() -> dict:
+    """Cost-model-driven-scheduling acceptance block (extra.cost_sched):
+    tools/profile_roofline.py's --mixed long-prompt flood at CPU smoke
+    size — ITL p99 + max inter-token gap with ms-budget scheduling
+    (LOCALAI_COST_SCHED=on + explicit LOCALAI_ITL_BUDGET_MS) vs the
+    token-budget baseline, plus the predicted-vs-measured device-time
+    geomean after EWMA warmup. Builds its own engines (one per leg),
+    so it is independent of the serving engine's lifecycle."""
+    from tools.profile_roofline import run_mixed
+
+    return run_mixed(smoke=True)
+
+
 def _lint_extra():
     """graftlint trajectory per release: rule count, findings, baseline
     size, interprocedural call-graph size, and graftsan (runtime
@@ -1411,6 +1424,7 @@ def main() -> None:
     extra["chaos"] = _chaos_extra()
     extra["tracing"] = _tracing_extra()
     extra["costmodel"] = _costmodel_extra()
+    extra["cost_sched"] = _cost_sched_extra()
     extra["lint"] = _lint_extra()
     extra["telemetry"] = REGISTRY.delta(tel_snap)
     print(json.dumps({

@@ -106,6 +106,18 @@ _knob("LOCALAI_KV_TIER_INFLIGHT_MB", "64", "float",
       "In-flight spill transfer window, in MiB.")
 
 # ------------------------------------------------------------ dispatch
+_knob("LOCALAI_PREFILL_GROUP_TOKENS", "8192", "int",
+      "Token budget per fused prefill/mixed dispatch — bounds the "
+      "[B, H, T, window] score materialization so big-bucket groups "
+      "cannot OOM at compile.")
+_knob("LOCALAI_COST_SCHED", "on", "flag",
+      "Cost-model-driven scheduling: predicted device time packs "
+      "dispatches and drives admission/deadline decisions; off "
+      "restores the pure token-budget scheduler.")
+_knob("LOCALAI_ITL_BUDGET_MS", "0", "float",
+      "Explicit inter-token-latency budget in ms: mixed/decode "
+      "dispatches are sized so their PREDICTED device time fits it "
+      "(0 = token-budget sizing only).")
 _knob("LOCALAI_WARMUP", "on", "flag",
       "Precompile the dispatch-variant set at model load (leader/"
       "single-host roles only).")

@@ -48,6 +48,7 @@ TPU-first re-design rather than translation:
 
 from __future__ import annotations
 
+import json
 import logging
 import queue
 import threading
@@ -626,7 +627,16 @@ class LLMEngine:
         # alternating-phase scheduler (the escape hatch). Forced off
         # when no prefill bucket fits the identity-batch token budget.
         self._mixed = knobs.flag("LOCALAI_MIXED_DISPATCH")
-        if not any(b * n_slots <= self._PREFILL_GROUP_TOKENS
+        # token budget per fused prefill/mixed dispatch: the XLA
+        # prefill attention materializes [B, H, T, window] f32 scores,
+        # so B*bucket must stay bounded or big-bucket groups OOM at
+        # compile (measured: a 64x2048 group at 1B/2048-ctx needs
+        # 34 GB of scores on a 16 GB chip). Read once at construction:
+        # the warmup variant set is sized from it, so a mid-life
+        # change would dispatch never-warmed shapes.
+        self._prefill_group_tokens = max(
+            1, knobs.int_("LOCALAI_PREFILL_GROUP_TOKENS"))
+        if not any(b * n_slots <= self._prefill_group_tokens
                    for b in self.prefill_buckets):
             self._mixed = False
         self._prefix_index = PrefixIndex()
@@ -1505,14 +1515,14 @@ class LLMEngine:
     @property
     def _mixed_buckets(self) -> tuple[int, ...]:
         """Prefill buckets whose identity-batch dispatch fits the
-        per-dispatch token budget (_PREFILL_GROUP_TOKENS): the mixed
-        step is always [n_slots, bucket], so n_slots*bucket bounds its
+        per-dispatch token budget (LOCALAI_PREFILL_GROUP_TOKENS): the
+        mixed step is always [n_slots, bucket], so n_slots*bucket bounds its
         device work — decode rows are admitted first (they cost one
         real token each) and the rest of the budget carries prefill
         chunk tokens, which is what bounds decode ITL under admission
         pressure."""
         return tuple(b for b in self.prefill_buckets
-                     if b * self.n_slots <= self._PREFILL_GROUP_TOKENS)
+                     if b * self.n_slots <= self._prefill_group_tokens)
 
     def _window_bucket(self, need: int) -> int:
         """Smallest power-of-two window >= need (floor 256, cap max_seq)."""
@@ -1520,6 +1530,67 @@ class LLMEngine:
         while w < need:
             w *= 2
         return min(w, self.max_seq)
+
+    def _itl_budget_ms(self) -> float:
+        """The explicit inter-token-latency budget cost scheduling
+        packs against, in ms; 0.0 when cost scheduling is off, the
+        cost model is absent, or no budget is set — every caller
+        treats 0.0 as 'token-budget sizing only'."""
+        if self._costmodel is None or not knobs.flag("LOCALAI_COST_SCHED"):
+            return 0.0
+        return max(0.0, knobs.float_("LOCALAI_ITL_BUDGET_MS"))
+
+    def _cost_sched_on(self) -> bool:
+        """Whether predictor-driven admission/deadline decisions are
+        active (independent of the ITL packing budget)."""
+        return (self._costmodel is not None
+                and knobs.flag("LOCALAI_COST_SCHED"))
+
+    def _mixed_window(self, prefilling: list, decoding: list,
+                      bucket: int) -> int:
+        """Context window the mixed dispatch for this composition and
+        bucket would select — EXACTLY the choice _enqueue_mixed makes
+        (ragged pins full width; otherwise the smallest compiled
+        window covering every advancing row), factored out so the
+        cost-packing pass can predict each candidate bucket's true
+        variant before any arrays are built."""
+        if self._ragged:
+            return self.max_seq
+        need_w = max(
+            [s.n_past + 1 for s in decoding]
+            + [s.n_past + min(s.n_prompt - s.n_past, bucket)
+               for s in prefilling]) + 1
+        window = self._window_bucket(need_w)
+        compiled = [k[1] for k in self._decode_k_fns
+                    if k[0] == "mixed" and window <= k[1]]
+        return min(compiled) if compiled else self.max_seq
+
+    def _cost_bucket(self, prefilling: list, decoding: list,
+                     cover: int, budget_ms: float) -> int:
+        """Predicted-device-time bucket choice for a mixed dispatch:
+        the largest candidate <= ``cover`` (the token-budget pick, so
+        cost packing only ever shrinks within the warmed variant set)
+        whose predicted device time fits ``budget_ms``. When every
+        predicted candidate exceeds the budget the smallest predicted
+        one dispatches anyway — progress beats stalling, and it is the
+        minimum-gap choice available. When NO candidate has a
+        prediction (variant never captured) the token-budget pick
+        stands."""
+        cm = self._costmodel
+        fit = smallest = None
+        for b in self._mixed_buckets:
+            if b > cover:
+                break
+            pred = cm.predict_ms(
+                "mixed", ("mixed", (self.n_slots, b),
+                          self._mixed_window(prefilling, decoding, b)))
+            if pred is None:
+                continue
+            if smallest is None:
+                smallest = b
+            if pred <= budget_ms:
+                fit = b  # ascending scan keeps the largest that fits
+        return fit or smallest or cover
 
     def _draft_prefill_fn(self):
         """Draft-model prefill (the draft cache must mirror the main
@@ -2251,13 +2322,36 @@ class LLMEngine:
         marker = self._warmup_marker_path()
         reuse_ok = knobs.flag("LOCALAI_WARMUP_REUSE")
         if marker is not None and reuse_ok and os.path.exists(marker):
-            self.warmup_reused = True
-            tm.ENGINE_WARMUP_SECONDS.labels(
-                model=self._mlabel, mode="reuse").set(
-                time.perf_counter() - t0)
-            log.info("warmup skipped: variant set %s already in the "
-                     "persistent compile cache", os.path.basename(marker))
-            return
+            # the capture pass rode the skipped warmup, so reload the
+            # cost rows the original warmup exported — same signature,
+            # same HLO set, same XLA cost rows. A marker without its
+            # sidecar (written before sidecars existed, or pruned) would
+            # leave the predictor blind for the whole process, so fall
+            # through to a full pass ONCE — under the populated compile
+            # cache that pass is trace + cache loads, and completing it
+            # rewrites marker + sidecar.
+            cm = self._costmodel
+            restored = -1
+            if cm is not None:
+                try:
+                    with open(marker + ".cost.json") as f:
+                        restored = cm.import_rows(json.load(f))
+                except (OSError, ValueError):
+                    restored = -1
+            if cm is None or restored >= 0:
+                self.warmup_reused = True
+                if restored > 0:
+                    log.info("warmup reuse: %d cost rows restored",
+                             restored)
+                tm.ENGINE_WARMUP_SECONDS.labels(
+                    model=self._mlabel, mode="reuse").set(
+                    time.perf_counter() - t0)
+                log.info("warmup skipped: variant set %s already in "
+                         "the persistent compile cache",
+                         os.path.basename(marker))
+                return
+            log.info("warmup reuse declined: cost sidecar missing for "
+                     "%s — re-capturing", os.path.basename(marker))
         n_variants = 0
 
         def _warm(kind, payload):
@@ -2293,7 +2387,7 @@ class LLMEngine:
             win_ladder.append(self.max_seq)
         for bucket in self.prefill_buckets:
             id_capable = (bucket * self.n_slots
-                          <= self._PREFILL_GROUP_TOKENS)
+                          <= self._prefill_group_tokens)
             # (B, window, identity) variants matching _enqueue's split:
             # bursts -> ONE identity shape per live-context window (no
             # (window, bucket) shape can cold-compile mid-request);
@@ -2476,6 +2570,15 @@ class LLMEngine:
             # exact signature skips the whole pass (best effort: losing
             # the marker only costs the speedup)
             try:
+                # cost rows first: a marker without its sidecar would
+                # reuse-skip future warmups with no way to restore the
+                # predictor's cost table
+                cm = self._costmodel
+                if cm is not None:
+                    rows = cm.export_rows()
+                    if rows:
+                        with open(marker + ".cost.json", "w") as f:
+                            json.dump(rows, f)
                 with open(marker, "w") as f:
                     f.write("ok")
             except OSError:
@@ -2595,14 +2698,45 @@ class LLMEngine:
     _CANCEL_TTL_S = 300.0  # unmatched cancel ids expire (leak bound)
 
     def _retry_after_s(self) -> float:
-        """Suggested client backoff for a shed request: roughly the p90
-        of recently observed admission queue waits, clamped to a sane
-        window. Caller holds self._lock."""
+        """Suggested client backoff for a shed request. With cost
+        scheduling on, the PREDICTED drain time of the actual queue
+        contents (prompt lengths and token budgets the predictor can
+        cost) — a hint that tracks what is really queued instead of
+        what recently happened. Falls back to the p90 of recently
+        observed admission queue waits when the predictor has nothing,
+        both clamped to the same sane window. Caller holds self._lock."""
+        drain = self._predicted_drain_s()
+        if drain is not None:
+            return drain
         ws = sorted(self._queue_waits)
         if not ws:
             return 1.0
         p90 = ws[min(len(ws) - 1, int(0.9 * len(ws)))]
         return min(30.0, max(0.5, p90))
+
+    def _predicted_drain_s(self) -> Optional[float]:
+        """Predicted seconds until the CURRENT queue drains: per queued
+        request, predicted prefill (per-token rate x prompt length)
+        plus predicted decode (per-step rate x token budget), spread
+        across the slots, clamped to the Retry-After window. None when
+        cost scheduling is off or the predictor has no rates yet (the
+        caller falls back to historical p90). Caller holds self._lock."""
+        if not self._cost_sched_on():
+            return None
+        cm = self._costmodel
+        tok_ms = cm.prefill_token_ms()
+        step_ms = (self._step_ms if self._step_ms > 0.0
+                   else cm.decode_step_ms())
+        if tok_ms is None and step_ms is None:
+            return None
+        total_ms = 0.0
+        for req, _ in self._pending:
+            if tok_ms is not None:
+                total_ms += tok_ms * len(req.prompt_ids)
+            if step_ms is not None:
+                total_ms += step_ms * max(0, req.max_tokens)
+        return min(30.0, max(0.5, total_ms / 1e3
+                             / max(1, self.n_slots)))
 
     def _purge_expired_cancels(self, now: float) -> int:
         """Drop race-ahead cancel ids older than _CANCEL_TTL_S; returns
@@ -2661,12 +2795,24 @@ class LLMEngine:
         """Terminate requests whose deadline has passed: queued ones get
         an immediate terminal event (no slot was ever held), decoding
         ones finish through the normal slot path with whatever partial
-        text they produced. Gated on the sticky _deadlines_armed flag so
-        deadline-free serving skips the sweep entirely."""
+        text they produced. With cost scheduling on, queued requests
+        whose PREDICTED completion already exceeds their deadline are
+        rejected early (stage="queued_predicted") instead of burning
+        prefill on work that cannot land in time. Gated on the sticky
+        _deadlines_armed flag so deadline-free serving skips the sweep
+        entirely."""
         if not self._deadlines_armed:
             return
         now = time.perf_counter()
-        expired: list[str] = []
+        expired: list[tuple[str, str]] = []  # (request id, stage)
+        # predicted-completion rejection: with cost scheduling on, a
+        # queued request whose PREDICTED first token already falls past
+        # its deadline is rejected now instead of wasting prefill on it.
+        # The prediction is the optimistic bound (prefill alone, as if
+        # a slot were free this instant), so a request this rejects
+        # could never have produced a token in time.
+        tok_ms = (self._costmodel.prefill_token_ms()
+                  if self._cost_sched_on() else None)
         with self._lock:
             still = []
             for req, out in self._pending:
@@ -2675,19 +2821,28 @@ class LLMEngine:
                     out.put(StreamEvent(
                         done=True, finish_reason="deadline_exceeded",
                         error="deadline exceeded while queued"))
-                    expired.append(req.id)
+                    expired.append((req.id, "queued"))
+                elif (req.deadline and tok_ms is not None
+                      and now + tok_ms * len(req.prompt_ids) / 1e3
+                      >= req.deadline):
+                    self._deferred.pop(req.id, None)
+                    out.put(StreamEvent(
+                        done=True, finish_reason="deadline_exceeded",
+                        error="predicted completion exceeds deadline "
+                              "(prefill alone overruns it)"))
+                    expired.append((req.id, "queued_predicted"))
                 else:
                     still.append((req, out))
             self._pending = still
-        for rid in expired:
+        for rid, stage in expired:
             TRACER.event(rid, "done")
             TRACER.annotate(rid, "terminal", outcome="deadline_exceeded",
-                            stage="queued")
+                            stage=stage)
             TRACER.finish(rid, status="deadline_exceeded")
             tm.ENGINE_REQUESTS.labels(model=self._mlabel,
                                       reason="deadline_exceeded").inc()
             tm.ENGINE_DEADLINE_EXCEEDED.labels(
-                model=self._mlabel, stage="queued").inc()
+                model=self._mlabel, stage=stage).inc()
         hit = [s for s in self.slots
                if s.active and s.request is not None
                and s.request.deadline and now >= s.request.deadline]
@@ -3000,13 +3155,21 @@ class LLMEngine:
             dur = time.perf_counter() - fl.t_enqueue
             tm.ENGINE_DEVICE_STEP.labels(
                 model=self._mlabel, kind=fl.kind).observe(dur)
+            rec = fl.meta.get("rec")
+            pred = fl.meta.get("pred_ms")
+            if pred is not None and rec is not None:
+                # predicted-vs-measured rides the timeline span, so
+                # Perfetto shows calibration error per dispatch
+                rec = dict(rec, predicted_ms=round(pred, 3),
+                           measured_ms=round(dur * 1e3, 3))
             FLIGHT.span("step:" + fl.kind, "device", fl.t_enqueue, dur,
-                        fl.meta.get("rec"))
+                        rec)
             if self._costmodel is not None:
-                # cost accounting + MFU sample against the flight's
-                # span — host dict math on already-harvested scalars
+                # cost accounting + MFU sample + predictor calibration
+                # against the flight's span — host dict math on
+                # already-harvested scalars
                 self._costmodel.on_harvest(
-                    fl.kind, fl.meta.get("cost"), dur)
+                    fl.kind, fl.meta.get("cost"), dur, predicted_ms=pred)
             if fl.kind == "prefill_final":
                 self._complete_prefill_final(fl)
             elif fl.kind == "mixed":
@@ -3605,12 +3768,6 @@ class LLMEngine:
     def _group_cap(self) -> int:
         return min(64, max(self.n_slots, 1))
 
-    # token budget per fused prefill dispatch: the XLA prefill attention
-    # materializes [B, H, T, window] f32 scores, so B*bucket must stay
-    # bounded or big-bucket groups OOM at compile (measured: a 64x2048
-    # group at 1B/2048-ctx needs 34 GB of scores on a 16 GB chip)
-    _PREFILL_GROUP_TOKENS = 8192
-
     @property
     def _half_k(self) -> int:
         """The half-length scan the steady-state arrival clamp snaps to:
@@ -3680,7 +3837,7 @@ class LLMEngine:
 
     def _prefill_group_cap(self, bucket: int) -> int:
         return max(1, min(self._group_cap,
-                          self._PREFILL_GROUP_TOKENS // max(bucket, 1)))
+                          self._prefill_group_tokens // max(bucket, 1)))
 
     # lint: region hot_path
     def _enqueue_prefill_final(self, group: list[_Slot],
@@ -3739,7 +3896,7 @@ class LLMEngine:
         # a fraction of the attention/sampler traffic. Split by group
         # size at the largest warmed legacy shape: trickles stay small,
         # a group reaching it is a genuine burst and goes identity.
-        identity = (bucket * self.n_slots <= self._PREFILL_GROUP_TOKENS
+        identity = (bucket * self.n_slots <= self._prefill_group_tokens
                     and len(group) >= self._legacy_prefill_max)
         if identity:
             B = self.n_slots
@@ -3839,12 +3996,16 @@ class LLMEngine:
         tm.ENGINE_MIXED_DISPATCH.labels(
             model=self._mlabel, composition="prefill_only").inc()
         self._note_ragged_rows("final", len(group))
+        ckey = costmodel.dispatch_key("prefill_final", payload)
         self._flights.append(_Flight(
             kind="prefill_final", arrays=[toks_out],
             meta={"pairs": [(s, s.request) for s in group], "rows": rows,
                   # cost-model variant key: accounted at harvest, where
                   # the flight's span is known
-                  "cost": costmodel.dispatch_key("prefill_final", payload),
+                  "cost": ckey,
+                  "pred_ms": (self._costmodel.predict_ms(
+                      "prefill_final", ckey)
+                      if self._costmodel is not None else None),
                   # timeline args for the flight recorder's harvest span
                   "rec": {"rows": len(group), "bucket": bucket,
                           "window": window}},
@@ -3893,13 +4054,24 @@ class LLMEngine:
         """Enqueue ONE fused mixed prefill+decode step (_mixed_fn).
 
         Budget policy: the dispatch is always [n_slots, bucket], so the
-        per-dispatch token budget (_PREFILL_GROUP_TOKENS) bounds the
+        per-dispatch token budget (LOCALAI_PREFILL_GROUP_TOKENS) bounds
         bucket to _mixed_buckets. Decode rows ride every dispatch (one
         token each — decode priority, so their inter-token gap is
         bounded by one budget's worth of device work); the bucket then
         grows just enough to cover the largest remaining prompt, capped
         by the budget — rows whose remainder exceeds it take a
         bucket-wide non-final chunk and continue next dispatch.
+
+        Cost scheduling (LOCALAI_COST_SCHED + LOCALAI_ITL_BUDGET_MS):
+        when decode rows are riding and an explicit ITL budget is set,
+        the bucket is instead the LARGEST candidate whose PREDICTED
+        device time (costmodel.predict_ms over the exact variant this
+        composition would dispatch) fits the budget — the token budget
+        stays as the cap (candidates never exceed the warmed variant
+        set) and as the fallback when no candidate has a prediction.
+        Under a long-prompt flood this shrinks the chunk below the
+        token-budget choice, bounding decode ITL in milliseconds
+        instead of tokens.
 
         Prefill bookkeeping (n_past/cache_tokens) advances HERE, like
         _enqueue_prefill_final: device execution order equals enqueue
@@ -3929,6 +4101,14 @@ class LLMEngine:
         need = min(max(s.n_prompt - s.n_past for s in prefilling),
                    buckets[-1])
         bucket = next(b for b in buckets if b >= need)
+        budget_ms = self._itl_budget_ms()
+        if budget_ms > 0.0 and decoding:
+            # ms-budget packing: decode rows ride regardless (their
+            # cost is inside every candidate's prediction); the bucket
+            # shrinks until the whole composition's predicted device
+            # time fits the ITL budget
+            bucket = self._cost_bucket(prefilling, decoding, bucket,
+                                       budget_ms)
         toks = np.zeros((S, bucket), np.int32)
         pos0 = np.zeros((S,), np.int32)
         n_chunk = np.ones((S,), np.int32)
@@ -3973,17 +4153,12 @@ class LLMEngine:
         # write_mask False is a pure no-op — their resident prefixes
         # survive untouched (no tail clamping, unlike the decode scan)
         masks = self._constraint_mask_rows(self.slots)
-        if self._ragged:
-            # one full-width variant per bucket; the kernel's page walk
-            # (or the fallback's full-width gather) is ragged already
-            window = self.max_seq
-        else:
-            need_w = max(int(pos0[i]) + int(n_chunk[i])
-                         for i in range(S) if write_mask[i]) + 1
-            window = self._window_bucket(need_w)
-            compiled = [k[1] for k in self._decode_k_fns
-                        if k[0] == "mixed" and window <= k[1]]
-            window = min(compiled) if compiled else self.max_seq
+        # ragged pins full width (the kernel's page walk — or the
+        # fallback's full-width gather — is ragged already); otherwise
+        # the smallest compiled window covering every advancing row.
+        # Shared with the cost-packing candidate scan above, so the
+        # predicted variant is the dispatched variant.
+        window = self._mixed_window(prefilling, decoding, bucket)
         payload = {
             "toks": toks, "pos0": pos0, "n_chunk": n_chunk,
             "write_mask": write_mask, "sample_sids": sample_sids,
@@ -4035,10 +4210,13 @@ class LLMEngine:
         self._note_ragged_rows("prefill", len(prefilling) - len(finals))
         if decoding:
             self._note_decode_advance(t_disp)
+        ckey = costmodel.dispatch_key("mixed", payload)
         self._flights.append(_Flight(
             kind="mixed", arrays=[toks_out],
             meta={"rows": rows, "chunk_tokens": chunk_tokens,
-                  "cost": costmodel.dispatch_key("mixed", payload),
+                  "cost": ckey,
+                  "pred_ms": (self._costmodel.predict_ms("mixed", ckey)
+                              if self._costmodel is not None else None),
                   # timeline args for the flight recorder's harvest span
                   "rec": {"decode": len(decoding),
                           "prefill": len(prefilling) - len(finals),
@@ -4398,6 +4576,24 @@ class LLMEngine:
             # _latency_k for the balanced/latency-mode policies and
             # their measured effect).
             k = min(k, self._latency_k(lat_mode))
+        itl_budget = self._itl_budget_ms()
+        if itl_budget > 0.0:
+            # explicit ms ITL budget: a k-scan's tokens surface only at
+            # harvest, so the scan's whole device time IS the stream's
+            # inter-token gap — clamp k to the largest warmed length
+            # whose predicted time fits. Per-step time comes from the
+            # measured EWMA when it has samples, else the cost-model
+            # prediction (the fallback-before-warm contract); floor at
+            # the smallest warmed multi-step scan: progress beats
+            # stalling even over budget.
+            step = (self._step_ms if self._step_ms > 0.0
+                    else (self._costmodel.decode_step_ms() or 0.0))
+            if step > 0.0:
+                fits = [kk for kk in self._warm_ks
+                        if kk > 1 and kk * step <= itl_budget]
+                kb = (max(fits) if fits
+                      else min(kk for kk in self._warm_ks if kk > 1))
+                k = min(k, kb)
 
         S = self.n_slots
         if self._use_kernel or self._ragged:
@@ -4490,11 +4686,14 @@ class LLMEngine:
             pass  # not all backends expose it; harvest still works
         self._dev_epoch = self._epoch
         self._dev_akey = akey
+        dckey = costmodel.dispatch_key("decodek", payload)
         self._flights.append(_Flight(
             kind="decodek", arrays=[toks],
             meta={
                 "k": k,
-                "cost": costmodel.dispatch_key("decodek", payload),
+                "cost": dckey,
+                "pred_ms": (self._costmodel.predict_ms("decodek", dckey)
+                            if self._costmodel is not None else None),
                 "pairs": [(s, s.request) for s in decoding],
                 # None for a chained scan: its predecessor's last tokens
                 # are unknown until that flight harvests (_harvest_last)

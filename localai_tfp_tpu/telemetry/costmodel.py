@@ -24,6 +24,27 @@ comparing its arithmetic intensity (flops / bytes accessed) against the
 machine balance point ``peak_flops / peak_bw``; peaks come from a
 built-in per-platform table overridable via ``LOCALAI_PEAK_FLOPS`` /
 ``LOCALAI_PEAK_HBM_GBS``.
+
+``predict_ms()`` turns the same table into a per-dispatch DEVICE-TIME
+predictor, which is what cost-model-driven scheduling
+(``LOCALAI_COST_SCHED`` + ``LOCALAI_ITL_BUDGET_MS``) packs against:
+
+    analytic_ms = max(flops / peak_flops, bytes / peak_bw) / n_dev
+    predicted   = analytic_ms * calibration_ewma[kind]
+
+The analytic term is the roofline lower bound (whichever of compute or
+bandwidth dominates); the calibration EWMA is the measured span /
+analytic ratio folded at every flight harvest, so the predictor
+absorbs dispatch RTT, achievable-fraction-of-peak, and kernel quality.
+Calibration is two-level: a variant that has harvested predicts from
+its OWN ratio EWMA (each variant's fixed overhead differs), cold
+variants borrow the kind-level EWMA once it has
+``_CALIB_MIN_SAMPLES`` harvests, and before that predictions fall back
+to the bare analytic bound; variants never captured predict ``None``
+and callers fall back to the token-budget heuristic. Harvests that carried
+a prediction also feed ``engine_dispatch_predicted_seconds`` and the
+``engine_dispatch_predicted_ratio`` (predicted / measured) histograms,
+so calibration drift is observable on /metrics and in Perfetto.
 """
 
 from __future__ import annotations
@@ -56,6 +77,18 @@ _PEAK_TABLE: dict[str, tuple[float, float]] = {
 }
 
 _EWMA_ALPHA = 0.2
+
+# calibration harvests of a kind before predict_ms() trusts its EWMA;
+# below it the bare analytic roofline bound is the prediction (a cold
+# EWMA from one outlier span would poison every early prediction)
+_CALIB_MIN_SAMPLES = 3
+
+# winsorization bound for calibration samples: measured spans include
+# host-side noise (scheduler preemption can turn a 0.3 ms dispatch into
+# a 6 ms span), and one 20x outlier shifts an alpha-0.2 EWMA by 4x —
+# clip each sample to within this factor of the trusted estimate so a
+# spike nudges the EWMA instead of poisoning it
+_CALIB_CLIP = 4.0
 
 
 def peak_rates(platform: str) -> tuple[float, float]:
@@ -146,6 +179,15 @@ class CostModel:
         self._totals: dict[str, list[float]] = {}
         self._mfu: Optional[float] = None  # EWMA, None until 1st sample
         self._mfu_samples = 0
+        # kind -> [measured/analytic EWMA, samples] — the per-kind
+        # calibration predict_ms() multiplies onto the analytic bound
+        self._calib: dict[str, list] = {}
+        # (kind, sig) -> [measured/analytic EWMA, samples] — per-
+        # variant refinement: each variant's fixed dispatch overhead
+        # differs (a tiny bucket's span is mostly RTT, a big one's
+        # mostly compute), so a variant that has harvested predicts
+        # from its own ratio and only cold variants borrow the kind's
+        self._calib_var: dict[tuple, list] = {}
 
     # ------------------------------------------------------- capture
 
@@ -172,6 +214,35 @@ class CostModel:
     def captured(self) -> dict[tuple, tuple[float, float]]:
         with self._lock:
             return dict(self._table)
+
+    def export_rows(self) -> dict[str, tuple[float, float]]:
+        """JSON-serializable snapshot of the captured cost table.
+        Dispatch keys are tuples of primitives, so ``repr`` round-trips
+        through ``ast.literal_eval`` in :meth:`import_rows`."""
+        with self._lock:
+            return {repr(k): v for k, v in self._table.items()}
+
+    def import_rows(self, rows: dict) -> int:
+        """Load previously exported cost rows (the warmup-reuse path:
+        an identical warmup signature means the variant set — and hence
+        each variant's XLA cost row — is identical, so the sidecar
+        written by the engine that DID warm up stands in for a fresh
+        capture pass). Existing rows win; returns rows added."""
+        import ast
+
+        added = 0
+        with self._lock:
+            for rk, v in rows.items():
+                try:
+                    key = ast.literal_eval(rk)
+                    flops, by = float(v[0]), float(v[1])
+                except (ValueError, SyntaxError, TypeError, IndexError):
+                    continue
+                if not isinstance(key, tuple) or key in self._table:
+                    continue
+                self._table[key] = (flops, by)
+                added += 1
+        return added
 
     # ---------------------------------------------------- accounting
 
@@ -205,14 +276,20 @@ class CostModel:
         self._account(kind, key)
 
     def on_harvest(self, kind: str, key: Optional[tuple],
-                   span_s: float) -> None:
+                   span_s: float,
+                   predicted_ms: Optional[float] = None) -> None:
         """Account a harvested flight and fold an MFU sample into the
-        EWMA (the flight's enqueue-to-ready span is the denominator)."""
+        EWMA (the flight's enqueue-to-ready span is the denominator).
+        The measured span also calibrates the device-time predictor for
+        this kind, and when the dispatch carried a prediction the
+        predicted-vs-measured pair lands on the two observability
+        histograms."""
         flops = self._account(kind, key)
         if flops <= 0.0 or span_s <= 0.0:
             return
         peak_flops, _ = peak_rates(self.platform)
         sample = min(1.0, flops / (span_s * peak_flops * self.n_devices))
+        span_ms = span_s * 1e3
         with self._lock:
             if self._mfu is None:
                 self._mfu = sample
@@ -220,9 +297,125 @@ class CostModel:
                 self._mfu += _EWMA_ALPHA * (sample - self._mfu)
             self._mfu_samples += 1
             mfu = self._mfu
+            # calibration: measured span / analytic roofline bound,
+            # per kind — warmup pads never calibrate (their spans
+            # include compile time)
+            if not self.capturing:
+                base = self._analytic_ms_locked(key)
+                if base is not None and base > 0.0:
+                    ratio = span_ms / base
+                    kc = self._calib.get(kind)
+                    anchor = (kc[0] if kc is not None
+                              and kc[1] >= _CALIB_MIN_SAMPLES else None)
+                    for table, ck in ((self._calib, kind),
+                                      (self._calib_var, key)):
+                        c = table.get(ck)
+                        # winsorize against this entry's own trusted
+                        # EWMA, else the kind's (a variant's FIRST
+                        # sample landing on a spike would otherwise
+                        # seed its whole refinement history)
+                        ref = (c[0] if c is not None and c[1] >= 2
+                               else anchor)
+                        r = ratio if ref is None else min(
+                            max(ratio, ref / _CALIB_CLIP),
+                            ref * _CALIB_CLIP)
+                        if c is None:
+                            table[ck] = [r, 1]
+                        else:
+                            c[0] += _EWMA_ALPHA * (r - c[0])
+                            c[1] += 1
         from . import metrics as tm
 
         tm.ENGINE_MFU.labels(model=self.model).set(mfu)
+        if predicted_ms is not None and predicted_ms > 0.0:
+            tm.ENGINE_DISPATCH_PREDICTED.labels(
+                model=self.model, kind=kind).observe(predicted_ms / 1e3)
+            tm.ENGINE_DISPATCH_PREDICTED_RATIO.labels(
+                model=self.model, kind=kind).observe(
+                    predicted_ms / span_ms)
+
+    # ------------------------------------------------------ prediction
+
+    def _analytic_ms_locked(self, key: Optional[tuple]
+                            ) -> Optional[float]:
+        """Roofline lower bound on device ms for one dispatch of
+        ``key``: whichever of the compute or bandwidth terms dominates,
+        spread across the mesh. None when the variant was never
+        captured. Caller holds self._lock."""
+        if key is None:
+            return None
+        row = self._table.get(key)
+        if row is None:
+            return None
+        flops, by = row
+        peak_flops, peak_bw = peak_rates(self.platform)
+        t_s = max(flops / (peak_flops * self.n_devices),
+                  by / (peak_bw * self.n_devices))
+        return t_s * 1e3 if t_s > 0.0 else None
+
+    def predict_ms(self, kind: str, key: Optional[tuple]
+                   ) -> Optional[float]:
+        """Predicted device-time (wall ms, enqueue to ready) for one
+        dispatch of variant ``key``: the analytic roofline bound scaled
+        by the variant's own calibration EWMA once it has harvested,
+        else the kind-level EWMA once it has ``_CALIB_MIN_SAMPLES``
+        harvests, else the bare analytic bound; ``None`` for a
+        never-captured variant (callers fall back to the token-budget
+        heuristic)."""
+        with self._lock:
+            base = self._analytic_ms_locked(key)
+            if base is None:
+                return None
+            cv = self._calib_var.get(key)
+            if cv is not None and cv[1] >= 2:
+                return base * cv[0]
+            c = self._calib.get(kind)
+            if c is not None and c[1] >= _CALIB_MIN_SAMPLES:
+                return base * c[0]
+        return base
+
+    def decode_step_ms(self) -> Optional[float]:
+        """Predicted per-token decode ms: the cheapest captured decodek
+        variant amortized over its scan length. None until a decodek
+        variant is captured. Feeds queue-drain prediction when the
+        engine's measured step EWMA has no samples yet."""
+        with self._lock:
+            keys = [k for k in self._table if k[0] == "decodek"]
+        best: Optional[float] = None
+        for key in keys:
+            p = self.predict_ms("decodek", key)
+            if p is None:
+                continue
+            per = p / max(1, int(key[1]))
+            if best is None or per < best:
+                best = per
+        return best
+
+    def prefill_token_ms(self) -> Optional[float]:
+        """Predicted per-token prefill ms: the best (most amortized)
+        captured prefill-shaped variant divided by its token capacity.
+        Optimistic by construction — queue-drain and queued-deadline
+        predictions built on it under-reject rather than over-reject."""
+        with self._lock:
+            keys = list(self._table)
+        best: Optional[float] = None
+        for key in keys:
+            kind = key[0]
+            if kind == "prefill_final":
+                tokens = int(key[1]) * int(key[2])
+            elif kind == "mixed":
+                tokens = int(key[1][0]) * int(key[1][1])
+            elif kind == "prefill":
+                tokens = int(key[1])
+            else:
+                continue
+            p = self.predict_ms(kind, key)
+            if p is None or tokens <= 0:
+                continue
+            per = p / tokens
+            if best is None or per < best:
+                best = per
+        return best
 
     # ------------------------------------------------------ summaries
 
@@ -269,6 +462,11 @@ class CostModel:
             mfu = self._mfu
             samples = self._mfu_samples
             variants = len(self._table)
+            calib = {k: {"ewma": round(c[0], 4), "samples": int(c[1]),
+                         "warm": c[1] >= _CALIB_MIN_SAMPLES}
+                     for k, c in sorted(self._calib.items())}
+            variants_calibrated = sum(
+                1 for c in self._calib_var.values() if c[1] >= 2)
         return {
             "platform": self.platform,
             "n_devices": self.n_devices,
@@ -279,5 +477,12 @@ class CostModel:
             "mfu_ewma": round(mfu, 6) if mfu is not None else None,
             "mfu_samples": samples,
             "variants_captured": variants,
+            # device-time predictor state: per-kind calibration EWMAs
+            # plus the derived per-token rates the admission/deadline
+            # predictions run on
+            "calibration": calib,
+            "variants_calibrated": variants_calibrated,
+            "predicted_decode_step_ms": self.decode_step_ms(),
+            "predicted_prefill_token_ms": self.prefill_token_ms(),
             "kinds": self.roofline(),
         }

@@ -1,9 +1,12 @@
 """Scheduler/device flight recorder: a bounded, lock-cheap timeline ring.
 
 Samples what the serving stack actually DID over time — per-dispatch
-device-flight spans (kind, composition, token counts), scheduler-state
-counters (queue depth, busy slots, KV pool occupancy), follower replay
-spans, and point events — and exports them as Chrome-trace JSON
+device-flight spans (kind, composition, token counts, and — when the
+cost-model predictor priced the dispatch — ``predicted_ms`` /
+``measured_ms``, so per-dispatch calibration error reads directly off
+the Perfetto args pane), scheduler-state counters (queue depth, busy
+slots, KV pool occupancy), follower replay spans, and point events —
+and exports them as Chrome-trace JSON
 (``GET /debug/timeline``) that loads directly into Perfetto
 (https://ui.perfetto.dev) or chrome://tracing. Offline rendering:
 tools/trace_viewer.py.
@@ -76,7 +79,10 @@ class FlightRecorder:
     def span(self, name: str, track: str, t0: float, dur_s: float,
              args: Optional[dict] = None) -> None:
         """A complete interval (Chrome-trace "X"): host-measured start
-        and duration, e.g. a device flight from enqueue to ready."""
+        and duration, e.g. a device flight from enqueue to ready.
+        ``args`` is caller-owned scalars only — the harvest path adds
+        ``predicted_ms``/``measured_ms`` to step spans it has a
+        prediction for, never anything requiring device work."""
         self.record("X", name, track, t0, dur_s, args)
 
     def instant(self, name: str, track: str,

@@ -338,6 +338,22 @@ ENGINE_MFU = REGISTRY.gauge(
     "flight divided by (device-step span x peak FLOPs across the mesh)",
     labels=("model",),
 )
+ENGINE_DISPATCH_PREDICTED = REGISTRY.histogram(
+    "engine_dispatch_predicted_seconds",
+    "Predicted device time per dispatch from the cost-model device-"
+    "time predictor (telemetry/costmodel.py predict_ms) — observed at "
+    "harvest next to engine_device_step_seconds, so the two "
+    "distributions overlay on one dashboard",
+    labels=("model", "kind"), buckets=_STEP_BUCKETS,
+)
+ENGINE_DISPATCH_PREDICTED_RATIO = REGISTRY.histogram(
+    "engine_dispatch_predicted_ratio",
+    "Predicted / measured device time per harvested dispatch — the "
+    "predictor's live calibration error (1.0 = perfect; drift away "
+    "from 1 means the per-kind calibration EWMA is stale)",
+    labels=("model", "kind"),
+    buckets=(0.125, 0.25, 0.5, 0.8, 1.0, 1.25, 2.0, 4.0, 8.0),
+)
 ENGINE_HBM_BYTES = REGISTRY.gauge(
     "engine_hbm_bytes",
     "Component-level HBM ledger (telemetry/hbm_ledger.py): bytes "

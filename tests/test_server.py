@@ -693,6 +693,80 @@ def test_queue_flood_sheds_429_with_retry_after(client):
     assert statuses == [200] * 8
 
 
+def test_retry_after_hint_predicted_and_p90(client, monkeypatch):
+    """Retry-After comes from the predicted drain of the ACTUAL queue
+    contents when cost scheduling is on (per-token prefill rate from
+    the cost model x what is really queued), and from the p90 of
+    recently observed queue waits when it is off."""
+    import queue as _q
+
+    from localai_tfp_tpu.engine.engine import GenRequest
+
+    # warm/load so the engine (and its captured cost model) exists
+    r = client.post("/v1/completions", json={
+        "model": "tiny", "prompt": "warm", "max_tokens": 1,
+        "ignore_eos": True})
+    assert r.status == 200
+    eng = _tiny_engine(client)
+    cm = eng._costmodel
+    assert cm is not None
+    monkeypatch.delenv("LOCALAI_PEAK_FLOPS", raising=False)
+    monkeypatch.delenv("LOCALAI_PEAK_HBM_GBS", raising=False)
+    monkeypatch.setenv("LOCALAI_COST_SCHED", "on")
+
+    # --- predicted path: swap in a single synthetic prefill row with
+    # a known rate. CPU peaks are (50e9 FLOP/s, 50e9 B/s); flops=5e10
+    # over 1000 tokens => 1000 ms/dispatch => exactly 1 ms/token.
+    fake_key = ("prefill", 1000, None, False)
+    n_dev = cm.n_devices
+    fakes = [GenRequest(prompt_ids=[0] * (2000 * eng.n_slots * n_dev),
+                        max_tokens=0),
+             GenRequest(prompt_ids=[0] * (2000 * eng.n_slots * n_dev),
+                        max_tokens=0)]
+    with eng._lock:
+        saved_pending = eng._pending
+        with cm._lock:
+            saved_table, saved_var, saved_kind = (
+                cm._table, cm._calib_var, cm._calib)
+            cm._table = {fake_key: (5e10, 0.0)}
+            cm._calib_var, cm._calib = {}, {}
+        eng._pending = saved_pending + [
+            (rq, _q.SimpleQueue()) for rq in fakes]
+        try:
+            hint = eng._retry_after_s()
+        finally:
+            eng._pending = saved_pending
+            with cm._lock:
+                cm._table, cm._calib_var, cm._calib = (
+                    saved_table, saved_var, saved_kind)
+    # the analytic bound spreads over n_devices (1/n_dev ms/token), so
+    # 2 x 2000*n_slots*n_dev tokens / 1e3 / n_slots = 4.0 s exactly
+    assert hint == pytest.approx(4.0, rel=1e-6)
+
+    # --- fallback path: knob off => predictor is bypassed, the hint is
+    # the p90 of the observed queue-wait window
+    monkeypatch.setenv("LOCALAI_COST_SCHED", "off")
+    with eng._lock:
+        saved_waits = list(eng._queue_waits)
+        eng._queue_waits.clear()
+        eng._queue_waits.extend([0.6] * 9 + [7.0])
+        try:
+            hint_off = eng._retry_after_s()
+        finally:
+            eng._queue_waits.clear()
+            eng._queue_waits.extend(saved_waits)
+    assert hint_off == pytest.approx(7.0)
+    # and with no history at all the hint is the 1s default
+    with eng._lock:
+        saved_waits = list(eng._queue_waits)
+        eng._queue_waits.clear()
+        try:
+            hint_cold = eng._retry_after_s()
+        finally:
+            eng._queue_waits.extend(saved_waits)
+    assert hint_cold == pytest.approx(1.0)
+
+
 def test_streaming_shed_is_429_before_headers(client):
     """The eager-submit probe turns a shed into a real 429 BEFORE the
     SSE headers go out — not a 200 that dies mid-stream."""

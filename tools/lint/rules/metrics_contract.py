@@ -6,8 +6,8 @@ wraps this rule). Every literal registry registration
 
 - be snake_case,
 - carry a unit suffix (counters ``_total``; histograms ``_seconds`` /
-  ``_bytes``; gauges ``_seconds``/``_bytes``/``_count``/``_ratio``/
-  ``_info``, or a ``<unit>_per_<x>`` rate),
+  ``_bytes``/``_ratio``; gauges ``_seconds``/``_bytes``/``_count``/
+  ``_ratio``/``_info``, or a ``<unit>_per_<x>`` rate),
 - appear as `` `name` `` in the README Observability table, and
 - a computed (non-literal) name is itself a finding: it can be neither
   linted nor documented.
@@ -30,7 +30,7 @@ _SNAKE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)*$")
 
 SUFFIXES = {
     "counter": ("_total",),
-    "histogram": ("_seconds", "_bytes"),
+    "histogram": ("_seconds", "_bytes", "_ratio"),
     "gauge": ("_seconds", "_bytes", "_count", "_ratio", "_info"),
 }
 
@@ -64,6 +64,8 @@ REQUIRED_FAMILIES = {
     "engine_device_flops_total",
     "engine_device_bytes_total",
     "engine_mfu_ratio",
+    "engine_dispatch_predicted_seconds",
+    "engine_dispatch_predicted_ratio",
     "engine_hbm_bytes",
     "device_hbm_used_bytes",
     "process_rss_bytes",
