@@ -506,6 +506,24 @@ def _chaos_extra() -> dict:
     return out
 
 
+def _fleet_extra() -> dict:
+    """Fleet-telemetry acceptance block (extra.fleet): the
+    profile_fleet smoke — N real member subprocesses behind an
+    in-process balancer. Tracks the digest-plane contracts: fleet p95
+    TTFT from merged digests within one histogram bucket of
+    client-measured, digest payloads under the byte cap and fresh at
+    probe cadence, and the SLO burn-rate monitor flipping within two
+    probe intervals of a member kill while /fleet/metrics keeps
+    serving. Runs member subprocesses, so it is independent of the
+    serving engine's lifecycle."""
+    import asyncio as _asyncio
+
+    from tools.profile_fleet import fleet_leg
+
+    return _asyncio.run(fleet_leg(n_members=3, probe_s=0.5,
+                                  n_requests=12))
+
+
 def _tracing_extra() -> dict:
     """Observability-cost acceptance block (extra.tracing): span/trace
     volume on this process, flight-recorder ring occupancy, and the
@@ -1422,6 +1440,7 @@ def main() -> None:
     # subject to the _LIVE_ENGINE_EXTRAS ordering guard
     extra["meshed_paged"] = _meshed_paged_extra()
     extra["chaos"] = _chaos_extra()
+    extra["fleet"] = _fleet_extra()
     extra["tracing"] = _tracing_extra()
     extra["costmodel"] = _costmodel_extra()
     extra["cost_sched"] = _cost_sched_extra()

@@ -188,6 +188,35 @@ _knob("LOCALAI_FED_PROBE_S", "5", "float",
       "Federation half-open probe interval seconds.")
 _knob("LOCALAI_P2P_TOKEN", "", "str",
       "Federation join token (falls back to TOKEN).")
+_knob("LOCALAI_DIGEST_MAX_BYTES", "4096", "int",
+      "Encoded-size cap for per-node telemetry digests "
+      "(telemetry/digest.py): builders shed prefix/model detail to "
+      "fit, the balancer rejects larger bodies as oversize.")
+_knob("LOCALAI_DIGEST_TOPK", "16", "int",
+      "Prefix-hash entries carried in the digest's top-k summary "
+      "(0 disables prefix gossip).")
+_knob("LOCALAI_DIGEST_STALE_S", "60", "float",
+      "Age past which a node's digest counts as stale on /fleet/* "
+      "(fleet_digest_stale_count; the data still serves with its "
+      "age attached).")
+_knob("LOCALAI_SLO_TTFT_P95_MS", "2000", "float",
+      "Fleet SLO: 95% of requests must see first token under this "
+      "many ms (burn-rate monitored on /fleet/slo).")
+_knob("LOCALAI_SLO_ITL_P99_MS", "200", "float",
+      "Fleet SLO: 99% of inter-token gaps must be under this many ms.")
+_knob("LOCALAI_SLO_AVAILABILITY", "0.99", "float",
+      "Fleet SLO: target fraction of registered nodes serving "
+      "(online, no outstanding probe failure).")
+_knob("LOCALAI_SLO_FAST_WINDOW_S", "300", "float",
+      "Fast burn-rate window seconds for the fleet SLO monitor.")
+_knob("LOCALAI_SLO_SLOW_WINDOW_S", "3600", "float",
+      "Slow burn-rate window seconds for the fleet SLO monitor.")
+_knob("LOCALAI_SLO_BURN_WARN", "6", "float",
+      "Burn rate (error rate / budget) at which BOTH windows flip an "
+      "objective to warning.")
+_knob("LOCALAI_SLO_BURN_CRIT", "14.4", "float",
+      "Burn rate at which BOTH windows flip an objective to critical "
+      "(the classic 30-day-budget-in-2-days threshold).")
 _knob("LOCALAI_GALLERIES", "", "str",
       "JSON gallery list (falls back to GALLERIES).")
 

@@ -640,6 +640,11 @@ class LLMEngine:
                    for b in self.prefill_buckets):
             self._mixed = False
         self._prefix_index = PrefixIndex()
+        # fleet-digest prefix gossip: top-k (hash, tokens) summary,
+        # recomputed on the scheduler thread ~1/s (the index has no
+        # locking) and swapped in atomically for any-thread readers
+        self._prefix_summary: tuple = ()
+        self._prefix_summary_t = 0.0
         # same-wave prefix grouping: request id -> (deadline, want_len)
         # for admissions deferred one scheduler iteration so a
         # wave-mate's prefill commits the shared prefix they copy from
@@ -2251,6 +2256,20 @@ class LLMEngine:
         return (self._ledger.snapshot()
                 if self._ledger is not None else None)
 
+    def predicted_drain_s(self) -> Optional[float]:
+        """Public, any-thread view of the cost-model queue-drain
+        prediction (telemetry/digest.py reads it for the fleet
+        heartbeat); None when cost scheduling is off or the predictor
+        has no rates yet."""
+        with self._lock:
+            return self._predicted_drain_s()
+
+    def prefix_summary(self) -> list:
+        """Scheduler-cached top-k prefix-hash summary (see
+        PrefixIndex.summary) — an atomic tuple swap away from the
+        scheduler thread, safe to read from any thread."""
+        return [[h, n] for h, n in self._prefix_summary]
+
     def _warmup_signature(self) -> str:
         """Fingerprint of everything the warmup variant set depends on:
         model geometry, engine shape knobs, backend/device kind. Two
@@ -2996,6 +3015,14 @@ class LLMEngine:
             # decode-stall gaps are only meaningful while a slot
             # decodes; reset the clock when the decode set drains
             self._last_decode_adv = 0.0
+        # fleet-digest prefix gossip: recompute the top-k summary ~1/s
+        # on the scheduler thread (the index has no locking); host
+        # hashing only, published by atomic tuple swap
+        nowp = time.monotonic()
+        if nowp - self._prefix_summary_t >= 1.0:
+            self._prefix_summary_t = nowp
+            self._prefix_summary = self._prefix_index.summary(
+                knobs.int_("LOCALAI_DIGEST_TOPK"))
         if self._ledger is not None:
             # ledger reconcile + device/host memory gauges: host dict
             # math and a memory_stats() host call, rate-limited to ~1/s

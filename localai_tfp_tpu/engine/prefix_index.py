@@ -197,6 +197,33 @@ class PrefixIndex:
         registered slots — free AND active."""
         return sum(len(s) for s in self._seqs.values())
 
+    def summary(self, k: int = 16) -> tuple[tuple[str, int], ...]:
+        """Top-k resident prefixes as (stable hash, token count) pairs
+        — the gossip payload for prefix-locality fleet routing
+        (telemetry/digest.py). The hash is content-addressed over the
+        canonical int64 token bytes, so two NODES holding the same
+        prefix produce the same hash; duplicates across slots collapse.
+        Scheduler-thread only, like every other method here."""
+        import hashlib
+
+        if k <= 0:
+            return ()
+        out: list[tuple[str, int]] = []
+        seen: set[str] = set()
+        for seq in sorted(self._seqs.values(), key=len, reverse=True):
+            if not len(seq):
+                continue
+            h = hashlib.blake2b(
+                np.ascontiguousarray(seq, np.int64).tobytes(),
+                digest_size=8).hexdigest()
+            if h in seen:
+                continue
+            seen.add(h)
+            out.append((h, int(len(seq))))
+            if len(out) >= k:
+                break
+        return tuple(out)
+
     # ----------------------------------------------------------- internals
 
     def _insert(self, slot: int, seq: np.ndarray) -> None:

@@ -51,6 +51,8 @@ from typing import Optional
 from aiohttp import ClientError, ClientSession, ClientTimeout, web
 
 from ..config import knobs
+from ..telemetry import digest as dg
+from ..telemetry import fleet as fleetmod
 from ..telemetry import metrics as tm
 from ..telemetry.flightrec import FLIGHT
 from ..telemetry.tracing import (
@@ -97,9 +99,24 @@ class Node:
     open_until: float = 0.0
     backoff_s: float = 0.0
     last_error: str = ""
+    # telemetry digest plane: last GOOD digest (a bad one never
+    # replaces it), when it landed, and which path delivered it
+    digest: Optional[dict] = None
+    digest_at: float = 0.0
+    digest_src: str = ""
 
     def online(self, now: Optional[float] = None) -> bool:
         return (now or time.monotonic()) - self.last_seen < STALE_S
+
+    def digest_age(self, now: Optional[float] = None) -> Optional[float]:
+        if self.digest is None:
+            return None
+        return max(0.0, (now or time.monotonic()) - self.digest_at)
+
+    def digest_stale(self, now: Optional[float] = None) -> bool:
+        age = self.digest_age(now)
+        return (age is None
+                or age > knobs.float_("LOCALAI_DIGEST_STALE_S"))
 
 
 class NodeRegistry:
@@ -123,14 +140,15 @@ class NodeRegistry:
             other.get("secret", ""), self.token_payload.get("secret", ""))
 
     def announce(self, token: str, node_id: str, name: str,
-                 address: str) -> bool:
+                 address: str, digest=None) -> bool:
         if not self._authorized(token):
             return False
         now = time.monotonic()
         n = self._nodes.get(node_id)
         if n is None:
-            self._nodes[node_id] = Node(id=node_id, name=name,
-                                        address=address, last_seen=now)
+            n = Node(id=node_id, name=name, address=address,
+                     last_seen=now)
+            self._nodes[node_id] = n
         else:
             # every successful announce is a full refresh: name and
             # address may both have changed across a node restart, and
@@ -140,7 +158,23 @@ class NodeRegistry:
             n.name = name
             n.address = address
             n.last_seen = now
+        if digest is not None:
+            self.store_digest(n, digest, src="announce")
         self.update_state_gauge()
+        return True
+
+    def store_digest(self, n: Node, obj, src: str = "probe") -> bool:
+        """Validate and attach a digest to ``n``. A malformed /
+        oversized / wrong-version digest is COUNTED and dropped — the
+        last good digest (with its age) keeps serving /fleet/* and
+        routing (satellite-1 hardening)."""
+        try:
+            d = (dg.decode(obj) if isinstance(obj, (bytes, bytearray))
+                 else dg.validate(obj))
+        except dg.DigestError as e:
+            tm.FEDERATION_DIGEST_ERRORS.labels(reason=e.reason).inc()
+            return False
+        n.digest, n.digest_at, n.digest_src = d, time.monotonic(), src
         return True
 
     def nodes(self, online_only: bool = False) -> list[Node]:
@@ -226,11 +260,16 @@ class FederatedServer:
         self.strategy = strategy
         self.probe_s = (knobs.float_("LOCALAI_FED_PROBE_S")
                         if probe_s is None else probe_s)
+        self.slo = fleetmod.SLOMonitor()
 
     def build_app(self) -> web.Application:
         app = web.Application()
         app.router.add_post("/federation/register", self.handle_register)
         app.router.add_get("/federation/nodes", self.handle_nodes)
+        # fleet telemetry plane — MUST register before the catch-all
+        # proxy route or these would be forwarded to a member
+        app.router.add_get("/fleet/metrics", self.handle_fleet_metrics)
+        app.router.add_get("/fleet/slo", self.handle_fleet_slo)
         app.router.add_route("*", "/{tail:.*}", self.handle_proxy)
         app.cleanup_ctx.append(self._client_ctx)
         return app
@@ -257,6 +296,7 @@ class FederatedServer:
         while True:
             await asyncio.sleep(self.probe_s)
             for node in self.registry.nodes():
+                healthy = False
                 try:
                     async with self._client.get(
                         node.address.rstrip("/") + "/healthz",
@@ -265,25 +305,147 @@ class FederatedServer:
                         if resp.status < 500:
                             node.last_seen = time.monotonic()
                             self.registry.record_success(node)
+                            healthy = True
                         else:
                             self.registry.record_failure(
                                 node, f"healthz HTTP {resp.status}")
                 except (ClientError, asyncio.TimeoutError, OSError) as e:
                     self.registry.record_failure(
                         node, f"healthz probe: {e!r}")
+                if healthy:
+                    await self._refresh_digest(node)
+            self._slo_tick()
+
+    async def _refresh_digest(self, node: Node) -> None:
+        """Probe-path digest refresh. Failures here feed
+        federation_digest_errors_total, never the circuit breaker —
+        /healthz alone governs liveness, so a node with a broken
+        telemetry endpoint keeps serving traffic (satellite-1)."""
+        cap = dg._max_bytes()
+        try:
+            if faultinject.ACTIVE:
+                # chaos surface: digest fetch/decode hardening
+                faultinject.fire("federated.digest")
+            async with self._client.get(
+                node.address.rstrip("/") + "/telemetry/digest",
+                timeout=ClientTimeout(total=2),
+            ) as resp:
+                if resp.status != 200:
+                    tm.FEDERATION_DIGEST_ERRORS.labels(
+                        reason="fetch").inc()
+                    return
+                # bounded read: one extra byte proves oversize without
+                # ever buffering an unbounded body
+                raw = await resp.content.read(cap + 1)
+            self.registry.store_digest(node, raw, src="probe")
+        except (ClientError, asyncio.TimeoutError, OSError,
+                faultinject.InjectedFault):
+            tm.FEDERATION_DIGEST_ERRORS.labels(reason="fetch").inc()
+
+    # ------------------------------------------------- fleet telemetry
+
+    def _merged_digest(self) -> dict:
+        return dg.merge_all(n.digest for n in self.registry.nodes())
+
+    def _offline_frac(self, now: Optional[float] = None) -> float:
+        """Fraction of registered nodes NOT serving — the availability
+        error rate. A node counts as serving when it is inside the
+        liveness horizon with no outstanding probe/proxy failure, so a
+        kill shows up at the FIRST failed probe, not after the breaker
+        trips."""
+        nodes = self.registry.nodes()
+        if not nodes:
+            return 0.0
+        now = now or time.monotonic()
+        serving = sum(1 for n in nodes
+                      if n.online(now) and n.consec_failures == 0)
+        return 1.0 - serving / len(nodes)
+
+    def _slo_tick(self) -> None:
+        self.slo.record(self._merged_digest(), self._offline_frac())
+
+    def _node_views(self, limit: int) -> list[dict]:
+        now = time.monotonic()
+        views = []
+        for n in self.registry.nodes()[:limit]:
+            views.append({
+                "node": n.name or n.id, "digest": n.digest,
+                "age_s": n.digest_age(now), "stale": n.digest_stale(now),
+                "in_flight": n.in_flight,
+                "serving": n.online(now)
+                and self.registry.state(n, now) != "open"})
+        return views
+
+    @staticmethod
+    def _limit(request: web.Request, default: int = 64,
+               cap: int = 512) -> int:
+        try:
+            limit = int(request.query.get("limit") or default)
+        except ValueError:
+            raise web.HTTPBadRequest(reason="'limit' must be an integer")
+        return max(1, min(limit, cap))
+
+    async def handle_fleet_metrics(self, request: web.Request
+                                   ) -> web.Response:
+        from ..telemetry.registry import CONTENT_TYPE
+
+        limit = self._limit(request)
+        self.slo.maybe_record(
+            lambda: (self._merged_digest(), self._offline_frac()))
+        text = fleetmod.render_fleet(
+            self._node_views(limit), self._merged_digest(),
+            self.slo.evaluate())
+        return web.Response(body=text.encode("utf-8"), headers={
+            "Content-Type": CONTENT_TYPE, "Cache-Control": "no-store"})
+
+    async def handle_fleet_slo(self, request: web.Request
+                               ) -> web.Response:
+        self.slo.maybe_record(
+            lambda: (self._merged_digest(), self._offline_frac()))
+        out = self.slo.evaluate()
+        now = time.monotonic()
+        nodes = self.registry.nodes()
+        out["nodes"] = {
+            "total": len(nodes),
+            "serving": sum(1 for n in nodes
+                           if n.online(now) and n.consec_failures == 0)}
+        return web.json_response(
+            out, headers={"Cache-Control": "no-store"})
 
     async def handle_register(self, request: web.Request) -> web.Response:
         body = await request.json()
         ok = self.registry.announce(
             body.get("token", ""), body.get("id", ""),
-            body.get("name", ""), body.get("address", ""))
+            body.get("name", ""), body.get("address", ""),
+            digest=body.get("digest"))
         if not ok:
             raise web.HTTPUnauthorized(reason="bad federation token")
         return web.json_response({"ok": True,
                                   "heartbeat_s": HEARTBEAT_S})
 
+    @staticmethod
+    def _digest_summary(n: Node, now: float) -> Optional[dict]:
+        """Compact per-node digest view for /federation/nodes (the full
+        digest stays on /fleet/metrics; this is the operator listing)."""
+        d = n.digest
+        if d is None:
+            return None
+        return {
+            "age_s": round(n.digest_age(now) or 0.0, 3),
+            "stale": n.digest_stale(now), "src": n.digest_src,
+            "queue_depth": d["occ"].get("queue_depth", 0),
+            "slots_busy": d["occ"].get("slots_busy", 0),
+            "n_slots": d["occ"].get("n_slots", 0),
+            "mfu": dg.mfu_mean(d),
+            "drain_s": d.get("drain_s"),
+            "models": d.get("models", []),
+            "kv_pages": d.get("kv_pages", {}),
+            "prefixes": len(d.get("prefixes", [])),
+        }
+
     async def handle_nodes(self, request: web.Request) -> web.Response:
         now = time.monotonic()
+        limit = self._limit(request)
         return web.json_response([
             {"id": n.id, "name": n.name, "address": n.address,
              "online": n.online(now), "in_flight": n.in_flight,
@@ -291,9 +453,10 @@ class FederatedServer:
              "state": self.registry.state(n, now),
              "consec_failures": n.consec_failures,
              "breaker_open_for_s": round(max(0.0, n.open_until - now), 3),
-             "last_error": n.last_error}
-            for n in self.registry.nodes()
-        ])
+             "last_error": n.last_error,
+             "digest": self._digest_summary(n, now)}
+            for n in self.registry.nodes()[:limit]
+        ], headers={"Cache-Control": "no-store"})
 
     async def handle_proxy(self, request: web.Request) -> web.StreamResponse:
         # the body is buffered up front so a connect-failure retry can
@@ -313,36 +476,57 @@ class FederatedServer:
             trace_id=tid, parent_span=pspan)
         status = "error"
         tried: set[str] = set()
+        shed_hints: list[float] = []
         try:
             while True:
                 node = self.registry.pick(self.strategy, exclude=tried)
                 if node is None:
+                    if not self.registry.nodes():
+                        # nothing has ever registered: a retry cannot
+                        # help, tell the client the fleet is absent
+                        status = "no_nodes"
+                        TRACER.annotate(rid, "terminal",
+                                        outcome="no_nodes")
+                        raise web.HTTPServiceUnavailable(
+                            reason="no federation nodes online")
+                    # nodes exist but every eligible one is down or
+                    # shedding: answer 429 with a Retry-After priced
+                    # from the fleet's own drain predictions instead
+                    # of an uninformative 502/503 (satellite-3)
                     if tried:
                         tm.FEDERATION_RETRIES.labels(
                             outcome="exhausted").inc()
-                        status = "exhausted"
-                        TRACER.annotate(rid, "terminal",
-                                        outcome="exhausted",
-                                        tried=len(tried))
-                        raise web.HTTPBadGateway(
-                            reason=f"all {len(tried)} eligible federation "
-                                   "nodes failed")
-                    status = "no_nodes"
-                    TRACER.annotate(rid, "terminal", outcome="no_nodes")
-                    raise web.HTTPServiceUnavailable(
-                        reason="no federation nodes online")
+                    ra = self._retry_after_s(shed_hints)
+                    status = "saturated" if shed_hints else "exhausted"
+                    TRACER.annotate(rid, "terminal", outcome=status,
+                                    tried=len(tried),
+                                    shed=len(shed_hints),
+                                    retry_after_s=ra)
+                    raise web.HTTPTooManyRequests(
+                        headers={"Retry-After": str(ra)},
+                        reason="every eligible federation node is down "
+                               "or shedding; retry after the predicted "
+                               "drain")
                 tried.add(node.id)
                 TRACER.annotate(rid, "pick", node=node.name,
                                 breaker=self.registry.state(node),
                                 attempt=len(tried))
-                resp = await self._proxy_once(request, node, data,
-                                              rerouted=len(tried) > 1,
-                                              rid=rid, trace_id=tid)
+                resp, shed_s = await self._proxy_once(
+                    request, node, data, rerouted=len(tried) > 1,
+                    rid=rid, trace_id=tid)
                 if resp is not None:
                     status = "proxied"
                     TRACER.annotate(rid, "terminal", outcome="proxied",
                                     node=node.name)
                     return resp
+                if shed_s is not None:
+                    # upstream shed (429 before any bytes): not a node
+                    # failure — keep its Retry-After hint and try the
+                    # next node
+                    shed_hints.append(shed_s)
+                    TRACER.annotate(rid, "shed", node=node.name,
+                                    retry_after_s=shed_s)
+                    continue
                 # connect failure before any bytes streamed: next node
                 TRACER.annotate(rid, "retry", node=node.name,
                                 error=node.last_error)
@@ -352,13 +536,35 @@ class FederatedServer:
             TRACER.event(rid, "done")
             TRACER.finish(rid, status=status)
 
+    def _retry_after_s(self, shed_hints: list) -> int:
+        """Whole-second Retry-After for a saturated fleet: the minimum
+        of the members' own shed hints, each node digest's predicted
+        drain, and the soonest breaker re-open — i.e. the earliest
+        moment ANY node plausibly takes traffic again. Falls back to
+        the breaker backoff base when nothing is known."""
+        import math
+
+        now = time.monotonic()
+        cands = [float(h) for h in shed_hints if h and h > 0]
+        for n in self.registry.nodes():
+            if n.digest is not None and n.digest.get("drain_s"):
+                cands.append(float(n.digest["drain_s"]))
+            if n.open_until > now:
+                cands.append(n.open_until - now)
+        horizon = min(cands) if cands else self.registry.breaker_base_s
+        return int(math.ceil(min(60.0, max(1.0, horizon))))
+
     async def _proxy_once(self, request: web.Request, node: Node,
                           data: bytes, rerouted: bool, rid: str = "",
                           trace_id: str = "",
-                          ) -> Optional[web.StreamResponse]:
-        """Proxy one attempt to `node`. Returns the (completed)
-        response, or None when the upstream failed before the response
-        was prepared — the only case a retry is safe."""
+                          ) -> tuple[Optional[web.StreamResponse],
+                                     Optional[float]]:
+        """Proxy one attempt to `node`. Returns (response, None) on a
+        completed attempt, (None, None) when the upstream failed before
+        the response was prepared (the only case a retry is safe), and
+        (None, retry_after_s) when the upstream SHED the request with a
+        429 — not a node failure, the caller tries the next node and
+        aggregates the hint."""
         node.in_flight += 1
         resp: Optional[web.StreamResponse] = None
         span = TRACER.begin_span(rid, "upstream")
@@ -383,6 +589,18 @@ class FederatedServer:
                 request.method, url, headers=headers,
                 data=data or None, allow_redirects=False,
             ) as upstream:
+                if upstream.status == 429:
+                    # the member shed at admission — a capacity signal,
+                    # not a failure: leave the breaker alone, hand the
+                    # drain hint back for aggregation (satellite-3)
+                    try:
+                        hint = float(
+                            upstream.headers.get("Retry-After", "") or 0)
+                    except ValueError:
+                        hint = 0.0
+                    if hint <= 0 and node.digest is not None:
+                        hint = float(node.digest.get("drain_s") or 0)
+                    return None, max(hint, 1.0)
                 resp = web.StreamResponse(status=upstream.status)
                 for k, v in upstream.headers.items():
                     if k.lower() not in self.HOP_HEADERS | {"content-length"}:
@@ -399,12 +617,12 @@ class FederatedServer:
                 self.registry.record_success(node)
                 if rerouted:
                     tm.FEDERATION_RETRIES.labels(outcome="rerouted").inc()
-                return resp
+                return resp, None
         except (ClientError, asyncio.TimeoutError,
                 faultinject.InjectedFault) as e:
             self.registry.record_failure(node, repr(e))
             if resp is None or not resp.prepared:
-                return None  # no bytes streamed; caller retries
+                return None, None  # no bytes streamed; caller retries
             # bytes already went out: the stream cannot move to another
             # node, so end it CLEANLY — SSE clients get a terminal
             # error event instead of a silent truncation
@@ -425,7 +643,7 @@ class FederatedServer:
                 # nothing left to notify
                 tm.RECOVERED_ERRORS.labels(
                     site="federated.midstream_notify").inc()
-            return resp
+            return resp, None
         finally:
             TRACER.end_span(span, node=node.name)
             # timeline: one attempt span on the federated track (token
@@ -436,18 +654,32 @@ class FederatedServer:
 
 
 async def announce_forever(balancer_url: str, token: str, node_id: str,
-                           name: str, address: str) -> None:
-    """Worker-side heartbeat loop (ref: ExposeService announce ticker)."""
+                           name: str, address: str,
+                           digest_fn=None) -> None:
+    """Worker-side heartbeat loop (ref: ExposeService announce ticker).
+    ``digest_fn`` (optional, sync) supplies this node's telemetry
+    digest; it rides every register POST so the balancer has occupancy
+    and latency buckets even with active probing disabled. A digest
+    failure never blocks the heartbeat — liveness outranks telemetry."""
     import logging
 
     log = logging.getLogger(__name__)
     async with ClientSession(timeout=ClientTimeout(total=10)) as client:
         while True:
+            body = {"token": token, "id": node_id, "name": name,
+                    "address": address}
+            if digest_fn is not None:
+                try:
+                    d = digest_fn()
+                    if d is not None:
+                        body["digest"] = d
+                except Exception:
+                    tm.RECOVERED_ERRORS.labels(
+                        site="federated.announce_digest").inc()
             try:
                 async with client.post(
                     balancer_url.rstrip("/") + "/federation/register",
-                    json={"token": token, "id": node_id, "name": name,
-                          "address": address},
+                    json=body,
                 ) as resp:
                     if resp.status == 401:
                         log.error(

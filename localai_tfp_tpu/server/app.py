@@ -30,7 +30,8 @@ from . import (
 log = logging.getLogger(__name__)
 
 # endpoints exempt from API-key auth (ref: app.go:139-174 default filters)
-AUTH_EXEMPT = {"/healthz", "/readyz", "/metrics", "/version", "/login"}
+AUTH_EXEMPT = {"/healthz", "/readyz", "/metrics", "/telemetry/digest",
+               "/version", "/login"}
 
 # server-rendered UI pages: browsers cannot attach a Bearer header on
 # NAVIGATION, so an unauthorized text/html GET redirects to /login
@@ -250,10 +251,14 @@ def build_app(state: Application) -> web.Application:
                     "explicitly if the balancer cannot resolve this host",
                     addr,
                 )
+            from ..telemetry import digest as _digest
+
             app_["announce_task"] = asyncio.create_task(announce_forever(
                 cfg.federated_server_url, cfg.p2p_token,
                 _uuid.uuid4().hex[:12], cfg.node_name or "localai-node",
                 addr,
+                # every heartbeat gossips this node's telemetry digest
+                digest_fn=lambda: _digest.collect(state.model_loader),
             ))
         if not cfg.disable_metrics:
             import asyncio

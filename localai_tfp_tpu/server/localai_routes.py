@@ -28,6 +28,7 @@ def register(app: web.Application) -> None:
     r.add_get("/readyz", health)
     r.add_get("/version", version)
     r.add_get("/metrics", metrics)
+    r.add_get("/telemetry/digest", telemetry_digest)
     r.add_get("/debug/traces", debug_traces)
     r.add_get("/debug/timeline", debug_timeline)
     r.add_get("/debug/profile", debug_profile)
@@ -96,6 +97,20 @@ async def metrics(request: web.Request) -> web.Response:
         body=st.metrics.render(openmetrics=om).encode("utf-8"),
         headers={"Content-Type": (OPENMETRICS_CONTENT_TYPE if om
                                   else CONTENT_TYPE)})
+
+
+async def telemetry_digest(request: web.Request) -> web.Response:
+    """This node's mergeable telemetry digest (telemetry/digest.py) —
+    what the federation balancer's probe loop fetches and the
+    heartbeat attaches. Bounded JSON (LOCALAI_DIGEST_MAX_BYTES);
+    collection reads host-held registry/scheduler values only, run off
+    the event loop because it briefly takes each engine's lock."""
+    st = _state(request)
+    from ..telemetry import digest as dg
+
+    payload = await run_blocking(dg.collect, st.model_loader)
+    return web.json_response(payload,
+                             headers={"Cache-Control": "no-store"})
 
 
 async def debug_traces(request: web.Request) -> web.Response:
@@ -455,7 +470,7 @@ async def federation_register(request: web.Request) -> web.Response:
     body = await _body(request)
     ok = st.registry.announce(
         body.get("token", ""), body.get("id", ""), body.get("name", ""),
-        body.get("address", ""))
+        body.get("address", ""), digest=body.get("digest"))
     if not ok:
         raise web.HTTPUnauthorized(reason="bad federation token")
     from ..parallel.federated import HEARTBEAT_S

@@ -1,0 +1,375 @@
+"""Mergeable per-engine telemetry digest — the fleet gossip payload.
+
+One node's serving state, compressed into a bounded JSON document that
+rides the federation heartbeat (``announce_forever`` attaches it to
+every register POST) and the balancer's active probe (``GET
+/telemetry/digest``). The design constraint that shapes everything
+here: fleet percentiles must come from EXACT histogram merges, never
+from averaging per-node percentiles (averaged p95s are statistically
+meaningless). So the digest carries raw log-bucket counts over FIXED
+global bucket boundaries — ``registry.DEFAULT_BUCKETS`` for
+request-scale series (TTFT, queue wait) and ``metrics._STEP_BUCKETS``
+for per-token ITL — and merging two digests is elementwise count
+addition. Changing either boundary ladder MUST bump
+``DIGEST_VERSION``: the boundaries are pinned by the version field,
+not shipped per digest (that would triple the payload).
+
+Merge algebra (tested in tests/test_fleet_telemetry.py):
+
+- ``merge`` is associative and commutative with ``empty()`` as the
+  identity — histogram counts/sums add, additive occupancy scalars
+  add, MFU is carried as (sum, n) so the fleet mean is exact, drain
+  takes the max (a node drains when its slowest engine does), models
+  union, and the prefix top-k keeps the k largest under a total order
+  ((tokens desc, hash asc)), which is itself an associative reduction.
+
+Size discipline: ``build`` drops prefix entries (then model names)
+until the encoded payload fits ``LOCALAI_DIGEST_MAX_BYTES`` (~4 KB
+default), so the heartbeat path has a hard byte bound. Everything read
+here is a host-held value (registry snapshots + scheduler-cached
+summaries) — collecting a digest never touches a device.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Optional, Sequence
+
+from ..config import knobs
+from . import metrics as tm
+from .registry import DEFAULT_BUCKETS
+
+DIGEST_VERSION = 1
+
+# fixed global bucket boundary ladders, pinned by DIGEST_VERSION
+HIST_BOUNDS: dict[str, tuple[float, ...]] = {
+    "ttft": DEFAULT_BUCKETS,
+    "itl": tm._STEP_BUCKETS,
+    "queue_wait": DEFAULT_BUCKETS,
+}
+
+# occupancy scalars that merge by plain addition
+_ADDITIVE = ("queue_depth", "slots_busy", "n_slots", "in_flight",
+             "mfu_sum", "mfu_n")
+
+_VALID_REASONS = ("oversize", "version", "malformed", "fetch")
+
+
+class DigestError(ValueError):
+    """A digest that failed decode/validation. ``reason`` is the
+    ``federation_digest_errors_total{reason}`` label value."""
+
+    def __init__(self, reason: str, detail: str = "") -> None:
+        super().__init__(f"digest {reason}: {detail}" if detail
+                         else f"digest {reason}")
+        assert reason in _VALID_REASONS
+        self.reason = reason
+
+
+def _max_bytes() -> int:
+    return max(512, knobs.int_("LOCALAI_DIGEST_MAX_BYTES"))
+
+
+def _topk() -> int:
+    return max(0, knobs.int_("LOCALAI_DIGEST_TOPK"))
+
+
+# --------------------------------------------------------------- build
+
+
+def empty() -> dict:
+    """The merge identity: all-zero histograms, empty occupancy."""
+    return {
+        "v": DIGEST_VERSION,
+        "hist": {k: {"c": [0] * (len(b) + 1), "s": 0.0}
+                 for k, b in HIST_BOUNDS.items()},
+        "occ": {k: 0 for k in _ADDITIVE},
+        "hbm": {},
+        "kv_pages": {"hot": 0, "warm": 0},
+        "models": [],
+        "drain_s": None,
+        "prefixes": [],
+    }
+
+
+def family_hist(fam) -> dict:
+    """One digest histogram from a registry family: bucket counts and
+    sum ADDED across every label set (per-model series collapse into
+    the node total — the boundaries are shared, so this is exact)."""
+    counts = [0] * (len(fam.buckets) + 1)
+    total = 0.0
+    for _key, snap in fam.collect():
+        for i, c in enumerate(snap["counts"]):
+            counts[i] += c
+        total += snap["sum"]
+    return {"c": counts, "s": round(total, 6)}
+
+
+def _gauge_values(fam) -> list[tuple[tuple, float]]:
+    return [(key, snap["value"]) for key, snap in fam.collect()]
+
+
+def build(*, hist: Optional[dict] = None, queue_depth: float = 0,
+          slots_busy: float = 0, n_slots: float = 0, in_flight: float = 0,
+          mfu: Optional[Sequence[float]] = (),
+          hbm: Optional[dict] = None, kv_pages: Optional[dict] = None,
+          models: Sequence[str] = (), drain_s: Optional[float] = None,
+          prefixes: Sequence = ()) -> dict:
+    """Assemble a digest from already-gathered host values, enforcing
+    the encoded-size cap (prefix entries, then model names, are shed
+    until it fits)."""
+    d = empty()
+    if hist:
+        for k in HIST_BOUNDS:
+            if k in hist:
+                d["hist"][k] = {"c": list(hist[k]["c"]),
+                                "s": float(hist[k]["s"])}
+    occ = d["occ"]
+    occ["queue_depth"] = int(queue_depth)
+    occ["slots_busy"] = int(slots_busy)
+    occ["n_slots"] = int(n_slots)
+    occ["in_flight"] = int(in_flight)
+    mfu = [float(x) for x in (mfu or ())]
+    occ["mfu_sum"] = round(sum(mfu), 6)
+    occ["mfu_n"] = len(mfu)
+    d["hbm"] = {str(k): int(v) for k, v in (hbm or {}).items() if v}
+    if kv_pages:
+        d["kv_pages"] = {"hot": int(kv_pages.get("hot", 0)),
+                         "warm": int(kv_pages.get("warm", 0))}
+    d["models"] = sorted(str(m) for m in models)
+    d["drain_s"] = (round(float(drain_s), 3)
+                    if drain_s is not None else None)
+    d["prefixes"] = _top_prefixes(
+        [(str(h), int(n)) for h, n in prefixes], _topk())
+    # hard byte bound for the heartbeat path: shed detail until it fits
+    cap = _max_bytes()
+    while len(encode(d)) > cap and d["prefixes"]:
+        d["prefixes"] = d["prefixes"][: len(d["prefixes"]) // 2]
+    while len(encode(d)) > cap and d["models"]:
+        d["models"] = d["models"][: len(d["models"]) // 2]
+    return d
+
+
+def collect(loader=None) -> dict:
+    """Build THIS node's digest from the process-wide registry plus the
+    loader's engine-backed models (duck-typed: ``loaded_names``/``get``
+    with backends exposing ``.engine``). Histograms come straight from
+    the canonical families; occupancy scalars from their gauges (both
+    are host-held snapshots — no device work); drain prediction and the
+    prefix top-k from per-engine scheduler-cached values."""
+    hist = {
+        "ttft": family_hist(tm.ENGINE_TTFT),
+        "itl": family_hist(tm.ENGINE_INTER_TOKEN),
+        "queue_wait": family_hist(tm.ENGINE_QUEUE_WAIT),
+    }
+    queue_depth = sum(v for _, v in _gauge_values(tm.ENGINE_QUEUE_DEPTH))
+    slots_busy = sum(v for _, v in _gauge_values(tm.ENGINE_SLOTS_BUSY))
+    mfu = [v for _, v in _gauge_values(tm.ENGINE_MFU)]
+    hbm: dict[str, float] = {}
+    for key, v in _gauge_values(tm.ENGINE_HBM_BYTES):
+        comp = key[tm.ENGINE_HBM_BYTES.labelnames.index("component")]
+        hbm[comp] = hbm.get(comp, 0) + v
+    kv_pages = {"hot": 0, "warm": 0}
+    tier_of = {"hbm": "hot", "host": "warm"}
+    for key, v in _gauge_values(tm.ENGINE_KV_TIER_PAGES):
+        tier = key[tm.ENGINE_KV_TIER_PAGES.labelnames.index("tier")]
+        if tier in tier_of:
+            kv_pages[tier_of[tier]] += int(v)
+    models: list[str] = []
+    n_slots = 0
+    drain: Optional[float] = None
+    prefixes: list[tuple[str, int]] = []
+    if loader is not None:
+        models = list(loader.loaded_names())
+        for name in models:
+            lm = loader.get(name)
+            eng = getattr(getattr(lm, "backend", None), "engine", None)
+            if eng is None:
+                continue
+            n_slots += int(getattr(eng, "n_slots", 0) or 0)
+            try:
+                d = eng.predicted_drain_s()
+            except Exception:
+                tm.RECOVERED_ERRORS.labels(site="digest.drain").inc()
+                d = None
+            if d is not None:
+                drain = d if drain is None else max(drain, d)
+            try:
+                prefixes.extend(eng.prefix_summary())
+            except Exception:
+                tm.RECOVERED_ERRORS.labels(site="digest.prefixes").inc()
+    return build(hist=hist, queue_depth=queue_depth,
+                 slots_busy=slots_busy, n_slots=n_slots, mfu=mfu,
+                 hbm=hbm, kv_pages=kv_pages, models=models,
+                 drain_s=drain, prefixes=prefixes)
+
+
+# --------------------------------------------------------------- merge
+
+
+def _top_prefixes(entries: Sequence[tuple[str, int]], k: int
+                  ) -> list[list]:
+    """Dedup by hash (max tokens wins), then keep the top k under the
+    total order (tokens desc, hash asc). Top-k under a total order is
+    an associative reduction: an entry dominated by k others in any
+    subset stays dominated in every superset."""
+    best: dict[str, int] = {}
+    for h, n in entries:
+        if n > best.get(h, -1):
+            best[h] = n
+    ranked = sorted(best.items(), key=lambda e: (-e[1], e[0]))
+    return [[h, n] for h, n in ranked[:k]]
+
+
+def merge(a: dict, b: dict) -> dict:
+    """Exact digest merge (see module docstring for the algebra). Both
+    inputs must already be validated; the result is a fresh dict."""
+    out = empty()
+    for k, bounds in HIST_BOUNDS.items():
+        ca, cb = a["hist"][k]["c"], b["hist"][k]["c"]
+        out["hist"][k] = {
+            "c": [x + y for x, y in zip(ca, cb)],
+            "s": round(a["hist"][k]["s"] + b["hist"][k]["s"], 6),
+        }
+    for k in _ADDITIVE:
+        v = a["occ"].get(k, 0) + b["occ"].get(k, 0)
+        out["occ"][k] = round(v, 6) if isinstance(v, float) else v
+    for src in (a, b):
+        for comp, v in src.get("hbm", {}).items():
+            out["hbm"][comp] = out["hbm"].get(comp, 0) + v
+    for tier in ("hot", "warm"):
+        out["kv_pages"][tier] = (a["kv_pages"].get(tier, 0)
+                                 + b["kv_pages"].get(tier, 0))
+    out["models"] = sorted(set(a["models"]) | set(b["models"]))
+    drains = [d for d in (a["drain_s"], b["drain_s"]) if d is not None]
+    out["drain_s"] = max(drains) if drains else None
+    out["prefixes"] = _top_prefixes(
+        [(h, n) for h, n in a["prefixes"] + b["prefixes"]], _topk())
+    return out
+
+
+def merge_all(digests) -> dict:
+    out = empty()
+    for d in digests:
+        if d is not None:
+            out = merge(out, d)
+    return out
+
+
+def mfu_mean(d: dict) -> Optional[float]:
+    n = d["occ"].get("mfu_n", 0)
+    return (d["occ"].get("mfu_sum", 0.0) / n) if n else None
+
+
+# ---------------------------------------------------------- percentiles
+
+
+def percentile_bounds(hist: dict, key: str, q: float
+                      ) -> tuple[float, float]:
+    """(lower, upper) boundary of the bucket holding the q-quantile of
+    a digest's ``hist`` map under ``key`` — the exact-merge answer to
+    "fleet p95". The true quantile lies WITHIN these bounds, so any
+    estimator that returns a point inside them is within one bucket
+    width of a dense oracle (the acceptance contract profile_fleet
+    checks)."""
+    bounds = HIST_BOUNDS[key]
+    counts = hist[key]["c"]
+    total = sum(counts)
+    if total <= 0:
+        return (0.0, 0.0)
+    rank = max(1, int(math.ceil(q * total)))
+    cum = 0
+    for i, c in enumerate(counts[:-1]):
+        cum += c
+        if cum >= rank:
+            return (bounds[i - 1] if i else 0.0, bounds[i])
+    return (bounds[-1], float("inf"))
+
+
+def percentile(hist: dict, key: str, q: float) -> float:
+    """Point estimate: the upper boundary of the quantile's bucket
+    (conservative; +Inf overflow reports the top finite boundary)."""
+    lo, hi = percentile_bounds(hist, key, q)
+    return lo if math.isinf(hi) else hi
+
+
+# ------------------------------------------------------ encode / decode
+
+
+def encode(d: dict) -> bytes:
+    return json.dumps(d, separators=(",", ":"), sort_keys=True).encode()
+
+
+def validate(obj, max_bytes: Optional[int] = None) -> dict:
+    """Validate an already-parsed digest object (the announce path —
+    the digest arrives embedded in the register JSON). Raises
+    DigestError(reason=oversize|version|malformed)."""
+    cap = max_bytes if max_bytes is not None else _max_bytes()
+    if not isinstance(obj, dict):
+        raise DigestError("malformed", "not an object")
+    if obj.get("v") != DIGEST_VERSION:
+        raise DigestError("version", f"v={obj.get('v')!r}")
+    hist = obj.get("hist")
+    if not isinstance(hist, dict):
+        raise DigestError("malformed", "hist missing")
+    for k, bounds in HIST_BOUNDS.items():
+        h = hist.get(k)
+        if not isinstance(h, dict):
+            raise DigestError("malformed", f"hist.{k} missing")
+        c = h.get("c")
+        if (not isinstance(c, list) or len(c) != len(bounds) + 1
+                or any(not isinstance(x, int) or x < 0 for x in c)):
+            raise DigestError("malformed", f"hist.{k} counts")
+        if not isinstance(h.get("s"), (int, float)) or h["s"] < 0:
+            raise DigestError("malformed", f"hist.{k} sum")
+    occ = obj.get("occ")
+    if not isinstance(occ, dict) or any(
+            not isinstance(occ.get(k, 0), (int, float))
+            for k in _ADDITIVE):
+        raise DigestError("malformed", "occ")
+    if not isinstance(obj.get("hbm", {}), dict):
+        raise DigestError("malformed", "hbm")
+    if not isinstance(obj.get("kv_pages", {}), dict):
+        raise DigestError("malformed", "kv_pages")
+    if not isinstance(obj.get("models", []), list):
+        raise DigestError("malformed", "models")
+    ds = obj.get("drain_s")
+    if ds is not None and not isinstance(ds, (int, float)):
+        raise DigestError("malformed", "drain_s")
+    pf = obj.get("prefixes", [])
+    if not isinstance(pf, list) or any(
+            not (isinstance(e, (list, tuple)) and len(e) == 2)
+            for e in pf):
+        raise DigestError("malformed", "prefixes")
+    if len(encode(obj)) > cap:
+        raise DigestError("oversize", f"> {cap} bytes")
+    # normalize onto a full schema so downstream code can index freely
+    d = empty()
+    for k in HIST_BOUNDS:
+        d["hist"][k] = {"c": [int(x) for x in hist[k]["c"]],
+                        "s": float(hist[k]["s"])}
+    for k in _ADDITIVE:
+        d["occ"][k] = occ.get(k, 0)
+    d["hbm"] = {str(k): v for k, v in obj.get("hbm", {}).items()
+                if isinstance(v, (int, float))}
+    kp = obj.get("kv_pages", {})
+    d["kv_pages"] = {"hot": int(kp.get("hot", 0) or 0),
+                     "warm": int(kp.get("warm", 0) or 0)}
+    d["models"] = [str(m) for m in obj.get("models", [])]
+    d["drain_s"] = float(ds) if ds is not None else None
+    d["prefixes"] = [[str(h), int(n)] for h, n in pf]
+    return d
+
+
+def decode(raw: bytes, max_bytes: Optional[int] = None) -> dict:
+    """Decode + validate a digest fetched over the wire. The size check
+    runs BEFORE json parsing so an oversized body never costs a parse."""
+    cap = max_bytes if max_bytes is not None else _max_bytes()
+    if len(raw) > cap:
+        raise DigestError("oversize", f"{len(raw)} > {cap} bytes")
+    try:
+        obj = json.loads(raw)
+    except (ValueError, UnicodeDecodeError) as e:
+        raise DigestError("malformed", str(e)[:80])
+    return validate(obj, max_bytes=cap)

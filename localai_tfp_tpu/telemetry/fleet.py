@@ -1,0 +1,343 @@
+"""Fleet-level telemetry: merged exposition + the SLO burn-rate monitor.
+
+The balancer-side consumers of the digest plane (telemetry/digest.py):
+
+- ``render_fleet`` turns the registry's per-node digests into one
+  Prometheus 0.0.4 page (``GET /fleet/metrics``): fleet-wide histograms
+  loaded from EXACT bucket merges (``fleet_ttft_seconds`` /
+  ``fleet_itl_seconds`` / ``fleet_queue_wait_seconds``), per-node
+  occupancy gauges (``fleet_node_*{node}``), and digest freshness
+  (``fleet_digest_age_seconds`` + ``fleet_digest_stale_count``). A
+  fresh private Registry is built per scrape — node sets churn, and a
+  rebuilt registry can never leak label sets for departed nodes.
+
+- ``SLOMonitor`` keeps a ring of (timestamp, cumulative merged bucket
+  counts, offline fraction) samples and evaluates knob-configured
+  objectives with the classic multi-window burn rate: for each
+  objective, burn = windowed error rate / error budget over a fast and
+  a slow window, and the state escalates only when BOTH windows burn
+  (fast alone is noise; slow alone is stale history) — ok below
+  ``LOCALAI_SLO_BURN_WARN``, warning at it, critical at
+  ``LOCALAI_SLO_BURN_CRIT``. Counter resets (a node restart zeroes its
+  histograms) clamp to zero instead of going negative. This state is
+  the scale-up/scale-down trigger the autoscaling PR consumes.
+
+A latency request counts against its objective when it landed in a
+bucket whose upper boundary exceeds the threshold — bucket-exact, so
+the monitor inherits the digest's never-average-percentiles guarantee.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from ..config import knobs
+from . import digest as dg
+from .registry import Registry
+
+_WINDOWS = ("fast", "slow")
+_STATES = ("ok", "warning", "critical")
+
+
+def _now() -> float:
+    return time.monotonic()
+
+
+# ------------------------------------------------------------ SLO monitor
+
+
+class SLOMonitor:
+    """Multi-window burn-rate state machine over merged fleet digests.
+
+    ``record`` appends one sample (called after each balancer probe
+    round, and lazily on scrape); ``evaluate`` derives per-objective
+    burn rates and states. Thread-safe: probes run on the event loop,
+    tests drive it synchronously.
+    """
+
+    MIN_RECORD_GAP_S = 0.05
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # (t, {hist key: tuple(cumulative counts)}, offline_frac)
+        self._samples: deque = deque()  # lint: guarded-by self._lock
+        self._last_t = 0.0  # lint: guarded-by self._lock
+
+    @staticmethod
+    def windows() -> dict[str, float]:
+        return {
+            "fast": max(0.1, knobs.float_("LOCALAI_SLO_FAST_WINDOW_S")),
+            "slow": max(0.2, knobs.float_("LOCALAI_SLO_SLOW_WINDOW_S")),
+        }
+
+    def record(self, merged: dict, offline_frac: float,
+               now: Optional[float] = None) -> None:
+        now = _now() if now is None else now
+        counts = {k: tuple(merged["hist"][k]["c"]) for k in dg.HIST_BOUNDS}
+        horizon = max(self.windows().values()) * 2.0
+        with self._lock:
+            self._samples.append(
+                (now, counts, min(1.0, max(0.0, float(offline_frac)))))
+            self._last_t = now
+            # prune, but always keep one sample OLDER than the slow
+            # window so windowed diffs have a baseline
+            while (len(self._samples) > 2
+                   and self._samples[1][0] < now - horizon):
+                self._samples.popleft()
+            while len(self._samples) > 4096:
+                self._samples.popleft()
+
+    def maybe_record(self, supplier: Callable[[], tuple[dict, float]],
+                     now: Optional[float] = None) -> None:
+        """Scrape-path recording: sample only if the probe loop hasn't
+        just done it (keeps scrape storms from flooding the ring)."""
+        now = _now() if now is None else now
+        with self._lock:
+            fresh = now - self._last_t < self.MIN_RECORD_GAP_S
+        if not fresh:
+            merged, offline = supplier()
+            self.record(merged, offline, now=now)
+
+    # ------------------------------------------------------- evaluation
+
+    def _window_diff(self, key: str, since: float, now: float
+                     ) -> tuple[list, float]:
+        """(per-bucket count deltas over [since, now], sample count) —
+        newest sample minus the OLDEST sample inside the window,
+        clamped elementwise against counter resets."""
+        with self._lock:
+            samples = list(self._samples)
+        if not samples:
+            return [], 0
+        newest = samples[-1]
+        base = None
+        for s in samples:
+            if s[0] >= since:
+                base = s
+                break
+        if base is None or base is newest:
+            # fewer than two samples in the window: prefer the newest
+            # sample OLDER than the window as the baseline
+            older = [s for s in samples if s[0] < since]
+            base = older[-1] if older else samples[0]
+        if base is newest:
+            return [0] * len(newest[1][key]), 1
+        return ([max(0, b - a) for a, b
+                 in zip(base[1][key], newest[1][key])], 2)
+
+    def _offline_mean(self, since: float) -> float:
+        with self._lock:
+            vals = [s[2] for s in self._samples if s[0] >= since]
+            if not vals and self._samples:
+                vals = [self._samples[-1][2]]
+        return sum(vals) / len(vals) if vals else 0.0
+
+    @staticmethod
+    def _latency_error_rate(diff: list, bounds: tuple,
+                            threshold_s: float) -> tuple[float, int]:
+        total = sum(diff)
+        if total <= 0:
+            return 0.0, 0
+        good = sum(c for c, b in zip(diff, bounds) if b <= threshold_s)
+        return (total - good) / total, total
+
+    def evaluate(self, now: Optional[float] = None) -> dict:
+        now = _now() if now is None else now
+        wins = self.windows()
+        warn = knobs.float_("LOCALAI_SLO_BURN_WARN")
+        crit = knobs.float_("LOCALAI_SLO_BURN_CRIT")
+        objectives: dict[str, dict] = {}
+
+        def state_of(burns: dict[str, float]) -> str:
+            lo = min(burns.values()) if burns else 0.0
+            if lo >= crit:
+                return "critical"
+            if lo >= warn:
+                return "warning"
+            return "ok"
+
+        # latency objectives: "q of requests complete under threshold"
+        threshold_ms = {
+            "ttft_p95": knobs.float_("LOCALAI_SLO_TTFT_P95_MS"),
+            "itl_p99": knobs.float_("LOCALAI_SLO_ITL_P99_MS"),
+        }
+        for name, key, q in (
+                ("ttft_p95", "ttft", 0.95),
+                ("itl_p99", "itl", 0.99)):
+            threshold_s = threshold_ms[name] / 1000.0
+            budget = 1.0 - q
+            windows = {}
+            burns = {}
+            for w, span in wins.items():
+                diff, _ = self._window_diff(key, now - span, now)
+                err, total = self._latency_error_rate(
+                    diff, dg.HIST_BOUNDS[key], threshold_s)
+                burn = err / budget
+                burns[w] = burn
+                windows[w] = {"window_s": span, "error_rate": round(err, 6),
+                              "events": total, "burn": round(burn, 3)}
+            objectives[name] = {
+                "threshold_ms": round(threshold_s * 1000.0, 3),
+                "budget": round(budget, 6), "windows": windows,
+                "state": state_of(burns)}
+
+        # availability: fraction of registered nodes not serving
+        target = min(0.999999, knobs.float_("LOCALAI_SLO_AVAILABILITY"))
+        budget = 1.0 - target
+        windows = {}
+        burns = {}
+        for w, span in wins.items():
+            err = self._offline_mean(now - span)
+            burn = err / budget
+            burns[w] = burn
+            windows[w] = {"window_s": span, "error_rate": round(err, 6),
+                          "burn": round(burn, 3)}
+        objectives["availability"] = {
+            "target": target, "budget": round(budget, 6),
+            "windows": windows, "state": state_of(burns)}
+
+        worst = max((o["state"] for o in objectives.values()),
+                    key=_STATES.index)
+        return {"state": worst, "burn_warn": warn, "burn_crit": crit,
+                "objectives": objectives}
+
+
+# --------------------------------------------------------- /fleet/metrics
+
+
+def render_fleet(nodes: list[dict], merged: dict,
+                 slo_eval: Optional[dict] = None) -> str:
+    """Prometheus 0.0.4 page for ``GET /fleet/metrics``. ``nodes`` is a
+    list of balancer-side node views::
+
+        {"node": str, "digest": dict|None, "age_s": float|None,
+         "stale": bool, "in_flight": int}
+
+    ``merged`` is the exact bucket-merge of every last-good digest.
+    Built on a throwaway Registry per scrape (node churn can never
+    accumulate label sets); histogram children are loaded from raw
+    digest counts via ``Histogram.load``.
+    """
+    reg = Registry()
+    cap = max(len(nodes) + 1, 8)
+    ttft = reg.histogram(
+        "fleet_ttft_seconds",
+        "Fleet-wide TTFT, exact bucket merge of per-node digests",
+        buckets=dg.HIST_BOUNDS["ttft"])
+    itl = reg.histogram(
+        "fleet_itl_seconds",
+        "Fleet-wide inter-token latency, exact digest bucket merge",
+        buckets=dg.HIST_BOUNDS["itl"])
+    qwait = reg.histogram(
+        "fleet_queue_wait_seconds",
+        "Fleet-wide queue wait, exact digest bucket merge",
+        buckets=dg.HIST_BOUNDS["queue_wait"])
+    for fam, key in ((ttft, "ttft"), (itl, "itl"),
+                     (qwait, "queue_wait")):
+        fam.load(merged["hist"][key]["c"], merged["hist"][key]["s"])
+
+    g_queue = reg.gauge(
+        "fleet_node_queue_depth_count",
+        "Queued requests per node (digest occupancy)",
+        labels=("node",), max_label_sets=cap)
+    g_busy = reg.gauge(
+        "fleet_node_slots_busy_count",
+        "Busy engine slots per node (digest occupancy)",
+        labels=("node",), max_label_sets=cap)
+    g_slots = reg.gauge(
+        "fleet_node_slots_count",
+        "Total engine slots per node (digest occupancy)",
+        labels=("node",), max_label_sets=cap)
+    g_mfu = reg.gauge(
+        "fleet_node_mfu_ratio",
+        "Mean engine MFU per node (digest cost-model EWMA)",
+        labels=("node",), max_label_sets=cap)
+    g_hbm = reg.gauge(
+        "fleet_node_hbm_bytes",
+        "Per-node HBM ledger bytes by component (digest)",
+        labels=("node", "component"), max_label_sets=cap * 8)
+    g_kv = reg.gauge(
+        "fleet_node_kv_pages_count",
+        "Per-node KV pages by tier (hot = HBM, warm = host RAM)",
+        labels=("node", "tier"), max_label_sets=cap * 2)
+    g_models = reg.gauge(
+        "fleet_node_models_loaded_count",
+        "Loaded models per node (digest)",
+        labels=("node",), max_label_sets=cap)
+    g_drain = reg.gauge(
+        "fleet_node_predicted_drain_seconds",
+        "Predicted queue-drain seconds per node (cost-model predictor; "
+        "absent when the node reports none)",
+        labels=("node",), max_label_sets=cap)
+    g_inflight = reg.gauge(
+        "fleet_node_in_flight_count",
+        "Requests the balancer currently has in flight to each node",
+        labels=("node",), max_label_sets=cap)
+    g_age = reg.gauge(
+        "fleet_digest_age_seconds",
+        "Seconds since each node's last good digest (-1 = never)",
+        labels=("node",), max_label_sets=cap)
+    g_stale = reg.gauge(
+        "fleet_digest_stale_count",
+        "Nodes whose digest is missing or older than "
+        "LOCALAI_DIGEST_STALE_S")
+    g_nodes = reg.gauge(
+        "fleet_nodes_count", "Registered federation nodes")
+    g_serving = reg.gauge(
+        "fleet_nodes_serving_count",
+        "Registered nodes currently online with a closed/half-open "
+        "breaker")
+
+    stale = 0
+    serving = 0
+    for nv in nodes:
+        node = nv["node"]
+        g_inflight.labels(node=node).set(nv.get("in_flight", 0))
+        age = nv.get("age_s")
+        g_age.labels(node=node).set(-1.0 if age is None else age)
+        if nv.get("stale", True):
+            stale += 1
+        if nv.get("serving"):
+            serving += 1
+        d = nv.get("digest")
+        if d is None:
+            continue
+        occ = d["occ"]
+        g_queue.labels(node=node).set(occ.get("queue_depth", 0))
+        g_busy.labels(node=node).set(occ.get("slots_busy", 0))
+        g_slots.labels(node=node).set(occ.get("n_slots", 0))
+        mfu = dg.mfu_mean(d)
+        if mfu is not None:
+            g_mfu.labels(node=node).set(mfu)
+        for comp, v in d.get("hbm", {}).items():
+            g_hbm.labels(node=node, component=comp).set(v)
+        for tier, v in d.get("kv_pages", {}).items():
+            g_kv.labels(node=node, tier=tier).set(v)
+        g_models.labels(node=node).set(len(d.get("models", [])))
+        if d.get("drain_s") is not None:
+            g_drain.labels(node=node).set(d["drain_s"])
+    g_stale.set(stale)
+    g_nodes.set(len(nodes))
+    g_serving.set(serving)
+
+    if slo_eval is not None:
+        g_burn = reg.gauge(
+            "fleet_slo_burn_rate_ratio",
+            "SLO burn rate (windowed error rate / error budget) per "
+            "objective and window; >= LOCALAI_SLO_BURN_CRIT in BOTH "
+            "windows is critical",
+            labels=("objective", "window"), max_label_sets=16)
+        g_state = reg.gauge(
+            "fleet_slo_state_info",
+            "Current SLO state per objective (1 on the active row)",
+            labels=("objective", "state"), max_label_sets=32)
+        for name, obj in slo_eval["objectives"].items():
+            for w, wv in obj["windows"].items():
+                g_burn.labels(objective=name, window=w).set(wv["burn"])
+            for st in _STATES:
+                g_state.labels(objective=name, state=st).set(
+                    1.0 if obj["state"] == st else 0.0)
+    return reg.render()

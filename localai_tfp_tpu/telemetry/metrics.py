@@ -288,6 +288,16 @@ FEDERATION_RETRIES = REGISTRY.counter(
     "bytes streamed so no retry was possible)",
     labels=("outcome",),
 )
+FEDERATION_DIGEST_ERRORS = REGISTRY.counter(
+    "federation_digest_errors_total",
+    "Per-node telemetry digests the balancer rejected, by reason "
+    "(fetch = probe GET failed, oversize = body past "
+    "LOCALAI_DIGEST_MAX_BYTES, version = unknown DIGEST_VERSION, "
+    "malformed = schema violation) — the node's last GOOD digest is "
+    "kept with its age; /fleet/metrics and routing never break on a "
+    "bad digest",
+    labels=("reason",),
+)
 FAULTS_INJECTED = REGISTRY.counter(
     "faults_injected_total",
     "Faults actually delivered by armed LOCALAI_FAULTS injection points "

@@ -251,6 +251,20 @@ class Histogram(_Family):
                 exemplar: Optional[dict] = None) -> None:
         self._solo().observe(v, exemplar)
 
+    def load(self, counts, sum_: float, **labelvalues) -> None:
+        """Overwrite a child's raw bucket counts and sum wholesale —
+        the fleet exposition path (telemetry/fleet.py) loads MERGED
+        digest bucket counts into a throwaway registry this way;
+        ``observe`` stays the one-sample live path. Short/long inputs
+        pad/truncate to the schema length, negatives clamp to zero."""
+        child = self.labels(**labelvalues)
+        n = len(self.buckets) + 1
+        vals = [max(0, int(x)) for x in list(counts)[:n]]
+        vals += [0] * (n - len(vals))
+        with self._lock:
+            child.counts = vals
+            child.sum = max(0.0, float(sum_))
+
     @staticmethod
     def _exemplar_str(ex: tuple) -> str:
         labels, value, ts = ex

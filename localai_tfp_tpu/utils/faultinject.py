@@ -3,8 +3,9 @@
 Named injection points are compiled into the failure-prone layers —
 the engine device-step funnel (``engine.device_step``), the model
 loader (``loader.load``), the multihost dispatch channel
-(``multihost.publish``), and the federated proxy
-(``federated.upstream`` / ``federated.midstream``) — and armed via
+(``multihost.publish``), the federated proxy
+(``federated.upstream`` / ``federated.midstream``), and the balancer's
+telemetry-digest probe fetch (``federated.digest``) — and armed via
 
     LOCALAI_FAULTS="point:spec[,point:spec...]"
 

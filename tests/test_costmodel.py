@@ -33,7 +33,7 @@ def served_engine(model):
     so it runs once per module."""
     spec, params, tk = model
     eng = LLMEngine(spec, params, tk, n_slots=4, max_seq=128,
-                    prefill_buckets=(8, 32, 128),
+                    prefill_buckets=(8, 32),
                     cache_dtype=jnp.float32, tag="costmodel-test")
     eng.warmup()
     for i in range(2):

@@ -112,6 +112,10 @@ def test_timings_populated(model):
     assert ev.timing_token_generation_ms > 0
 
 
+# slow tier: concurrency storms live in test_engine_stress (same
+# tier); tier-1 keeps test_more_requests_than_slots for multi-wave
+# serving
+@pytest.mark.slow
 def test_concurrent_requests_isolated(model):
     """Concurrent slot-batched decode must produce exactly what each request
     produces when it runs alone (slot isolation, ref: llama.cpp slots)."""

@@ -229,7 +229,8 @@ def _counter(family, **labels):
 def test_connect_failure_retries_next_node_and_exhausts():
     """A dead upstream (connect refused — no bytes streamed) is retried
     onto the next eligible node transparently; when every node fails
-    the client gets one clean 502."""
+    the client gets one clean 429 with a Retry-After priced from the
+    fleet's own state (breaker backoff when no digest knows better)."""
     loop = asyncio.new_event_loop()
 
     async def go():
@@ -272,11 +273,15 @@ def test_connect_failure_retries_next_node_and_exhausts():
         assert entries["a-dead"]["last_error"]
         assert entries["b-live"]["state"] == "closed"
 
-        # kill the live node too: retries exhaust into a single 502
+        # kill the live node too: retries exhaust into a single 429
+        # with a Retry-After hint (satellite-3 shed aggregation — a
+        # fleet that EXISTS but cannot serve is a capacity condition,
+        # not a gateway error)
         await live.close()
         exhausted0 = _counter(tm.FEDERATION_RETRIES, outcome="exhausted")
         r = await client.post("/v1/models", data=b"x")
-        assert r.status == 502
+        assert r.status == 429
+        assert int(r.headers["Retry-After"]) >= 1
         assert _counter(tm.FEDERATION_RETRIES,
                         outcome="exhausted") == exhausted0 + 1
 

@@ -1,0 +1,481 @@
+"""Fleet telemetry plane: digest merge algebra, heartbeat/probe digest
+carriage, decode hardening, the SLO burn-rate monitor, and the
+balancer's /fleet/* endpoints (ISSUE 17)."""
+
+import asyncio
+import json
+import time
+from bisect import bisect_left
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from localai_tfp_tpu.parallel.federated import (
+    FederatedServer, NodeRegistry, generate_token,
+)
+from localai_tfp_tpu.telemetry import digest as dg
+from localai_tfp_tpu.telemetry import fleet as fleetmod
+from localai_tfp_tpu.telemetry import metrics as tm
+from localai_tfp_tpu.telemetry.registry import Registry
+from localai_tfp_tpu.utils import faultinject as fi
+
+from tests.test_telemetry import parse_prom, validate_families
+
+
+@pytest.fixture(autouse=True)
+def _faults_disarmed():
+    fi.disarm()
+    yield
+    fi.disarm()
+
+
+def _hist_from(vals, key="ttft"):
+    """Digest-shaped histogram from dense observations (the oracle's
+    view of what a node's registry histogram would hold)."""
+    bounds = dg.HIST_BOUNDS[key]
+    counts = [0] * (len(bounds) + 1)
+    for v in vals:
+        counts[bisect_left(bounds, v)] += 1
+    return {"c": counts, "s": round(sum(vals), 6)}
+
+
+def _digest(ttft=(), itl=(), queue_wait=(), **kw):
+    return dg.build(hist={"ttft": _hist_from(ttft),
+                          "itl": _hist_from(itl, "itl"),
+                          "queue_wait": _hist_from(queue_wait)}, **kw)
+
+
+def _counter(family, **labels):
+    return family.labels(**labels).value
+
+
+# ----------------------------------------------------------- merge algebra
+
+
+def test_merge_identity_commutative_associative():
+    a = _digest(ttft=[0.02, 0.3, 7.0], itl=[0.004], queue_depth=3,
+                slots_busy=2, n_slots=4, mfu=[0.5, 0.7],
+                hbm={"params": 100, "kv": 50}, models=["m1"],
+                drain_s=2.0, prefixes=[("aa", 9), ("bb", 4)])
+    b = _digest(ttft=[0.5], queue_wait=[0.001, 0.2], queue_depth=1,
+                n_slots=2, mfu=[0.1], hbm={"kv": 25}, models=["m2"],
+                prefixes=[("aa", 3), ("cc", 7)])
+    c = _digest(itl=[0.08, 0.3], models=["m1", "m3"], drain_s=5.5,
+                prefixes=[("dd", 1)])
+    e = dg.empty()
+    # identity, both sides, byte-exact
+    assert dg.encode(dg.merge(a, e)) == dg.encode(a)
+    assert dg.encode(dg.merge(e, a)) == dg.encode(a)
+    # commutative + associative, byte-exact
+    assert dg.encode(dg.merge(a, b)) == dg.encode(dg.merge(b, a))
+    assert dg.encode(dg.merge(dg.merge(a, b), c)) == \
+        dg.encode(dg.merge(a, dg.merge(b, c)))
+
+    m = dg.merge_all([a, b, c])
+    # merged histogram counts are exact sums
+    for k in dg.HIST_BOUNDS:
+        want = [x + y + z for x, y, z in zip(
+            a["hist"][k]["c"], b["hist"][k]["c"], c["hist"][k]["c"])]
+        assert m["hist"][k]["c"] == want
+    assert m["occ"]["queue_depth"] == 4
+    assert m["occ"]["n_slots"] == 6
+    # MFU merges as (sum, n) so the fleet mean is the exact sample mean
+    assert dg.mfu_mean(m) == pytest.approx((0.5 + 0.7 + 0.1) / 3)
+    assert m["drain_s"] == 5.5  # max across nodes
+    assert m["models"] == ["m1", "m2", "m3"]
+    # prefix top-k: dedup by hash keeps the max count
+    assert ["aa", 9] in m["prefixes"] and ["cc", 7] in m["prefixes"]
+    assert ["aa", 3] not in m["prefixes"]
+
+
+def test_fleet_p95_within_one_bucket_of_dense_oracle():
+    # three nodes, deterministic skewed latencies
+    node_vals = [
+        [0.003 * i for i in range(1, 40)],
+        [0.05 + 0.02 * i for i in range(30)],
+        [0.4, 0.9, 1.7, 3.0, 8.0, 20.0],
+    ]
+    merged = dg.merge_all(_digest(ttft=vals) for vals in node_vals)
+    import math
+    dense = sorted(v for vals in node_vals for v in vals)
+    # nearest-rank p95 (rank = ceil(q*n), the estimator the digest uses)
+    oracle = dense[max(0, math.ceil(0.95 * len(dense)) - 1)]
+    lo, hi = dg.percentile_bounds(merged["hist"], "ttft", 0.95)
+    # the true quantile lies INSIDE the reported bucket: any point in
+    # [lo, hi] is within one bucket width of the dense oracle
+    assert lo <= oracle <= hi
+    assert dg.percentile(merged["hist"], "ttft", 0.95) == hi
+
+
+def test_digest_roundtrip_and_size_cap(monkeypatch):
+    d = _digest(ttft=[0.01, 0.5], models=["m1", "m2"], mfu=[0.4],
+                prefixes=[("ab", 5)], drain_s=1.25)
+    raw = dg.encode(d)
+    back = dg.decode(raw)
+    assert dg.encode(back) == raw  # wire round-trip is stable
+    assert len(raw) <= dg._max_bytes()
+
+    # build sheds detail (prefixes first, then models) to honor the cap
+    monkeypatch.setenv("LOCALAI_DIGEST_MAX_BYTES", "600")
+    big = dg.build(models=[f"model-{i:04d}" for i in range(200)],
+                   prefixes=[(f"{i:016x}", i) for i in range(500)])
+    assert len(dg.encode(big)) <= 600
+    assert dg.decode(dg.encode(big))  # still a valid digest
+
+
+def test_decode_rejects_bad_payloads():
+    with pytest.raises(dg.DigestError) as ei:
+        dg.decode(b"\xff\x00 not json")
+    assert ei.value.reason == "malformed"
+    with pytest.raises(dg.DigestError) as ei:
+        dg.decode(b"x" * (dg._max_bytes() + 1))
+    assert ei.value.reason == "oversize"
+    old = dg.empty()
+    old["v"] = 0  # a pre-versioned node gossiping stale boundaries
+    with pytest.raises(dg.DigestError) as ei:
+        dg.decode(dg.encode(old))
+    assert ei.value.reason == "version"
+    broken = dg.empty()
+    broken["hist"]["ttft"]["c"] = [-1] * len(broken["hist"]["ttft"]["c"])
+    with pytest.raises(dg.DigestError) as ei:
+        dg.validate(broken)
+    assert ei.value.reason == "malformed"
+
+
+# ------------------------------------------------- registry digest carriage
+
+
+def test_announce_attaches_digest_and_bad_digests_keep_last_good():
+    tok = generate_token()
+    reg = NodeRegistry(tok)
+    good = _digest(ttft=[0.1], models=["m1"])
+    assert reg.announce(tok, "n1", "n1", "http://a", digest=good)
+    n = reg._nodes["n1"]
+    assert n.digest is not None and n.digest_src == "announce"
+    assert n.digest["models"] == ["m1"]
+    assert n.digest_age() is not None and n.digest_age() < 5
+
+    # wrong version: counted + skipped, last good digest survives
+    v0 = _counter(tm.FEDERATION_DIGEST_ERRORS, reason="version")
+    old = dg.empty()
+    old["v"] = 99
+    assert reg.announce(tok, "n1", "n1", "http://a", digest=old)
+    assert n.digest["models"] == ["m1"]
+    assert _counter(tm.FEDERATION_DIGEST_ERRORS,
+                    reason="version") == v0 + 1
+
+    # malformed: same containment — and the node's breaker/error state
+    # is untouched (digest errors never feed routing)
+    m0 = _counter(tm.FEDERATION_DIGEST_ERRORS, reason="malformed")
+    assert reg.announce(tok, "n1", "n1", "http://a",
+                        digest={"v": dg.DIGEST_VERSION})
+    assert n.digest["models"] == ["m1"]
+    assert n.consec_failures == 0 and n.last_error == ""
+    assert _counter(tm.FEDERATION_DIGEST_ERRORS,
+                    reason="malformed") == m0 + 1
+
+    # oversize raw bytes on the probe path
+    o0 = _counter(tm.FEDERATION_DIGEST_ERRORS, reason="oversize")
+    assert not reg.store_digest(n, b"x" * (dg._max_bytes() + 1))
+    assert n.digest["models"] == ["m1"]
+    assert _counter(tm.FEDERATION_DIGEST_ERRORS,
+                    reason="oversize") == o0 + 1
+
+
+def test_digest_staleness_horizon(monkeypatch):
+    tok = generate_token()
+    reg = NodeRegistry(tok)
+    reg.announce(tok, "n1", "n1", "http://a", digest=dg.empty())
+    n = reg._nodes["n1"]
+    assert not n.digest_stale()
+    monkeypatch.setenv("LOCALAI_DIGEST_STALE_S", "10")
+    n.digest_at -= 60
+    assert n.digest_stale()
+    # a node that never sent one is stale by definition
+    reg.announce(tok, "n2", "n2", "http://b")
+    assert reg._nodes["n2"].digest_stale()
+    assert reg._nodes["n2"].digest_age() is None
+
+
+# -------------------------------------------------------- SLO burn rates
+
+
+def _slo_env(monkeypatch, **over):
+    env = {"LOCALAI_SLO_FAST_WINDOW_S": "1",
+           "LOCALAI_SLO_SLOW_WINDOW_S": "5",
+           "LOCALAI_SLO_TTFT_P95_MS": "100",
+           "LOCALAI_SLO_AVAILABILITY": "0.99"}
+    env.update(over)
+    for k, v in env.items():
+        monkeypatch.setenv(k, str(v))
+
+
+def test_slo_availability_burn_transitions(monkeypatch):
+    _slo_env(monkeypatch)
+    mon = fleetmod.SLOMonitor()
+    t = 1000.0
+    for i in range(12):  # healthy half-minute: all nodes serving
+        mon.record(dg.empty(), 0.0, now=t + i * 0.5)
+    t += 6.0
+    out = mon.evaluate(now=t)
+    assert out["objectives"]["availability"]["state"] == "ok"
+    assert out["state"] == "ok"
+    # a third of the fleet goes dark and STAYS dark: both windows burn
+    for i in range(12):
+        mon.record(dg.empty(), 1 / 3, now=t + i * 0.5)
+    out = mon.evaluate(now=t + 6.0)
+    avail = out["objectives"]["availability"]
+    # error rate 0.33 against a 0.01 budget: way past critical in both
+    assert avail["windows"]["fast"]["burn"] > 14.4
+    assert avail["windows"]["slow"]["burn"] > 14.4
+    assert avail["state"] == "critical"
+    assert out["state"] == "critical"
+
+
+def test_slo_latency_burn_needs_both_windows(monkeypatch):
+    _slo_env(monkeypatch)
+    mon = fleetmod.SLOMonitor()
+    t = 2000.0
+    good = _digest(ttft=[0.01] * 50)
+    mon.record(good, 0.0, now=t)
+    # a NEW burst of slow requests (cumulative counts grow): every
+    # added request lands in a bucket above the 100 ms threshold
+    cum = [0.01] * 50
+    for i in range(12):
+        cum = cum + [0.9] * 4
+        mon.record(_digest(ttft=cum), 0.0, now=t + 0.5 * (i + 1))
+    out = mon.evaluate(now=t + 6.0)
+    ttft = out["objectives"]["ttft_p95"]
+    # windowed error rate is 1.0 (all NEW requests were slow): burn =
+    # 1.0 / 0.05 = 20 in both windows -> critical
+    assert ttft["windows"]["fast"]["error_rate"] == pytest.approx(1.0)
+    assert ttft["state"] == "critical"
+
+    # fast recovery: new requests are all good again -> the FAST window
+    # clears while the slow window still burns; min() gates the state
+    # back down (fast-alone or slow-alone never escalates)
+    for i in range(4):
+        cum = cum + [0.01] * 10
+        mon.record(_digest(ttft=cum), 0.0, now=t + 6.0 + 0.3 * (i + 1))
+    out = mon.evaluate(now=t + 7.5)
+    ttft = out["objectives"]["ttft_p95"]
+    assert ttft["windows"]["fast"]["burn"] < 6
+    assert ttft["windows"]["slow"]["burn"] > 6
+    assert ttft["state"] == "ok"
+
+
+def test_slo_counter_reset_clamps(monkeypatch):
+    _slo_env(monkeypatch)
+    mon = fleetmod.SLOMonitor()
+    t = 3000.0
+    mon.record(_digest(ttft=[5.0] * 40), 0.0, now=t)
+    # a node restart zeroes its histograms: merged counts DROP
+    mon.record(_digest(ttft=[5.0] * 2), 0.0, now=t + 0.5)
+    out = mon.evaluate(now=t + 0.6)
+    for w in out["objectives"]["ttft_p95"]["windows"].values():
+        assert w["burn"] >= 0.0  # clamped, never negative
+
+
+# ------------------------------------------------- balancer fleet endpoints
+
+
+def _fake_member(digest_obj, status=200):
+    """Member stub serving /healthz + /telemetry/digest (+ 429 shed on
+    everything else when status says so)."""
+    async def healthz(request):
+        return web.json_response({"ok": True})
+
+    async def telemetry(request):
+        if isinstance(digest_obj, (bytes, bytearray)):
+            return web.Response(body=bytes(digest_obj),
+                                content_type="application/json")
+        return web.json_response(digest_obj)
+
+    async def catchall(request):
+        if status == 429:
+            return web.Response(status=429, headers={"Retry-After": "7"})
+        return web.json_response({"ok": True})
+
+    app = web.Application()
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/telemetry/digest", telemetry)
+    app.router.add_route("*", "/{tail:.*}", catchall)
+    return TestServer(app)
+
+
+def test_probe_refreshes_digest_and_faultinject_point():
+    loop = asyncio.new_event_loop()
+
+    async def go():
+        member = _fake_member(_digest(ttft=[0.05], models=["probe-m"]))
+        await member.start_server()
+        tok = generate_token()
+        fed = FederatedServer(tok, probe_s=0.05)
+        client = TestClient(TestServer(fed.build_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/federation/register", json={
+                "token": tok, "id": "n1", "name": "n1",
+                "address": f"http://127.0.0.1:{member.port}"})
+            assert r.status == 200
+            n = fed.registry._nodes["n1"]
+            t0 = time.monotonic()
+            while n.digest_src != "probe" and time.monotonic() - t0 < 5:
+                await asyncio.sleep(0.02)
+            assert n.digest_src == "probe"
+            assert n.digest["models"] == ["probe-m"]
+
+            # armed digest faults: counted as fetch errors, last good
+            # kept, and the breaker NEVER sees them (satellite-1)
+            f0 = _counter(tm.FEDERATION_DIGEST_ERRORS, reason="fetch")
+            fi.arm("federated.digest:fail")
+            await asyncio.sleep(0.3)
+            fi.disarm()
+            assert _counter(tm.FEDERATION_DIGEST_ERRORS,
+                            reason="fetch") > f0
+            assert n.digest["models"] == ["probe-m"]
+            assert fed.registry.state(n) == "closed"
+            assert n.consec_failures == 0
+
+            # /fleet/metrics survives the fault storm and still renders
+            r = await client.get("/fleet/metrics")
+            assert r.status == 200
+        finally:
+            await client.close()
+            await member.close()
+
+    loop.run_until_complete(go())
+    loop.close()
+
+
+def test_fleet_metrics_exposition_and_endpoint_hygiene():
+    loop = asyncio.new_event_loop()
+
+    async def go():
+        tok = generate_token()
+        fed = FederatedServer(tok, probe_s=0)
+        client = TestClient(TestServer(fed.build_app()))
+        await client.start_server()
+        try:
+            d1 = _digest(ttft=[0.02, 0.2], itl=[0.004], queue_depth=2,
+                         slots_busy=1, n_slots=4, mfu=[0.5],
+                         hbm={"kv": 1000}, kv_pages={"hot": 8, "warm": 3},
+                         models=["m1"], drain_s=1.5)
+            d2 = _digest(ttft=[4.0], queue_wait=[0.3], n_slots=2,
+                         models=["m2"])
+            for nid, d in (("n1", d1), ("n2", d2)):
+                r = await client.post("/federation/register", json={
+                    "token": tok, "id": nid, "name": nid,
+                    "address": f"http://127.0.0.1:1{nid[-1]}",
+                    "digest": d})
+                assert r.status == 200
+
+            r = await client.get("/fleet/metrics")
+            assert r.status == 200
+            assert r.headers["Cache-Control"] == "no-store"
+            fams = parse_prom((await r.read()).decode())
+            validate_families(fams)
+            for fam in ("fleet_ttft_seconds", "fleet_itl_seconds",
+                        "fleet_queue_wait_seconds",
+                        "fleet_node_queue_depth_count",
+                        "fleet_node_slots_busy_count",
+                        "fleet_node_mfu_ratio", "fleet_node_hbm_bytes",
+                        "fleet_node_kv_pages_count",
+                        "fleet_node_predicted_drain_seconds",
+                        "fleet_digest_age_seconds",
+                        "fleet_digest_stale_count", "fleet_nodes_count",
+                        "fleet_slo_burn_rate_ratio",
+                        "fleet_slo_state_info"):
+                assert fam in fams, f"{fam} missing from /fleet/metrics"
+            # the fleet histogram is the EXACT bucket merge
+            count = [v for n, l, v in fams["fleet_ttft_seconds"]["samples"]
+                     if n == "fleet_ttft_seconds_count"][0]
+            assert count == 3  # 2 from n1 + 1 from n2
+            depth = {l["node"]: v for n, l, v in
+                     fams["fleet_node_queue_depth_count"]["samples"]}
+            assert depth == {"n1": 2.0, "n2": 0.0}
+
+            # /fleet/slo: JSON state view, no-store
+            r = await client.get("/fleet/slo")
+            assert r.status == 200
+            assert r.headers["Cache-Control"] == "no-store"
+            slo = await r.json()
+            assert slo["nodes"]["total"] == 2
+            assert set(slo["objectives"]) == {
+                "ttft_p95", "itl_p99", "availability"}
+
+            # /federation/nodes: digest summary + limit + no-store
+            r = await client.get("/federation/nodes")
+            assert r.headers["Cache-Control"] == "no-store"
+            nodes = await r.json()
+            assert len(nodes) == 2
+            assert nodes[0]["digest"]["models"] == ["m1"]
+            assert nodes[0]["digest"]["src"] == "announce"
+            r = await client.get("/federation/nodes?limit=1")
+            assert len(await r.json()) == 1
+            r = await client.get("/fleet/metrics?limit=1")
+            assert r.status == 200
+            r = await client.get("/federation/nodes?limit=bogus")
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+    loop.close()
+
+
+def test_all_nodes_shedding_aggregates_to_429_with_drain_hint():
+    """Satellite-3: members answering 429 at admission are a capacity
+    signal — the balancer aggregates them into one 429 whose
+    Retry-After is the minimum member hint, and no breaker is fed."""
+    loop = asyncio.new_event_loop()
+
+    async def go():
+        m1 = _fake_member(dg.empty(), status=429)
+        m2 = _fake_member(dg.empty(), status=429)
+        await m1.start_server()
+        await m2.start_server()
+        tok = generate_token()
+        fed = FederatedServer(tok, probe_s=0)
+        client = TestClient(TestServer(fed.build_app()))
+        await client.start_server()
+        try:
+            for nid, m in (("s1", m1), ("s2", m2)):
+                r = await client.post("/federation/register", json={
+                    "token": tok, "id": nid, "name": nid,
+                    "address": f"http://127.0.0.1:{m.port}"})
+                assert r.status == 200
+            r = await client.post("/v1/models", data=b"x")
+            assert r.status == 429
+            assert int(r.headers["Retry-After"]) == 7  # min member hint
+            for nid in ("s1", "s2"):
+                n = fed.registry._nodes[nid]
+                assert n.consec_failures == 0  # sheds never feed it
+                assert fed.registry.state(n) == "closed"
+        finally:
+            await client.close()
+            await m1.close()
+            await m2.close()
+
+    loop.run_until_complete(go())
+    loop.close()
+
+
+# ------------------------------------------------------------ registry glue
+
+
+def test_histogram_load_clamps_and_renders():
+    reg = Registry()
+    h = reg.histogram("x_seconds", "h", buckets=(0.1, 1.0))
+    h.load([1, -5, 2, 99, 99], 3.5)  # negative clamps, extra truncates
+    text = reg.render()
+    fams = parse_prom(text)
+    validate_families(fams)
+    samples = {(n, l.get("le")): v
+               for n, l, v in fams["x_seconds"]["samples"]}
+    assert samples[("x_seconds_bucket", "0.1")] == 1
+    assert samples[("x_seconds_bucket", "1")] == 1  # cumulative, -5 -> 0
+    assert samples[("x_seconds_bucket", "+Inf")] == 3
+    assert samples[("x_seconds_count", None)] == 3
+    assert samples[("x_seconds_sum", None)] == 3.5

@@ -217,6 +217,9 @@ def test_mixed_load_no_starvation_decode_priority(model):
         f'engine_decode_stall_seconds_count{{model="{m}"}}', 0.0) > 0
 
 
+# slow tier: grammar + logit-bias through batched rows is tier-1 on
+# the current dispatch path in test_ragged_attention
+@pytest.mark.slow
 def test_grammar_and_logit_bias_ride_mixed_dispatches(model):
     """Host-interactive slots (grammar constraint, logit-bias ban) keep
     draining correctly while another stream decodes: their masks ride

@@ -155,6 +155,9 @@ def test_follower_replays_prefix_reuse_and_respects_channel_guards(model):
     assert not os.path.exists("/tmp/should-not-be-written.npz")
 
 
+# slow tier: wall-clock stall detection is timing-sensitive on shared
+# CI; follower replay correctness stays tier-1 in this module
+@pytest.mark.slow
 def test_follower_load_does_not_stall_other_model(model):
     """VERDICT r1 weak #3: loading model B on the follower must NOT
     pause model A's in-flight replay — A keeps decoding during B's load

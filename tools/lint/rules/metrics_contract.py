@@ -57,6 +57,19 @@ REQUIRED_FAMILIES = {
     "engine_deadline_exceeded_total",
     "federation_node_state_count",
     "federation_retries_total",
+    "federation_digest_errors_total",
+    "fleet_ttft_seconds",
+    "fleet_itl_seconds",
+    "fleet_queue_wait_seconds",
+    "fleet_node_queue_depth_count",
+    "fleet_node_slots_busy_count",
+    "fleet_node_mfu_ratio",
+    "fleet_node_hbm_bytes",
+    "fleet_node_predicted_drain_seconds",
+    "fleet_digest_age_seconds",
+    "fleet_digest_stale_count",
+    "fleet_slo_burn_rate_ratio",
+    "fleet_slo_state_info",
     "faults_injected_total",
     "engine_device_step_seconds",
     "trace_spans_dropped_total",

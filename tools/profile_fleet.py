@@ -1,0 +1,417 @@
+"""Fleet profile: the telemetry digest plane measured end to end.
+
+Boots N REAL member servers (subprocesses, tiny CPU checkpoint) behind
+an in-process federated balancer, drives mixed streaming traffic
+through the balancer, and prints one JSON report with the acceptance
+numbers the fleet-telemetry PR tracks:
+
+  percentile cross-check:
+    client_ttft_p95_s       — p95 of client-measured time-to-first-
+                              content-chunk across every request
+    fleet_ttft_p95_bounds_s — the bucket holding p95 in the balancer's
+                              merged digest histogram (/fleet/metrics)
+    ttft_within_one_bucket  — |client bucket - digest bucket| <= 1:
+                              exact bucket merges put the fleet p95
+                              within one histogram bucket of what
+                              clients actually saw (the contract that
+                              forbids averaging per-node percentiles)
+
+  digest plane health:
+    digest_bytes_max        — largest /telemetry/digest body observed
+                              (contract: <= LOCALAI_DIGEST_MAX_BYTES)
+    digest_age_max_s        — staleness across nodes right after the
+                              traffic wave (probe-refreshed, so this
+                              tracks the probe interval, not the 20 s
+                              heartbeat)
+    load_skew               — max(requests_served) / mean — least-used
+                              routing should keep this near 1.0
+
+  SLO burn-rate monitor:
+    slo_flip_latency_s      — kill one member; seconds until the
+                              availability objective on /fleet/slo
+                              leaves "ok" (fast/slow windows shrunk via
+                              env so the flip is observable in a smoke)
+    slo_flip_within_2_probes— latency <= 2 probe intervals (+ sched
+                              slack): the first failed probe marks the
+                              node not-serving, the second confirms
+    metrics_served_during_kill — /fleet/metrics kept answering 200
+                              while the fleet was degraded
+
+Run:  python tools/profile_fleet.py [--members N] [--requests N]
+                                    [--probe-s S] [--json]
+
+CPU smoke (what CI can afford):  python tools/profile_fleet.py --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import math
+import os
+import re
+import socket
+import sys
+import tempfile
+import time
+from bisect import bisect_left
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# SLO windows shrunk so a burn-rate flip is observable inside a smoke
+# run; generous TTFT threshold so first-request compiles cannot push
+# the latency objective into warning and muddy the availability check
+_SMOKE_ENV = {
+    "LOCALAI_SLO_FAST_WINDOW_S": "1",
+    "LOCALAI_SLO_SLOW_WINDOW_S": "5",
+    "LOCALAI_SLO_TTFT_P95_MS": "30000",
+    "LOCALAI_SLO_ITL_P99_MS": "30000",
+}
+
+_TINY_YAML = """
+name: tiny
+backend: jax-llm
+parameters:
+  model: tiny-ckpt
+  temperature: 0.0
+  max_tokens: 16
+context_size: 128
+max_batch_slots: 2
+dtype: float32
+template:
+  completion: "{{.Input}}"
+  chat_message: "{{.RoleName}}: {{.Content}}"
+  chat: "{{.Input}}\\nassistant:"
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _make_models(models_dir: str) -> None:
+    """Tiny torch Llama checkpoint + config: real jax-llm members that
+    boot (and first-request compile) in seconds on CPU."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=300, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256,
+    )).save_pretrained(os.path.join(models_dir, "tiny-ckpt"),
+                       safe_serialization=True)
+    with open(os.path.join(models_dir, "tiny.yaml"), "w") as f:
+        f.write(_TINY_YAML)
+
+
+def _spawn_member(models_dir: str, cwd: str, port: int, *,
+                  balancer_url: str, token: str, name: str):
+    """One REAL member: announces itself (digest riding the heartbeat)
+    and serves the balancer's /healthz + /telemetry/digest probes."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["LOCALAI_WARMUP"] = "0"  # skip warmup decode: fast boot
+    env["LOCALAI_NODE_NAME"] = name
+    env.pop("LOCALAI_FAULTS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                  if p])
+    return subprocess.Popen(
+        [sys.executable, "-m", "localai_tfp_tpu.cli", "run",
+         "--models-path", models_dir, "--address", "127.0.0.1",
+         "--port", str(port),
+         "--p2p-token", token,
+         "--federated-server", balancer_url,
+         "--advertise-address", f"http://127.0.0.1:{port}"],
+        cwd=cwd, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT)
+
+
+async def _wait_ready(session, base: str, timeout_s: float = 180.0) -> None:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        try:
+            async with session.get(base + "/readyz") as r:
+                if r.status == 200:
+                    return
+        except Exception:
+            pass
+        await asyncio.sleep(0.3)
+    raise TimeoutError(f"member {base} never became ready")
+
+
+async def _chat_ttft(client, prompt: str, max_tokens: int) -> float:
+    """One streaming chat completion through the balancer; returns the
+    client-measured time to the first GENERATED event — the first chunk
+    after the role preamble (which is written before generation
+    starts). A tiny random checkpoint can emit tokens whose bytes decode
+    to empty text, so the finish chunk is an accepted (late) fallback —
+    at smoke token counts it lands in the same log bucket."""
+    t0 = time.perf_counter()
+    resp = await client.request(
+        "POST", "/v1/chat/completions",
+        json={"model": "tiny", "stream": True, "max_tokens": max_tokens,
+              "messages": [{"role": "user", "content": prompt}]})
+    assert resp.status == 200, f"proxy status {resp.status}"
+    ttft = None
+    async for raw in resp.content:
+        line = raw.decode("utf-8", "replace").strip()
+        if not line.startswith("data: ") or line == "data: [DONE]":
+            continue
+        try:
+            ev = json.loads(line[len("data: "):])
+        except ValueError:
+            continue
+        choice = (ev.get("choices") or [{}])[0]
+        delta = choice.get("delta") or {}
+        finish = choice.get("finish_reason")
+        if finish == "error":
+            raise RuntimeError(f"stream errored: {ev}")
+        if ttft is None and "role" not in delta and (
+                delta.get("content") or finish is not None):
+            ttft = time.perf_counter() - t0
+    resp.release()
+    if ttft is None:
+        raise RuntimeError("stream produced no generated event")
+    return ttft
+
+
+def _prom_hist(text: str, family: str) -> list[tuple[float, float]]:
+    """[(le, cumulative_count)] rows of one un-labelled fleet histogram
+    from a Prometheus 0.0.4 page, in exposition order."""
+    rows = []
+    for m in re.finditer(
+            rf'^{family}_bucket\{{le="([^"]+)"\}}\s+(\S+)$', text, re.M):
+        le = m.group(1)
+        rows.append((float("inf") if le == "+Inf" else float(le),
+                     float(m.group(2))))
+    return rows
+
+
+def _cum_p95_index(rows: list[tuple[float, float]], q: float) -> int:
+    """Bucket index holding the q-quantile of a cumulative histogram."""
+    total = rows[-1][1] if rows else 0.0
+    if total <= 0:
+        return 0
+    rank = max(1.0, math.ceil(q * total))
+    for i, (_le, cum) in enumerate(rows):
+        if cum >= rank:
+            return i
+    return len(rows) - 1
+
+
+async def fleet_leg(n_members: int = 3, probe_s: float = 0.5,
+                    n_requests: int = 18) -> dict:
+    import aiohttp
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from localai_tfp_tpu.parallel.federated import (
+        FederatedServer, generate_token,
+    )
+    from localai_tfp_tpu.telemetry import digest as dg
+
+    saved = {k: os.environ.get(k) for k in _SMOKE_ENV}
+    os.environ.update(_SMOKE_ENV)
+    out: dict = {"members": n_members, "probe_s": probe_s,
+                 "requests": n_requests}
+    members: list = []
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            models = os.path.join(tmp, "models")
+            os.makedirs(models)
+            _make_models(models)
+
+            tok = generate_token()
+            fed = FederatedServer(tok, probe_s=probe_s)
+            client = TestClient(TestServer(fed.build_app()))
+            await client.start_server()
+            balancer_url = (f"http://127.0.0.1:"
+                            f"{client.server.port}")
+            session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=10))
+            try:
+                ports = []
+                for i in range(n_members):
+                    port = _free_port()
+                    cwd = os.path.join(tmp, f"member{i}")
+                    os.makedirs(cwd)
+                    members.append(_spawn_member(
+                        models, cwd, port, balancer_url=balancer_url,
+                        token=tok, name=f"member-{i}"))
+                    ports.append(port)
+                t_boot = time.monotonic()
+                await asyncio.gather(*[
+                    _wait_ready(session, f"http://127.0.0.1:{p}")
+                    for p in ports])
+                out["member_boot_s"] = round(
+                    time.monotonic() - t_boot, 1)
+
+                # the startup announce registers each member (digest
+                # attached); wait until the registry sees the full fleet
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < 60:
+                    r = await client.get("/federation/nodes")
+                    nodes = await r.json()
+                    if len(nodes) == n_members:
+                        break
+                    await asyncio.sleep(0.2)
+                assert len(nodes) == n_members, \
+                    f"only {len(nodes)}/{n_members} members registered"
+                out["announce_digest_nodes"] = sum(
+                    1 for n in nodes
+                    if (n.get("digest") or {}).get("src") == "announce")
+
+                # ---- mixed traffic wave through the balancer ----
+                sem = asyncio.Semaphore(3)
+                prompts = ["hi", "tell me a story about a boat",
+                           "x " * 20, "why"]
+
+                async def one(i: int) -> float:
+                    async with sem:
+                        return await _chat_ttft(
+                            client, prompts[i % len(prompts)],
+                            max_tokens=8 + 8 * (i % 2))
+
+                ttfts = await asyncio.gather(
+                    *[one(i) for i in range(n_requests)])
+                ttfts = sorted(ttfts)
+
+                # let the next probe round pick up final digests
+                await asyncio.sleep(2 * probe_s + 0.2)
+
+                # ---- digest plane health ----
+                sizes = []
+                for p in ports:
+                    async with session.get(
+                            f"http://127.0.0.1:{p}/telemetry/digest"
+                    ) as r:
+                        raw = await r.read()
+                    dg.decode(raw)  # must round-trip the wire format
+                    sizes.append(len(raw))
+                out["digest_bytes_max"] = max(sizes)
+                out["digest_within_cap"] = max(sizes) <= dg._max_bytes()
+
+                r = await client.get("/federation/nodes")
+                nodes = await r.json()
+                out["nodes_cache_control"] = r.headers.get(
+                    "Cache-Control")
+                ages = [(n.get("digest") or {}).get("age_s")
+                        for n in nodes]
+                out["digest_age_max_s"] = round(
+                    max(a for a in ages if a is not None), 3)
+                out["digest_stale_nodes"] = sum(
+                    1 for n in nodes
+                    if (n.get("digest") or {}).get("stale", True))
+                served = [n["requests_served"] for n in nodes]
+                mean = sum(served) / max(1, len(served))
+                out["requests_served"] = served
+                out["load_skew"] = round(max(served) / mean, 3) \
+                    if mean else None
+
+                # ---- percentile cross-check: merged digests vs what
+                # clients measured ----
+                r = await client.get("/fleet/metrics")
+                prom = (await r.read()).decode()
+                rows = _prom_hist(prom, "fleet_ttft_seconds")
+                total = rows[-1][1] if rows else 0
+                out["fleet_ttft_count"] = int(total)
+                i_fleet = _cum_p95_index(rows, 0.95)
+                client_p95 = ttfts[
+                    min(len(ttfts) - 1, int(math.ceil(0.95 * len(ttfts))) - 1)]
+                bounds = dg.HIST_BOUNDS["ttft"]
+                i_client = bisect_left(bounds, client_p95)
+                out["client_ttft_p50_s"] = round(
+                    ttfts[len(ttfts) // 2], 4)
+                out["client_ttft_p95_s"] = round(client_p95, 4)
+                out["fleet_ttft_p95_bounds_s"] = [
+                    0.0 if i_fleet == 0 else bounds[i_fleet - 1],
+                    rows[i_fleet][0] if rows else 0.0]
+                out["ttft_within_one_bucket"] = abs(
+                    i_fleet - i_client) <= 1
+                itl_rows = _prom_hist(prom, "fleet_itl_seconds")
+                if itl_rows and itl_rows[-1][1] > 0:
+                    i50 = _cum_p95_index(itl_rows, 0.50)
+                    i95 = _cum_p95_index(itl_rows, 0.95)
+                    out["fleet_itl_p50_le_s"] = itl_rows[i50][0]
+                    out["fleet_itl_p95_le_s"] = itl_rows[i95][0]
+
+                # ---- SLO flip: kill one member ----
+                r = await client.get("/fleet/slo")
+                slo = await r.json()
+                out["slo_cache_control"] = r.headers.get("Cache-Control")
+                out["slo_state_before_kill"] = \
+                    slo["objectives"]["availability"]["state"]
+                members[-1].kill()
+                t_kill = time.monotonic()
+                flip = None
+                metrics_ok = True
+                while time.monotonic() - t_kill < 15.0:
+                    r = await client.get("/fleet/metrics")
+                    metrics_ok &= r.status == 200
+                    await r.release()
+                    r = await client.get("/fleet/slo")
+                    slo = await r.json()
+                    if slo["objectives"]["availability"]["state"] != "ok":
+                        flip = time.monotonic() - t_kill
+                        break
+                    await asyncio.sleep(0.05)
+                out["slo_state_after_kill"] = \
+                    slo["objectives"]["availability"]["state"]
+                out["slo_flip_latency_s"] = (round(flip, 3)
+                                             if flip is not None else None)
+                out["slo_flip_within_2_probes"] = (
+                    flip is not None and flip <= 2 * probe_s + 0.5)
+                out["metrics_served_during_kill"] = metrics_ok
+                out["nodes_serving_after_kill"] = slo["nodes"]["serving"]
+            finally:
+                await session.close()
+                await client.close()
+    finally:
+        for m in members:
+            m.terminate()
+        for m in members:
+            try:
+                m.wait(timeout=10)
+            except Exception:
+                m.kill()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--members", type=int, default=3)
+    ap.add_argument("--requests", type=int, default=18)
+    ap.add_argument("--probe-s", type=float, default=0.5)
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast CPU smoke settings (3 members, "
+                         "12 requests)")
+    ap.add_argument("--json", action="store_true",
+                    help="compact one-line JSON output")
+    args = ap.parse_args()
+    if args.smoke:
+        args.members, args.requests = 3, 12
+
+    report = asyncio.run(fleet_leg(
+        n_members=args.members, probe_s=args.probe_s,
+        n_requests=args.requests))
+    print(json.dumps(report) if args.json
+          else json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()
