@@ -42,6 +42,7 @@ import asyncio
 import base64
 import hmac
 import json
+import logging
 import os
 import secrets
 import time
@@ -60,6 +61,8 @@ from ..telemetry.tracing import (
     parse_traceparent,
 )
 from ..utils import faultinject
+
+log = logging.getLogger(__name__)
 
 HEARTBEAT_S = 20.0  # ref: announce every 20s (p2p.go:350-362)
 STALE_S = 60.0  # ref: FailureThreshold on LastSeen
@@ -80,6 +83,21 @@ def parse_token(token: str) -> dict:
         return json.loads(base64.urlsafe_b64decode(token.encode()))
     except Exception:
         raise ValueError("invalid federation token")
+
+
+def tokens_match(a: str, b: str) -> bool:
+    """Constant-time federation-token equivalence by shared SECRET
+    (two encodings of the same payload still match). Members use this
+    to recognize the balancer's X-Federation-Token on otherwise
+    auth-exempt telemetry fetches."""
+    if not a or not b:
+        return False
+    try:
+        pa, pb = parse_token(a), parse_token(b)
+    except ValueError:
+        return False
+    return hmac.compare_digest(pa.get("secret", ""),
+                               pb.get("secret", ""))
 
 
 @dataclass
@@ -173,6 +191,14 @@ class NodeRegistry:
                  else dg.validate(obj))
         except dg.DigestError as e:
             tm.FEDERATION_DIGEST_ERRORS.labels(reason=e.reason).inc()
+            return False
+        except Exception:
+            # validate()/decode() contract says DigestError-only, but a
+            # digest arrives off the wire: an escape here would kill the
+            # probe task (announce path: 500 /federation/register), so
+            # contain it the same way and keep the last good digest
+            log.exception("unexpected digest validation failure")
+            tm.FEDERATION_DIGEST_ERRORS.labels(reason="malformed").inc()
             return False
         n.digest, n.digest_at, n.digest_src = d, time.monotonic(), src
         return True
@@ -328,6 +354,9 @@ class FederatedServer:
                 faultinject.fire("federated.digest")
             async with self._client.get(
                 node.address.rstrip("/") + "/telemetry/digest",
+                # the federation token unlocks the prefix top-k (the
+                # member omits prompt-derived fields on anonymous GETs)
+                headers={"X-Federation-Token": self.token},
                 timeout=ClientTimeout(total=2),
             ) as resp:
                 if resp.status != 200:
@@ -445,7 +474,12 @@ class FederatedServer:
 
     async def handle_nodes(self, request: web.Request) -> web.Response:
         now = time.monotonic()
-        limit = self._limit(request)
+        # the operator listing defaults to the cap, not the 64 the
+        # per-node gauge endpoints use: consumers that never pass
+        # ?limit must see the whole fleet, and X-Total-Count makes an
+        # explicit-limit truncation detectable
+        limit = self._limit(request, default=512)
+        nodes = self.registry.nodes()
         return web.json_response([
             {"id": n.id, "name": n.name, "address": n.address,
              "online": n.online(now), "in_flight": n.in_flight,
@@ -455,8 +489,9 @@ class FederatedServer:
              "breaker_open_for_s": round(max(0.0, n.open_until - now), 3),
              "last_error": n.last_error,
              "digest": self._digest_summary(n, now)}
-            for n in self.registry.nodes()[:limit]
-        ], headers={"Cache-Control": "no-store"})
+            for n in nodes[:limit]
+        ], headers={"Cache-Control": "no-store",
+                    "X-Total-Count": str(len(nodes))})
 
     async def handle_proxy(self, request: web.Request) -> web.StreamResponse:
         # the body is buffered up front so a connect-failure retry can
@@ -490,9 +525,13 @@ class FederatedServer:
                         raise web.HTTPServiceUnavailable(
                             reason="no federation nodes online")
                     # nodes exist but every eligible one is down or
-                    # shedding: answer 429 with a Retry-After priced
-                    # from the fleet's own drain predictions instead
-                    # of an uninformative 502/503 (satellite-3)
+                    # shedding. The status code preserves the semantic
+                    # split: member sheds (any 429 hint collected) are
+                    # a CAPACITY condition -> one aggregated 429; pure
+                    # connect failures are an OUTAGE -> 503, so 5xx
+                    # alerting still fires during a full-fleet failure.
+                    # Both carry a Retry-After priced from the fleet's
+                    # own drain predictions (satellite-3).
                     if tried:
                         tm.FEDERATION_RETRIES.labels(
                             outcome="exhausted").inc()
@@ -502,11 +541,15 @@ class FederatedServer:
                                     tried=len(tried),
                                     shed=len(shed_hints),
                                     retry_after_s=ra)
-                    raise web.HTTPTooManyRequests(
+                    if shed_hints:
+                        raise web.HTTPTooManyRequests(
+                            headers={"Retry-After": str(ra)},
+                            reason="every federation node is shedding; "
+                                   "retry after the predicted drain")
+                    raise web.HTTPServiceUnavailable(
                         headers={"Retry-After": str(ra)},
-                        reason="every eligible federation node is down "
-                               "or shedding; retry after the predicted "
-                               "drain")
+                        reason="every eligible federation node is "
+                               "unreachable or breaker-open")
                 tried.add(node.id)
                 TRACER.annotate(rid, "pick", node=node.name,
                                 breaker=self.registry.state(node),
@@ -657,13 +700,16 @@ async def announce_forever(balancer_url: str, token: str, node_id: str,
                            name: str, address: str,
                            digest_fn=None) -> None:
     """Worker-side heartbeat loop (ref: ExposeService announce ticker).
-    ``digest_fn`` (optional, sync) supplies this node's telemetry
-    digest; it rides every register POST so the balancer has occupancy
-    and latency buckets even with active probing disabled. A digest
+    ``digest_fn`` (optional; sync or returning an awaitable) supplies
+    this node's telemetry digest; it rides every register POST so the
+    balancer has occupancy and latency buckets even with active probing
+    disabled. Collection briefly takes each engine's lock, so callers
+    should hand in an executor-wrapped fn (the same ``run_blocking``
+    the /telemetry/digest route uses) — awaiting it here keeps the
+    heartbeat from ever stalling the member's event loop. A digest
     failure never blocks the heartbeat — liveness outranks telemetry."""
-    import logging
+    import inspect
 
-    log = logging.getLogger(__name__)
     async with ClientSession(timeout=ClientTimeout(total=10)) as client:
         while True:
             body = {"token": token, "id": node_id, "name": name,
@@ -671,6 +717,8 @@ async def announce_forever(balancer_url: str, token: str, node_id: str,
             if digest_fn is not None:
                 try:
                     d = digest_fn()
+                    if inspect.isawaitable(d):
+                        d = await d
                     if d is not None:
                         body["digest"] = d
                 except Exception:

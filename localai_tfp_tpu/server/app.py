@@ -29,7 +29,11 @@ from . import (
 
 log = logging.getLogger(__name__)
 
-# endpoints exempt from API-key auth (ref: app.go:139-174 default filters)
+# endpoints exempt from API-key auth (ref: app.go:139-174 default
+# filters). /telemetry/digest stays exempt so the balancer probe always
+# reaches it, but the route itself withholds the prompt-derived prefix
+# top-k unless the caller presents an API key or the federation token
+# (localai_routes._digest_caller_trusted).
 AUTH_EXEMPT = {"/healthz", "/readyz", "/metrics", "/telemetry/digest",
                "/version", "/login"}
 
@@ -252,13 +256,18 @@ def build_app(state: Application) -> web.Application:
                     addr,
                 )
             from ..telemetry import digest as _digest
+            from .common import run_blocking
 
             app_["announce_task"] = asyncio.create_task(announce_forever(
                 cfg.federated_server_url, cfg.p2p_token,
                 _uuid.uuid4().hex[:12], cfg.node_name or "localai-node",
                 addr,
-                # every heartbeat gossips this node's telemetry digest
-                digest_fn=lambda: _digest.collect(state.model_loader),
+                # every heartbeat gossips this node's telemetry digest;
+                # collection briefly takes each engine's lock, so it
+                # runs on the blocking pool (same as the
+                # /telemetry/digest route) — never on the event loop
+                digest_fn=lambda: run_blocking(
+                    _digest.collect, state.model_loader),
             ))
         if not cfg.disable_metrics:
             import asyncio

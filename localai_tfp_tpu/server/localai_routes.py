@@ -99,16 +99,42 @@ async def metrics(request: web.Request) -> web.Response:
                                   else CONTENT_TYPE)})
 
 
+def _digest_caller_trusted(request: web.Request) -> bool:
+    """The digest endpoint is auth-exempt so the balancer probe always
+    reaches it, but the prefix top-k is derived from user PROMPT
+    content — it only ships to callers that prove themselves: a valid
+    API key, or the shared federation token (what the balancer's probe
+    sends). With no API keys configured the whole server is open and
+    the distinction is moot."""
+    st = _state(request)
+    keys = st.config.api_keys
+    if not keys:
+        return True
+    auth = request.headers.get("Authorization", "")
+    token = (auth[7:] if auth.startswith("Bearer ")
+             else request.headers.get("x-api-key", ""))
+    if token in keys:
+        return True
+    from ..parallel.federated import tokens_match
+
+    return tokens_match(request.headers.get("X-Federation-Token", ""),
+                        st.config.p2p_token)
+
+
 async def telemetry_digest(request: web.Request) -> web.Response:
     """This node's mergeable telemetry digest (telemetry/digest.py) —
     what the federation balancer's probe loop fetches and the
     heartbeat attaches. Bounded JSON (LOCALAI_DIGEST_MAX_BYTES);
     collection reads host-held registry/scheduler values only, run off
-    the event loop because it briefly takes each engine's lock."""
+    the event loop because it briefly takes each engine's lock.
+    Anonymous callers get the digest minus the prompt-derived prefix
+    top-k (see _digest_caller_trusted)."""
     st = _state(request)
     from ..telemetry import digest as dg
 
     payload = await run_blocking(dg.collect, st.model_loader)
+    if not _digest_caller_trusted(request):
+        payload = dict(payload, prefixes=[])
     return web.json_response(payload,
                              headers={"Cache-Control": "no-store"})
 

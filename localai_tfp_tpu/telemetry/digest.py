@@ -339,26 +339,42 @@ def validate(obj, max_bytes: Optional[int] = None) -> dict:
         raise DigestError("malformed", "drain_s")
     pf = obj.get("prefixes", [])
     if not isinstance(pf, list) or any(
-            not (isinstance(e, (list, tuple)) and len(e) == 2)
+            not (isinstance(e, (list, tuple)) and len(e) == 2
+                 and isinstance(e[1], (int, float)))
             for e in pf):
         raise DigestError("malformed", "prefixes")
+    kp = obj.get("kv_pages", {})
+    if any(v is not None and not isinstance(v, (int, float))
+           for v in (kp.get("hot"), kp.get("warm"))):
+        raise DigestError("malformed", "kv_pages values")
     if len(encode(obj)) > cap:
         raise DigestError("oversize", f"> {cap} bytes")
-    # normalize onto a full schema so downstream code can index freely
-    d = empty()
-    for k in HIST_BOUNDS:
-        d["hist"][k] = {"c": [int(x) for x in hist[k]["c"]],
-                        "s": float(hist[k]["s"])}
-    for k in _ADDITIVE:
-        d["occ"][k] = occ.get(k, 0)
-    d["hbm"] = {str(k): v for k, v in obj.get("hbm", {}).items()
-                if isinstance(v, (int, float))}
-    kp = obj.get("kv_pages", {})
-    d["kv_pages"] = {"hot": int(kp.get("hot", 0) or 0),
-                     "warm": int(kp.get("warm", 0) or 0)}
-    d["models"] = [str(m) for m in obj.get("models", [])]
-    d["drain_s"] = float(ds) if ds is not None else None
-    d["prefixes"] = [[str(h), int(n)] for h, n in pf]
+    # normalize onto a full schema so downstream code can index freely.
+    # The try/except is a hard containment boundary: EVERY failure out
+    # of validate() must be a DigestError, because the callers
+    # (store_digest on both the announce and probe paths) catch exactly
+    # that — anything else would kill the balancer's probe task or 500
+    # /federation/register.
+    try:
+        d = empty()
+        for k in HIST_BOUNDS:
+            d["hist"][k] = {"c": [int(x) for x in hist[k]["c"]],
+                            "s": float(hist[k]["s"])}
+        for k in _ADDITIVE:
+            d["occ"][k] = occ.get(k, 0)
+        d["hbm"] = {str(k): v for k, v in obj.get("hbm", {}).items()
+                    if isinstance(v, (int, float))}
+        d["kv_pages"] = {"hot": int(kp.get("hot", 0) or 0),
+                         "warm": int(kp.get("warm", 0) or 0)}
+        d["models"] = [str(m) for m in obj.get("models", [])]
+        d["drain_s"] = float(ds) if ds is not None else None
+        d["prefixes"] = [[str(h), int(n)] for h, n in pf]
+    except DigestError:
+        raise
+    # OverflowError: json.loads accepts bare Infinity, and int(inf)
+    # raises it — not a ValueError subclass
+    except (TypeError, ValueError, KeyError, OverflowError) as e:
+        raise DigestError("malformed", f"normalize: {e!r}"[:80])
     return d
 
 

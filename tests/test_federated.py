@@ -229,8 +229,9 @@ def _counter(family, **labels):
 def test_connect_failure_retries_next_node_and_exhausts():
     """A dead upstream (connect refused — no bytes streamed) is retried
     onto the next eligible node transparently; when every node fails
-    the client gets one clean 429 with a Retry-After priced from the
-    fleet's own state (breaker backoff when no digest knows better)."""
+    the client gets one clean 503 with a Retry-After priced from the
+    fleet's own state (breaker backoff when no digest knows better) —
+    a 5xx, not a 429, so outage alerting keyed on 5xx still fires."""
     loop = asyncio.new_event_loop()
 
     async def go():
@@ -273,14 +274,14 @@ def test_connect_failure_retries_next_node_and_exhausts():
         assert entries["a-dead"]["last_error"]
         assert entries["b-live"]["state"] == "closed"
 
-        # kill the live node too: retries exhaust into a single 429
-        # with a Retry-After hint (satellite-3 shed aggregation — a
-        # fleet that EXISTS but cannot serve is a capacity condition,
-        # not a gateway error)
+        # kill the live node too: retries exhaust into a single 503
+        # with a Retry-After hint. 429 is reserved for the shed path
+        # (members answering 429) — a fleet that is simply UNREACHABLE
+        # is an outage, and monitors key on 5xx for that.
         await live.close()
         exhausted0 = _counter(tm.FEDERATION_RETRIES, outcome="exhausted")
         r = await client.post("/v1/models", data=b"x")
-        assert r.status == 429
+        assert r.status == 503
         assert int(r.headers["Retry-After"]) >= 1
         assert _counter(tm.FEDERATION_RETRIES,
                         outcome="exhausted") == exhausted0 + 1

@@ -143,6 +143,45 @@ def test_decode_rejects_bad_payloads():
     assert ei.value.reason == "malformed"
 
 
+def test_validate_never_leaks_non_digest_errors():
+    """Structurally plausible but type-poisoned digests must raise
+    DigestError, never bare TypeError/ValueError — store_digest catches
+    ONLY DigestError, so a leak would kill the balancer's probe task
+    (stopping probing/breakers/SLO ticks fleet-wide) or 500
+    /federation/register."""
+    poisons = [
+        {"prefixes": [["h", None]]},     # int(None) -> TypeError
+        {"prefixes": [["h", "x"]]},      # int("x") -> ValueError
+        {"prefixes": ["hx"]},            # len-2 str is not an entry
+        {"kv_pages": {"hot": "x"}},      # int("x") -> ValueError
+        {"kv_pages": {"warm": [1]}},     # int([1]) -> TypeError
+        # json.loads accepts bare Infinity; int(inf) -> OverflowError
+        {"prefixes": [["h", float("inf")]]},
+    ]
+    for over in poisons:
+        d = dg.empty()
+        d.update(over)
+        with pytest.raises(dg.DigestError) as ei:
+            dg.validate(d)
+        assert ei.value.reason == "malformed", over
+
+    # ...and the registry path survives them too: counted + dropped,
+    # last good digest kept (the end-to-end guarantee)
+    tok = generate_token()
+    reg = NodeRegistry(tok)
+    good = _digest(models=["kept"])
+    assert reg.announce(tok, "np", "np", "http://a", digest=good)
+    n = reg._nodes["np"]
+    m0 = _counter(tm.FEDERATION_DIGEST_ERRORS, reason="malformed")
+    for over in poisons:
+        d = dg.empty()
+        d.update(over)
+        assert reg.announce(tok, "np", "np", "http://a", digest=d)
+        assert n.digest["models"] == ["kept"]
+    assert _counter(tm.FEDERATION_DIGEST_ERRORS,
+                    reason="malformed") == m0 + len(poisons)
+
+
 # ------------------------------------------------- registry digest carriage
 
 
@@ -408,12 +447,15 @@ def test_fleet_metrics_exposition_and_endpoint_hygiene():
             # /federation/nodes: digest summary + limit + no-store
             r = await client.get("/federation/nodes")
             assert r.headers["Cache-Control"] == "no-store"
+            assert r.headers["X-Total-Count"] == "2"
             nodes = await r.json()
             assert len(nodes) == 2
             assert nodes[0]["digest"]["models"] == ["m1"]
             assert nodes[0]["digest"]["src"] == "announce"
+            # an explicit limit truncates, but the total stays visible
             r = await client.get("/federation/nodes?limit=1")
             assert len(await r.json()) == 1
+            assert r.headers["X-Total-Count"] == "2"
             r = await client.get("/fleet/metrics?limit=1")
             assert r.status == 200
             r = await client.get("/federation/nodes?limit=bogus")

@@ -594,6 +594,55 @@ def test_cookie_percent_decoded_before_compare(workdir):
         loop.close()
 
 
+def test_telemetry_digest_prefixes_gated_on_key_or_fed_token(
+        workdir, monkeypatch):
+    """/telemetry/digest stays auth-exempt (the balancer probe must
+    always reach it), but the prefix top-k is derived from user prompt
+    content: anonymous callers get the digest WITHOUT it; an API key or
+    the shared federation token (what the probe sends) unlocks it."""
+    from localai_tfp_tpu.parallel.federated import generate_token
+    from localai_tfp_tpu.telemetry import digest as dg
+
+    fed_tok = generate_token()
+    loop = asyncio.new_event_loop()
+    cfg = ApplicationConfig(
+        models_path=str(workdir / "models"),
+        generated_content_dir=str(workdir / "generated"),
+        upload_dir=str(workdir / "uploads"),
+        config_dir=str(workdir / "configuration"),
+        api_keys=["sk-test"],
+        p2p_token=fed_tok,
+    )
+    state = Application(cfg)
+    app = build_app(state)
+    tc = TestClient(TestServer(app), loop=loop)
+    loop.run_until_complete(tc.start_server())
+    monkeypatch.setattr(
+        dg, "collect", lambda loader=None: dg.build(prefixes=[("ab", 5)]))
+    try:
+        client = SyncClient(loop, tc)
+        # anonymous: 200 (exempt) but the prompt-derived field is gone
+        r = client.get("/telemetry/digest")
+        assert r.status == 200 and r.json["prefixes"] == []
+        # API key unlocks it
+        r = client.get("/telemetry/digest",
+                       headers={"Authorization": "Bearer sk-test"})
+        assert r.json["prefixes"] == [["ab", 5]]
+        # ... as does the federation token the balancer probe sends
+        r = client.get("/telemetry/digest",
+                       headers={"X-Federation-Token": fed_tok})
+        assert r.json["prefixes"] == [["ab", 5]]
+        # a DIFFERENT federation token does not
+        r = client.get("/telemetry/digest",
+                       headers={"X-Federation-Token": generate_token()})
+        assert r.status == 200 and r.json["prefixes"] == []
+        # the stripped payload still validates and merges
+        dg.validate(r.json)
+    finally:
+        loop.run_until_complete(tc.close())
+        loop.close()
+
+
 # ---------------------------------------------------------------------------
 # robustness: deadlines (timeout field / header) + bounded-admission 429
 
