@@ -524,6 +524,27 @@ def _fleet_extra() -> dict:
                                   n_requests=12))
 
 
+def _fleet_routing_extra() -> dict:
+    """Routing + autoscaling acceptance block (extra.fleet_routing):
+    the profile_fleet --routing / --autoscale smokes. Tracks the
+    prefix-locality contracts — cross-replica prefix hit rate > 0.5
+    and repeat-request TTFT p50 beating blind least-used in the same
+    run — and the elastic-scaling contracts: a queue burst boots a
+    warmup-reuse replica within ~2 probe intervals, and the idle
+    scale-down drains the victim (zero in-flight) before the kill.
+    Runs member subprocesses, so it is independent of the serving
+    engine's lifecycle."""
+    import asyncio as _asyncio
+
+    from tools.profile_fleet import autoscale_leg, routing_leg
+
+    return {
+        "routing": _asyncio.run(routing_leg(
+            n_members=3, probe_s=0.5, repeats=4)),
+        "autoscale": _asyncio.run(autoscale_leg()),
+    }
+
+
 def _tracing_extra() -> dict:
     """Observability-cost acceptance block (extra.tracing): span/trace
     volume on this process, flight-recorder ring occupancy, and the
@@ -1441,6 +1462,7 @@ def main() -> None:
     extra["meshed_paged"] = _meshed_paged_extra()
     extra["chaos"] = _chaos_extra()
     extra["fleet"] = _fleet_extra()
+    extra["fleet_routing"] = _fleet_routing_extra()
     extra["tracing"] = _tracing_extra()
     extra["costmodel"] = _costmodel_extra()
     extra["cost_sched"] = _cost_sched_extra()

@@ -108,8 +108,10 @@ def build_parser() -> argparse.ArgumentParser:
     fed.add_argument("--address", default="0.0.0.0")
     fed.add_argument("--port", type=int, default=8080)
     fed.add_argument("--p2p-token", default=None)
-    fed.add_argument("--strategy", default="least-used",
-                     choices=["least-used", "random"])
+    fed.add_argument("--strategy", default=None,
+                     choices=["prefix", "least-used", "random"],
+                     help="pick strategy (default: LOCALAI_FED_STRATEGY"
+                          ", prefix = locality-scored routing)")
 
     worker = sub.add_parser(
         "worker", help="run a worker that joins a federation")

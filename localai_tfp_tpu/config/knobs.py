@@ -85,6 +85,9 @@ _knob("LOCALAI_DECODE_KERNEL", "auto", "str",
 _knob("LOCALAI_WARMUP_REUSE", "on", "flag",
       "Skip the warmup pass when the persistent compile-cache marker "
       "for the variant set exists.")
+_knob("LOCALAI_PREFIX_SUMMARY_S", "1", "float",
+      "Scheduler refresh interval for the prefix-index top-k summary "
+      "gossiped in telemetry digests, in seconds.")
 
 # -------------------------------------------------------------- kv tier
 _knob("LOCALAI_KV_TIER_HOST_MB", "256", "float",
@@ -217,6 +220,41 @@ _knob("LOCALAI_SLO_BURN_WARN", "6", "float",
 _knob("LOCALAI_SLO_BURN_CRIT", "14.4", "float",
       "Burn rate at which BOTH windows flip an objective to critical "
       "(the classic 30-day-budget-in-2-days threshold).")
+_knob("LOCALAI_FED_STRATEGY", "prefix", "str",
+      "Default federated pick strategy: prefix (locality-scored), "
+      "least-used (byte-identical legacy pick), or random.")
+_knob("LOCALAI_ROUTE_ALPHA", "0.01", "float",
+      "Routing score weight per matched prefix token (the locality "
+      "term of score = a*match - b*drain - g*pressure).")
+_knob("LOCALAI_ROUTE_BETA", "1", "float",
+      "Routing score weight per predicted drain second.")
+_knob("LOCALAI_ROUTE_GAMMA", "1", "float",
+      "Routing score weight per unit queue pressure (in_flight plus "
+      "digest-reported queue depth over slots).")
+_knob("LOCALAI_SCALE_UP_QW_MS", "500", "float",
+      "Autoscaler scale-up trigger: windowed fleet queue-wait p90 "
+      "above this many ms (0 disables scale-up).")
+_knob("LOCALAI_SCALE_MIN", "1", "int",
+      "Autoscaler lower bound on serving replicas.")
+_knob("LOCALAI_SCALE_MAX", "8", "int",
+      "Autoscaler upper bound on serving replicas.")
+_knob("LOCALAI_SCALE_TICK_S", "0", "float",
+      "Autoscaler evaluation interval seconds (0 = the federation "
+      "probe interval).")
+_knob("LOCALAI_SCALE_COOLDOWN_S", "30", "float",
+      "Cooldown seconds after any scale action (or failed attempt) "
+      "before the autoscaler acts again.")
+_knob("LOCALAI_SCALE_HYSTERESIS", "2", "int",
+      "Consecutive autoscaler ticks a scale signal must persist "
+      "before acting.")
+_knob("LOCALAI_SCALE_DOWN_MFU", "0.05", "float",
+      "Fleet mean MFU below which (with occupancy also under floor) "
+      "scale-down is considered.")
+_knob("LOCALAI_SCALE_DOWN_OCC", "0.25", "float",
+      "Fleet busy-slot fraction below which scale-down is considered.")
+_knob("LOCALAI_SCALE_DRAIN_TIMEOUT_S", "60", "float",
+      "Max seconds to wait for a draining scale-down victim to empty "
+      "before the kill proceeds anyway.")
 _knob("LOCALAI_GALLERIES", "", "str",
       "JSON gallery list (falls back to GALLERIES).")
 

@@ -145,6 +145,12 @@ class GenRequest:
     # while queued AND while decoding
     timeout_s: float = 0.0
     deadline: float = 0.0
+    # HTTP-edge message-boundary fingerprint chain
+    # (utils/fingerprint.py): (hash_hex, cum_canonical_bytes) pairs
+    # registered with the prefix index at slot assignment so digest
+    # gossip advertises hashes the federated balancer can recompute
+    # from a raw request body without a tokenizer
+    prefix_chain: tuple = ()
 
 
 class _PadReq:
@@ -645,6 +651,7 @@ class LLMEngine:
         # locking) and swapped in atomically for any-thread readers
         self._prefix_summary: tuple = ()
         self._prefix_summary_t = 0.0
+        self._prefix_summary_rev = -1  # index revision last summarized
         # same-wave prefix grouping: request id -> (deadline, want_len)
         # for admissions deferred one scheduler iteration so a
         # wave-mate's prefill commits the shared prefix they copy from
@@ -2874,6 +2881,17 @@ class LLMEngine:
 
     def _loop(self) -> None:
         while True:
+            if not self._has_work():
+                # TRUE idle transition: step() will not run again until
+                # new work arrives, so publish pending prefix-index
+                # changes now — the final harvest of a wave would
+                # otherwise never reach the gossiped prefix summary
+                # and the member's digest would advertise the
+                # PREVIOUS request's residency until the next
+                # admission. (Unlocked peek: this thread is the only
+                # mutator of slots/flights; a submit racing in merely
+                # makes the refresh redundant, never wrong.)
+                self._refresh_prefix_summary(force=True)
             with self._lock:
                 while not self._stop and not self._has_work():
                     self._lock.wait(timeout=0.5)
@@ -2955,6 +2973,27 @@ class LLMEngine:
         if not (harvested or dispatched):
             self._wait_for_event()
 
+    def _refresh_prefix_summary(self, force: bool = False) -> None:
+        """Recompute the gossiped prefix top-k when the refresh
+        interval elapsed (or ``force``, on the idle transition).
+        Registrations otherwise update only on admission waves, so the
+        summary first syncs the index against the live slot tokens;
+        the rehash itself is revision-gated, so an unchanged index
+        costs only the (vectorized, usually early-out) sync diff."""
+        nowp = time.monotonic()
+        if not force and nowp - self._prefix_summary_t < knobs.float_(
+                "LOCALAI_PREFIX_SUMMARY_S"):
+            return
+        if self._prefix_enabled:
+            self._prefix_index.sync(
+                (s.idx, s.cache_tokens) for s in self.slots)
+        self._prefix_summary_t = nowp
+        if self._prefix_index.revision == self._prefix_summary_rev:
+            return
+        self._prefix_summary_rev = self._prefix_index.revision
+        self._prefix_summary = self._prefix_index.summary(
+            knobs.int_("LOCALAI_DIGEST_TOPK"))
+
     def _update_gauges(self) -> None:
         """Scheduler-state gauges, refreshed once per iteration from
         values the scheduler already holds on the host (no device syncs;
@@ -3015,14 +3054,11 @@ class LLMEngine:
             # decode-stall gaps are only meaningful while a slot
             # decodes; reset the clock when the decode set drains
             self._last_decode_adv = 0.0
-        # fleet-digest prefix gossip: recompute the top-k summary ~1/s
-        # on the scheduler thread (the index has no locking); host
-        # hashing only, published by atomic tuple swap
-        nowp = time.monotonic()
-        if nowp - self._prefix_summary_t >= 1.0:
-            self._prefix_summary_t = nowp
-            self._prefix_summary = self._prefix_index.summary(
-                knobs.int_("LOCALAI_DIGEST_TOPK"))
+        # fleet-digest prefix gossip: recompute the top-k summary every
+        # LOCALAI_PREFIX_SUMMARY_S on the scheduler thread (the index
+        # has no locking); host hashing only, published by atomic
+        # tuple swap
+        self._refresh_prefix_summary()
         if self._ledger is not None:
             # ledger reconcile + device/host memory gauges: host dict
             # math and a memory_stats() host call, rate-limited to ~1/s
@@ -3398,18 +3434,34 @@ class LLMEngine:
         free = [s for s in self.slots if not s.active]
         if not free:
             return None
-        best = max(free, key=lambda s: _common_prefix(s.cache_tokens,
-                                                      req.prompt_ids))
-        if (not self._prefix_enabled
-                or _common_prefix(best.cache_tokens, req.prompt_ids)
-                >= self._prefix_min_copy):
-            return best
-        # no free slot meaningfully matches this prompt: evict the
-        # resident prefix with the LOWEST reuse value (LRU x length) so
-        # hot donor prefixes survive for future cross-slot copies
+        if not self._prefix_enabled:
+            return max(free, key=lambda s: _common_prefix(
+                s.cache_tokens, req.prompt_ids))
+        # value-destroyed placement: admitting onto a slot overwrites
+        # its resident prefix beyond the overlap, so the right victim
+        # is the slot whose UNSHARED tail is worth the least (reuse
+        # value scaled by the fraction overwritten) — NOT the
+        # max-overlap slot. Scoring by overlap alone steers every new
+        # conversation that shares a trivial opening with a hot
+        # resident (chat-template header, "You are a ..." boilerplate)
+        # onto that resident and evicts it while a near-worthless slot
+        # sits free; and _maybe_prefix_copy serves the same overlap
+        # from ANY donor row, so in-place placement saves only the
+        # copy, never the prefill. Ties (e.g. two empty slots) prefer
+        # the larger overlap: in-place reuse skips the donor copy.
         now = time.monotonic()
-        return min(free,
-                   key=lambda s: self._prefix_index.value(s.idx, now))
+
+        def cost(s: _Slot) -> tuple:
+            overlap = _common_prefix(s.cache_tokens, req.prompt_ids)
+            n = self._prefix_index.registered_len(s.idx)
+            destroyed = 0.0
+            if n:
+                keep = min(overlap, n)
+                destroyed = (self._prefix_index.value(s.idx, now)
+                             * (n - keep) / n)
+            return (destroyed, -overlap)
+
+        return min(free, key=cost)
 
     def _maybe_prefix_copy(self, slot: _Slot, req: GenRequest,
                            common: int) -> tuple[int, int]:
@@ -3695,6 +3747,8 @@ class LLMEngine:
             # the stale longer registration
             self._prefix_index.set_tokens(slot.idx, slot.cache_tokens)
             self._prefix_index.touch(slot.idx)
+            self._prefix_index.set_chain(
+                slot.idx, req.prefix_chain, len(req.prompt_ids))
         if common > 0:
             # attribute reuse by source; clamp so the three sources sum
             # exactly to `common` even across the relogit -1 adjustment

@@ -87,6 +87,15 @@ class PrefixIndex:
         self._root = _Node(np.empty(0, np.int64))
         self._seqs: dict[int, np.ndarray] = {}  # slot -> registered seq
         self._last_use: dict[int, float] = {}  # slot -> monotonic stamp
+        # slot -> (edge fingerprint chain, prompt token length) — set at
+        # assignment for HTTP-admitted requests (utils/fingerprint.py)
+        self._chains: dict[int, tuple[tuple, int]] = {}
+        # bumped on every content mutation; the engine uses it to skip
+        # summary() rehashes when nothing changed and to force one
+        # before the scheduler goes idle (gossip would otherwise miss
+        # prefixes retained by requests shorter than the refresh
+        # interval)
+        self.revision = 0
 
     # ------------------------------------------------------------ register
 
@@ -106,6 +115,7 @@ class PrefixIndex:
                 pass  # extension: insert walks the covered path again
             else:
                 self._remove_path(slot, old)
+        self.revision += 1
         self._seqs[slot] = seq
         self._last_use[slot] = time.monotonic() if now is None else now
         if len(seq):
@@ -114,8 +124,23 @@ class PrefixIndex:
     def remove(self, slot: int) -> None:
         old = self._seqs.pop(slot, None)
         self._last_use.pop(slot, None)
+        if self._chains.pop(slot, None) is not None or old is not None:
+            self.revision += 1
         if old is not None:
             self._remove_path(slot, old)
+
+    def set_chain(self, slot: int, chain, prompt_len: int) -> None:
+        """Attach the HTTP-edge message-boundary fingerprint chain for
+        the request resident in ``slot`` (see utils/fingerprint.py).
+        ``prompt_len`` is the prompt's token length, used to convert
+        the chain's canonical-byte offsets into token estimates in
+        ``summary()``. An empty chain clears any prior registration
+        (the slot falls back to token-bytes hashing)."""
+        if chain and prompt_len > 0:
+            self._chains[slot] = (tuple(chain), int(prompt_len))
+            self.revision += 1
+        elif self._chains.pop(slot, None) is not None:
+            self.revision += 1
 
     def sync(self, slot_tokens: Iterable[tuple[int, list]]) -> None:
         """Diff-and-reregister every (slot, live_tokens) pair. Called
@@ -198,31 +223,50 @@ class PrefixIndex:
         return sum(len(s) for s in self._seqs.values())
 
     def summary(self, k: int = 16) -> tuple[tuple[str, int], ...]:
-        """Top-k resident prefixes as (stable hash, token count) pairs
+        """Top-k resident prefixes as (fingerprint, token count) pairs
         — the gossip payload for prefix-locality fleet routing
-        (telemetry/digest.py). The hash is content-addressed over the
-        canonical int64 token bytes, so two NODES holding the same
-        prefix produce the same hash; duplicates across slots collapse.
-        Scheduler-thread only, like every other method here."""
+        (telemetry/digest.py). Slots admitted through the HTTP edge
+        carry a message-boundary fingerprint chain registered via
+        ``set_chain`` (utils/fingerprint.py); those emit one entry PER
+        CHAIN BOUNDARY, the token count estimated by scaling the prompt
+        token length by canonical-byte fraction and clamped to what is
+        actually KV-resident. Because the chain is computed from raw
+        request bytes, the federated balancer derives the SAME hashes
+        from an incoming body without a tokenizer and matches them
+        against these gossiped entries. Chainless slots (direct engine
+        callers) fall back to a content hash over the canonical int64
+        token bytes — stable across nodes, but only matchable by
+        another engine. Scheduler-thread only, like every other method
+        here."""
         import hashlib
 
         if k <= 0:
             return ()
-        out: list[tuple[str, int]] = []
-        seen: set[str] = set()
-        for seq in sorted(self._seqs.values(), key=len, reverse=True):
-            if not len(seq):
+        best: dict[str, int] = {}
+        for slot, seq in self._seqs.items():
+            resident = len(seq)
+            if not resident:
                 continue
+            entry = self._chains.get(slot)
+            if entry is not None:
+                chain, prompt_len = entry
+                total_b = chain[-1][1]
+                if total_b > 0:
+                    last = len(chain) - 1
+                    for j, (h, cum_b) in enumerate(chain):
+                        est = prompt_len if j == last else max(
+                            1, (prompt_len * int(cum_b)) // total_b)
+                        est = min(est, resident)
+                        if est > best.get(h, 0):
+                            best[h] = est
+                    continue
             h = hashlib.blake2b(
                 np.ascontiguousarray(seq, np.int64).tobytes(),
                 digest_size=8).hexdigest()
-            if h in seen:
-                continue
-            seen.add(h)
-            out.append((h, int(len(seq))))
-            if len(out) >= k:
-                break
-        return tuple(out)
+            if resident > best.get(h, 0):
+                best[h] = resident
+        top = sorted(best.items(), key=lambda e: (-e[1], e[0]))[:k]
+        return tuple((h, int(n)) for h, n in top)
 
     # ----------------------------------------------------------- internals
 

@@ -1,0 +1,301 @@
+"""SLO-driven elastic autoscaling for the federated fleet.
+
+The digest plane (telemetry/digest.py) already puts queue-wait
+histograms, occupancy, MFU and predicted drain on every heartbeat;
+this module closes the loop: an :class:`Autoscaler` task runs beside
+the balancer's probe loop and turns those merged signals into a
+desired replica count —
+
+- **scale up** when the *windowed* fleet queue-wait p90 (cumulative
+  merged bucket counts diffed per tick, clamped against node-restart
+  resets) exceeds ``LOCALAI_SCALE_UP_QW_MS``;
+- **scale down** when the fleet is provably idle: busy-slot fraction
+  under ``LOCALAI_SCALE_DOWN_OCC``, mean MFU under
+  ``LOCALAI_SCALE_DOWN_MFU`` and no queued work;
+- both gated by hysteresis (``LOCALAI_SCALE_HYSTERESIS`` consecutive
+  ticks of the same signal), a cooldown after ANY action or failed
+  attempt (``LOCALAI_SCALE_COOLDOWN_S``) and the
+  ``LOCALAI_SCALE_MIN``/``LOCALAI_SCALE_MAX`` bounds.
+
+Actions go through a pluggable :class:`ScaleDriver`. The default
+:class:`LogScaleDriver` only logs intent — operators see what the
+autoscaler WOULD do on ``fleet_replicas_desired_count`` /
+``fleet_scale_events_total`` before handing it a real driver
+(``tools/profile_fleet.py`` provides a subprocess driver that boots
+warmup-reuse members, the PR 12 0.29 s AOT boot that makes scale-out
+fast enough to track bursts).
+
+Scale-down is drain-before-kill: the victim's ``Node.draining`` flag
+takes it out of routing immediately, the kill waits until the
+balancer's in-flight count AND the node's digest queue are empty (or
+``LOCALAI_SCALE_DRAIN_TIMEOUT_S`` elapses), then the driver kills it
+and the registry drops it.
+
+Failure containment mirrors the digest plane: a driver failure (chaos
+point ``federated.scale``) is tallied as ``outcome="error"``, NEVER
+feeds the circuit breaker, and the loop retries after the cooldown —
+a broken cloud API must not wedge the balancer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+import time
+from typing import Optional
+
+from ..config import knobs
+from ..telemetry import digest as dg
+from ..utils import faultinject
+
+log = logging.getLogger(__name__)
+
+_DIRECTIONS = ("up", "down")
+_OUTCOMES = ("ok", "error")
+
+
+class ScaleDriver:
+    """Pluggable actuator for scale decisions. Methods may be sync or
+    async; exceptions are contained by the autoscaler (tallied as
+    ``fleet_scale_events_total{outcome="error"}``, retried after
+    cooldown). ``mutates=False`` subclasses are advisory: the
+    autoscaler computes and publishes the desired count but never
+    drains, kills or boots anything."""
+
+    mutates = True
+
+    def scale_up(self, count: int) -> None:  # pragma: no cover - iface
+        raise NotImplementedError
+
+    def scale_down(self, node) -> None:  # pragma: no cover - iface
+        raise NotImplementedError
+
+
+class LogScaleDriver(ScaleDriver):
+    """Default driver: log intent, act on nothing. The desired-count
+    gauge still moves, so the decision loop is observable before it is
+    trusted with a real actuator — and no routing state (draining
+    flags, registry membership) is ever touched."""
+
+    mutates = False
+
+    def scale_up(self, count: int) -> None:
+        log.info("autoscaler wants %d more replica(s) (log-only driver)",
+                 count)
+
+    def scale_down(self, node) -> None:
+        log.info("autoscaler would drain+kill a replica "
+                 "(log-only driver)")
+
+
+class Autoscaler:
+    """Desired-replica-count controller over the balancer's merged
+    digests. ``run()`` is the asyncio task; ``step()`` is one evaluate+
+    act round (tests drive it directly with a fake clock)."""
+
+    def __init__(self, fed, driver: Optional[ScaleDriver] = None) -> None:
+        self.fed = fed
+        self.registry = fed.registry
+        self.driver = driver or LogScaleDriver()
+        self.desired = 0
+        self.events: dict[tuple, int] = {}  # (direction, outcome) -> n
+        self.last_scale_up_t = 0.0  # monotonic; profile_fleet reaction
+        self._prev_qw: Optional[list] = None  # cumulative counts
+        self._up_streak = 0
+        self._down_streak = 0
+        self._cooldown_until = 0.0
+        self._drain_deadline: dict[str, float] = {}  # node id -> t
+
+    # ------------------------------------------------------------- config
+
+    @property
+    def tick_s(self) -> float:
+        t = knobs.float_("LOCALAI_SCALE_TICK_S")
+        return t if t > 0 else float(self.fed.probe_s)
+
+    @property
+    def enabled(self) -> bool:
+        return self.tick_s > 0
+
+    @property
+    def rides_probe(self) -> bool:
+        """With LOCALAI_SCALE_TICK_S unset the tick runs synchronously
+        at the END of each probe round (federated._probe_loop), right
+        after the digests it decides on were refreshed — a free-running
+        task of the same period could lag the freshest digest by up to
+        a full probe interval, which is most of the scale-out reaction
+        budget. An explicit tick period opts into the separate task."""
+        return knobs.float_("LOCALAI_SCALE_TICK_S") <= 0
+
+    def snapshot(self) -> dict:
+        """Cumulative tallies for the /fleet/metrics exposition
+        (telemetry/fleet.py loads them into its per-scrape registry)."""
+        return {"desired": self.desired, "events": dict(self.events)}
+
+    # ------------------------------------------------------------ signals
+
+    def _windowed_qw_p90_ms(self, merged: dict) -> Optional[float]:
+        """Queue-wait p90 over THIS tick's new samples: the merged
+        digest histograms are cumulative, so diff against the previous
+        tick's counts (clamped against resets). None = no new traffic
+        (an idle fleet must not read as a fast one — or a slow one)."""
+        cur = list(merged["hist"]["queue_wait"]["c"])
+        prev, self._prev_qw = self._prev_qw, cur
+        if prev is None:
+            return None
+        delta = [max(0, b - a) for a, b in zip(prev, cur)]
+        if sum(delta) <= 0:
+            return None
+        hist = {"queue_wait": {"c": delta, "s": 0.0}}
+        return dg.percentile(hist, "queue_wait", 0.9) * 1000.0
+
+    def _serving(self) -> list:
+        return [n for n in self.registry.nodes(online_only=True)
+                if not n.draining]
+
+    # --------------------------------------------------------------- loop
+
+    async def run(self) -> None:
+        while True:
+            await asyncio.sleep(self.tick_s)
+            try:
+                await self.step()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # decision bugs must not kill the task — next tick
+                # starts from fresh registry state
+                log.exception("autoscaler step failed")
+
+    async def step(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        await self._reap_drains(now)
+        merged = self.fed._merged_digest()
+        serving = self._serving()
+        n_serving = len(serving)
+        smin = max(0, knobs.int_("LOCALAI_SCALE_MIN"))
+        smax = max(smin, knobs.int_("LOCALAI_SCALE_MAX"))
+        hysteresis = max(1, knobs.int_("LOCALAI_SCALE_HYSTERESIS"))
+
+        qw_ms = self._windowed_qw_p90_ms(merged)
+        up_thresh = knobs.float_("LOCALAI_SCALE_UP_QW_MS")
+        occ = merged["occ"]
+        n_slots = int(occ.get("n_slots", 0) or 0)
+        busy_frac = (int(occ.get("slots_busy", 0) or 0) / n_slots
+                     if n_slots else 0.0)
+        queue_depth = int(occ.get("queue_depth", 0) or 0)
+        mfu = dg.mfu_mean(merged) or 0.0
+
+        want = n_serving
+        if (up_thresh > 0 and qw_ms is not None and qw_ms > up_thresh
+                and n_serving > 0):
+            self._up_streak += 1
+            self._down_streak = 0
+            if self._up_streak >= hysteresis:
+                want = n_serving + 1
+        elif (n_serving > smin and queue_depth == 0
+              and busy_frac < knobs.float_("LOCALAI_SCALE_DOWN_OCC")
+              and mfu < knobs.float_("LOCALAI_SCALE_DOWN_MFU")):
+            self._down_streak += 1
+            self._up_streak = 0
+            if self._down_streak >= hysteresis:
+                want = n_serving - 1
+        else:
+            self._up_streak = self._down_streak = 0
+        want = max(smin, min(smax, want))
+        self.desired = want
+
+        if want == n_serving or now < self._cooldown_until:
+            return
+        if not self.driver.mutates:
+            # advisory mode: publish intent (gauge + log), touch no
+            # routing state; cooldown just rate-limits the log line
+            self._cooldown_until = now + knobs.float_(
+                "LOCALAI_SCALE_COOLDOWN_S")
+            if want > n_serving:
+                self.driver.scale_up(want - n_serving)
+            else:
+                self.driver.scale_down(None)
+            return
+        if want > n_serving:
+            self._up_streak = 0
+            if await self._invoke("up", self.driver.scale_up,
+                                  want - n_serving, now=now):
+                self.last_scale_up_t = time.monotonic()
+        elif want < n_serving:
+            self._down_streak = 0
+            self._begin_drain(serving, now)
+
+    # ------------------------------------------------------------ actions
+
+    def _begin_drain(self, serving: list, now: float) -> None:
+        """Mark the least-loaded replica as draining: it takes no new
+        traffic from this instant; the kill happens in a later tick's
+        ``_reap_drains`` once it is empty (drain-before-kill)."""
+        def load(n):
+            qd = 0
+            if n.digest is not None:
+                qd = int(n.digest.get("occ", {}).get(
+                    "queue_depth", 0) or 0)
+            return (n.in_flight, qd, n.id)
+
+        victim = min(serving, key=load)
+        victim.draining = True
+        self._drain_deadline[victim.id] = now + knobs.float_(
+            "LOCALAI_SCALE_DRAIN_TIMEOUT_S")
+        self._cooldown_until = now + knobs.float_(
+            "LOCALAI_SCALE_COOLDOWN_S")
+        log.info("autoscaler draining replica %s",
+                 victim.name or victim.id)
+
+    async def _reap_drains(self, now: float) -> None:
+        if now < self._cooldown_until:
+            # the kill is a driver action like any other: it waits out
+            # the cooldown (and a FAILED kill retries only after it —
+            # observed pre-fix as one error per tick against a broken
+            # driver)
+            return
+        for n in list(self.registry.nodes()):
+            if not n.draining:
+                continue
+            deadline = self._drain_deadline.get(n.id, now)
+            qd = 0
+            if n.digest is not None:
+                qd = int(n.digest.get("occ", {}).get(
+                    "queue_depth", 0) or 0)
+            drained = n.in_flight == 0 and qd == 0
+            if not drained and now < deadline:
+                continue  # still busy, inside the drain budget
+            if await self._invoke("down", self.driver.scale_down, n,
+                                  now=now):
+                self.registry.remove(n.id)
+                self._drain_deadline.pop(n.id, None)
+
+    async def _invoke(self, direction: str, fn, *args,
+                      now: Optional[float] = None) -> bool:
+        """Run one driver action under the ``federated.scale`` chaos
+        point. ANY failure (injected or real) is tallied and contained
+        — the loop keeps running, the circuit breakers never hear
+        about it, and the cooldown schedules the retry."""
+        now = time.monotonic() if now is None else now
+        self._cooldown_until = now + knobs.float_(
+            "LOCALAI_SCALE_COOLDOWN_S")
+        try:
+            if faultinject.ACTIVE:
+                faultinject.fire("federated.scale")
+            res = fn(*args)
+            if inspect.isawaitable(res):
+                await res
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.warning("autoscaler scale-%s failed: %r", direction, e)
+            self._tally(direction, "error")
+            return False
+        self._tally(direction, "ok")
+        return True
+
+    def _tally(self, direction: str, outcome: str) -> None:
+        key = (direction, outcome)
+        self.events[key] = self.events.get(key, 0) + 1

@@ -44,6 +44,7 @@ import hmac
 import json
 import logging
 import os
+import random
 import secrets
 import time
 from dataclasses import dataclass, field
@@ -60,7 +61,7 @@ from ..telemetry.tracing import (
     TRACER, fault_scope, make_traceparent, mint_trace_id, new_span_id,
     parse_traceparent,
 )
-from ..utils import faultinject
+from ..utils import faultinject, fingerprint
 
 log = logging.getLogger(__name__)
 
@@ -122,6 +123,10 @@ class Node:
     digest: Optional[dict] = None
     digest_at: float = 0.0
     digest_src: str = ""
+    # autoscaler drain marker: a draining node takes no NEW traffic
+    # (route() skips it) while its in-flight work finishes, then the
+    # ScaleDriver kills it — drain-before-kill, never mid-request
+    draining: bool = False
 
     def online(self, now: Optional[float] = None) -> bool:
         return (now or time.monotonic()) - self.last_seen < STALE_S
@@ -141,13 +146,17 @@ class NodeRegistry:
     """Token-guarded membership table (the gossip-ledger equivalent)
     plus the per-node circuit breakers."""
 
-    def __init__(self, token: str) -> None:
+    def __init__(self, token: str, *,
+                 rng: Optional[random.Random] = None) -> None:
         self.token_payload = parse_token(token)
         self._nodes: dict[str, Node] = {}
         self.breaker_fails = max(
             1, knobs.int_("LOCALAI_FED_BREAKER_FAILS"))
         self.breaker_base_s = knobs.float_("LOCALAI_FED_BREAKER_BASE_S")
         self.breaker_cap_s = knobs.float_("LOCALAI_FED_BREAKER_CAP_S")
+        # injectable RNG: the "random" strategy is seedable in tests
+        # (the module doubles as the default shared Random instance)
+        self.rng = rng if rng is not None else random
 
     def _authorized(self, token: str) -> bool:
         try:
@@ -208,6 +217,12 @@ class NodeRegistry:
         out = sorted(self._nodes.values(), key=lambda n: n.id)
         return [n for n in out if n.online(now)] if online_only else out
 
+    def remove(self, node_id: str) -> None:
+        """Drop a node (autoscaler scale-down after drain + kill; a
+        re-announce from a still-alive member simply re-registers)."""
+        self._nodes.pop(node_id, None)
+        self.update_state_gauge()
+
     # ---- circuit breaker ----
 
     def state(self, n: Node, now: Optional[float] = None) -> str:
@@ -255,19 +270,99 @@ class NodeRegistry:
         active prober is the designated half-open probe — proxy traffic
         prefers known-good nodes). `exclude` carries the ids already
         tried by the current request's retry loop."""
+        node, _ = self.route(strategy, exclude=exclude)
+        return node
+
+    def route(self, strategy: str = "least-used",
+              exclude: frozenset = frozenset(),
+              chain: tuple = ()) -> tuple[Optional[Node], dict]:
+        """``pick`` plus prefix locality: with ``strategy="prefix"``
+        and a request fingerprint ``chain`` (utils/fingerprint.py),
+        eligible nodes are scored ::
+
+            score = alpha * matched_prefix_tokens * disc
+                  - beta  * predicted_drain_s     * disc
+                  - gamma * queue_pressure
+
+        where ``matched_prefix_tokens`` is the largest gossiped prefix
+        entry whose hash appears in the chain, ``disc`` linearly
+        discounts every digest-derived term by age (0 at
+        LOCALAI_DIGEST_STALE_S — a fully stale digest decays to
+        load-only routing on the balancer's own in_flight counts), and
+        ``queue_pressure`` is balancer-live in_flight plus the
+        discounted digest queue/busy fraction. Ties break
+        deterministically on (in_flight, requests_served, id).
+
+        Breaker/exclude semantics are identical to ``pick``; with
+        ``least-used`` (or no chain, or no digests stored) the choice
+        is byte-identical to the legacy pick. Returns ``(node, info)``
+        with ``info = {"result": hit|miss|stale|off,
+        "matched_tokens": int}``.
+        """
         now = time.monotonic()
         online = [n for n in self.nodes(online_only=True)
-                  if n.id not in exclude]
+                  if n.id not in exclude and not n.draining]
         closed = [n for n in online if self.state(n, now) == "closed"]
         pool = closed or [n for n in online
                           if self.state(n, now) == "half_open"]
+        info = {"result": "off", "matched_tokens": 0}
         if not pool:
-            return None
+            return None, info
         if strategy == "random":
-            import random
-
-            return random.choice(pool)
-        return min(pool, key=lambda n: (n.in_flight, n.requests_served))
+            return self.rng.choice(pool), info
+        scored = (strategy == "prefix" and bool(chain)
+                  and any(n.digest is not None for n in pool))
+        if not scored:
+            if strategy == "prefix" and chain:
+                # locality was requested but nothing has gossiped yet
+                info["result"] = "miss"
+            return min(pool, key=lambda n: (n.in_flight,
+                                            n.requests_served)), info
+        alpha = knobs.float_("LOCALAI_ROUTE_ALPHA")
+        beta = knobs.float_("LOCALAI_ROUTE_BETA")
+        gamma = knobs.float_("LOCALAI_ROUTE_GAMMA")
+        stale_s = max(1e-9, knobs.float_("LOCALAI_DIGEST_STALE_S"))
+        hashes = fingerprint.chain_hashes(chain)
+        fresh_match = stale_match = False
+        best = None
+        best_key = None
+        best_hit = (0, 0.0)  # (matched, disc) of the current best
+        for n in pool:
+            matched = 0
+            disc = 0.0
+            drain = 0.0
+            pressure = float(n.in_flight)
+            d = n.digest
+            if d is not None:
+                age = n.digest_age(now) or 0.0
+                disc = max(0.0, 1.0 - age / stale_s)
+                for h, toks in d.get("prefixes", ()):
+                    if h in hashes and int(toks) > matched:
+                        matched = int(toks)
+                drain = float(d.get("drain_s") or 0.0)
+                occ = d.get("occ", {})
+                n_slots = max(1, int(occ.get("n_slots", 0) or 0))
+                pressure += disc * (
+                    int(occ.get("queue_depth", 0) or 0)
+                    + int(occ.get("slots_busy", 0) or 0)) / n_slots
+            if matched:
+                if disc > 0.0:
+                    fresh_match = True
+                else:
+                    stale_match = True
+            score = (alpha * matched * disc - beta * drain * disc
+                     - gamma * pressure)
+            key = (-score, n.in_flight, n.requests_served, n.id)
+            if best_key is None or key < best_key:
+                best, best_key, best_hit = n, key, (matched, disc)
+        if best_hit[0] > 0 and best_hit[1] > 0.0:
+            info["result"] = "hit"
+            info["matched_tokens"] = best_hit[0]
+        elif stale_match and not fresh_match:
+            info["result"] = "stale"
+        else:
+            info["result"] = "miss"
+        return best, info
 
 
 class FederatedServer:
@@ -279,14 +374,26 @@ class FederatedServer:
     HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding",
                    "upgrade", "proxy-authorization", "te", "trailer"}
 
-    def __init__(self, token: str, *, strategy: str = "least-used",
-                 probe_s: Optional[float] = None) -> None:
+    def __init__(self, token: str, *, strategy: Optional[str] = None,
+                 probe_s: Optional[float] = None,
+                 scale_driver=None) -> None:
         self.registry = NodeRegistry(token)
         self.token = token
-        self.strategy = strategy
+        self.strategy = (strategy if strategy is not None
+                         else knobs.str_("LOCALAI_FED_STRATEGY"))
         self.probe_s = (knobs.float_("LOCALAI_FED_PROBE_S")
                         if probe_s is None else probe_s)
         self.slo = fleetmod.SLOMonitor()
+        # SLO-driven elastic autoscaling: runs beside the probe task;
+        # the default LogScaleDriver only logs intent, a real driver
+        # (tools/profile_fleet.py boots warmup-reuse members) acts
+        from .autoscale import Autoscaler
+
+        self.autoscaler = Autoscaler(self, driver=scale_driver)
+        # in-process routing tally (per result class), mirrored into
+        # federation_route_locality_total — profile_fleet reads this
+        # to compute cross-replica prefix hit rates without scraping
+        self.route_stats = {"hit": 0, "miss": 0, "stale": 0, "off": 0}
 
     def build_app(self) -> web.Application:
         app = web.Application()
@@ -302,15 +409,23 @@ class FederatedServer:
 
     async def _client_ctx(self, app):
         self._client = ClientSession(timeout=ClientTimeout(total=600))
-        self._probe_task = (asyncio.get_event_loop().create_task(
-            self._probe_loop()) if self.probe_s > 0 else None)
+        loop = asyncio.get_event_loop()
+        self._probe_task = (loop.create_task(self._probe_loop())
+                            if self.probe_s > 0 else None)
+        # default cadence rides the probe loop (step right after the
+        # digests refresh); an explicit LOCALAI_SCALE_TICK_S runs free
+        self._scale_task = (loop.create_task(self.autoscaler.run())
+                            if self.autoscaler.enabled
+                            and not self.autoscaler.rides_probe
+                            else None)
         yield
-        if self._probe_task is not None:
-            self._probe_task.cancel()
-            try:
-                await self._probe_task
-            except asyncio.CancelledError:
-                pass
+        for task in (self._probe_task, self._scale_task):
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
         await self._client.close()
 
     async def _probe_loop(self) -> None:
@@ -341,6 +456,13 @@ class FederatedServer:
                 if healthy:
                     await self._refresh_digest(node)
             self._slo_tick()
+            if self.autoscaler.enabled and self.autoscaler.rides_probe:
+                try:
+                    await self.autoscaler.step()
+                except Exception:
+                    # same containment as Autoscaler.run(): a decision
+                    # bug must not kill the probe loop
+                    log.exception("autoscaler step failed")
 
     async def _refresh_digest(self, node: Node) -> None:
         """Probe-path digest refresh. Failures here feed
@@ -423,7 +545,7 @@ class FederatedServer:
             lambda: (self._merged_digest(), self._offline_frac()))
         text = fleetmod.render_fleet(
             self._node_views(limit), self._merged_digest(),
-            self.slo.evaluate())
+            self.slo.evaluate(), scale=self.autoscaler.snapshot())
         return web.Response(body=text.encode("utf-8"), headers={
             "Content-Type": CONTENT_TYPE, "Cache-Control": "no-store"})
 
@@ -485,6 +607,7 @@ class FederatedServer:
              "online": n.online(now), "in_flight": n.in_flight,
              "requests_served": n.requests_served,
              "state": self.registry.state(n, now),
+             "draining": n.draining,
              "consec_failures": n.consec_failures,
              "breaker_open_for_s": round(max(0.0, n.open_until - now), 3),
              "last_error": n.last_error,
@@ -512,9 +635,28 @@ class FederatedServer:
         status = "error"
         tried: set[str] = set()
         shed_hints: list[float] = []
+        # prefix-locality fingerprint: hash the SAME canonical bytes
+        # the member edge hashes (utils/fingerprint.py), so the chain
+        # matches the hashes the fleet gossips in digest `prefixes`.
+        # Non-JSON / non-chat bodies yield an empty chain = locality
+        # off for that request, never an error.
+        chain = (fingerprint.chain_from_bytes(data)
+                 if request.method == "POST" else ())
         try:
             while True:
-                node = self.registry.pick(self.strategy, exclude=tried)
+                node, rinfo = self.registry.route(
+                    self.strategy, exclude=tried, chain=chain)
+                if not tried:
+                    # first attempt only: retries are breaker business,
+                    # not routing-quality signal
+                    res = rinfo["result"]
+                    self.route_stats[res] = (
+                        self.route_stats.get(res, 0) + 1)
+                    tm.FEDERATION_ROUTE_LOCALITY.labels(
+                        result=res).inc()
+                    if rinfo["matched_tokens"]:
+                        tm.FEDERATION_PREFIX_MATCHED.inc(
+                            rinfo["matched_tokens"])
                 if node is None:
                     if not self.registry.nodes():
                         # nothing has ever registered: a retry cannot
@@ -553,7 +695,9 @@ class FederatedServer:
                 tried.add(node.id)
                 TRACER.annotate(rid, "pick", node=node.name,
                                 breaker=self.registry.state(node),
-                                attempt=len(tried))
+                                attempt=len(tried),
+                                locality=rinfo["result"],
+                                matched_tokens=rinfo["matched_tokens"])
                 resp, shed_s = await self._proxy_once(
                     request, node, data, rerouted=len(tried) > 1,
                     rid=rid, trace_id=tid)

@@ -24,6 +24,7 @@ from aiohttp import web
 
 from ..config.model_config import ModelConfig, Usecase
 from ..telemetry.tracing import TRACER
+from ..utils import fingerprint
 from ..grammars.json_schema import functions_grammar, schema_to_gbnf
 from ..grammars.parse import (FinetuneStream, apply_finetune,
                               parse_function_call, parse_text_content)
@@ -217,6 +218,11 @@ def _predict_options(cfg: ModelConfig, body: dict, prompt: str,
         logit_bias=logit_bias,
         correlation_id=correlation_id,
         timeout_s=max(0.0, float(pick("timeout", 0.0) or 0.0)),
+        # member-edge fingerprint chain over the SAME canonical bytes
+        # the federated balancer hashes (utils/fingerprint.py) — the
+        # engine gossips these hashes so locality routing can match a
+        # raw incoming body against fleet KV residency
+        prefix_chain=fingerprint.chain_from_body(body),
     )
 
 

@@ -209,7 +209,8 @@ class SLOMonitor:
 
 
 def render_fleet(nodes: list[dict], merged: dict,
-                 slo_eval: Optional[dict] = None) -> str:
+                 slo_eval: Optional[dict] = None,
+                 scale: Optional[dict] = None) -> str:
     """Prometheus 0.0.4 page for ``GET /fleet/metrics``. ``nodes`` is a
     list of balancer-side node views::
 
@@ -219,7 +220,10 @@ def render_fleet(nodes: list[dict], merged: dict,
     ``merged`` is the exact bucket-merge of every last-good digest.
     Built on a throwaway Registry per scrape (node churn can never
     accumulate label sets); histogram children are loaded from raw
-    digest counts via ``Histogram.load``.
+    digest counts via ``Histogram.load``. ``scale`` is the autoscaler's
+    cumulative snapshot (``parallel/autoscale.py``): the desired
+    replica count plus (direction, outcome) event tallies, loaded as
+    counters so scrapers see a monotone series.
     """
     reg = Registry()
     cap = max(len(nodes) + 1, 8)
@@ -340,4 +344,21 @@ def render_fleet(nodes: list[dict], merged: dict,
             for st in _STATES:
                 g_state.labels(objective=name, state=st).set(
                     1.0 if obj["state"] == st else 0.0)
+
+    if scale is not None:
+        g_desired = reg.gauge(
+            "fleet_replicas_desired_count",
+            "Replica count the autoscaler currently wants "
+            "(LOCALAI_SCALE_MIN..MAX bounded; the log-only driver "
+            "publishes intent without acting)")
+        c_events = reg.counter(
+            "fleet_scale_events_total",
+            "Autoscaler actions by direction and outcome (error = the "
+            "ScaleDriver failed; contained, retried after cooldown, "
+            "never fed to the circuit breakers)",
+            labels=("direction", "outcome"), max_label_sets=8)
+        g_desired.set(scale.get("desired", 0))
+        for (direction, outcome), n in sorted(
+                scale.get("events", {}).items()):
+            c_events.labels(direction=direction, outcome=outcome).inc(n)
     return reg.render()

@@ -298,6 +298,21 @@ FEDERATION_DIGEST_ERRORS = REGISTRY.counter(
     "bad digest",
     labels=("reason",),
 )
+FEDERATION_ROUTE_LOCALITY = REGISTRY.counter(
+    "federation_route_locality_total",
+    "Prefix-locality routing decisions by result (hit = picked node "
+    "holds the request's fingerprinted prefix per a fresh digest, "
+    "miss = no eligible node matched, stale = matches existed only on "
+    "stale digests so routing decayed to load-only, off = non-prefix "
+    "strategy or no fingerprint chain in the body)",
+    labels=("result",),
+)
+FEDERATION_PREFIX_MATCHED = REGISTRY.counter(
+    "federation_prefix_matched_tokens_total",
+    "Prefix tokens the balancer routed onto a node already holding "
+    "them (gossiped-digest estimate at pick time; the cross-replica "
+    "KV reuse the locality strategy buys)",
+)
 FAULTS_INJECTED = REGISTRY.counter(
     "faults_injected_total",
     "Faults actually delivered by armed LOCALAI_FAULTS injection points "

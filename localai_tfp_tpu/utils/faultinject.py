@@ -4,8 +4,10 @@ Named injection points are compiled into the failure-prone layers —
 the engine device-step funnel (``engine.device_step``), the model
 loader (``loader.load``), the multihost dispatch channel
 (``multihost.publish``), the federated proxy
-(``federated.upstream`` / ``federated.midstream``), and the balancer's
-telemetry-digest probe fetch (``federated.digest``) — and armed via
+(``federated.upstream`` / ``federated.midstream``), the balancer's
+telemetry-digest probe fetch (``federated.digest``), and the
+autoscaler's ScaleDriver boot/kill actions (``federated.scale``) —
+and armed via
 
     LOCALAI_FAULTS="point:spec[,point:spec...]"
 

@@ -1,0 +1,134 @@
+"""Message-boundary fingerprint chains — the shared hash both edges use.
+
+The federated balancer wants to route a request to the replica that
+already holds its KV prefix, but the balancer has no tokenizer: it sees
+raw JSON bodies, while the engine's prefix index is keyed by token ids.
+The bridge is a *fingerprint chain* computed from canonical message
+bytes — something both the balancer and the member HTTP edge can derive
+from the same request body, independently, and get identical hashes.
+
+``chain_from_body(body)`` returns a tuple of ``(hash_hex, cum_bytes)``
+pairs, one per message boundary::
+
+    h_0   = H(seed)                      seed = model name
+    h_i   = H(h_{i-1} || canon(msg_i))   blake2b, 8-byte hex
+
+Chain-element equality at depth ``j`` proves the first ``j`` messages
+are byte-identical — exactly the prefix-reuse condition, because chat
+templates render message prefixes deterministically. ``cum_bytes`` (the
+cumulative canonical byte length through boundary ``i``) lets the
+engine estimate per-boundary *token* counts by scaling the known prompt
+token length by byte fraction, so gossiped digests can advertise
+"I hold ~N reusable tokens behind hash h" without the balancer ever
+tokenizing anything.
+
+Canonicalisation keeps only the fields that affect the rendered
+prompt (role/content/name/tool fields), serialised as compact
+sorted-key JSON — whitespace or key-order differences between clients
+do not break matching, while any content difference does.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Iterable, Sequence
+
+# blake2b with an 8-byte digest -> 16 hex chars, matching the width the
+# digest plane already gossips for engine prefix hashes.
+HASH_HEX_LEN = 16
+
+# message fields that influence the rendered prompt; everything else
+# (timestamps, client metadata) is ignored so it can't break matching
+_CANON_FIELDS = ("role", "content", "name", "tool_calls", "tool_call_id")
+
+
+def _h(prev_hex: str, payload: bytes) -> str:
+    h = hashlib.blake2b(digest_size=8)
+    h.update(prev_hex.encode("ascii"))
+    h.update(payload)
+    return h.hexdigest()
+
+
+def canon_message(msg: Any) -> bytes:
+    """Canonical bytes for one chat message: routing-relevant fields
+    only, compact sorted-key JSON, UTF-8 (``ensure_ascii=False`` so
+    unicode content hashes over its actual bytes, not escapes)."""
+    if not isinstance(msg, dict):
+        msg = {"content": "" if msg is None else str(msg)}
+    keep = {}
+    for k in _CANON_FIELDS:
+        v = msg.get(k)
+        if v is not None:
+            keep[k] = v
+    try:
+        return json.dumps(keep, sort_keys=True, separators=(",", ":"),
+                          ensure_ascii=False).encode("utf-8")
+    except (TypeError, ValueError):
+        # non-JSON-able content: degrade to repr bytes rather than fail
+        return repr(keep).encode("utf-8")
+
+
+def chain_from_messages(messages: Iterable[Any],
+                        seed: str = "") -> tuple:
+    """Fingerprint chain over a chat ``messages`` list."""
+    prev = _h("", str(seed).encode("utf-8"))
+    cum = 0
+    out = []
+    for m in messages:
+        payload = canon_message(m)
+        cum += len(payload)
+        prev = _h(prev, payload)
+        out.append((prev, cum))
+    return tuple(out)
+
+
+def chain_from_prompt(prompt: Any, seed: str = "") -> tuple:
+    """Single-boundary chain for a plain completion prompt (string or
+    list of strings). Whole-prompt granularity: completions only match
+    on identical full prompts, which is the honest claim without
+    message structure to segment on."""
+    if isinstance(prompt, (list, tuple)):
+        prompt = "\n".join("" if p is None else str(p) for p in prompt)
+    payload = ("" if prompt is None else str(prompt)).encode("utf-8")
+    if not payload:
+        return ()
+    prev = _h("", str(seed).encode("utf-8"))
+    return ((_h(prev, payload), len(payload)),)
+
+
+def chain_from_body(body: Any) -> tuple:
+    """Chain for a raw OpenAI-style request body (already-parsed dict).
+
+    Dispatches on ``messages`` (chat) vs ``prompt`` (completions);
+    returns ``()`` for anything unrecognised — an empty chain simply
+    disables locality routing for that request."""
+    if not isinstance(body, dict):
+        return ()
+    seed = str(body.get("model") or "")
+    msgs = body.get("messages")
+    if isinstance(msgs, (list, tuple)) and msgs:
+        return chain_from_messages(msgs, seed)
+    prompt = body.get("prompt")
+    if prompt:
+        return chain_from_prompt(prompt, seed)
+    return ()
+
+
+def chain_from_bytes(raw: bytes) -> tuple:
+    """Balancer-edge convenience: parse raw body bytes and fingerprint
+    them. Any parse failure -> empty chain (locality off, never an
+    error — routing must not reject what the member might accept)."""
+    if not raw:
+        return ()
+    try:
+        body = json.loads(raw.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return ()
+    return chain_from_body(body)
+
+
+def chain_hashes(chain: Sequence) -> frozenset:
+    """The hash set of a chain, for membership tests against gossiped
+    digest entries."""
+    return frozenset(e[0] for e in chain)

@@ -67,6 +67,10 @@ class PredictOptions:
     # LOCALAI_REQUEST_DEADLINE_S; the engine enforces it while queued
     # and while decoding)
     timeout_s: float = 0.0
+    # message-boundary fingerprint chain computed at the HTTP edge from
+    # the raw body (utils/fingerprint.py) — rides into GenRequest so
+    # the engine's prefix gossip carries balancer-derivable hashes
+    prefix_chain: tuple = ()
 
 
 @dataclass

@@ -604,6 +604,7 @@ class JaxLLMBackend(Backend):
             prompt_cache_ro=opts.prompt_cache_ro,
             correlation_id=opts.correlation_id,
             timeout_s=max(0.0, opts.timeout_s),
+            prefix_chain=tuple(opts.prefix_chain or ()),
             soft_embeds=soft_embeds,
             soft_positions=soft_positions,
             **({"id": opts.request_id} if opts.request_id else {}),

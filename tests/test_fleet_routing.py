@@ -1,0 +1,416 @@
+"""Prefix-locality fleet routing + SLO-driven autoscaling (ISSUE 18):
+edge fingerprint-chain agreement, the cost-scored route() (locality vs
+load trade-off, staleness decay, least-used byte-compat), the
+autoscaler loop (scale-up on queue-wait, drain-before-kill), and the
+``federated.scale`` chaos point."""
+
+import asyncio
+import json
+import random
+import time
+from bisect import bisect_left
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from localai_tfp_tpu.parallel.autoscale import Autoscaler, ScaleDriver
+from localai_tfp_tpu.parallel.federated import (
+    FederatedServer, NodeRegistry, generate_token,
+)
+from localai_tfp_tpu.telemetry import digest as dg
+from localai_tfp_tpu.telemetry import metrics as tm
+from localai_tfp_tpu.utils import faultinject as fi
+from localai_tfp_tpu.utils import fingerprint as fp
+
+
+@pytest.fixture(autouse=True)
+def _faults_disarmed():
+    fi.disarm()
+    yield
+    fi.disarm()
+
+
+def _qw_hist(vals):
+    bounds = dg.HIST_BOUNDS["queue_wait"]
+    counts = [0] * (len(bounds) + 1)
+    for v in vals:
+        counts[bisect_left(bounds, v)] += 1
+    return {"c": counts, "s": round(sum(vals), 6)}
+
+
+def _counter(family, **labels):
+    return family.labels(**labels).value
+
+
+# ------------------------------------------------- fingerprint chains
+
+
+CHAT_BODIES = [
+    {"model": "m", "messages": [
+        {"role": "system", "content": "You are a helpful assistant."},
+        {"role": "user", "content": "hello"}]},
+    {"model": "m", "messages": [
+        {"role": "user", "content": "héllo ünïcode ☃ \U0001f680"}]},
+    {"model": "m", "messages": [
+        {"role": "user", "content": "weather?"},
+        {"role": "assistant", "content": None,
+         "tool_calls": [{"id": "c1", "type": "function", "function": {
+             "name": "get_weather", "arguments": "{\"city\":\"SF\"}"}}]},
+        {"role": "tool", "tool_call_id": "c1", "content": "sunny"}]},
+]
+
+
+@pytest.mark.parametrize("body", CHAT_BODIES)
+def test_chain_agrees_balancer_vs_member(body):
+    """The balancer hashes raw bytes, the member hashes the parsed
+    body — identical requests must produce identical chains, across
+    unicode, system prompts and tool messages, and regardless of JSON
+    key order / whitespace."""
+    member_chain = fp.chain_from_body(body)
+    assert member_chain and all(len(h) == fp.HASH_HEX_LEN
+                                for h, _ in member_chain)
+    raw = json.dumps(body).encode("utf-8")
+    assert fp.chain_from_bytes(raw) == member_chain
+    # key order and whitespace differences must not change the chain
+    shuffled = json.dumps(body, indent=2, sort_keys=True).encode()
+    assert fp.chain_from_bytes(shuffled) == member_chain
+    # cum_bytes strictly increases; hashes chain (prefix property)
+    cums = [b for _, b in member_chain]
+    assert cums == sorted(cums) and cums[0] > 0
+
+
+def test_chain_prefix_extension_and_divergence():
+    base = {"model": "m", "messages": [{"role": "user", "content": "a"}]}
+    ext = {"model": "m", "messages": base["messages"] + [
+        {"role": "assistant", "content": "b"}]}
+    other = {"model": "m", "messages": [{"role": "user", "content": "X"}]}
+    c_base, c_ext = fp.chain_from_body(base), fp.chain_from_body(ext)
+    assert c_ext[: len(c_base)] == c_base  # shared prefix, shared chain
+    assert fp.chain_from_body(other)[0] != c_base[0]
+    # a different model seeds a different chain (KV is model-scoped)
+    alt = dict(base, model="m2")
+    assert fp.chain_from_body(alt)[0][0] != c_base[0][0]
+    # non-chat bodies: no chain, never an error
+    assert fp.chain_from_bytes(b"x") == ()
+    assert fp.chain_from_body({"input": "embed me"}) == ()
+
+
+# ------------------------------------------------------ scored routing
+
+
+def _reg(n=2):
+    tok = generate_token()
+    reg = NodeRegistry(tok)
+    for i in range(n):
+        reg.announce(tok, f"n{i}", f"n{i}", f"http://n{i}")
+    return tok, reg
+
+
+def _prefix_digest(chain, tokens=64, **kw):
+    return dg.build(prefixes=[(chain[-1][0], tokens)], **kw)
+
+
+def test_locality_beats_load_up_to_tradeoff(monkeypatch):
+    """alpha*matched wins against a moderately loaded holder; beyond
+    the alpha/gamma trade-off an idle non-holder wins."""
+    monkeypatch.setenv("LOCALAI_ROUTE_ALPHA", "0.01")
+    monkeypatch.setenv("LOCALAI_ROUTE_GAMMA", "1")
+    tok, reg = _reg(2)
+    chain = fp.chain_from_body(CHAT_BODIES[0])
+    holder, idle = reg._nodes["n0"], reg._nodes["n1"]
+    reg.store_digest(holder, _prefix_digest(chain, tokens=400))
+    reg.store_digest(idle, dg.build())
+    # 400 matched tokens * 0.01 = 4.0 score headroom
+    holder.in_flight = 3
+    node, info = reg.route("prefix", chain=chain)
+    assert node.id == "n0" and info["result"] == "hit"
+    assert info["matched_tokens"] == 400
+    # hot holder loses to the idle node past the trade-off
+    holder.in_flight = 5
+    node, info = reg.route("prefix", chain=chain)
+    assert node.id == "n1" and info["result"] == "miss"
+
+
+def test_stale_digest_decays_to_load_only(monkeypatch):
+    monkeypatch.setenv("LOCALAI_DIGEST_STALE_S", "60")
+    tok, reg = _reg(2)
+    chain = fp.chain_from_body(CHAT_BODIES[0])
+    holder, idle = reg._nodes["n0"], reg._nodes["n1"]
+    reg.store_digest(holder, _prefix_digest(chain, tokens=4000))
+    reg.store_digest(idle, dg.build())
+    holder.in_flight = 1
+    # fresh: a big locality term dominates the 1-request load gap
+    assert reg.route("prefix", chain=chain)[0].id == "n0"
+    # fully stale: the locality AND drain terms vanish -> load-only
+    holder.digest_at -= 120.0
+    node, info = reg.route("prefix", chain=chain)
+    assert node.id == "n1" and info["result"] == "stale"
+    assert info["matched_tokens"] == 0
+
+
+def test_least_used_byte_identical_and_no_digest_fallback():
+    """``least-used`` (and the prefix strategy with nothing gossiped)
+    must pick exactly what HEAD's pick() picked."""
+    tok, reg = _reg(4)
+    rnd = random.Random(7)
+    chain = fp.chain_from_body(CHAT_BODIES[0])
+    for _ in range(50):
+        for n in reg._nodes.values():
+            n.in_flight = rnd.randrange(4)
+            n.requests_served = rnd.randrange(4)
+        legacy = min(
+            (n for n in reg.nodes(online_only=True)),
+            key=lambda n: (n.in_flight, n.requests_served))
+        assert reg.pick("least-used") is legacy
+        # prefix strategy, chain present, but NO digests stored:
+        # identical choice (locality cannot act on nothing)
+        node, info = reg.route("prefix", chain=chain)
+        assert node is legacy and info["result"] == "miss"
+        # no chain at all: locality reports off, same pick
+        node, info = reg.route("prefix")
+        assert node is legacy and info["result"] == "off"
+
+
+def test_random_strategy_seedable():
+    tok = generate_token()
+    reg = NodeRegistry(tok, rng=random.Random(1234))
+    for i in range(5):
+        reg.announce(tok, f"n{i}", f"n{i}", f"http://n{i}")
+    seq = [reg.pick("random").id for _ in range(8)]
+    reg2 = NodeRegistry(tok, rng=random.Random(1234))
+    for i in range(5):
+        reg2.announce(tok, f"n{i}", f"n{i}", f"http://n{i}")
+    assert [reg2.pick("random").id for _ in range(8)] == seq
+
+
+def test_draining_node_takes_no_new_traffic():
+    tok, reg = _reg(2)
+    reg._nodes["n0"].draining = True
+    for _ in range(4):
+        assert reg.pick("least-used").id == "n1"
+    reg._nodes["n1"].draining = True
+    assert reg.pick("least-used") is None
+
+
+def test_proxy_routes_to_prefix_holder_end_to_end():
+    """Full HTTP path: the balancer fingerprints the raw body and lands
+    the request on the member whose gossiped digest holds the prefix,
+    with the locality counters moving."""
+    body = CHAT_BODIES[0]
+    chain = fp.chain_from_body(body)
+
+    async def go():
+        hits = {"m1": 0, "m2": 0}
+
+        def member(name):
+            async def handler(request):
+                hits[name] += 1
+                return web.json_response({"member": name})
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", handler)
+            return app
+
+        m1, m2 = TestServer(member("m1")), TestServer(member("m2"))
+        await m1.start_server()
+        await m2.start_server()
+        tok = generate_token()
+        fed = FederatedServer(tok, strategy="prefix", probe_s=0)
+        fed.registry.announce(tok, "m1", "m1",
+                              f"http://127.0.0.1:{m1.port}")
+        fed.registry.announce(tok, "m2", "m2",
+                              f"http://127.0.0.1:{m2.port}")
+        fed.registry.store_digest(
+            fed.registry._nodes["m2"], _prefix_digest(chain, tokens=300))
+        fed.registry.store_digest(fed.registry._nodes["m1"], dg.build())
+        client = TestClient(TestServer(fed.build_app()))
+        await client.start_server()
+        hit0 = _counter(tm.FEDERATION_ROUTE_LOCALITY, result="hit")
+        matched0 = tm.FEDERATION_PREFIX_MATCHED._solo().value
+        for _ in range(3):
+            resp = await client.post("/v1/chat/completions", json=body)
+            assert resp.status == 200
+            assert (await resp.json())["member"] == "m2"
+        # a non-chat body falls back to least-used (locality off)
+        await client.post("/v1/models", data=b"x")
+        assert hits["m2"] == 3
+        assert fed.route_stats["hit"] == 3
+        assert fed.route_stats["off"] >= 1
+        assert _counter(tm.FEDERATION_ROUTE_LOCALITY,
+                        result="hit") == hit0 + 3
+        assert tm.FEDERATION_PREFIX_MATCHED._solo().value \
+            == matched0 + 3 * 300
+        # the exposition includes the autoscaler families
+        page = await (await client.get("/fleet/metrics")).text()
+        assert "fleet_replicas_desired_count" in page
+        assert "fleet_scale_events_total" in page
+        await client.close()
+        await m1.close()
+        await m2.close()
+
+    asyncio.new_event_loop().run_until_complete(go())
+
+
+# --------------------------------------------------------- autoscaler
+
+
+class _RecordingDriver(ScaleDriver):
+    mutates = True
+
+    def __init__(self):
+        self.ups = []
+        self.downs = []
+
+    def scale_up(self, count):
+        self.ups.append(count)
+
+    def scale_down(self, node):
+        self.downs.append(node.id)
+
+
+def _scale_env(monkeypatch, **over):
+    env = {"LOCALAI_SCALE_UP_QW_MS": "500",
+           "LOCALAI_SCALE_HYSTERESIS": "1",
+           "LOCALAI_SCALE_COOLDOWN_S": "30",
+           "LOCALAI_SCALE_MIN": "1", "LOCALAI_SCALE_MAX": "8"}
+    env.update({k: str(v) for k, v in over.items()})
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+
+
+def _fed_with_nodes(n=1, **fed_kw):
+    tok = generate_token()
+    fed = FederatedServer(tok, probe_s=0, **fed_kw)
+    for i in range(n):
+        fed.registry.announce(tok, f"n{i}", f"n{i}", f"http://n{i}")
+    return tok, fed
+
+
+def test_scale_up_on_windowed_queue_wait(monkeypatch):
+    """Cumulative queue-wait counts diff per tick; a p90 burst over
+    LOCALAI_SCALE_UP_QW_MS boots a replica. An idle tick (no delta)
+    must NOT read as slow traffic."""
+    _scale_env(monkeypatch)
+    tok, fed = _fed_with_nodes(1)
+    driver = _RecordingDriver()
+    auto = fed.autoscaler
+    auto.driver = driver
+    node = fed.registry._nodes["n0"]
+
+    async def go():
+        t = time.monotonic()
+        fed.registry.store_digest(node, dg.build(
+            hist={"queue_wait": _qw_hist([1.0] * 20)}))
+        await auto.step(now=t)  # primes the window, no baseline yet
+        assert driver.ups == []
+        # no new samples -> no signal, even though cumulative p90 is 1 s
+        await auto.step(now=t + 1)
+        assert driver.ups == [] and auto._up_streak == 0
+        # 20 NEW slow waits land -> delta p90 ~1 s > 500 ms -> scale up
+        fed.registry.store_digest(node, dg.build(
+            hist={"queue_wait": _qw_hist([1.0] * 40)}))
+        await auto.step(now=t + 2)
+        assert driver.ups == [1]
+        assert auto.desired == 2
+        assert auto.events[("up", "ok")] == 1
+        # cooldown holds even if the signal persists
+        fed.registry.store_digest(node, dg.build(
+            hist={"queue_wait": _qw_hist([1.0] * 60)}))
+        await auto.step(now=t + 3)
+        assert driver.ups == [1]
+
+    asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_scale_down_drains_before_kill(monkeypatch):
+    """The victim leaves rotation immediately but is only killed once
+    the balancer's in-flight count hits zero (or the drain times out),
+    and the registry drops it after the driver kill."""
+    _scale_env(monkeypatch)
+    tok, fed = _fed_with_nodes(2)
+    driver = _RecordingDriver()
+    auto = fed.autoscaler
+    auto.driver = driver
+
+    async def go():
+        t = time.monotonic()
+        for n in fed.registry.nodes():
+            fed.registry.store_digest(n, dg.build())  # idle digests
+        busy = fed.registry._nodes["n0"]
+        busy.in_flight = 2  # victim selection prefers the emptier n1
+        await auto.step(now=t)
+        victim = fed.registry._nodes["n1"]
+        assert victim.draining and driver.downs == []
+        assert auto.desired == 1
+        # draining node takes no traffic; the kill waits for drain
+        assert fed.registry.pick("least-used").id == "n0"
+        victim.in_flight = 1
+        await auto.step(now=t + 40)  # past cooldown, still in flight
+        assert driver.downs == []
+        victim.in_flight = 0
+        await auto.step(now=t + 41)
+        assert driver.downs == ["n1"]
+        assert "n1" not in fed.registry._nodes
+        assert auto.events[("down", "ok")] == 1
+
+    asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_scale_chaos_never_wedges_or_trips_breaker(monkeypatch):
+    """Satellite 3: a ScaleDriver failure (federated.scale) is tallied
+    as outcome=error, never touches the circuit breakers, and the
+    autoscaler retries after the cooldown."""
+    _scale_env(monkeypatch, LOCALAI_SCALE_COOLDOWN_S="5")
+    tok, fed = _fed_with_nodes(1)
+    driver = _RecordingDriver()
+    auto = fed.autoscaler
+    auto.driver = driver
+    node = fed.registry._nodes["n0"]
+    fi.arm("federated.scale:fail@1")
+
+    async def go():
+        t = time.monotonic()
+        fed.registry.store_digest(node, dg.build(
+            hist={"queue_wait": _qw_hist([1.0] * 20)}))
+        await auto.step(now=t)
+        fed.registry.store_digest(node, dg.build(
+            hist={"queue_wait": _qw_hist([1.0] * 40)}))
+        await auto.step(now=t + 1)  # boot attempt -> injected fault
+        assert driver.ups == []
+        assert auto.events[("up", "error")] == 1
+        # contained: breakers untouched, loop keeps deciding
+        assert node.consec_failures == 0
+        assert fed.registry.state(node) == "closed"
+        # still cooling down: no retry yet
+        fed.registry.store_digest(node, dg.build(
+            hist={"queue_wait": _qw_hist([1.0] * 60)}))
+        await auto.step(now=t + 2)
+        assert driver.ups == []
+        # cooldown elapsed + signal persists -> retry succeeds
+        fed.registry.store_digest(node, dg.build(
+            hist={"queue_wait": _qw_hist([1.0] * 80)}))
+        await auto.step(now=t + 8)
+        assert driver.ups == [1]
+        assert auto.events[("up", "ok")] == 1
+        assert _counter(tm.FAULTS_INJECTED, point="federated.scale") >= 1
+
+    asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_log_driver_publishes_intent_without_acting(monkeypatch):
+    """The default driver must never mutate routing state: desired
+    moves, nothing drains, nothing leaves the registry."""
+    _scale_env(monkeypatch)
+    tok, fed = _fed_with_nodes(3)
+
+    async def go():
+        t = time.monotonic()
+        for n in fed.registry.nodes():
+            fed.registry.store_digest(n, dg.build())
+        await fed.autoscaler.step(now=t)
+        assert fed.autoscaler.desired == 2  # wants one fewer
+        assert all(not n.draining for n in fed.registry.nodes())
+        assert len(fed.registry.nodes()) == 3
+        assert fed.autoscaler.events == {}
+
+    asyncio.new_event_loop().run_until_complete(go())

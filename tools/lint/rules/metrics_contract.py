@@ -58,6 +58,10 @@ REQUIRED_FAMILIES = {
     "federation_node_state_count",
     "federation_retries_total",
     "federation_digest_errors_total",
+    "federation_route_locality_total",
+    "federation_prefix_matched_tokens_total",
+    "fleet_replicas_desired_count",
+    "fleet_scale_events_total",
     "fleet_ttft_seconds",
     "fleet_itl_seconds",
     "fleet_queue_wait_seconds",
