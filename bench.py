@@ -384,13 +384,40 @@ def _kv_tiering_extra(eng, tok) -> dict:
     return out
 
 
+def _disagg_extra() -> dict:
+    """Disaggregated-serving acceptance block (extra.disagg): the
+    tools/profile_disagg contrast on a dedicated engine pair — decode
+    ITL p99 and the max inter-token gap with long prompts flooding the
+    same engine vs split across the migration relay (both must be
+    STRICTLY better with disagg on), migration wall p50/p95, the
+    zero-re-prefill cross-check, and the seeded byte-identity leg.
+    Runs on a dedicated pair for the same reason as the tiering
+    capacity story: the live bench engine is not disaggregated."""
+    from tools.profile_disagg import disagg_contrast
+
+    r = disagg_contrast(True)
+    return {
+        "ok": r["ok"],
+        "itl_p99_ms_off": r["off"]["itl_p99_ms"],
+        "itl_p99_ms_on": r["on"]["itl_p99_ms"],
+        "max_gap_ms_off": r["off"]["max_gap_ms"],
+        "max_gap_ms_on": r["on"]["max_gap_ms"],
+        "itl_p99_improved": r["itl_p99_improved"],
+        "max_gap_improved": r["max_gap_improved"],
+        "migration_ms": r["on"]["migration_ms"],
+        "zero_reprefill": r["zero_reprefill"],
+        "seeded_identity": r["identity"]["identical"],
+        "contrast": r,
+    }
+
+
 # extras that measure the LIVE serving engine: _bench_http's teardown
 # (runner.cleanup()) fires the app cleanup that CLOSES it, so these must
 # be recorded first. _bench_http enforces the order (it was a
 # comment-only gotcha through PR 4; measuring a closed engine reports
 # garbage silently).
 _LIVE_ENGINE_EXTRAS = ("mixed_itl", "paged_kv", "ragged_attn",
-                       "kv_tiering")
+                       "kv_tiering", "disagg")
 
 
 def _mixed_itl_extra(eng, tok, n_tok=96) -> dict:
@@ -1371,6 +1398,9 @@ def main() -> None:
             # tiered KV acceptance: decode overhead on THIS live
             # engine, capacity multiple on a dedicated pair
             extra["kv_tiering"] = _kv_tiering_extra(eng8, tok8)
+            # disaggregated-serving acceptance: ITL contrast +
+            # zero-re-prefill on a dedicated pair
+            extra["disagg"] = _disagg_extra()
             tok_s, p50_h, p95_h, p50_steady = _bench_http(
                 state, "bench8b", 64, 512, runs=2, extra=extra)
             extra["ttft_p50_ms_8b_http"] = p50_h
@@ -1411,6 +1441,9 @@ def main() -> None:
         extra["ragged_attn"]["warmup"] = _ragged_warmup_compare(
             spec, params, tok)
         extra["kv_tiering"] = _kv_tiering_extra(eng, tok)
+        # disaggregated-serving acceptance: ITL contrast +
+        # zero-re-prefill on a dedicated pair
+        extra["disagg"] = _disagg_extra()
         # smoke HTTP leg: a minimal Application with the in-memory
         # engine registered (the TPU leg exercises the full disk-loader
         # path; here the endpoint plumbing is what's smoke-tested)

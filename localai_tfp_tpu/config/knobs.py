@@ -108,6 +108,27 @@ _knob("LOCALAI_KV_TIER_DIR", "", "str",
 _knob("LOCALAI_KV_TIER_INFLIGHT_MB", "64", "float",
       "In-flight spill transfer window, in MiB.")
 
+# ------------------------------------------------- disaggregated serving
+_knob("LOCALAI_DISAGG", "off", "flag",
+      "Disaggregated prefill/decode serving: a second prefill-tuned "
+      "engine runs long prompts and migrates finished KV pages to the "
+      "decode engine (engine/kv_migrate.py); off is byte-identical "
+      "single-engine serving.")
+_knob("LOCALAI_DISAGG_MIN_PROMPT", "256", "int",
+      "Minimum prompt tokens before a request takes the disaggregated "
+      "path; shorter prompts stay on the decode engine.")
+_knob("LOCALAI_DISAGG_MIN_MS", "0", "float",
+      "Minimum PREDICTED prefill milliseconds (cost-model "
+      "prefill_token_ms x prompt tokens) before disaggregating; 0 "
+      "routes on prompt length alone.")
+_knob("LOCALAI_DISAGG_MIGRATE_DEADLINE_S", "5", "float",
+      "Budget for the migrate stage (prefill terminal to adopted "
+      "handoff) before the request falls back to re-prefill on the "
+      "decode engine.")
+_knob("LOCALAI_DISAGG_PREFILL_SLOTS", "2", "int",
+      "Slot count for the prefill-side engine (it holds at most this "
+      "many prompts in flight; each finishes at its first token).")
+
 # ------------------------------------------------------------ dispatch
 _knob("LOCALAI_PREFILL_GROUP_TOKENS", "8192", "int",
       "Token budget per fused prefill/mixed dispatch — bounds the "

@@ -151,6 +151,14 @@ class GenRequest:
     # gossip advertises hashes the federated balancer can recompute
     # from a raw request body without a tokenizer
     prefix_chain: tuple = ()
+    # disaggregated serving handoff (engine/kv_migrate.KVHandoff): set
+    # by the DisaggRouter on the decode-engine resubmit of a request
+    # whose prompt was prefilled on the prefill engine. _admit adopts
+    # the migrated pages instead of prefilling, and submit_many
+    # preserves the ORIGINAL t_submit/deadline it carries so TTFT and
+    # deadline enforcement stay end-to-end. Host-only — never rides a
+    # dispatch payload.
+    disagg: Optional[Any] = None
 
 
 class _PadReq:
@@ -456,6 +464,10 @@ class LLMEngine:
         # several models publish on one channel
         state_dir: Optional[str] = None,  # where OOM post-mortems and
         # profiler captures land (None: $STATE_DIR, else ./run)
+        kv_tier: Optional[bool] = None,  # tiered KV memory override:
+        # None follows LOCALAI_KV_TIER; the disaggregated prefill
+        # engine passes False (its slots live one prompt each — the
+        # migration interchange replaces warm-tier churn there)
     ) -> None:
         self.channel = channel
         self.follower = follower
@@ -693,10 +705,21 @@ class LLMEngine:
         # would be an implicit cross-shard all-gather per spill)
         if (self._paged and channel is None and not follower
                 and draft is None and mesh is None
-                and knobs.flag("LOCALAI_KV_TIER")):
+                and (knobs.flag("LOCALAI_KV_TIER") if kv_tier is None
+                     else kv_tier)):
             from .kv_tier import KVTierManager
 
             self._tier = KVTierManager(self)
+        # disaggregated serving hooks (engine/kv_migrate.Migrator): the
+        # DisaggRouter attaches one per engine before start() — prefill
+        # side captures finished slots' pages into the migration bus,
+        # decode side adopts them at admission. None = no hooks, the
+        # single-engine path byte-identical.
+        self._migrator = None
+        # stage label for active-slot deadline expiry: "decode" for a
+        # normal engine, "prefill" for the disaggregated prefill engine
+        # (its active slots are running prompts, not streams)
+        self._deadline_stage = "decode"
 
         if self._paged:
             _page = self._page
@@ -2614,17 +2637,21 @@ class LLMEngine:
         """Queue a request; returns the event stream queue."""
         return self.submit_many([req])[0]
 
-    def submit_many(self, reqs: list[GenRequest]) -> list[queue.SimpleQueue]:
+    def submit_many(
+        self, reqs: list[GenRequest],
+        outs: Optional[list[queue.SimpleQueue]] = None,
+    ) -> list[queue.SimpleQueue]:
         """Queue a burst of requests under ONE lock acquisition, so the
         scheduler admits them as a single wave. Beyond fairness, this
         makes the batched final-prefill group size deterministic (the
         per-request submit path can race admission into odd-sized groups,
-        each a fresh jit shape)."""
-        outs: list[queue.SimpleQueue] = []
+        each a fresh jit shape). ``outs`` lets a caller supply the event
+        queues (the DisaggRouter resubmits a migrated request onto the
+        client's ORIGINAL stream queue — no forwarding hop per token)."""
+        if outs is None:
+            outs = [queue.SimpleQueue() for _ in reqs]
         ok: list[tuple[GenRequest, queue.SimpleQueue]] = []
-        for req in reqs:
-            out: queue.SimpleQueue = queue.SimpleQueue()
-            outs.append(out)
+        for req, out in zip(reqs, outs):
             if len(req.prompt_ids) >= self.max_seq:
                 out.put(StreamEvent(
                     done=True, finish_reason="error",
@@ -2653,6 +2680,14 @@ class LLMEngine:
             # r5 #4)
             now = time.perf_counter()
             for req, _ in ok:
+                if req.disagg is not None and req.t_submit:
+                    # migrated resubmit: the request keeps the t_submit/
+                    # deadline the router stamped at ORIGINAL arrival, so
+                    # TTFT and deadline enforcement stay end-to-end
+                    # across the prefill→migrate→decode relay
+                    if req.deadline:
+                        self._deadlines_armed = True
+                    continue
                 req.t_submit = now
                 budget = req.timeout_s or self._default_deadline_s
                 if budget > 0:
@@ -2678,6 +2713,10 @@ class LLMEngine:
                 depth = len(self._pending)
                 self._lock.notify_all()
             for req, out in shed:
+                if req.disagg is not None:
+                    # a shed migrated resubmit must free its interchange
+                    # blocks (idempotent KVHandoff.release)
+                    req.disagg.release()
                 out.put(StreamEvent(
                     done=True, finish_reason="shed",
                     error=f"admission queue full "
@@ -2844,13 +2883,19 @@ class LLMEngine:
             for req, out in self._pending:
                 if req.deadline and now >= req.deadline:
                     self._deferred.pop(req.id, None)
+                    if req.disagg is not None:
+                        req.disagg.release()
                     out.put(StreamEvent(
                         done=True, finish_reason="deadline_exceeded",
                         error="deadline exceeded while queued"))
                     expired.append((req.id, "queued"))
                 elif (req.deadline and tok_ms is not None
+                      and req.disagg is None
                       and now + tok_ms * len(req.prompt_ids) / 1e3
                       >= req.deadline):
+                    # (migrated resubmits are exempt: their prompt is
+                    # already in pages — pricing a re-prefill against
+                    # the deadline would reject work that needs none)
                     self._deferred.pop(req.id, None)
                     out.put(StreamEvent(
                         done=True, finish_reason="deadline_exceeded",
@@ -2874,7 +2919,7 @@ class LLMEngine:
                and s.request.deadline and now >= s.request.deadline]
         for s in hit:
             tm.ENGINE_DEADLINE_EXCEEDED.labels(
-                model=self._mlabel, stage="decode").inc()
+                model=self._mlabel, stage=self._deadline_stage).inc()
             self._finish(s, "deadline_exceeded")
 
     # ------------------------------------------------------------- scheduler
@@ -3279,6 +3324,8 @@ class LLMEngine:
                 if cancelled:  # cancel raced ahead
                     del self._cancelled[req.id]
                     self._deferred.pop(req.id, None)
+                    if req.disagg is not None:
+                        req.disagg.release()
                     out.put(StreamEvent(done=True,
                                         finish_reason="cancelled"))
             if cancelled:
@@ -3295,10 +3342,12 @@ class LLMEngine:
                 tm.ENGINE_CANCELLATIONS.labels(model=self._mlabel,
                                                reason="client").inc()
                 continue
-            if self._defer_for_prefix(req, forming, now):
+            if req.disagg is None and self._defer_for_prefix(
+                    req, forming, now):
                 requeue.append((req, out))
                 continue
             if (self._tier is not None and req.soft_embeds is None
+                    and req.disagg is None
                     and self._tier.plan(req, now)):
                 # the session's KV is in the cold tier and its disk
                 # load is inside the deadline window: hold admission
@@ -3314,6 +3363,22 @@ class LLMEngine:
                 # wait for a release instead of admit-then-kill thrash
                 continue
             self._deferred.pop(req.id, None)
+            if req.disagg is not None and self._migrator is not None:
+                # migrated resubmit: stage the prefill engine's pages
+                # into this pool and adopt them by reference — the slot
+                # wakes in DECODE with the whole prompt resident and
+                # re-prefills ZERO tokens. Spill the slot's resident
+                # prefix first (same demote-on-reuse as the tier path:
+                # the gather lands before any overwrite in device
+                # order). On staging failure (fault injection, pool
+                # pressure) the handoff is dropped and the request
+                # falls through to _assign below — an ordinary
+                # re-prefill, correct just slower.
+                if self._tier is not None and req.soft_embeds is None:
+                    self._tier.capture(slot, req)
+                if self._migrator.assign_migrated(slot, req, out):
+                    continue
+                req.disagg = None
             if self._tier is not None and req.soft_embeds is None:
                 # demote-on-reuse: spill the resident prefix this
                 # assignment is about to discard (gather enqueued
@@ -5011,6 +5076,13 @@ class LLMEngine:
         req = slot.request
         self._flush_emit(slot)  # buffered text precedes the done event
         self._maybe_save_prompt_cache(slot)
+        if self._migrator is not None and req is not None:
+            # disaggregated prefill side: a finishing prefill-probe
+            # slot's pages are captured into the migration bus HERE,
+            # before release can recycle them (the gather lands first
+            # in device order, so later overwrites are safe). No-op
+            # for ordinary requests.
+            self._migrator.on_finish(slot, reason)
         full = slot.decoder.text if slot.decoder else ""
         if req is not None and req.stop:
             for st in req.stop:
@@ -5029,6 +5101,18 @@ class LLMEngine:
             queue_ms = max(0.0, (slot.t_start - req.t_submit) * 1e3)
             if slot.t_first:
                 ttft_ms = (slot.t_first - req.t_submit) * 1e3
+        if req is not None and req.disagg is not None:
+            # migrated request: queue time is what the request spent
+            # QUEUED on either engine (original wait on the prefill
+            # side + re-admission wait here), not the whole relay —
+            # prefill device time and migration wall already live in
+            # timing_prompt_processing_ms (stamped at adoption)
+            h = req.disagg
+            queue_ms = h.queued_ms + max(
+                0.0, (slot.t_start - h.t_resubmit) * 1e3)
+            tm.ENGINE_DISAGG_STAGE.labels(
+                model=self._mlabel, stage="decode").observe(
+                max(0.0, now - h.t_resubmit))
         ev = StreamEvent(
             done=True,
             finish_reason=reason,

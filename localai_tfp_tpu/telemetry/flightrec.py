@@ -45,6 +45,12 @@ _T0 = time.perf_counter()
 # visual proof that a spill never blocks a device step)
 KV_TIER_TRACK = "kv_tier"
 
+# dedicated timeline thread for disaggregated-serving KV migration lanes
+# (engine/kv_migrate.py capture/stage spans interleave against BOTH
+# engines' "device" tracks — the visual proof that a migration never
+# blocks either engine's device step)
+MIGRATE_TRACK = "migrate"
+
 
 def _env_capacity() -> int:
     return max(64, knobs.int_("LOCALAI_TIMELINE_EVENTS"))

@@ -205,6 +205,37 @@ ENGINE_KV_TIER_BYTES = REGISTRY.counter(
     "scale planes included for int8 caches)",
     labels=("model", "direction"),
 )
+# disaggregated prefill/decode serving (engine/kv_migrate.py)
+ENGINE_DISAGG_REQUESTS = REGISTRY.counter(
+    "engine_disagg_requests_total",
+    "Requests by disaggregation path (disagg = prefilled on the "
+    "prefill engine and migrated, local = stayed on the decode engine, "
+    "fallback = migration failed and the request re-prefilled on the "
+    "decode engine)",
+    labels=("model", "path"),
+)
+ENGINE_KV_MIGRATED_PAGES = REGISTRY.counter(
+    "engine_kv_migrated_pages_total",
+    "KV pages moved through the prefill->decode migration interchange "
+    "by outcome (migrated = adopted by reference on the decode engine, "
+    "fault = an injected/real capture or adopt failure, dropped = "
+    "captured but abandoned before adoption)",
+    labels=("model", "outcome"),
+)
+ENGINE_KV_MIGRATION = REGISTRY.histogram(
+    "engine_kv_migration_seconds",
+    "Wall time of the migrate stage per disaggregated request: prefill "
+    "terminal to handoff collected on the router thread (D2H gather "
+    "landing + content-addressed host publish)",
+    labels=("model",),
+)
+ENGINE_DISAGG_STAGE = REGISTRY.histogram(
+    "engine_disagg_stage_seconds",
+    "Per-stage wall time of disaggregated requests (queued/prefill on "
+    "the prefill engine, migrate on the router, decode from resubmit "
+    "to terminal on the decode engine)",
+    labels=("model", "stage"),
+)
 # stall-free mixed prefill+decode dispatch (engine._enqueue_mixed)
 ENGINE_MIXED_DISPATCH = REGISTRY.counter(
     "engine_mixed_dispatch_total",

@@ -5,9 +5,12 @@ the engine device-step funnel (``engine.device_step``), the model
 loader (``loader.load``), the multihost dispatch channel
 (``multihost.publish``), the federated proxy
 (``federated.upstream`` / ``federated.midstream``), the balancer's
-telemetry-digest probe fetch (``federated.digest``), and the
-autoscaler's ScaleDriver boot/kill actions (``federated.scale``) —
-and armed via
+telemetry-digest probe fetch (``federated.digest``), the autoscaler's
+ScaleDriver boot/kill actions (``federated.scale``), the KV tier's
+DMA lanes (``kv_tier.spill`` / ``kv_tier.fetch``), and the
+disaggregated-serving migration protocol (``disagg.migrate`` on the
+prefill-side capture, ``disagg.handoff`` on the decode-side adopt —
+engine/kv_migrate.py) — and armed via
 
     LOCALAI_FAULTS="point:spec[,point:spec...]"
 

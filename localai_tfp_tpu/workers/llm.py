@@ -401,6 +401,25 @@ class JaxLLMBackend(Backend):
                         tag=opts.model,
                     )
                     self.engine.start()
+                if (knobs.flag("LOCALAI_DISAGG")
+                        and mesh is None and draft is None
+                        and channel is None and role != "follower"
+                        and getattr(self.engine, "_paged", False)):
+                    # disaggregated serving: a prefill-tuned sibling
+                    # engine shares the weights, and the router front
+                    # door relays long prompts through the KV page
+                    # migration protocol (engine/kv_migrate.py). Off
+                    # by default — the plain engine path is untouched.
+                    from ..engine.kv_migrate import (DisaggRouter,
+                                                     build_prefill_engine)
+
+                    with phases.timed("disagg_s"):
+                        prefill = build_prefill_engine(
+                            self.spec, params, self.tokenizer,
+                            decode=self.engine, cache_dtype=kv_dtype,
+                            tag=opts.model)
+                        prefill.start()
+                        self.engine = DisaggRouter(prefill, self.engine)
                 if (role != "follower"
                         and knobs.flag("LOCALAI_WARMUP")):
                     # precompile the dispatch-variant set: a cold jit

@@ -294,6 +294,49 @@ def test_chunked_prompt_prefill_timing_attribution(model):
     assert ev.timing_prompt_processing_ms >= ev.timing_prefill_enqueue_ms
 
 
+def test_chunked_prompt_prefill_timing_attribution_disagg(model):
+    """Disaggregated extension of the attribution test above: when the
+    chunked prompt runs on the PREFILL engine and the stream decodes on
+    the other, timing_prompt_processing_ms must carry the prefill
+    engine's device time PLUS the migration wall — not the decode
+    engine's (zero) prompt work."""
+    import os
+
+    from localai_tfp_tpu.engine.kv_migrate import (DisaggRouter,
+                                                   build_prefill_engine)
+    spec, params, tk = model
+    saved = os.environ.get("LOCALAI_DISAGG_MIN_PROMPT")
+    os.environ["LOCALAI_DISAGG_MIN_PROMPT"] = "64"
+    decode = _engine(model, mixed=True)
+    prefill = build_prefill_engine(spec, params, tk, decode=decode,
+                                   cache_dtype=jnp.float32)
+    router = DisaggRouter(prefill, decode)
+    router.start()
+    try:
+        prompt = tk.encode("a long prompt that must chunk " * 8)
+        assert len(prompt) > 128
+        mig0 = decode._migrator.counters["adoptions"]
+        ev = router.generate(GenRequest(prompt_ids=prompt, max_tokens=4,
+                                        ignore_eos=True))
+        assert ev.finish_reason == "length", ev.error
+        # the request really took the relay (not a fallback)
+        assert decode._migrator.counters["adoptions"] == mig0 + 1
+        assert ev.timing_prompt_processing_ms > 1.0
+        assert ev.timing_prefill_enqueue_ms >= 0.0
+        assert ev.timing_prompt_processing_ms >= \
+            ev.timing_prefill_enqueue_ms
+        # TTFT spans the whole relay: it can never undercut the prompt
+        # processing it contains
+        assert ev.timing_first_token_ms >= \
+            ev.timing_prompt_processing_ms
+    finally:
+        if saved is None:
+            os.environ.pop("LOCALAI_DISAGG_MIN_PROMPT", None)
+        else:
+            os.environ["LOCALAI_DISAGG_MIN_PROMPT"] = saved
+        router.close()
+
+
 def test_tokens_per_second_ewma_single_path(model):
     """Satellite: metrics.tokens_per_second is ONE EWMA across every
     decode flavor instead of three stores stomping each other with

@@ -49,6 +49,10 @@ REQUIRED_FAMILIES = {
     "engine_kv_tier_moves_total",
     "engine_kv_tier_prefetch_total",
     "engine_kv_tier_bytes_moved_total",
+    "engine_disagg_requests_total",
+    "engine_kv_migrated_pages_total",
+    "engine_kv_migration_seconds",
+    "engine_disagg_stage_seconds",
     "engine_dispatch_compile_variants_count",
     "engine_ragged_rows_total",
     "engine_mesh_devices_count",

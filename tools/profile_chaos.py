@@ -13,6 +13,14 @@ one JSON report with the acceptance numbers the robustness PR tracks:
     terminal_completeness  — EVERY submitted stream ended in exactly
                              one terminal event (the core contract)
 
+  disagg leg (prefill + decode engines under the migration relay):
+    migrate_fault / handoff_fault / device_fault storms against the
+    disagg.migrate and disagg.handoff injection points and the shared
+    device-step funnel: every request must still end in exactly one
+    terminal (served, graceful re-prefill fallback, or error), a calm
+    followup must be served, and both KV pools PLUS the host
+    interchange must come out leak-clean
+
   federation leg (balancer + 2 member instances over localhost HTTP):
     failover_latency_s     — kill a member; time until the breaker
                              opens via the active /healthz probe
@@ -153,6 +161,94 @@ def engine_leg(flood: int) -> dict:
             out["kv_pool_leak_check"] = "clean"
     finally:
         eng.close()
+    return out
+
+
+def disagg_leg(flood: int) -> dict:
+    """Chaos on the disaggregated relay: migration-capture faults,
+    handoff faults, and a device-step storm across BOTH engines — every
+    request must still end in exactly one terminal (served, fallback
+    re-prefill, or error), and both pools plus the host interchange
+    must come out leak-clean."""
+    import jax.numpy as jnp
+
+    from localai_tfp_tpu.engine.engine import GenRequest
+    from localai_tfp_tpu.engine.kv_migrate import (DisaggRouter,
+                                                   build_prefill_engine)
+    from localai_tfp_tpu.utils import faultinject as fi
+
+    saved = {k: os.environ.get(k) for k in
+             ("LOCALAI_DISAGG_MIN_PROMPT", "LOCALAI_KV_PAGE")}
+    os.environ["LOCALAI_DISAGG_MIN_PROMPT"] = "32"
+    # 16-token pages: the default 256-token page sizes the pool at
+    # exactly one page per slot, so staging an adoption would always
+    # hit pool exhaustion and the leg would only ever measure fallbacks
+    os.environ.setdefault("LOCALAI_KV_PAGE", "16")
+    eng, tk = _build_engine(max_seq=256)
+    prefill = build_prefill_engine(eng.spec, eng.params, tk, decode=eng,
+                                   cache_dtype=jnp.float32)
+    router = DisaggRouter(prefill, eng)
+    router.start()
+    out: dict = {}
+    long = "disagg chaos probe " + "x " * 24
+
+    def storm(tag: str) -> list:
+        reqs = [GenRequest(prompt_ids=tk.encode(f"{tag} {i:02d} " + long),
+                           max_tokens=4, ignore_eos=True)
+                for i in range(flood)]
+        reasons = []
+        for q in router.submit_many(reqs):
+            n, ev = _drain(q)
+            nonlocal_complete[0] &= n == 1
+            reasons.append(ev.finish_reason)
+        return reasons
+
+    nonlocal_complete = [True]
+    try:
+        # warm the relay (compiles + a clean adoption)
+        ev = router.generate(GenRequest(prompt_ids=tk.encode("w " + long),
+                                        max_tokens=4, ignore_eos=True))
+        assert ev.finish_reason == "length", ev.error
+
+        legs = {
+            "migrate_fault": "disagg.migrate:rate@0.5@3",
+            "handoff_fault": "disagg.handoff:rate@0.5@5",
+            "device_fault": "engine.device_step:rate@0.2@13",
+        }
+        for name, spec in legs.items():
+            fb0 = eng._migrator.counters["adoptions"]
+            fi.arm(spec)
+            reasons = storm(name)
+            injected = {p: c[1] for p, c in fi.counts().items()}
+            fi.disarm()
+            out[name] = {
+                "injected": injected,
+                "reasons": {r: reasons.count(r) for r in set(reasons)},
+                "served_or_errored": all(
+                    r in ("length", "error", "stop") for r in reasons),
+                "adoptions": eng._migrator.counters["adoptions"] - fb0,
+            }
+        # a clean followup proves both engines survived the storms
+        ev = router.generate(GenRequest(prompt_ids=tk.encode("calm " + long),
+                                        max_tokens=4, ignore_eos=True))
+        out["survived_followup"] = ev.finish_reason == "length"
+        out["terminal_completeness"] = nonlocal_complete[0]
+        out["fallbacks"] = router.prefill._migrator.counters[
+            "capture_faults"]
+        time.sleep(0.3)
+        eng._pool.leak_check()
+        prefill._pool.leak_check()
+        assert router.bus.live_blocks() == 0, "interchange leak"
+        out["kv_pool_leak_check"] = "clean"
+        out["interchange_leak_check"] = "clean"
+    finally:
+        fi.disarm()
+        router.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     return out
 
 
@@ -349,6 +445,7 @@ def main() -> None:
 
     report = {
         "engine": engine_leg(args.flood),
+        "disagg": disagg_leg(max(4, args.flood // 4)),
         "federation": asyncio.run(federation_leg(args.probe_s)),
         "tracing": asyncio.run(tracing_leg()),
     }

@@ -1,0 +1,365 @@
+"""Disaggregated-serving profiler: decode ITL with prefill off-loaded.
+
+The disaggregated relay (engine/kv_migrate.py) exists for one number:
+the inter-token cadence of LIVE decode streams while long prompts keep
+arriving. On a single engine every admitted long prompt runs its
+prefill dispatch inside the same serial device loop that produces
+decode tokens, so active streams stall for the full prefill. With
+disaggregation the prefill runs on a sibling engine and the finished
+KV pages migrate through the host interchange — the decode loop only
+ever pays a page-scatter adoption.
+
+This tool drives the SAME workload through both configurations and
+prints one JSON report:
+
+  off leg (single engine):  N sustained decode streams + a flood of
+      long prompts admitted mid-decode; per-stream inter-token gaps.
+  on  leg (prefill + decode engines under DisaggRouter): identical
+      traffic; additionally migration wall p50/p95, the zero-re-prefill
+      cross-check (the decode engine's prompt-token counter must not
+      move during the flood, and the migrated-pages counter must equal
+      flood_requests x pages_per_prompt), and the router path counts.
+  identity leg: one seeded request (temperature/top_k/seed) run on a
+      plain engine and through the relay — the outputs must match
+      byte for byte (the migrated sampler row carries the rng state).
+
+Acceptance gates (process exits non-zero if any fail): decode ITL p99
+AND the max inter-token gap must be STRICTLY better with disagg on,
+migrated requests re-prefill zero tokens, and the seeded outputs are
+identical.
+
+Run:  python tools/profile_disagg.py [--streams N] [--flood M]
+          [--decode-tokens D]
+
+CPU smoke (tiny model, fast settings — what CI can afford):
+
+  python tools/profile_disagg.py --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue as _queue
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_ENV_KNOBS = ("LOCALAI_DISAGG_MIN_PROMPT",
+              "LOCALAI_DISAGG_MIGRATE_DEADLINE_S")
+
+
+def _pct(xs, p: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(round(p / 100.0 * (len(xs) - 1))))]
+
+
+def _model():
+    import jax
+    import jax.numpy as jnp
+
+    from localai_tfp_tpu.engine.tokenizer import ByteTokenizer
+    from localai_tfp_tpu.models.llm_spec import tiny_spec
+    from localai_tfp_tpu.models.transformer import init_params
+
+    tk = ByteTokenizer()
+    spec = tiny_spec(vocab_size=tk.vocab_size, max_position=512)
+    params = init_params(jax.random.PRNGKey(0), spec, dtype=jnp.float32)
+    return spec, params, tk
+
+
+def _decode_engine(spec, params, tk, max_seq=512,
+                   buckets=(8, 32, 256)):
+    import jax.numpy as jnp
+
+    from localai_tfp_tpu.engine.engine import LLMEngine
+
+    return LLMEngine(spec, params, tk, n_slots=4, max_seq=max_seq,
+                     prefill_buckets=buckets, cache_dtype=jnp.float32)
+
+
+def _watch(q, times: list, finals: list) -> None:
+    """Drain one stream, stamping the arrival time of every
+    token-bearing event (buffered emission coalesces identically in
+    both legs, so the gap series is comparable)."""
+    while True:
+        ev = q.get(timeout=600)
+        if ev.token_id is not None:
+            times.append(time.perf_counter())
+        if ev.done:
+            finals.append(ev)
+            return
+
+
+def _leg(sub, tk, n_streams: int, flood_n: int, d_tokens: int,
+         long_body: str) -> dict:
+    """One contrast leg: sustain ``n_streams`` decode streams on
+    ``sub`` (an engine or a router — same submit surface), flood
+    ``flood_n`` long prompts mid-decode, return the gap series."""
+    from localai_tfp_tpu.engine.engine import GenRequest
+
+    times: list[list[float]] = [[] for _ in range(n_streams)]
+    finals: list[list] = [[] for _ in range(n_streams)]
+    qs = sub.submit_many([
+        GenRequest(prompt_ids=tk.encode(f"stream {i:02d}"),
+                   max_tokens=d_tokens, temperature=0.0,
+                   ignore_eos=True)
+        for i in range(n_streams)])
+    watchers = []
+    for i, q in enumerate(qs):
+        t = threading.Thread(target=_watch, args=(q, times[i], finals[i]),
+                             daemon=True)
+        t.start()
+        watchers.append(t)
+    # flood only once every stream is decoding: the gaps then measure
+    # admission interference, not startup order
+    t0 = time.perf_counter()
+    while (any(len(ts) < 2 for ts in times)
+           and time.perf_counter() - t0 < 120):
+        time.sleep(0.005)
+    t_flood = time.perf_counter()
+    flood_qs = []
+    for j in range(flood_n):
+        flood_qs += sub.submit_many([GenRequest(
+            prompt_ids=tk.encode(f"ctx {j:02d} " + long_body),
+            max_tokens=4, temperature=0.0, ignore_eos=True)])
+        time.sleep(0.05)
+    flood_finals = []
+    for q in flood_qs:
+        while True:
+            ev = q.get(timeout=600)
+            if ev.done:
+                flood_finals.append(ev)
+                break
+    for t in watchers:
+        t.join(timeout=600)
+    gaps = [1e3 * (b - a)
+            for ts in times for a, b in zip(ts, ts[1:])]
+    assert gaps, "streams produced no inter-token gaps"
+    bad = [f.finish_reason for f in flood_finals + sum(finals, [])
+           if f.finish_reason != "length"]
+    return {
+        "streams": n_streams, "flood_requests": flood_n,
+        "decode_tokens": d_tokens,
+        "itl_p50_ms": round(_pct(gaps, 50), 2),
+        "itl_p99_ms": round(_pct(gaps, 99), 2),
+        "max_gap_ms": round(max(gaps), 2),
+        "gap_samples": len(gaps),
+        "flood_wall_s": round(time.perf_counter() - t_flood, 3),
+        "non_length_finishes": bad,
+    }
+
+
+def _warm(sub, tk, long_body: str, n_streams: int) -> None:
+    """Compile every dispatch variant the measured waves hit — short
+    prefill, the long prefill bucket, decode AT MEASUREMENT
+    CONCURRENCY (the step dispatch specializes on active-slot count),
+    and — through a router — the probe/adoption path — so gaps measure
+    scheduling, not the jit."""
+    from localai_tfp_tpu.engine.engine import GenRequest
+
+    qs = sub.submit_many(
+        [GenRequest(prompt_ids=tk.encode(f"warm stream {i:02d}"),
+                    max_tokens=12, temperature=0.0, ignore_eos=True)
+         for i in range(n_streams)]
+        + [GenRequest(prompt_ids=tk.encode("warm " + long_body),
+                      max_tokens=4, temperature=0.0, ignore_eos=True)])
+    for q in qs:
+        while True:
+            ev = q.get(timeout=600)
+            if ev.done:
+                assert ev.finish_reason == "length", ev.error
+                break
+
+
+def identity_leg(spec, params, tk) -> dict:
+    """Seeded relay identity: the migrated sampler row must continue
+    the EXACT rng/penalty stream, so plain-engine output and relay
+    output match byte for byte."""
+    import jax.numpy as jnp
+
+    from localai_tfp_tpu.engine.engine import GenRequest
+    from localai_tfp_tpu.engine.kv_migrate import (DisaggRouter,
+                                                   build_prefill_engine)
+
+    prompt = "disaggregated migration identity probe " + "w " * 24
+
+    def seeded(sub):
+        return sub.generate(GenRequest(
+            prompt_ids=tk.encode(prompt), max_tokens=12,
+            temperature=0.8, top_k=40, seed=7, ignore_eos=True))
+
+    plain = _decode_engine(spec, params, tk)
+    try:
+        ref = seeded(plain)
+    finally:
+        plain.close()
+    decode = _decode_engine(spec, params, tk)
+    prefill = build_prefill_engine(spec, params, tk, decode=decode,
+                                   cache_dtype=jnp.float32)
+    router = DisaggRouter(prefill, decode)
+    router.start()
+    try:
+        got = seeded(router)
+        migrated = decode._migrator.counters["adoptions"] == 1
+    finally:
+        router.close()
+    return {
+        "prompt_tokens": len(tk.encode(prompt)),
+        "migrated": migrated,
+        "off_text": ref.full_text,
+        "on_text": got.full_text,
+        "identical": (got.full_text == ref.full_text
+                      and got.completion_tokens == ref.completion_tokens
+                      and migrated),
+    }
+
+
+def disagg_contrast(smoke: bool = True, n_streams: int = 3,
+                    flood_n: int = 0, d_tokens: int = 0) -> dict:
+    """The full contrast report (importable — bench.py's extra.disagg
+    block calls this on the smoke settings)."""
+    import jax.numpy as jnp
+
+    from localai_tfp_tpu.engine.kv_migrate import (DisaggRouter,
+                                                   build_prefill_engine)
+    from localai_tfp_tpu.telemetry.registry import REGISTRY
+
+    flood_n = flood_n or (4 if smoke else 12)
+    d_tokens = d_tokens or (64 if smoke else 192)
+    long_body = "w " * 112  # ~230 tokens: the 256-token prefill bucket
+
+    saved = {k: os.environ.get(k) for k in _ENV_KNOBS}
+    os.environ["LOCALAI_DISAGG_MIN_PROMPT"] = "64"
+    os.environ["LOCALAI_DISAGG_MIGRATE_DEADLINE_S"] = "60"
+    os.environ.setdefault("LOCALAI_KV_PAGE", "16")
+    spec, params, tk = _model()
+    report: dict = {"smoke": smoke}
+    try:
+        # ---- off leg: one engine owns both prefill and decode ----
+        eng = _decode_engine(spec, params, tk)
+        try:
+            # full variant warmup: the adaptive k-scan picks its scan
+            # length at run time, and a cold k jitting mid-measurement
+            # would swamp the gap series in BOTH legs
+            eng.warmup()
+            _warm(eng, tk, long_body, n_streams)
+            report["off"] = _leg(eng, tk, n_streams, flood_n, d_tokens,
+                                 long_body)
+            eng._pool.leak_check()
+        finally:
+            eng.close()
+
+        # ---- on leg: prefill sibling + migration relay ----
+        decode = _decode_engine(spec, params, tk)
+        prefill = build_prefill_engine(spec, params, tk, decode=decode,
+                                       cache_dtype=jnp.float32)
+        router = DisaggRouter(prefill, decode)
+        router.start()
+        try:
+            # time every successful collect: the same window the
+            # router prices as migration wall
+            mig_ms: list[float] = []
+            real_collect = router.bus.collect
+
+            def timed_collect(rid, timeout):
+                c0 = time.perf_counter()
+                h, why = real_collect(rid, timeout)
+                if h is not None:
+                    mig_ms.append(1e3 * (time.perf_counter() - c0))
+                return h, why
+
+            router.bus.collect = timed_collect
+            router.warmup()
+            _warm(router, tk, long_body, n_streams)
+            base = REGISTRY.snapshot()
+            mig_ms.clear()
+            prompt0 = decode.metrics.prompt_tokens_processed
+            adopt0 = decode._migrator.counters["adoptions"]
+            on = _leg(router, tk, n_streams, flood_n, d_tokens,
+                      long_body)
+            delta = REGISTRY.delta(base)
+            adoptions = decode._migrator.counters["adoptions"] - adopt0
+            # zero re-prefill: the decode engine's prompt counter may
+            # only move for the short LOCAL streams, never the flood
+            stream_prompt = sum(
+                len(tk.encode(f"stream {i:02d}"))
+                for i in range(n_streams))
+            prompt_moved = (decode.metrics.prompt_tokens_processed
+                            - prompt0)
+            npg_per = decode._pool.pages_for(
+                len(tk.encode("ctx 00 " + long_body)))
+            migrated_pages = sum(
+                v for k, v in delta.items()
+                if k.startswith("engine_kv_migrated_pages_total")
+                and 'outcome="migrated"' in k)
+            on["migration_ms"] = {
+                "p50": round(_pct(mig_ms, 50), 2),
+                "p95": round(_pct(mig_ms, 95), 2),
+                "n": len(mig_ms),
+            }
+            on["adoptions"] = adoptions
+            on["fallbacks"] = sum(
+                v for k, v in delta.items()
+                if k.startswith("engine_disagg_requests_total")
+                and 'path="fallback"' in k)
+            on["decode_prompt_tokens"] = prompt_moved
+            on["stream_prompt_tokens"] = stream_prompt
+            on["migrated_pages"] = migrated_pages
+            on["expected_pages"] = flood_n * npg_per
+            report["on"] = on
+            time.sleep(0.2)
+            decode._pool.leak_check()
+            prefill._pool.leak_check()
+            assert router.bus.live_blocks() == 0
+            report["zero_reprefill"] = (
+                adoptions == flood_n
+                and prompt_moved <= stream_prompt
+                and migrated_pages == flood_n * npg_per)
+        finally:
+            router.close()
+
+        report["identity"] = identity_leg(spec, params, tk)
+        report["itl_p99_improved"] = (report["on"]["itl_p99_ms"]
+                                      < report["off"]["itl_p99_ms"])
+        report["max_gap_improved"] = (report["on"]["max_gap_ms"]
+                                      < report["off"]["max_gap_ms"])
+        report["ok"] = (report["itl_p99_improved"]
+                        and report["max_gap_improved"]
+                        and report["zero_reprefill"]
+                        and report["identity"]["identical"])
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return report
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--streams", type=int, default=3,
+                    help="sustained decode streams")
+    ap.add_argument("--flood", type=int, default=0,
+                    help="long prompts flooded mid-decode "
+                         "(default 12, smoke 4)")
+    ap.add_argument("--decode-tokens", type=int, default=0,
+                    help="tokens per sustained stream "
+                         "(default 192, smoke 64)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast CPU smoke settings")
+    args = ap.parse_args()
+    report = disagg_contrast(args.smoke, args.streams, args.flood,
+                             args.decode_tokens)
+    print(json.dumps(report, indent=2), flush=True)
+    sys.exit(0 if report["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()
