@@ -411,6 +411,87 @@ def _disagg_extra() -> dict:
     }
 
 
+def _weight_paging_extra() -> dict:
+    """Gallery weight-paging acceptance block (extra.weight_paging):
+    the profile_coldstart --gallery round-robin on DEDICATED small
+    engines (N models under an HBM weight budget sized for ~2) plus
+    the profile_chaos gallery leg. Headlines: a warm model's first
+    token must beat a cold build by >= 5x, the HBM high-water mark
+    must respect the budget, and both injected weight faults must
+    leave the request served and the pager leak-clean. Dedicated
+    engines keep this out of the _LIVE_ENGINE_EXTRAS ordering guard."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from localai_tfp_tpu.engine.engine import LLMEngine
+    from localai_tfp_tpu.engine.tokenizer import ByteTokenizer
+    from localai_tfp_tpu.models.llm_spec import tiny_spec
+    from localai_tfp_tpu.models.transformer import init_params
+    from tools.profile_chaos import gallery_leg
+    from tools.profile_coldstart import gallery_shape
+
+    g = gallery_shape(n_models=4, rounds=3)
+    c = gallery_leg()
+    speedup = g["warm_vs_cold_speedup"] or 0.0
+
+    # all-hot steady-state overhead: the pager's scheduler hooks are a
+    # lock-check per admission pass — interleaved best-of on a
+    # dedicated engine pair must stay within 1%
+    tok = ByteTokenizer()
+    spec = tiny_spec(vocab_size=tok.vocab_size, max_position=512)
+    params = init_params(jax.random.PRNGKey(0), spec,
+                         dtype=jnp.float32)
+    saved = os.environ.get("LOCALAI_WEIGHT_PAGING")
+    tok_s_on = tok_s_off = 0.0
+    try:
+        os.environ["LOCALAI_WEIGHT_PAGING"] = "on"
+        e_on = LLMEngine(spec, params, tok, n_slots=4, max_seq=256,
+                         prefill_buckets=(8, 32, 128))
+        os.environ["LOCALAI_WEIGHT_PAGING"] = "off"
+        e_off = LLMEngine(spec, params, tok, n_slots=4, max_seq=256,
+                          prefill_buckets=(8, 32, 128))
+        try:
+            for _ in range(2):
+                on, _, _ = _bench_config(e_on, tok, 4, 32, runs=1)
+                off, _, _ = _bench_config(e_off, tok, 4, 32, runs=1)
+                tok_s_on = max(tok_s_on, on)
+                tok_s_off = max(tok_s_off, off)
+        finally:
+            e_on.close()
+            e_off.close()
+    finally:
+        if saved is None:
+            os.environ.pop("LOCALAI_WEIGHT_PAGING", None)
+        else:
+            os.environ["LOCALAI_WEIGHT_PAGING"] = saved
+    overhead = max(0.0, 1.0 - tok_s_on / max(tok_s_off, 1e-9))
+    return {
+        "ok": (speedup >= 5.0
+               and overhead <= 0.01
+               and g["hbm_high_water_mb"] <= g["hbm_budget_mb"] * 1.25
+               and c["demote_fault"]["served"]
+               and c["fetch_fault"]["served"]
+               and c["fetch_fault"]["one_terminal"]
+               and c["pager_leak_check"] == "clean"),
+        "warm_vs_cold_speedup": speedup,
+        "decode_tok_s_paging_on": tok_s_on,
+        "decode_tok_s_paging_off": tok_s_off,
+        "paging_overhead_frac": round(overhead, 4),
+        "paging_overhead_within_1pct": overhead <= 0.01,
+        "warm_first_token_ms": round(
+            g["warm_first_token_s"]["p50"] * 1e3, 1),
+        "cold_first_token_ms": round(
+            g["cold_first_token_s"]["p50"] * 1e3, 1),
+        "hbm_high_water_mb": g["hbm_high_water_mb"],
+        "hbm_budget_mb": g["hbm_budget_mb"],
+        "lru_thrash_demotes": g["lru_thrash_demotes"],
+        "gallery": g,
+        "chaos": c,
+    }
+
+
 # extras that measure the LIVE serving engine: _bench_http's teardown
 # (runner.cleanup()) fires the app cleanup that CLOSES it, so these must
 # be recorded first. _bench_http enforces the order (it was a
@@ -1493,6 +1574,7 @@ def main() -> None:
     # forced-host-device child on single-device smokes), so it is not
     # subject to the _LIVE_ENGINE_EXTRAS ordering guard
     extra["meshed_paged"] = _meshed_paged_extra()
+    extra["weight_paging"] = _weight_paging_extra()
     extra["chaos"] = _chaos_extra()
     extra["fleet"] = _fleet_extra()
     extra["fleet_routing"] = _fleet_routing_extra()

@@ -108,6 +108,31 @@ _knob("LOCALAI_KV_TIER_DIR", "", "str",
 _knob("LOCALAI_KV_TIER_INFLIGHT_MB", "64", "float",
       "In-flight spill transfer window, in MiB.")
 
+# --------------------------------------------------------- weight paging
+_knob("LOCALAI_WEIGHT_PAGING", "on", "flag",
+      "Layer-granular weight paging: idle models demote their weights "
+      "to host RAM and promote back on demand, so dozens of gallery "
+      "models share one chip (single-chip engines; meshed/follower/"
+      "draft/disagg engines force it off). off is byte-identical to "
+      "the fully-resident path.")
+_knob("LOCALAI_WEIGHT_HBM_MB", "0", "float",
+      "Cross-engine HBM budget for hot (device-resident) weights, in "
+      "MiB — the process-wide LRU demotes the least-recently-used "
+      "model's weights to host RAM when the hot set exceeds it "
+      "(0 = unlimited: models only demote via the watchdog or an "
+      "explicit demote_weights call).")
+_knob("LOCALAI_WEIGHT_PREFETCH_AHEAD", "2", "int",
+      "Layer pages kept in flight ahead of the promotion commit "
+      "cursor (double-buffer depth of the warm->hot layer stream).")
+_knob("LOCALAI_WEIGHT_INFLIGHT_MB", "256", "float",
+      "In-flight device->host transfer window during weight demotion, "
+      "in MiB.")
+_knob("LOCALAI_WATCHDOG_DEMOTE", "off", "flag",
+      "Watchdog idle handling demotes a model's weights to host RAM "
+      "(keeping registry/tokenizer/engine state) instead of shutting "
+      "the model down — the next request pays a warm promotion, not a "
+      "cold load.")
+
 # ------------------------------------------------- disaggregated serving
 _knob("LOCALAI_DISAGG", "off", "flag",
       "Disaggregated prefill/decode serving: a second prefill-tuned "

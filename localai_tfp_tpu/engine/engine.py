@@ -468,6 +468,10 @@ class LLMEngine:
         # None follows LOCALAI_KV_TIER; the disaggregated prefill
         # engine passes False (its slots live one prompt each — the
         # migration interchange replaces warm-tier churn there)
+        weight_paging: Optional[bool] = None,  # layer-granular weight
+        # paging override: None follows LOCALAI_WEIGHT_PAGING; disagg
+        # workers pass False (prefill/decode engines share one tree by
+        # reference — paging either side would strand the other)
     ) -> None:
         self.channel = channel
         self.follower = follower
@@ -710,6 +714,24 @@ class LLMEngine:
             from .kv_tier import KVTierManager
 
             self._tier = KVTierManager(self)
+        # layer-granular weight paging (engine/weight_pager.py): the
+        # parameter tree can leave the chip for a host-RAM warm mirror
+        # while the engine (slots, KV, dispatch cache, tokenizer) stays
+        # up, and streams back layer-by-layer ahead of first token.
+        # Single-chip engines only: meshed trees don't round-trip
+        # through one host mirror, follower/channel engines replay a
+        # leader whose tree must stay put, and a draft pair would
+        # strand its second tree. LOCALAI_WEIGHT_PAGING=off restores
+        # the fully-resident path byte-identically (the pager never
+        # touches eng.params while hot).
+        self._pager = None
+        if (channel is None and not follower and draft is None
+                and mesh is None
+                and (knobs.flag("LOCALAI_WEIGHT_PAGING")
+                     if weight_paging is None else weight_paging)):
+            from .weight_pager import WeightPager
+
+            self._pager = WeightPager(self)
         # disaggregated serving hooks (engine/kv_migrate.Migrator): the
         # DisaggRouter attaches one per engine before start() — prefill
         # side captures finished slots' pages into the migration bus,
@@ -855,7 +877,17 @@ class LLMEngine:
         self._ledger_t = 0.0  # last reconcile (rate-limited ~1s)
         if knobs.flag("LOCALAI_HBM_LEDGER"):
             led = hbm_ledger.HBMLedger(self._mlabel)
-            led.register("weights", self.params)
+            if self._pager is not None:
+                # paged weights attribute by tier: hot follows the
+                # device-resident bytes (the promotion cursor's fraction
+                # mid-stream), warm is the host mirror — host=True keeps
+                # it out of the device drift sum
+                pager = self._pager
+                led.register("weights_hot", pager.device_bytes)
+                led.register("weights_warm", pager.host_bytes,
+                             host=True)
+            else:
+                led.register("weights", self.params)
             led.register("kv_arena",
                          (self.cache.k, self.cache.v))
             if getattr(self.cache, "k_scale", None) is not None:
@@ -2254,6 +2286,13 @@ class LLMEngine:
             for tname in ("hbm", "host", "disk"):
                 tm.ENGINE_KV_TIER_PAGES.labels(
                     model=self._mlabel, tier=tname).set(0)
+        if self._pager is not None:
+            # abort any in-flight page move, release the host mirror,
+            # deregister from the cross-engine LRU
+            self._pager.close()
+            for tname in ("hot", "warm"):
+                tm.ENGINE_WEIGHT_PAGES.labels(
+                    model=self._mlabel, tier=tname).set(0)
         tm.ENGINE_MFU.labels(model=self._mlabel).set(0.0)
         if self._ledger is not None:
             self._ledger.reset_gauges()
@@ -2966,7 +3005,10 @@ class LLMEngine:
                         pool_stats=(self._pool.stats()
                                     if self._pool is not None else None),
                         tier_stats=(self._tier.stats()
-                                    if self._tier is not None else None))
+                                    if self._tier is not None else None),
+                        weight_stats=(self._pager.stats()
+                                      if self._pager is not None
+                                      else None))
                 self._fail_all(f"engine step error: {e!r}")
 
     def _has_work(self) -> bool:
@@ -3095,6 +3137,13 @@ class LLMEngine:
                     tm.ENGINE_KV_TIER_PAGES.labels(
                         model=m, tier=tname).set(v)
                 FLIGHT.sample("kv_host_pages", "scheduler", tp["host"])
+        if self._pager is not None:
+            # weight-tier residency: host scalars the pager tallies
+            # under its own lock (a promotion's hot count climbs with
+            # the commit cursor)
+            wp = self._pager.tier_pages()
+            for tname, v in wp.items():
+                tm.ENGINE_WEIGHT_PAGES.labels(model=m, tier=tname).set(v)
         if not any(s.state is SlotState.DECODE for s in self.slots):
             # decode-stall gaps are only meaningful while a slot
             # decodes; reset the clock when the decode set drains
@@ -3293,6 +3342,10 @@ class LLMEngine:
     # to a GLOBAL prefix cache: radix index over every slot's resident
     # prefix + on-device cross-slot row copies)
     def _admit(self) -> None:
+        if self._pager is not None:
+            # weight-pager hook: work arriving while a demotion's D2H
+            # stream is aloft flips its abort flag — never blocks
+            self._pager.tick()
         if self._tier is not None:
             # tier policy tick rides the admission pass: harvest landed
             # spill/fetch DMAs, apply background IO results, expire
@@ -3302,6 +3355,16 @@ class LLMEngine:
         with self._lock:
             pending, self._pending = self._pending, []
         if not pending:
+            return
+        if self._pager is not None and not self._pager.poll_admission():
+            # weights not device-resident: the poll kicked the warm->hot
+            # promotion (layer-streamed, on its own thread); requeue the
+            # wave untouched and retry next pass. The brief sleep keeps
+            # this retry loop from busy-spinning the scheduler while the
+            # stream lands — promotion completion notifies _lock.
+            with self._lock:
+                self._pending[:0] = pending
+            time.sleep(0.002)
             return
         if self._prefix_enabled:
             # lazy re-register: decode appends / window clamps since the

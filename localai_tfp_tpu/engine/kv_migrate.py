@@ -871,6 +871,9 @@ def build_prefill_engine(spec, params, tokenizer, *, decode,
         latency_target_ms=None,
         autostart=False,
         kv_tier=False,
+        # shares `params` by reference with the decode engine: paging
+        # either side out would strand the other's dispatches
+        weight_paging=False,
         tag=(tag + "-prefill") if tag else "prefill",
     )
     if cache_dtype is not None:

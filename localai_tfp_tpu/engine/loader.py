@@ -19,6 +19,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..config import knobs
 from ..config.model_config import ModelConfig
 from ..telemetry import metrics as tm
 from ..utils import faultinject
@@ -491,9 +492,43 @@ class WatchDog:
                 and lm.busy_since is None
                 and now - lm.last_used > self.idle_timeout
             ):
+                if knobs.flag("LOCALAI_WATCHDOG_DEMOTE"):
+                    outcome = self._try_demote(lm)
+                    if outcome == "demoted":
+                        # demote-to-warm instead of kill: weights page
+                        # to host RAM, the engine/tokenizer/KV state
+                        # survive, and the idle clock restarts — a model
+                        # idle through ANOTHER full timeout (now warm)
+                        # falls through to today's shutdown
+                        log.warning(
+                            "watchdog: %s idle > %.0fs, demoting "
+                            "weights to host RAM", name,
+                            self.idle_timeout)
+                        tm.MODEL_EVICTIONS.labels(
+                            reason="watchdog_demote").inc()
+                        lm.last_used = now
+                        continue
+                    if outcome == "busy":
+                        continue  # transfer aloft: decide next tick
                 log.warning("watchdog: %s idle > %.0fs, killing",
                             name, self.idle_timeout)
                 tm.WATCHDOG_KILLS.labels(kind="idle").inc()
                 self.loader.shutdown_model(name, reason="watchdog_idle")
                 killed.append(name)
         return killed
+
+    @staticmethod
+    def _try_demote(lm: LoadedModel) -> Optional[str]:
+        """Ask the backend to page its weights out. Returns "demoted"
+        (a demotion just started), "busy" (one is already in flight),
+        "warm" (nothing hot to demote — the kill timer keeps running),
+        or None (backend has no pager: use the kill path)."""
+        fn = getattr(lm.backend, "demote_weights", None)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception as e:
+            log.warning("watchdog: demote of %s raised %r; falling "
+                        "back to kill", lm.name, e)
+            return None

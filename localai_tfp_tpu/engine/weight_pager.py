@@ -1,0 +1,668 @@
+"""Layer-granular weight paging: HBM-hot weights, host-RAM warm tier.
+
+A gallery deployment keeps dozens of models registered but only a few
+in flight at once; the reference handles that with whole-process
+lifecycle (per-model backend spawn, watchdog idle reap — pkg/model
+watchdog.go) so an idle model's next request pays a full checkpoint
+load. Here weights page instead: every engine owns a
+:class:`WeightPager` that can move its parameter tree between
+
+- HOT: the ordinary device-resident stacked tree (``eng.params``) —
+  the serving path is untouched; a hot model's dispatches see the same
+  arrays they would without paging (``LOCALAI_WEIGHT_PAGING=off`` is
+  byte-identical by construction).
+- WARM: the same tree mirrored to host RAM as numpy leaves (int8 ``q``
+  planes and their f32 scale planes both — a round trip is bit-exact),
+  device copy dropped. A warm model's engine, tokenizer, dispatch
+  cache and KV state all survive; only the weights left the chip.
+
+Both moves are layer-granular thanks to the stacked-scan layout
+(models/hf_loader.py ``layer_pages``): a "page" is row ``li`` of every
+stacked ``[L, ...]`` leaf, plus one globals page (embed / final norm /
+lm head). Granularity buys the two properties the whole design exists
+for:
+
+- DEMOTION never blocks a device step. It runs on its own background
+  thread through the same ``copy_to_host_async`` + ``TransferWindow``
+  discipline as the KV tier's spill (models/staging.py) — the
+  scheduler thread never waits on the D2H stream; the demote thread
+  does all the blocking. The thread only fires while the engine is
+  quiescent and abandons itself the moment work arrives
+  (``tick`` sets the abort flag from the scheduler's admission pass).
+- PROMOTION streams layers ahead of a commit cursor: layer ``i``
+  commits into the growing stacked tree (donated
+  ``dynamic_update_index_in_dim``, one jitted scatter reused across
+  layers) while layers ``i+1..i+k`` ride the H2D link
+  (``LOCALAI_WEIGHT_PREFETCH_AHEAD`` deep). A warm model's first
+  token costs one overlapped weight stream — hundreds of ms — not an
+  ``hf_loader`` ingest.
+
+Cross-engine policy lives in the process-global :data:`COORD`: every
+pager registers (weakly — an unclosed test engine must stay
+collectable) and ``pressure()`` demotes least-recently-used hot
+victims whenever hot bytes would exceed ``LOCALAI_WEIGHT_HBM_MB``.
+The warm mirror is RETAINED after promotion (and seeded by the quant
+artifact's ``keep_host`` on first load), so a clean model's next
+demotion is a zero-DMA bookkeeping drop ("seed" outcome).
+
+Meshed, follower, draft-carrying and disagg engines force paging off:
+sharded trees don't round-trip through one host mirror, and disagg
+prefill/decode pairs share one tree by reference.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import knobs
+from ..models.hf_loader import layer_pages
+from ..models.quant import QTensor
+from ..models.staging import TransferWindow
+from ..telemetry import metrics as tm
+from ..telemetry.flightrec import FLIGHT, WEIGHTS_TRACK
+from ..utils import faultinject
+
+log = logging.getLogger("localai.weights")
+
+__all__ = ["WeightPager", "PagerCoordinator", "COORD"]
+
+
+# one jitted scatter shared by every promotion: donating the stacked
+# buffer makes each layer commit an in-place row write, and passing the
+# layer index as a traced scalar keeps it ONE compile per (shape,
+# dtype), not one per layer
+_scatter_fns: dict = {}
+
+
+def _scatter(stack, row, li):
+    key = (stack.shape, str(stack.dtype))
+    fn = _scatter_fns.get(key)
+    if fn is None:
+        fn = jax.jit(
+            lambda s, r, l: jax.lax.dynamic_update_index_in_dim(
+                s, r, l, 0),
+            donate_argnums=(0,))
+        _scatter_fns[key] = fn
+    return fn(stack, row, jnp.int32(li))
+
+
+def _leaf_nbytes(leaf) -> int:
+    if isinstance(leaf, QTensor):
+        return int(leaf.q.nbytes) + int(leaf.scale.nbytes)
+    return int(getattr(leaf, "nbytes", 0))
+
+
+def _tree_nbytes(tree: Optional[dict]) -> int:
+    if not tree:
+        return 0
+    return sum(_leaf_nbytes(v) for v in tree.values())
+
+
+class PagerCoordinator:
+    """Process-global LRU across every live pager.
+
+    Holds WEAK references: a pager pins its engine's parameter tree,
+    so the coordinator must never keep a closed/leaked engine's pager
+    (and its multi-GB host mirror) alive. ``pressure()`` reads the
+    ``LOCALAI_WEIGHT_HBM_MB`` budget at call time (0 = unlimited) and
+    asks least-recently-used hot victims to demote until the hot set
+    fits — demotion is asynchronous, so the budget is a target the
+    fleet converges to, not a hard admission gate.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pagers: list = []  # weakrefs  # lint: guarded-by self._lock
+        self.counters = {"pressure_demotes": 0}
+
+    def register(self, pager: "WeightPager") -> None:
+        with self._lock:
+            self._pagers.append(weakref.ref(pager))
+
+    def unregister(self, pager: "WeightPager") -> None:
+        with self._lock:
+            self._pagers = [r for r in self._pagers
+                            if r() is not None and r() is not pager]
+
+    def _live(self) -> list:
+        with self._lock:
+            live = [p for p in (r() for r in self._pagers)
+                    if p is not None and not p.closed]
+            self._pagers = [weakref.ref(p) for p in live]
+        return live
+
+    def pressure(self, requester: Optional["WeightPager"] = None) -> int:
+        """Demote LRU hot victims until hot bytes (plus the requester's
+        incoming tree) fit the budget. Returns victims asked."""
+        budget = int(knobs.float_("LOCALAI_WEIGHT_HBM_MB") * (1 << 20))
+        if budget <= 0:
+            return 0
+        live = self._live()
+        need = (requester.tree_bytes()
+                if requester is not None else 0)
+        hot = [p for p in live
+               if p.state == "hot" and p is not requester]
+        total = need + sum(p.tree_bytes() for p in hot)
+        asked = 0
+        for victim in sorted(hot, key=lambda p: p.last_used):
+            if total <= budget:
+                break
+            if victim.request_demote(reason="pressure"):
+                total -= victim.tree_bytes()
+                asked += 1
+                with self._lock:
+                    self.counters["pressure_demotes"] += 1
+        self.update_residency()
+        return asked
+
+    def residency(self) -> dict:
+        counts = {"hot": 0, "warm": 0, "transitioning": 0}
+        for p in self._live():
+            st = p.state
+            counts["hot" if st == "hot" else
+                   "warm" if st == "warm" else "transitioning"] += 1
+        return counts
+
+    def update_residency(self) -> None:
+        for state, n in self.residency().items():
+            tm.ENGINE_MODEL_RESIDENCY.labels(state=state).set(n)
+
+
+COORD = PagerCoordinator()
+
+
+class WeightPager:
+    """Weight residency state machine for one single-chip engine.
+
+    States: ``hot`` (tree on device, engine serves normally) ->
+    ``demoting`` (background D2H) -> ``warm`` (host mirror only,
+    ``eng.params is None``) -> ``promoting`` (layer-streamed H2D) ->
+    ``hot``. All transitions happen under ``self._plock``; the engine's
+    scheduler only ever calls :meth:`tick` / :meth:`poll_admission`,
+    which never block on a transfer. ``self._plock`` must never be
+    held while taking ``eng._lock`` (the promote thread notifies the
+    engine OUTSIDE the pager lock) — the reverse order is what the
+    scheduler uses.
+    """
+
+    def __init__(self, eng) -> None:
+        self.eng = eng
+        self._mlabel = eng._mlabel
+        self.n_layers = int(eng.spec.n_layers)
+        self.n_pages = self.n_layers + 1  # + the globals page
+        self.ahead = max(1, knobs.int_("LOCALAI_WEIGHT_PREFETCH_AHEAD"))
+        self._plock = threading.RLock()
+        self.state = "hot"  # lint: guarded-by self._plock
+        self._host: Optional[dict] = None  # lint: guarded-by self._plock
+        self._host_src: Optional[int] = None  # id() of mirrored tree  # lint: guarded-by self._plock
+        self._abort = False  # lint: guarded-by self._plock
+        self._thread: Optional[threading.Thread] = None  # lint: guarded-by self._plock
+        self._cursor = 0  # committed layer pages while promoting  # lint: guarded-by self._plock
+        self._device_bytes = _tree_nbytes(eng.params)
+        self._hot_event = threading.Event()
+        self._hot_event.set()
+        self.last_used = time.monotonic()
+        self.closed = False
+        self.counters = {
+            "demotes": 0, "promotes": 0, "seed_demotes": 0,
+            "cold_fallbacks": 0, "aborted_demotes": 0,
+            "faulted_demotes": 0, "faulted_fetches": 0,
+        }
+        COORD.register(self)
+        # a new model arriving hot is itself HBM pressure: ask the
+        # fleet's LRU members to yield before this engine's first step
+        COORD.pressure(self)
+        COORD.update_residency()
+
+    # ------------------------------------------------------ scheduler API
+
+    def tick(self) -> None:
+        """Scheduler-thread hook (top of the admission pass): work
+        arriving while a demotion is in flight aborts it — serving
+        latency always wins over paging progress. Never blocks."""
+        with self._plock:
+            if self.state == "demoting" and self.eng._has_work():
+                self._abort = True
+
+    def poll_admission(self) -> bool:
+        """May the scheduler admit work right now? Hot -> yes (and the
+        touch feeds the cross-engine LRU). Warm -> kick a promotion and
+        say no; the caller requeues its poured requests and retries
+        next pass. Transitioning -> no (demotions self-abort via
+        :meth:`tick`; promotions finish on their own thread)."""
+        self.last_used = time.monotonic()
+        with self._plock:
+            if self.state == "hot":
+                return True
+            if self.state == "warm":
+                self._start_promote_locked()
+            return False
+
+    # --------------------------------------------------------- demotion
+
+    def request_demote(self, reason: str = "explicit") -> bool:
+        """Begin an async demotion (hot engines only). Returns whether
+        a demote thread was started; completion is asynchronous — the
+        engine keeps serving until the final quiescent drop."""
+        with self._plock:
+            if self.closed or self.state != "hot":
+                return False
+            if self.eng._has_work():
+                return False
+            self.state = "demoting"
+            self._abort = False
+            self._hot_event.clear()
+            t = threading.Thread(target=self._demote, daemon=True,
+                                 name="weights-demote")
+            self._thread = t
+        log.info("weight demotion (%s): %s", reason, self._mlabel)
+        t.start()
+        COORD.update_residency()
+        return True
+
+    def _abandon_demote(self, outcome: str) -> None:
+        with self._plock:
+            self.state = "hot"
+            self._hot_event.set()
+        self.counters["aborted_demotes" if outcome == "aborted"
+                      else "faulted_demotes"] += 1
+        tm.ENGINE_WEIGHT_PAGE_MOVES.labels(
+            model=self._mlabel, direction="demote",
+            outcome=outcome).inc()
+        COORD.update_residency()
+
+    def _demote(self) -> None:
+        """Background D2H page-out. Blocking waits are FINE here — this
+        thread owns them, the scheduler never joins it. The device tree
+        is dropped only at the very end, under the pager lock, after a
+        final quiescence check; any abandonment leaves the engine
+        exactly hot."""
+        eng = self.eng
+        params = eng.params
+        if params is None:  # raced a close/reload
+            self._abandon_demote("aborted")
+            return
+        if eng._has_work():
+            self._abandon_demote("aborted")
+            return
+        try:
+            if faultinject.ACTIVE:
+                faultinject.fire("weights.demote")
+        except faultinject.InjectedFault:
+            # abandoned BEFORE any copy or bookkeeping: the model stays
+            # hot and serves; chaos tests assert exactly this
+            self._abandon_demote("fault")
+            return
+        with self._plock:
+            seeded = (self._host is not None
+                      and self._host_src == id(params))
+        outcome = "seed" if seeded else "ok"
+        host: Optional[dict] = None
+        if not seeded:
+            t0 = time.perf_counter()
+            budget = int(
+                knobs.float_("LOCALAI_WEIGHT_INFLIGHT_MB") * (1 << 20))
+            window = TransferWindow(budget)
+            flying: list[tuple[str, Any]] = []
+            nbytes_total = 0
+            aborted = False
+            for name, leaf in params.items():
+                with self._plock:
+                    aborted = self._abort
+                if aborted:
+                    break
+                handles = ((leaf.q, leaf.scale)
+                           if isinstance(leaf, QTensor) else (leaf,))
+                nbytes = _leaf_nbytes(leaf)
+                if window.over(nbytes):
+                    window.drain(nbytes)
+                for h in handles:
+                    h.copy_to_host_async()
+                window.add(name, nbytes, handles)
+                flying.append((name, leaf))
+                nbytes_total += nbytes
+            if aborted:
+                window.forget()  # DMAs land on their own; stop tracking
+                self._abandon_demote("aborted")
+                return
+            window.flush()
+            # handles already on host: these asarray calls copy from
+            # the cached host buffer, they do not sync the device
+            host = {}
+            for name, leaf in flying:
+                if isinstance(leaf, QTensor):
+                    host[name] = QTensor(q=np.asarray(leaf.q),
+                                         scale=np.asarray(leaf.scale))
+                else:
+                    host[name] = np.asarray(leaf)
+            FLIGHT.transfer("demote", t0, time.perf_counter() - t0,
+                            self.n_pages, nbytes_total, blocking=False,
+                            track=WEIGHTS_TRACK, prefix="w")
+        with self._plock:
+            if self._abort or self.eng._has_work():
+                # work arrived during the copy: keep serving hot. The
+                # mirror we just paid for stays valid, so the NEXT
+                # demotion is a free seed drop
+                if host is not None:
+                    self._host = host
+                    self._host_src = id(params)
+                self.state = "hot"
+                self._hot_event.set()
+                aborted = True
+            else:
+                if host is not None:
+                    self._host = host
+                    self._host_src = id(params)
+                self.eng.params = None
+                self._device_bytes = 0
+                self.state = "warm"
+                aborted = False
+        del params
+        if aborted:
+            self.counters["aborted_demotes"] += 1
+            tm.ENGINE_WEIGHT_PAGE_MOVES.labels(
+                model=self._mlabel, direction="demote",
+                outcome="aborted").inc()
+        else:
+            self.counters["demotes"] += 1
+            if seeded:
+                self.counters["seed_demotes"] += 1
+            tm.ENGINE_WEIGHT_PAGE_MOVES.labels(
+                model=self._mlabel, direction="demote",
+                outcome=outcome).inc(self.n_pages)
+        COORD.update_residency()
+
+    # -------------------------------------------------------- promotion
+
+    def _start_promote_locked(self) -> None:
+        # lint: holds self._plock
+        if self.state != "warm" or self._host is None:
+            return
+        self.state = "promoting"
+        self._cursor = 0
+        t = threading.Thread(target=self._promote, daemon=True,
+                             name="weights-promote")
+        self._thread = t
+        t.start()
+
+    def ensure_hot(self, timeout_s: float = 60.0) -> bool:
+        """Block (caller's thread — never the scheduler) until the tree
+        is device-resident. Kicks a promotion when warm, aborts an
+        in-flight demotion, and returns whether hot was reached."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._plock:
+                if self.state == "hot":
+                    return True
+                if self.state == "warm":
+                    self._start_promote_locked()
+                elif self.state == "demoting":
+                    self._abort = True
+            if self._hot_event.wait(timeout=min(
+                    0.1, max(0.0, deadline - time.monotonic()))):
+                with self._plock:
+                    if self.state == "hot":
+                        return True
+        return self.state == "hot"
+
+    def _promote(self) -> None:
+        """Layer-streamed H2D promotion. Double-buffered: while layer
+        ``i`` commits into the stacked tree (donated scatter), layers
+        up to ``i + ahead`` are already riding the link. Installs the
+        finished tree itself — the engine is either idle (nothing to
+        race) or spinning in the requeue gate waiting for exactly
+        this."""
+        eng = self.eng
+        t_all = time.perf_counter()
+        with self._plock:
+            host = self._host
+        if host is None:  # invalidated under us
+            with self._plock:
+                if self.state == "promoting":
+                    self.state = "warm"
+            return
+        COORD.pressure(self)  # make room before bytes start landing
+        try:
+            assembled = self._stream_in(host)
+            result = "warm"
+        except faultinject.InjectedFault:
+            # fault on the streamed path: fall back to one plain
+            # blocking load of the full host mirror — no fire() on this
+            # leg, the request must still serve
+            self.counters["faulted_fetches"] += 1
+            self.counters["cold_fallbacks"] += 1
+            tm.ENGINE_WEIGHT_PREFETCH.labels(
+                model=self._mlabel, result="fault").inc()
+            assembled = jax.device_put(host)
+            jax.block_until_ready(assembled)
+            result = "cold"
+        except Exception:
+            log.exception("weight promotion failed; model stays warm")
+            with self._plock:
+                if self.state == "promoting":
+                    self.state = "warm"
+            COORD.update_residency()
+            return
+        with self._plock:
+            if self.state != "promoting":  # closed under us
+                return
+            eng.params = assembled
+            self._device_bytes = _tree_nbytes(assembled)
+            self._cursor = self.n_pages
+            self.state = "hot"
+            # the host mirror still bit-matches the tree we just built
+            # from it: re-point the seed marker at the new params object
+            # so the NEXT demotion is a zero-DMA drop
+            self._host_src = id(assembled)
+            self._hot_event.set()
+        self.counters["promotes"] += 1
+        tm.ENGINE_WEIGHT_PREFETCH.labels(
+            model=self._mlabel, result=result).inc()
+        tm.ENGINE_WEIGHT_PAGE_MOVES.labels(
+            model=self._mlabel, direction="promote",
+            outcome="ok").inc(self.n_pages)
+        FLIGHT.transfer("promote", t_all,
+                        time.perf_counter() - t_all, self.n_pages,
+                        self._device_bytes, blocking=False,
+                        track=WEIGHTS_TRACK, prefix="w")
+        COORD.update_residency()
+        # wake the scheduler's admission wait OUTSIDE the pager lock
+        with eng._lock:
+            eng._lock.notify_all()
+
+    def _stream_in(self, host: dict) -> dict:
+        """The streamed promotion body; raises InjectedFault through to
+        the caller's cold-fallback leg."""
+        L = self.n_layers
+        layered, globals_, page = layer_pages(host, L)
+        # growing stacked tree: zeros now, one donated row-scatter per
+        # layer as each page's H2D lands
+        stacked: dict = {}
+        for k, v in layered.items():
+            if isinstance(v, QTensor):
+                stacked[k] = QTensor(
+                    q=jnp.zeros(v.q.shape, v.q.dtype),
+                    scale=jnp.zeros(v.scale.shape, v.scale.dtype))
+            else:
+                stacked[k] = jnp.zeros(v.shape, v.dtype)
+
+        def commit(li: int, rows: dict, t0: float, nbytes: int) -> None:
+            for k, r in rows.items():
+                if isinstance(r, QTensor):
+                    stacked[k] = QTensor(
+                        q=_scatter(stacked[k].q, r.q, li),
+                        scale=_scatter(stacked[k].scale, r.scale, li))
+                else:
+                    stacked[k] = _scatter(stacked[k], r, li)
+            with self._plock:
+                self._cursor = li + 1
+            FLIGHT.transfer("fetch", t0, time.perf_counter() - t0, 1,
+                            nbytes, blocking=False,
+                            track=WEIGHTS_TRACK, prefix="w")
+
+        flight: deque = deque()  # (li, device rows, t0, nbytes)
+        for li in range(L):
+            if faultinject.ACTIVE:
+                faultinject.fire("weights.fetch")
+            t0 = time.perf_counter()
+            rows = page(li)
+            dev = jax.device_put(rows)  # async H2D enqueue
+            flight.append(
+                (li, dev, t0, sum(_leaf_nbytes(r)
+                                  for r in rows.values())))
+            while len(flight) > self.ahead:
+                commit(*flight.popleft())
+        while flight:
+            commit(*flight.popleft())
+        out = dict(stacked)
+        for k, v in globals_.items():
+            out[k] = jax.device_put(v)
+        jax.block_until_ready(out)
+        return out
+
+    # ------------------------------------------------------- host mirror
+
+    def seed_host(self, host: dict, params_obj: Any) -> None:
+        """Adopt a ready-made host mirror of ``params_obj`` (the quant
+        artifact's ``keep_host`` capture): the model's first demotion
+        becomes a zero-DMA drop."""
+        if not host:
+            return
+        with self._plock:
+            self._host = dict(host)
+            self._host_src = id(params_obj)
+
+    def invalidate_host(self) -> None:
+        """The engine's tree was reassigned in place (LoRA apply /
+        remove): the mirror no longer matches — drop it so the next
+        demotion re-copies."""
+        with self._plock:
+            self._host = None
+            self._host_src = None
+            self._device_bytes = _tree_nbytes(self.eng.params)
+
+    # ------------------------------------------------------ diagnostics
+
+    def tree_bytes(self) -> int:
+        """Size of the full tree (device bytes when hot, the host
+        mirror's when not — same dtypes, same total)."""
+        if self._device_bytes:
+            return self._device_bytes
+        with self._plock:
+            return _tree_nbytes(self._host)
+
+    def device_bytes(self) -> int:
+        """Ledger source for ``weights_hot``: device-resident weight
+        bytes right now (the commit cursor's fraction while a
+        promotion streams)."""
+        with self._plock:
+            if self.state in ("hot", "demoting"):
+                return self._device_bytes
+            if self.state == "promoting":
+                full = _tree_nbytes(self._host)
+                return int(full * self._cursor / max(1, self.n_pages))
+            return 0
+
+    def host_bytes(self) -> int:
+        """Ledger source for ``weights_warm`` (host=True): bytes held
+        by the warm mirror, including while it backs a hot tree."""
+        with self._plock:
+            return _tree_nbytes(self._host)
+
+    def tier_pages(self) -> dict:
+        """{"hot": pages, "warm": pages} for the gauge family; a
+        promotion reports its committed cursor, so the hot count
+        climbs layer by layer."""
+        with self._plock:
+            if self.state in ("hot", "demoting"):
+                hot = self.n_pages
+            elif self.state == "promoting":
+                hot = self._cursor
+            else:
+                hot = 0
+            warm = self.n_pages if self._host is not None else 0
+        return {"hot": hot, "warm": warm}
+
+    def stats(self) -> dict:
+        with self._plock:
+            return {
+                "state": self.state,
+                "pages": self.n_pages,
+                "device_bytes": self.device_bytes(),
+                "host_bytes": _tree_nbytes(self._host),
+                "seeded": self._host is not None,
+                **self.counters,
+            }
+
+    def leak_check(self) -> None:
+        """State-machine invariants; raises AssertionError."""
+        with self._plock:
+            st = self.state
+            if st == "hot" and self.eng.params is None \
+                    and not self.closed:
+                raise AssertionError("hot pager with no device tree")
+            if st == "warm":
+                if self.eng.params is not None:
+                    raise AssertionError(
+                        "warm pager but eng.params still set")
+                if self._host is None:
+                    raise AssertionError(
+                        "warm pager with no host mirror (weights lost)")
+                if self._device_bytes != 0:
+                    raise AssertionError(
+                        "warm pager still accounting device bytes")
+            if self._host is not None:
+                n_host = len(self._host)
+                if st == "hot" and self.eng.params is not None \
+                        and n_host != len(self.eng.params):
+                    raise AssertionError(
+                        "host mirror leaf count diverged from tree")
+
+    # -------------------------------------------------------- lifecycle
+
+    def settle(self, timeout_s: float = 30.0) -> bool:
+        """Wait for any in-flight transition to land (tests/tools only;
+        the scheduler never calls this). Returns settled."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._plock:
+                t = self._thread
+                if self.state in ("hot", "warm") and (
+                        t is None or not t.is_alive()):
+                    return True
+            if t is not None:
+                t.join(timeout=0.05)
+            else:
+                time.sleep(0.01)
+        return False
+
+    def close(self) -> None:
+        """Engine teardown: abort anything in flight, wait for the
+        worker thread, release the mirror and deregister."""
+        with self._plock:
+            self.closed = True
+            self._abort = True
+            t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+        with self._plock:
+            # a promotion that lost the race to closed leaves state
+            # "promoting"; normalize so residency gauges read sanely
+            if self.state == "demoting":
+                self.state = "hot"
+            elif self.state == "promoting":
+                self.state = "warm" if self._host is not None else "hot"
+            self._host = None
+            self._host_src = None
+            self._hot_event.set()
+        COORD.unregister(self)
+        COORD.update_residency()

@@ -82,7 +82,9 @@ def artifact_path(model_dir: str, quant: str, dtype_name: str) -> str:
 
 
 def try_load(path: str, device,
-             phases: Optional[Any] = None) -> Optional[dict[str, Any]]:
+             phases: Optional[Any] = None,
+             keep_host: Optional[dict[str, Any]] = None,
+             ) -> Optional[dict[str, Any]]:
     """Read an artifact and place it on ``device``; None on any miss.
 
     Pipelined: ONE reader thread pulls tensors off disk a small window
@@ -91,7 +93,14 @@ def try_load(path: str, device,
     each — the r5 bench's artifact-mode load paid them back-to-back.
     The final ``block_until_ready`` drains the transfer queue so the
     returned tree is resident (and ``phases`` bills it as transfer_s
-    rather than hiding it in engine construction)."""
+    rather than hiding it in engine construction).
+
+    ``keep_host`` (a dict the caller owns) is filled with the host-side
+    numpy leaves as they stream past — QTensor leaves as numpy-leaf
+    QTensors — giving the weight pager (engine/weight_pager.py) a free
+    warm-tier mirror: the arrays were already in host RAM on the way to
+    the chip, so the model's FIRST demotion needs no device->host DMA
+    at all. On a miss/failure the dict is cleared."""
     if not enabled() or not os.path.exists(path):
         return None
     import contextlib
@@ -106,6 +115,8 @@ def try_load(path: str, device,
     try:
         params: dict[str, Any] = {}
         qparts: dict[str, dict[str, Any]] = {}
+        hparams: dict[str, Any] = {}
+        hqparts: dict[str, dict[str, Any]] = {}
         with safe_open(path, framework="np") as h:
             meta = h.metadata() or {}
             if meta.get("format") != FORMAT_VERSION:
@@ -127,19 +138,30 @@ def try_load(path: str, device,
                         arr = futures.pop(name).result()
                     with timed("transfer_s"):
                         dev = jax.device_put(arr, device)
-                    del arr
                     if name.endswith(".q"):
                         qparts.setdefault(name[:-2], {})["q"] = dev
+                        if keep_host is not None:
+                            hqparts.setdefault(name[:-2], {})["q"] = arr
                     elif name.endswith(".scale"):
                         qparts.setdefault(name[:-6], {})["scale"] = dev
+                        if keep_host is not None:
+                            hqparts.setdefault(name[:-6], {})["scale"] = arr
                     else:
                         params[name] = dev
+                        if keep_host is not None:
+                            hparams[name] = arr
+                    del arr
             finally:
                 pool.shutdown(wait=True)
         for name, parts in qparts.items():
             if "q" not in parts or "scale" not in parts:
                 return None
             params[name] = QTensor(q=parts["q"], scale=parts["scale"])
+        if keep_host is not None:
+            keep_host.update(hparams)
+            for name, parts in hqparts.items():
+                keep_host[name] = QTensor(q=parts["q"],
+                                          scale=parts["scale"])
         with timed("transfer_s"):
             jax.block_until_ready(params)
         try:
@@ -155,6 +177,8 @@ def try_load(path: str, device,
         return params
     except Exception as e:
         log.warning("quant artifact %s unreadable (%r) — full load", path, e)
+        if keep_host is not None:
+            keep_host.clear()
         return None
 
 

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .llm_spec import LLMSpec, spec_from_hf_config
-from .transformer import Params
+from .transformer import _NON_LAYER_KEYS, Params
 
 
 def load_hf_state(model_dir: str) -> tuple[dict, Callable[[str], np.ndarray], list[str]]:
@@ -420,3 +420,42 @@ def load_params(
             object.__setattr__(spec, "tie_word_embeddings", True)
 
     return spec, p
+
+
+def layer_pages(host_tree: dict, n_layers: int):
+    """Partition a parameter tree into the weight pager's transfer units.
+
+    The stacked-scan layout makes layer granularity free: every per-layer
+    leaf is a single ``[L, ...]`` array, so "page li" is just row ``li``
+    of each stacked leaf — no per-tensor bookkeeping, and the promotion
+    path can reassemble the stacked tree with one
+    ``dynamic_update_index_in_dim`` per leaf per layer
+    (engine/weight_pager.py). Returns ``(layered, globals_, page)``:
+
+    - ``layered``: the stacked ``[L, ...]`` leaves (keys not in
+      :data:`~localai_tfp_tpu.models.transformer._NON_LAYER_KEYS`),
+    - ``globals_``: the unstacked leaves (embeddings, final norm,
+      lm head) that travel as one extra "globals" page,
+    - ``page(li)``: dict of layer ``li``'s rows, slicing through
+      :class:`~localai_tfp_tpu.models.transformer.QTensor` leaves
+      (row of ``q`` and of ``scale`` — the int8 planes and their scale
+      planes page together so a round trip stays bit-exact).
+
+    Works on host (numpy) and device (jax) trees alike; the pager uses
+    it on the host mirror so slicing never touches HBM.
+    """
+    layered = {k: v for k, v in host_tree.items() if k not in _NON_LAYER_KEYS}
+    globals_ = {k: v for k, v in host_tree.items() if k in _NON_LAYER_KEYS}
+
+    def page(li: int) -> dict:
+        if not 0 <= li < n_layers:
+            raise IndexError(f"layer page {li} outside [0, {n_layers})")
+        out = {}
+        for k, v in layered.items():
+            if hasattr(v, "q"):  # QTensor: slice both planes
+                out[k] = type(v)(v.q[li], v.scale[li])
+            else:
+                out[k] = v[li]
+        return out
+
+    return layered, globals_, page

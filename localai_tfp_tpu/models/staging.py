@@ -122,6 +122,17 @@ class TransferWindow:
                 jax.block_until_ready(h)
             self.flying -= b
 
+    def forget(self) -> list:
+        """Non-blocking: drop every tracked entry and return their tags
+        WITHOUT waiting for the transfers. For abandoned streams (an
+        aborted weight demotion — engine/weight_pager.py) where the
+        caller no longer wants the data; the in-flight DMAs still
+        complete on their own, the window just stops accounting them."""
+        tags = [t for t, _, _ in self._q]
+        self._q.clear()
+        self.flying = 0
+        return tags
+
 
 _PRECISION_BITS = {"bfloat16": (8, 7), "float16": (5, 10)}
 

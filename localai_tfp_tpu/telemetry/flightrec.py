@@ -51,6 +51,12 @@ KV_TIER_TRACK = "kv_tier"
 # blocks either engine's device step)
 MIGRATE_TRACK = "migrate"
 
+# dedicated timeline thread for weight-paging DMA lanes
+# (engine/weight_pager.py demote/fetch spans interleave against the
+# "device" track — the visual proof that paging a model's weights in or
+# out never blocks a device step)
+WEIGHTS_TRACK = "weights"
+
 
 def _env_capacity() -> int:
     return max(64, knobs.int_("LOCALAI_TIMELINE_EVENTS"))
@@ -104,15 +110,17 @@ class FlightRecorder:
 
     def transfer(self, direction: str, t0: float, dur_s: float,
                  pages: int, nbytes: int, blocking: bool = False,
-                 track: str = KV_TIER_TRACK) -> None:
+                 track: str = KV_TIER_TRACK, prefix: str = "kv") -> None:
         """A tier DMA lane span (KV spill/fetch/save/load,
-        engine/kv_tier.py): enqueue-to-observed-ready window stamped at
-        harvest like device flights — recording one never forces a
-        sync. ``blocking`` marks a transfer the scheduler WAITED on;
-        the tier's contract (tests/test_kv_tier.py) is that no
-        device-step span ever overlaps a blocking=True transfer,
-        because the tier never records one."""
-        self.record("X", "kv:" + direction, track, t0, dur_s,
+        engine/kv_tier.py; weight demote/fetch with ``prefix="w"``,
+        engine/weight_pager.py): enqueue-to-observed-ready window
+        stamped at harvest like device flights — recording one never
+        forces a sync. ``blocking`` marks a transfer the scheduler
+        WAITED on; the tier's contract (tests/test_kv_tier.py,
+        tests/test_weight_paging.py) is that no device-step span ever
+        overlaps a blocking=True transfer, because the tier never
+        records one."""
+        self.record("X", prefix + ":" + direction, track, t0, dur_s,
                     {"pages": pages, "bytes": nbytes,
                      "blocking": blocking})
 

@@ -73,19 +73,30 @@ class HBMLedger:
         self.model = model
         self._lock = threading.Lock()
         self._sources: dict[str, Source] = {}  # lint: guarded-by self._lock
+        self._host: set[str] = set()  # lint: guarded-by self._lock
         self._last_reconcile: Optional[dict] = None  # lint: guarded-by self._lock
 
-    def register(self, component: str, source: Source) -> None:
+    def register(self, component: str, source: Source,
+                 host: bool = False) -> None:
         """Attach/replace a component's byte source. Pytrees are
-        measured once, now (re-register after reallocating)."""
+        measured once, now (re-register after reallocating).
+        ``host=True`` marks a host-RAM component (the weight pager's
+        warm tier): it still lands on the per-component gauge but is
+        excluded from the device drift sum — host bytes can never
+        explain ``bytes_in_use``."""
         if not (isinstance(source, (int, float)) or callable(source)):
             source = nbytes_of(source)
         with self._lock:
             self._sources[component] = source
+            if host:
+                self._host.add(component)
+            else:
+                self._host.discard(component)
 
     def drop(self, component: str) -> None:
         with self._lock:
             self._sources.pop(component, None)
+            self._host.discard(component)
 
     def attributed(self) -> dict[str, int]:
         """Current bytes per component (callables evaluated outside
@@ -126,7 +137,9 @@ class HBMLedger:
         for name, b in attr.items():
             tm.ENGINE_HBM_BYTES.labels(
                 model=self.model, component=name).set(b)
-        total = sum(attr.values())
+        with self._lock:
+            host = set(self._host)
+        total = sum(b for n, b in attr.items() if n not in host)
         snap: dict[str, Any] = {"components": attr, "attributed": total,
                                 "bytes_in_use": in_use}
         if in_use is not None:
@@ -147,7 +160,11 @@ class HBMLedger:
         if last is not None:
             return last
         attr = self.attributed()
-        return {"components": attr, "attributed": sum(attr.values()),
+        with self._lock:
+            host = set(self._host)
+        return {"components": attr,
+                "attributed": sum(b for n, b in attr.items()
+                                  if n not in host),
                 "bytes_in_use": None}
 
     def reset_gauges(self) -> None:
@@ -175,7 +192,8 @@ def _device_memory_stats() -> Optional[dict]:
 def dump_post_mortem(state_dir: str, model: str, error: BaseException,
                      ledger: Optional[HBMLedger] = None,
                      pool_stats: Any = None,
-                     tier_stats: Optional[dict] = None) -> Optional[str]:
+                     tier_stats: Optional[dict] = None,
+                     weight_stats: Optional[dict] = None) -> Optional[str]:
     """Write an OOM forensics JSON under ``state_dir`` and return its
     path. Never raises — forensics must not mask the original failure.
     """
@@ -195,6 +213,7 @@ def dump_post_mortem(state_dir: str, model: str, error: BaseException,
                         if hasattr(pool_stats, "_asdict")
                         else pool_stats),
             "kv_tier": tier_stats,
+            "weight_pager": weight_stats,
             "devices": sysinfo.device_memory(),
             "flightrec_tail": events[-256:],
         }

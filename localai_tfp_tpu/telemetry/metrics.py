@@ -205,6 +205,39 @@ ENGINE_KV_TIER_BYTES = REGISTRY.counter(
     "scale planes included for int8 caches)",
     labels=("model", "direction"),
 )
+# layer-granular weight paging (engine/weight_pager.py): HBM-hot
+# device tree, host-RAM warm pages, cross-engine LRU
+ENGINE_WEIGHT_PAGES = REGISTRY.gauge(
+    "engine_weight_pages_count",
+    "Weight pages resident per tier (hot = on-device layer pages, "
+    "warm = host-RAM layer pages; a page counts in both tiers while "
+    "the retained host copy backs a promoted device tree)",
+    labels=("model", "tier"),
+)
+ENGINE_WEIGHT_PAGE_MOVES = REGISTRY.counter(
+    "engine_weight_page_moves_total",
+    "Weight page tier transitions by direction (demote = HBM->host, "
+    "promote = host->HBM) and outcome (ok, seed = demote served from "
+    "the retained/artifact host copy with zero DMA, fault = injected/"
+    "real transfer failure, aborted = new work arrived mid-demotion "
+    "and the device tree was kept)",
+    labels=("model", "direction", "outcome"),
+)
+ENGINE_WEIGHT_PREFETCH = REGISTRY.counter(
+    "engine_weight_prefetch_total",
+    "Warm-model promotion attempts at admission (warm = layer-streamed "
+    "prefetch-ahead assembly served the wake-up, cold = the stream "
+    "faulted and the blocking full-tree fallback load served it, "
+    "fault = a streamed page transfer failed)",
+    labels=("model", "result"),
+)
+ENGINE_MODEL_RESIDENCY = REGISTRY.gauge(
+    "engine_model_residency_count",
+    "Live engines per weight-residency state across the process (hot "
+    "= weights on device, warm = weights paged to host RAM, "
+    "transitioning = a demotion or promotion is in flight)",
+    labels=("state",),
+)
 # disaggregated prefill/decode serving (engine/kv_migrate.py)
 ENGINE_DISAGG_REQUESTS = REGISTRY.counter(
     "engine_disagg_requests_total",

@@ -7,10 +7,12 @@ loader (``loader.load``), the multihost dispatch channel
 (``federated.upstream`` / ``federated.midstream``), the balancer's
 telemetry-digest probe fetch (``federated.digest``), the autoscaler's
 ScaleDriver boot/kill actions (``federated.scale``), the KV tier's
-DMA lanes (``kv_tier.spill`` / ``kv_tier.fetch``), and the
+DMA lanes (``kv_tier.spill`` / ``kv_tier.fetch``), the
 disaggregated-serving migration protocol (``disagg.migrate`` on the
 prefill-side capture, ``disagg.handoff`` on the decode-side adopt —
-engine/kv_migrate.py) — and armed via
+engine/kv_migrate.py), and the weight pager's tier lanes
+(``weights.demote`` on the D2H page-out, ``weights.fetch`` on the
+layer-streamed promotion — engine/weight_pager.py) — and armed via
 
     LOCALAI_FAULTS="point:spec[,point:spec...]"
 

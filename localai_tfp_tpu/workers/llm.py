@@ -179,6 +179,8 @@ class JaxLLMBackend(Backend):
                 defer_commit = False  # streaming device commit
                 artifact_hit = False  # pre-quantized tree from cache
                 artifact_file = None
+                artifact_host = {}  # host mirror kept from the artifact
+                # read — seeds the weight pager's warm tier for free
                 pending_artifact = None  # written after warmup
                 params = None
                 load_ledger = None  # load-time HBM attribution (the
@@ -256,9 +258,14 @@ class JaxLLMBackend(Backend):
 
                         artifact_file = artifact_path(
                             model_dir, quant, str(dtype.__name__))
+                        # the artifact read streams every leaf through
+                        # host RAM anyway; keep that copy as the weight
+                        # pager's warm mirror so the model's first
+                        # demotion is a zero-DMA drop
                         params = try_load(artifact_file,
                                           jax.devices()[0],
-                                          phases=phases)
+                                          phases=phases,
+                                          keep_host=artifact_host)
                         if params is not None:
                             self.spec = spec_from_hf_config(hf_state[0])
                             if "lm_head" not in params:
@@ -399,7 +406,22 @@ class JaxLLMBackend(Backend):
                         channel=channel if role == "leader" else None,
                         follower=role == "follower",
                         tag=opts.model,
+                        # disagg shares one tree between the prefill
+                        # and decode engines by reference — weight
+                        # paging would strand one side's dispatches
+                        weight_paging=(
+                            False if knobs.flag("LOCALAI_DISAGG")
+                            else None),
                     )
+                    pager = getattr(self.engine, "_pager", None)
+                    if pager is not None and artifact_hit \
+                            and artifact_host:
+                        # artifact loads never merge LoRA (defer_commit
+                        # excludes adapters), so the captured host tree
+                        # mirrors engine.params exactly
+                        pager.seed_host(artifact_host,
+                                        self.engine.params)
+                    artifact_host = {}
                     self.engine.start()
                 if (knobs.flag("LOCALAI_DISAGG")
                         and mesh is None and draft is None
@@ -508,6 +530,13 @@ class JaxLLMBackend(Backend):
                     int(p.size) * p.dtype.itemsize
                     for p in jax.tree_util.tree_leaves(self.engine.params)
                 ))
+                pager = getattr(self.engine, "_pager", None)
+                if pager is not None:
+                    # weight residency split: a warm model reports
+                    # params_bytes 0 (nothing on device) and its tree
+                    # under weights_warm_bytes
+                    mem["weights_hot_bytes"] = int(pager.device_bytes())
+                    mem["weights_warm_bytes"] = int(pager.host_bytes())
             except Exception as e:
                 # status must never fail, but a half-built engine
                 # should say so rather than report empty memory
@@ -518,6 +547,30 @@ class JaxLLMBackend(Backend):
         return self.engine is not None and any(
             s.active for s in self.engine.slots
         )
+
+    def demote_weights(self) -> Optional[str]:
+        """Page this model's weights out to host RAM (watchdog demote
+        mode and the admin API). Returns "demoted" (async demotion
+        started), "busy" (a transition is in flight or the engine has
+        work), "warm" (already paged out), or None (no pager: meshed /
+        disagg / paging off)."""
+        pager = getattr(self.engine, "_pager", None)
+        if pager is None:
+            return None
+        st = pager.state
+        if st == "hot":
+            return ("demoted"
+                    if pager.request_demote(reason="watchdog")
+                    else "busy")
+        if st in ("demoting", "promoting"):
+            return "busy"
+        return "warm"
+
+    def weight_residency(self) -> Optional[dict]:
+        """Pager snapshot for /backend/monitor (None when paging is
+        off for this engine)."""
+        pager = getattr(self.engine, "_pager", None)
+        return None if pager is None else pager.stats()
 
     # ------------------------------------------------------------- inference
 
@@ -736,9 +789,11 @@ class JaxLLMBackend(Backend):
                 "LoRA hot-apply needs full-precision weights; load the "
                 "model without quantization (or restart with the adapter "
                 "in lora_adapters, which merges before quantizing)")
+        self._pager_prepare_swap()
         params, n = merge_lora(self.spec, self.engine.params, adapter_dir,
                                scale=scale)
         self.engine.params = self._reshard(params)
+        self._pager_after_swap()
         return n
 
     def remove_lora(self, adapter_dir: str, scale: float = 1.0) -> int:
@@ -748,10 +803,27 @@ class JaxLLMBackend(Backend):
         if self._quantized:
             raise RuntimeError(
                 "LoRA hot-unmerge needs full-precision weights")
+        self._pager_prepare_swap()
         params, n = merge_lora(self.spec, self.engine.params, adapter_dir,
                                scale=scale, sign=-1.0)
         self.engine.params = self._reshard(params)
+        self._pager_after_swap()
         return n
+
+    def _pager_prepare_swap(self) -> None:
+        """A LoRA hot-apply reassigns engine.params: the tree must be
+        device-resident first (merge reads it), and the pager's host
+        mirror goes stale the moment the swap lands."""
+        pager = getattr(self.engine, "_pager", None)
+        if pager is not None and not pager.ensure_hot():
+            raise RuntimeError(
+                "weights not device-resident (promotion timed out); "
+                "retry the LoRA operation")
+
+    def _pager_after_swap(self) -> None:
+        pager = getattr(self.engine, "_pager", None)
+        if pager is not None:
+            pager.invalidate_host()
 
     def _reshard(self, params):
         """merge_lora round-trips leaves through host memory; under a mesh

@@ -1,0 +1,441 @@
+"""Layer-granular weight paging (engine/weight_pager.py): HBM-hot /
+host-RAM-warm weight tiers so a gallery of models shares one chip.
+
+The contract under test: ``LOCALAI_WEIGHT_PAGING=off`` is structural
+(no pager object at all) and byte-identical — greedy AND seeded
+sampling streams match a paging-on all-hot engine exactly; a
+demote -> promote round trip is bit-exact per leaf including the int8
+``q``/``scale`` planes of quantized projections; promotion re-seeds the
+host mirror so the next demotion is a zero-DMA drop; prefetch streams
+layers without ever recording a blocking transfer (flight-recorder
+evidence); HBM pressure demotes the least-recently-used engine across
+the whole process (PagerCoordinator); the HBM ledger attributes
+``weights_hot``/``weights_warm`` and keeps host bytes out of the device
+drift sum; injected faults on ``weights.demote`` leave the model hot
+and serving, on ``weights.fetch`` fall back to one cold blocking load —
+the request still serves with exactly one terminal event; and the
+watchdog's demote-to-warm mode pages idle models out instead of
+killing them, escalating to a kill only after a second full timeout."""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from localai_tfp_tpu.config.model_config import ModelConfig
+from localai_tfp_tpu.engine.engine import GenRequest, LLMEngine
+from localai_tfp_tpu.engine.loader import (
+    ModelLoader,
+    WatchDog,
+    registry,
+)
+from localai_tfp_tpu.engine.tokenizer import ByteTokenizer
+from localai_tfp_tpu.engine.weight_pager import COORD
+from localai_tfp_tpu.models.llm_spec import tiny_spec
+from localai_tfp_tpu.models.quant import QTensor, quantize_params
+from localai_tfp_tpu.models.transformer import init_params
+from localai_tfp_tpu.telemetry import metrics as tm
+from localai_tfp_tpu.telemetry.flightrec import FLIGHT
+from localai_tfp_tpu.utils import faultinject as fi
+from localai_tfp_tpu.workers.base import Backend, ModelLoadOptions, Result
+
+_KNOBS = ("LOCALAI_WEIGHT_PAGING", "LOCALAI_WEIGHT_HBM_MB",
+          "LOCALAI_WEIGHT_PREFETCH_AHEAD", "LOCALAI_WEIGHT_INFLIGHT_MB",
+          "LOCALAI_WATCHDOG_DEMOTE")
+
+
+@pytest.fixture(autouse=True)
+def _knob_guard():
+    saved = {k: os.environ.get(k) for k in _KNOBS}
+    yield
+    fi.disarm()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+@pytest.fixture(scope="module")
+def model():
+    tk = ByteTokenizer()
+    spec = tiny_spec(vocab_size=tk.vocab_size, max_position=256)
+    params = init_params(jax.random.PRNGKey(0), spec, dtype=jnp.float32)
+    return spec, params, tk
+
+
+def _engine(model, paging, **kw):
+    spec, params, tk = model
+    os.environ["LOCALAI_WEIGHT_PAGING"] = paging
+    return LLMEngine(spec, params, tk, n_slots=2, max_seq=128,
+                     prefill_buckets=(8, 32), **kw)
+
+
+def _run(eng, prompt="the quick brown fox", max_tokens=12,
+         temperature=0.0, seed=7):
+    q = eng.submit(GenRequest(prompt_ids=eng.tokenize(prompt),
+                              max_tokens=max_tokens,
+                              temperature=temperature, seed=seed,
+                              ignore_eos=True))
+    toks, finals = [], 0
+    while True:
+        ev = q.get(timeout=120)
+        if ev.token_id is not None:
+            toks.append(ev.token_id)
+        if ev.done:
+            finals += 1
+            break
+    # drain any stragglers (there must be none: exactly one terminal)
+    while not q.empty():
+        if q.get_nowait().done:
+            finals += 1
+    return toks, ev.finish_reason, finals
+
+
+def _one_shot(model, paging, **gen_kw):
+    eng = _engine(model, paging)
+    try:
+        return _run(eng, **gen_kw)[:2]
+    finally:
+        eng.close()
+
+
+def _demote_now(pager, timeout=30.0):
+    """Demotions need a quiescent engine; flights can linger a beat
+    after the terminal event, so retry the request until it takes."""
+    deadline = time.monotonic() + timeout
+    while not pager.request_demote():
+        assert time.monotonic() < deadline, "engine never went quiet"
+        time.sleep(0.01)
+    assert pager.settle(timeout)
+
+
+def _leaves(tree):
+    return jax.tree_util.tree_leaves(tree)
+
+
+# ---------------------------------------------------------------------------
+# the off knob: structural removal, byte-identical output
+
+
+def test_off_knob_is_structural(model):
+    off = _engine(model, "off")
+    on = _engine(model, "on")
+    forced = _engine(model, "on", weight_paging=False)
+    try:
+        assert off._pager is None
+        assert on._pager is not None
+        assert forced._pager is None  # ctor override beats the knob
+    finally:
+        off.close()
+        on.close()
+        forced.close()
+
+
+@pytest.mark.slow  # tier-1 representative: the seeded-sampling twin
+def test_off_knob_byte_identity_greedy(model):
+    a = _one_shot(model, "off")
+    b = _one_shot(model, "off")
+    c = _one_shot(model, "on")
+    assert a == b, "baseline itself is nondeterministic"
+    assert a == c, "all-hot paged engine diverged from paging=off"
+
+
+def test_off_knob_byte_identity_seeded_sampling(model):
+    a = _one_shot(model, "off", temperature=0.9, seed=1234)
+    b = _one_shot(model, "on", temperature=0.9, seed=1234)
+    assert a == b, "seeded sampling diverged under paging"
+
+
+# ---------------------------------------------------------------------------
+# demote -> promote round trip: bit-exact, including int8 planes
+
+
+def test_round_trip_bit_exact_quantized(model):
+    spec, params, tk = model
+    qparams = quantize_params(params)
+    assert any(isinstance(v, QTensor) for v in qparams.values())
+    before = {k: (QTensor(q=np.asarray(v.q), scale=np.asarray(v.scale))
+                  if isinstance(v, QTensor) else np.asarray(v))
+              for k, v in qparams.items()}
+    os.environ["LOCALAI_WEIGHT_PAGING"] = "on"
+    eng = LLMEngine(spec, qparams, tk, n_slots=2, max_seq=128,
+                    prefill_buckets=(8, 32))
+    try:
+        pager = eng._pager
+        _demote_now(pager)
+        assert pager.state == "warm"
+        assert eng.params is None
+        assert pager.counters["demotes"] == 1
+        # a warm engine auto-promotes on the next admission pass
+        toks, fin, finals = _run(eng, max_tokens=4)
+        assert finals == 1 and toks
+        assert pager.state == "hot"
+        assert pager.counters["promotes"] == 1
+        bl, al = _leaves(before), _leaves(eng.params)
+        assert len(bl) == len(al)
+        for b, a in zip(bl, al):
+            a = np.asarray(a)
+            assert b.dtype == a.dtype and b.shape == a.shape
+            assert np.array_equal(b, a), "weight bits changed in transit"
+        pager.leak_check()
+    finally:
+        eng.close()
+
+
+def test_promote_reseeds_host_mirror(model):
+    """After a promotion the host mirror still bit-matches the device
+    tree, so the NEXT demotion must be a zero-DMA seed drop."""
+    eng = _engine(model, "on")
+    try:
+        pager = eng._pager
+        _demote_now(pager)
+        _run(eng, max_tokens=2)  # warm -> promote -> serve
+        assert pager.state == "hot"
+        _demote_now(pager)
+        assert pager.counters["demotes"] == 2
+        assert pager.counters["seed_demotes"] == 1
+        pager.leak_check()
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# prefetch overlap: flight-recorder evidence, no blocking transfers
+
+
+def test_prefetch_never_records_blocking_transfer(model):
+    was = FLIGHT.enabled
+    FLIGHT.enabled = True
+    eng = _engine(model, "on")
+    try:
+        pager = eng._pager
+        _demote_now(pager)
+        FLIGHT.clear()
+        _run(eng, max_tokens=4)  # promotion streams the layers back
+        assert pager.state == "hot"
+        trace = FLIGHT.export_chrome_trace()
+        tracks = {ev["tid"]: ev["args"]["name"]
+                  for ev in trace["traceEvents"]
+                  if ev.get("ph") == "M" and ev["name"] == "thread_name"}
+        w = [ev for ev in trace["traceEvents"] if ev.get("ph") == "X"
+             and tracks.get(ev["tid"]) == "weights"]
+        fetches = [ev for ev in w if ev["name"] == "w:fetch"]
+        spec = model[0]
+        assert len(fetches) >= spec.n_layers, \
+            "promotion did not stream per-layer fetches"
+        assert any(ev["name"] == "w:promote" for ev in w)
+        assert all(ev["args"]["blocking"] is False for ev in w), \
+            "a weight transfer blocked the scheduler"
+    finally:
+        FLIGHT.enabled = was
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-engine LRU under HBM pressure
+
+
+def test_pressure_demotes_lru_engine(model):
+    spec, _, tk = model
+    pa = init_params(jax.random.PRNGKey(1), spec, dtype=jnp.float32)
+    pb = init_params(jax.random.PRNGKey(2), spec, dtype=jnp.float32)
+    os.environ["LOCALAI_WEIGHT_PAGING"] = "on"
+    ea = LLMEngine(spec, pa, tk, n_slots=2, max_seq=128,
+                   prefill_buckets=(8, 32))
+    eb = LLMEngine(spec, pb, tk, n_slots=2, max_seq=128,
+                   prefill_buckets=(8, 32))
+    try:
+        a, b = ea._pager, eb._pager
+        _run(ea, max_tokens=2)  # A touched first: the LRU victim
+        _run(eb, max_tokens=2)
+        # budget fits ~1.5 trees: promoting B must evict exactly A
+        budget_mb = (a.tree_bytes() * 1.5) / (1 << 20)
+        os.environ["LOCALAI_WEIGHT_HBM_MB"] = f"{budget_mb:.6f}"
+        _demote_now(b)
+        before = COORD.counters["pressure_demotes"]
+        _run(eb, max_tokens=2)  # promote -> pressure -> demote A
+        assert eb._pager.state == "hot"
+        assert COORD.counters["pressure_demotes"] > before
+        deadline = time.monotonic() + 30
+        while a.state != "warm":
+            assert time.monotonic() < deadline, \
+                f"LRU victim never went warm (state={a.state})"
+            time.sleep(0.01)
+        assert ea.params is None
+        a.leak_check()
+        b.leak_check()
+    finally:
+        os.environ["LOCALAI_WEIGHT_HBM_MB"] = "0"
+        ea.close()
+        eb.close()
+
+
+# ---------------------------------------------------------------------------
+# HBM ledger: hot/warm attribution, host bytes out of the drift sum
+
+
+def test_ledger_hot_warm_reconcile(model):
+    eng = _engine(model, "on")
+    try:
+        pager = eng._pager
+        led = eng._ledger
+        assert led is not None
+        attr = led.attributed()
+        assert attr["weights_hot"] == pager.tree_bytes() > 0
+        assert attr["weights_warm"] == 0
+        assert "weights" not in attr  # replaced by the tiered pair
+        _demote_now(pager)
+        attr = led.attributed()
+        assert attr["weights_hot"] == 0
+        assert attr["weights_warm"] == pager.host_bytes() > 0
+        snap = led.reconcile(memory_stats=lambda: None)
+        # warm bytes live in host RAM: they must not be counted
+        # against the device allocation drift
+        assert snap["attributed"] == sum(
+            b for n, b in snap["components"].items()
+            if n != "weights_warm")
+        pages = pager.tier_pages()
+        assert pages == {"hot": 0, "warm": pager.n_pages}
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: injected faults on both transfer directions
+
+
+def test_fault_on_demote_stays_hot_and_serves(model):
+    eng = _engine(model, "on")
+    try:
+        pager = eng._pager
+        fi.arm("weights.demote:fail@1")
+        deadline = time.monotonic() + 30
+        while not pager.request_demote():
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        assert pager.settle(30)
+        assert pager.state == "hot", "faulted demotion must abandon"
+        assert eng.params is not None
+        assert pager.counters["faulted_demotes"] == 1
+        fi.disarm()
+        toks, fin, finals = _run(eng, max_tokens=4)
+        assert finals == 1 and toks
+        pager.leak_check()
+    finally:
+        eng.close()
+
+
+def test_fault_on_fetch_falls_back_cold(model):
+    ref, _ = _one_shot(model, "off", max_tokens=4)
+    eng = _engine(model, "on")
+    try:
+        pager = eng._pager
+        _demote_now(pager)
+        fi.arm("weights.fetch:fail@1")
+        toks, fin, finals = _run(eng, max_tokens=4)
+        fi.disarm()
+        assert finals == 1, "fault produced duplicate terminal events"
+        assert pager.state == "hot"
+        assert pager.counters["cold_fallbacks"] == 1
+        assert pager.counters["faulted_fetches"] == 1
+        assert toks == ref, "cold-fallback weights diverged"
+        pager.leak_check()
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# watchdog demote-to-warm mode
+
+
+class _PagedBackend(Backend):
+    """Scripted demote_weights: first idle tick demotes, later ticks
+    report the model already warm (nothing hot left to page out)."""
+
+    def __init__(self):
+        self.script = ["demoted", "warm"]
+        self.shut = False
+
+    def load_model(self, opts: ModelLoadOptions) -> Result:
+        return Result(True)
+
+    def health(self):
+        return True
+
+    def shutdown(self):
+        self.shut = True
+
+    def demote_weights(self):
+        return self.script.pop(0) if self.script else "warm"
+
+
+def _loader_with(backend_cls):
+    saved = dict(registry._factories)
+    registry._factories.clear()
+    registry.register("jax-llm", backend_cls)
+    ml = ModelLoader()
+    ml.load(ModelConfig.from_dict({"name": "m", "backend": "jax-llm",
+                                   "parameters": {"model": "dir"}}))
+    return ml, saved
+
+
+def test_watchdog_demote_mode(model):
+    os.environ["LOCALAI_WATCHDOG_DEMOTE"] = "on"
+    ml, saved = _loader_with(_PagedBackend)
+    try:
+        ml.mark_idle("m")
+        wd = WatchDog(ml, idle_timeout=100, enable_idle=True)
+        child = tm.MODEL_EVICTIONS.labels(reason="watchdog_demote")
+        before = child.value
+        # first expiry: demoted, NOT killed, idle clock restarts
+        assert wd.check(time.monotonic() + 101) == []
+        assert ml.loaded_names() == ["m"]
+        assert child.value == before + 1
+        # model stays idle through ANOTHER full timeout while warm:
+        # the backend reports "warm" and the kill path runs
+        assert wd.check(time.monotonic() + 300) == ["m"]
+        assert ml.loaded_names() == []
+    finally:
+        registry._factories.clear()
+        registry._factories.update(saved)
+        ml.stop_all()
+
+
+def test_watchdog_demote_busy_transfer_skips_tick(model):
+    os.environ["LOCALAI_WATCHDOG_DEMOTE"] = "on"
+
+    class Busy(_PagedBackend):
+        def __init__(self):
+            super().__init__()
+            self.script = ["busy", "busy"]
+
+    ml, saved = _loader_with(Busy)
+    try:
+        ml.mark_idle("m")
+        wd = WatchDog(ml, idle_timeout=10, enable_idle=True)
+        # a demotion already aloft: neither demote-count nor kill,
+        # the decision is deferred to the next tick
+        assert wd.check(time.monotonic() + 11) == []
+        assert ml.loaded_names() == ["m"]
+    finally:
+        registry._factories.clear()
+        registry._factories.update(saved)
+        ml.stop_all()
+
+
+def test_watchdog_demote_off_keeps_kill_path(model):
+    os.environ["LOCALAI_WATCHDOG_DEMOTE"] = "off"
+    ml, saved = _loader_with(_PagedBackend)
+    try:
+        ml.mark_idle("m")
+        wd = WatchDog(ml, idle_timeout=10, enable_idle=True)
+        assert wd.check(time.monotonic() + 11) == ["m"]
+        assert ml.loaded_names() == []
+    finally:
+        registry._factories.clear()
+        registry._factories.update(saved)
+        ml.stop_all()

@@ -49,6 +49,10 @@ REQUIRED_FAMILIES = {
     "engine_kv_tier_moves_total",
     "engine_kv_tier_prefetch_total",
     "engine_kv_tier_bytes_moved_total",
+    "engine_weight_pages_count",
+    "engine_weight_page_moves_total",
+    "engine_weight_prefetch_total",
+    "engine_model_residency_count",
     "engine_disagg_requests_total",
     "engine_kv_migrated_pages_total",
     "engine_kv_migration_seconds",

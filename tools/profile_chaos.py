@@ -21,6 +21,13 @@ one JSON report with the acceptance numbers the robustness PR tracks:
     followup must be served, and both KV pools PLUS the host
     interchange must come out leak-clean
 
+  gallery leg (one paged engine, engine/weight_pager.py):
+    faults on the weights.demote D2H page-out (the model must stay hot
+    and keep serving) and on the weights.fetch H2D layer stream (the
+    promotion must fall back to one cold blocking load and the request
+    still serve, with exactly one terminal event). Pager accounting
+    must come out leak-clean after both storms.
+
   federation leg (balancer + 2 member instances over localhost HTTP):
     failover_latency_s     — kill a member; time until the breaker
                              opens via the active /healthz probe
@@ -252,6 +259,74 @@ def disagg_leg(flood: int) -> dict:
     return out
 
 
+def gallery_leg() -> dict:
+    """Chaos on the weight pager: a demote fault must leave the model
+    hot and serving; a fetch fault mid-promotion must fall back to one
+    cold blocking load with the request still served — exactly one
+    terminal event either way, and the pager leak-clean after both."""
+    from localai_tfp_tpu.engine.engine import GenRequest
+    from localai_tfp_tpu.utils import faultinject as fi
+
+    saved = os.environ.get("LOCALAI_WEIGHT_PAGING")
+    os.environ["LOCALAI_WEIGHT_PAGING"] = "on"
+    eng, tk = _build_engine()
+    out: dict = {}
+
+    def demote_now(timeout=30.0):
+        t0 = time.monotonic()
+        while not eng._pager.request_demote():
+            if time.monotonic() - t0 > timeout:
+                raise TimeoutError("engine never went quiet")
+            time.sleep(0.01)
+        assert eng._pager.settle(timeout)
+
+    try:
+        pager = eng._pager
+        ev = eng.generate(GenRequest(prompt_ids=tk.encode("warm"),
+                                     max_tokens=4, ignore_eos=True))
+        assert ev.finish_reason == "length", ev.error
+
+        # ---- fault on the D2H page-out: abandon, stay hot, serve ----
+        fi.arm("weights.demote:fail@1")
+        demote_now()
+        fi.disarm()
+        n, ev = _drain(eng.submit(GenRequest(
+            prompt_ids=tk.encode("after demote fault"), max_tokens=4,
+            ignore_eos=True)))
+        out["demote_fault"] = {
+            "stayed_hot": pager.state == "hot"
+            and eng.params is not None,
+            "faulted_demotes": pager.counters["faulted_demotes"],
+            "served": ev.finish_reason == "length" and n == 1,
+        }
+
+        # ---- fault on the H2D layer stream: cold fallback, serve ----
+        demote_now()
+        assert pager.state == "warm" and eng.params is None
+        fi.arm("weights.fetch:fail@1")
+        n, ev = _drain(eng.submit(GenRequest(
+            prompt_ids=tk.encode("after fetch fault"), max_tokens=4,
+            ignore_eos=True)))
+        fi.disarm()
+        out["fetch_fault"] = {
+            "cold_fallbacks": pager.counters["cold_fallbacks"],
+            "promoted_hot": pager.state == "hot",
+            "served": ev.finish_reason == "length",
+            "one_terminal": n == 1,
+        }
+        pager.leak_check()
+        out["pager_leak_check"] = "clean"
+        out["stats"] = pager.stats()
+    finally:
+        fi.disarm()
+        eng.close()
+        if saved is None:
+            os.environ.pop("LOCALAI_WEIGHT_PAGING", None)
+        else:
+            os.environ["LOCALAI_WEIGHT_PAGING"] = saved
+    return out
+
+
 def _spawn_member(models_dir: str, cwd: str, port: int):
     import subprocess
 
@@ -446,6 +521,7 @@ def main() -> None:
     report = {
         "engine": engine_leg(args.flood),
         "disagg": disagg_leg(max(4, args.flood // 4)),
+        "gallery": gallery_leg(),
         "federation": asyncio.run(federation_leg(args.probe_s)),
         "tracing": asyncio.run(tracing_leg()),
     }

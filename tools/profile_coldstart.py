@@ -13,12 +13,21 @@ Runs the SAME path bench.py's 8B leg takes: real-format HF checkpoint
 (artifact cache on, so the second run measures the artifact-mode load).
 On CPU hosts a tiny geometry is substituted so the tool runs anywhere.
 
+The --gallery mode measures the weight-paging story instead
+(engine/weight_pager.py): N models round-robin on one chip with the
+HBM weight budget sized for ~2 of them, so every visit to a paged-out
+model pays a warm PROMOTION (layer-streamed H2D from the host mirror)
+rather than a cold load. Reports cold vs warm vs hot first-token
+latency, the HBM high-water mark against the budget, and LRU thrash
+(coordinator pressure demotions).
+
 Usage:
   python tools/profile_coldstart.py            # geometry by backend
   python tools/profile_coldstart.py --tiny     # force tiny (CPU smoke)
   python tools/profile_coldstart.py --cold     # drop the quant artifact
                                                # first: measure the full
                                                # (streamed) load
+  python tools/profile_coldstart.py --gallery  # N-model paging smoke
 """
 
 from __future__ import annotations
@@ -27,9 +36,123 @@ import argparse
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+
+def _pctl(xs: list, q: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def gallery_shape(n_models: int = 4, rounds: int = 3) -> dict:
+    """The gallery contention story on small engines: N models share
+    one chip, the weight-HBM budget fits ~2, a round-robin client
+    visits them all. First-token latency is bucketed by the pager
+    state the visit found (cold = engine build + transfer + first
+    step; warm = layer-streamed promotion; hot = resident). Returns
+    the JSON-able shape bench.py embeds as ``extra.weight_paging``."""
+    import jax
+    import jax.numpy as jnp
+
+    from localai_tfp_tpu.engine.engine import GenRequest, LLMEngine
+    from localai_tfp_tpu.engine.tokenizer import ByteTokenizer
+    from localai_tfp_tpu.engine.weight_pager import COORD
+    from localai_tfp_tpu.models.llm_spec import tiny_spec
+    from localai_tfp_tpu.models.transformer import init_params
+
+    tok = ByteTokenizer()
+    spec = tiny_spec(vocab_size=tok.vocab_size, max_position=256)
+    saved = {k: os.environ.get(k)
+             for k in ("LOCALAI_WEIGHT_PAGING", "LOCALAI_WEIGHT_HBM_MB")}
+    os.environ["LOCALAI_WEIGHT_PAGING"] = "on"
+    os.environ["LOCALAI_WEIGHT_HBM_MB"] = "0"
+    engines: list = []
+    high_water = 0
+    thrash0 = COORD.counters["pressure_demotes"]
+
+    def first_token_s(eng, prompt: str) -> float:
+        t0 = time.perf_counter()
+        q = eng.submit(GenRequest(prompt_ids=eng.tokenize(prompt),
+                                  max_tokens=4, temperature=0.0,
+                                  ignore_eos=True))
+        t1 = None
+        while True:
+            ev = q.get(timeout=300)
+            if t1 is None and ev.token_id is not None:
+                t1 = time.perf_counter()
+            if ev.done:
+                break
+        return (t1 or time.perf_counter()) - t0
+
+    try:
+        cold, warm, hot = [], [], []
+        budget_mb = 0.0
+        for i in range(n_models):
+            params = init_params(jax.random.PRNGKey(i), spec,
+                                 dtype=jnp.float32)
+            t0 = time.perf_counter()
+            eng = LLMEngine(spec, params, tok, n_slots=2, max_seq=128,
+                            prefill_buckets=(8, 32))
+            cold.append(time.perf_counter() - t0
+                        + first_token_s(eng, f"gallery model {i}"))
+            engines.append(eng)
+            if i == 0:
+                # budget fits ~2 trees: from the third model on, every
+                # arrival pressures the LRU resident out
+                budget_mb = (eng._pager.tree_bytes() * 2.5) / (1 << 20)
+                os.environ["LOCALAI_WEIGHT_HBM_MB"] = \
+                    f"{budget_mb:.6f}"
+            high_water = max(high_water, sum(
+                e._pager.device_bytes() for e in engines))
+        for r in range(rounds):
+            for i, eng in enumerate(engines):
+                state = eng._pager.state
+                dt = first_token_s(eng, f"round {r} model {i}")
+                (hot if state == "hot" else warm).append(dt)
+                high_water = max(high_water, sum(
+                    e._pager.device_bytes() for e in engines))
+        # let in-flight pressure demotions land before reading state
+        for eng in engines:
+            eng._pager.settle(30)
+        residency = COORD.residency()
+        for eng in engines:
+            eng._pager.leak_check()
+        cold_p50, warm_p50 = _pctl(cold, 0.5), _pctl(warm, 0.5)
+        return {
+            "n_models": n_models,
+            "rounds": rounds,
+            "tree_mb": round(
+                engines[0]._pager.tree_bytes() / (1 << 20), 3),
+            "hbm_budget_mb": round(budget_mb, 3),
+            "cold_first_token_s": {
+                "p50": round(cold_p50, 4), "max": round(max(cold), 4),
+                "n": len(cold)},
+            "warm_first_token_s": {
+                "p50": round(warm_p50, 4),
+                "max": round(max(warm), 4) if warm else 0.0,
+                "n": len(warm)},
+            "hot_first_token_s": {
+                "p50": round(_pctl(hot, 0.5), 4), "n": len(hot)},
+            "warm_vs_cold_speedup": round(
+                cold_p50 / max(warm_p50, 1e-9), 2) if warm else None,
+            "hbm_high_water_mb": round(high_water / (1 << 20), 3),
+            "lru_thrash_demotes":
+                COORD.counters["pressure_demotes"] - thrash0,
+            "residency": residency,
+        }
+    finally:
+        for eng in engines:
+            eng.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def main() -> None:
@@ -40,10 +163,33 @@ def main() -> None:
                     help="remove the quant artifact first (full load)")
     ap.add_argument("--no-warmup-reuse", action="store_true",
                     help="ignore persistent-cache warmup markers")
+    ap.add_argument("--gallery", action="store_true",
+                    help="N-model round-robin weight-paging smoke")
+    ap.add_argument("--models", type=int, default=4,
+                    help="gallery size (with --gallery)")
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="round-robin passes (with --gallery)")
     args = ap.parse_args()
 
     if args.no_warmup_reuse:
         os.environ["LOCALAI_WARMUP_REUSE"] = "off"
+
+    if args.gallery:
+        g = gallery_shape(n_models=args.models, rounds=args.rounds)
+        print(f"\ngallery: {g['n_models']} models x {g['rounds']} "
+              f"rounds, {g['tree_mb']:.1f} MB trees under a "
+              f"{g['hbm_budget_mb']:.1f} MB weight budget")
+        for k in ("cold", "warm", "hot"):
+            row = g[f"{k}_first_token_s"]
+            print(f"  {k:<5} first token p50 {row['p50'] * 1e3:8.1f} ms"
+                  f"   (n={row['n']})")
+        print(f"  warm vs cold speedup : {g['warm_vs_cold_speedup']}x")
+        print(f"  HBM high water       : {g['hbm_high_water_mb']:.1f} "
+              f"MB (budget {g['hbm_budget_mb']:.1f} MB)")
+        print(f"  LRU pressure demotes : {g['lru_thrash_demotes']}")
+        print(f"  residency at rest    : {g['residency']}")
+        print("\nJSON: " + json.dumps(g))
+        return
 
     import jax
 
